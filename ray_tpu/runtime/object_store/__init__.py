@@ -1,0 +1,5 @@
+from ray_tpu.runtime.object_store.store import (  # noqa: F401
+    ObjectStore,
+    StoreFullError,
+    ObjectNotFoundError,
+)

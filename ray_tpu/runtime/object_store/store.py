@@ -1,0 +1,233 @@
+"""Python client for the native shared-memory object store.
+
+Plasma-equivalent client API (reference: src/ray/object_manager/plasma/client.h)
+over the serverless C++ store in store.cpp. Every process (driver, workers,
+raylet) opens the same shared-memory file; `get` returns zero-copy memoryviews
+over the mapping, so numpy/jax host arrays deserialize without copies.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import time
+from typing import Optional
+
+from ray_tpu.runtime.object_store.build import ensure_built
+
+ID_SIZE = 20
+
+
+class StoreFullError(Exception):
+    pass
+
+
+class ObjectNotFoundError(Exception):
+    pass
+
+
+class _Lib:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            so = ensure_built()
+            lib = ctypes.CDLL(so)
+            lib.store_open.restype = ctypes.c_void_p
+            lib.store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int]
+            lib.store_close.argtypes = [ctypes.c_void_p]
+            lib.store_base.restype = ctypes.c_void_p
+            lib.store_base.argtypes = [ctypes.c_void_p]
+            for name in ("store_capacity", "store_used", "store_num_objects", "store_seal_count"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_uint64
+                fn.argtypes = [ctypes.c_void_p]
+            lib.store_create_object.restype = ctypes.c_int
+            lib.store_create_object.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint64)]
+            for name in ("store_seal", "store_release", "store_delete", "store_contains",
+                         "store_abort"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.store_get.restype = ctypes.c_int
+            lib.store_get.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.store_list.restype = ctypes.c_uint64
+            lib.store_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+            inst = object.__new__(cls)
+            inst.lib = lib
+            cls._instance = inst
+        return cls._instance
+
+
+class StoreBuffer:
+    """A zero-copy view of a sealed object.
+
+    Holds a read reference in the store for its lifetime: the object cannot be
+    evicted while any StoreBuffer on any process is alive.
+    """
+
+    __slots__ = ("store", "object_id", "data", "metadata", "_released")
+
+    def __init__(self, store: "ObjectStore", object_id: bytes, data: memoryview, metadata: bytes):
+        self.store = store
+        self.object_id = object_id
+        self.data = data
+        self.metadata = metadata
+        self._released = False
+
+    def release(self):
+        if self._released:
+            return
+        try:
+            self.data.release()
+        except BufferError:
+            # Exported views (e.g. numpy arrays) are still alive. Keep the
+            # store refcount held: dropping it would let eviction reuse the
+            # bytes under those views. The ref is retried at GC; if views
+            # outlive us we deliberately leak the ref (pin > corruption).
+            return
+        self._released = True
+        self.store._release(self.object_id)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+    def __len__(self):
+        return len(self.data)
+
+
+class ObjectStore:
+    """One per process; maps the node's shared-memory arena."""
+
+    def __init__(self, path: str, capacity: int = 0, create: bool = False,
+                 table_size: int = 0):
+        self._lib = _Lib().lib
+        self.path = path
+        self.handle = self._lib.store_open(
+            path.encode(), ctypes.c_uint64(capacity), ctypes.c_uint64(table_size),
+            1 if create else 0)
+        if not self.handle:
+            raise RuntimeError(f"failed to open object store at {path} (create={create})")
+        # Separate Python-level mapping of the same file for buffer views.
+        self._fd = os.open(path, os.O_RDWR)
+        size = os.fstat(self._fd).st_size
+        self._mm = mmap.mmap(self._fd, size)
+        self._view = memoryview(self._mm)
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._view.release()
+        except BufferError:
+            pass
+        try:
+            self._mm.close()
+        except BufferError:
+            pass
+        os.close(self._fd)
+        self._lib.store_close(self.handle)
+
+    # -- stats -------------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self._lib.store_capacity(self.handle)
+
+    @property
+    def used(self) -> int:
+        return self._lib.store_used(self.handle)
+
+    @property
+    def num_objects(self) -> int:
+        return self._lib.store_num_objects(self.handle)
+
+    # -- object ops --------------------------------------------------------
+    def create(self, object_id: bytes, data_size: int, metadata: bytes = b"") -> memoryview:
+        """Allocate an unsealed object; returns writable view of its data area."""
+        assert len(object_id) == ID_SIZE
+        off = ctypes.c_uint64()
+        rc = self._lib.store_create_object(
+            self.handle, object_id, ctypes.c_uint64(data_size),
+            ctypes.c_uint64(len(metadata)), ctypes.byref(off))
+        if rc == -1:
+            raise ValueError(f"object {object_id.hex()} already exists")
+        if rc == -2:
+            raise StoreFullError(
+                f"object store full: need {data_size}, capacity {self.capacity}, used {self.used}")
+        if rc == -3:
+            raise StoreFullError("object table full")
+        o = off.value
+        if metadata:
+            self._view[o + data_size:o + data_size + len(metadata)] = metadata
+        return self._view[o:o + data_size]
+
+    def seal(self, object_id: bytes):
+        rc = self._lib.store_seal(self.handle, object_id)
+        if rc == -1:
+            raise ValueError(f"seal: object {object_id.hex()} not found")
+        if rc == -2:
+            raise ValueError(
+                f"seal: object {object_id.hex()} not in created state (double seal?)")
+
+    def abort(self, object_id: bytes):
+        """Abort an in-progress create (frees the unsealed buffer)."""
+        self._lib.store_abort(self.handle, object_id)
+
+    def put(self, object_id: bytes, data, metadata: bytes = b"") -> None:
+        buf = self.create(object_id, len(data), metadata)
+        try:
+            buf[:] = data
+        except BaseException:
+            buf.release()
+            self.abort(object_id)
+            raise
+        buf.release()
+        self.seal(object_id)
+
+    def get(self, object_id: bytes, timeout: Optional[float] = 0) -> StoreBuffer:
+        """Get a sealed object; blocks up to `timeout` seconds for it to appear."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        sleep = 0.0002
+        while True:
+            off = ctypes.c_uint64()
+            dsz = ctypes.c_uint64()
+            msz = ctypes.c_uint64()
+            rc = self._lib.store_get(self.handle, object_id, ctypes.byref(off),
+                                     ctypes.byref(dsz), ctypes.byref(msz))
+            if rc == 0:
+                o, d, m = off.value, dsz.value, msz.value
+                data = self._view[o:o + d]
+                metadata = bytes(self._view[o + d:o + d + m]) if m else b""
+                return StoreBuffer(self, object_id, data, metadata)
+            if deadline is not None and time.monotonic() >= deadline:
+                raise ObjectNotFoundError(object_id.hex())
+            time.sleep(sleep)
+            sleep = min(sleep * 2, 0.01)
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(self._lib.store_contains(self.handle, object_id))
+
+    def delete(self, object_id: bytes) -> bool:
+        return self._lib.store_delete(self.handle, object_id) == 0
+
+    def list_objects(self, max_objects: int = 1 << 16) -> list[bytes]:
+        buf = ctypes.create_string_buffer(max_objects * ID_SIZE)
+        n = self._lib.store_list(self.handle, buf, ctypes.c_uint64(max_objects))
+        raw = buf.raw
+        return [raw[i * ID_SIZE:(i + 1) * ID_SIZE] for i in range(n)]
+
+    def _release(self, object_id: bytes):
+        if not self._closed:
+            self._lib.store_release(self.handle, object_id)

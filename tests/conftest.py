@@ -1,0 +1,32 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+The test environment may have a TPU PJRT plugin registered (which overrides
+JAX_PLATFORMS); we override back to CPU in-process before any backend
+initializes, mirroring the reference's trick of running scheduler/collective
+tests without accelerators (reference: python/ray/tests/conftest.py).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("RAY_TPU_TESTING", "1")
+# Ensure subprocesses (workers) also come up on CPU jax with 8 virtual devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_jax():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.default_backend() == "cpu"
+    assert len(jax.devices()) == 8
+    return jax
