@@ -1,0 +1,37 @@
+"""ray_tpu: a TPU-native distributed ML framework.
+
+A brand-new implementation of the Ray programming model (tasks, actors,
+objects, placement groups) and ML stack (train/data/tune/serve/llm/rl),
+designed TPU-first: JAX/XLA/Pallas for compute, `jax.lax` collectives over
+ICI for communication, and a scheduler that understands TPU chips and slices.
+See SURVEY.md at the repo root for the structural map to the reference.
+"""
+
+from ray_tpu.core.api import (  # noqa: F401
+    available_resources,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.exceptions import (  # noqa: F401
+    ActorDiedError,
+    ActorError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
+
+__version__ = "0.1.0"

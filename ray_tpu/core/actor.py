@@ -1,0 +1,128 @@
+"""Actor API: ActorClass and ActorHandle.
+
+Reference analog: python/ray/actor.py (ActorClass._remote:893 -> GCS-mediated
+creation; ActorHandle method submission via the direct actor transport).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.core import worker as worker_mod
+from ray_tpu.core.task_spec import ActorSpec
+from ray_tpu.runtime.scheduling import PlacementGroupStrategy
+from ray_tpu.utils.ids import ActorID
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        core = worker_mod.global_worker()
+        refs = core.submit_actor_task(
+            self._handle._actor_id, self._method_name, args, kwargs,
+            num_returns=self._num_returns,
+            name=f"{self._handle._class_name}.{self._method_name}",
+            max_task_retries=self._handle._max_task_retries)
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError("Actor methods cannot be called directly; use .remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: bytes, class_name: str, max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._max_task_retries = max_task_retries
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name, self._max_task_retries))
+
+
+class ActorClass:
+    # Default num_cpus=0 matches the reference: an actor's lifetime holds no
+    # CPU (only explicit num_cpus/num_tpus reservations pin resources).
+    def __init__(self, cls, *, num_cpus: float = 0.0, num_tpus: float = 0.0,
+                 resources: Optional[Dict[str, float]] = None, max_restarts: int = 0,
+                 max_task_retries: int = 0, max_concurrency: int = 1,
+                 name: Optional[str] = None, namespace: str = "default",
+                 lifetime: Optional[str] = None, scheduling_strategy=None):
+        self._cls = cls
+        self._num_cpus = num_cpus
+        self._num_tpus = num_tpus
+        self._resources = dict(resources or {})
+        self._max_restarts = max_restarts
+        self._max_task_retries = max_task_retries
+        self._max_concurrency = max_concurrency
+        self._name = name
+        self._namespace = namespace
+        self._lifetime = lifetime
+        self._scheduling_strategy = scheduling_strategy
+
+    def options(self, **overrides) -> "ActorClass":
+        kw = dict(num_cpus=self._num_cpus, num_tpus=self._num_tpus,
+                  resources=dict(self._resources), max_restarts=self._max_restarts,
+                  max_task_retries=self._max_task_retries,
+                  max_concurrency=self._max_concurrency, name=self._name,
+                  namespace=self._namespace, lifetime=self._lifetime,
+                  scheduling_strategy=self._scheduling_strategy)
+        kw.update(overrides)
+        return ActorClass(self._cls, **kw)
+
+    def _resource_demand(self) -> Dict[str, float]:
+        demand = dict(self._resources)
+        if self._num_cpus:
+            demand["CPU"] = float(self._num_cpus)
+        if self._num_tpus:
+            demand["TPU"] = float(self._num_tpus)
+        return demand
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        core = worker_mod.global_worker()
+        class_id = core.register_class(self._cls)
+        ser_args, names = core.serialize_args(args, kwargs)
+        pg_id, bundle_index = None, -1
+        strategy = self._scheduling_strategy
+        if isinstance(strategy, PlacementGroupStrategy):
+            pg_id = strategy.placement_group.id.binary()
+            bundle_index = strategy.bundle_index
+        spec = ActorSpec(
+            actor_id=ActorID.generate().binary(),
+            class_id=class_id, name=self._name,
+            class_name=self._cls.__name__, args=ser_args, kwarg_names=names,
+            resources=self._resource_demand(), max_restarts=self._max_restarts,
+            max_task_retries=self._max_task_retries,
+            max_concurrency=self._max_concurrency,
+            scheduling_strategy=strategy, placement_group_id=pg_id,
+            placement_group_bundle_index=bundle_index, namespace=self._namespace)
+        reply = core.create_actor(spec)
+        if not reply.get("ok"):
+            raise RuntimeError(f"actor creation failed: {reply.get('error')}")
+        return ActorHandle(spec.actor_id, self._cls.__name__, self._max_task_retries)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(f"Actor class {self._cls.__name__} cannot be instantiated "
+                        "directly; use .remote()")
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    core = worker_mod.global_worker()
+    info = core.get_actor_info(name=name, namespace=namespace)
+    if not info.get("found") or info["state"] == "DEAD":
+        raise ValueError(f"no live actor named {name!r}")
+    return ActorHandle(info["actor_id"], info["class_name"])

@@ -1,0 +1,220 @@
+"""Public API: init/shutdown/remote/get/put/wait/kill.
+
+Reference analog: python/ray/_private/worker.py (init:1285, shutdown:1894,
+get:2645, put:2813, wait:2878, remote:3266).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu.core import worker as worker_mod
+from ray_tpu.core.actor import ActorClass, get_actor  # noqa: F401
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu.core.worker import CoreWorker
+from ray_tpu.runtime import node as node_mod
+from ray_tpu.runtime import resources as resources_mod
+
+_head: Optional[node_mod.NodeProcesses] = None
+
+
+def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         object_store_memory: int = 2 << 30,
+         labels: Optional[Dict[str, str]] = None,
+         worker_env: Optional[Dict[str, str]] = None,
+         ignore_reinit_error: bool = False) -> "RuntimeContext":
+    """Start a local cluster (default) or connect to an existing one
+    (address="host:port" of its GCS)."""
+    global _head
+    if worker_mod.is_initialized():
+        if ignore_reinit_error:
+            return RuntimeContext()
+        raise RuntimeError("ray_tpu.init() already called (use ignore_reinit_error)")
+
+    if address is None:
+        session_dir = node_mod.new_session_dir()
+        processes = node_mod.NodeProcesses(session_dir)
+        processes.gcs_proc, processes.gcs_address = node_mod.start_gcs(session_dir)
+        # Workers must resolve by-reference pickles (module-level functions/
+        # classes) against the driver's import paths (runtime_env working_dir
+        # equivalent for the local-cluster case).
+        import sys as _sys
+        driver_path = ":".join(p for p in _sys.path if p)
+        worker_env = dict(worker_env or {})
+        worker_env.setdefault(
+            "PYTHONPATH",
+            driver_path + ":" + os.environ.get("PYTHONPATH", ""))
+        res = resources_mod.node_resources(num_cpus, num_tpus, None, resources)
+        node_labels = dict(resources_mod.tpu_slice_labels())
+        node_labels.update(labels or {})
+        processes.raylet_proc, info = node_mod.start_raylet(
+            session_dir, processes.gcs_address, res, node_labels,
+            object_store_memory, is_head=True, worker_env=worker_env)
+        processes.node_id = bytes.fromhex(info["node_id"])
+        processes.raylet_address = tuple(info["address"])
+        processes.store_path = info["store_path"]
+        _head = processes
+        core = CoreWorker(
+            mode="driver", gcs_address=processes.gcs_address,
+            raylet_address=processes.raylet_address,
+            store_path=processes.store_path, session_dir=session_dir,
+            node_id=processes.node_id)
+    else:
+        host, port = address.rsplit(":", 1)
+        gcs_address = (host, int(port))
+        # Connect-only mode: pick the head (or first) node's raylet as local.
+        import asyncio
+
+        from ray_tpu.runtime.rpc import RpcClient
+
+        async def _discover():
+            client = RpcClient(*gcs_address)
+            await client.connect(timeout=30)
+            nodes = await client.call("get_nodes")
+            await client.close()
+            return nodes
+
+        loop = asyncio.new_event_loop()
+        try:
+            nodes = loop.run_until_complete(_discover())
+        finally:
+            loop.close()
+        if not nodes:
+            raise RuntimeError(f"no nodes registered at GCS {address}")
+        head = next((n for n in nodes if n["is_head"]), nodes[0])
+        core = CoreWorker(
+            mode="driver", gcs_address=gcs_address,
+            raylet_address=tuple(head["address"]),
+            store_path=head["object_store_path"] if os.path.exists(
+                head["object_store_path"]) else None,
+            session_dir=os.path.dirname(head["object_store_path"]),
+            node_id=head["node_id"])
+    core.job_id = core.io.run(core.gcs.call("register_job"))["job_id"]
+    worker_mod.set_global_worker(core)
+    atexit.register(_atexit_shutdown)
+    return RuntimeContext()
+
+
+def _atexit_shutdown():
+    try:
+        if worker_mod.is_initialized():
+            shutdown()
+    except Exception:
+        pass
+
+
+def shutdown():
+    global _head
+    if worker_mod.is_initialized():
+        core = worker_mod.global_worker()
+        core.shutdown(kill_cluster=_head is not None)
+        worker_mod.set_global_worker(None)
+    if _head is not None:
+        for proc in (_head.raylet_proc, _head.gcs_proc):
+            if proc is not None:
+                try:
+                    proc.wait(timeout=5)
+                except Exception:
+                    try:
+                        proc.kill()
+                    except Exception:
+                        pass
+        _head = None
+
+
+def is_initialized() -> bool:
+    return worker_mod.is_initialized()
+
+
+def remote(*args, **kwargs):
+    """@remote decorator for functions and classes, with or without options."""
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+
+    def decorator(target):
+        if isinstance(target, type):
+            allowed = {"num_cpus", "num_tpus", "resources", "max_restarts",
+                       "max_task_retries", "max_concurrency", "name", "namespace",
+                       "lifetime", "scheduling_strategy"}
+            opts = {k: v for k, v in kwargs.items() if k in allowed}
+            return ActorClass(target, **opts)
+        allowed = {"num_returns", "num_cpus", "num_tpus", "resources",
+                   "max_retries", "scheduling_strategy"}
+        opts = {k: v for k, v in kwargs.items() if k in allowed}
+        return RemoteFunction(target, **opts)
+
+    return decorator
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    core = worker_mod.global_worker()
+    if isinstance(refs, ObjectRef):
+        return core.get_one(refs, timeout)
+    return core.get(list(refs), timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return worker_mod.global_worker().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None
+         ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    return worker_mod.global_worker().wait(refs, num_returns, timeout)
+
+
+def kill(actor_handle, *, no_restart: bool = True):
+    worker_mod.global_worker().kill_actor(actor_handle._actor_id, no_restart)
+
+
+class RuntimeContext:
+    @property
+    def gcs_address(self) -> Optional[str]:
+        core = worker_mod.global_worker()
+        return f"{core.gcs.host}:{core.gcs.port}"
+
+    @property
+    def node_id(self):
+        return worker_mod.global_worker().node_id
+
+    @property
+    def session_dir(self):
+        return worker_mod.global_worker().session_dir
+
+    @property
+    def current_actor_id(self):
+        return worker_mod.global_worker().current_actor_id
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()
+
+
+def nodes() -> List[dict]:
+    core = worker_mod.global_worker()
+    return core.io.run(core.gcs.call("get_nodes"))
+
+
+def cluster_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        for k, v in n["resources"].items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        for k, v in n["available"].items():
+            total[k] = total.get(k, 0.0) + v
+    return total

@@ -1,0 +1,64 @@
+"""Exception types surfaced by the public API.
+
+Reference analog: python/ray/exceptions.py.
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all ray_tpu errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at `get` with remote traceback."""
+
+    def __init__(self, function_name: str, traceback_str: str,
+                 cause: BaseException | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"task {function_name} failed:\n{traceback_str}")
+
+    def __reduce__(self):
+        # Exceptions with non-(args)-shaped __init__ need explicit reduce to
+        # survive the RPC pickle path.
+        return (type(self), (self.function_name, self.traceback_str, self.cause))
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id_hex: str, reason: str = ""):
+        self.actor_id_hex = actor_id_hex
+        self.reason = reason
+        super().__init__(f"actor {actor_id_hex[:12]} died: {reason}")
+
+    def __reduce__(self):
+        return (type(self), (self.actor_id_hex, self.reason))
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing a task died (e.g. OOM-killed, segfault)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupError(RayTpuError):
+    pass

@@ -1,0 +1,52 @@
+"""ObjectRef: a future-like handle to a task result or put object.
+
+Reference analog: python/ray/_raylet.pyx ObjectRef + ownership in
+src/ray/core_worker/reference_count.h (ours records the owner address for
+the cross-node pull protocol).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "__weakref__")
+
+    def __init__(self, object_id: bytes, owner: Optional[bytes] = None):
+        self._id = object_id
+        self._owner = owner
+
+    def binary(self) -> bytes:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    @property
+    def owner(self) -> Optional[bytes]:
+        return self._owner
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()[:16]})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self._id, self._owner))
+
+    # Allow `await ref` inside async actors / drivers.
+    def __await__(self):
+        from ray_tpu.core.worker import global_worker
+        worker = global_worker()
+
+        async def _get():
+            import asyncio
+            loop = asyncio.get_event_loop()
+            return await loop.run_in_executor(None, worker.get_one, self, None)
+
+        return _get().__await__()

@@ -1,0 +1,67 @@
+"""@ray_tpu.remote functions.
+
+Reference analog: python/ray/remote_function.py (RemoteFunction._remote:303).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu.core import worker as worker_mod
+from ray_tpu.runtime.scheduling import PlacementGroupStrategy
+
+DEFAULT_MAX_RETRIES = 3
+
+
+class RemoteFunction:
+    def __init__(self, fn, *, num_returns: int = 1, num_cpus: float = 1.0,
+                 num_tpus: float = 0.0, resources: Optional[Dict[str, float]] = None,
+                 max_retries: int = DEFAULT_MAX_RETRIES, scheduling_strategy=None):
+        self._fn = fn
+        self._num_returns = num_returns
+        self._num_cpus = num_cpus
+        self._num_tpus = num_tpus
+        self._resources = dict(resources or {})
+        self._max_retries = max_retries
+        self._scheduling_strategy = scheduling_strategy
+        functools.update_wrapper(self, fn)
+
+    def options(self, **overrides) -> "RemoteFunction":
+        kw = dict(
+            num_returns=self._num_returns, num_cpus=self._num_cpus,
+            num_tpus=self._num_tpus, resources=dict(self._resources),
+            max_retries=self._max_retries,
+            scheduling_strategy=self._scheduling_strategy)
+        kw.update(overrides)
+        return RemoteFunction(self._fn, **kw)
+
+    def _resource_demand(self) -> Dict[str, float]:
+        demand = dict(self._resources)
+        if self._num_cpus:
+            demand["CPU"] = float(self._num_cpus)
+        if self._num_tpus:
+            demand["TPU"] = float(self._num_tpus)
+        return demand
+
+    def remote(self, *args, **kwargs):
+        core = worker_mod.global_worker()
+        pg_id, bundle_index = None, -1
+        strategy = self._scheduling_strategy
+        if isinstance(strategy, PlacementGroupStrategy):
+            pg_id = strategy.placement_group.id.binary()
+            bundle_index = strategy.bundle_index
+        refs = core.submit_task(
+            self._fn, args, kwargs,
+            name=getattr(self._fn, "__qualname__", str(self._fn)),
+            num_returns=self._num_returns,
+            resources=self._resource_demand(),
+            max_retries=self._max_retries,
+            scheduling_strategy=strategy,
+            placement_group_id=pg_id, bundle_index=bundle_index)
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{getattr(self._fn, '__name__', '?')}' cannot be called "
+            "directly; use .remote() (or access the original via __wrapped__).")

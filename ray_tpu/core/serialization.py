@@ -1,0 +1,112 @@
+"""Value (de)serialization with zero-copy large buffers.
+
+Reference analog: python/ray/_private/serialization.py + the plasma buffer
+protocol. We use cloudpickle protocol 5: large contiguous buffers (numpy
+arrays, bytes) are extracted out-of-band and laid out after the pickle stream
+inside a single store object, so `get` reconstructs arrays as views over
+shared memory without copying.
+
+Object payload layout:
+    [u32 n_buffers][u64 pickle_len][u64 len × n_buffers]
+    [pickle bytes][pad to 8][buf 0][pad to 8][buf 1] ...
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+# Buffers >= this go out-of-band (below it, copying beats the bookkeeping).
+OUT_OF_BAND_THRESHOLD = 16 * 1024
+
+
+def _align8(n: int) -> int:
+    return (n + 7) & ~7
+
+
+def serialize(value: Any) -> Tuple[List, int]:
+    """Serialize `value` to (segments, total_size).
+
+    `segments` is a list of byte-likes whose concatenation is the object
+    payload; callers write them into a store buffer (or b"".join them for
+    inline transport) without extra copies of the large buffers.
+    """
+    buffers: List[pickle.PickleBuffer] = []
+
+    def buffer_callback(buf: pickle.PickleBuffer) -> bool:
+        raw = buf.raw()
+        if raw.nbytes >= OUT_OF_BAND_THRESHOLD and raw.contiguous:
+            buffers.append(buf)
+            return False  # out-of-band
+        return True  # in-band
+
+    pickled = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+    raw_views = [b.raw() for b in buffers]
+    header = struct.pack("<IQ", len(raw_views), len(pickled)) + b"".join(
+        struct.pack("<Q", v.nbytes) for v in raw_views)
+    segments: List = [header, pickled]
+    offset = len(header) + len(pickled)
+    for v in raw_views:
+        pad = _align8(offset) - offset
+        if pad:
+            segments.append(b"\x00" * pad)
+            offset += pad
+        segments.append(v)
+        offset += v.nbytes
+    return segments, offset
+
+
+def write_segments(dst: memoryview, segments: List) -> None:
+    off = 0
+    for seg in segments:
+        n = seg.nbytes if isinstance(seg, memoryview) else len(seg)
+        dst[off:off + n] = seg
+        off += n
+
+
+def join_segments(segments: List) -> bytes:
+    return b"".join(bytes(s) if isinstance(s, memoryview) else s for s in segments)
+
+
+class PinnedBuffer:
+    """A PEP-688 buffer that pins `pin` (e.g. a StoreBuffer read reference)
+    for as long as any consumer (numpy array, bytes view) is alive.
+
+    Zero-copy deserialization hands these to pickle: reconstructed arrays keep
+    the PinnedBuffer as their base, so the store refcount is held until the
+    arrays are garbage collected — eviction can never reuse live bytes.
+    """
+
+    __slots__ = ("_view", "_pin")
+
+    def __init__(self, view: memoryview, pin: Any):
+        self._view = view
+        self._pin = pin
+
+    def __buffer__(self, flags):
+        return memoryview(self._view)
+
+
+def deserialize(payload, pin: Any = None) -> Any:
+    """Deserialize a payload (memoryview => out-of-band buffers are views).
+
+    `pin` is attached to every out-of-band buffer: the returned object graph
+    keeps it (and thus the underlying store read reference) alive for as long
+    as the zero-copy arrays are.
+    """
+    view = payload if isinstance(payload, memoryview) else memoryview(payload)
+    n_buffers, pickle_len = struct.unpack_from("<IQ", view, 0)
+    lens = struct.unpack_from(f"<{n_buffers}Q", view, 12) if n_buffers else ()
+    off = 12 + 8 * n_buffers
+    pickled = view[off:off + pickle_len]
+    off += pickle_len
+    bufs = []
+    for ln in lens:
+        off = _align8(off)
+        chunk = view[off:off + ln]
+        bufs.append(PinnedBuffer(chunk, pin) if pin is not None else chunk)
+        off += ln
+    return pickle.loads(pickled, buffers=bufs)

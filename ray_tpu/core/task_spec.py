@@ -1,0 +1,52 @@
+"""Task and actor specifications passed over the wire.
+
+Reference analog: src/ray/common/task/task_spec.h:257 TaskSpecification (ours
+is a plain dataclass pickled by the RPC layer rather than a protobuf).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# An argument is ("v", payload_bytes) for pass-by-value or
+# ("r", object_id_bytes) for a shared-memory store reference.
+Arg = Tuple[str, bytes]
+
+
+@dataclass
+class TaskSpec:
+    task_id: bytes
+    fn_id: bytes              # key of pickled function in GCS KV
+    name: str                 # human-readable, for errors/metrics
+    args: List[Arg] = field(default_factory=list)
+    kwarg_names: List[Optional[str]] = field(default_factory=list)  # parallel to args; None = positional
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 3
+    # Actor fields (None for normal tasks)
+    actor_id: Optional[bytes] = None
+    method_name: Optional[str] = None
+    seq_no: int = 0
+    # Scheduling
+    scheduling_strategy: Any = None
+    placement_group_id: Optional[bytes] = None
+    placement_group_bundle_index: int = -1
+
+
+@dataclass
+class ActorSpec:
+    actor_id: bytes
+    class_id: bytes           # key of pickled class in GCS KV
+    name: Optional[str]       # named actor (GCS registry) or None
+    class_name: str
+    args: List[Arg] = field(default_factory=list)
+    kwarg_names: List[Optional[str]] = field(default_factory=list)
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    scheduling_strategy: Any = None
+    placement_group_id: Optional[bytes] = None
+    placement_group_bundle_index: int = -1
+    namespace: str = "default"

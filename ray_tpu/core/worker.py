@@ -1,0 +1,634 @@
+"""The per-process core worker: connections, object access, task submission.
+
+Reference analog: src/ray/core_worker/core_worker.h CoreWorker (Put
+core_worker.cc:1522, Get :1823, SubmitTask via
+transport/normal_task_submitter.cc:23 with per-SchedulingKey lease caching,
+SubmitActorTask :2803 via actor_task_submitter.h:75) plus the in-process
+memory store for inlined results (store_provider/memory_store/).
+
+One instance per process (driver or worker), created by ray_tpu.init() /
+worker bootstrap. Synchronous public methods; all I/O on a dedicated asyncio
+thread (the instrumented_io_context analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import os
+import threading
+import time
+from concurrent.futures import Future as SyncFuture
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu.core import serialization
+from ray_tpu.core.exceptions import (
+    ActorDiedError, GetTimeoutError, ObjectLostError, RayTpuError, TaskError,
+    WorkerCrashedError)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import ActorSpec, TaskSpec
+from ray_tpu.runtime.object_store import ObjectNotFoundError, ObjectStore
+from ray_tpu.runtime.rpc import ConnectionLost, EventLoopThread, RpcClient
+from ray_tpu.utils.ids import ObjectID, TaskID
+
+logger = logging.getLogger(__name__)
+
+INLINE_RESULT_MAX = 100 * 1024
+LEASE_IDLE_TIMEOUT_S = 1.0
+_MISSING = object()
+
+
+class _LeasedWorker:
+    def __init__(self, lease_id, worker_id, address, node_id):
+        self.lease_id = lease_id
+        self.worker_id = worker_id
+        self.address = address
+        self.node_id = node_id
+        self.client: Optional[RpcClient] = None
+        self.busy = False
+        self.return_timer: Optional[asyncio.TimerHandle] = None
+
+
+class _KeyState:
+    """Per-SchedulingKey submission state (normal_task_submitter.h:52)."""
+
+    def __init__(self):
+        self.queue: List[TaskSpec] = []
+        self.leases: List[_LeasedWorker] = []
+        self.inflight_reqs: set = set()  # outstanding lease request ids
+
+
+class CoreWorker:
+    def __init__(self, mode: str, gcs_address: Tuple[str, int],
+                 raylet_address: Optional[Tuple[str, int]],
+                 store_path: Optional[str], session_dir: str,
+                 node_id: Optional[bytes] = None):
+        self.mode = mode
+        self.session_dir = session_dir
+        self.node_id = node_id
+        self.io = EventLoopThread()
+        self.gcs = self.io.run(self._connect(gcs_address))
+        self.raylet = (self.io.run(self._connect(raylet_address))
+                       if raylet_address else None)
+        self.store = ObjectStore(store_path, create=False) if store_path else None
+        self.memory_store: Dict[bytes, Any] = {}      # oid -> deserialized value
+        self._object_locations: Dict[bytes, bytes] = {}  # oid -> node_id (plasma results)
+        self.result_futures: Dict[bytes, SyncFuture] = {}
+        self._mem_lock = threading.Lock()
+        self._registered_fns: set = set()
+        self._keys: Dict[Tuple, _KeyState] = {}
+        self._actor_clients: Dict[bytes, "_ActorClient"] = {}
+        self._put_refs: set = set()                   # plasma ids this process created
+        self.current_actor_id: Optional[bytes] = None
+        self.current_task_name: Optional[str] = None
+        self.job_id = None
+
+    @staticmethod
+    async def _connect(addr):
+        client = RpcClient(addr[0], addr[1])
+        await client.connect(timeout=60)
+        return client
+
+    # ------------------------------------------------------------------ put/get
+
+    def _require_store(self) -> ObjectStore:
+        if self.store is None:
+            raise RayTpuError(
+                "this process is not colocated with a node object store "
+                "(remote-attached driver); put/get of plasma objects is unavailable")
+        return self.store
+
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() does not accept ObjectRefs")
+        oid = ObjectID.generate().binary()
+        segments, total = serialization.serialize(value)
+        self._write_segments_to_plasma(oid, segments, total)
+        self._put_refs.add(oid)
+        return ObjectRef(oid, owner=self.node_id)
+
+    def _write_segments_to_plasma(self, oid: bytes, segments, total: int):
+        store = self._require_store()
+        buf = store.create(oid, total)
+        try:
+            serialization.write_segments(buf, segments)
+        except BaseException:
+            buf.release()
+            store.abort(oid)
+            raise
+        buf.release()
+        store.seal(oid)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            out.append(self.get_one(ref, remaining))
+        return out
+
+    def get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+        oid = ref.binary()
+        with self._mem_lock:
+            if oid in self.memory_store:
+                return self._raise_if_error(self.memory_store[oid])
+            fut = self.result_futures.get(oid)
+        if fut is not None:
+            try:
+                fut.result(timeout)
+            except TimeoutError:
+                raise GetTimeoutError(f"get() timed out waiting for {ref}")
+            with self._mem_lock:
+                if oid in self.memory_store:
+                    return self._raise_if_error(self.memory_store[oid])
+            # fell through: result is in plasma
+        location = self._object_locations.get(oid)
+        if location is not None and self.node_id is not None and location != self.node_id:
+            # Result lives in another node's store; the pull protocol lands
+            # with the object manager (M4). Fail loudly instead of hanging.
+            raise ObjectLostError(
+                f"object {ref} lives on node {location.hex()[:12]}; cross-node "
+                "object transfer is not available on this cluster")
+        store = self._require_store()
+        try:
+            buf = store.get(oid, timeout=timeout if timeout is not None else None)
+        except ObjectNotFoundError:
+            raise GetTimeoutError(f"get() timed out waiting for {ref}")
+        # `pin=buf` keeps the store read reference alive for as long as any
+        # zero-copy array deserialized out of this payload is.
+        value = serialization.deserialize(buf.data, pin=buf)
+        return self._raise_if_error(value)
+
+    @staticmethod
+    def _raise_if_error(value):
+        if isinstance(value, RayTpuError):
+            raise value
+        return value
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        assert num_returns <= len(refs)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        sleep = 0.0005
+        while len(ready) < num_returns:
+            still = []
+            for ref in pending:
+                oid = ref.binary()
+                with self._mem_lock:
+                    in_mem = oid in self.memory_store
+                    fut = self.result_futures.get(oid)
+                if in_mem or (fut is not None and fut.done()) or \
+                        (self.store is not None and self.store.contains(oid)):
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(sleep)
+            sleep = min(sleep * 1.5, 0.02)
+        return ready, pending
+
+    # ------------------------------------------------------------- functions
+
+    def register_function(self, fn) -> bytes:
+        pickled = cloudpickle.dumps(fn)
+        fn_id = hashlib.sha1(pickled).digest()
+        if fn_id not in self._registered_fns:
+            self.io.run(self.gcs.call("kv_put", key=b"fn:" + fn_id, value=pickled,
+                                      overwrite=False))
+            self._registered_fns.add(fn_id)
+        return fn_id
+
+    def register_class(self, cls) -> bytes:
+        pickled = cloudpickle.dumps(cls)
+        class_id = hashlib.sha1(pickled).digest()
+        if class_id not in self._registered_fns:
+            self.io.run(self.gcs.call("kv_put", key=b"cls:" + class_id, value=pickled,
+                                      overwrite=False))
+            self._registered_fns.add(class_id)
+        return class_id
+
+    # ------------------------------------------------------------ serialization
+
+    def serialize_args(self, args, kwargs) -> Tuple[List, List]:
+        """Build TaskSpec args: small values inline; ObjectRefs stay refs;
+        large values spill to plasma (DependencyResolver analog)."""
+        out, names = [], []
+        for name, value in [(None, a) for a in args] + list(kwargs.items()):
+            if isinstance(value, ObjectRef):
+                out.append(("r", value.binary()))
+            else:
+                segments, total = serialization.serialize(value)
+                if total > INLINE_RESULT_MAX and self.store is not None:
+                    oid = ObjectID.generate().binary()
+                    self._write_segments_to_plasma(oid, segments, total)
+                    self._put_refs.add(oid)
+                    out.append(("r", oid))
+                else:
+                    out.append(("v", serialization.join_segments(segments)))
+            names.append(name)
+        return out, names
+
+    def resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        """Worker-side: materialize TaskSpec args."""
+        args, kwargs = [], {}
+        for (kind, payload), name in zip(spec.args, spec.kwarg_names):
+            if kind == "v":
+                value = serialization.deserialize(payload)
+            else:
+                buf = self._require_store().get(payload, timeout=60)
+                value = serialization.deserialize(buf.data, pin=buf)
+            if name is None:
+                args.append(value)
+            else:
+                kwargs[name] = value
+        return args, kwargs
+
+    # ------------------------------------------------------------ normal tasks
+
+    def submit_task(self, fn, args, kwargs, *, name: str, num_returns: int,
+                    resources: Dict[str, float], max_retries: int,
+                    scheduling_strategy=None, placement_group_id=None,
+                    bundle_index=-1) -> List[ObjectRef]:
+        fn_id = self.register_function(fn)
+        ser_args, names = self.serialize_args(args, kwargs)
+        task_id = TaskID.generate().binary()
+        spec = TaskSpec(
+            task_id=task_id, fn_id=fn_id, name=name, args=ser_args,
+            kwarg_names=names, num_returns=num_returns, resources=resources,
+            max_retries=max_retries, scheduling_strategy=scheduling_strategy,
+            placement_group_id=placement_group_id,
+            placement_group_bundle_index=bundle_index)
+        refs = [ObjectRef(ObjectID.for_task_return(TaskID(task_id), i).binary(),
+                          owner=self.node_id)
+                for i in range(num_returns)]
+        with self._mem_lock:
+            for ref in refs:
+                self.result_futures[ref.binary()] = SyncFuture()
+        self.io.spawn(self._submit_async(spec))
+        return refs
+
+    def _scheduling_key(self, spec: TaskSpec) -> Tuple:
+        res = tuple(sorted(spec.resources.items()))
+        pg = (spec.placement_group_id, spec.placement_group_bundle_index)
+        return (spec.fn_id, res, pg)
+
+    async def _submit_async(self, spec: TaskSpec):
+        key = self._scheduling_key(spec)
+        state = self._keys.setdefault(key, _KeyState())
+        state.queue.append(spec)
+        await self._pump(key, state)
+
+    async def _pump(self, key, state: _KeyState):
+        # Assign queued tasks to idle leases.
+        for lease in state.leases:
+            if not state.queue:
+                break
+            if not lease.busy:
+                spec = state.queue.pop(0)
+                self._cancel_return(lease)
+                lease.busy = True
+                asyncio.ensure_future(self._run_on_lease(key, state, lease, spec))
+        # Match outstanding lease requests to unassigned work: request more if
+        # short, cancel extras if the queue drained (the raylet would otherwise
+        # grant stale speculative leases and starve other scheduling keys).
+        want = min(len(state.queue), 64)
+        if want > len(state.inflight_reqs):
+            for _ in range(want - len(state.inflight_reqs)):
+                req_id = os.urandom(8)
+                state.inflight_reqs.add(req_id)
+                asyncio.ensure_future(self._request_lease(key, state, req_id))
+        elif want < len(state.inflight_reqs):
+            extra = len(state.inflight_reqs) - want
+            for req_id in list(state.inflight_reqs)[:extra]:
+                asyncio.ensure_future(
+                    self.raylet.call("cancel_lease_request", req_id=req_id))
+
+    async def _request_lease(self, key, state: _KeyState, req_id: bytes):
+        spec_resources = dict(key[1])
+        pg_id, bundle_index = key[2]
+        try:
+            reply = await self.raylet.call(
+                "lease_worker", resources=spec_resources, req_id=req_id,
+                placement_group_id=pg_id, bundle_index=bundle_index)
+        except Exception as e:
+            state.inflight_reqs.discard(req_id)
+            self._fail_queued(state, RayTpuError(f"lease request failed: {e!r}"))
+            return
+        state.inflight_reqs.discard(req_id)
+        if not reply.get("ok"):
+            if reply.get("canceled"):
+                return
+            if state.queue:
+                self._fail_queued(state, RayTpuError(reply.get("error", "lease refused")))
+            return
+        lease = _LeasedWorker(reply["lease_id"], reply["worker_id"],
+                              tuple(reply["worker_address"]), reply["node_id"])
+        try:
+            lease.client = RpcClient(*lease.address)
+            await lease.client.connect(timeout=15)
+        except Exception:
+            await self._return_lease(state, lease, dead=True)
+            return
+        state.leases.append(lease)
+        await self._pump(key, state)
+        if not lease.busy:
+            # Granted after the queue drained (speculative grant): give the
+            # worker back promptly so it doesn't pin resources.
+            self._schedule_return(key, state, lease)
+
+    def _fail_queued(self, state: _KeyState, err: RayTpuError):
+        while state.queue:
+            spec = state.queue.pop(0)
+            self._complete_error(spec, err)
+
+    async def _resolve_dependencies(self, spec: TaskSpec) -> Optional[RayTpuError]:
+        """DependencyResolver analog (normal_task_submitter.cc): before pushing,
+        wait for pending ObjectRef args; inline values that live only in this
+        process's memory store (workers can't see it), keep plasma refs as-is.
+        Returns an error to propagate if a dependency failed."""
+        for i, (kind, payload) in enumerate(spec.args):
+            if kind != "r":
+                continue
+            oid = payload
+            with self._mem_lock:
+                fut = self.result_futures.get(oid)
+            if fut is not None:
+                try:
+                    await asyncio.wrap_future(fut)
+                except Exception:
+                    pass
+            with self._mem_lock:
+                value = self.memory_store.get(oid, _MISSING)
+            if value is not _MISSING:
+                if isinstance(value, RayTpuError):
+                    return value
+                segments, _ = serialization.serialize(value)
+                spec.args[i] = ("v", serialization.join_segments(segments))
+        return None
+
+    async def _run_on_lease(self, key, state: _KeyState, lease: _LeasedWorker,
+                            spec: TaskSpec):
+        dep_err = await self._resolve_dependencies(spec)
+        if dep_err is not None:
+            self._complete_error(spec, dep_err)
+            lease.busy = False
+            if state.queue:
+                await self._pump(key, state)
+            else:
+                self._schedule_return(key, state, lease)
+            return
+        try:
+            reply = await lease.client.call("push_task", spec=spec)
+        except (ConnectionLost, OSError):
+            state.leases.remove(lease)
+            await self._return_lease(state, lease, dead=True)
+            if spec.max_retries > 0:
+                spec.max_retries -= 1
+                logger.warning("task %s worker died; retrying", spec.name)
+                state.queue.append(spec)
+                await self._pump(key, state)
+            else:
+                self._complete_error(spec, WorkerCrashedError(
+                    f"worker running {spec.name} died"))
+            return
+        except Exception as e:
+            # Non-connection failure (e.g. worker couldn't load the function):
+            # surface it on the result futures and free the lease.
+            self._complete_error(spec, e if isinstance(e, RayTpuError)
+                                 else RayTpuError(f"task push failed: {e!r}"))
+            lease.busy = False
+            if state.queue:
+                await self._pump(key, state)
+            else:
+                self._schedule_return(key, state, lease)
+            return
+        self._complete_task(spec, reply)
+        lease.busy = False
+        if state.queue:
+            await self._pump(key, state)
+        else:
+            self._schedule_return(key, state, lease)
+
+    def _schedule_return(self, key, state: _KeyState, lease: _LeasedWorker):
+        loop = asyncio.get_event_loop()
+        self._cancel_return(lease)
+        lease.return_timer = loop.call_later(
+            LEASE_IDLE_TIMEOUT_S,
+            lambda: asyncio.ensure_future(self._maybe_return(key, state, lease)))
+
+    def _cancel_return(self, lease: _LeasedWorker):
+        if lease.return_timer is not None:
+            lease.return_timer.cancel()
+            lease.return_timer = None
+
+    async def _maybe_return(self, key, state: _KeyState, lease: _LeasedWorker):
+        if lease.busy or state.queue:
+            return
+        if lease in state.leases:
+            state.leases.remove(lease)
+        await self._return_lease(state, lease, dead=False)
+
+    async def _return_lease(self, state, lease: _LeasedWorker, dead: bool):
+        try:
+            await self.raylet.call("return_worker", lease_id=lease.lease_id,
+                                   worker_dead=dead)
+        except Exception:
+            pass
+        if lease.client is not None:
+            await lease.client.close()
+
+    def _complete_task(self, spec: TaskSpec, reply: dict):
+        if reply["status"] == "ok":
+            returns = reply["returns"]
+            node_id = reply.get("node_id")
+            with self._mem_lock:
+                for i, (kind, payload) in enumerate(returns):
+                    oid = ObjectID.for_task_return(TaskID(spec.task_id), i).binary()
+                    if kind == "v":
+                        self.memory_store[oid] = serialization.deserialize(payload)
+                    elif node_id is not None:
+                        # Sealed in the executing node's plasma store.
+                        self._object_locations[oid] = node_id
+                    fut = self.result_futures.pop(oid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(True)
+        else:
+            err = reply["error"]
+            self._complete_error(spec, err)
+
+    def _complete_error(self, spec: TaskSpec, err: RayTpuError):
+        with self._mem_lock:
+            for i in range(spec.num_returns):
+                oid = ObjectID.for_task_return(TaskID(spec.task_id), i).binary()
+                self.memory_store[oid] = err
+                fut = self.result_futures.pop(oid, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(True)
+
+    # ------------------------------------------------------------ actor tasks
+
+    def create_actor(self, spec: ActorSpec, timeout: float = 300.0) -> dict:
+        return self.io.run(self.gcs.call("create_actor", spec=spec, timeout=timeout))
+
+    def submit_actor_task(self, actor_id: bytes, method_name: str, args, kwargs,
+                          *, num_returns: int, name: str,
+                          max_task_retries: int = 0) -> List[ObjectRef]:
+        ser_args, names = self.serialize_args(args, kwargs)
+        task_id = TaskID.generate().binary()
+        spec = TaskSpec(task_id=task_id, fn_id=b"", name=name, args=ser_args,
+                        kwarg_names=names, num_returns=num_returns,
+                        max_retries=max_task_retries, actor_id=actor_id,
+                        method_name=method_name)
+        refs = [ObjectRef(ObjectID.for_task_return(TaskID(task_id), i).binary())
+                for i in range(num_returns)]
+        with self._mem_lock:
+            for ref in refs:
+                self.result_futures[ref.binary()] = SyncFuture()
+        client = self._actor_clients.get(actor_id)
+        if client is None:
+            client = self._actor_clients.setdefault(actor_id, _ActorClient(self, actor_id))
+        self.io.spawn(client.enqueue(spec))
+        return refs
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        self.io.run(self.gcs.call("kill_actor", actor_id=actor_id,
+                                  no_restart=no_restart))
+
+    def get_actor_info(self, actor_id=None, name=None, namespace="default") -> dict:
+        return self.io.run(self.gcs.call("get_actor", actor_id=actor_id, name=name,
+                                         namespace=namespace))
+
+    # ------------------------------------------------------------ shutdown
+
+    def shutdown(self, kill_cluster: bool):
+        try:
+            if kill_cluster:
+                self.io.run(self.gcs.call("shutdown_cluster", timeout=5), timeout=10)
+        except Exception:
+            pass
+        try:
+            for client in self._actor_clients.values():
+                if client.client is not None:
+                    self.io.run(client.client.close(), timeout=2)
+            self.io.run(self.gcs.close(), timeout=2)
+            if self.raylet is not None:
+                self.io.run(self.raylet.close(), timeout=2)
+        except Exception:
+            pass
+        self.io.stop()
+        if self.store is not None:
+            self.store.close()
+
+
+class _ActorClient:
+    """Direct submission channel to one actor (actor_task_submitter.h:75):
+    sequence numbers, ordered delivery, reconnect-on-restart."""
+
+    def __init__(self, core: CoreWorker, actor_id: bytes):
+        self.core = core
+        self.actor_id = actor_id
+        self.client: Optional[RpcClient] = None
+        self.seq_no = 0
+        self.connect_lock = asyncio.Lock()
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._pump_task: Optional[asyncio.Task] = None
+
+    async def enqueue(self, spec: TaskSpec):
+        """Per-caller FIFO: one pump drains the queue so wire order ==
+        submission order (ActorSchedulingQueue sequencing analog)."""
+        await self._queue.put(spec)
+        if self._pump_task is None or self._pump_task.done():
+            self._pump_task = asyncio.ensure_future(self._pump())
+
+    async def _pump(self):
+        while not self._queue.empty():
+            spec = self._queue.get_nowait()
+            try:
+                await self.submit(spec)
+            except Exception as e:
+                self.core._complete_error(spec, ActorDiedError(
+                    self.actor_id.hex(), f"submit failed: {e!r}"))
+
+    async def _ensure_connected(self):
+        if self.client is not None:
+            return
+        async with self.connect_lock:
+            if self.client is not None:
+                return
+            deadline = time.monotonic() + 120
+            while True:
+                info = await self.core.gcs.call("get_actor", actor_id=self.actor_id)
+                if not info.get("found"):
+                    raise ActorDiedError(self.actor_id.hex(), "unknown actor")
+                state = info["state"]
+                if state == "ALIVE":
+                    client = RpcClient(*info["address"])
+                    await client.connect(timeout=15)
+                    self.client = client
+                    return
+                if state == "DEAD":
+                    raise ActorDiedError(self.actor_id.hex(),
+                                         info.get("death_reason", ""))
+                if time.monotonic() > deadline:
+                    raise ActorDiedError(self.actor_id.hex(),
+                                         f"stuck in state {state}")
+                await asyncio.sleep(0.1)
+
+    async def submit(self, spec: TaskSpec):
+        dep_err = await self.core._resolve_dependencies(spec)
+        if dep_err is not None:
+            self.core._complete_error(spec, dep_err)
+            return
+        spec.seq_no = self.seq_no
+        self.seq_no += 1
+        attempts = spec.max_retries + 1
+        while attempts > 0:
+            attempts -= 1
+            try:
+                await self._ensure_connected()
+                reply = await self.client.call("push_actor_task", spec=spec)
+                self.core._complete_task(spec, reply)
+                return
+            except (ConnectionLost, OSError) as e:
+                # Connection died: drop the client; next attempt re-resolves
+                # the address (actor may be restarting).
+                if self.client is not None:
+                    await self.client.close()
+                    self.client = None
+                last_err = e
+            except ActorDiedError as e:
+                self.core._complete_error(spec, e)
+                return
+        self.core._complete_error(
+            spec, ActorDiedError(self.actor_id.hex(), f"connection lost: {last_err!r}"))
+
+
+# ---------------------------------------------------------------- globals
+
+_global_worker: Optional[CoreWorker] = None
+_global_lock = threading.Lock()
+
+
+def global_worker() -> CoreWorker:
+    if _global_worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _global_worker
+
+
+def set_global_worker(worker: Optional[CoreWorker]):
+    global _global_worker
+    with _global_lock:
+        _global_worker = worker
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None

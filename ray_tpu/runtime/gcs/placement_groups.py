@@ -1,0 +1,236 @@
+"""Placement group manager: 2-phase bundle reservation across raylets.
+
+Reference analog: src/ray/gcs/gcs_server/gcs_placement_group_manager.* and
+gcs_placement_group_scheduler.h:453 (Prepare/Commit two-phase protocol),
+strategies from src/ray/protobuf/common.proto:978-985 (PACK, SPREAD,
+STRICT_PACK, STRICT_SPREAD).
+
+TPU-native addition: STRICT_PACK placement prefers nodes advertising a whole
+ICI slice (label "tpu-slice"), so a bundle-per-chip group lands on one
+physically-connected slice (SURVEY §2 mapping note; see
+runtime/resources.py for slice detection).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.runtime import scheduling
+
+logger = logging.getLogger(__name__)
+
+PACK = "PACK"
+SPREAD = "SPREAD"
+STRICT_PACK = "STRICT_PACK"
+STRICT_SPREAD = "STRICT_SPREAD"
+
+PENDING = "PENDING"
+CREATED = "CREATED"
+REMOVED = "REMOVED"
+RESCHEDULING = "RESCHEDULING"
+
+
+class PlacementGroupRecord:
+    def __init__(self, pg_id: bytes, bundles: List[Dict[str, float]], strategy: str,
+                 name: str = ""):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = PENDING
+        # bundle index -> node_id
+        self.locations: List[Optional[bytes]] = [None] * len(bundles)
+
+    def view(self) -> dict:
+        return {
+            "placement_group_id": self.pg_id,
+            "name": self.name,
+            "strategy": self.strategy,
+            "bundles": self.bundles,
+            "state": self.state,
+            "locations": list(self.locations),
+        }
+
+
+class PlacementGroupManager:
+    def __init__(self, gcs):
+        self.gcs = gcs
+        self._groups: Dict[bytes, PlacementGroupRecord] = {}
+        self._lock = asyncio.Lock()
+
+    # ---- queries ----------------------------------------------------------
+
+    def bundle_location(self, pg_id: bytes, bundle_index: int) -> Optional[bytes]:
+        rec = self._groups.get(pg_id)
+        if rec is None or rec.state != CREATED:
+            return None
+        if bundle_index < 0:
+            for loc in rec.locations:
+                if loc is not None:
+                    return loc
+            return None
+        return rec.locations[bundle_index]
+
+    async def get(self, pg_id: bytes):
+        rec = self._groups.get(pg_id)
+        return {"found": rec is not None, **(rec.view() if rec else {})}
+
+    async def list(self):
+        return [r.view() for r in self._groups.values()]
+
+    # ---- creation: plan, then 2PC prepare/commit --------------------------
+
+    async def create(self, pg_id: bytes, bundles: List[Dict[str, float]],
+                     strategy: str = PACK, name: str = ""):
+        rec = PlacementGroupRecord(pg_id, bundles, strategy, name)
+        self._groups[pg_id] = rec
+        async with self._lock:
+            ok, err = await self._try_place(rec)
+        if not ok:
+            return {"ok": False, "error": err, "placement_group_id": pg_id}
+        rec.state = CREATED
+        await self.gcs.publish("placement_group", {"event": "created", "pg": rec.view()})
+        return {"ok": True, "placement_group_id": pg_id}
+
+    def _plan(self, rec: PlacementGroupRecord) -> Optional[List[Tuple[int, bytes]]]:
+        """Pick a node per bundle against a snapshot of available resources.
+
+        Returns [(bundle_index, node_id)] or None if infeasible.
+        """
+        nodes = [n for n in self.gcs._nodes.values() if n.alive]
+        snapshot = {n.node_id: dict(n.available) for n in nodes}
+        totals = {n.node_id: n.resources for n in nodes}
+        labels = {n.node_id: n.labels for n in nodes}
+        plan: List[Tuple[int, bytes]] = []
+
+        def fits_on(nid, bundle):
+            return scheduling.fits(snapshot[nid], bundle)
+
+        idxs = list(range(len(rec.bundles)))
+        if rec.strategy in (STRICT_PACK, PACK):
+            # Try to land everything on one node. STRICT_PACK: prefer nodes
+            # advertising an intact TPU slice (ICI-contiguous placement).
+            candidates = sorted(
+                snapshot.keys(),
+                key=lambda nid: (0 if labels[nid].get("tpu-slice") else 1,
+                                 scheduling.utilization_score(totals[nid], snapshot[nid], {})))
+            for nid in candidates:
+                snap = dict(snapshot[nid])
+                ok = True
+                for b in rec.bundles:
+                    if scheduling.fits(snap, b):
+                        scheduling.subtract(snap, b)
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    return [(i, nid) for i in idxs]
+            if rec.strategy == STRICT_PACK:
+                return None
+            # PACK falls back to spreading while preferring fewer nodes.
+        if rec.strategy == STRICT_SPREAD:
+            used_nodes = set()
+            for i in idxs:
+                placed = False
+                for nid in sorted(snapshot, key=lambda nid: scheduling.utilization_score(
+                        totals[nid], snapshot[nid], rec.bundles[i])):
+                    if nid in used_nodes or not fits_on(nid, rec.bundles[i]):
+                        continue
+                    scheduling.subtract(snapshot[nid], rec.bundles[i])
+                    used_nodes.add(nid)
+                    plan.append((i, nid))
+                    placed = True
+                    break
+                if not placed:
+                    return None
+            return plan
+        # PACK fallback / SPREAD: greedy per-bundle.
+        prefer_few = rec.strategy == PACK
+        for i in idxs:
+            order = sorted(
+                snapshot,
+                key=lambda nid: scheduling.utilization_score(
+                    totals[nid], snapshot[nid], rec.bundles[i]) * (-1 if prefer_few else 1))
+            placed = False
+            for nid in order:
+                if fits_on(nid, rec.bundles[i]):
+                    scheduling.subtract(snapshot[nid], rec.bundles[i])
+                    plan.append((i, nid))
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return plan
+
+    async def _try_place(self, rec: PlacementGroupRecord) -> Tuple[bool, str]:
+        plan = self._plan(rec)
+        if plan is None:
+            return False, "infeasible: no node assignment satisfies the bundles"
+        # Phase 1: prepare every bundle reservation.
+        prepared: List[Tuple[int, bytes]] = []
+        for i, nid in plan:
+            node = self.gcs._nodes.get(nid)
+            try:
+                r = await node.client.call("prepare_bundle", pg_id=rec.pg_id,
+                                           bundle_index=i, resources=rec.bundles[i],
+                                           timeout=30)
+            except Exception as e:
+                r = {"ok": False, "error": repr(e)}
+            if not r.get("ok"):
+                for j, njd in prepared:
+                    try:
+                        await self.gcs._nodes[njd].client.call(
+                            "cancel_bundle", pg_id=rec.pg_id, bundle_index=j, timeout=30)
+                    except Exception:
+                        pass
+                return False, r.get("error", "prepare failed")
+            prepared.append((i, nid))
+        # Phase 2: commit.
+        for i, nid in prepared:
+            await self.gcs._nodes[nid].client.call(
+                "commit_bundle", pg_id=rec.pg_id, bundle_index=i, timeout=30)
+            rec.locations[i] = nid
+        return True, ""
+
+    async def remove(self, pg_id: bytes):
+        rec = self._groups.get(pg_id)
+        if rec is None:
+            return {"ok": False}
+        for i, nid in enumerate(rec.locations):
+            if nid is None:
+                continue
+            node = self.gcs._nodes.get(nid)
+            if node is not None and node.alive:
+                try:
+                    await node.client.call("return_bundle", pg_id=pg_id, bundle_index=i,
+                                           timeout=30)
+                except Exception:
+                    pass
+        rec.state = REMOVED
+        rec.locations = [None] * len(rec.bundles)
+        await self.gcs.publish("placement_group", {"event": "removed", "pg": rec.view()})
+        return {"ok": True}
+
+    async def on_node_dead(self, node_id: bytes):
+        """Reschedule groups that had bundles on a dead node."""
+        for rec in self._groups.values():
+            if rec.state == CREATED and node_id in rec.locations:
+                rec.state = RESCHEDULING
+                for i, nid in enumerate(rec.locations):
+                    if nid is not None and nid != node_id:
+                        node = self.gcs._nodes.get(nid)
+                        if node is not None and node.alive:
+                            try:
+                                await node.client.call("return_bundle", pg_id=rec.pg_id,
+                                                       bundle_index=i, timeout=30)
+                            except Exception:
+                                pass
+                rec.locations = [None] * len(rec.bundles)
+                async with self._lock:
+                    ok, _ = await self._try_place(rec)
+                rec.state = CREATED if ok else PENDING
+                await self.gcs.publish("placement_group",
+                                       {"event": "rescheduled" if ok else "pending",
+                                        "pg": rec.view()})

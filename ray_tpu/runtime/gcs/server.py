@@ -1,0 +1,395 @@
+"""GCS: the cluster-global control service.
+
+Reference analog: src/ray/gcs/gcs_server/ (GcsServer gcs_server.h:89). One per
+cluster. Owns: internal KV (function/class table lives here —
+gcs_function_manager.h:32), node table (gcs_node_manager), actor directory +
+lifecycle state machine (gcs_actor_manager.h:291), named actors, placement
+groups (gcs_placement_group_manager, 2-phase Prepare/Commit), and cluster
+pubsub (InternalPubSubHandler). Persistence is the in-memory store client
+(in_memory_store_client.h); the StoreClient seam for a Redis-backed version
+is `self._kv` + the table dicts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core.task_spec import ActorSpec
+from ray_tpu.runtime.rpc import RpcClient, RpcServer, ServerConnection
+from ray_tpu.runtime import scheduling
+
+logger = logging.getLogger(__name__)
+
+# Actor lifecycle states (gcs_actor_manager.h state machine)
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class NodeRecord:
+    def __init__(self, node_id: bytes, address: Tuple[str, int], resources: Dict[str, float],
+                 object_store_path: str, is_head: bool, labels: Dict[str, str]):
+        self.node_id = node_id
+        self.address = address
+        self.resources = dict(resources)
+        self.available = dict(resources)  # updated by resource reports
+        self.object_store_path = object_store_path
+        self.is_head = is_head
+        self.labels = dict(labels)
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+        self.client: Optional[RpcClient] = None
+
+    def view(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "address": self.address,
+            "resources": dict(self.resources),
+            "available": dict(self.available),
+            "object_store_path": self.object_store_path,
+            "is_head": self.is_head,
+            "labels": dict(self.labels),
+            "alive": self.alive,
+        }
+
+
+class ActorRecord:
+    def __init__(self, spec: ActorSpec):
+        self.spec = spec
+        self.state = PENDING_CREATION
+        self.address: Optional[Tuple[str, int]] = None
+        self.node_id: Optional[bytes] = None
+        self.worker_id: Optional[bytes] = None
+        self.restarts_used = 0
+        self.death_reason = ""
+
+    def view(self) -> dict:
+        return {
+            "actor_id": self.spec.actor_id,
+            "name": self.spec.name,
+            "class_name": self.spec.class_name,
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id,
+            "restarts_used": self.restarts_used,
+            "max_restarts": self.spec.max_restarts,
+            "death_reason": self.death_reason,
+        }
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.server = RpcServer(host, port)
+        self.server.register_all(self)
+        self.server.on_disconnect = self._on_disconnect
+        self._kv: Dict[bytes, bytes] = {}
+        self._nodes: Dict[bytes, NodeRecord] = {}
+        self._actors: Dict[bytes, ActorRecord] = {}
+        self._named_actors: Dict[Tuple[str, str], bytes] = {}  # (namespace, name) -> actor_id
+        self._subscribers: Dict[str, Set[ServerConnection]] = {}
+        self._actor_locks: Dict[bytes, asyncio.Lock] = {}
+        self._pg_manager = None  # installed in M4 (placement groups)
+        self._health_task = None
+        self._shutdown = asyncio.Event()
+        # Job/task event tables (state API)
+        self._job_counter = 0
+        self._jobs: Dict[int, dict] = {}
+
+    async def start(self):
+        await self.server.start()
+        from ray_tpu.runtime.gcs.placement_groups import PlacementGroupManager
+        self._pg_manager = PlacementGroupManager(self)
+        self._health_task = asyncio.ensure_future(self._health_check_loop())
+        logger.info("GCS listening on %s:%d", self.server.host, self.server.port)
+        return self
+
+    @property
+    def address(self):
+        return self.server.address
+
+    # ---- node management -------------------------------------------------
+
+    async def handle_register_node(self, conn, node_id, address, resources,
+                                   object_store_path, is_head=False, labels=None):
+        rec = NodeRecord(node_id, tuple(address), resources, object_store_path,
+                         is_head, labels or {})
+        client = RpcClient(*rec.address)
+        await client.connect(timeout=10)
+        rec.client = client
+        self._nodes[node_id] = rec
+        conn.meta["node_id"] = node_id
+        await self.publish("node", {"event": "added", "node": rec.view()})
+        logger.info("node %s registered at %s resources=%s",
+                    node_id.hex()[:12], rec.address, resources)
+        return {"ok": True, "nodes": [n.view() for n in self._nodes.values()]}
+
+    async def handle_node_heartbeat(self, conn, node_id, available=None):
+        rec = self._nodes.get(node_id)
+        if rec is None:
+            return {"ok": False, "unknown": True}
+        rec.last_heartbeat = time.monotonic()
+        if available is not None:
+            rec.available = dict(available)
+        return {"ok": True}
+
+    async def handle_get_nodes(self, conn, only_alive=True):
+        return [n.view() for n in self._nodes.values() if n.alive or not only_alive]
+
+    async def handle_drain_node(self, conn, node_id):
+        await self._mark_node_dead(node_id, "drained")
+        return {"ok": True}
+
+    async def _on_disconnect(self, conn: ServerConnection):
+        for subs in self._subscribers.values():
+            subs.discard(conn)
+        node_id = conn.meta.get("node_id")
+        if node_id is not None and node_id in self._nodes and self._nodes[node_id].alive:
+            await self._mark_node_dead(node_id, "raylet disconnected")
+
+    async def _mark_node_dead(self, node_id: bytes, reason: str):
+        rec = self._nodes.get(node_id)
+        if rec is None or not rec.alive:
+            return
+        rec.alive = False
+        logger.warning("node %s marked dead: %s", node_id.hex()[:12], reason)
+        await self.publish("node", {"event": "removed", "node": rec.view(), "reason": reason})
+        # Fail/restart actors that lived on that node.
+        for actor in list(self._actors.values()):
+            if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION):
+                asyncio.ensure_future(
+                    self._handle_actor_failure(actor.spec.actor_id, f"node died: {reason}"))
+        if self._pg_manager is not None:
+            await self._pg_manager.on_node_dead(node_id)
+
+    async def _health_check_loop(self):
+        # gcs_health_check_manager analog: periodic liveness by heartbeat age.
+        while not self._shutdown.is_set():
+            await asyncio.sleep(1.0)
+            now = time.monotonic()
+            for rec in list(self._nodes.values()):
+                if rec.alive and now - rec.last_heartbeat > 30.0:
+                    await self._mark_node_dead(rec.node_id, "heartbeat timeout")
+
+    # ---- KV (function/class table, runtime metadata) ---------------------
+
+    async def handle_kv_put(self, conn, key: bytes, value: bytes, overwrite=True):
+        if not overwrite and key in self._kv:
+            return {"ok": False, "exists": True}
+        self._kv[key] = value
+        return {"ok": True}
+
+    async def handle_kv_get(self, conn, key: bytes):
+        return {"value": self._kv.get(key)}
+
+    async def handle_kv_del(self, conn, key: bytes):
+        return {"ok": self._kv.pop(key, None) is not None}
+
+    async def handle_kv_keys(self, conn, prefix: bytes = b""):
+        return {"keys": [k for k in self._kv if k.startswith(prefix)]}
+
+    # ---- pubsub ----------------------------------------------------------
+
+    async def handle_subscribe(self, conn, channels: List[str]):
+        for ch in channels:
+            self._subscribers.setdefault(ch, set()).add(conn)
+        return {"ok": True}
+
+    async def handle_publish(self, conn, channel: str, message: Any):
+        await self.publish(channel, message)
+        return {"ok": True}
+
+    async def publish(self, channel: str, message: Any):
+        dead = []
+        for conn in self._subscribers.get(channel, ()):  # long-poll-free push
+            try:
+                await conn.push("pubsub", {"channel": channel, "message": message})
+            except Exception:
+                dead.append(conn)
+        for conn in dead:
+            self._subscribers.get(channel, set()).discard(conn)
+
+    # ---- job table --------------------------------------------------------
+
+    async def handle_register_job(self, conn, metadata=None):
+        self._job_counter += 1
+        job_id = self._job_counter
+        self._jobs[job_id] = {"job_id": job_id, "start_time": time.time(),
+                              "metadata": metadata or {}, "alive": True}
+        return {"job_id": job_id}
+
+    async def handle_get_jobs(self, conn):
+        return list(self._jobs.values())
+
+    # ---- actor management (gcs_actor_manager.h:291 state machine) --------
+
+    async def handle_create_actor(self, conn, spec: ActorSpec):
+        if spec.name:
+            key = (spec.namespace, spec.name)
+            if key in self._named_actors:
+                existing = self._actors[self._named_actors[key]]
+                if existing.state != DEAD:
+                    return {"ok": False, "error": f"actor name {spec.name!r} already taken"}
+            self._named_actors[key] = spec.actor_id
+        record = ActorRecord(spec)
+        self._actors[spec.actor_id] = record
+        self._actor_locks[spec.actor_id] = asyncio.Lock()
+        try:
+            await self._schedule_and_create(record)
+        except Exception as e:
+            record.state = DEAD
+            record.death_reason = f"creation failed: {e!r}"
+            return {"ok": False, "error": record.death_reason}
+        return {"ok": True, "address": record.address, "actor_id": spec.actor_id}
+
+    async def _schedule_and_create(self, record: ActorRecord):
+        """GcsActorScheduler analog (gcs_actor_scheduler.h:111): lease a worker
+        from a raylet, push the creation task to it, record the address."""
+        spec = record.spec
+        last_err = None
+        import os as _os
+        for node in scheduling.rank_nodes_for_actor(self._nodes, spec, self._pg_manager):
+            req_id = _os.urandom(8)
+            try:
+                lease = await node.client.call(
+                    "lease_worker", resources=spec.resources, for_actor=True,
+                    placement_group_id=spec.placement_group_id,
+                    bundle_index=spec.placement_group_bundle_index,
+                    req_id=req_id, timeout=60)
+            except Exception as e:
+                last_err = e
+                # The pending lease (or a grant that raced the timeout) must
+                # not leak worker resources at the raylet.
+                try:
+                    await node.client.call("cancel_lease_request", req_id=req_id,
+                                           timeout=10)
+                except Exception:
+                    pass
+                continue
+            if not lease.get("ok"):
+                last_err = RuntimeError(lease.get("error", "lease refused"))
+                continue
+            worker_addr = tuple(lease["worker_address"])
+            worker_client = RpcClient(*worker_addr)
+            try:
+                await worker_client.connect(timeout=15)
+                reply = await worker_client.call("create_actor", spec=spec, timeout=300)
+                if not reply.get("ok"):
+                    raise RuntimeError(reply.get("error", "actor __init__ failed"))
+            except Exception as e:
+                last_err = e
+                try:
+                    await node.client.call("return_worker", lease_id=lease["lease_id"],
+                                           worker_dead=True)
+                except Exception:
+                    pass
+                # __init__ raising is terminal, not a scheduling failure.
+                if isinstance(e, RuntimeError):
+                    raise
+                continue
+            finally:
+                await worker_client.close()
+            record.state = ALIVE
+            record.address = worker_addr
+            record.node_id = node.node_id
+            record.worker_id = lease["worker_id"]
+            await self.publish("actor", {"event": "alive", "actor": record.view()})
+            return
+        raise RuntimeError(f"no feasible node for actor {spec.class_name} "
+                           f"(resources={spec.resources}): {last_err!r}")
+
+    async def handle_get_actor(self, conn, actor_id: Optional[bytes] = None,
+                               name: Optional[str] = None, namespace: str = "default"):
+        if actor_id is None and name is not None:
+            actor_id = self._named_actors.get((namespace, name))
+        rec = self._actors.get(actor_id) if actor_id else None
+        if rec is None:
+            return {"found": False}
+        return {"found": True, **rec.view()}
+
+    async def handle_list_actors(self, conn):
+        return [r.view() for r in self._actors.values()]
+
+    async def handle_kill_actor(self, conn, actor_id: bytes, no_restart=True):
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            return {"ok": False}
+        if no_restart:
+            rec.spec.max_restarts = 0
+        node = self._nodes.get(rec.node_id) if rec.node_id else None
+        if node is not None and node.alive and rec.worker_id is not None:
+            try:
+                await node.client.call("kill_worker", worker_id=rec.worker_id)
+            except Exception:
+                pass
+        return {"ok": True}
+
+    async def handle_report_worker_death(self, conn, node_id, worker_id, actor_id=None,
+                                         reason=""):
+        """Raylet tells us a worker process exited (node_manager death path)."""
+        if actor_id is not None:
+            await self._handle_actor_failure(actor_id, reason or "worker died")
+        return {"ok": True}
+
+    async def _handle_actor_failure(self, actor_id: bytes, reason: str):
+        rec = self._actors.get(actor_id)
+        if rec is None or rec.state == DEAD:
+            return
+        lock = self._actor_locks.setdefault(actor_id, asyncio.Lock())
+        async with lock:
+            if rec.state == DEAD:
+                return
+            if rec.restarts_used < rec.spec.max_restarts:
+                rec.restarts_used += 1
+                rec.state = RESTARTING
+                rec.address = None
+                await self.publish("actor", {"event": "restarting", "actor": rec.view()})
+                try:
+                    await self._schedule_and_create(rec)
+                except Exception as e:
+                    rec.state = DEAD
+                    rec.death_reason = f"restart failed: {e!r}"
+                    await self.publish("actor", {"event": "dead", "actor": rec.view()})
+            else:
+                rec.state = DEAD
+                rec.death_reason = reason
+                await self.publish("actor", {"event": "dead", "actor": rec.view()})
+
+    # ---- placement groups (delegated, see gcs/placement_groups.py) -------
+
+    async def handle_create_placement_group(self, conn, **kw):
+        return await self._pg_manager.create(**kw)
+
+    async def handle_remove_placement_group(self, conn, **kw):
+        return await self._pg_manager.remove(**kw)
+
+    async def handle_get_placement_group(self, conn, **kw):
+        return await self._pg_manager.get(**kw)
+
+    async def handle_list_placement_groups(self, conn):
+        return await self._pg_manager.list()
+
+    # ---- shutdown ---------------------------------------------------------
+
+    async def handle_shutdown_cluster(self, conn):
+        asyncio.ensure_future(self._do_shutdown())
+        return {"ok": True}
+
+    async def _do_shutdown(self):
+        await asyncio.sleep(0.05)  # let the reply flush
+        for rec in self._nodes.values():
+            if rec.alive and rec.client is not None:
+                try:
+                    await rec.client.call("shutdown_node", timeout=5)
+                except Exception:
+                    pass
+        self._shutdown.set()
+
+    async def wait_for_shutdown(self):
+        await self._shutdown.wait()
+        if self._health_task:
+            self._health_task.cancel()
+        await self.server.close()

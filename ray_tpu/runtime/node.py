@@ -1,0 +1,83 @@
+"""Node bootstrap: start/stop the head-node process tree.
+
+Reference analog: python/ray/_private/node.py (:1117-1429) and services.py
+(start_gcs_server:1445, start_raylet:1529): the driver spawns the GCS and a
+raylet as subprocesses and connects to them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+from typing import Dict, Optional, Tuple
+
+
+class NodeProcesses:
+    def __init__(self, session_dir: str):
+        self.session_dir = session_dir
+        self.gcs_proc: Optional[subprocess.Popen] = None
+        self.raylet_proc: Optional[subprocess.Popen] = None
+        self.gcs_address: Optional[Tuple[str, int]] = None
+        self.raylet_address: Optional[Tuple[str, int]] = None
+        self.node_id: Optional[bytes] = None
+        self.store_path: Optional[str] = None
+
+
+def new_session_dir() -> str:
+    base = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
+    session = os.path.join(base, f"session_{int(time.time())}_{uuid.uuid4().hex[:8]}")
+    os.makedirs(os.path.join(session, "logs"), exist_ok=True)
+    return session
+
+
+def _wait_file(path: str, timeout: float, proc: subprocess.Popen, what: str) -> str:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                return f.read()
+        if proc.poll() is not None:
+            raise RuntimeError(f"{what} exited with code {proc.returncode} during startup "
+                               f"(logs in {os.path.dirname(path)})")
+        time.sleep(0.02)
+    raise RuntimeError(f"timed out waiting for {what} to start")
+
+
+def start_gcs(session_dir: str) -> Tuple[subprocess.Popen, Tuple[str, int]]:
+    ready = os.path.join(session_dir, "gcs_ready")
+    log = open(os.path.join(session_dir, "logs", "gcs.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.runtime.gcs.main", "--ready-file", ready],
+        stdout=log, stderr=subprocess.STDOUT, start_new_session=True)
+    log.close()
+    addr = _wait_file(ready, 60, proc, "GCS")
+    host, port = addr.rsplit(":", 1)
+    return proc, (host, int(port))
+
+
+def start_raylet(session_dir: str, gcs_address: Tuple[str, int],
+                 resources: Dict[str, float], labels: Dict[str, str],
+                 object_store_memory: int, is_head: bool = False,
+                 worker_env: Optional[Dict[str, str]] = None,
+                 name: str = "raylet") -> Tuple[subprocess.Popen, dict]:
+    ready = os.path.join(session_dir, f"{name}_ready_{uuid.uuid4().hex[:6]}")
+    log = open(os.path.join(session_dir, "logs", f"{name}.log"), "ab")
+    cmd = [sys.executable, "-m", "ray_tpu.runtime.raylet.main",
+           "--gcs-address", f"{gcs_address[0]}:{gcs_address[1]}",
+           "--session-dir", session_dir,
+           "--resources", json.dumps(resources),
+           "--labels", json.dumps(labels),
+           "--object-store-memory", str(object_store_memory),
+           "--worker-env", json.dumps(worker_env or {}),
+           "--ready-file", ready]
+    if is_head:
+        cmd.append("--is-head")
+    proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                            start_new_session=True)
+    log.close()
+    info = json.loads(_wait_file(ready, 60, proc, "raylet"))
+    return proc, info

@@ -1,0 +1,416 @@
+"""Raylet: the per-node manager.
+
+Reference analog: src/ray/raylet/ — NodeManager (node_manager.h:118; lease
+handler node_manager.cc:1915), WorkerPool (worker_pool.h:127 PopWorker,
+prestart :234), LocalTaskManager (local_task_manager.cc:57), and the node's
+plasma store which it creates and owns (object_manager/plasma/store_runner).
+
+One process per node. Grants worker leases to drivers (normal tasks) and to
+the GCS (actor creation); owns local resource accounting including
+placement-group bundle reservations (PlacementGroupResourceManager analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.runtime import scheduling
+from ray_tpu.runtime.object_store import ObjectStore
+from ray_tpu.runtime.rpc import RpcClient, RpcServer
+from ray_tpu.utils.ids import NodeID, WorkerID
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_OBJECT_STORE_MEMORY = 2 << 30
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: bytes, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.address: Optional[Tuple[str, int]] = None
+        self.ready = asyncio.Event()
+        self.is_actor = False
+        self.actor_id: Optional[bytes] = None
+        self.lease_id: Optional[bytes] = None
+        self.lease_resources: Dict[str, float] = {}
+        self.pg_key: Optional[Tuple[bytes, int]] = None
+        self.req_id: Optional[bytes] = None
+
+
+class PendingLease:
+    def __init__(self, resources, for_actor, pg_key, fut, req_id=None):
+        self.resources = resources
+        self.for_actor = for_actor
+        self.pg_key = pg_key
+        self.fut = fut
+        self.req_id = req_id
+        self.enqueued = time.monotonic()
+
+
+class Raylet:
+    def __init__(self, gcs_address: Tuple[str, int], session_dir: str,
+                 resources: Dict[str, float], labels: Dict[str, str],
+                 object_store_memory: int = DEFAULT_OBJECT_STORE_MEMORY,
+                 is_head: bool = False, host: str = "127.0.0.1",
+                 worker_env: Optional[Dict[str, str]] = None):
+        self.node_id = NodeID.generate().binary()
+        self.gcs_address = gcs_address
+        self.session_dir = session_dir
+        self.total_resources = dict(resources)
+        self.available = dict(resources)
+        self.labels = labels
+        self.is_head = is_head
+        self.worker_env = worker_env or {}
+        self.server = RpcServer(host, 0)
+        self.server.register_all(self)
+        self.store_path = os.path.join(
+            session_dir, f"store_{self.node_id.hex()[:12]}.shm")
+        self.object_store_memory = object_store_memory
+        self.store: Optional[ObjectStore] = None
+        self.gcs: Optional[RpcClient] = None
+        self._workers: Dict[bytes, WorkerHandle] = {}
+        self._idle: List[WorkerHandle] = []
+        self._pending: List[PendingLease] = []
+        # Placement-group bundle reservations: (pg_id, bundle_index) ->
+        # {"resources": ..., "available": ...}; prepared-but-uncommitted hold
+        # resources too (2PC).
+        self._bundles: Dict[Tuple[bytes, int], Dict] = {}
+        self._shutdown = asyncio.Event()
+        self._monitor_task = None
+        self._heartbeat_task = None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    async def start(self):
+        os.makedirs(self.session_dir, exist_ok=True)
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self.store = ObjectStore(self.store_path, capacity=self.object_store_memory,
+                                 create=True)
+        await self.server.start()
+        self.gcs = RpcClient(*self.gcs_address)
+        await self.gcs.connect(timeout=30)
+        reply = await self.gcs.call(
+            "register_node", node_id=self.node_id, address=self.server.address,
+            resources=self.total_resources, object_store_path=self.store_path,
+            is_head=self.is_head, labels=self.labels)
+        assert reply["ok"]
+        self._monitor_task = asyncio.ensure_future(self._monitor_workers())
+        self._heartbeat_task = asyncio.ensure_future(self._heartbeat_loop())
+        logger.info("raylet %s up at %s resources=%s", self.node_id.hex()[:12],
+                    self.server.address, self.total_resources)
+        return self
+
+    async def _heartbeat_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                await self.gcs.call("node_heartbeat", node_id=self.node_id,
+                                    available=self.available)
+            except Exception:
+                pass
+            await asyncio.sleep(2.0)
+
+    async def run_forever(self):
+        await self._shutdown.wait()
+        await self._cleanup()
+
+    async def _cleanup(self):
+        for task in (self._monitor_task, self._heartbeat_task):
+            if task:
+                task.cancel()
+        for w in list(self._workers.values()):
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+        deadline = time.monotonic() + 3
+        for w in list(self._workers.values()):
+            try:
+                w.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except Exception:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+        if self.store is not None:
+            self.store.close()
+            try:
+                os.unlink(self.store_path)
+            except OSError:
+                pass
+        await self.server.close()
+
+    async def handle_shutdown_node(self, conn):
+        self._shutdown.set()
+        return {"ok": True}
+
+    # ---- worker pool (worker_pool.h) -------------------------------------
+
+    def _spawn_worker(self) -> WorkerHandle:
+        worker_id = WorkerID.generate().binary()
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_RAYLET_ADDR"] = f"{self.server.host}:{self.server.port}"
+        env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_address[0]}:{self.gcs_address[1]}"
+        env["RAY_TPU_STORE_PATH"] = self.store_path
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        log_path = os.path.join(self.session_dir, "logs",
+                                f"worker_{worker_id.hex()[:12]}.log")
+        log_file = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.runtime.worker_main"],
+            env=env, stdout=log_file, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        log_file.close()
+        handle = WorkerHandle(worker_id, proc)
+        self._workers[worker_id] = handle
+        return handle
+
+    async def handle_worker_ready(self, conn, worker_id: bytes, address):
+        w = self._workers.get(worker_id)
+        if w is None:
+            return {"ok": False}
+        w.address = tuple(address)
+        w.ready.set()
+        conn.meta["worker_id"] = worker_id
+        return {"ok": True}
+
+    async def _monitor_workers(self):
+        """Child watcher: detect worker process exits (worker death path)."""
+        while not self._shutdown.is_set():
+            await asyncio.sleep(0.2)
+            for w in list(self._workers.values()):
+                if w.proc.poll() is not None:
+                    del self._workers[w.worker_id]
+                    if w in self._idle:
+                        self._idle.remove(w)
+                    reason = f"worker exited with code {w.proc.returncode}"
+                    if w.lease_resources:
+                        scheduling.add(self._lease_pool(w.pg_key), w.lease_resources)
+                    if not w.ready.is_set():
+                        w.ready.set()  # unblock lease waiters; address stays None
+                    try:
+                        await self.gcs.call("report_worker_death", node_id=self.node_id,
+                                            worker_id=w.worker_id, actor_id=w.actor_id,
+                                            reason=reason)
+                    except Exception:
+                        pass
+                    await self._dispatch_pending()
+
+    # ---- resource accounting ---------------------------------------------
+
+    def _lease_pool(self, pg_key: Optional[Tuple[bytes, int]]) -> Dict[str, float]:
+        """The resource pool a lease draws from: node-level, or a committed
+        placement-group bundle."""
+        if pg_key is None:
+            return self.available
+        bundle = self._bundles.get(pg_key)
+        if bundle is None:
+            raise RuntimeError(f"no bundle {pg_key[0].hex()[:12]}:{pg_key[1]} on this node")
+        return bundle["available"]
+
+    # ---- leases (node_manager.cc:1915 HandleRequestWorkerLease) ----------
+
+    async def handle_lease_worker(self, conn, resources: Dict[str, float],
+                                  for_actor: bool = False,
+                                  placement_group_id: Optional[bytes] = None,
+                                  bundle_index: int = -1,
+                                  req_id: Optional[bytes] = None):
+        pg_key = None
+        if placement_group_id is not None:
+            idx = bundle_index if bundle_index >= 0 else self._any_bundle_index(placement_group_id)
+            if idx is None:
+                return {"ok": False, "error": "placement group bundle not on this node"}
+            pg_key = (placement_group_id, idx)
+        logger.debug("lease_worker: res=%s avail=%s pending=%d", resources, self.available, len(self._pending))
+        fut = asyncio.get_event_loop().create_future()
+        self._pending.append(PendingLease(resources, for_actor, pg_key, fut, req_id))
+        await self._dispatch_pending()
+        return await fut
+
+    async def handle_cancel_lease_request(self, conn, req_id: bytes):
+        """Cancel a lease request: still-queued -> dequeue; already granted
+        (grant raced the caller's timeout) -> reclaim the worker."""
+        for req in self._pending:
+            if req.req_id == req_id:
+                self._pending.remove(req)
+                if not req.fut.done():
+                    req.fut.set_result({"ok": False, "canceled": True})
+                return {"ok": True}
+        for w in self._workers.values():
+            if w.req_id == req_id and w.lease_id is not None:
+                scheduling.add(self._lease_pool(w.pg_key), w.lease_resources)
+                w.lease_id = None
+                w.lease_resources = {}
+                w.pg_key = None
+                w.req_id = None
+                if not w.is_actor:
+                    self._idle.append(w)
+                await self._dispatch_pending()
+                return {"ok": True, "reclaimed": True}
+        return {"ok": False}
+
+    def _any_bundle_index(self, pg_id: bytes) -> Optional[int]:
+        for (gid, idx), b in self._bundles.items():
+            if gid == pg_id and b["committed"]:
+                return idx
+        return None
+
+    async def _dispatch_pending(self):
+        """FIFO-with-skip dispatch: grant every queued lease that fits."""
+        granted = True
+        while granted:
+            granted = False
+            for req in list(self._pending):
+                try:
+                    pool = self._lease_pool(req.pg_key)
+                except RuntimeError as e:
+                    self._pending.remove(req)
+                    if not req.fut.done():
+                        req.fut.set_result({"ok": False, "error": str(e)})
+                    continue
+                if not scheduling.fits(pool, req.resources):
+                    if not scheduling.fits(self.total_resources if req.pg_key is None
+                                           else self._bundles[req.pg_key]["resources"],
+                                           req.resources):
+                        self._pending.remove(req)
+                        if not req.fut.done():
+                            req.fut.set_result(
+                                {"ok": False,
+                                 "error": f"infeasible resources {req.resources}"})
+                    continue
+                scheduling.subtract(pool, req.resources)
+                self._pending.remove(req)
+                granted = True
+                logger.debug("dispatch: granting lease res=%s avail=%s", req.resources, self.available)
+                asyncio.ensure_future(self._grant_lease(req))
+
+    async def _grant_lease(self, req: PendingLease):
+        try:
+            if self._idle and not req.for_actor:
+                w = self._idle.pop()
+            else:
+                w = self._spawn_worker()
+            await asyncio.wait_for(w.ready.wait(), timeout=120)
+            if w.address is None:
+                raise RuntimeError("worker died during startup")
+            w.lease_id = os.urandom(8)
+            w.lease_resources = dict(req.resources)
+            w.pg_key = req.pg_key
+            w.is_actor = req.for_actor
+            w.req_id = req.req_id
+            if not req.fut.done():
+                logger.debug("grant_lease: worker=%s addr=%s", w.worker_id.hex()[:8], w.address)
+                req.fut.set_result({
+                    "ok": True, "lease_id": w.lease_id, "worker_id": w.worker_id,
+                    "worker_address": w.address, "node_id": self.node_id,
+                })
+        except Exception as e:
+            scheduling.add(self._lease_pool(req.pg_key), req.resources)
+            if not req.fut.done():
+                req.fut.set_result({"ok": False, "error": repr(e)})
+
+    async def handle_return_worker(self, conn, lease_id: bytes, worker_dead: bool = False):
+        logger.debug("return_worker: lease=%s avail=%s", lease_id.hex()[:8], self.available)
+        for w in self._workers.values():
+            if w.lease_id == lease_id:
+                scheduling.add(self._lease_pool(w.pg_key), w.lease_resources)
+                w.lease_id = None
+                w.lease_resources = {}
+                w.pg_key = None
+                if worker_dead:
+                    try:
+                        w.proc.terminate()
+                    except Exception:
+                        pass
+                elif not w.is_actor:
+                    self._idle.append(w)
+                await self._dispatch_pending()
+                return {"ok": True}
+        return {"ok": False}
+
+    async def handle_mark_actor(self, conn, worker_id: bytes, actor_id: bytes):
+        w = self._workers.get(worker_id)
+        if w is None:
+            return {"ok": False}
+        w.is_actor = True
+        w.actor_id = actor_id
+        return {"ok": True}
+
+    async def handle_kill_worker(self, conn, worker_id: bytes, force: bool = True):
+        w = self._workers.get(worker_id)
+        if w is None:
+            return {"ok": False}
+        try:
+            w.proc.kill() if force else w.proc.terminate()
+        except Exception:
+            pass
+        return {"ok": True}
+
+    # ---- placement group bundles: 2PC target (Prepare/Commit) ------------
+
+    async def handle_prepare_bundle(self, conn, pg_id: bytes, bundle_index: int,
+                                    resources: Dict[str, float]):
+        key = (pg_id, bundle_index)
+        if key in self._bundles:
+            return {"ok": True}  # idempotent retry
+        if not scheduling.fits(self.available, resources):
+            return {"ok": False, "error": "insufficient resources at prepare"}
+        scheduling.subtract(self.available, resources)
+        self._bundles[key] = {"resources": dict(resources),
+                              "available": dict(resources), "committed": False}
+        return {"ok": True}
+
+    async def handle_commit_bundle(self, conn, pg_id: bytes, bundle_index: int):
+        b = self._bundles.get((pg_id, bundle_index))
+        if b is None:
+            return {"ok": False}
+        b["committed"] = True
+        await self._dispatch_pending()
+        return {"ok": True}
+
+    async def handle_cancel_bundle(self, conn, pg_id: bytes, bundle_index: int):
+        b = self._bundles.pop((pg_id, bundle_index), None)
+        if b is not None:
+            scheduling.add(self.available, b["resources"])
+        return {"ok": True}
+
+    async def handle_return_bundle(self, conn, pg_id: bytes, bundle_index: int):
+        b = self._bundles.pop((pg_id, bundle_index), None)
+        if b is not None:
+            scheduling.add(self.available, b["resources"])
+            # Kill workers still leased inside the bundle.
+            for w in list(self._workers.values()):
+                if w.pg_key == (pg_id, bundle_index):
+                    try:
+                        w.proc.terminate()
+                    except Exception:
+                        pass
+        await self._dispatch_pending()
+        return {"ok": True}
+
+    # ---- introspection ----------------------------------------------------
+
+    async def handle_node_stats(self, conn):
+        return {
+            "node_id": self.node_id,
+            "resources": self.total_resources,
+            "available": self.available,
+            "num_workers": len(self._workers),
+            "num_idle": len(self._idle),
+            "num_pending_leases": len(self._pending),
+            "object_store_used": self.store.used if self.store else 0,
+            "object_store_capacity": self.store.capacity if self.store else 0,
+            "bundles": [
+                {"pg_id": k[0], "bundle_index": k[1], "committed": v["committed"],
+                 "resources": v["resources"], "available": v["available"]}
+                for k, v in self._bundles.items()],
+        }

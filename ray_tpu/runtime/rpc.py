@@ -1,0 +1,297 @@
+"""Asyncio RPC layer: framed-pickle request/reply + push channels.
+
+Reference analog: src/ray/rpc/ (GrpcServer grpc_server.h:88, ClientCallManager
+client_call.h, retryable_grpc_client.cc). The wire is a length-prefixed pickle
+frame over TCP; the programming model mirrors gRPC async services: named
+handlers on servers, awaitable calls on clients, plus server->client pushes
+for pubsub. Transport is swappable behind these two classes.
+
+Frame: [u32 length][pickle payload]
+Payload: (kind, msg_id, method, data)
+  kind: 0 = request, 1 = reply, 2 = error reply, 3 = push (one-way)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import struct
+import threading
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_HDR = struct.Struct("<I")
+KIND_REQUEST, KIND_REPLY, KIND_ERROR, KIND_PUSH = 0, 1, 2, 3
+MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    hdr = await reader.readexactly(_HDR.size)
+    (length,) = _HDR.unpack(hdr)
+    if length > MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    body = await reader.readexactly(length)
+    return pickle.loads(body)
+
+
+def _frame(obj) -> bytes:
+    body = pickle.dumps(obj, protocol=5)
+    return _HDR.pack(len(body)) + body
+
+
+class RpcServer:
+    """Serves named async handlers; handler(conn, **data) -> reply data."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._handlers: Dict[str, Callable[..., Awaitable[Any]]] = {}
+        self._server: Optional[asyncio.Server] = None
+        self._conns: set = set()
+        self.on_disconnect: Optional[Callable[["ServerConnection"], Awaitable[None]]] = None
+
+    def register(self, method: str, handler: Callable[..., Awaitable[Any]]):
+        self._handlers[method] = handler
+
+    def register_all(self, obj, prefix: str = "handle_"):
+        for name in dir(obj):
+            if name.startswith(prefix):
+                self.register(name[len(prefix):], getattr(obj, name))
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    async def _on_conn(self, reader, writer):
+        conn = ServerConnection(reader, writer)
+        self._conns.add(conn)
+        try:
+            while True:
+                try:
+                    kind, msg_id, method, data = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError, EOFError):
+                    break
+                if kind == KIND_REQUEST:
+                    asyncio.ensure_future(self._dispatch(conn, msg_id, method, data))
+                elif kind == KIND_PUSH:
+                    asyncio.ensure_future(self._dispatch(conn, None, method, data))
+        finally:
+            self._conns.discard(conn)
+            if self.on_disconnect is not None:
+                try:
+                    await self.on_disconnect(conn)
+                except Exception:
+                    logger.exception("on_disconnect handler failed")
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, conn, msg_id, method, data):
+        handler = self._handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for method {method!r}")
+            result = await handler(conn, **data)
+            if msg_id is not None:
+                await conn.send((KIND_REPLY, msg_id, method, result))
+        except Exception as e:
+            if msg_id is not None:
+                try:
+                    await conn.send((KIND_ERROR, msg_id, method, e))
+                except Exception:
+                    logger.exception("failed to send error reply for %s", method)
+            else:
+                logger.exception("push handler %s failed", method)
+
+    async def close(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self._conns):
+            conn.close()
+
+
+class ServerConnection:
+    """Server side of one client connection (usable for pushes to client)."""
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+        self._lock = asyncio.Lock()
+        self.meta: Dict[str, Any] = {}  # handlers stash identity here
+
+    async def send(self, payload):
+        data = _frame(payload)
+        async with self._lock:
+            self.writer.write(data)
+            await self.writer.drain()
+
+    async def push(self, method: str, data: dict):
+        await self.send((KIND_PUSH, None, method, data))
+
+    def close(self):
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    @property
+    def peername(self):
+        try:
+            return self.writer.get_extra_info("peername")
+        except Exception:
+            return None
+
+
+class RpcClient:
+    """Async client. Push frames from the server invoke `on_push`."""
+
+    def __init__(self, host: str, port: int,
+                 on_push: Optional[Callable[[str, dict], Awaitable[None]]] = None):
+        self.host = host
+        self.port = port
+        self.on_push = on_push
+        self._reader = None
+        self._writer = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._lock: Optional[asyncio.Lock] = None
+        self._recv_task = None
+        self._closed = False
+        self._dead = False
+
+    async def connect(self, timeout: float = 30.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        delay = 0.02
+        while True:
+            try:
+                self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+                break
+            except OSError:
+                if asyncio.get_event_loop().time() >= deadline:
+                    raise
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 0.5)
+        self._lock = asyncio.Lock()
+        self._recv_task = asyncio.ensure_future(self._recv_loop())
+        return self
+
+    async def _recv_loop(self):
+        try:
+            while True:
+                kind, msg_id, method, data = await _read_frame(self._reader)
+                if kind in (KIND_REPLY, KIND_ERROR):
+                    fut = self._pending.pop(msg_id, None)
+                    if fut is not None and not fut.done():
+                        if kind == KIND_REPLY:
+                            fut.set_result(data)
+                        else:
+                            fut.set_exception(data if isinstance(data, BaseException)
+                                              else RpcError(str(data)))
+                elif kind == KIND_PUSH and self.on_push is not None:
+                    asyncio.ensure_future(self._run_push(method, data))
+        except (asyncio.IncompleteReadError, ConnectionResetError, EOFError, OSError):
+            pass
+        except Exception:
+            logger.exception("rpc client recv loop error")
+        finally:
+            self._dead = True
+            self._fail_pending(ConnectionLost(f"connection to {self.host}:{self.port} lost"))
+
+    async def _run_push(self, method, data):
+        try:
+            await self.on_push(method, data)
+        except Exception:
+            logger.exception("push handler for %s failed", method)
+
+    def _fail_pending(self, exc):
+        for fut in self._pending.values():
+            if not fut.done():
+                try:
+                    fut.set_exception(exc)
+                    fut.exception()  # mark retrieved; avoid GC warnings
+                except RuntimeError:
+                    pass  # event loop already closed (interpreter shutdown)
+        self._pending.clear()
+
+    async def call(self, method: str, timeout: Optional[float] = None, **data):
+        if self._closed or self._dead:
+            raise ConnectionLost(
+                f"connection to {self.host}:{self.port} closed"
+                if self._closed else f"connection to {self.host}:{self.port} lost")
+        self._next_id += 1
+        msg_id = self._next_id
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[msg_id] = fut
+        payload = _frame((KIND_REQUEST, msg_id, method, data))
+        async with self._lock:
+            self._writer.write(payload)
+            await self._writer.drain()
+        if timeout is not None:
+            return await asyncio.wait_for(fut, timeout)
+        return await fut
+
+    async def push(self, method: str, **data):
+        payload = _frame((KIND_PUSH, None, method, data))
+        async with self._lock:
+            self._writer.write(payload)
+            await self._writer.drain()
+
+    async def close(self):
+        self._closed = True
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            try:
+                await self._recv_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop in a daemon thread.
+
+    Drivers and workers are synchronous Python; all RPC I/O runs on this loop
+    (the asio io_context analog, reference:
+    src/ray/common/asio/instrumented_io_context.h).
+    """
+
+    def __init__(self, name: str = "ray_tpu_io"):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)

@@ -1,0 +1,234 @@
+"""Worker process: executes tasks and hosts actors.
+
+Reference analog: the worker side of src/ray/core_worker/ — HandlePushTask
+(core_worker.cc:3810) -> TaskReceiver -> ExecuteTask (:3229), actor creation
+(:2556 target side), with the Python function loading of
+python/ray/_private/function_manager.py (pickled defs from GCS KV).
+
+The process runs two halves:
+  * an asyncio RPC server (this module) that receives pushed tasks, and
+  * a CoreWorker (ray_tpu.core.worker) so user code inside tasks can submit
+    nested tasks / use the object store — the full API works in workers.
+Execution happens on a thread pool (serial by default; actors can raise
+max_concurrency), keeping the IO loop responsive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import os
+import sys
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu.core import serialization
+from ray_tpu.core.exceptions import TaskError
+from ray_tpu.core.task_spec import ActorSpec, TaskSpec
+from ray_tpu.core.worker import CoreWorker, INLINE_RESULT_MAX, set_global_worker
+from ray_tpu.runtime.rpc import RpcClient, RpcServer
+from ray_tpu.utils.ids import ObjectID, TaskID
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerRuntime:
+    def __init__(self):
+        self.worker_id = bytes.fromhex(os.environ["RAY_TPU_WORKER_ID"])
+        self.node_id = bytes.fromhex(os.environ["RAY_TPU_NODE_ID"])
+        raylet = os.environ["RAY_TPU_RAYLET_ADDR"].rsplit(":", 1)
+        gcs = os.environ["RAY_TPU_GCS_ADDR"].rsplit(":", 1)
+        self.raylet_addr = (raylet[0], int(raylet[1]))
+        self.gcs_addr = (gcs[0], int(gcs[1]))
+        self.store_path = os.environ["RAY_TPU_STORE_PATH"]
+        self.session_dir = os.environ["RAY_TPU_SESSION_DIR"]
+        self.server = RpcServer("127.0.0.1", 0)
+        self.server.register_all(self)
+        self.core: Optional[CoreWorker] = None
+        self.exec_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task_exec")
+        self.fn_cache: Dict[bytes, Any] = {}
+        self.actor_instance = None
+        self.actor_spec: Optional[ActorSpec] = None
+        self._raylet_client: Optional[RpcClient] = None
+
+    async def start(self):
+        # CoreWorker first: user code needs the full API during tasks.
+        self.core = CoreWorker(
+            mode="worker", gcs_address=self.gcs_addr,
+            raylet_address=self.raylet_addr, store_path=self.store_path,
+            session_dir=self.session_dir, node_id=self.node_id)
+        set_global_worker(self.core)
+        await self.server.start()
+        self._raylet_client = RpcClient(*self.raylet_addr)
+        await self._raylet_client.connect(timeout=30)
+        await self._raylet_client.call(
+            "worker_ready", worker_id=self.worker_id, address=self.server.address)
+        asyncio.ensure_future(self._orphan_watchdog())
+        logger.info("worker %s ready at %s", self.worker_id.hex()[:12],
+                    self.server.address)
+
+    async def _orphan_watchdog(self):
+        """Exit when our raylet goes away (worker processes must not outlive
+        their node, even when the raylet is SIGKILLed)."""
+        while not self._raylet_client._dead:
+            await asyncio.sleep(1.0)
+        logger.warning("raylet connection lost; worker exiting")
+        os._exit(1)
+
+    # ---- function/class loading (function_manager.py analog) -------------
+
+    def _load_function(self, fn_id: bytes):
+        fn = self.fn_cache.get(fn_id)
+        if fn is None:
+            reply = self.core.io.run(self.core.gcs.call("kv_get", key=b"fn:" + fn_id))
+            blob = reply["value"]
+            if blob is None:
+                raise RuntimeError(f"function {fn_id.hex()[:12]} not found in GCS")
+            fn = cloudpickle.loads(blob)
+            self.fn_cache[fn_id] = fn
+        return fn
+
+    def _load_class(self, class_id: bytes):
+        cls = self.fn_cache.get(class_id)
+        if cls is None:
+            reply = self.core.io.run(self.core.gcs.call("kv_get", key=b"cls:" + class_id))
+            blob = reply["value"]
+            if blob is None:
+                raise RuntimeError(f"class {class_id.hex()[:12]} not found in GCS")
+            cls = cloudpickle.loads(blob)
+            self.fn_cache[class_id] = cls
+        return cls
+
+    # ---- task execution ---------------------------------------------------
+
+    def _execute(self, fn, spec: TaskSpec) -> dict:
+        """Runs on the exec thread; returns the RPC reply."""
+        try:
+            args, kwargs = self.core.resolve_args(spec)
+            self.core.current_task_name = spec.name
+            result = fn(*args, **kwargs)
+            returns = []
+            values = (result,) if spec.num_returns == 1 else tuple(result)
+            if spec.num_returns > 1 and len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task declared num_returns={spec.num_returns} but returned "
+                    f"{len(values)} values")
+            for i, value in enumerate(values):
+                segments, total = serialization.serialize(value)
+                oid = ObjectID.for_task_return(TaskID(spec.task_id), i).binary()
+                if total <= INLINE_RESULT_MAX:
+                    returns.append(("v", serialization.join_segments(segments)))
+                else:
+                    store = self.core.store
+                    if store.contains(oid):
+                        # Retry of a task whose previous attempt already sealed
+                        # this return: reuse it (ids are deterministic).
+                        returns.append(("r", oid))
+                        continue
+                    # A crashed previous attempt may have left an unsealed
+                    # create behind; reclaim the id.
+                    store.abort(oid)
+                    buf = store.create(oid, total)
+                    try:
+                        serialization.write_segments(buf, segments)
+                    except BaseException:
+                        buf.release()
+                        store.abort(oid)
+                        raise
+                    buf.release()
+                    store.seal(oid)
+                    returns.append(("r", oid))
+            return {"status": "ok", "returns": returns, "node_id": self.node_id}
+        except Exception as e:
+            tb = traceback.format_exc()
+            logger.error("task %s failed:\n%s", spec.name, tb)
+            return {"status": "error",
+                    "error": TaskError(spec.name, tb, cause=_safe_cause(e))}
+        finally:
+            self.core.current_task_name = None
+
+    async def handle_push_task(self, conn, spec: TaskSpec):
+        fn = self._load_function(spec.fn_id)
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(self.exec_pool, self._execute, fn, spec)
+
+    # ---- actor lifecycle --------------------------------------------------
+
+    async def handle_create_actor(self, conn, spec: ActorSpec):
+        def _create():
+            cls = self._load_class(spec.class_id)
+            args, kwargs = self.core.resolve_args(
+                TaskSpec(task_id=b"\0" * 20, fn_id=b"", name="__init__",
+                         args=spec.args, kwarg_names=spec.kwarg_names))
+            self.actor_instance = cls(*args, **kwargs)
+            self.actor_spec = spec
+            self.core.current_actor_id = spec.actor_id
+            return {"ok": True}
+
+        if spec.max_concurrency > 1:
+            self.exec_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=spec.max_concurrency, thread_name_prefix="actor_exec")
+        loop = asyncio.get_event_loop()
+        try:
+            result = await loop.run_in_executor(self.exec_pool, _create)
+            await self._raylet_client.call("mark_actor", worker_id=self.worker_id,
+                                           actor_id=spec.actor_id)
+            return result
+        except Exception as e:
+            tb = traceback.format_exc()
+            logger.error("actor creation failed:\n%s", tb)
+            return {"ok": False, "error": f"{e!r}\n{tb}"}
+
+    async def handle_push_actor_task(self, conn, spec: TaskSpec):
+        if self.actor_instance is None:
+            return {"status": "error",
+                    "error": TaskError(spec.name, "no actor instance on this worker")}
+        method = getattr(self.actor_instance, spec.method_name, None)
+        if method is None:
+            return {"status": "error",
+                    "error": TaskError(
+                        spec.name,
+                        f"actor has no method {spec.method_name!r}")}
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(self.exec_pool, self._execute, method, spec)
+
+    async def handle_ping(self, conn):
+        return {"ok": True}
+
+    async def handle_exit(self, conn):
+        asyncio.get_event_loop().call_later(0.05, sys.exit, 0)
+        return {"ok": True}
+
+
+def _safe_cause(e: BaseException):
+    """Exceptions must survive pickling across the wire; fall back to repr."""
+    try:
+        cloudpickle.dumps(e)
+        return e
+    except Exception:
+        return None
+
+
+def main():
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="[worker %(asctime)s %(levelname)s %(name)s] %(message)s")
+    runtime = WorkerRuntime()
+
+    async def run():
+        await runtime.start()
+        await asyncio.Event().wait()  # serve until killed
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

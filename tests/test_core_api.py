@@ -1,0 +1,252 @@
+"""Core API tests: tasks, objects, actors on a single-node cluster.
+
+Reference test model: python/ray/tests/test_basic*.py with the
+ray_start_regular fixture (conftest.py:553).
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def echo(x):
+    return x
+
+
+def test_simple_task(cluster):
+    assert ray_tpu.get(echo.remote(42), timeout=60) == 42
+
+
+def test_task_fanout(cluster):
+    refs = [echo.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs, timeout=60) == list(range(50))
+
+
+def test_kwargs_and_multiple_args(cluster):
+    @ray_tpu.remote
+    def f(a, b, c=0, d=0):
+        return a + b + c + d
+
+    assert ray_tpu.get(f.remote(1, 2, c=3, d=4), timeout=60) == 10
+
+
+def test_num_returns(cluster):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3], timeout=60) == [1, 2, 3]
+
+
+def test_large_result_via_plasma(cluster):
+    @ray_tpu.remote
+    def big():
+        return np.arange(1 << 20, dtype=np.int64)
+
+    arr = ray_tpu.get(big.remote(), timeout=60)
+    assert arr.shape == (1 << 20,)
+    assert arr[-1] == (1 << 20) - 1
+
+
+def test_put_get_roundtrip(cluster):
+    ref = ray_tpu.put({"a": np.ones(100000), "b": "text"})
+    out = ray_tpu.get(ref, timeout=30)
+    assert out["b"] == "text"
+    np.testing.assert_array_equal(out["a"], np.ones(100000))
+
+
+def test_object_ref_as_arg(cluster):
+    ref = ray_tpu.put(np.full(50000, 7.0))
+
+    @ray_tpu.remote
+    def consume(arr):
+        return float(arr.sum())
+
+    assert ray_tpu.get(consume.remote(ref), timeout=60) == 350000.0
+
+
+def test_task_result_as_arg(cluster):
+    # An inlined (small) upstream result must be resolved by the submitter and
+    # delivered to the downstream worker (DependencyResolver path).
+    a = echo.remote(5)
+    b = echo.remote(a)
+    assert ray_tpu.get(b, timeout=60) == 5
+
+
+def test_failed_dependency_propagates(cluster):
+    @ray_tpu.remote
+    def fail():
+        raise ValueError("upstream-fail")
+
+    bad = fail.remote()
+    downstream = echo.remote(bad)
+    with pytest.raises(ray_tpu.RayTpuError, match="upstream-fail"):
+        ray_tpu.get(downstream, timeout=60)
+
+
+def test_exception_propagation(cluster):
+    @ray_tpu.remote
+    def fail():
+        raise ValueError("boom-42")
+
+    with pytest.raises(ray_tpu.TaskError, match="boom-42"):
+        ray_tpu.get(fail.remote(), timeout=60)
+
+
+def test_nested_tasks(cluster):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10), timeout=60) == 21
+
+
+def test_wait(cluster):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    refs = [slow.remote(0.05), slow.remote(10)]
+    ready, pending = ray_tpu.wait(refs, num_returns=1, timeout=30)
+    assert len(ready) == 1 and len(pending) == 1
+    assert ray_tpu.get(ready[0], timeout=30) == 0.05
+
+
+def test_get_timeout(cluster):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(60)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(hang.remote(), timeout=0.5)
+
+
+def test_options_override(cluster):
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return "ok"
+
+    assert ray_tpu.get(f.options(num_cpus=2).remote(), timeout=60) == "ok"
+
+
+def test_task_retry_on_worker_crash(cluster):
+    marker = f"/tmp/ray_tpu_retry_{os.getpid()}"
+
+    @ray_tpu.remote(max_retries=2)
+    def crash_once(path):
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os.kill(os.getpid(), signal.SIGKILL)
+        return "recovered"
+
+    try:
+        assert ray_tpu.get(crash_once.remote(marker), timeout=90) == "recovered"
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_cluster_resources(cluster):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4.0
+
+
+class _CounterBody:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, k=1):
+        self.n += k
+        return self.n
+
+    def pid(self):
+        return os.getpid()
+
+    def fail(self):
+        raise RuntimeError("actor-task-fail")
+
+
+Counter = ray_tpu.remote(_CounterBody)
+
+
+def test_actor_basic(cluster):
+    c = Counter.remote(10)
+    assert ray_tpu.get([c.inc.remote() for _ in range(3)], timeout=60) == [11, 12, 13]
+
+
+def test_actor_ordering(cluster):
+    c = Counter.remote(0)
+    refs = [c.inc.remote() for _ in range(20)]
+    assert ray_tpu.get(refs, timeout=60) == list(range(1, 21))
+
+
+def test_actor_error_does_not_kill_actor(cluster):
+    c = Counter.remote(0)
+    with pytest.raises(ray_tpu.TaskError, match="actor-task-fail"):
+        ray_tpu.get(c.fail.remote(), timeout=60)
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+
+
+def test_named_actor(cluster):
+    Counter.options(name="test-named").remote(100)
+    h = ray_tpu.get_actor("test-named")
+    assert ray_tpu.get(h.inc.remote(), timeout=60) == 101
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("does-not-exist")
+
+
+def test_actor_kill(cluster):
+    c = Counter.remote(0)
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+    ray_tpu.kill(c)
+    time.sleep(1.0)
+    with pytest.raises(ray_tpu.ActorError):
+        ray_tpu.get(c.inc.remote(), timeout=30)
+
+
+def test_actor_restart(cluster):
+    p = Counter.options(max_restarts=1).remote(0)
+    pid = ray_tpu.get(p.pid.remote(), timeout=60)
+    os.kill(pid, signal.SIGKILL)
+    time.sleep(1.5)
+    # State is lost (fresh __init__) but the actor is alive again.
+    deadline = time.time() + 60
+    while True:
+        try:
+            new_pid = ray_tpu.get(p.pid.remote(), timeout=30)
+            break
+        except ray_tpu.ActorError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+    assert new_pid != pid
+
+
+def test_actor_handle_passing(cluster):
+    c = Counter.remote(0)
+
+    @ray_tpu.remote
+    def use_actor(handle):
+        return ray_tpu.get(handle.inc.remote(5))
+
+    assert ray_tpu.get(use_actor.remote(c), timeout=60) == 5
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 6
