@@ -1,0 +1,15 @@
+from ray_tpu.collective.collective import (  # noqa: F401
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective.communicator import Communicator  # noqa: F401

@@ -1,0 +1,108 @@
+"""Collective API over named process groups.
+
+Reference analog: python/ray/util/collective/collective.py
+(init_collective_group:123, allreduce:268, barrier:308, broadcast:383,
+allgather:433, reducescatter:482, send:541, recv:604). Rendezvous goes
+through the GCS KV (the reference stores the NCCL unique id in a named actor;
+a KV entry is the same pattern one level lower).
+
+Backends:
+  * "tcp"  — TCPCommunicator (CPU/gloo analog; tests and control plane)
+  * "jax"  — multi-host jax.distributed bootstrap; collectives then run
+             in-graph over ICI (see jax_backend.initialize_jax_distributed)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.collective.communicator import Communicator
+from ray_tpu.collective.cpu_group import TCPCommunicator
+
+_groups: Dict[str, Communicator] = {}
+
+
+def _gcs_kv():
+    from ray_tpu.core.worker import global_worker
+
+    core = global_worker()
+
+    def kv_put(key: str, value: str):
+        core.io.run(core.gcs.call("kv_put", key=key.encode(), value=value.encode()))
+
+    def kv_get(key: str) -> Optional[str]:
+        reply = core.io.run(core.gcs.call("kv_get", key=key.encode()))
+        return reply["value"].decode() if reply["value"] is not None else None
+
+    return kv_put, kv_get
+
+
+def init_collective_group(world_size: int, rank: int, backend: str = "tcp",
+                          group_name: str = "default") -> Communicator:
+    if group_name in _groups:
+        raise ValueError(f"collective group {group_name!r} already initialized")
+    kv_put, kv_get = _gcs_kv()
+    if backend == "tcp":
+        comm = TCPCommunicator(rank, world_size, group_name, kv_put, kv_get)
+    elif backend == "jax":
+        from ray_tpu.collective.jax_backend import JaxDistributedCommunicator
+        comm = JaxDistributedCommunicator(rank, world_size, group_name, kv_put, kv_get)
+    else:
+        raise ValueError(f"unknown collective backend {backend!r}")
+    _groups[group_name] = comm
+    return comm
+
+
+def destroy_collective_group(group_name: str = "default"):
+    comm = _groups.pop(group_name, None)
+    if comm is not None:
+        comm.close()
+
+
+def get_group(group_name: str = "default") -> Communicator:
+    if group_name not in _groups:
+        raise ValueError(f"collective group {group_name!r} is not initialized")
+    return _groups[group_name]
+
+
+def get_rank(group_name: str = "default") -> int:
+    return get_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return get_group(group_name).world_size
+
+
+def allreduce(array: np.ndarray, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).allreduce(array, op)
+
+
+def allgather(array: np.ndarray, group_name: str = "default") -> List[np.ndarray]:
+    return get_group(group_name).allgather(array)
+
+
+def reducescatter(arrays: Sequence[np.ndarray], group_name: str = "default",
+                  op: str = "sum") -> np.ndarray:
+    return get_group(group_name).reducescatter(arrays, op)
+
+
+def broadcast(array: np.ndarray, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(array, src_rank)
+
+
+def alltoall(arrays: Sequence[np.ndarray], group_name: str = "default"):
+    return get_group(group_name).alltoall(arrays)
+
+
+def send(array: np.ndarray, dst_rank: int, group_name: str = "default"):
+    get_group(group_name).send(array, dst_rank)
+
+
+def recv(shape, dtype, src_rank: int, group_name: str = "default") -> np.ndarray:
+    return get_group(group_name).recv(shape, dtype, src_rank)
+
+
+def barrier(group_name: str = "default"):
+    get_group(group_name).barrier()

@@ -1,0 +1,94 @@
+"""Communicator interface.
+
+Reference analog: python/ray/experimental/channel/communicator.py:19
+(Communicator ABC used by compiled-graph channels and util.collective).
+The TPU-native design splits collectives into two planes:
+
+  * in-graph: `jax.lax` collectives (psum/all_gather/ppermute/all_to_all)
+    compiled into XLA programs over the device mesh — the ICI data plane.
+    These don't go through this interface; they ARE the program.
+  * out-of-graph: control-plane collectives over host arrays (rendezvous,
+    gradient sync for CPU tests, cross-slice DCN fallback). This interface
+    covers those, with a TCP implementation (CPU/gloo analog) and a JAX
+    implementation that stages through device meshes when available.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Sequence
+
+import numpy as np
+
+REDUCE_OPS = ("sum", "prod", "min", "max", "mean")
+
+
+class Communicator(abc.ABC):
+    """A process group: `world_size` ranks that communicate collectively."""
+
+    def __init__(self, rank: int, world_size: int, group_name: str):
+        assert 0 <= rank < world_size
+        self.rank = rank
+        self.world_size = world_size
+        self.group_name = group_name
+
+    @abc.abstractmethod
+    def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
+        ...
+
+    @abc.abstractmethod
+    def allgather(self, array: np.ndarray) -> List[np.ndarray]:
+        ...
+
+    @abc.abstractmethod
+    def reducescatter(self, arrays: Sequence[np.ndarray], op: str = "sum") -> np.ndarray:
+        ...
+
+    @abc.abstractmethod
+    def broadcast(self, array: np.ndarray, src_rank: int = 0) -> np.ndarray:
+        ...
+
+    @abc.abstractmethod
+    def send(self, array: np.ndarray, dst_rank: int) -> None:
+        ...
+
+    @abc.abstractmethod
+    def recv(self, shape, dtype, src_rank: int) -> np.ndarray:
+        ...
+
+    @abc.abstractmethod
+    def barrier(self) -> None:
+        ...
+
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """Default all-to-all via send/recv pairs (override for better)."""
+        out: List[np.ndarray] = [None] * self.world_size  # type: ignore
+        out[self.rank] = np.asarray(arrays[self.rank])
+        for offset in range(1, self.world_size):
+            dst = (self.rank + offset) % self.world_size
+            src = (self.rank - offset) % self.world_size
+            if self.rank % 2 == 0:
+                self.send(np.asarray(arrays[dst]), dst)
+                out[src] = self.recv(None, None, src)
+            else:
+                out[src] = self.recv(None, None, src)
+                self.send(np.asarray(arrays[dst]), dst)
+        return out
+
+    def close(self) -> None:
+        pass
+
+
+def reduce_arrays(arrays: Sequence[np.ndarray], op: str) -> np.ndarray:
+    stack = np.stack([np.asarray(a) for a in arrays])
+    if op == "sum":
+        return stack.sum(axis=0)
+    if op == "prod":
+        return stack.prod(axis=0)
+    if op == "min":
+        return stack.min(axis=0)
+    if op == "max":
+        return stack.max(axis=0)
+    if op == "mean":
+        return stack.mean(axis=0)
+    raise ValueError(f"unknown reduce op {op!r}")

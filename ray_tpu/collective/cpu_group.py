@@ -1,0 +1,205 @@
+"""TCP star-topology communicator: the CPU/gloo-analog backend.
+
+Reference analog: python/ray/util/collective/collective_group/
+gloo_collective_group.py:184 GLOOGroup. Rank 0 coordinates: gathers
+contributions, reduces, fans results back out. Bandwidth-optimal rings are
+unnecessary here — this backend exists for tests and small control-plane
+arrays; the TPU data plane uses in-graph lax collectives (see
+ray_tpu/collective/jax_group.py).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.collective.communicator import Communicator, reduce_arrays
+
+_HDR = struct.Struct("<Q")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=5)
+    sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket):
+    buf = b""
+    while len(buf) < _HDR.size:
+        chunk = sock.recv(_HDR.size - len(buf))
+        if not chunk:
+            raise ConnectionError("collective peer disconnected")
+        buf += chunk
+    (length,) = _HDR.unpack(buf)
+    parts = []
+    got = 0
+    while got < length:
+        chunk = sock.recv(min(1 << 20, length - got))
+        if not chunk:
+            raise ConnectionError("collective peer disconnected")
+        parts.append(chunk)
+        got += len(chunk)
+    return pickle.loads(b"".join(parts))
+
+
+class TCPCommunicator(Communicator):
+    """Star-topology process group over TCP.
+
+    Rendezvous: rank 0 binds an ephemeral port and publishes "host:port"
+    through `kv_put(key, value)`; other ranks poll `kv_get(key)`.
+    """
+
+    def __init__(self, rank: int, world_size: int, group_name: str,
+                 kv_put: Callable[[str, str], None],
+                 kv_get: Callable[[str], Optional[str]],
+                 timeout: float = 120.0):
+        super().__init__(rank, world_size, group_name)
+        self._timeout = timeout
+        self._kv_put = kv_put
+        self._kv_get = kv_get
+        # Direct p2p plane: every rank listens; connections form lazily.
+        self._p2p_listener = socket.create_server(("127.0.0.1", 0))
+        self._p2p_listener.settimeout(timeout)
+        kv_put(f"collective:{group_name}:p2p:{rank}",
+               f"127.0.0.1:{self._p2p_listener.getsockname()[1]}")
+        self._p2p_out: dict = {}   # dst rank -> socket
+        self._p2p_in: dict = {}    # src rank -> socket
+        key = f"collective:{group_name}"
+        if world_size == 1:
+            self._peers = []
+            return
+        if rank == 0:
+            self._listener = socket.create_server(("127.0.0.1", 0))
+            port = self._listener.getsockname()[1]
+            kv_put(key, f"127.0.0.1:{port}")
+            self._peers: List[Optional[socket.socket]] = [None] * world_size
+            deadline = time.monotonic() + timeout
+            self._listener.settimeout(timeout)
+            connected = 0
+            while connected < world_size - 1:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"collective group {group_name}: only {connected + 1}/"
+                        f"{world_size} ranks joined")
+                sock, _ = self._listener.accept()
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                peer_rank = _recv_msg(sock)
+                self._peers[peer_rank] = sock
+                connected += 1
+        else:
+            deadline = time.monotonic() + timeout
+            addr = None
+            while addr is None:
+                addr = kv_get(key)
+                if addr is None:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(f"rendezvous for {group_name} timed out")
+                    time.sleep(0.02)
+            host, port = addr.rsplit(":", 1)
+            self._root = socket.create_connection((host, int(port)), timeout=timeout)
+            self._root.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _send_msg(self._root, rank)
+
+    # ---- root-coordinated collectives ------------------------------------
+
+    def _coordinate(self, opcode: str, payload, compute):
+        """Root: gather payloads from all ranks, run `compute(payloads)->
+        per-rank replies`, scatter. Non-root: send payload, await reply."""
+        if self.world_size == 1:
+            return compute([payload])[0]
+        if self.rank == 0:
+            payloads: List = [None] * self.world_size
+            payloads[0] = payload
+            for r in range(1, self.world_size):
+                op, data = _recv_msg(self._peers[r])
+                assert op == opcode, f"collective mismatch: {op} vs {opcode}"
+                payloads[r] = data
+            replies = compute(payloads)
+            for r in range(1, self.world_size):
+                _send_msg(self._peers[r], replies[r])
+            return replies[0]
+        _send_msg(self._root, (opcode, payload))
+        return _recv_msg(self._root)
+
+    def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
+        def compute(payloads):
+            result = reduce_arrays(payloads, op)
+            return [result] * self.world_size
+
+        return self._coordinate("allreduce", np.asarray(array), compute)
+
+    def allgather(self, array: np.ndarray) -> List[np.ndarray]:
+        def compute(payloads):
+            return [list(payloads)] * self.world_size
+
+        return self._coordinate("allgather", np.asarray(array), compute)
+
+    def reducescatter(self, arrays: Sequence[np.ndarray], op: str = "sum") -> np.ndarray:
+        def compute(payloads):
+            # payloads[r] is a list of world_size shards from rank r.
+            return [reduce_arrays([p[r] for p in payloads], op)
+                    for r in range(self.world_size)]
+
+        return self._coordinate("reducescatter", [np.asarray(a) for a in arrays],
+                                compute)
+
+    def broadcast(self, array: np.ndarray, src_rank: int = 0) -> np.ndarray:
+        def compute(payloads):
+            return [payloads[src_rank]] * self.world_size
+
+        payload = np.asarray(array) if self.rank == src_rank else None
+        return self._coordinate("broadcast", payload, compute)
+
+    def barrier(self) -> None:
+        self._coordinate("barrier", None, lambda payloads: [None] * self.world_size)
+
+    # ---- p2p (direct pairwise connections) -------------------------------
+
+    def send(self, array: np.ndarray, dst_rank: int) -> None:
+        sock = self._p2p_out.get(dst_rank)
+        if sock is None:
+            key = f"collective:{self.group_name}:p2p:{dst_rank}"
+            deadline = time.monotonic() + self._timeout
+            addr = None
+            while addr is None:
+                addr = self._kv_get(key)
+                if addr is None:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(f"p2p rendezvous with rank {dst_rank}")
+                    time.sleep(0.02)
+            host, port = addr.rsplit(":", 1)
+            sock = socket.create_connection((host, int(port)), timeout=self._timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _send_msg(sock, self.rank)  # identify ourselves
+            self._p2p_out[dst_rank] = sock
+        _send_msg(sock, np.asarray(array))
+
+    def recv(self, shape, dtype, src_rank: int) -> np.ndarray:
+        while src_rank not in self._p2p_in:
+            sock, _ = self._p2p_listener.accept()
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            peer = _recv_msg(sock)
+            self._p2p_in[peer] = sock
+        return _recv_msg(self._p2p_in[src_rank])
+
+    def close(self) -> None:
+        try:
+            for sock in list(self._p2p_out.values()) + list(self._p2p_in.values()):
+                sock.close()
+            self._p2p_listener.close()
+            if self.world_size > 1:
+                if self.rank == 0:
+                    for sock in self._peers:
+                        if sock is not None:
+                            sock.close()
+                    self._listener.close()
+                else:
+                    self._root.close()
+        except Exception:
+            pass

@@ -1,0 +1,163 @@
+"""Multi-host JAX collective backend: the NCCL-replacement data plane.
+
+Reference analog: util/collective's NCCLGroup (nccl_collective_group.py:128)
+and Train's torch.distributed process groups. The TPU-native equivalent:
+every worker process calls `jax.distributed.initialize` against a coordinator
+whose address rendezvouses through the GCS KV (named-actor pattern in the
+reference, collective.py:123). After that, arrays live on the global mesh and
+collectives are `jax.lax` ops compiled over ICI (intra-slice) / DCN
+(cross-slice) — XLA inserts and schedules the transfers.
+
+The Communicator methods here are out-of-graph conveniences staged through
+jit; hot-path training code should instead build meshes with
+ray_tpu.parallel and keep collectives inside its compiled step functions.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.collective.communicator import Communicator
+
+_initialized = False
+
+
+def initialize_jax_distributed(rank: int, world_size: int, group_name: str,
+                               kv_put: Callable[[str, str], None],
+                               kv_get: Callable[[str], Optional[str]],
+                               timeout: float = 120.0) -> None:
+    """Bootstrap jax.distributed across worker processes.
+
+    Rank 0 picks a free port and publishes it; everyone joins. Safe to call
+    once per process.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    key = f"collective:{group_name}:jax_coordinator"
+    if world_size == 1:
+        _initialized = True
+        return
+    if rank == 0:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        coordinator = f"127.0.0.1:{port}"
+        kv_put(key, coordinator)
+    else:
+        deadline = time.monotonic() + timeout
+        coordinator = None
+        while coordinator is None:
+            coordinator = kv_get(key)
+            if coordinator is None:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"jax coordinator rendezvous for {group_name}")
+                time.sleep(0.02)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=world_size, process_id=rank)
+    _initialized = True
+
+
+class JaxDistributedCommunicator(Communicator):
+    """Out-of-graph collectives over the global jax mesh (all processes)."""
+
+    def __init__(self, rank: int, world_size: int, group_name: str,
+                 kv_put, kv_get, timeout: float = 120.0):
+        super().__init__(rank, world_size, group_name)
+        initialize_jax_distributed(rank, world_size, group_name, kv_put, kv_get,
+                                   timeout)
+        import jax
+
+        self.jax = jax
+        self.devices = jax.devices()  # global, across processes
+        self.local_devices = jax.local_devices()
+
+    # Helper: stage a host array onto the process-sharded global mesh, apply
+    # an in-graph collective, fetch the (replicated) result.
+    def _process_mesh(self):
+        import numpy as onp
+        from jax.sharding import Mesh
+
+        devs = onp.array(self.devices[:self.world_size * len(self.local_devices)])
+        # One mesh axis over processes: use the first local device per process.
+        per_proc = [d for d in self.devices if d.process_index == self.jax.process_index()]
+        first_per_proc = sorted(
+            {d.process_index: d for d in self.devices}.items())
+        return Mesh(onp.array([d for _, d in first_per_proc]), ("proc",))
+
+    def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from jax import lax
+
+        mesh = self._process_mesh()
+        x = jnp.asarray(array)[None, ...]  # leading axis = proc shard
+        sharding = NamedSharding(mesh, P("proc"))
+        global_shape = (self.world_size,) + tuple(array.shape)
+        arr = self.jax.make_array_from_process_local_data(sharding, np.asarray(x),
+                                                          global_shape)
+
+        def reduce_fn(v):
+            red = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin,
+                   "mean": lambda t, n: lax.pmean(t, n)}[op]
+            return red(v[0], "proc")[None]
+
+        fn = shard_map(reduce_fn, mesh=mesh, in_specs=P("proc"), out_specs=P("proc"))
+        out = self.jax.jit(fn)(arr)
+        return np.asarray(out.addressable_data(0))[0] if out.addressable_shards \
+            else np.asarray(out)[0]
+
+    def allgather(self, array: np.ndarray) -> List[np.ndarray]:
+        gathered = self.allreduce_concat(array)
+        return [gathered[i] for i in range(self.world_size)]
+
+    def allreduce_concat(self, array: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax import lax
+
+        mesh = self._process_mesh()
+        x = jnp.asarray(array)[None, ...]
+        sharding = NamedSharding(mesh, P("proc"))
+        global_shape = (self.world_size,) + tuple(array.shape)
+        arr = self.jax.make_array_from_process_local_data(sharding, np.asarray(x),
+                                                          global_shape)
+
+        def gather_fn(v):
+            return lax.all_gather(v[0], "proc")[None]
+
+        fn = shard_map(gather_fn, mesh=mesh, in_specs=P("proc"),
+                       out_specs=P("proc"))
+        out = self.jax.jit(fn)(arr)
+        return np.asarray(out.addressable_data(0))[0]
+
+    def reducescatter(self, arrays: Sequence[np.ndarray], op: str = "sum"):
+        stacked = np.stack([np.asarray(a) for a in arrays])
+        reduced = self.allreduce(stacked, op)
+        return reduced[self.rank]
+
+    def broadcast(self, array: np.ndarray, src_rank: int = 0) -> np.ndarray:
+        contribution = np.asarray(array) if self.rank == src_rank \
+            else np.zeros_like(np.asarray(array))
+        return self.allreduce(contribution, "sum")
+
+    def send(self, array, dst_rank):
+        raise NotImplementedError(
+            "p2p over the jax backend is in-graph (lax.ppermute); use the tcp "
+            "backend for out-of-graph host p2p")
+
+    def recv(self, shape, dtype, src_rank):
+        raise NotImplementedError(
+            "p2p over the jax backend is in-graph (lax.ppermute); use the tcp "
+            "backend for out-of-graph host p2p")
+
+    def barrier(self) -> None:
+        self.allreduce(np.zeros(1, dtype=np.float32), "sum")

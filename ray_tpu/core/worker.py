@@ -42,11 +42,12 @@ _MISSING = object()
 
 
 class _LeasedWorker:
-    def __init__(self, lease_id, worker_id, address, node_id):
+    def __init__(self, lease_id, worker_id, address, node_id, raylet):
         self.lease_id = lease_id
         self.worker_id = worker_id
         self.address = address
         self.node_id = node_id
+        self.raylet = raylet  # the raylet client that granted this lease
         self.client: Optional[RpcClient] = None
         self.busy = False
         self.return_timer: Optional[asyncio.TimerHandle] = None
@@ -80,6 +81,7 @@ class CoreWorker:
         self._mem_lock = threading.Lock()
         self._registered_fns: set = set()
         self._keys: Dict[Tuple, _KeyState] = {}
+        self._raylet_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._actor_clients: Dict[bytes, "_ActorClient"] = {}
         self._put_refs: set = set()                   # plasma ids this process created
         self.current_actor_id: Optional[bytes] = None
@@ -309,16 +311,32 @@ class CoreWorker:
         elif want < len(state.inflight_reqs):
             extra = len(state.inflight_reqs) - want
             for req_id in list(state.inflight_reqs)[:extra]:
-                asyncio.ensure_future(
-                    self.raylet.call("cancel_lease_request", req_id=req_id))
+                # The request may have spilled; cancel everywhere we talk to.
+                for target in [self.raylet, *self._raylet_clients.values()]:
+                    asyncio.ensure_future(
+                        target.call("cancel_lease_request", req_id=req_id))
+
+    async def _raylet_for(self, address: Tuple[str, int]) -> RpcClient:
+        client = self._raylet_clients.get(address)
+        if client is None or client._dead:
+            client = RpcClient(*address)
+            await client.connect(timeout=15)
+            self._raylet_clients[address] = client
+        return client
 
     async def _request_lease(self, key, state: _KeyState, req_id: bytes):
         spec_resources = dict(key[1])
         pg_id, bundle_index = key[2]
+        target = self.raylet
         try:
-            reply = await self.raylet.call(
-                "lease_worker", resources=spec_resources, req_id=req_id,
-                placement_group_id=pg_id, bundle_index=bundle_index)
+            for _hop in range(4):  # bounded spillback chain
+                reply = await target.call(
+                    "lease_worker", resources=spec_resources, req_id=req_id,
+                    placement_group_id=pg_id, bundle_index=bundle_index)
+                if reply.get("spillback"):
+                    target = await self._raylet_for(tuple(reply["spillback"]))
+                    continue
+                break
         except Exception as e:
             state.inflight_reqs.discard(req_id)
             self._fail_queued(state, RayTpuError(f"lease request failed: {e!r}"))
@@ -331,7 +349,8 @@ class CoreWorker:
                 self._fail_queued(state, RayTpuError(reply.get("error", "lease refused")))
             return
         lease = _LeasedWorker(reply["lease_id"], reply["worker_id"],
-                              tuple(reply["worker_address"]), reply["node_id"])
+                              tuple(reply["worker_address"]), reply["node_id"],
+                              target)
         try:
             lease.client = RpcClient(*lease.address)
             await lease.client.connect(timeout=15)
@@ -439,8 +458,8 @@ class CoreWorker:
 
     async def _return_lease(self, state, lease: _LeasedWorker, dead: bool):
         try:
-            await self.raylet.call("return_worker", lease_id=lease.lease_id,
-                                   worker_dead=dead)
+            await lease.raylet.call("return_worker", lease_id=lease.lease_id,
+                                    worker_dead=dead)
         except Exception:
             pass
         if lease.client is not None:

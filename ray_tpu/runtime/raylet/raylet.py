@@ -85,6 +85,7 @@ class Raylet:
         self._shutdown = asyncio.Event()
         self._monitor_task = None
         self._heartbeat_task = None
+        self._cluster_view: List[dict] = []
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -108,10 +109,14 @@ class Raylet:
         return self
 
     async def _heartbeat_loop(self):
+        # Heartbeats push availability up to the GCS; the cluster view pulled
+        # back is this raylet's spillback routing table (ray_syncer resource
+        # gossip analog, src/ray/common/ray_syncer/).
         while not self._shutdown.is_set():
             try:
                 await self.gcs.call("node_heartbeat", node_id=self.node_id,
                                     available=self.available)
+                self._cluster_view = await self.gcs.call("get_nodes")
             except Exception:
                 pass
             await asyncio.sleep(2.0)
@@ -283,15 +288,30 @@ class Raylet:
                                            req.resources):
                         self._pending.remove(req)
                         if not req.fut.done():
-                            req.fut.set_result(
-                                {"ok": False,
-                                 "error": f"infeasible resources {req.resources}"})
+                            req.fut.set_result(self._spillback_or_fail(req))
                     continue
                 scheduling.subtract(pool, req.resources)
                 self._pending.remove(req)
                 granted = True
                 logger.debug("dispatch: granting lease res=%s avail=%s", req.resources, self.available)
                 asyncio.ensure_future(self._grant_lease(req))
+
+    def _spillback_or_fail(self, req: PendingLease) -> dict:
+        """Locally-infeasible lease: route the client to a node whose total
+        capacity fits (HandleRequestWorkerLease spillback reply,
+        cluster_resource_scheduler.cc:149 GetBestSchedulableNode)."""
+        candidates = [
+            n for n in self._cluster_view
+            if n.get("alive") and n["node_id"] != self.node_id
+            and scheduling.fits(n["resources"], req.resources)]
+        if not candidates:
+            return {"ok": False,
+                    "error": f"infeasible resources {req.resources}: no node in the "
+                             "cluster has enough total capacity"}
+        best = min(candidates, key=lambda n: scheduling.utilization_score(
+            n["resources"], n.get("available", n["resources"]), req.resources))
+        return {"ok": False, "spillback": tuple(best["address"]),
+                "spillback_node": best["node_id"]}
 
     async def _grant_lease(self, req: PendingLease):
         try:

@@ -1,0 +1,10 @@
+from ray_tpu.core.placement_group import (  # noqa: F401
+    PACK,
+    SPREAD,
+    STRICT_PACK,
+    STRICT_SPREAD,
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)

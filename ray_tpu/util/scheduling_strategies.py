@@ -1,0 +1,14 @@
+"""Public scheduling strategies.
+
+Reference analog: python/ray/util/scheduling_strategies.py
+(PlacementGroupSchedulingStrategy:15, NodeAffinitySchedulingStrategy:41,
+NodeLabelSchedulingStrategy:135).
+"""
+
+from ray_tpu.runtime.scheduling import (  # noqa: F401
+    DefaultStrategy,
+    NodeAffinityStrategy as NodeAffinitySchedulingStrategy,
+    NodeLabelStrategy as NodeLabelSchedulingStrategy,
+    PlacementGroupStrategy as PlacementGroupSchedulingStrategy,
+    SpreadStrategy as SpreadSchedulingStrategy,
+)
