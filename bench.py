@@ -1,0 +1,112 @@
+"""Headline benchmark: flagship Llama training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes a scalability envelope, not tokens/sec (BASELINE.md);
+the repo's north-star target is Llama-3-8B FSDP at >=45% MFU on v5e. On the
+single available chip we run the same training math (fwd+bwd+adamw, bf16,
+remat) at a ~1B-parameter configuration and report tokens/sec/chip with
+model FLOPs utilization; vs_baseline = achieved_MFU / 0.45 target.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+PEAK_FLOPS = {
+    "v5e": 197e12,   # bf16 peak per chip
+    "v5p": 459e12,
+    "v4": 275e12,
+    "cpu": 1e11,     # nominal, keeps the metric finite off-TPU
+}
+
+
+def detect_peak() -> float:
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return PEAK_FLOPS["cpu"]
+    kind = jax.devices()[0].device_kind.lower()
+    for name, peak in PEAK_FLOPS.items():
+        if name in kind.replace(" ", ""):
+            return peak
+    return PEAK_FLOPS["v5e"]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        config = llama.LlamaConfig(
+            vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=8192, max_seq=2048)
+        batch, seq, steps = 8, 2048, 10
+    else:  # smoke path for dev machines
+        config = llama.LlamaConfig.tiny(max_seq=128)
+        batch, seq, steps = 4, 128, 3
+
+    opt = optax.adamw(1e-4, b1=0.9, b2=0.95,
+                      mu_dtype=jnp.bfloat16)
+
+    @jax.jit
+    def init_state(key):
+        params = llama.init_params(config, key)
+        return {"params": params, "opt": opt.init(params)}
+
+    @jax.jit
+    def train_step(state, tokens):
+        def loss(p):
+            l, m = llama.loss_fn(p, {"tokens": tokens}, config)
+            return l
+
+        l, grads = jax.value_and_grad(loss)(state["params"])
+        updates, opt_state = opt.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "opt": opt_state}, l
+
+    state = init_state(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0,
+                                config.vocab_size)
+
+    # Warmup / compile.
+    state, l = train_step(state, tokens)
+    jax.block_until_ready(l)
+    state, l = train_step(state, tokens)
+    jax.block_until_ready(l)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, l = train_step(state, tokens)
+    jax.block_until_ready(l)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * steps / dt
+    flops_per_token = config.flops_per_token(seq)
+    mfu = tok_s * flops_per_token / detect_peak()
+
+    print(json.dumps({
+        "metric": "llama1b_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "detail": {
+            "mfu": round(mfu, 4),
+            "params_b": round(config.num_params() / 1e9, 3),
+            "batch_tokens": tokens_per_step,
+            "steps": steps,
+            "backend": jax.default_backend(),
+            "loss": float(l),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

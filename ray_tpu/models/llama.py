@@ -1,0 +1,212 @@
+"""Llama-3-family decoder: the flagship model, pure-JAX and mesh-native.
+
+The reference serves this family through external engines (vLLM for serving,
+torch for training — SURVEY §2.3 ray.llm/ray.train rows). Here the model is a
+first-class citizen: parameters are a pytree with logical-axis annotations
+(ray_tpu.parallel.sharding), the layer stack is a `lax.scan` over stacked
+weights (one-layer compile, O(1) HLO size in depth), attention dispatches to
+XLA-fused reference, Pallas flash (serving), or ring attention (sp>1), and
+the same definition drives training (FSDP/TP/SP) and inference (TP + paged
+KV) by swapping rule tables.
+
+Architecture: RMSNorm (pre-norm), RoPE, GQA, SwiGLU — Llama-3 conventions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies, swiglu
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 2048
+    n_layers: int = 16
+    n_heads: int = 16
+    n_kv_heads: int = 8
+    d_ff: int = 8192
+    max_seq: int = 2048
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attention_impl: str = "auto"   # reference | flash | ring
+    sp_axis: str = "sp"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama3_8b(**overrides) -> "LlamaConfig":
+        base = dict(vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+                    n_kv_heads=8, d_ff=14336, rope_theta=500000.0)
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def tiny(**overrides) -> "LlamaConfig":
+        base = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, max_seq=128)
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+    def num_params(self) -> int:
+        d, f, v, L = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+        hd = self.head_dim
+        attn = d * self.n_heads * hd + 2 * d * self.n_kv_heads * hd + self.n_heads * hd * d
+        mlp = 3 * d * f
+        per_layer = attn + mlp + 2 * d
+        return v * d + L * per_layer + d + d * v
+
+    def flops_per_token(self, seq: int) -> float:
+        """Training FLOPs/token (fwd+bwd ~= 6*N + attention term)."""
+        n = self.num_params() - self.vocab_size * self.d_model  # non-embedding
+        attn_flops = 12 * self.n_layers * self.d_model * seq  # 2*2*3 * L * d * s
+        return 6.0 * n + attn_flops
+
+
+# ---------------------------------------------------------------- parameters
+
+def init_params(config: LlamaConfig, key: jax.Array) -> Dict:
+    d, f, v = config.d_model, config.d_ff, config.vocab_size
+    hd, H, K, L = config.head_dim, config.n_heads, config.n_kv_heads, config.n_layers
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(config.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+
+    def stack(key, shape, fan_in):
+        return dense(key, (L,) + shape, fan_in)
+
+    params = {
+        "embed": dense(k_embed, (v, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), dtype=config.dtype),
+            "wq": stack(ks[0], (d, H * hd), d),
+            "wk": stack(ks[1], (d, K * hd), d),
+            "wv": stack(ks[2], (d, K * hd), d),
+            "wo": stack(ks[3], (H * hd, d), H * hd),
+            "mlp_norm": jnp.ones((L, d), dtype=config.dtype),
+            "w_gate": stack(ks[4], (d, f), d),
+            "w_up": stack(ks[5], (d, f), d),
+            "w_down": stack(ks[6], (f, d), f),
+        },
+        "final_norm": jnp.ones((d,), dtype=config.dtype),
+        "lm_head": dense(k_head, (d, v), d),
+    }
+    return params
+
+
+def param_logical_axes(config: LlamaConfig) -> Dict:
+    """Logical axis names per parameter (see parallel/sharding.py rules)."""
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", None),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", None),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+# ---------------------------------------------------------------- forward
+
+def _attention_dispatch(config: LlamaConfig, q, k, v):
+    impl = config.attention_impl
+    if impl == "ring":
+        from functools import partial as _partial
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ray_tpu.parallel.mesh import current_mesh
+        from ray_tpu.parallel.ring import ring_attention
+
+        mesh = current_mesh()
+        if mesh is None:
+            raise RuntimeError(
+                "attention_impl='ring' needs an ambient mesh: wrap the step "
+                "in ray_tpu.parallel.mesh.use_mesh(mesh)")
+        spec = P(("dp", "fsdp", "ep"), config.sp_axis, "tp", None)
+        fn = shard_map(
+            _partial(ring_attention, axis_name=config.sp_axis, causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        return fn(q, k, v)
+    return attention(q, k, v, causal=True, impl=impl)
+
+
+def _layer(config: LlamaConfig, x, layer_params, cos, sin):
+    """One decoder layer. x: (b, s, d)."""
+    b, s, d = x.shape
+    hd, H, K = config.head_dim, config.n_heads, config.n_kv_heads
+    p = layer_params
+
+    h = rms_norm(x, p["attn_norm"], config.norm_eps)
+    q = (h @ p["wq"]).reshape(b, s, H, hd)
+    k = (h @ p["wk"]).reshape(b, s, K, hd)
+    v = (h @ p["wv"]).reshape(b, s, K, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn_out = _attention_dispatch(config, q, k, v)
+    x = x + (attn_out.reshape(b, s, H * hd) @ p["wo"])
+
+    h = rms_norm(x, p["mlp_norm"], config.norm_eps)
+    x = x + (swiglu(h @ p["w_gate"], h @ p["w_up"]) @ p["w_down"])
+    return x
+
+
+def forward(params: Dict, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
+    """tokens: (b, s) int32 -> logits (b, s, vocab) float32."""
+    cos, sin = rope_frequencies(config.head_dim, config.max_seq, config.rope_theta)
+    x = params["embed"][tokens].astype(config.dtype)
+
+    layer_fn = partial(_layer, config)
+    if config.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(x, layer_params):
+        return layer_fn(x, layer_params, cos, sin), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = (x @ params["lm_head"].astype(config.dtype)).astype(jnp.float32)
+    return logits
+
+
+def loss_fn(params: Dict, batch: Dict[str, jax.Array],
+            config: LlamaConfig) -> Tuple[jax.Array, Dict]:
+    """batch: {"tokens": (b, s+1) int32} -> next-token cross entropy."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, config)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:].astype(jnp.float32)
+        loss = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    else:
+        loss = -ll.mean()
+    return loss, {"loss": loss, "tokens": jnp.array(targets.size, jnp.float32)}

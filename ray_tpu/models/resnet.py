@@ -1,0 +1,175 @@
+"""ResNet-50 (and friends) in pure JAX — the DDP reference-config model.
+
+Reference analog: the ResNet-50/CIFAR-10 TorchTrainer DDP config
+(BASELINE.json configs[0]). Functional: `apply(params, state, x, train)`
+returns (logits, new_state) where state carries batch-norm running stats.
+NHWC layout (TPU-native; channels-last feeds the MXU's 128-lane dimension).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+STAGES = {
+    "resnet18": ([2, 2, 2, 2], False),
+    "resnet34": ([3, 4, 6, 3], False),
+    "resnet50": ([3, 4, 6, 3], True),
+    "resnet101": ([3, 4, 23, 3], True),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    depth: str = "resnet50"
+    num_classes: int = 10
+    width: int = 64
+    small_inputs: bool = True     # CIFAR stem (3x3, no maxpool)
+    dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
+
+
+def _conv(params_key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(params_key, (kh, kw, cin, cout), jnp.float32)
+            * jnp.sqrt(2.0 / fan_in))
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))}
+
+
+def conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def batch_norm(x, p, s, train: bool, momentum: float):
+    if train:
+        mean = jnp.mean(x, axis=(0, 1, 2))
+        var = jnp.var(x, axis=(0, 1, 2))
+        new_s = {"mean": momentum * s["mean"] + (1 - momentum) * mean,
+                 "var": momentum * s["var"] + (1 - momentum) * var}
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = jax.lax.rsqrt(var + 1e-5).astype(x.dtype)
+    out = (x - mean.astype(x.dtype)) * inv * p["scale"].astype(x.dtype) \
+        + p["bias"].astype(x.dtype)
+    return out, new_s
+
+
+def init(config: ResNetConfig, key) -> Tuple[Dict, Dict]:
+    blocks, bottleneck = STAGES[config.depth]
+    keys = iter(jax.random.split(key, 256))
+    w = config.width
+    params: Dict = {}
+    state: Dict = {}
+    stem_k = 3 if config.small_inputs else 7
+    params["stem"] = {"conv": _conv(next(keys), stem_k, stem_k, 3, w),
+                      "bn": _bn_init(w)}
+    state["stem"] = _bn_state(w)
+    cin = w
+    for si, n in enumerate(blocks):
+        cmid = w * (2 ** si)
+        cout = cmid * (4 if bottleneck else 1)
+        for bi in range(n):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            name = f"s{si}b{bi}"
+            bp: Dict = {}
+            bs: Dict = {}
+            if bottleneck:
+                bp["conv1"] = _conv(next(keys), 1, 1, cin, cmid)
+                bp["conv2"] = _conv(next(keys), 3, 3, cmid, cmid)
+                bp["conv3"] = _conv(next(keys), 1, 1, cmid, cout)
+                for i, c in (("1", cmid), ("2", cmid), ("3", cout)):
+                    bp[f"bn{i}"] = _bn_init(c)
+                    bs[f"bn{i}"] = _bn_state(c)
+            else:
+                bp["conv1"] = _conv(next(keys), 3, 3, cin, cmid)
+                bp["conv2"] = _conv(next(keys), 3, 3, cmid, cout)
+                for i, c in (("1", cmid), ("2", cout)):
+                    bp[f"bn{i}"] = _bn_init(c)
+                    bs[f"bn{i}"] = _bn_state(c)
+            if stride != 1 or cin != cout:
+                bp["proj"] = _conv(next(keys), 1, 1, cin, cout)
+                bp["proj_bn"] = _bn_init(cout)
+                bs["proj_bn"] = _bn_state(cout)
+            params[name] = bp
+            state[name] = bs
+            cin = cout
+    params["head"] = {
+        "w": jax.random.normal(next(keys), (cin, config.num_classes)) * 0.01,
+        "b": jnp.zeros((config.num_classes,))}
+    return params, state
+
+
+def apply(params: Dict, state: Dict, x: jax.Array, config: ResNetConfig,
+          train: bool = True) -> Tuple[jax.Array, Dict]:
+    """x: (n, h, w, 3) -> logits (n, classes), new batch-norm state."""
+    blocks, bottleneck = STAGES[config.depth]
+    x = x.astype(config.dtype)
+    new_state: Dict = {}
+    p = params["stem"]
+    x = conv(x, p["conv"], stride=1 if config.small_inputs else 2)
+    x, new_state["stem"] = batch_norm(x, p["bn"], state["stem"], train,
+                                      config.bn_momentum)
+    x = jax.nn.relu(x)
+    if not config.small_inputs:
+        x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                                  (1, 2, 2, 1), "SAME")
+    for si, n in enumerate(blocks):
+        for bi in range(n):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            name = f"s{si}b{bi}"
+            bp, bs = params[name], state[name]
+            ns: Dict = {}
+            shortcut = x
+            if bottleneck:
+                y = conv(x, bp["conv1"])
+                y, ns["bn1"] = batch_norm(y, bp["bn1"], bs["bn1"], train,
+                                          config.bn_momentum)
+                y = jax.nn.relu(y)
+                y = conv(y, bp["conv2"], stride)
+                y, ns["bn2"] = batch_norm(y, bp["bn2"], bs["bn2"], train,
+                                          config.bn_momentum)
+                y = jax.nn.relu(y)
+                y = conv(y, bp["conv3"])
+                y, ns["bn3"] = batch_norm(y, bp["bn3"], bs["bn3"], train,
+                                          config.bn_momentum)
+            else:
+                y = conv(x, bp["conv1"], stride)
+                y, ns["bn1"] = batch_norm(y, bp["bn1"], bs["bn1"], train,
+                                          config.bn_momentum)
+                y = jax.nn.relu(y)
+                y = conv(y, bp["conv2"])
+                y, ns["bn2"] = batch_norm(y, bp["bn2"], bs["bn2"], train,
+                                          config.bn_momentum)
+            if "proj" in bp:
+                shortcut = conv(x, bp["proj"], stride)
+                shortcut, ns["proj_bn"] = batch_norm(
+                    shortcut, bp["proj_bn"], bs["proj_bn"], train,
+                    config.bn_momentum)
+            x = jax.nn.relu(y + shortcut)
+            new_state[name] = ns
+    x = jnp.mean(x, axis=(1, 2))
+    logits = x.astype(jnp.float32) @ params["head"]["w"] + params["head"]["b"]
+    return logits, new_state
+
+
+def loss_fn(params, state, batch, config: ResNetConfig):
+    logits, new_state = apply(params, state, batch["image"], config, train=True)
+    labels = batch["label"]
+    loss = -jnp.mean(jnp.take_along_axis(
+        jax.nn.log_softmax(logits), labels[:, None], axis=-1))
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": acc, "state": new_state}

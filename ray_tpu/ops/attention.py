@@ -1,0 +1,161 @@
+"""Attention ops: XLA-fused reference + Pallas flash-attention forward.
+
+Design (TPU-first):
+  * Training uses the jnp reference: XLA on TPU fuses the fp32 softmax into
+    the two matmuls and handles the backward pass; at training block sizes
+    this keeps the MXU busy without hand-scheduling.
+  * Serving/prefill uses the Pallas flash kernel (no backward needed): online
+    softmax over KV blocks, O(seq) memory, causal-block skipping. This is the
+    TTFT hot path the reference outsources to vLLM's CUDA kernels.
+  * GQA (n_kv_heads < n_heads) supported everywhere by logical repeat.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """(batch, seq, kv_heads, hd) -> (batch, seq, kv_heads*n_rep, hd)."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d)
+
+
+def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                  causal: bool = True, scale: Optional[float] = None,
+                  positions_q: Optional[jax.Array] = None,
+                  positions_kv: Optional[jax.Array] = None) -> jax.Array:
+    """q: (b, sq, h, d); k/v: (b, skv, hkv, d). Returns (b, sq, h, d).
+
+    fp32 softmax; XLA fuses this chain on TPU. The causal mask compares
+    absolute positions when provided (needed for ring/sequence parallelism).
+    """
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    if hkv != h:
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        pos_q = positions_q if positions_q is not None else jnp.arange(sq)
+        pos_k = positions_kv if positions_kv is not None else jnp.arange(k.shape[1])
+        mask = pos_q[:, None] >= pos_k[None, :]
+        logits = jnp.where(mask[None, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention forward (TPU)
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_kv: int,
+                      causal: bool, scale: float, block_q: int):
+    """Grid: (batch*heads, num_q_blocks). Blocks:
+    q_ref: (block_q, d), k_ref/v_ref: (seq_kv, d) resident, o_ref: (block_q, d).
+
+    Online softmax over KV blocks; with causal=True, KV blocks entirely above
+    the diagonal are skipped (the scheduling win of flash attention).
+    """
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # block: (1, block_q, d)
+    d = q.shape[-1]
+
+    m = jnp.full((block_q, 1), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((block_q, 1), dtype=jnp.float32)
+    acc = jnp.zeros((block_q, d), dtype=jnp.float32)
+
+    q_start = qi * block_q
+    num_k_blocks = pl.cdiv(seq_kv, block_k)
+    # Causal: only iterate KV blocks whose start is <= the last query row.
+    max_kb = jnp.where(
+        causal, (q_start + block_q - 1) // block_k + 1, num_k_blocks)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T  # (block_q, block_k)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+        acc_new = alpha * acc + p @ v_blk
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, max_kb, body, (m, l, acc))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        causal: bool = True, scale: Optional[float] = None,
+                        block_q: int = 256, block_k: int = 256,
+                        interpret: Optional[bool] = None) -> jax.Array:
+    """Pallas flash forward. q: (b, sq, h, d), k/v: (b, skv, hkv, d)."""
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    if hkv != h:
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # Layout: fold (b, h) into the grid's first axis; operate on (seq, d).
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, skv, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, skv, d)
+
+    grid = (b * h, pl.cdiv(sq, block_q))
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, seq_kv=skv, causal=causal,
+        scale=scale, block_q=block_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, skv, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, skv, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None,
+              impl: str = "auto") -> jax.Array:
+    """Dispatch: "reference" (training, XLA-fused, differentiable) or
+    "flash" (serving forward)."""
+    if impl == "auto":
+        impl = "reference"
+    if impl == "reference":
+        return mha_reference(q, k, v, causal=causal, scale=scale)
+    if impl == "flash":
+        return flash_attention_fwd(q, k, v, causal=causal, scale=scale)
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,133 @@
+"""Sharded training step builder: DDP/FSDP/TP/SP as pjit shardings.
+
+Reference analog: Train's prepare_model DDP/FSDP wrappers
+(train/torch/train_loop_utils.py:162,188) and the per-step NCCL collectives
+they imply. TPU-native: the step function is jitted once with NamedShardings
+derived from logical-axis rules; XLA emits the reduce-scatter/all-gather
+(FSDP) or all-reduce (DDP) over ICI and overlaps them with compute. There is
+no wrapper class per strategy — the rule table IS the strategy.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.parallel import sharding as sharding_mod
+from ray_tpu.parallel.mesh import use_mesh
+
+
+def init_train_state(params, optimizer) -> Dict:
+    return {
+        "params": params,
+        "opt_state": optimizer.init(params),
+        "step": jnp.zeros((), dtype=jnp.int32),
+    }
+
+
+def state_logical_axes(param_axes) -> Dict:
+    """Optimizer state mirrors parameter sharding (adam moments are
+    param-shaped; scalars replicate)."""
+    return {
+        "params": param_axes,
+        "opt_state": None,  # resolved structurally below
+        "step": (),
+    }
+
+
+def _spec_like_params(opt_state, params, param_specs):
+    """Give every param-shaped leaf in opt_state the matching param spec;
+    everything else replicates."""
+    from jax.sharding import PartitionSpec
+
+    flat_params, _ = jax.tree.flatten(params)
+    flat_specs, _ = jax.tree.flatten(
+        param_specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+    shape_to_spec = {}
+    for p, s in zip(flat_params, flat_specs):
+        shape_to_spec.setdefault((p.shape, p.dtype), s)
+
+    def leaf_spec(leaf):
+        if hasattr(leaf, "shape"):
+            return shape_to_spec.get((leaf.shape, leaf.dtype), PartitionSpec())
+        return PartitionSpec()
+
+    return jax.tree.map(leaf_spec, opt_state)
+
+
+def build_train_step(
+    loss_fn: Callable[[Any, Any], Tuple[jax.Array, Dict]],
+    optimizer: optax.GradientTransformation,
+    mesh,
+    param_axes,
+    batch_axes,
+    rules: Optional[Dict] = None,
+) -> Tuple[Callable, Callable]:
+    """Returns (init_fn, step_fn), both jitted with shardings.
+
+    - loss_fn(params, batch) -> (loss, metrics)
+    - param_axes / batch_axes: pytrees of logical-axis tuples
+    - init_fn(params_host_or_abstract) -> sharded TrainState
+    - step_fn(state, batch) -> (state, metrics); donates state
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rules = rules or sharding_mod.TRAIN_RULES
+    param_specs = sharding_mod.tree_specs(param_axes, rules)
+    batch_specs = sharding_mod.tree_specs(batch_axes, rules)
+    param_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+    batch_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), batch_specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    def _state_shardings(state):
+        opt_specs = _spec_like_params(state["opt_state"], state["params"],
+                                      param_specs)
+        return {
+            "params": param_shardings,
+            "opt_state": jax.tree.map(lambda s: NamedSharding(mesh, s), opt_specs,
+                                      is_leaf=lambda x: isinstance(x, PartitionSpec)),
+            "step": repl,
+        }
+
+    def init_fn(params):
+        with use_mesh(mesh):
+            abstract = jax.eval_shape(partial(init_train_state, optimizer=optimizer),
+                                      params)
+            shardings = _state_shardings(abstract)
+            fn = jax.jit(partial(init_train_state, optimizer=optimizer),
+                         in_shardings=(param_shardings,),
+                         out_shardings=shardings)
+            # Host params: place them first so jit doesn't double-materialize.
+            placed = jax.tree.map(
+                lambda x, s: jax.device_put(x, s), params, param_shardings)
+            return fn(placed), shardings
+
+    def make_step(state_shardings):
+        @partial(jax.jit,
+                 in_shardings=(state_shardings, batch_shardings),
+                 out_shardings=(state_shardings, repl),
+                 donate_argnums=(0,))
+        def step_fn(state, batch):
+            with use_mesh(mesh):
+                (loss, metrics), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(state["params"], batch)
+                updates, opt_state = optimizer.update(
+                    grads, state["opt_state"], state["params"])
+                params = optax.apply_updates(state["params"], updates)
+                new_state = {"params": params, "opt_state": opt_state,
+                             "step": state["step"] + 1}
+                metrics = dict(metrics)
+                metrics["grad_norm"] = optax.global_norm(grads)
+                return new_state, metrics
+
+        return step_fn
+
+    return init_fn, make_step

@@ -81,6 +81,33 @@ def build_mesh(config: MeshConfig, devices: Optional[Sequence] = None):
     return Mesh(dev_array, AXES)
 
 
+_CURRENT_MESH = None
+
+
+class use_mesh:
+    """Context manager installing `mesh` as the ambient mesh (used by model
+    code that needs explicit shard_map, e.g. ring attention)."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._prev = None
+
+    def __enter__(self):
+        global _CURRENT_MESH
+        self._prev = _CURRENT_MESH
+        _CURRENT_MESH = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        global _CURRENT_MESH
+        _CURRENT_MESH = self._prev
+        return False
+
+
+def current_mesh():
+    return _CURRENT_MESH
+
+
 def single_device_mesh():
     import jax
 

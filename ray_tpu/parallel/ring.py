@@ -1,0 +1,122 @@
+"""Ring attention: sequence/context parallelism over the `sp` mesh axis.
+
+Absent from the reference entirely (SURVEY §2.4 marks SP/CP "must be built
+natively"). Design: the sequence dimension is sharded over `sp`; each device
+holds one query block and rotates KV blocks around the ICI ring with
+`lax.ppermute`, accumulating attention with an online softmax (log-sum-exp
+carry). Communication overlaps compute naturally because XLA pipelines the
+ppermute with the per-block attention matmuls.
+
+Differentiable: the accumulation is plain jnp and ppermute has a transpose
+rule, so the same code trains (backward re-rotates blocks in reverse).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import NEG_INF, repeat_kv
+
+
+def _block_attn(q, k, v, scale, pos_q, pos_k, causal):
+    """One KV block's contribution: returns (unnormalized acc, lse parts)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = pos_q[:, None] >= pos_k[None, :]
+        s = jnp.where(mask[None, None, :, :], s, NEG_INF)
+    m = s.max(axis=-1)                                  # (b, h, q)
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)                                  # (b, h, q)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return acc, m, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str = "sp", causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Call INSIDE shard_map with seq sharded over `axis_name`.
+
+    q: (b, seq_local, h, d); k/v: (b, seq_local, hkv, d) — the local shard.
+    Device i holds tokens [i*seq_local, (i+1)*seq_local).
+    """
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    if hkv != h:
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    sp = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+
+    pos_q = my * sq + jnp.arange(sq)
+
+    def step(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        # The KV block currently held started at rank (my - i) mod sp.
+        src = (my - i) % sp
+        pos_k = src * sq + jnp.arange(sq)
+        blk_acc, blk_m, blk_l = _block_attn(q, k_blk, v_blk, scale, pos_q,
+                                            pos_k, causal)
+        m_new = jnp.maximum(m, blk_m)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(blk_m - m_new)
+        l_new = alpha * l + beta * blk_l
+        acc_new = (acc * alpha.transpose(0, 2, 1)[..., None]
+                   + blk_acc * beta.transpose(0, 2, 1)[..., None])
+        # Rotate KV around the ring (device p sends to p+1).
+        perm = [(p, (p + 1) % sp) for p in range(sp)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return k_next, v_next, m_new, l_new, acc_new
+
+    m0 = jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, sq), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, sq, h, d), dtype=jnp.float32)
+    carry = (k, v, m0, l0, acc0)
+    # Python loop: sp is static, XLA unrolls and pipelines ppermute/compute.
+    for i in range(sp):
+        carry = step(i, carry)
+    _, _, m, l, acc = carry
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis_name: str = "sp", causal: bool = True,
+                      scale: Optional[float] = None,
+                      attn_fn=None) -> jax.Array:
+    """DeepSpeed-Ulysses-style SP: all_to_all swaps the sharded dim from
+    sequence to heads, runs full-sequence attention locally on h/sp heads,
+    and swaps back. Better for moderate sequence lengths; requires
+    h % sp == 0. Call inside shard_map with seq sharded over `axis_name`."""
+    from ray_tpu.ops.attention import mha_reference
+
+    b, sq, h, d = q.shape
+    sp = lax.axis_size(axis_name)
+    assert h % sp == 0, f"heads {h} not divisible by sp {sp}"
+    hkv = k.shape[2]
+    if hkv != h:
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
+
+    def to_heads(x):
+        # (b, sq_local, h, d) -> (b, sq_global, h/sp, d)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    fn = attn_fn or (lambda a, b_, c: mha_reference(a, b_, c, causal=causal,
+                                                    scale=scale))
+    out = fn(qh, kh, vh)
+    return to_seq(out)
