@@ -44,10 +44,12 @@ def main():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
+        # ~0.9B params: fits one 16GB v5e chip with bf16 params + adam
+        # moments (mu bf16, nu fp32) + remat'd activations.
         config = llama.LlamaConfig(
-            vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
-            n_kv_heads=8, d_ff=8192, max_seq=2048)
-        batch, seq, steps = 8, 2048, 10
+            vocab_size=32000, d_model=2048, n_layers=14, n_heads=16,
+            n_kv_heads=8, d_ff=7168, max_seq=2048)
+        batch, seq, steps = 4, 2048, 10
     else:  # smoke path for dev machines
         config = llama.LlamaConfig.tiny(max_seq=128)
         batch, seq, steps = 4, 128, 3
@@ -60,7 +62,9 @@ def main():
         params = llama.init_params(config, key)
         return {"params": params, "opt": opt.init(params)}
 
-    @jax.jit
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
     def train_step(state, tokens):
         def loss(p):
             l, m = llama.loss_fn(p, {"tokens": tokens}, config)
@@ -75,16 +79,18 @@ def main():
     tokens = jax.random.randint(jax.random.key(1), (batch, seq + 1), 0,
                                 config.vocab_size)
 
-    # Warmup / compile.
+    # Warmup / compile. Sync via explicit scalar fetch: block_until_ready can
+    # be a no-op on remote-execution PJRT backends, so every timing boundary
+    # forces a device->host value transfer.
     state, l = train_step(state, tokens)
-    jax.block_until_ready(l)
+    _ = float(l)
     state, l = train_step(state, tokens)
-    jax.block_until_ready(l)
+    _ = float(l)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, l = train_step(state, tokens)
-    jax.block_until_ready(l)
+    final_loss = float(l)  # forces completion of the whole chain
     dt = time.perf_counter() - t0
 
     tokens_per_step = batch * seq
@@ -103,7 +109,7 @@ def main():
             "batch_tokens": tokens_per_step,
             "steps": steps,
             "backend": jax.default_backend(),
-            "loss": float(l),
+            "loss": final_loss,
         },
     }))
 

@@ -1,0 +1,14 @@
+from ray_tpu.data.block import Block, BlockAccessor  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    DataIterator,
+    Dataset,
+    MaterializedDataset,
+    from_blocks,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+)

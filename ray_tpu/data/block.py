@@ -1,0 +1,88 @@
+"""Blocks: the unit of distributed data.
+
+Reference analog: python/ray/data/block.py:256 (Block = Arrow table or
+pandas DataFrame; BlockAccessor). Ours standardizes on Arrow tables —
+zero-copy into numpy for the TPU host feed path — with dict-of-numpy and
+pandas conversion at the edges.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+Batch = Dict[str, np.ndarray]
+
+
+def block_from_batch(batch: Union[Batch, "pa.Table", Any]) -> Block:
+    if isinstance(batch, pa.Table):
+        return batch
+    if hasattr(batch, "to_dict") and type(batch).__module__.startswith("pandas"):
+        return pa.Table.from_pandas(batch, preserve_index=False)
+    if isinstance(batch, dict):
+        arrays = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            if v.ndim > 1:
+                # Tensor columns: fixed-shape lists.
+                arrays[k] = pa.FixedSizeListArray.from_arrays(
+                    pa.array(v.reshape(-1)), int(np.prod(v.shape[1:])))
+            else:
+                arrays[k] = pa.array(v)
+        return pa.table(arrays)
+    raise TypeError(f"cannot make a block from {type(batch)}")
+
+
+def block_from_rows(rows: List[Dict[str, Any]]) -> Block:
+    if not rows:
+        return pa.table({})
+    cols = {k: [r[k] for r in rows] for k in rows[0]}
+    return block_from_batch({k: np.asarray(v) for k, v in cols.items()})
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self.block = block
+
+    def num_rows(self) -> int:
+        return self.block.num_rows
+
+    def size_bytes(self) -> int:
+        return self.block.nbytes
+
+    def schema(self):
+        return self.block.schema
+
+    def to_batch(self) -> Batch:
+        out: Batch = {}
+        for name in self.block.column_names:
+            col = self.block.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                flat = col.combine_chunks().flatten()
+                width = col.type.list_size
+                out[name] = np.asarray(flat).reshape(-1, width)
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_pandas(self):
+        return self.block.to_pandas()
+
+    def to_rows(self) -> Iterator[Dict[str, Any]]:
+        batch = self.to_batch()
+        n = self.num_rows()
+        for i in range(n):
+            yield {k: v[i] for k, v in batch.items()}
+
+    def slice(self, start: int, end: int) -> Block:
+        return self.block.slice(start, end - start)
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if b.num_rows > 0]
+        if not blocks:
+            return pa.table({})
+        return pa.concat_tables(blocks)

@@ -1,0 +1,219 @@
+"""Dataset: the lazy, streaming, distributed data API.
+
+Reference analog: python/ray/data/dataset.py (map_batches:409, iter_batches
+via iterator.py:94, read_api.py connectors). Plans build lazily; execution
+streams blocks through the task runtime with backpressure (execution.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+from builtins import range as _range
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.data import datasource as ds_mod
+from ray_tpu.data import plan as plan_mod
+from ray_tpu.data.block import Batch, Block, BlockAccessor
+
+
+class Dataset:
+    def __init__(self, ops: List[plan_mod.LogicalOp], parallelism: int = 8):
+        self._ops = ops
+        self._parallelism = parallelism
+
+    # ---- transforms (lazy) ----------------------------------------------
+
+    def _with(self, op: plan_mod.LogicalOp) -> "Dataset":
+        return Dataset(self._ops + [op], self._parallelism)
+
+    def map_batches(self, fn: Callable[[Batch], Batch], *,
+                    batch_size: Optional[int] = None,
+                    fn_kwargs: Optional[dict] = None) -> "Dataset":
+        return self._with(plan_mod.MapBatches(fn, batch_size, fn_kwargs))
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        return self._with(plan_mod.MapRows(fn))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        return self._with(plan_mod.FlatMap(fn))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        return self._with(plan_mod.FilterRows(fn))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(plan_mod.Limit(n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(plan_mod.Repartition(num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with(plan_mod.RandomShuffle(seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with(plan_mod.Sort(key, descending))
+
+    # ---- execution -------------------------------------------------------
+
+    def iter_blocks(self) -> Iterator[Block]:
+        from ray_tpu.data.execution import execute_streaming
+
+        yield from execute_streaming(self._ops, self._parallelism)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        """Streams batches, re-chunking across block boundaries."""
+        leftover: Optional[Block] = None
+        for block in self.iter_blocks():
+            if leftover is not None and leftover.num_rows:
+                block = BlockAccessor.concat([leftover, block])
+                leftover = None
+            if batch_size is None:
+                yield self._format(block, batch_format)
+                continue
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            start = 0
+            while n - start >= batch_size:
+                yield self._format(acc.slice(start, start + batch_size),
+                                   batch_format)
+                start += batch_size
+            if start < n:
+                leftover = acc.slice(start, n)
+        if leftover is not None and leftover.num_rows and not drop_last:
+            yield self._format(leftover, batch_format)
+
+    def iter_rows(self) -> Iterator[Dict]:
+        for block in self.iter_blocks():
+            yield from BlockAccessor(block).to_rows()
+
+    @staticmethod
+    def _format(block: Block, batch_format: str):
+        if batch_format in ("numpy", "default"):
+            return BlockAccessor(block).to_batch()
+        if batch_format == "pandas":
+            return BlockAccessor(block).to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return block
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    # ---- consumption -----------------------------------------------------
+
+    def take(self, n: int = 20) -> List[Dict]:
+        return list(itertools.islice(self.iter_rows(), n))
+
+    def take_all(self) -> List[Dict]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(b.num_rows for b in self.iter_blocks())
+
+    def schema(self):
+        for block in self.iter_blocks():
+            return block.schema
+        return None
+
+    def materialize(self) -> "MaterializedDataset":
+        return MaterializedDataset(list(self.iter_blocks()), self._parallelism)
+
+    def to_pandas(self):
+        return BlockAccessor.concat(list(self.iter_blocks())).to_pandas()
+
+    # ---- train ingestion -------------------------------------------------
+
+    def streaming_split(self, n: int) -> List["DataIterator"]:
+        """N disjoint iterators (one per train worker), round-robin blocks.
+
+        Reference analog: Dataset.streaming_split used by Train's DataConfig.
+        """
+        blocks = list(self.iter_blocks())  # materialized split (round 1)
+        shards: List[List[Block]] = [[] for _ in _range(n)]
+        for i, b in enumerate(blocks):
+            shards[i % n].append(b)
+        return [DataIterator(MaterializedDataset(s, self._parallelism))
+                for s in shards]
+
+    def split(self, n: int) -> List["MaterializedDataset"]:
+        blocks = list(self.iter_blocks())
+        shards: List[List[Block]] = [[] for _ in _range(n)]
+        for i, b in enumerate(blocks):
+            shards[i % n].append(b)
+        return [MaterializedDataset(s, self._parallelism) for s in shards]
+
+
+class MaterializedDataset(Dataset):
+    def __init__(self, blocks: List[Block], parallelism: int = 8):
+        self._blocks = blocks
+        self._parallelism = parallelism
+        self._ops = []
+
+    def iter_blocks(self) -> Iterator[Block]:
+        yield from self._blocks
+
+    def _with(self, op):
+        # Transforms on materialized data re-enter the lazy path.
+        ds = from_blocks(self._blocks, self._parallelism)
+        return ds._with(op)
+
+
+class DataIterator:
+    """Per-worker view for train ingestion (reference: DataIterator
+    iterator.py:94)."""
+
+    def __init__(self, dataset: Dataset):
+        self._ds = dataset
+
+    def iter_batches(self, **kwargs):
+        return self._ds.iter_batches(**kwargs)
+
+    def count(self):
+        return self._ds.count()
+
+
+# ---- read API (reference: read_api.py) -----------------------------------
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    return Dataset([plan_mod.Read(ds_mod.RangeDatasource(n), parallelism)],
+                   parallelism)
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(ds_mod.ItemsDatasource(items), parallelism)],
+                   parallelism)
+
+
+def from_numpy(arrays: Dict[str, np.ndarray], *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(ds_mod.NumpyDatasource(arrays), parallelism)],
+                   parallelism)
+
+
+def from_pandas(df, *, parallelism: int = 8) -> Dataset:
+    import pyarrow as pa
+
+    table = pa.Table.from_pandas(df, preserve_index=False)
+    return from_blocks([table], parallelism)
+
+
+def from_blocks(blocks: List[Block], parallelism: int = 8) -> Dataset:
+    class _BlocksSource(ds_mod.Datasource):
+        def read_tasks(self, parallelism_, limit):
+            return [lambda b=b: b for b in blocks]
+
+    return Dataset([plan_mod.Read(_BlocksSource(), parallelism)], parallelism)
+
+
+def read_parquet(paths, *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(ds_mod.ParquetDatasource(paths), parallelism)],
+                   parallelism)
+
+
+def read_csv(paths, *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(ds_mod.CSVDatasource(paths), parallelism)],
+                   parallelism)
+
+
+def read_json(paths, *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(ds_mod.JSONDatasource(paths), parallelism)],
+                   parallelism)

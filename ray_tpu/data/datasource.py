@@ -1,0 +1,129 @@
+"""Datasources: read tasks that produce blocks.
+
+Reference analog: python/ray/data/read_api.py + datasource/ connectors. Each
+datasource splits into `ReadTask`s (callables returning one block) so reads
+parallelize as ordinary tasks.
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, block_from_batch, block_from_rows
+
+ReadTask = Callable[[], Block]
+
+
+class Datasource:
+    def read_tasks(self, parallelism: int, limit: Optional[int]) -> List[ReadTask]:
+        raise NotImplementedError
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, column: str = "id"):
+        self.n = n
+        self.column = column
+
+    def read_tasks(self, parallelism, limit):
+        n = self.n if limit is None else min(self.n, limit)
+        parallelism = max(1, min(parallelism, n))
+        per = (n + parallelism - 1) // parallelism
+        tasks = []
+        for i in range(parallelism):
+            lo, hi = i * per, min((i + 1) * per, n)
+            if lo >= hi:
+                break
+            col = self.column
+            tasks.append(lambda lo=lo, hi=hi: block_from_batch(
+                {col: np.arange(lo, hi)}))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self.items = items
+
+    def read_tasks(self, parallelism, limit):
+        items = self.items if limit is None else self.items[:limit]
+        parallelism = max(1, min(parallelism, len(items) or 1))
+        per = (len(items) + parallelism - 1) // parallelism
+        tasks = []
+        for i in range(parallelism):
+            chunk = items[i * per:(i + 1) * per]
+            if not chunk:
+                break
+            if chunk and isinstance(chunk[0], dict):
+                tasks.append(lambda c=chunk: block_from_rows(c))
+            else:
+                tasks.append(lambda c=chunk: block_from_batch(
+                    {"item": np.asarray(c)}))
+        return tasks
+
+
+class NumpyDatasource(Datasource):
+    def __init__(self, arrays: Dict[str, np.ndarray]):
+        self.arrays = arrays
+
+    def read_tasks(self, parallelism, limit):
+        n = len(next(iter(self.arrays.values())))
+        if limit is not None:
+            n = min(n, limit)
+        parallelism = max(1, min(parallelism, n))
+        per = (n + parallelism - 1) // parallelism
+        tasks = []
+        for i in range(parallelism):
+            lo, hi = i * per, min((i + 1) * per, n)
+            if lo >= hi:
+                break
+            tasks.append(lambda lo=lo, hi=hi: block_from_batch(
+                {k: v[lo:hi] for k, v in self.arrays.items()}))
+        return tasks
+
+
+class _FileDatasource(Datasource):
+    def __init__(self, paths):
+        if isinstance(paths, str):
+            paths = [paths]
+        expanded: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                expanded.extend(sorted(
+                    os.path.join(p, f) for f in os.listdir(p)))
+            elif any(ch in p for ch in "*?["):
+                expanded.extend(sorted(glob_mod.glob(p)))
+            else:
+                expanded.append(p)
+        if not expanded:
+            raise FileNotFoundError(f"no files match {paths}")
+        self.paths = expanded
+
+    def _read_file(self, path: str) -> Block:
+        raise NotImplementedError
+
+    def read_tasks(self, parallelism, limit):
+        return [lambda p=p: self._read_file(p) for p in self.paths]
+
+
+class ParquetDatasource(_FileDatasource):
+    def _read_file(self, path):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path)
+
+
+class CSVDatasource(_FileDatasource):
+    def _read_file(self, path):
+        from pyarrow import csv as pacsv
+
+        return pacsv.read_csv(path)
+
+
+class JSONDatasource(_FileDatasource):
+    def _read_file(self, path):
+        from pyarrow import json as pajson
+
+        return pajson.read_json(path)

@@ -1,0 +1,168 @@
+"""Streaming execution of dataset plans over the task runtime.
+
+Reference analog: python/ray/data/_internal/execution/streaming_executor.py:48
+(run:231; scheduling loop streaming_executor_state.py:393/:531). Blocks flow
+through fused map stages as remote tasks with bounded in-flight concurrency
+(backpressure); results stream to the consumer as they finish rather than
+materializing the whole dataset.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import plan as plan_mod
+from ray_tpu.data.block import Block, BlockAccessor, block_from_batch
+
+MAX_IN_FLIGHT = 8
+
+
+def _apply_fused(stages_payload: bytes, block: Block) -> Block:
+    """Worker-side: run a fused chain of transforms on one block."""
+    import cloudpickle
+
+    from ray_tpu.data import plan as plan_mod
+    from ray_tpu.data.block import BlockAccessor, block_from_batch, block_from_rows
+
+    stages = cloudpickle.loads(stages_payload)
+    for stage in stages:
+        acc = BlockAccessor(block)
+        if isinstance(stage, plan_mod.MapBatches):
+            batch = acc.to_batch()
+            out = stage.fn(batch, **(stage.fn_kwargs or {}))
+            block = block_from_batch(out)
+        elif isinstance(stage, plan_mod.MapRows):
+            block = block_from_rows([stage.fn(r) for r in acc.to_rows()])
+        elif isinstance(stage, plan_mod.FlatMap):
+            rows = []
+            for r in acc.to_rows():
+                rows.extend(stage.fn(r))
+            block = block_from_rows(rows)
+        elif isinstance(stage, plan_mod.FilterRows):
+            block = block_from_rows([r for r in acc.to_rows() if stage.fn(r)])
+        else:
+            raise TypeError(f"unfusable stage {stage}")
+    return block
+
+
+def execute_streaming(ops: List[plan_mod.LogicalOp], parallelism: int,
+                      max_in_flight: int = MAX_IN_FLIGHT) -> Iterator[Block]:
+    """Run the optimized plan; yields output blocks as they complete."""
+    import cloudpickle
+
+    ops = plan_mod.optimize(ops)
+    assert ops and isinstance(ops[0], plan_mod.Read), "plan must start with Read"
+    read: plan_mod.Read = ops[0]
+    rest = ops[1:]
+
+    # Split plan into streamable prefix (fused maps) and barrier suffix
+    # (repartition/shuffle/sort/limit need all blocks).
+    stream_stages: List[plan_mod.FusedMap] = []
+    barrier_ops: List[plan_mod.LogicalOp] = []
+    for op in rest:
+        if isinstance(op, plan_mod.FusedMap) and not barrier_ops:
+            stream_stages.append(op)
+        else:
+            barrier_ops.append(op)
+
+    tasks = read.datasource.read_tasks(parallelism, read.limit)
+
+    fused_payloads = [cloudpickle.dumps(s.stages) for s in stream_stages]
+
+    @ray_tpu.remote
+    def run_block(read_task_payload, payloads):
+        import cloudpickle as cp
+
+        read_task = cp.loads(read_task_payload)
+        block = read_task()
+        for p in payloads:
+            block = _apply_fused(p, block)
+        return block
+
+    import cloudpickle as cp
+
+    # Bounded-in-flight dispatch with order preservation: tasks complete in
+    # any order, blocks are yielded in plan order (backpressure loop,
+    # select_operator_to_run analog).
+    queue = [(i, cp.dumps(t)) for i, t in enumerate(tasks)]
+    pending: dict = {}         # ref -> index
+    completed: dict = {}       # index -> Block
+    next_idx = 0
+
+    def submit_more():
+        while queue and len(pending) < max_in_flight:
+            idx, payload = queue.pop(0)
+            pending[run_block.remote(payload, fused_payloads)] = idx
+
+    def stream():
+        nonlocal next_idx
+        submit_more()
+        while pending or completed:
+            while next_idx in completed:
+                yield completed.pop(next_idx)
+                next_idx += 1
+            if not pending:
+                continue
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1, timeout=600)
+            if not ready:
+                raise TimeoutError("dataset task timed out")
+            for ref in ready:
+                idx = pending.pop(ref)
+                completed[idx] = ray_tpu.get(ref, timeout=600)
+            submit_more()
+
+    if not barrier_ops:
+        yield from stream()
+        return
+
+    # Barrier path: materialize, then apply barrier ops locally (distributed
+    # shuffle lands in a later round).
+    blocks = list(stream())
+    for op in barrier_ops:
+        blocks = _apply_barrier(op, blocks)
+    yield from blocks
+
+
+def _apply_barrier(op, blocks: List[Block]) -> List[Block]:
+    from ray_tpu.data.block import BlockAccessor
+
+    if isinstance(op, plan_mod.Limit):
+        out, taken = [], 0
+        for b in blocks:
+            if taken >= op.n:
+                break
+            take = min(b.num_rows, op.n - taken)
+            out.append(BlockAccessor(b).slice(0, take))
+            taken += take
+        return out
+    if isinstance(op, plan_mod.Repartition):
+        whole = BlockAccessor.concat(blocks)
+        n = whole.num_rows
+        k = max(1, op.num_blocks)
+        per = (n + k - 1) // k
+        return [BlockAccessor(whole).slice(i * per, min((i + 1) * per, n))
+                for i in range(k) if i * per < n]
+    if isinstance(op, plan_mod.RandomShuffle):
+        whole = BlockAccessor.concat(blocks)
+        rng = np.random.default_rng(op.seed)
+        idx = rng.permutation(whole.num_rows)
+        import pyarrow.compute as pc
+
+        return [whole.take(idx)]
+    if isinstance(op, plan_mod.Sort):
+        whole = BlockAccessor.concat(blocks)
+        import pyarrow.compute as pc
+
+        order = "descending" if op.descending else "ascending"
+        idx = pc.sort_indices(whole, sort_keys=[(op.key, order)])
+        return [whole.take(idx)]
+    if isinstance(op, plan_mod.FusedMap):
+        # FusedMap after a barrier op: run locally.
+        import cloudpickle
+
+        payload = cloudpickle.dumps(op.stages)
+        return [_apply_fused(payload, b) for b in blocks]
+    raise TypeError(f"unknown barrier op {op}")

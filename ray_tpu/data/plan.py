@@ -1,0 +1,110 @@
+"""Logical plan + optimizer for datasets.
+
+Reference analog: python/ray/data/_internal/logical/ — operators plus rules
+(operator_fusion.py, limit_pushdown.py). Plans here are linear chains of
+operators over blocks; the optimizer fuses adjacent row/batch transforms into
+one task stage (zero intermediate materialization) and pushes limits into
+reads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional
+
+
+class LogicalOp:
+    name = "op"
+
+
+@dataclasses.dataclass
+class Read(LogicalOp):
+    datasource: Any               # Datasource
+    parallelism: int
+    limit: Optional[int] = None
+    name = "Read"
+
+
+@dataclasses.dataclass
+class MapBatches(LogicalOp):
+    fn: Callable
+    batch_size: Optional[int] = None
+    fn_kwargs: Optional[dict] = None
+    name = "MapBatches"
+
+
+@dataclasses.dataclass
+class MapRows(LogicalOp):
+    fn: Callable
+    name = "MapRows"
+
+
+@dataclasses.dataclass
+class FilterRows(LogicalOp):
+    fn: Callable
+    name = "Filter"
+
+
+@dataclasses.dataclass
+class FlatMap(LogicalOp):
+    fn: Callable
+    name = "FlatMap"
+
+
+@dataclasses.dataclass
+class Limit(LogicalOp):
+    n: int
+    name = "Limit"
+
+
+@dataclasses.dataclass
+class Repartition(LogicalOp):
+    num_blocks: int
+    name = "Repartition"
+
+
+@dataclasses.dataclass
+class RandomShuffle(LogicalOp):
+    seed: Optional[int] = None
+    name = "RandomShuffle"
+
+
+@dataclasses.dataclass
+class Sort(LogicalOp):
+    key: str
+    descending: bool = False
+    name = "Sort"
+
+
+FUSABLE = (MapBatches, MapRows, FilterRows, FlatMap)
+
+
+@dataclasses.dataclass
+class FusedMap(LogicalOp):
+    """A chain of row/batch transforms executed in one task."""
+
+    stages: List[LogicalOp]
+    name = "FusedMap"
+
+
+def optimize(ops: List[LogicalOp]) -> List[LogicalOp]:
+    """Fusion + limit pushdown."""
+    # Limit pushdown: Limit directly after Read folds into the read.
+    out: List[LogicalOp] = []
+    for op in ops:
+        if isinstance(op, Limit) and out and isinstance(out[-1], Read) \
+                and out[-1].limit is None:
+            out[-1] = dataclasses.replace(out[-1], limit=op.n)
+        else:
+            out.append(op)
+    # Fuse adjacent map-like ops.
+    fused: List[LogicalOp] = []
+    for op in out:
+        if isinstance(op, FUSABLE):
+            if fused and isinstance(fused[-1], FusedMap):
+                fused[-1].stages.append(op)
+            else:
+                fused.append(FusedMap(stages=[op]))
+        else:
+            fused.append(op)
+    return fused

@@ -1,0 +1,182 @@
+"""Model execution for serving: prefill + paged decode.
+
+Reference analog: the vLLM engine internals the reference only *places*
+(vllm_engine.py:222, vllm_models.py:117-168). Here the engine is native:
+the KV cache is a paged pool `(layers, num_blocks, block_size, kv_heads,
+head_dim)`; block tables map each sequence's logical positions onto pool
+blocks; decode attention gathers pages (jnp reference impl; the Pallas
+ragged-paged-attention kernel drops into `paged_attention` for TPU decode).
+
+Shapes are static per (batch-bucket, max-blocks) so XLA compiles a small,
+reusable set of programs — no dynamic shapes in the hot loop.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama as llama_mod
+from ray_tpu.ops.attention import NEG_INF, mha_reference
+from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies, swiglu
+
+
+def init_kv_cache(config: llama_mod.LlamaConfig, num_blocks: int,
+                  block_size: int) -> Dict[str, jax.Array]:
+    shape = (config.n_layers, num_blocks, block_size, config.n_kv_heads,
+             config.head_dim)
+    return {"k": jnp.zeros(shape, dtype=config.dtype),
+            "v": jnp.zeros(shape, dtype=config.dtype)}
+
+
+def _write_kv(cache_layer_k, cache_layer_v, k, v, block_ids, offsets):
+    """Scatter new kv rows into the paged pool.
+
+    cache_layer_*: (num_blocks, bs, K, hd); k/v: (n_tokens, K, hd);
+    block_ids/offsets: (n_tokens,).
+    """
+    return (cache_layer_k.at[block_ids, offsets].set(k),
+            cache_layer_v.at[block_ids, offsets].set(v))
+
+
+def paged_attention(q, cache_k, cache_v, block_tables, seq_lens, *,
+                    block_size: int, scale: float):
+    """Decode attention over paged KV.
+
+    q: (b, H, hd) one query token per sequence.
+    cache_k/v: (num_blocks, bs, K, hd) for ONE layer.
+    block_tables: (b, max_blocks) int32; seq_lens: (b,) lengths INCLUDING the
+    current token (whose kv must already be written).
+    Returns (b, H, hd).
+    """
+    b, H, hd = q.shape
+    K = cache_k.shape[2]
+    max_blocks = block_tables.shape[1]
+    max_ctx = max_blocks * block_size
+    # Gather pages: (b, max_blocks, bs, K, hd) -> (b, max_ctx, K, hd)
+    k = cache_k[block_tables].reshape(b, max_ctx, K, hd)
+    v = cache_v[block_tables].reshape(b, max_ctx, K, hd)
+    if K != H:
+        rep = H // K
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bhd,bkhd->bhk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(max_ctx)[None, :]
+    mask = pos < seq_lens[:, None]
+    logits = jnp.where(mask[:, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhk,bkhd->bhd", probs, v)
+
+
+class ModelRunner:
+    """Jit-compiled prefill and decode over a paged cache."""
+
+    def __init__(self, config: llama_mod.LlamaConfig, params,
+                 num_blocks: int, block_size: int = 16):
+        self.config = config
+        self.params = params
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.cache = init_kv_cache(config, num_blocks, block_size)
+        self.cos, self.sin = rope_frequencies(
+            config.head_dim, config.max_seq, config.rope_theta)
+        self._prefill_jit = jax.jit(self._prefill, donate_argnums=(1,))
+        self._decode_jit = jax.jit(self._decode, donate_argnums=(1,))
+
+    # ---- prefill ---------------------------------------------------------
+
+    def _prefill(self, tokens, cache, block_table):
+        """tokens: (1, s); block_table: (max_blocks,). Returns (logits_last,
+        cache) with the prompt's kv written into the pool."""
+        config = self.config
+        p = self.params
+        s = tokens.shape[1]
+        x = p["embed"][tokens].astype(config.dtype)
+        positions = jnp.arange(s)
+        block_ids = block_table[positions // self.block_size]
+        offsets = positions % self.block_size
+
+        def layer_step(carry, layer_params_and_idx):
+            x, cache_k, cache_v = carry
+            lp, li = layer_params_and_idx
+            h = rms_norm(x, lp["attn_norm"], config.norm_eps)
+            b, s, d = x.shape
+            H, K, hd = config.n_heads, config.n_kv_heads, config.head_dim
+            q = (h @ lp["wq"]).reshape(b, s, H, hd)
+            k = (h @ lp["wk"]).reshape(b, s, K, hd)
+            v = (h @ lp["wv"]).reshape(b, s, K, hd)
+            q = apply_rope(q, self.cos, self.sin)
+            k = apply_rope(k, self.cos, self.sin)
+            cache_k = cache_k.at[li, block_ids, offsets].set(k[0])
+            cache_v = cache_v.at[li, block_ids, offsets].set(v[0])
+            attn = mha_reference(q, k, v, causal=True)
+            x = x + (attn.reshape(b, s, H * hd) @ lp["wo"])
+            h = rms_norm(x, lp["mlp_norm"], config.norm_eps)
+            x = x + (swiglu(h @ lp["w_gate"], h @ lp["w_up"]) @ lp["w_down"])
+            return (x, cache_k, cache_v), None
+
+        layer_indices = jnp.arange(config.n_layers)
+        (x, ck, cv), _ = jax.lax.scan(
+            layer_step, (x, cache["k"], cache["v"]),
+            (p["layers"], layer_indices))
+        x = rms_norm(x, p["final_norm"], config.norm_eps)
+        logits = (x[:, -1, :] @ p["lm_head"].astype(config.dtype)).astype(
+            jnp.float32)
+        return logits, {"k": ck, "v": cv}
+
+    def prefill(self, tokens: jax.Array, block_table) -> jax.Array:
+        logits, self.cache = self._prefill_jit(tokens, self.cache, block_table)
+        return logits
+
+    # ---- decode ----------------------------------------------------------
+
+    def _decode(self, tokens, cache, block_tables, positions, seq_lens):
+        """tokens: (b,) last sampled token per seq; positions: (b,) where the
+        new token goes; seq_lens: (b,) lengths AFTER this token."""
+        config = self.config
+        p = self.params
+        b = tokens.shape[0]
+        H, K, hd = config.n_heads, config.n_kv_heads, config.head_dim
+        scale = 1.0 / math.sqrt(hd)
+        x = p["embed"][tokens].astype(config.dtype)[:, None, :]  # (b,1,d)
+        block_ids = jnp.take_along_axis(
+            block_tables, (positions // self.block_size)[:, None], axis=1)[:, 0]
+        offsets = positions % self.block_size
+
+        def layer_step(carry, layer_params_and_idx):
+            x, cache_k, cache_v = carry
+            lp, li = layer_params_and_idx
+            h = rms_norm(x, lp["attn_norm"], config.norm_eps)
+            q = (h @ lp["wq"]).reshape(b, 1, H, hd)
+            k = (h @ lp["wk"]).reshape(b, 1, K, hd)
+            v = (h @ lp["wv"]).reshape(b, 1, K, hd)
+            q = apply_rope(q, self.cos, self.sin, positions[:, None])
+            k = apply_rope(k, self.cos, self.sin, positions[:, None])
+            cache_k = cache_k.at[li, block_ids, offsets].set(k[:, 0])
+            cache_v = cache_v.at[li, block_ids, offsets].set(v[:, 0])
+            attn = paged_attention(q[:, 0], cache_k[li], cache_v[li],
+                                   block_tables, seq_lens,
+                                   block_size=self.block_size, scale=scale)
+            x = x + (attn.reshape(b, 1, H * hd) @ lp["wo"])
+            h = rms_norm(x, lp["mlp_norm"], config.norm_eps)
+            x = x + (swiglu(h @ lp["w_gate"], h @ lp["w_up"]) @ lp["w_down"])
+            return (x, cache_k, cache_v), None
+
+        layer_indices = jnp.arange(config.n_layers)
+        (x, ck, cv), _ = jax.lax.scan(
+            layer_step, (x, cache["k"], cache["v"]),
+            (p["layers"], layer_indices))
+        x = rms_norm(x, p["final_norm"], config.norm_eps)
+        logits = (x[:, 0, :] @ p["lm_head"].astype(config.dtype)).astype(
+            jnp.float32)
+        return logits, {"k": ck, "v": cv}
+
+    def decode(self, tokens, block_tables, positions, seq_lens) -> jax.Array:
+        logits, self.cache = self._decode_jit(
+            tokens, self.cache, block_tables, positions, seq_lens)
+        return logits

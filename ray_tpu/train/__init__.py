@@ -1,0 +1,15 @@
+from ray_tpu.train.backend import allreduce_gradients  # noqa: F401
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from ray_tpu.train.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.result import Result  # noqa: F401
+from ray_tpu.train.session import get_checkpoint, get_context, report  # noqa: F401
+from ray_tpu.train.trainer import (  # noqa: F401
+    CollectiveTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+)

@@ -1,0 +1,106 @@
+"""Train backends: how a worker group becomes a distributed compute group.
+
+Reference analog: train/torch/config.py:36,153 (_TorchBackend wiring
+init_process_group over NCCL) and backend_executor's rank/env plumbing
+(:278-456). TPU-native:
+
+  * JaxBackend — multi-host jax.distributed bootstrap (coordinator address
+    rendezvoused through the GCS KV). After on_start, `jax.devices()` spans
+    the whole worker group and pjit/shard_map programs run collectives over
+    ICI/DCN. This is the FSDP/TP/SP path.
+  * CollectiveBackend — out-of-graph gradient sync via the TCP communicator
+    (gloo analog). This is the CPU-testable DDP path: each worker computes
+    grads locally and allreduces host arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class Backend:
+    backend_name = "base"
+
+    def on_start(self, rank: int, world_size: int, group_name: str):
+        """Runs INSIDE each train worker before the user function."""
+
+    def on_shutdown(self, rank: int, world_size: int, group_name: str):
+        pass
+
+
+class JaxBackend(Backend):
+    """jax.distributed across the worker group (the NCCL-process-group
+    replacement). Workers must each own their TPU chips (TPU_VISIBLE_CHIPS
+    is set by the raylet lease)."""
+
+    backend_name = "jax"
+
+    def on_start(self, rank: int, world_size: int, group_name: str):
+        from ray_tpu.collective.collective import _gcs_kv
+        from ray_tpu.collective.jax_backend import initialize_jax_distributed
+
+        kv_put, kv_get = _gcs_kv()
+        initialize_jax_distributed(rank, world_size, group_name, kv_put, kv_get)
+
+
+class CollectiveBackend(Backend):
+    """TCP collective group for out-of-graph DDP gradient sync."""
+
+    backend_name = "collective"
+
+    def __init__(self):
+        self.comm = None
+
+    def on_start(self, rank: int, world_size: int, group_name: str):
+        from ray_tpu.collective.collective import init_collective_group
+
+        global _active_group
+        self.comm = init_collective_group(world_size, rank, backend="tcp",
+                                          group_name=group_name)
+        _active_group = group_name
+
+    def on_shutdown(self, rank: int, world_size: int, group_name: str):
+        from ray_tpu.collective.collective import destroy_collective_group
+
+        try:
+            destroy_collective_group(group_name)
+        except Exception:
+            pass
+        self.comm = None
+
+
+BACKENDS = {"jax": JaxBackend, "collective": CollectiveBackend, "none": Backend}
+
+# The collective group name of the currently-running train job in this
+# worker process (set by setup_backend; used by allreduce_gradients).
+_active_group: Optional[str] = None
+
+
+def make_backend(name_or_backend) -> Backend:
+    if isinstance(name_or_backend, Backend):
+        return name_or_backend
+    return BACKENDS[name_or_backend or "none"]()
+
+
+def allreduce_gradients(grads, group_name: Optional[str] = None):
+    """DDP helper: mean-allreduce a pytree of host/jax arrays over the
+    worker group's collective backend (reference: the NCCL allreduce inside
+    DDP's backward). Use inside train loops running the CollectiveBackend."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.collective.collective import get_group
+
+    comm = get_group(group_name or _active_group or "default")
+    leaves, treedef = jax.tree.flatten(grads)
+    flat = np.concatenate([np.asarray(l).ravel() for l in leaves]) \
+        if leaves else np.zeros(0)
+    reduced = comm.allreduce(flat, op="mean")
+    out = []
+    offset = 0
+    for leaf in leaves:
+        size = int(np.prod(np.asarray(leaf).shape)) if hasattr(leaf, "shape") else 1
+        out.append(reduced[offset:offset + size].reshape(np.asarray(leaf).shape)
+                   .astype(np.asarray(leaf).dtype))
+        offset += size
+    return jax.tree.unflatten(treedef, out)

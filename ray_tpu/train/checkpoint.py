@@ -1,0 +1,118 @@
+"""Checkpoints: directory handles + top-K retention + pytree (de)serialization.
+
+Reference analog: python/ray/train/_checkpoint.py:56 (Checkpoint = filesystem
++ path), train/_internal/checkpoint_manager.py (top-K by score). Pytree
+save/load uses a flat npz + pickled treedef — works for jax arrays on any
+mesh (arrays are fetched to host; sharded restore re-shards via device_put).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @staticmethod
+    def from_directory(path: str) -> "Checkpoint":
+        return Checkpoint(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, dest: str) -> str:
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    # -- pytree helpers ----------------------------------------------------
+
+    @staticmethod
+    def save_pytree(tree: Any, path: str, name: str = "state") -> "Checkpoint":
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        leaves, treedef = jax.tree.flatten(tree)
+        host_leaves = [np.asarray(leaf) for leaf in leaves]
+        np.savez(os.path.join(path, f"{name}.npz"),
+                 **{f"leaf_{i}": l for i, l in enumerate(host_leaves)})
+        with open(os.path.join(path, f"{name}.treedef.pkl"), "wb") as f:
+            pickle.dump(treedef, f)
+        return Checkpoint(path)
+
+    def load_pytree(self, name: str = "state") -> Any:
+        import jax
+
+        with open(os.path.join(self.path, f"{name}.treedef.pkl"), "rb") as f:
+            treedef = pickle.load(f)
+        data = np.load(os.path.join(self.path, f"{name}.npz"))
+        leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+        return jax.tree.unflatten(treedef, leaves)
+
+
+class CheckpointManager:
+    """Top-K checkpoint retention under a run directory."""
+
+    def __init__(self, run_path: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self.run_path = run_path
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._entries: List[Tuple[float, str, Dict]] = []
+        self._counter = 0
+        os.makedirs(run_path, exist_ok=True)
+
+    def register(self, source_dir: str, metrics: Dict) -> Checkpoint:
+        self._counter += 1
+        dest = os.path.join(self.run_path, f"checkpoint_{self._counter:06d}")
+        if os.path.abspath(source_dir) != dest:
+            shutil.copytree(source_dir, dest, dirs_exist_ok=True)
+        with open(os.path.join(dest, "metrics.json"), "w") as f:
+            json.dump({k: v for k, v in metrics.items()
+                       if isinstance(v, (int, float, str, bool))}, f)
+        score = float(metrics.get(self.score_attribute, self._counter)) \
+            if self.score_attribute else float(self._counter)
+        self._entries.append((score, dest, dict(metrics)))
+        self._prune()
+        return Checkpoint(dest)
+
+    def _prune(self):
+        if self.num_to_keep is None or len(self._entries) <= self.num_to_keep:
+            return
+        reverse = self.score_order == "max"
+        ranked = sorted(self._entries, key=lambda e: e[0], reverse=reverse)
+        keep = ranked[:self.num_to_keep]
+        for score, path, metrics in self._entries:
+            if (score, path, metrics) not in keep:
+                shutil.rmtree(path, ignore_errors=True)
+        self._entries = [e for e in self._entries if e in keep]
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        reverse = self.score_order == "max"
+        best = sorted(self._entries, key=lambda e: e[0], reverse=reverse)[0]
+        return Checkpoint(best[1])
+
+    @property
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        return Checkpoint(self._entries[-1][1])

@@ -1,0 +1,57 @@
+"""Train configuration objects.
+
+Reference analog: python/ray/air/config.py (ScalingConfig:102, RunConfig,
+CheckpointConfig, FailureConfig). TPU-native twist: workers are scaled by
+TPU chips/slices, and the placement strategy defaults to STRICT_PACK so a
+worker group lands on one ICI slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    # TPU topology: request whole slices ("v5e-8") instead of loose chips.
+    topology: Optional[str] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1.0
+        if "CPU" not in res and not self.use_tpu:
+            res["CPU"] = 1.0
+        return res
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        name = self.name or "train_run"
+        return os.path.join(base, name)

@@ -1,0 +1,100 @@
+"""TrainController: the v2-style training control loop.
+
+Reference analog: python/ray/train/v2/_internal/execution/controller/
+controller.py:91 — own poll loop (no Tune wrapping), failure policy decides
+group restarts, checkpoint manager tracks top-K. SURVEY §7.5 explicitly says
+to build this shape rather than the v1 Tune-wrapped design.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.core.exceptions import RayTpuError
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.result import Result
+
+logger = logging.getLogger(__name__)
+
+
+class TrainController:
+    def __init__(self, train_fn: Callable, *, train_loop_config: Optional[Dict],
+                 scaling_config: ScalingConfig, run_config: RunConfig,
+                 backend: Any = "none"):
+        self.train_fn = train_fn
+        self.train_loop_config = train_loop_config or {}
+        self.scaling = scaling_config
+        self.run_config = run_config
+        self.backend = backend
+        self.run_name = run_config.name or f"train-{uuid.uuid4().hex[:8]}"
+        self.storage_path = run_config.resolved_storage_path()
+        ckpt_cfg = run_config.checkpoint_config
+        self.ckpt_manager = CheckpointManager(
+            self.storage_path, ckpt_cfg.num_to_keep,
+            ckpt_cfg.checkpoint_score_attribute, ckpt_cfg.checkpoint_score_order)
+        self.latest_metrics: Dict = {}
+        self.metrics_history: List[Dict] = []
+
+    def run(self, poll_interval: float = 0.2) -> Result:
+        from ray_tpu.train.worker_group import WorkerGroup
+
+        failures_left = self.run_config.failure_config.max_failures
+        attempt = 0
+        while True:
+            attempt += 1
+            group = WorkerGroup(self.scaling, f"{self.run_name}-a{attempt}",
+                                self.storage_path)
+            try:
+                group.start(self.backend, group_name=f"{self.run_name}-a{attempt}")
+                latest = self.ckpt_manager.latest_checkpoint
+                group.start_training(
+                    self.train_fn, self.train_loop_config,
+                    latest.path if latest else None)
+                error = self._poll_until_done(group, poll_interval)
+            except RayTpuError as e:
+                error = repr(e)
+            finally:
+                group.shutdown()
+            if error is None:
+                return Result(metrics=self.latest_metrics,
+                              checkpoint=self.ckpt_manager.latest_checkpoint,
+                              best_checkpoints=None, path=self.storage_path,
+                              metrics_dataframe=self.metrics_history, error=None)
+            if failures_left > 0:
+                failures_left -= 1
+                logger.warning("train run %s failed (%s); restarting "
+                               "(%d retries left)", self.run_name, error,
+                               failures_left)
+                continue
+            return Result(metrics=self.latest_metrics,
+                          checkpoint=self.ckpt_manager.latest_checkpoint,
+                          best_checkpoints=None, path=self.storage_path,
+                          metrics_dataframe=self.metrics_history,
+                          error=error)
+
+    def _poll_until_done(self, group, poll_interval: float) -> Optional[str]:
+        while True:
+            polls = group.poll()
+            # Collate per-rank reports into rounds; rank-0 metrics win (the
+            # reference reports rank-0 results by default).
+            for poll in polls:
+                for item in poll["results"]:
+                    if "error" in item:
+                        return item["error"]
+                    if item["rank"] == 0:
+                        metrics = item["metrics"]
+                        self.latest_metrics = metrics
+                        self.metrics_history.append(metrics)
+                        if item.get("checkpoint_path"):
+                            self.ckpt_manager.register(item["checkpoint_path"],
+                                                       metrics)
+            errors = [p["error"] for p in polls if p["error"]]
+            if errors:
+                return errors[0]
+            if all(p["finished"] for p in polls):
+                return None
+            time.sleep(poll_interval)

@@ -1,0 +1,21 @@
+"""Training result.
+
+Reference analog: python/ray/air/result.py Result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    best_checkpoints: Optional[List]
+    path: str
+    metrics_dataframe: Optional[List[Dict]] = None
+    error: Optional[str] = None

@@ -1,0 +1,91 @@
+"""Per-worker training session: report/context.
+
+Reference analog: python/ray/train/_internal/session.py (:405 init, report
+:672, get_checkpoint :786). The session lives inside each train-worker actor;
+`report()` hands (metrics, checkpoint) back to the controller.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import tempfile
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session: Optional["TrainSession"] = None
+
+
+class TrainContext:
+    def __init__(self, session: "TrainSession"):
+        self._s = session
+
+    def get_world_size(self) -> int:
+        return self._s.world_size
+
+    def get_world_rank(self) -> int:
+        return self._s.world_rank
+
+    def get_local_rank(self) -> int:
+        return self._s.local_rank
+
+    def get_node_rank(self) -> int:
+        return self._s.node_rank
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self._s.latest_checkpoint
+
+    def get_trial_name(self) -> str:
+        return self._s.run_name
+
+    def get_storage_path(self) -> str:
+        return self._s.storage_path
+
+
+class TrainSession:
+    def __init__(self, world_rank: int, world_size: int, local_rank: int,
+                 node_rank: int, run_name: str, storage_path: str,
+                 latest_checkpoint: Optional[Checkpoint] = None):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.node_rank = node_rank
+        self.run_name = run_name
+        self.storage_path = storage_path
+        self.latest_checkpoint = latest_checkpoint
+        self.results: "queue.Queue" = queue.Queue()
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
+def init_session(**kwargs) -> TrainSession:
+    global _session
+    _session = TrainSession(**kwargs)
+    return _session
+
+
+def get_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError("Not inside a ray_tpu.train worker")
+    return _session
+
+
+def get_context() -> TrainContext:
+    return TrainContext(get_session())
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+    """Report metrics (and optionally a checkpoint dir) to the controller."""
+    s = get_session()
+    ckpt_path = None
+    if checkpoint is not None:
+        ckpt_path = checkpoint.as_directory()
+        s.latest_checkpoint = checkpoint
+    s.results.put({"metrics": dict(metrics), "checkpoint_path": ckpt_path,
+                   "rank": s.world_rank})
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_session().latest_checkpoint

@@ -1,0 +1,58 @@
+"""Public trainers.
+
+Reference analog: python/ray/train/data_parallel_trainer.py
+(DataParallelTrainer) + torch/torch_trainer.py; ours is JAX-first:
+
+    def train_fn(config):
+        ctx = ray_tpu.train.get_context()
+        ... build mesh over jax.devices(), pjit step, session.report(...)
+
+    trainer = JaxTrainer(train_fn, scaling_config=ScalingConfig(num_workers=8,
+                          use_tpu=True), run_config=RunConfig(...))
+    result = trainer.fit()
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.controller import TrainController
+from ray_tpu.train.result import Result
+
+
+class DataParallelTrainer:
+    backend: Any = "none"
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 backend: Optional[Any] = None):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        if backend is not None:
+            self.backend = backend
+
+    def fit(self) -> Result:
+        controller = TrainController(
+            self.train_loop_per_worker,
+            train_loop_config=self.train_loop_config,
+            scaling_config=self.scaling_config,
+            run_config=self.run_config,
+            backend=self.backend)
+        return controller.run()
+
+
+class JaxTrainer(DataParallelTrainer):
+    """Worker group wired through jax.distributed (ICI/DCN collectives)."""
+
+    backend = "jax"
+
+
+class CollectiveTrainer(DataParallelTrainer):
+    """Worker group with a TCP collective group (CPU DDP; tests)."""
+
+    backend = "collective"

@@ -1,0 +1,144 @@
+"""Train worker group: N actors in a placement group running the user fn.
+
+Reference analog: train/_internal/worker_group.py + v2 worker_group.py:102
+(poll_status:421). Each worker is an actor; the user train function runs on
+a thread inside it; `session.report` results are polled by the controller.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.placement_group import placement_group, remove_placement_group
+from ray_tpu.runtime.scheduling import PlacementGroupStrategy
+from ray_tpu.train import session as session_mod
+from ray_tpu.train.backend import make_backend
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import ScalingConfig
+
+
+class TrainWorker:
+    """Actor hosting one rank of the training job."""
+
+    def __init__(self, rank: int, world_size: int, run_name: str,
+                 storage_path: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.run_name = run_name
+        self.storage_path = storage_path
+        self.session: Optional[session_mod.TrainSession] = None
+        self.thread: Optional[threading.Thread] = None
+
+    def setup_backend(self, backend_name, group_name: str):
+        backend = make_backend(backend_name)
+        backend.on_start(self.rank, self.world_size, group_name)
+        self._backend = backend
+        self._group_name = group_name
+        return True
+
+    def start_training(self, train_fn_payload: bytes, config: Dict,
+                       latest_checkpoint_path: Optional[str]) -> bool:
+        import cloudpickle
+
+        train_fn = cloudpickle.loads(train_fn_payload)
+        ckpt = Checkpoint(latest_checkpoint_path) if latest_checkpoint_path else None
+        self.session = session_mod.init_session(
+            world_rank=self.rank, world_size=self.world_size,
+            local_rank=self.rank, node_rank=0, run_name=self.run_name,
+            storage_path=self.storage_path, latest_checkpoint=ckpt)
+
+        def run():
+            try:
+                if config:
+                    train_fn(config)
+                else:
+                    try:
+                        train_fn({})
+                    except TypeError:
+                        train_fn()
+            except BaseException as e:  # noqa: BLE001 - reported to controller
+                self.session.error = e
+                self.session.results.put(
+                    {"error": traceback.format_exc(), "rank": self.rank})
+            finally:
+                self.session.finished.set()
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+        return True
+
+    def poll(self, max_results: int = 16) -> Dict[str, Any]:
+        """Drain queued results; report liveness."""
+        out: List[Dict] = []
+        if self.session is not None:
+            while len(out) < max_results and not self.session.results.empty():
+                out.append(self.session.results.get_nowait())
+        finished = self.session is not None and self.session.finished.is_set()
+        error = None
+        if self.session is not None and self.session.error is not None:
+            error = repr(self.session.error)
+        return {"results": out, "finished": finished, "error": error,
+                "rank": self.rank}
+
+    def shutdown_backend(self):
+        if getattr(self, "_backend", None) is not None:
+            self._backend.on_shutdown(self.rank, self.world_size, self._group_name)
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, scaling: ScalingConfig, run_name: str, storage_path: str):
+        self.scaling = scaling
+        self.run_name = run_name
+        self.storage_path = storage_path
+        self.pg = None
+        self.workers: List = []
+
+    def start(self, backend_name, group_name: str):
+        res = self.scaling.worker_resources()
+        bundles = [dict(res) for _ in range(self.scaling.num_workers)]
+        self.pg = placement_group(bundles, strategy=self.scaling.placement_strategy,
+                                  name=f"train-{self.run_name}")
+        if not self.pg.wait(120):
+            raise RuntimeError("placement group for train workers not ready")
+        WorkerActor = ray_tpu.remote(TrainWorker)
+        self.workers = [
+            WorkerActor.options(
+                num_cpus=res.get("CPU", 0), num_tpus=res.get("TPU", 0),
+                resources={k: v for k, v in res.items()
+                           if k not in ("CPU", "TPU")},
+                scheduling_strategy=PlacementGroupStrategy(self.pg, i)).remote(
+                rank=i, world_size=self.scaling.num_workers,
+                run_name=self.run_name, storage_path=self.storage_path)
+            for i in range(self.scaling.num_workers)]
+        # Backend setup runs concurrently (collective rendezvous needs it).
+        ray_tpu.get([w.setup_backend.remote(backend_name, group_name)
+                     for w in self.workers], timeout=300)
+
+    def start_training(self, train_fn, config, latest_checkpoint_path):
+        import cloudpickle
+
+        payload = cloudpickle.dumps(train_fn)
+        ray_tpu.get([w.start_training.remote(payload, config, latest_checkpoint_path)
+                     for w in self.workers], timeout=300)
+
+    def poll(self) -> List[Dict]:
+        return ray_tpu.get([w.poll.remote() for w in self.workers], timeout=120)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        if self.pg is not None:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
+            self.pg = None

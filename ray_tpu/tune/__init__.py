@@ -1,0 +1,18 @@
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.search import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.session import (  # noqa: F401
+    get_checkpoint_dir,
+    get_trial_id,
+    report,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner  # noqa: F401

@@ -1,0 +1,184 @@
+"""TuneController: actor-based trial lifecycle.
+
+Reference analog: python/ray/tune/execution/tune_controller.py:68 (trial
+actors over the actor manager; scheduler decisions drive stop/exploit).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune import session as tune_session
+from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler
+
+logger = logging.getLogger(__name__)
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERRORED = "ERRORED"
+
+
+class TrialRunner:
+    """Actor hosting one trial's function trainable."""
+
+    def __init__(self, trial_id: str, storage_path: str):
+        self.trial_id = trial_id
+        self.storage_path = storage_path
+        self.session = None
+        self.thread = None
+
+    def start(self, fn_payload: bytes, config: Dict,
+              checkpoint_dir: Optional[str]) -> bool:
+        import cloudpickle
+
+        fn = cloudpickle.loads(fn_payload)
+        self.session = tune_session.init_session(
+            trial_id=self.trial_id, config=config,
+            storage_path=self.storage_path, checkpoint_dir=checkpoint_dir)
+
+        def run():
+            try:
+                fn(config)
+            except BaseException as e:  # noqa: BLE001
+                self.session.error = e
+                self.session.results.put(
+                    {"error": traceback.format_exc(), "trial_id": self.trial_id})
+            finally:
+                self.session.finished.set()
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+        return True
+
+    def poll(self, max_results: int = 32) -> Dict[str, Any]:
+        out = []
+        if self.session is not None:
+            while len(out) < max_results and not self.session.results.empty():
+                out.append(self.session.results.get_nowait())
+        return {"results": out,
+                "finished": self.session is not None and self.session.finished.is_set()}
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: Dict):
+        self.trial_id = trial_id
+        self.config = config
+        self.status = PENDING
+        self.actor = None
+        self.last_result: Dict = {}
+        self.history: List[Dict] = []
+        self.checkpoint_dir: Optional[str] = None
+        self.error: Optional[str] = None
+        self.restarts = 0
+
+
+class TuneController:
+    def __init__(self, trainable: Callable, variants: List[Dict], *,
+                 scheduler=None, storage_path: str, run_name: str,
+                 max_concurrent: int = 4,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        self.trainable = trainable
+        self.scheduler = scheduler or FIFOScheduler()
+        self.storage_path = os.path.join(storage_path, run_name)
+        os.makedirs(self.storage_path, exist_ok=True)
+        self.max_concurrent = max_concurrent
+        self.resources = resources_per_trial or {"CPU": 0}
+        self.trials = [Trial(f"trial_{i:04d}", cfg)
+                       for i, cfg in enumerate(variants)]
+
+    def run(self, poll_interval: float = 0.1) -> List[Trial]:
+        import cloudpickle
+
+        payload = cloudpickle.dumps(self.trainable)
+        RunnerActor = ray_tpu.remote(TrialRunner)
+
+        def start_trial(trial: Trial, checkpoint_dir=None, config=None):
+            trial.actor = RunnerActor.options(
+                num_cpus=self.resources.get("CPU", 0),
+                num_tpus=self.resources.get("TPU", 0)).remote(
+                trial.trial_id, self.storage_path)
+            cfg = config if config is not None else trial.config
+            trial.config = cfg
+            ray_tpu.get(trial.actor.start.remote(payload, cfg, checkpoint_dir),
+                        timeout=120)
+            trial.status = RUNNING
+
+        while True:
+            running = [t for t in self.trials if t.status == RUNNING]
+            pending = [t for t in self.trials if t.status == PENDING]
+            for trial in pending[:max(0, self.max_concurrent - len(running))]:
+                start_trial(trial)
+            running = [t for t in self.trials if t.status == RUNNING]
+            if not running and not pending:
+                break
+            polls = ray_tpu.get([t.actor.poll.remote() for t in running],
+                                timeout=120)
+            for trial, poll in zip(running, polls):
+                decision = CONTINUE
+                for item in poll["results"]:
+                    if "error" in item:
+                        trial.status = ERRORED
+                        trial.error = item["error"]
+                        break
+                    metrics = item["metrics"]
+                    trial.last_result = metrics
+                    trial.history.append(metrics)
+                    if item.get("checkpoint_dir"):
+                        trial.checkpoint_dir = self._persist_checkpoint(
+                            trial, item["checkpoint_dir"])
+                    decision = self.scheduler.on_result(trial.trial_id, metrics)
+                    if decision != CONTINUE:
+                        break
+                if trial.status == ERRORED:
+                    self._kill(trial)
+                elif decision == STOP:
+                    trial.status = TERMINATED
+                    self._kill(trial)
+                elif decision == EXPLOIT:
+                    self._exploit(trial, start_trial)
+                elif poll["finished"]:
+                    trial.status = TERMINATED
+                    self._kill(trial)
+            time.sleep(poll_interval)
+        return self.trials
+
+    def _persist_checkpoint(self, trial: Trial, src_dir: str) -> str:
+        dest = os.path.join(self.storage_path, trial.trial_id,
+                            f"checkpoint_{len(trial.history):06d}")
+        if os.path.abspath(src_dir) != dest and os.path.exists(src_dir):
+            shutil.copytree(src_dir, dest, dirs_exist_ok=True)
+        return dest
+
+    def _exploit(self, trial: Trial, start_trial):
+        """PBT exploit/explore: restart from a better trial's checkpoint with
+        mutated config."""
+        target_id = self.scheduler.exploit_target(trial.trial_id)
+        target = next((t for t in self.trials if t.trial_id == target_id), None)
+        if target is None or target.checkpoint_dir is None:
+            return  # nothing to exploit yet
+        new_config = self.scheduler.explore(dict(target.config)) \
+            if hasattr(self.scheduler, "explore") else dict(target.config)
+        logger.info("PBT: %s exploits %s (new config %s)", trial.trial_id,
+                    target.trial_id, new_config)
+        self._kill(trial)
+        trial.restarts += 1
+        start_trial(trial, checkpoint_dir=target.checkpoint_dir,
+                    config=new_config)
+
+    @staticmethod
+    def _kill(trial: Trial):
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None

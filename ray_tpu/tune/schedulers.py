@@ -1,0 +1,136 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Reference analog: python/ray/tune/schedulers/ (async_hyperband.py
+ASHAScheduler, pbt.py:221 PopulationBasedTraining).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+EXPLOIT = "EXPLOIT"   # PBT: replace weights+config from a better trial
+
+
+class TrialScheduler:
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+    def exploit_target(self, trial_id: str):
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving: at each rung, trials outside the top
+    1/reduction_factor of completed rung results are stopped."""
+
+    def __init__(self, metric: str, mode: str = "max", *,
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        # rung milestone -> list of recorded metric values
+        self.rungs: Dict[int, List[float]] = {}
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(t)
+            t *= reduction_factor
+        self.milestones = milestones
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for milestone in self.milestones:
+            if t == milestone:
+                recorded = self.rungs.setdefault(milestone, [])
+                recorded.append(float(value))
+                if len(recorded) >= self.rf:
+                    ranked = sorted(recorded, reverse=(self.mode == "max"))
+                    cutoff = ranked[max(0, len(ranked) // self.rf - 1)]
+                    bad = value < cutoff if self.mode == "max" else value > cutoff
+                    if bad:
+                        return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: every `perturbation_interval` iterations, bottom-quantile trials
+    exploit (copy checkpoint+config of) a top-quantile trial and explore
+    (mutate hyperparameters)."""
+
+    def __init__(self, metric: str, mode: str = "max", *,
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25, seed: int = 0,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.time_attr = time_attr
+        self.rng = random.Random(seed)
+        self.latest: Dict[str, Dict] = {}  # trial_id -> last result
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        self.latest[trial_id] = result
+        t = result.get(self.time_attr, 0)
+        if t == 0 or t % self.interval != 0 or len(self.latest) < 2:
+            return CONTINUE
+        scores = {tid: r.get(self.metric) for tid, r in self.latest.items()
+                  if r.get(self.metric) is not None}
+        if trial_id not in scores or len(scores) < 2:
+            return CONTINUE
+        ranked = sorted(scores, key=lambda tid: scores[tid],
+                        reverse=(self.mode == "max"))
+        k = max(1, int(len(ranked) * self.quantile))
+        if trial_id in ranked[-k:] and trial_id not in ranked[:k]:
+            return EXPLOIT
+        return CONTINUE
+
+    def exploit_target(self, trial_id: str) -> Optional[str]:
+        scores = {tid: r.get(self.metric) for tid, r in self.latest.items()
+                  if r.get(self.metric) is not None and tid != trial_id}
+        if not scores:
+            return None
+        ranked = sorted(scores, key=lambda tid: scores[tid],
+                        reverse=(self.mode == "max"))
+        k = max(1, int(len(ranked) * self.quantile))
+        return self.rng.choice(ranked[:k])
+
+    def explore(self, config: Dict) -> Dict:
+        """Mutate hyperparameters (x0.8 / x1.25 or resample)."""
+        from ray_tpu.tune.search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if key not in out:
+                continue
+            if isinstance(spec, Domain):
+                out[key] = spec.sample(self.rng)
+            elif isinstance(spec, list):
+                out[key] = self.rng.choice(spec)
+            elif callable(spec):
+                out[key] = spec()
+            elif isinstance(out[key], (int, float)):
+                factor = self.rng.choice([0.8, 1.25])
+                out[key] = type(out[key])(out[key] * factor)
+        return out

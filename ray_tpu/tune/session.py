@@ -1,0 +1,51 @@
+"""Per-trial session for function trainables (tune.report)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+_session: Optional["TuneSession"] = None
+
+
+class TuneSession:
+    def __init__(self, trial_id: str, config: Dict, storage_path: str,
+                 checkpoint_dir: Optional[str]):
+        self.trial_id = trial_id
+        self.config = config
+        self.storage_path = storage_path
+        self.checkpoint_dir = checkpoint_dir
+        self.results: "queue.Queue" = queue.Queue()
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.iteration = 0
+
+
+def init_session(**kwargs) -> TuneSession:
+    global _session
+    _session = TuneSession(**kwargs)
+    return _session
+
+
+def get_session() -> TuneSession:
+    if _session is None:
+        raise RuntimeError("not inside a tune trial")
+    return _session
+
+
+def report(metrics: Dict[str, Any], checkpoint_dir: Optional[str] = None):
+    s = get_session()
+    s.iteration += 1
+    m = dict(metrics)
+    m.setdefault("training_iteration", s.iteration)
+    s.results.put({"metrics": m, "checkpoint_dir": checkpoint_dir,
+                   "trial_id": s.trial_id})
+
+
+def get_checkpoint_dir() -> Optional[str]:
+    return get_session().checkpoint_dir
+
+
+def get_trial_id() -> str:
+    return get_session().trial_id

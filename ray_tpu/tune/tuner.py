@@ -1,0 +1,97 @@
+"""Tuner: the public tuning API.
+
+Reference analog: python/ray/tune/tuner.py:312 Tuner.fit -> ResultGrid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune.controller import ERRORED, TERMINATED, Trial, TuneController
+from ray_tpu.tune.search import generate_variants
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None
+    seed: int = 0
+
+
+class TrialResult:
+    def __init__(self, trial: Trial):
+        self.trial_id = trial.trial_id
+        self.config = trial.config
+        self.metrics = trial.last_result
+        self.metrics_history = trial.history
+        self.checkpoint_dir = trial.checkpoint_dir
+        self.error = trial.error
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str], mode: str):
+        self._results = [TrialResult(t) for t in trials]
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> TrialResult:
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        assert metric, "metric required to rank results"
+        scored = [r for r in self._results if metric in (r.metrics or {})]
+        if not scored:
+            raise ValueError("no trial reported the metric " + metric)
+        return sorted(scored, key=lambda r: r.metrics[metric],
+                      reverse=(mode == "max"))[0]
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([
+            {"trial_id": r.trial_id, **(r.metrics or {}),
+             **{f"config/{k}": v for k, v in r.config.items()}}
+            for r in self._results])
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *, param_space: Optional[Dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources_per_trial = resources_per_trial
+
+    def fit(self) -> ResultGrid:
+        variants = generate_variants(self.param_space,
+                                     self.tune_config.num_samples,
+                                     self.tune_config.seed)
+        run_name = self.run_config.name or f"tune-{uuid.uuid4().hex[:8]}"
+        controller = TuneController(
+            self.trainable, variants,
+            scheduler=self.tune_config.scheduler,
+            storage_path=self.run_config.storage_path or "/tmp/ray_tpu_results",
+            run_name=run_name,
+            max_concurrent=self.tune_config.max_concurrent_trials,
+            resources_per_trial=self.resources_per_trial)
+        trials = controller.run()
+        return ResultGrid(trials, self.tune_config.metric, self.tune_config.mode)

@@ -1,0 +1,156 @@
+"""Train stack tests: DDP on CPU workers (the reference PR1 config shape:
+ResNet/CIFAR DDP, CPU-runnable — BASELINE.md), checkpointing, failure
+restart. Reference test model: python/ray/train/tests with 2-worker groups."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint, CheckpointConfig, CollectiveTrainer, DataParallelTrainer,
+    FailureConfig, RunConfig, ScalingConfig)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _resnet_ddp_train_fn(config):
+    """ResNet-18 on synthetic CIFAR shards with collective DDP grad sync."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu import train as rtrain
+    from ray_tpu.models import resnet
+
+    ctx = rtrain.get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+
+    model_cfg = resnet.ResNetConfig(depth="resnet18", num_classes=10, width=16)
+    params, state = resnet.init(model_cfg, jax.random.key(0))  # same seed = same init
+    opt = optax.sgd(0.05, momentum=0.9)
+    opt_state = opt.init(params)
+
+    # Per-rank data shard (deterministic synthetic CIFAR).
+    key = jax.random.key(100 + rank)
+    images = jax.random.normal(key, (32, 32, 32, 3))
+    labels = jax.random.randint(jax.random.key(200 + rank), (32,), 0, 10)
+
+    grad_fn = jax.jit(jax.value_and_grad(
+        lambda p, s, b: resnet.loss_fn(p, s, b, model_cfg), has_aux=True))
+
+    for step in range(config.get("steps", 3)):
+        batch = {"image": images, "label": labels}
+        (loss, aux), grads = grad_fn(params, state, batch)
+        state = aux["state"]
+        grads = rtrain.allreduce_gradients(grads)  # DDP sync point
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        metrics = {"loss": float(loss), "accuracy": float(aux["accuracy"]),
+                   "step": step}
+        if rank == 0 and step == config.get("steps", 3) - 1:
+            # Checkpoint dirs must outlive report() (async upload): save under
+            # the run's storage path, not a temp dir.
+            d = os.path.join(ctx.get_storage_path(), f"worker_ckpt_{step}")
+            Checkpoint.save_pytree({"params": params}, d)
+            rtrain.report(metrics, checkpoint=Checkpoint(d))
+        else:
+            rtrain.report(metrics)
+
+
+def test_resnet_ddp_two_workers(cluster, tmp_path):
+    trainer = CollectiveTrainer(
+        _resnet_ddp_train_fn,
+        train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ddp-test", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 2
+    assert result.checkpoint is not None
+    restored = result.checkpoint.load_pytree()
+    assert "params" in restored
+    # All reports from rank 0 were collected.
+    assert len(result.metrics_dataframe) == 3
+
+
+def _grad_sync_check_fn(config):
+    import numpy as np
+
+    from ray_tpu import train as rtrain
+
+    ctx = rtrain.get_context()
+    rank = ctx.get_world_rank()
+    grads = {"w": np.full(4, float(rank + 1))}
+    synced = rtrain.allreduce_gradients(grads)
+    # mean of 1.0 and 2.0 = 1.5 on both ranks
+    rtrain.report({"synced0": float(synced["w"][0]), "rank": rank})
+
+
+def test_gradient_sync_is_mean(cluster, tmp_path):
+    trainer = CollectiveTrainer(
+        _grad_sync_check_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="sync-test", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["synced0"] == 1.5
+
+
+def _failing_once_fn(config):
+    from ray_tpu import train as rtrain
+
+    marker = config["marker"]
+    if not os.path.exists(marker):
+        open(marker, "w").close()
+        raise RuntimeError("transient-failure")
+    rtrain.report({"ok": 1})
+
+
+def test_failure_policy_restarts(cluster, tmp_path):
+    marker = str(tmp_path / "fail_marker")
+    trainer = DataParallelTrainer(
+        _failing_once_fn,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="fail-test", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["ok"] == 1
+
+
+def test_error_surfaces_without_retries(cluster, tmp_path):
+    def bad_fn(config):
+        raise ValueError("unrecoverable-boom")
+
+    trainer = DataParallelTrainer(
+        bad_fn, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="err-test", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is not None and "unrecoverable-boom" in result.error
+
+
+def test_checkpoint_manager_topk(tmp_path):
+    from ray_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "run"), num_to_keep=2,
+                            score_attribute="score", score_order="max")
+    for i, score in enumerate([0.1, 0.9, 0.5]):
+        src = tmp_path / f"src{i}"
+        src.mkdir()
+        (src / "data.txt").write_text(str(score))
+        mgr.register(str(src), {"score": score})
+    assert mgr.best_checkpoint is not None
+    with open(os.path.join(mgr.best_checkpoint.path, "data.txt")) as f:
+        assert f.read() == "0.9"
+    # Only top-2 kept on disk.
+    kept = [d for d in os.listdir(tmp_path / "run") if d.startswith("checkpoint")]
+    assert len(kept) == 2

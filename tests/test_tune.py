@@ -1,0 +1,121 @@
+"""Tune tests: grid/random search, ASHA early stopping, PBT exploit.
+
+Reference test model: python/ray/tune/tests."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune import ASHAScheduler, PopulationBasedTraining, TuneConfig, Tuner
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _quadratic(config):
+    # Best at x=3.
+    score = -((config["x"] - 3.0) ** 2)
+    tune.report({"score": score, "x": config["x"]})
+
+
+def test_grid_search(cluster, tmp_path):
+    tuner = Tuner(
+        _quadratic,
+        param_space={"x": tune.grid_search([0.0, 1.0, 3.0, 5.0])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 4
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.config["x"] == 3.0
+
+
+def test_random_sampling(cluster, tmp_path):
+    tuner = Tuner(
+        _quadratic,
+        param_space={"x": tune.uniform(0, 6)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=6),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 6
+    assert all(0 <= r.config["x"] <= 6 for r in grid._results)
+
+
+def _iterative(config):
+    # Converges toward config["lr"]-dependent plateau over 8 iters. Slow
+    # enough that rung populations form across trials (ASHA is asynchronous:
+    # a trial reaching an empty rung passes it by design).
+    value = 0.0
+    for i in range(8):
+        value += config["lr"]
+        tune.report({"value": value})
+        time.sleep(0.3)
+
+
+def test_asha_stops_bad_trials(cluster, tmp_path):
+    scheduler = ASHAScheduler(metric="value", mode="max", max_t=8,
+                              grace_period=2, reduction_factor=2)
+    tuner = Tuner(
+        _iterative,
+        param_space={"lr": tune.grid_search([2.0, 1.0, 0.2, 0.1])},
+        tune_config=TuneConfig(metric="value", mode="max", scheduler=scheduler),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.config["lr"] == 2.0
+    # Weak trials hit populated rungs and get stopped before iteration 8.
+    iters = [len(r.metrics_history) for r in grid._results]
+    assert min(iters) < 8
+
+
+def _pbt_trainable(config):
+    # Trials carry a "weight" through checkpoints; good lr grows it faster.
+    weight = 0.0
+    ckpt_dir = tune.get_checkpoint_dir()
+    if ckpt_dir:
+        with open(os.path.join(ckpt_dir, "weight.txt")) as f:
+            weight = float(f.read())
+    session = tune.session.get_session()
+    for i in range(12):
+        weight += config["lr"]
+        d = os.path.join(session.storage_path, f"{tune.get_trial_id()}_tmp")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "weight.txt"), "w") as f:
+            f.write(str(weight))
+        tune.report({"weight": weight, "lr": config["lr"]}, checkpoint_dir=d)
+        time.sleep(0.02)
+
+
+def test_pbt_exploits(cluster, tmp_path):
+    scheduler = PopulationBasedTraining(
+        metric="weight", mode="max", perturbation_interval=4,
+        hyperparam_mutations={"lr": [0.1, 1.0]})
+    tuner = Tuner(
+        _pbt_trainable,
+        param_space={"lr": tune.grid_search([0.1, 1.0])},
+        tune_config=TuneConfig(metric="weight", mode="max", scheduler=scheduler),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["weight"] > 4.0  # exploited trials catch up
+
+
+def test_trial_error_reported(cluster, tmp_path):
+    def bad(config):
+        raise RuntimeError("trial-blew-up")
+
+    tuner = Tuner(bad, param_space={"x": tune.grid_search([1])},
+                  tune_config=TuneConfig(metric="score", mode="max"),
+                  run_config=RunConfig(storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert grid.errors and "trial-blew-up" in grid.errors[0]
