@@ -1,0 +1,8 @@
+from ray_tpu.llm.engine import LLMEngine, RequestOutput  # noqa: F401
+from ray_tpu.llm.sampling import SamplingParams  # noqa: F401
+from ray_tpu.llm.serving import (  # noqa: F401
+    LLMConfig,
+    LLMServer,
+    build_llm_deployment,
+    build_openai_app,
+)

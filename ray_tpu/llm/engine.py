@@ -1,0 +1,222 @@
+"""LLM engine: continuous batching over the paged-KV model runner.
+
+Reference analog: the vLLM engine the reference wraps (SURVEY §3.5 hot loop:
+"engine continuous-batching step loop (vLLM-internal in reference; Pallas
+paged-attention engine in the TPU build)"). Components:
+
+  * BlockManager — host-side page allocator for the KV pool (free list,
+    per-sequence block tables, OOM preemption by recompute).
+  * Scheduler — admission: waiting requests join the running batch when KV
+    pages are available; prefill happens on admission, decode runs batched
+    every step.
+  * LLMEngine — add_request / step / generate; step() = (maybe prefills) +
+    one batched decode + sampling + finish detection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.llm.sampling import SamplingParams, sample
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: str
+    prompt_token_ids: List[int]
+    output_token_ids: List[int]
+    finished: bool
+    finish_reason: Optional[str] = None
+    text: Optional[str] = None
+
+
+class _Request:
+    def __init__(self, request_id: str, prompt: List[int],
+                 params: SamplingParams):
+        self.id = request_id
+        self.prompt = list(prompt)
+        self.params = params
+        self.output: List[int] = []
+        self.blocks: List[int] = []
+        self.finished_reason: Optional[str] = None
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt) + len(self.output)
+
+
+class BlockManager:
+    def __init__(self, num_blocks: int, block_size: int):
+        self.block_size = block_size
+        self.free: deque = deque(range(num_blocks))
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return (num_tokens + self.block_size - 1) // self.block_size
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return len(self.free) >= self.blocks_needed(num_tokens)
+
+    def allocate(self, req: _Request, num_tokens: int) -> bool:
+        need = self.blocks_needed(num_tokens) - len(req.blocks)
+        if need > len(self.free):
+            return False
+        for _ in range(max(0, need)):
+            req.blocks.append(self.free.popleft())
+        return True
+
+    def release(self, req: _Request):
+        self.free.extend(req.blocks)
+        req.blocks = []
+
+
+class LLMEngine:
+    def __init__(self, model_runner, *, max_batch_size: int = 8,
+                 max_blocks_per_seq: Optional[int] = None,
+                 tokenizer=None):
+        self.runner = model_runner
+        self.block_size = model_runner.block_size
+        self.block_manager = BlockManager(model_runner.num_blocks,
+                                          model_runner.block_size)
+        self.max_batch = max_batch_size
+        self.max_blocks_per_seq = max_blocks_per_seq or (
+            model_runner.config.max_seq // model_runner.block_size)
+        self.tokenizer = tokenizer
+        self.waiting: deque = deque()
+        self.running: List[_Request] = []
+        self.finished_outputs: List[RequestOutput] = []
+
+    # ---- API -------------------------------------------------------------
+
+    def add_request(self, prompt_token_ids: Sequence[int],
+                    params: Optional[SamplingParams] = None,
+                    request_id: Optional[str] = None) -> str:
+        rid = request_id or uuid.uuid4().hex[:12]
+        self.waiting.append(_Request(rid, list(prompt_token_ids),
+                                     params or SamplingParams()))
+        return rid
+
+    def has_unfinished(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def step(self) -> List[RequestOutput]:
+        """One engine iteration: admit+prefill, batched decode, sample."""
+        self._admit()
+        outputs: List[RequestOutput] = []
+        if self.finished_outputs:
+            # Requests that finished during admission (stop token / length on
+            # the very first sampled token).
+            outputs.extend(self.finished_outputs)
+            self.finished_outputs.clear()
+        if not self.running:
+            return outputs
+        logits = self._decode_batch()
+        finished: List[_Request] = []
+        for i, req in enumerate(self.running):
+            token = sample(logits[i], req.params,
+                           np.asarray(req.prompt + req.output))
+            req.output.append(int(token))
+            if self._is_finished(req):
+                finished.append(req)
+                outputs.append(RequestOutput(
+                    req.id, req.prompt, req.output, True, req.finished_reason,
+                    self._detok(req.output)))
+        for req in finished:
+            self.running.remove(req)
+            self.block_manager.release(req)
+        return outputs
+
+    def generate(self, prompts: List[Sequence[int]],
+                 params: Optional[SamplingParams] = None,
+                 ) -> List[RequestOutput]:
+        ids = [self.add_request(p, params) for p in prompts]
+        collected: Dict[str, RequestOutput] = {}
+        while self.has_unfinished():
+            for out in self.step():
+                collected[out.request_id] = out
+        return [collected[i] for i in ids]
+
+    # ---- internals -------------------------------------------------------
+
+    def _admit(self):
+        """Move waiting requests into the running batch while KV pages and
+        batch slots allow; prefill each admitted prompt."""
+        import jax.numpy as jnp
+
+        while self.waiting and len(self.running) < self.max_batch:
+            req = self.waiting[0]
+            # Reserve room for the prompt plus at least one generated token.
+            if not self.block_manager.can_allocate(req.num_tokens + 1):
+                break
+            self.waiting.popleft()
+            assert self.block_manager.allocate(req, req.num_tokens + 1)
+            table = self._block_table(req)
+            logits = self.runner.prefill(
+                jnp.asarray([req.prompt], dtype=jnp.int32), table)
+            token = sample(np.asarray(logits[0]), req.params,
+                           np.asarray(req.prompt))
+            req.output.append(int(token))
+            if self._is_finished(req):
+                self.block_manager.release(req)
+                self.finished_outputs.append(RequestOutput(
+                    req.id, req.prompt, req.output, True, req.finished_reason,
+                    self._detok(req.output)))
+            else:
+                self.running.append(req)
+
+    def _decode_batch(self) -> np.ndarray:
+        import jax.numpy as jnp
+
+        # Ensure every request has a page for its next token.
+        for req in self.running:
+            if not self.block_manager.allocate(req, req.num_tokens + 1):
+                # Preempt the newest request (recompute later) to free pages.
+                victim = self.running[-1]
+                self.block_manager.release(victim)
+                victim.output = []
+                self.running.remove(victim)
+                self.waiting.appendleft(victim)
+                if req is victim:
+                    continue
+                assert self.block_manager.allocate(req, req.num_tokens + 1)
+        b = len(self.running)
+        tokens = jnp.asarray([r.output[-1] for r in self.running], dtype=jnp.int32)
+        positions = jnp.asarray([r.num_tokens - 1 for r in self.running],
+                                dtype=jnp.int32)
+        seq_lens = jnp.asarray([r.num_tokens for r in self.running],
+                               dtype=jnp.int32)
+        tables = jnp.concatenate([self._block_table(r)[None] for r in self.running])
+        logits = self.runner.decode(tokens, tables, positions, seq_lens)
+        return np.asarray(logits)
+
+    def _block_table(self, req: _Request):
+        import jax.numpy as jnp
+
+        table = np.zeros(self.max_blocks_per_seq, dtype=np.int32)
+        table[:len(req.blocks)] = req.blocks
+        return jnp.asarray(table)
+
+    def _is_finished(self, req: _Request) -> bool:
+        p = req.params
+        if p.stop_token_ids and req.output[-1] in p.stop_token_ids:
+            req.finished_reason = "stop"
+            return True
+        if len(req.output) >= p.max_tokens:
+            req.finished_reason = "length"
+            return True
+        if req.num_tokens >= self.runner.config.max_seq:
+            req.finished_reason = "length"
+            return True
+        return False
+
+    def _detok(self, token_ids: List[int]) -> Optional[str]:
+        if self.tokenizer is None:
+            return None
+        try:
+            return self.tokenizer.decode(token_ids)
+        except Exception:
+            return None

@@ -1,0 +1,104 @@
+"""LLM serving deployment: OpenAI-style completions over the native engine.
+
+Reference analog: python/ray/llm/_internal/serve/ (VLLMEngine wrapper
+vllm_engine.py:222, vllm_deployment.py, the OpenAI router deployments/
+routers/, build_openai_app). Ours wraps the native paged-attention engine
+(ray_tpu.llm.engine) in a serve deployment; TP placement maps to num_tpus on
+the replica (the reference plans TP x PP placement groups around vLLM,
+vllm_models.py:117-168 — here the engine's mesh lives inside the replica).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import serve
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    model_config: Any = None            # llama.LlamaConfig
+    params_checkpoint: Optional[str] = None  # dir with saved params pytree
+    seed: int = 0
+    num_kv_blocks: int = 256
+    block_size: int = 16
+    max_batch_size: int = 8
+    num_replicas: int = 1
+    num_tpus_per_replica: float = 0.0
+    tokenizer: Any = None
+
+
+class LLMServer:
+    """The replica callable: owns one engine instance."""
+
+    def __init__(self, llm_config: LLMConfig):
+        import jax
+
+        from ray_tpu.llm.engine import LLMEngine
+        from ray_tpu.llm.model_runner import ModelRunner
+        from ray_tpu.models import llama
+
+        config = llm_config.model_config or llama.LlamaConfig.tiny()
+        if llm_config.params_checkpoint:
+            from ray_tpu.train.checkpoint import Checkpoint
+
+            params = Checkpoint(llm_config.params_checkpoint).load_pytree()
+        else:
+            params = llama.init_params(config, jax.random.key(llm_config.seed))
+        runner = ModelRunner(config, params,
+                             num_blocks=llm_config.num_kv_blocks,
+                             block_size=llm_config.block_size)
+        self.engine = LLMEngine(runner,
+                                max_batch_size=llm_config.max_batch_size,
+                                tokenizer=llm_config.tokenizer)
+        self.tokenizer = llm_config.tokenizer
+
+    def __call__(self, request: Dict) -> Dict:
+        return self.completions(request)
+
+    def completions(self, request: Dict) -> Dict:
+        """OpenAI-ish /v1/completions: {"prompt": str|[int], "max_tokens",
+        "temperature", "top_k", "top_p", "stop_token_ids"}."""
+        from ray_tpu.llm.sampling import SamplingParams
+
+        prompt = request.get("prompt", [])
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError("string prompts require a tokenizer")
+            prompt = self.tokenizer.encode(prompt)
+        params = SamplingParams(
+            temperature=float(request.get("temperature", 0.0)),
+            top_k=int(request.get("top_k", 0)),
+            top_p=float(request.get("top_p", 1.0)),
+            max_tokens=int(request.get("max_tokens", 32)),
+            stop_token_ids=request.get("stop_token_ids"),
+            seed=request.get("seed"))
+        out = self.engine.generate([prompt], params)[0]
+        return {
+            "id": out.request_id,
+            "object": "text_completion",
+            "choices": [{
+                "text": out.text,
+                "token_ids": out.output_token_ids,
+                "finish_reason": out.finish_reason,
+            }],
+            "usage": {
+                "prompt_tokens": len(out.prompt_token_ids),
+                "completion_tokens": len(out.output_token_ids),
+            },
+        }
+
+
+def build_llm_deployment(llm_config: LLMConfig, name: str = "llm") -> Any:
+    dep = serve.deployment(LLMServer).options(
+        name=name, num_replicas=llm_config.num_replicas,
+        num_tpus=llm_config.num_tpus_per_replica)
+    return dep.bind(llm_config)
+
+
+def build_openai_app(llm_config: LLMConfig, name: str = "v1-completions"):
+    """Deploys the engine and the HTTP ingress; POST /{name} serves
+    completions."""
+    handle = serve.run(build_llm_deployment(llm_config, name), http=True)
+    return handle

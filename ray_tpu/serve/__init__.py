@@ -1,0 +1,10 @@
+from ray_tpu.serve.api import (  # noqa: F401
+    delete,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start_http_proxy,
+    status,
+)
+from ray_tpu.serve.deployment import Deployment, deployment  # noqa: F401
+from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401

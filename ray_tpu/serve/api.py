@@ -1,0 +1,95 @@
+"""Serve public API.
+
+Reference analog: python/ray/serve/api.py (serve.run:591, get_deployment_handle,
+delete, status).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.deployment import Deployment
+from ray_tpu.serve.handle import DeploymentHandle
+
+_controller = None
+_proxy = None
+
+
+def _get_controller():
+    global _controller
+    if _controller is None:
+        try:
+            _controller = ray_tpu.get_actor(ServeController.CONTROLLER_NAME)
+        except ValueError:
+            Controller = ray_tpu.remote(ServeController)
+            _controller = Controller.options(
+                name=ServeController.CONTROLLER_NAME).remote()
+    return _controller
+
+
+def run(target: Union[Deployment, List[Deployment]], *,
+        http: bool = False, http_port: int = 0) -> DeploymentHandle:
+    """Deploy one or more deployments; returns a handle to the first."""
+    import cloudpickle
+
+    controller = _get_controller()
+    deployments = [target] if isinstance(target, Deployment) else list(target)
+    for dep in deployments:
+        cfg = {
+            "num_replicas": dep.config.num_replicas,
+            "max_ongoing_requests": dep.config.max_ongoing_requests,
+            "num_cpus": dep.config.num_cpus,
+            "num_tpus": dep.config.num_tpus,
+            "resources": dep.config.resources,
+        }
+        ray_tpu.get(controller.deploy.remote(
+            dep.name, cloudpickle.dumps(dep.func_or_class), cfg,
+            cloudpickle.dumps((dep.init_args, dep.init_kwargs))), timeout=600)
+    if http:
+        start_http_proxy(port=http_port)
+    return DeploymentHandle(deployments[0].name)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def status() -> List[dict]:
+    controller = _get_controller()
+    return ray_tpu.get(controller.list_deployments.remote(), timeout=60)
+
+
+def delete(name: str) -> bool:
+    controller = _get_controller()
+    return ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
+def start_http_proxy(port: int = 0):
+    """Start (or return) the HTTP ingress; returns (host, port)."""
+    global _proxy
+    if _proxy is None:
+        from ray_tpu.serve.proxy import HTTPProxyActor
+
+        Proxy = ray_tpu.remote(HTTPProxyActor)
+        _proxy = Proxy.options(name="SERVE_HTTP_PROXY").remote(port=port)
+    return tuple(ray_tpu.get(_proxy.address.remote(), timeout=60))
+
+
+def shutdown():
+    global _controller, _proxy
+    for name in [d["name"] for d in status()]:
+        delete(name)
+    if _proxy is not None:
+        try:
+            ray_tpu.kill(_proxy)
+        except Exception:
+            pass
+    if _controller is not None:
+        try:
+            ray_tpu.kill(_controller)
+        except Exception:
+            pass
+    _controller = None
+    _proxy = None

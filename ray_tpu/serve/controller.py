@@ -1,0 +1,79 @@
+"""ServeController: deployment/replica lifecycle.
+
+Reference analog: python/ray/serve/_private/controller.py:84 ServeController
++ deployment_state.py:1248 (replica state machine) + long_poll.py:204 config
+propagation. Ours: a named actor owning the replica actors per deployment;
+handles pull the replica list with a version number and refresh on change
+(the long-poll pattern collapsed to versioned polling).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class ServeController:
+    CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+    def __init__(self):
+        # deployment name -> {"replicas": [handles], "config", "version"}
+        self.deployments: Dict[str, Dict] = {}
+        self.version = 0
+
+    def deploy(self, name: str, target_payload: bytes, config: dict,
+               init_args_payload: bytes) -> bool:
+        import cloudpickle
+
+        from ray_tpu.serve.deployment import ReplicaActor
+
+        init_args, init_kwargs = cloudpickle.loads(init_args_payload)
+        existing = self.deployments.get(name)
+        if existing is not None:
+            for r in existing["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        Replica = ray_tpu.remote(ReplicaActor)
+        replicas = []
+        for i in range(config["num_replicas"]):
+            replicas.append(Replica.options(
+                num_cpus=config.get("num_cpus", 0),
+                num_tpus=config.get("num_tpus", 0),
+                resources=config.get("resources") or {}).remote(
+                target_payload, init_args, init_kwargs))
+        # Wait until replicas construct successfully.
+        ray_tpu.get([r.health_check.remote() for r in replicas], timeout=300)
+        self.version += 1
+        self.deployments[name] = {"replicas": replicas, "config": config,
+                                  "version": self.version}
+        return True
+
+    def get_replicas(self, name: str) -> dict:
+        d = self.deployments.get(name)
+        if d is None:
+            return {"found": False, "version": self.version}
+        return {"found": True, "replicas": d["replicas"],
+                "version": d["version"]}
+
+    def list_deployments(self) -> List[dict]:
+        return [{"name": k, "num_replicas": len(v["replicas"]),
+                 "config": v["config"]} for k, v in self.deployments.items()]
+
+    def delete_deployment(self, name: str) -> bool:
+        d = self.deployments.pop(name, None)
+        if d is None:
+            return False
+        for r in d["replicas"]:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.version += 1
+        return True
+
+    def global_version(self) -> int:
+        return self.version

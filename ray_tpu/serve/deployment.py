@@ -1,0 +1,106 @@
+"""Deployment definition + replica actor.
+
+Reference analog: python/ray/serve/deployment.py (@serve.deployment) and
+replica.py (user-code runner). A deployment wraps a class (or function);
+replicas are actors created by the controller; requests arrive as ordinary
+actor calls (`handle_request`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    num_cpus: float = 0.0
+    num_tpus: float = 0.0
+    resources: Optional[Dict[str, float]] = None
+
+
+class Deployment:
+    def __init__(self, func_or_class: Any, name: str, config: DeploymentConfig,
+                 init_args: tuple = (), init_kwargs: Optional[dict] = None):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.config = config
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs or {}
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                num_cpus: Optional[float] = None,
+                num_tpus: Optional[float] = None,
+                resources: Optional[Dict[str, float]] = None) -> "Deployment":
+        cfg = dataclasses.replace(
+            self.config,
+            num_replicas=num_replicas if num_replicas is not None
+            else self.config.num_replicas,
+            max_ongoing_requests=max_ongoing_requests if max_ongoing_requests
+            is not None else self.config.max_ongoing_requests,
+            num_cpus=num_cpus if num_cpus is not None else self.config.num_cpus,
+            num_tpus=num_tpus if num_tpus is not None else self.config.num_tpus,
+            resources=resources if resources is not None else self.config.resources)
+        return Deployment(self.func_or_class, name or self.name, cfg,
+                          self.init_args, self.init_kwargs)
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        """Bind init args (the graph-building API)."""
+        return Deployment(self.func_or_class, self.name, self.config, args, kwargs)
+
+
+def deployment(func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 16,
+               num_cpus: float = 0.0, num_tpus: float = 0.0,
+               resources: Optional[Dict[str, float]] = None):
+    def wrap(target):
+        return Deployment(
+            target, name or target.__name__,
+            DeploymentConfig(num_replicas, max_ongoing_requests, num_cpus,
+                             num_tpus, resources))
+
+    if func_or_class is not None:
+        return wrap(func_or_class)
+    return wrap
+
+
+class ReplicaActor:
+    """Hosts the user callable. One per replica."""
+
+    def __init__(self, target_payload: bytes, init_args, init_kwargs):
+        import cloudpickle
+
+        target = cloudpickle.loads(target_payload)
+        if isinstance(target, type):
+            self.callable = target(*init_args, **init_kwargs)
+        else:
+            self.callable = target
+        self._ongoing = 0
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict):
+        self._ongoing += 1
+        try:
+            fn = self.callable if method == "__call__" and not isinstance(
+                self.callable, object.__class__) else None
+            if method == "__call__":
+                fn = self.callable if callable(self.callable) else None
+                if fn is None:
+                    raise AttributeError("deployment target is not callable")
+            else:
+                fn = getattr(self.callable, method)
+            return fn(*args, **kwargs)
+        finally:
+            self._ongoing -= 1
+
+    def queue_len(self) -> int:
+        return self._ongoing
+
+    def health_check(self) -> bool:
+        check = getattr(self.callable, "check_health", None)
+        if check is not None:
+            check()
+        return True

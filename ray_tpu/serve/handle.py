@@ -1,0 +1,86 @@
+"""DeploymentHandle: request routing to replicas.
+
+Reference analog: python/ray/serve/handle.py:625 DeploymentHandle +
+router.py:578/pow_2_scheduler.py:52 (power-of-two-choices on queue length).
+Client-side: the handle tracks its own in-flight count per replica and picks
+the lighter of two random replicas — the same load-balancing rule without a
+probe round-trip.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the underlying ObjectRef."""
+
+    def __init__(self, ref, on_done):
+        self._ref = ref
+        self._on_done = on_done
+        self._done = False
+
+    def result(self, timeout: Optional[float] = 60.0):
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            if not self._done:
+                self._done = True
+                self._on_done()
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.method_name = method_name
+        self._replicas: List = []
+        self._version = -1
+        self._inflight: Dict[int, int] = {}
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, method_name)
+        h._replicas = self._replicas
+        h._version = self._version
+        h._inflight = self._inflight
+        return h
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return self.options(item)
+
+    def _refresh(self):
+        from ray_tpu.serve.api import _get_controller
+
+        controller = _get_controller()
+        info = ray_tpu.get(controller.get_replicas.remote(self.deployment_name),
+                           timeout=60)
+        if not info["found"]:
+            raise ValueError(f"no deployment named {self.deployment_name!r}")
+        if info["version"] != self._version:
+            self._replicas = info["replicas"]
+            self._version = info["version"]
+            self._inflight = {i: 0 for i in range(len(self._replicas))}
+
+    def _pick_replica(self) -> int:
+        n = len(self._replicas)
+        if n == 1:
+            return 0
+        a, b = random.sample(range(n), 2)
+        return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        if not self._replicas:
+            self._refresh()
+        idx = self._pick_replica()
+        replica = self._replicas[idx]
+        self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        ref = replica.handle_request.remote(self.method_name, args, kwargs)
+
+        def on_done(i=idx):
+            self._inflight[i] = max(0, self._inflight.get(i, 0) - 1)
+
+        return DeploymentResponse(ref, on_done)

@@ -1,0 +1,76 @@
+"""HTTP proxy: the ingress actor.
+
+Reference analog: python/ray/serve/_private/proxy.py:752 HTTPProxy (ASGI).
+An aiohttp server in an actor: POST /<deployment> with a JSON body calls the
+deployment's __call__ with the parsed payload; `{"method": ...}` in the query
+string selects a different method.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Optional
+
+
+class HTTPProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._ready.wait(timeout=30)
+
+    def address(self):
+        return (self.host, self.port)
+
+    def _serve(self):
+        from aiohttp import web
+
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        handles = {}
+
+        async def dispatch(request: "web.Request"):
+            name = request.match_info["deployment"]
+            method = request.query.get("method", "__call__")
+            key = (name, method)
+            if key not in handles:
+                handles[key] = DeploymentHandle(name, method)
+            try:
+                payload = await request.json()
+            except Exception:
+                payload = (await request.read()).decode() or None
+            handle = handles[key]
+            loop = asyncio.get_event_loop()
+            try:
+                # Handle calls are sync (they ride the driver RPC thread);
+                # run in executor to keep the proxy loop free.
+                result = await loop.run_in_executor(
+                    None, lambda: handle.remote(payload).result(timeout=300))
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=404)
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": repr(e)}, status=500)
+            if isinstance(result, (dict, list, str, int, float)) or result is None:
+                return web.json_response({"result": result})
+            return web.Response(body=bytes(result))
+
+        async def healthz(request):
+            return web.json_response({"status": "ok"})
+
+        async def run():
+            app = web.Application()
+            app.router.add_get("/-/healthz", healthz)
+            app.router.add_post("/{deployment}", dispatch)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self.port = site._server.sockets[0].getsockname()[1]
+            self._ready.set()
+            await asyncio.Event().wait()
+
+        asyncio.new_event_loop().run_until_complete(run())
