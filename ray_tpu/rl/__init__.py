@@ -1,0 +1,3 @@
+from ray_tpu.rl.algorithm import PPO, EnvRunner  # noqa: F401
+from ray_tpu.rl.env import VectorCartPole, make_env  # noqa: F401
+from ray_tpu.rl.ppo import PPOConfig  # noqa: F401

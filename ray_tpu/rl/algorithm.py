@@ -1,0 +1,163 @@
+"""Algorithm driver: EnvRunner actors + compiled Learner.
+
+Reference analog: rllib Algorithm (algorithms/algorithm.py:199) with
+EnvRunnerGroup (env/env_runner_group.py:71) and LearnerGroup
+(core/learner/learner_group.py:79). Round-1 shape: N env-runner actors
+collect rollouts with broadcast weights; one learner process (the driver or
+a learner actor) runs the jit-compiled PPO update; a fault-tolerant manager
+restarts dead runners.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl import ppo as ppo_mod
+from ray_tpu.rl.env import make_env
+
+logger = logging.getLogger(__name__)
+
+
+class EnvRunner:
+    """Actor: collects one rollout per call with the given weights."""
+
+    def __init__(self, config: ppo_mod.PPOConfig, seed: int):
+        import jax
+
+        self.config = config
+        self.env = make_env(config.env, config.envs_per_runner, seed)
+        self.obs = self.env.reset()
+        self.forward = jax.jit(ppo_mod.policy_forward)
+        self.rng = np.random.default_rng(seed)
+        self.episode_returns: List[float] = []
+        self._running_return = np.zeros(config.envs_per_runner)
+
+    def rollout(self, params) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        T = self.config.rollout_length
+        obs_buf, act_buf, logp_buf, rew_buf, done_buf, val_buf = \
+            [], [], [], [], [], []
+        for _ in range(T):
+            logits, values = self.forward(params, jnp.asarray(self.obs))
+            logits = np.asarray(logits)
+            probs = np.exp(logits - logits.max(-1, keepdims=True))
+            probs /= probs.sum(-1, keepdims=True)
+            actions = np.array([self.rng.choice(len(p), p=p) for p in probs])
+            logp = np.log(probs[np.arange(len(actions)), actions] + 1e-10)
+            next_obs, reward, done = self.env.step(actions)
+            obs_buf.append(self.obs)
+            act_buf.append(actions)
+            logp_buf.append(logp)
+            rew_buf.append(reward)
+            done_buf.append(done.astype(np.float32))
+            val_buf.append(np.asarray(values))
+            self._running_return += reward
+            for i in np.where(done)[0]:
+                self.episode_returns.append(float(self._running_return[i]))
+                self._running_return[i] = 0.0
+            self.obs = next_obs
+        _, last_value = self.forward(params, jnp.asarray(self.obs))
+        return {
+            "obs": np.stack(obs_buf),
+            "actions": np.stack(act_buf),
+            "logp_old": np.stack(logp_buf),
+            "rewards": np.stack(rew_buf),
+            "dones": np.stack(done_buf),
+            "values": np.stack(val_buf),
+            "last_value": np.asarray(last_value),
+            "episode_returns": self.episode_returns[-50:],
+        }
+
+
+class PPO:
+    """The Algorithm: train() runs one iteration (rollouts + update)."""
+
+    def __init__(self, config: ppo_mod.PPOConfig):
+        import jax
+        import optax
+
+        self.config = config
+        self.params = ppo_mod.init_policy(config, jax.random.key(0))
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.update_fn = ppo_mod.make_update_fn(config, self.optimizer)
+        self.key = jax.random.key(1)
+        Runner = ray_tpu.remote(EnvRunner)
+        self.runners = [Runner.remote(config, seed=i)
+                        for i in range(config.num_env_runners)]
+        self.iteration = 0
+
+    def train(self) -> Dict:
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        rollouts = self._collect_rollouts()
+        gae_in = [(r["rewards"], r["values"], r["dones"], r["last_value"])
+                  for r in rollouts]
+        batches = []
+        episode_returns: List[float] = []
+        for r in rollouts:
+            adv, ret = ppo_mod.compute_gae(
+                jnp.asarray(r["rewards"]), jnp.asarray(r["values"]),
+                jnp.asarray(r["dones"]), jnp.asarray(r["last_value"]),
+                self.config.gamma, self.config.gae_lambda)
+            flat = {
+                "obs": r["obs"].reshape(-1, self.config.obs_dim),
+                "actions": r["actions"].reshape(-1).astype(np.int32),
+                "logp_old": r["logp_old"].reshape(-1).astype(np.float32),
+                "advantages": np.asarray(adv).reshape(-1),
+                "returns": np.asarray(ret).reshape(-1),
+            }
+            batches.append(flat)
+            episode_returns.extend(r["episode_returns"])
+        batch = {k: jnp.asarray(np.concatenate([b[k] for b in batches]))
+                 for k in batches[0]}
+        self.key, subkey = jax.random.split(self.key)
+        self.params, self.opt_state, metrics = self.update_fn(
+            self.params, self.opt_state, batch, subkey)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": float(np.mean(episode_returns))
+            if episode_returns else 0.0,
+            "num_env_steps": int(batch["obs"].shape[0]),
+            "time_this_iter_s": time.perf_counter() - t0,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def _collect_rollouts(self) -> List[Dict]:
+        """FaultTolerantActorManager-lite: dead runners are replaced and the
+        round retried on the survivors + replacements."""
+        import jax
+
+        params_host = jax.tree.map(np.asarray, self.params)
+        for attempt in range(3):
+            refs = [r.rollout.remote(params_host) for r in self.runners]
+            results, failed = [], []
+            for i, ref in enumerate(refs):
+                try:
+                    results.append(ray_tpu.get(ref, timeout=300))
+                except ray_tpu.RayTpuError:
+                    failed.append(i)
+            if not failed:
+                return results
+            logger.warning("replacing %d dead env runners", len(failed))
+            Runner = ray_tpu.remote(EnvRunner)
+            for i in failed:
+                self.runners[i] = Runner.remote(self.config,
+                                                seed=100 + attempt * 10 + i)
+        raise RuntimeError("env runners kept dying")
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

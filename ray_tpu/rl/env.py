@@ -1,0 +1,70 @@
+"""Built-in vectorized environments (no gym dependency).
+
+Reference analog: RLlib's env layer (rllib/env/); CartPole is the standard
+smoke-test task (tuned_examples/ppo/cartpole_ppo.py equivalents).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class VectorCartPole:
+    """Classic CartPole-v1 dynamics, vectorized over n_envs, numpy only."""
+
+    obs_dim = 4
+    n_actions = 2
+    max_steps = 500
+
+    def __init__(self, n_envs: int, seed: int = 0):
+        self.n = n_envs
+        self.rng = np.random.default_rng(seed)
+        self.state = np.zeros((n_envs, 4), dtype=np.float32)
+        self.steps = np.zeros(n_envs, dtype=np.int64)
+        self.reset()
+
+    def reset(self) -> np.ndarray:
+        self.state = self.rng.uniform(-0.05, 0.05, (self.n, 4)).astype(np.float32)
+        self.steps[:] = 0
+        return self.state.copy()
+
+    def _reset_done(self, done: np.ndarray):
+        k = int(done.sum())
+        if k:
+            self.state[done] = self.rng.uniform(-0.05, 0.05, (k, 4)).astype(
+                np.float32)
+            self.steps[done] = 0
+
+    def step(self, actions: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        g, mc, mp, length, fmag, tau = 9.8, 1.0, 0.1, 0.5, 10.0, 0.02
+        total_m = mc + mp
+        pml = mp * length
+        x, x_dot, th, th_dot = self.state.T
+        force = np.where(actions == 1, fmag, -fmag)
+        cos, sin = np.cos(th), np.sin(th)
+        temp = (force + pml * th_dot ** 2 * sin) / total_m
+        th_acc = (g * sin - cos * temp) / (
+            length * (4.0 / 3.0 - mp * cos ** 2 / total_m))
+        x_acc = temp - pml * th_acc * cos / total_m
+        x = x + tau * x_dot
+        x_dot = x_dot + tau * x_acc
+        th = th + tau * th_dot
+        th_dot = th_dot + tau * th_acc
+        self.state = np.stack([x, x_dot, th, th_dot], axis=1).astype(np.float32)
+        self.steps += 1
+        done = (np.abs(x) > 2.4) | (np.abs(th) > 0.2095) | \
+            (self.steps >= self.max_steps)
+        reward = np.ones(self.n, dtype=np.float32)
+        obs = self.state.copy()
+        self._reset_done(done)
+        return obs, reward, done
+
+
+ENVS = {"CartPole-v1": VectorCartPole}
+
+
+def make_env(name: str, n_envs: int, seed: int = 0):
+    return ENVS[name](n_envs, seed)

@@ -1,0 +1,107 @@
+"""CLI: cluster lifecycle + introspection.
+
+Reference analog: python/ray/scripts/scripts.py (`ray start/stop/status/
+memory/...`, registration :2625-2667). Subcommands:
+
+    python -m ray_tpu.scripts start --head [--num-cpus N] [--num-tpus N]
+    python -m ray_tpu.scripts start --address HOST:PORT  (join as a node)
+    python -m ray_tpu.scripts status --address HOST:PORT
+    python -m ray_tpu.scripts list nodes|actors|pgs|jobs --address ...
+    python -m ray_tpu.scripts stop --address HOST:PORT
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _connect(address: str):
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+
+
+def cmd_start(args):
+    from ray_tpu.runtime import node as node_mod
+    from ray_tpu.runtime import resources as resources_mod
+
+    if args.head:
+        session = node_mod.new_session_dir()
+        gcs_proc, gcs_addr = node_mod.start_gcs(session)
+        res = resources_mod.node_resources(args.num_cpus, args.num_tpus)
+        labels = resources_mod.tpu_slice_labels()
+        _, info = node_mod.start_raylet(session, gcs_addr, res, labels,
+                                       args.object_store_memory, is_head=True)
+        print(f"head started; GCS at {gcs_addr[0]}:{gcs_addr[1]}")
+        print(f"  session dir: {session}")
+        print(f"  connect with: ray_tpu.init(address='{gcs_addr[0]}:{gcs_addr[1]}')")
+    else:
+        if not args.address:
+            sys.exit("--address required to join an existing cluster")
+        host, port = args.address.rsplit(":", 1)
+        session = node_mod.new_session_dir()
+        res = resources_mod.node_resources(args.num_cpus, args.num_tpus)
+        labels = resources_mod.tpu_slice_labels()
+        _, info = node_mod.start_raylet(session, (host, int(port)), res, labels,
+                                       args.object_store_memory)
+        print(f"node {info['node_id'][:12]} joined {args.address}")
+
+
+def cmd_status(args):
+    from ray_tpu.state.api import summary
+
+    _connect(args.address)
+    print(json.dumps(summary(), indent=2, default=str))
+
+
+def cmd_list(args):
+    from ray_tpu.state import api
+
+    _connect(args.address)
+    fetch = {"nodes": api.list_nodes, "actors": api.list_actors,
+             "pgs": api.list_placement_groups, "jobs": api.list_jobs}[args.what]
+    print(json.dumps(fetch(), indent=2, default=str))
+
+
+def cmd_stop(args):
+    import ray_tpu
+
+    _connect(args.address)
+    core = ray_tpu.core.worker.global_worker()
+    core.io.run(core.gcs.call("shutdown_cluster", timeout=10))
+    print("cluster shutdown requested")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--object-store-memory", type=int, default=2 << 30)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("status")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list")
+    p.add_argument("what", choices=["nodes", "actors", "pgs", "jobs"])
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("stop")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_stop)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""State API: cluster introspection.
+
+Reference analog: python/ray/util/state/ (api.py — `ray list actors/nodes/
+objects/...`). Queries go to the GCS (and per-node raylets for live stats).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.core import worker as worker_mod
+
+
+def _gcs_call(method: str, **kw):
+    core = worker_mod.global_worker()
+    return core.io.run(core.gcs.call(method, **kw))
+
+
+def list_nodes() -> List[dict]:
+    out = []
+    for n in _gcs_call("get_nodes", only_alive=False):
+        out.append({
+            "node_id": n["node_id"].hex(),
+            "address": f"{n['address'][0]}:{n['address'][1]}",
+            "alive": n["alive"],
+            "is_head": n["is_head"],
+            "resources": n["resources"],
+            "available": n["available"],
+            "labels": n["labels"],
+        })
+    return out
+
+
+def list_actors() -> List[dict]:
+    out = []
+    for a in _gcs_call("list_actors"):
+        out.append({
+            "actor_id": a["actor_id"].hex(),
+            "class_name": a["class_name"],
+            "name": a["name"],
+            "state": a["state"],
+            "node_id": a["node_id"].hex() if a["node_id"] else None,
+            "restarts": a["restarts_used"],
+        })
+    return out
+
+
+def list_placement_groups() -> List[dict]:
+    out = []
+    for pg in _gcs_call("list_placement_groups"):
+        out.append({
+            "placement_group_id": pg["placement_group_id"].hex(),
+            "name": pg["name"],
+            "strategy": pg["strategy"],
+            "state": pg["state"],
+            "bundles": pg["bundles"],
+            "locations": [loc.hex() if loc else None
+                          for loc in pg["locations"]],
+        })
+    return out
+
+
+def list_jobs() -> List[dict]:
+    return _gcs_call("get_jobs")
+
+
+def node_stats() -> List[dict]:
+    """Live per-raylet stats (workers, leases, object store usage)."""
+    import asyncio
+
+    from ray_tpu.runtime.rpc import RpcClient
+
+    core = worker_mod.global_worker()
+    stats = []
+    for n in _gcs_call("get_nodes"):
+        async def fetch(addr=tuple(n["address"])):
+            client = RpcClient(*addr)
+            await client.connect(timeout=5)
+            try:
+                return await client.call("node_stats", timeout=10)
+            finally:
+                await client.close()
+
+        try:
+            s = core.io.run(fetch(), timeout=15)
+            s["node_id"] = s["node_id"].hex()
+            stats.append(s)
+        except Exception:
+            pass
+    return stats
+
+
+def summary() -> Dict:
+    nodes = list_nodes()
+    actors = list_actors()
+    return {
+        "nodes_alive": sum(1 for n in nodes if n["alive"]),
+        "nodes_total": len(nodes),
+        "actors_alive": sum(1 for a in actors if a["state"] == "ALIVE"),
+        "actors_total": len(actors),
+        "placement_groups": len(list_placement_groups()),
+        "cluster_resources": _sum_resources(nodes, "resources"),
+        "available_resources": _sum_resources(
+            [n for n in nodes if n["alive"]], "available"),
+    }
+
+
+def _sum_resources(nodes: List[dict], key: str) -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes:
+        for k, v in n[key].items():
+            total[k] = total.get(k, 0.0) + v
+    return total
