@@ -1,0 +1,206 @@
+"""Autoscaler v2-style reconciler, TPU-slice-aware.
+
+Reference analog: python/ray/autoscaler/v2/ (autoscaler.py:42 Autoscaler,
+Reconciler, InstanceStorage, scheduler.py ResourceDemandScheduler) with the
+FakeMultiNodeProvider test pattern
+(autoscaler/_private/fake_multi_node/node_provider.py:236).
+
+TPU-native rule (SURVEY §2 mapping note + §7.10): demand for TPU chips is
+rounded up to whole slices — an instance type advertising a "v5e-8" slice is
+launched as a unit; loose-chip bin-packing never splits a slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu.runtime import scheduling
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class InstanceType:
+    name: str
+    resources: Dict[str, float]
+    max_workers: int = 100
+    # TPU topology: whole-slice instances (e.g. {"TPU": 8} labeled v5e-8)
+    tpu_slice: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Instance:
+    instance_id: str
+    instance_type: str
+    status: str = "LAUNCHING"   # LAUNCHING | RUNNING | TERMINATING
+    node_id: Optional[bytes] = None
+    launched_at: float = 0.0
+
+
+class NodeProvider:
+    """Cloud abstraction (reference: autoscaler NodeProvider plugins)."""
+
+    def launch(self, instance_type: InstanceType) -> str:
+        raise NotImplementedError
+
+    def terminate(self, instance_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated(self) -> List[str]:
+        raise NotImplementedError
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches real raylet subprocesses on this machine (test provider)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster  # ray_tpu.cluster_utils.Cluster
+        self.nodes: Dict[str, object] = {}
+
+    def launch(self, instance_type: InstanceType) -> str:
+        labels = {}
+        if instance_type.tpu_slice:
+            labels["tpu-slice"] = f"{instance_type.tpu_slice}-{uuid.uuid4().hex[:6]}"
+            labels["tpu-pod-type"] = instance_type.tpu_slice
+        res = dict(instance_type.resources)
+        num_cpus = res.pop("CPU", 1)
+        num_tpus = res.pop("TPU", 0)
+        node = self.cluster.add_node(num_cpus=num_cpus, num_tpus=num_tpus,
+                                     resources=res, labels=labels)
+        iid = f"fake-{uuid.uuid4().hex[:8]}"
+        self.nodes[iid] = node
+        return iid
+
+    def terminate(self, instance_id: str) -> None:
+        node = self.nodes.pop(instance_id, None)
+        if node is not None:
+            self.cluster.remove_node(node, force=False)
+
+    def non_terminated(self) -> List[str]:
+        return list(self.nodes)
+
+
+class Autoscaler:
+    """Reconciler: observed demand + cluster state -> launch/terminate."""
+
+    def __init__(self, provider: NodeProvider,
+                 instance_types: List[InstanceType],
+                 *, idle_timeout_s: float = 60.0,
+                 min_workers: int = 0, max_workers: int = 8):
+        self.provider = provider
+        self.instance_types = {t.name: t for t in instance_types}
+        self.instances: Dict[str, Instance] = {}
+        self.idle_timeout_s = idle_timeout_s
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self._idle_since: Dict[str, float] = {}
+
+    # -- demand ------------------------------------------------------------
+
+    def get_demand(self) -> List[Dict[str, float]]:
+        """Unmet resource demand: queued leases per raylet + pending PGs."""
+        from ray_tpu.state.api import _gcs_call, node_stats
+
+        demand: List[Dict[str, float]] = []
+        for stats in node_stats():
+            for _ in range(stats.get("num_pending_leases", 0)):
+                demand.append({"CPU": 1.0})  # raylet doesn't expose shapes yet
+        for pg in _gcs_call("list_placement_groups"):
+            if pg["state"] in ("PENDING", "RESCHEDULING"):
+                demand.extend(pg["bundles"])
+        return demand
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self, demand: Optional[List[Dict[str, float]]] = None
+                  ) -> Dict[str, int]:
+        """One reconciliation round; returns {"launched": n, "terminated": m}."""
+        from ray_tpu.state.api import list_nodes
+
+        if demand is None:
+            demand = self.get_demand()
+        nodes = [n for n in list_nodes() if n["alive"]]
+        free = [dict(n["available"]) for n in nodes]
+
+        # Unplaceable demand after bin-packing onto current free capacity.
+        unmet: List[Dict[str, float]] = []
+        for bundle in demand:
+            placed = False
+            for avail in free:
+                if scheduling.fits(avail, bundle):
+                    scheduling.subtract(avail, bundle)
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(bundle)
+
+        launched = 0
+        to_launch = self._plan_launches(unmet)
+        for type_name in to_launch:
+            if len(self.instances) >= self.max_workers:
+                break
+            iid = self.provider.launch(self.instance_types[type_name])
+            self.instances[iid] = Instance(iid, type_name, "RUNNING",
+                                           launched_at=time.time())
+            launched += 1
+
+        terminated = self._terminate_idle(nodes, demand)
+        return {"launched": launched, "terminated": terminated,
+                "unmet_demand": len(unmet)}
+
+    def _plan_launches(self, unmet: List[Dict[str, float]]) -> List[str]:
+        """Choose instance types to cover unmet bundles. TPU demand rounds up
+        to whole slices; CPU demand bin-packs into the smallest type."""
+        plan: List[str] = []
+        tpu_chips = sum(b.get("TPU", 0) for b in unmet)
+        if tpu_chips > 0:
+            slice_types = [t for t in self.instance_types.values()
+                           if t.resources.get("TPU", 0) > 0]
+            if slice_types:
+                t = max(slice_types, key=lambda t: t.resources["TPU"])
+                count = math.ceil(tpu_chips / t.resources["TPU"])
+                plan.extend([t.name] * count)
+        cpu_bundles = [b for b in unmet if b.get("TPU", 0) == 0 and b]
+        if cpu_bundles:
+            cpu_types = [t for t in self.instance_types.values()
+                         if t.resources.get("TPU", 0) == 0]
+            if cpu_types:
+                t = max(cpu_types, key=lambda t: t.resources.get("CPU", 0))
+                per_node = t.resources.get("CPU", 1)
+                need = sum(b.get("CPU", 1) for b in cpu_bundles)
+                plan.extend([t.name] * math.ceil(need / per_node))
+        return plan
+
+    def _terminate_idle(self, nodes, demand) -> int:
+        """Terminate instances whose node has been fully idle past the
+        timeout (never below min_workers; head node is never touched)."""
+        terminated = 0
+        if demand:
+            self._idle_since.clear()
+            return 0
+        now = time.time()
+        node_by_id = {n["node_id"]: n for n in nodes}
+        for iid, inst in list(self.instances.items()):
+            if len(self.instances) <= self.min_workers:
+                break
+            node = node_by_id.get(inst.node_id.hex() if inst.node_id else "")
+            fully_idle = node is not None and \
+                node["available"] == node["resources"]
+            if node is None:
+                # Match by provider knowledge: fall back to age-based idle.
+                fully_idle = True
+            if fully_idle:
+                since = self._idle_since.setdefault(iid, now)
+                if now - since > self.idle_timeout_s:
+                    self.provider.terminate(iid)
+                    del self.instances[iid]
+                    self._idle_since.pop(iid, None)
+                    terminated += 1
+            else:
+                self._idle_since.pop(iid, None)
+        return terminated
