@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import math
 import time
 import uuid
 from typing import Dict, List, Optional
@@ -54,6 +53,10 @@ class NodeProvider:
     def non_terminated(self) -> List[str]:
         raise NotImplementedError
 
+    def get_node_id(self, instance_id: str) -> Optional[bytes]:
+        """Raylet node id for a launched instance, once known (else None)."""
+        return None
+
 
 class FakeMultiNodeProvider(NodeProvider):
     """Launches real raylet subprocesses on this machine (test provider)."""
@@ -84,6 +87,10 @@ class FakeMultiNodeProvider(NodeProvider):
     def non_terminated(self) -> List[str]:
         return list(self.nodes)
 
+    def get_node_id(self, instance_id: str) -> Optional[bytes]:
+        node = self.nodes.get(instance_id)
+        return getattr(node, "node_id", None)
+
 
 class Autoscaler:
     """Reconciler: observed demand + cluster state -> launch/terminate."""
@@ -91,13 +98,17 @@ class Autoscaler:
     def __init__(self, provider: NodeProvider,
                  instance_types: List[InstanceType],
                  *, idle_timeout_s: float = 60.0,
-                 min_workers: int = 0, max_workers: int = 8):
+                 min_workers: int = 0, max_workers: int = 8,
+                 boot_grace_s: float = 300.0):
         self.provider = provider
         self.instance_types = {t.name: t for t in instance_types}
         self.instances: Dict[str, Instance] = {}
         self.idle_timeout_s = idle_timeout_s
         self.min_workers = min_workers
         self.max_workers = max_workers
+        # How long a launched instance may stay unregistered before it is
+        # considered failed and reaped.
+        self.boot_grace_s = boot_grace_s
         self._idle_since: Dict[str, float] = {}
 
     # -- demand ------------------------------------------------------------
@@ -125,9 +136,26 @@ class Autoscaler:
         if demand is None:
             demand = self.get_demand()
         nodes = [n for n in list_nodes() if n["alive"]]
+        alive_ids = {n["node_id"] for n in nodes}
         free = [dict(n["available"]) for n in nodes]
 
-        # Unplaceable demand after bin-packing onto current free capacity.
+        # Resolve instance -> raylet-node bindings and mark registered
+        # instances RUNNING. Instances still booting (launched but not yet in
+        # the GCS node table) contribute their full advertised capacity so a
+        # periodic reconcile loop doesn't re-launch for the same demand every
+        # tick while a slice boots.
+        for inst in self.instances.values():
+            if inst.node_id is None:
+                inst.node_id = self.provider.get_node_id(inst.instance_id)
+            registered = (inst.node_id is not None
+                          and inst.node_id.hex() in alive_ids)
+            if registered:
+                inst.status = "RUNNING"
+            elif inst.status == "LAUNCHING":
+                free.append(dict(
+                    self.instance_types[inst.instance_type].resources))
+
+        # Unplaceable demand after bin-packing onto current + booting capacity.
         unmet: List[Dict[str, float]] = []
         for bundle in demand:
             placed = False
@@ -145,7 +173,7 @@ class Autoscaler:
             if len(self.instances) >= self.max_workers:
                 break
             iid = self.provider.launch(self.instance_types[type_name])
-            self.instances[iid] = Instance(iid, type_name, "RUNNING",
+            self.instances[iid] = Instance(iid, type_name, "LAUNCHING",
                                            launched_at=time.time())
             launched += 1
 
@@ -154,31 +182,41 @@ class Autoscaler:
                 "unmet_demand": len(unmet)}
 
     def _plan_launches(self, unmet: List[Dict[str, float]]) -> List[str]:
-        """Choose instance types to cover unmet bundles. TPU demand rounds up
-        to whole slices; CPU demand bin-packs into the smallest type."""
+        """Choose instance types covering unmet bundles by per-bundle fit:
+        every bundle must fit whole on one planned instance (bundles are
+        per-node). TPU bundles launch whole slices (the instance type IS an
+        intact ICI slice); remaining capacity of planned instances is
+        first-fit packed with further bundles."""
         plan: List[str] = []
-        tpu_chips = sum(b.get("TPU", 0) for b in unmet)
-        if tpu_chips > 0:
-            slice_types = [t for t in self.instance_types.values()
-                           if t.resources.get("TPU", 0) > 0]
-            if slice_types:
-                t = max(slice_types, key=lambda t: t.resources["TPU"])
-                count = math.ceil(tpu_chips / t.resources["TPU"])
-                plan.extend([t.name] * count)
-        cpu_bundles = [b for b in unmet if b.get("TPU", 0) == 0 and b]
-        if cpu_bundles:
-            cpu_types = [t for t in self.instance_types.values()
-                         if t.resources.get("TPU", 0) == 0]
-            if cpu_types:
-                t = max(cpu_types, key=lambda t: t.resources.get("CPU", 0))
-                per_node = t.resources.get("CPU", 1)
-                need = sum(b.get("CPU", 1) for b in cpu_bundles)
-                plan.extend([t.name] * math.ceil(need / per_node))
+        plan_free: List[Dict[str, float]] = []
+        for bundle in sorted(unmet, key=lambda b: -sum(b.values())):
+            placed = False
+            for cap in plan_free:
+                if scheduling.fits(cap, bundle):
+                    scheduling.subtract(cap, bundle)
+                    placed = True
+                    break
+            if placed:
+                continue
+            candidates = [t for t in self.instance_types.values()
+                          if scheduling.fits(dict(t.resources), bundle)]
+            if not candidates:
+                logger.warning(
+                    "no instance type fits bundle %s; leaving unmet", bundle)
+                continue
+            # Smallest adequate type; avoid burning TPU slices on CPU work.
+            t = min(candidates, key=lambda t: (t.resources.get("TPU", 0),
+                                               sum(t.resources.values())))
+            plan.append(t.name)
+            cap = dict(t.resources)
+            scheduling.subtract(cap, bundle)
+            plan_free.append(cap)
         return plan
 
     def _terminate_idle(self, nodes, demand) -> int:
         """Terminate instances whose node has been fully idle past the
-        timeout (never below min_workers; head node is never touched)."""
+        timeout (never below min_workers; head node is never touched).
+        Instances that never registered are reaped after boot_grace_s."""
         terminated = 0
         if demand:
             self._idle_since.clear()
@@ -188,12 +226,14 @@ class Autoscaler:
         for iid, inst in list(self.instances.items()):
             if len(self.instances) <= self.min_workers:
                 break
-            node = node_by_id.get(inst.node_id.hex() if inst.node_id else "")
-            fully_idle = node is not None and \
-                node["available"] == node["resources"]
+            node = node_by_id.get(inst.node_id.hex()) if inst.node_id else None
             if node is None:
-                # Match by provider knowledge: fall back to age-based idle.
-                fully_idle = True
+                # Not (or no longer) registered: reap only once the boot
+                # grace expires — a booting node may be seconds from joining,
+                # and a bound-but-vanished node is dead anyway.
+                fully_idle = now - inst.launched_at > self.boot_grace_s
+            else:
+                fully_idle = node["available"] == node["resources"]
             if fully_idle:
                 since = self._idle_since.setdefault(iid, now)
                 if now - since > self.idle_timeout_s:

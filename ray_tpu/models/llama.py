@@ -156,12 +156,12 @@ def _attention_dispatch(config: LlamaConfig, q, k, v):
     return attention(q, k, v, causal=True, impl=impl)
 
 
-def _layer(config: LlamaConfig, x, layer_params, cos, sin):
-    """One decoder layer. x: (b, s, d)."""
+def attention_sublayer(config, x, p, cos, sin):
+    """Pre-norm GQA attention block with residual. Shared by every decoder
+    family in models/ (config needs head_dim/n_heads/n_kv_heads/norm_eps and
+    the attention_impl fields _attention_dispatch reads)."""
     b, s, d = x.shape
     hd, H, K = config.head_dim, config.n_heads, config.n_kv_heads
-    p = layer_params
-
     h = rms_norm(x, p["attn_norm"], config.norm_eps)
     q = (h @ p["wq"]).reshape(b, s, H, hd)
     k = (h @ p["wk"]).reshape(b, s, K, hd)
@@ -169,8 +169,24 @@ def _layer(config: LlamaConfig, x, layer_params, cos, sin):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn_out = _attention_dispatch(config, q, k, v)
-    x = x + (attn_out.reshape(b, s, H * hd) @ p["wo"])
+    return x + (attn_out.reshape(b, s, H * hd) @ p["wo"])
 
+
+def next_token_ce(logits: jax.Array, targets: jax.Array,
+                  mask: Optional[jax.Array] = None) -> jax.Array:
+    """Mean next-token cross entropy; mask (same shape as targets) optional."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        mask = mask.astype(jnp.float32)
+        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return -ll.mean()
+
+
+def _layer(config: LlamaConfig, x, layer_params, cos, sin):
+    """One decoder layer. x: (b, s, d)."""
+    p = layer_params
+    x = attention_sublayer(config, x, p, cos, sin)
     h = rms_norm(x, p["mlp_norm"], config.norm_eps)
     x = x + (swiglu(h @ p["w_gate"], h @ p["w_up"]) @ p["w_down"])
     return x
@@ -201,12 +217,7 @@ def loss_fn(params: Dict, batch: Dict[str, jax.Array],
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     logits = forward(params, inputs, config)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
-    if mask is not None:
-        mask = mask[:, 1:].astype(jnp.float32)
-        loss = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    else:
-        loss = -ll.mean()
+    loss = next_token_ce(logits, targets,
+                         mask[:, 1:] if mask is not None else None)
     return loss, {"loss": loss, "tokens": jnp.array(targets.size, jnp.float32)}

@@ -108,6 +108,13 @@ def test_actor_in_placement_group(cluster):
 
 
 def test_pg_resources_released_on_remove(cluster):
+    # Settle: wait until releases from earlier tests have propagated so
+    # `before` reflects the true free count, not a stale heartbeat.
+    total = ray_tpu.cluster_resources().get("TPU", 0)
+    deadline = time.time() + 30
+    while (ray_tpu.available_resources().get("TPU", 0) < total
+           and time.time() < deadline):
+        time.sleep(0.3)
     before = ray_tpu.available_resources().get("TPU", 0)
     pg = placement_group([{"TPU": 2}], strategy=PACK)
     assert pg.wait(30)
