@@ -5,9 +5,11 @@ Reconciler, InstanceStorage, scheduler.py ResourceDemandScheduler) with the
 FakeMultiNodeProvider test pattern
 (autoscaler/_private/fake_multi_node/node_provider.py:236).
 
-TPU-native rule (SURVEY §2 mapping note + §7.10): demand for TPU chips is
-rounded up to whole slices — an instance type advertising a "v5e-8" slice is
-launched as a unit; loose-chip bin-packing never splits a slice.
+TPU-native rule (SURVEY §2 mapping note + §7.10): planning is per-bundle —
+every bundle must fit whole on one planned instance (bundles are per-node),
+and TPU bundles launch whole slices: an instance type advertising a "v5e-8"
+slice is launched as a unit, and loose-chip bin-packing never splits a slice.
+A bundle larger than every instance type is logged and left unmet.
 """
 
 from __future__ import annotations
@@ -143,14 +145,25 @@ class Autoscaler:
         # instances RUNNING. Instances still booting (launched but not yet in
         # the GCS node table) contribute their full advertised capacity so a
         # periodic reconcile loop doesn't re-launch for the same demand every
-        # tick while a slice boots.
-        for inst in self.instances.values():
+        # tick while a slice boots — but an instance that outlives the boot
+        # grace without ever registering is reaped HERE, before capacity
+        # accounting: its phantom capacity must not suppress a replacement
+        # launch while real demand goes unserved.
+        now = time.time()
+        for iid, inst in list(self.instances.items()):
             if inst.node_id is None:
                 inst.node_id = self.provider.get_node_id(inst.instance_id)
             registered = (inst.node_id is not None
                           and inst.node_id.hex() in alive_ids)
             if registered:
                 inst.status = "RUNNING"
+                continue
+            if now - inst.launched_at > self.boot_grace_s:
+                logger.warning("instance %s never registered within %.0fs; "
+                               "terminating", iid, self.boot_grace_s)
+                self.provider.terminate(iid)
+                del self.instances[iid]
+                self._idle_since.pop(iid, None)
             elif inst.status == "LAUNCHING":
                 free.append(dict(
                     self.instance_types[inst.instance_type].resources))
@@ -214,9 +227,10 @@ class Autoscaler:
         return plan
 
     def _terminate_idle(self, nodes, demand) -> int:
-        """Terminate instances whose node has been fully idle past the
-        timeout (never below min_workers; head node is never touched).
-        Instances that never registered are reaped after boot_grace_s."""
+        """Terminate instances whose node has been fully idle past
+        idle_timeout_s (never below min_workers; head node is never touched).
+        Never-registered instances are reaped by reconcile() after
+        boot_grace_s, independent of demand."""
         terminated = 0
         if demand:
             self._idle_since.clear()
@@ -228,12 +242,9 @@ class Autoscaler:
                 break
             node = node_by_id.get(inst.node_id.hex()) if inst.node_id else None
             if node is None:
-                # Not (or no longer) registered: reap only once the boot
-                # grace expires — a booting node may be seconds from joining,
-                # and a bound-but-vanished node is dead anyway.
-                fully_idle = now - inst.launched_at > self.boot_grace_s
-            else:
-                fully_idle = node["available"] == node["resources"]
+                # Still booting (reconcile handles boot-grace reaping).
+                continue
+            fully_idle = node["available"] == node["resources"]
             if fully_idle:
                 since = self._idle_since.setdefault(iid, now)
                 if now - since > self.idle_timeout_s:

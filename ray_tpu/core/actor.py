@@ -32,6 +32,12 @@ class ActorMethod:
             max_task_retries=self._handle._max_task_retries)
         return refs[0] if self._num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node for this method call (ray_tpu.dag)."""
+        from ray_tpu.dag.node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError("Actor methods cannot be called directly; use .remote()")
 

@@ -61,6 +61,12 @@ class RemoteFunction:
             placement_group_id=pg_id, bundle_index=bundle_index)
         return refs[0] if self._num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node for this task call (ray_tpu.dag)."""
+        from ray_tpu.dag.node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Remote function '{getattr(self._fn, '__name__', '?')}' cannot be called "

@@ -1,0 +1,17 @@
+"""Compiled graphs: a lazily-bound DAG API over actors/tasks that can be
+lowered onto persistent actor loops connected by shared-memory channels.
+
+Reference analog: python/ray/dag/ + python/ray/experimental/channel/.
+"""
+
+from ray_tpu.dag.channel import ChannelClosed, ShmChannel  # noqa: F401
+from ray_tpu.dag.collective import allreduce  # noqa: F401
+from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef  # noqa: F401
+from ray_tpu.dag.node import (ClassMethodNode, DAGNode, FunctionNode,  # noqa: F401
+                              InputNode, MultiOutputNode)
+
+__all__ = [
+    "DAGNode", "InputNode", "MultiOutputNode", "ClassMethodNode",
+    "FunctionNode", "CompiledDAG", "CompiledDAGRef", "ShmChannel",
+    "ChannelClosed", "allreduce",
+]

@@ -1,0 +1,132 @@
+"""Shared-memory channels for compiled graphs.
+
+Reference analog: python/ray/experimental/channel/shared_memory_channel.py:91,151
+(mutable plasma objects, N27 `experimental_mutable_object_manager.*`). The TPU
+build's channel is a ring of versioned objects in the node's shared-memory
+store: the writer seals version v at a deterministic id derived from
+(channel_id, v); the reader blocks on that id, then frees old versions. Writer
+backpressure = bounded ring: version v may only be written once v-capacity has
+been consumed. Zero-copy on the read side (numpy views over the mmap).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from collections import deque
+from typing import Any, Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.runtime.object_store.store import ObjectNotFoundError
+
+__all__ = ["ShmChannel", "ChannelClosed", "CLOSE"]
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class _CloseToken:
+    """Sentinel flowing through a channel to tear down compiled loops."""
+
+    def __reduce__(self):
+        return (_get_close, ())
+
+    def __repr__(self):
+        return "<dag.CLOSE>"
+
+
+CLOSE = _CloseToken()
+
+
+def _get_close():
+    return CLOSE
+
+
+def _store():
+    from ray_tpu.core import worker as worker_mod
+
+    return worker_mod.global_worker()._require_store()
+
+
+class ShmChannel:
+    """Single-writer single-reader bounded channel over the local store.
+
+    Pickles as (channel_id, capacity); each process lazily opens its own
+    store connection and tracks its own read/write cursor — the writer
+    process only writes, the reader process only reads.
+    """
+
+    def __init__(self, channel_id: Optional[bytes] = None, capacity: int = 2):
+        import os
+
+        self.channel_id = channel_id or os.urandom(12)
+        # read() retains the latest consumed version (zero-copy safety), so
+        # the usable in-flight depth is capacity-1; require >= 2.
+        self.capacity = max(2, int(capacity))
+        self._wv = 0            # next version to write
+        self._rv = 0            # next version to read
+        self._retired: deque = deque()
+
+    def __reduce__(self):
+        return (ShmChannel, (self.channel_id, self.capacity))
+
+    def _oid(self, version: int) -> bytes:
+        h = hashlib.sha1(self.channel_id + version.to_bytes(8, "little"))
+        return h.digest()[:20]
+
+    # -- writer side --------------------------------------------------------
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        store = _store()
+        if self._wv >= self.capacity:
+            # Ring is full until the reader frees the slot `capacity` back.
+            old = self._oid(self._wv - self.capacity)
+            deadline = None if timeout is None else time.monotonic() + timeout
+            sleep = 0.0002
+            while store.contains(old):
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise TimeoutError("channel write backpressure timeout")
+                time.sleep(sleep)
+                sleep = min(sleep * 2, 0.005)
+        segments, total = serialization.serialize(value)
+        oid = self._oid(self._wv)
+        store.abort(oid)  # reclaim a stale unsealed create, if any
+        buf = store.create(oid, total)
+        try:
+            serialization.write_segments(buf, segments)
+        except BaseException:
+            buf.release()
+            store.abort(oid)
+            raise
+        buf.release()
+        store.seal(oid)
+        self._wv += 1
+
+    def close_write(self) -> None:
+        self.write(CLOSE)
+
+    # -- reader side --------------------------------------------------------
+    def read(self, timeout: Optional[float] = None) -> Any:
+        store = _store()
+        oid = self._oid(self._rv)
+        try:
+            buf = store.get(oid, timeout=timeout)
+        except ObjectNotFoundError:
+            raise TimeoutError(f"channel read timed out (version {self._rv})")
+        value = serialization.deserialize(buf.data, pin=buf)
+        self._rv += 1
+        # Free old versions; keep the most recent buffer alive so zero-copy
+        # views handed to the caller on the previous read stay valid until
+        # they have moved on one iteration.
+        self._retired.append(oid)
+        while len(self._retired) > 1:
+            store.delete(self._retired.popleft())
+        if isinstance(value, _CloseToken):
+            raise ChannelClosed()
+        return value
+
+    def drain(self) -> None:
+        """Reader-side cleanup after the loop exits."""
+        store = _store()
+        while self._retired:
+            store.delete(self._retired.popleft())

@@ -1,0 +1,41 @@
+"""In-graph collectives for compiled DAGs.
+
+Reference analog: python/ray/dag/collective_node.py:18,111 +
+python/ray/experimental/collective/allreduce.py. `allreduce.bind(nodes)`
+returns one output node per participant; compiled, each participant's loop
+runs the collective in-place over the ray_tpu.collective TCP/JAX group — on
+TPU meshes the hot-path collectives live inside the compiled XLA program
+(jax.lax.psum over ICI); this DAG-level collective is the actor-to-actor
+(host-mediated) tier used by pipeline/learner topologies.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Sequence
+
+from ray_tpu.dag.node import ClassMethodNode, CollectiveOutputNode, DAGNode
+
+_coll_counter = itertools.count()
+
+
+class _AllReduce:
+    def bind(self, nodes: Sequence[DAGNode], op: str = "sum") -> List[CollectiveOutputNode]:
+        nodes = list(nodes)
+        if len(nodes) < 2:
+            raise ValueError("allreduce needs at least 2 participant nodes")
+        actors = set()
+        for n in nodes:
+            if not isinstance(n, ClassMethodNode):
+                raise TypeError("allreduce participants must be actor-method nodes")
+            if n.actor._actor_id in actors:
+                raise ValueError("each participant must live on a distinct actor")
+            actors.add(n.actor._actor_id)
+        coll_id = next(_coll_counter)
+        outputs: List[CollectiveOutputNode] = []
+        for n in nodes:
+            outputs.append(CollectiveOutputNode(coll_id, n, outputs, op))
+        return outputs
+
+
+allreduce = _AllReduce()

@@ -1,0 +1,260 @@
+"""CompiledDAG: lower a DAG onto persistent actor loops + shm channels.
+
+Reference analog: python/ray/dag/compiled_dag_node.py:767 (CompiledDAG,
+execute:2507) — compile once, then each execute() is channel writes/reads with
+no per-call task submission. This is the pipeline-parallel substrate: each
+pipeline stage is an actor whose loop runs its stage and forwards activations
+through a bounded channel, so stage N's compute overlaps stage N+1's.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import worker as worker_mod
+from ray_tpu.dag import executor
+from ray_tpu.dag.channel import ChannelClosed, ShmChannel
+from ray_tpu.dag.node import (ClassMethodNode, CollectiveOutputNode, DAGNode,
+                              FunctionNode, InputAttributeNode, InputNode,
+                              MultiOutputNode)
+
+_dag_counter = itertools.count()
+
+
+class CompiledDAGRef:
+    """Result handle for one execute(); results must be consumed in order."""
+
+    def __init__(self, dag: "CompiledDAG", index: int):
+        self._dag = dag
+        self._index = index
+        self._value = None
+        self._done = False
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._done:
+            self._value = self._dag._fetch(self._index, timeout)
+            self._done = True
+        return self._value
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, *, buffer_size: int = 2,
+                 submit_timeout: float = 60.0):
+        self.root = root
+        # ShmChannel retains the last-read version for zero-copy safety, so
+        # the usable in-flight depth is buffer_size-1; keep >= 2.
+        self.buffer_size = max(2, buffer_size)
+        self.submit_timeout = submit_timeout
+        self.uid = next(_dag_counter)
+        self._core = worker_mod.global_worker()
+        self._input_channels: List[ShmChannel] = []
+        self._output_channels: List[ShmChannel] = []
+        self._loop_refs = []
+        self._exec_count = 0
+        self._fetch_count = 0
+        self._partial: List[Any] = []  # outputs read so far for the next fetch
+        self._single_output = True
+        self._torn_down = False
+        self._build()
+
+    # -- compilation --------------------------------------------------------
+    def _build(self):
+        nodes = self.root.topo_sort()
+        outputs: List[DAGNode]
+        if isinstance(self.root, MultiOutputNode):
+            outputs = self.root.outputs
+            self._single_output = False
+        else:
+            outputs = [self.root]
+
+        def owner(n: DAGNode) -> Optional[bytes]:
+            if isinstance(n, (ClassMethodNode, CollectiveOutputNode)):
+                return n.actor._actor_id
+            return None  # driver side (Input*, MultiOutput)
+
+        plans: Dict[bytes, dict] = {}
+        op_by_node: Dict[int, dict] = {}
+
+        def plan_for(aid: bytes) -> dict:
+            if aid not in plans:
+                plans[aid] = {"collective_groups": [], "input_channel": None,
+                              "ops": []}
+            return plans[aid]
+
+        # channel per (producer node, consumer actor), deduped; the read is
+        # attached to the first consumer op on that actor (schedule order).
+        edge_channels: Dict[Tuple[int, bytes], ShmChannel] = {}
+        coll_groups: Dict[int, List[CollectiveOutputNode]] = {}
+
+        def encode(x, consumer_op: dict, consumer_aid: bytes):
+            plan = plans[consumer_aid]
+            if isinstance(x, InputNode):
+                self._need_input(plan)
+                return executor._InArg(None)
+            if isinstance(x, InputAttributeNode):
+                self._need_input(plan)
+                return executor._InArg(x.key)
+            if isinstance(x, DAGNode):
+                src_aid = owner(x)
+                if src_aid is None:
+                    raise ValueError(f"cannot compile node {x!r} as a data source")
+                if src_aid != consumer_aid:
+                    key = (x.node_id, consumer_aid)
+                    if key not in edge_channels:
+                        ch = ShmChannel(capacity=self.buffer_size)
+                        edge_channels[key] = ch
+                        op_by_node[x.node_id]["writes"].append(ch)
+                        consumer_op["reads"].append((x.node_id, ch))
+                return executor._Ref(x.node_id)
+            if isinstance(x, (list, tuple)):
+                return type(x)(encode(v, consumer_op, consumer_aid) for v in x)
+            if isinstance(x, dict):
+                return {k: encode(v, consumer_op, consumer_aid)
+                        for k, v in x.items()}
+            return x
+
+        for n in nodes:
+            if isinstance(n, FunctionNode):
+                raise ValueError(
+                    "experimental_compile supports actor-method nodes only; "
+                    "FunctionNode tasks run via uncompiled execute()")
+            if isinstance(n, (InputNode, InputAttributeNode, MultiOutputNode)):
+                continue
+            aid = owner(n)
+            plan = plan_for(aid)
+            op = {"node_id": n.node_id, "reads": [], "writes": []}
+            if isinstance(n, ClassMethodNode):
+                op.update(kind="method", method=n.method_name)
+                plan["ops"].append(op)
+                op_by_node[n.node_id] = op
+                op["args"] = encode(list(n.args), op, aid)
+                op["kwargs"] = encode(dict(n.kwargs), op, aid)
+            elif isinstance(n, CollectiveOutputNode):
+                coll_groups.setdefault(n.coll_id, [])
+                op.update(kind="collective", src=n.src.node_id,
+                          reduce_op=n.reduce_op,
+                          group=f"__dag{self.uid}_cc{n.coll_id}")
+                plan["ops"].append(op)
+                op_by_node[n.node_id] = op
+                encode(n.src, op, aid)  # wires the src edge if cross-actor
+                coll_groups[n.coll_id].append(n)
+
+        # collective group membership (rank = participant order)
+        for coll_id, members in coll_groups.items():
+            members = sorted(members, key=lambda m: m.participants.index(m))
+            name = f"__dag{self.uid}_cc{coll_id}"
+            world = len(members)
+            for rank, m in enumerate(members):
+                plans[owner(m)]["collective_groups"].append((name, world, rank))
+
+        # outputs -> driver channels, in MultiOutput order
+        for t in outputs:
+            if owner(t) is None:
+                raise ValueError("DAG output must be an actor-method node")
+            ch = ShmChannel(capacity=self.buffer_size)
+            op_by_node[t.node_id]["writes"].append(ch)
+            self._output_channels.append(ch)
+
+        # actors with nothing to read still need a per-iteration trigger
+        for aid, plan in plans.items():
+            if plan["input_channel"] is None and not any(
+                    op["reads"] for op in plan["ops"]):
+                self._need_input(plan)
+
+        # launch loops
+        handles = {owner(n): n.actor for n in nodes
+                   if isinstance(n, (ClassMethodNode, CollectiveOutputNode))}
+        for aid, plan in plans.items():
+            refs = self._core.submit_actor_task(
+                aid, "__ray_dag_loop__", (plan,), {}, num_returns=1,
+                name=f"dag_loop:{handles[aid]._class_name}", max_task_retries=0)
+            self._loop_refs.append(refs[0])
+
+    def _need_input(self, plan: dict):
+        if plan["input_channel"] is None:
+            ch = ShmChannel(capacity=self.buffer_size)
+            plan["input_channel"] = ch
+            self._input_channels.append(ch)
+
+    # -- execution ----------------------------------------------------------
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("CompiledDAG has been torn down")
+        for ch in self._input_channels:
+            ch.write((args, kwargs), timeout=self.submit_timeout)
+        ref = CompiledDAGRef(self, self._exec_count)
+        self._exec_count += 1
+        return ref
+
+    def _fetch(self, index: int, timeout: Optional[float]):
+        if index != self._fetch_count:
+            raise RuntimeError(
+                f"compiled DAG results must be consumed in order "
+                f"(asked for {index}, next is {self._fetch_count})")
+        # Resume partially-read multi-output fetches (a timeout mid-read must
+        # not desynchronize the per-channel cursors).
+        try:
+            while len(self._partial) < len(self._output_channels):
+                ch = self._output_channels[len(self._partial)]
+                self._partial.append(ch.read(timeout=timeout))
+        except ChannelClosed:
+            self._raise_loop_error()
+            raise RuntimeError("compiled DAG loop exited unexpectedly")
+        vals, self._partial = self._partial, []
+        self._fetch_count += 1
+        return vals[0] if self._single_output else vals
+
+    def _raise_loop_error(self):
+        """A loop died: unwind the rest of the pipeline, surface its error."""
+        from ray_tpu.core.api import get
+
+        self._torn_down = True
+        for ch in self._input_channels:
+            try:
+                ch.close_write()
+            except BaseException:
+                pass
+        first_error = None
+        for ref in self._loop_refs:
+            try:
+                get(ref, timeout=30)
+            except BaseException as e:  # noqa: BLE001 — surface the task error
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+
+    def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        for ch in self._input_channels:
+            try:
+                ch.close_write()
+            except BaseException:
+                pass
+        # Drain each output channel to its close token so the loops can flush
+        # and no sealed objects are left behind in the shm store.
+        for ch in self._output_channels:
+            try:
+                while True:
+                    ch.read(timeout=5)
+            except (ChannelClosed, TimeoutError):
+                pass
+            try:
+                ch.drain()
+            except BaseException:
+                pass
+        from ray_tpu.core.api import get
+
+        try:
+            get(self._loop_refs, timeout=30)
+        except BaseException:
+            pass
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except BaseException:
+            pass

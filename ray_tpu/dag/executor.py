@@ -1,0 +1,131 @@
+"""In-actor execution loop for compiled graphs.
+
+Reference analog: the generated actor loop of
+python/ray/dag/compiled_dag_node.py (ExecutableTask:451, _execute_until:2436)
+with the static READ -> COMPUTE -> WRITE schedule of dag_node_operation.py:17-34:
+each op reads exactly its own input channels just before computing and writes
+its outputs immediately after, so a graph that revisits an actor through
+another actor (a -> b -> a) streams instead of deadlocking. The worker runtime
+dispatches method name `__ray_dag_loop__` here (runtime/worker_main.py), so
+user classes need no special support.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict
+
+from ray_tpu.dag.channel import ChannelClosed, ShmChannel
+
+logger = logging.getLogger(__name__)
+
+
+class _Ref:
+    """Arg placeholder: output of another op in this DAG."""
+
+    def __init__(self, node_id: int):
+        self.node_id = node_id
+
+
+class _InArg:
+    """Arg placeholder: one of execute()'s arguments."""
+
+    def __init__(self, key=None):
+        self.key = key  # None = whole input, int = positional, str = keyword
+
+
+def _fill(x, values: Dict[int, Any], inp):
+    if isinstance(x, _Ref):
+        return values[x.node_id]
+    if isinstance(x, _InArg):
+        args, kwargs = inp
+        if x.key is None:
+            return args[0] if (len(args) == 1 and not kwargs) else (args, kwargs)
+        if isinstance(x.key, int):
+            return args[x.key]
+        return kwargs[x.key]
+    if isinstance(x, (list, tuple)):
+        return type(x)(_fill(v, values, inp) for v in x)
+    if isinstance(x, dict):
+        return {k: _fill(v, values, inp) for k, v in x.items()}
+    return x
+
+
+def run_loop(actor_instance, plan: dict) -> dict:
+    """Blocking loop over the static schedule:
+
+    plan = {
+      "collective_groups": [(group_name, world_size, rank)],
+      "input_channel": ShmChannel | None,   # read once, at iteration start
+      "ops": [{"node_id", "kind": "method"|"collective",
+               "method", "args", "kwargs",          # method ops
+               "src", "group", "reduce_op",         # collective ops
+               "reads": [(producer_node_id, ShmChannel)],  # per-op READ
+               "writes": [ShmChannel]}],                   # per-op WRITE
+    }
+    """
+    from ray_tpu.collective import collective as cc
+
+    for group_name, world_size, rank in plan.get("collective_groups", []):
+        try:
+            cc.init_collective_group(world_size, rank, backend="tcp",
+                                     group_name=group_name)
+        except ValueError:
+            pass  # already initialized by a previous compile of this actor
+
+    input_channel: ShmChannel = plan.get("input_channel")
+    ops = plan["ops"]
+    all_writes = [ch for op in ops for ch in op.get("writes", [])]
+    all_reads = [ch for op in ops for _, ch in op.get("reads", [])]
+    iterations = 0
+    try:
+        while True:
+            values: Dict[int, Any] = {}
+            inp = None
+            try:
+                if input_channel is not None:
+                    inp = input_channel.read()
+                for op in ops:
+                    for producer_id, ch in op.get("reads", []):
+                        values[producer_id] = ch.read()
+                    if op["kind"] == "method":
+                        method = getattr(actor_instance, op["method"])
+                        args = _fill(op["args"], values, inp)
+                        kwargs = _fill(op["kwargs"], values, inp)
+                        values[op["node_id"]] = method(*args, **kwargs)
+                    elif op["kind"] == "collective":
+                        import numpy as np
+
+                        local = np.asarray(values[op["src"]])
+                        reduced = cc.allreduce(local, group_name=op["group"])
+                        if op["reduce_op"] == "mean":
+                            world = cc.get_collective_group_size(op["group"])
+                            reduced = reduced / world
+                        values[op["node_id"]] = reduced
+                    else:
+                        raise ValueError(f"unknown op kind {op['kind']!r}")
+                    for ch in op.get("writes", []):
+                        ch.write(values[op["node_id"]])
+            except ChannelClosed:
+                break
+            iterations += 1
+    except BaseException:
+        logger.exception("compiled DAG loop failed after %d iterations", iterations)
+        raise
+    finally:
+        # Propagate shutdown downstream so the whole pipeline unwinds.
+        for ch in all_writes:
+            try:
+                ch.close_write()
+            except BaseException:
+                pass
+        if input_channel is not None:
+            input_channel.drain()
+        for ch in all_reads:
+            ch.drain()
+        for group_name, _, _ in plan.get("collective_groups", []):
+            try:
+                cc.destroy_collective_group(group_name)
+            except BaseException:
+                pass
+    return {"iterations": iterations}

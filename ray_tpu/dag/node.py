@@ -1,0 +1,228 @@
+"""DAG node API: bind/execute graphs of actor-method and task calls.
+
+Reference analog: python/ray/dag/ (DAGNode, InputNode, ClassMethodNode,
+MultiOutputNode; CompiledDAG at compiled_dag_node.py:767). Uncompiled
+`execute()` interprets the graph with ordinary task/actor-task submission;
+`experimental_compile()` lowers it onto persistent per-actor loops connected
+by shared-memory channels (see compiled.py) — the pipeline-parallel substrate.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+_node_counter = itertools.count()
+
+
+class DAGNode:
+    def __init__(self, args: Tuple = (), kwargs: Optional[Dict] = None):
+        self.node_id = next(_node_counter)
+        self.args = tuple(args)
+        self.kwargs = dict(kwargs or {})
+
+    # -- traversal ---------------------------------------------------------
+    def upstream(self) -> List["DAGNode"]:
+        out: List[DAGNode] = []
+
+        def walk(x):
+            if isinstance(x, DAGNode):
+                out.append(x)
+            elif isinstance(x, (list, tuple)):
+                for v in x:
+                    walk(v)
+            elif isinstance(x, dict):
+                for v in x.values():
+                    walk(v)
+
+        for a in self.args:
+            walk(a)
+        for v in self.kwargs.values():
+            walk(v)
+        return out
+
+    def topo_sort(self) -> List["DAGNode"]:
+        order: List[DAGNode] = []
+        seen = set()
+
+        def visit(n: DAGNode):
+            if n.node_id in seen:
+                return
+            seen.add(n.node_id)
+            for u in n.upstream():
+                visit(u)
+            order.append(n)
+
+        visit(self)
+        return order
+
+    # -- uncompiled execution ---------------------------------------------
+    def execute(self, *args, **kwargs):
+        """Interpret the DAG once with normal .remote() calls.
+
+        Returns an ObjectRef (or a list of them for MultiOutputNode).
+        """
+        cache: Dict[int, Any] = {}
+        for node in self.topo_sort():
+            cache[node.node_id] = node._eval(cache, args, kwargs)
+        return cache[self.node_id]
+
+    def _eval(self, cache, args, kwargs):
+        raise NotImplementedError
+
+    def _resolve(self, x, cache, args, kwargs, *, top=False):
+        """Replace DAG nodes in an arg structure with their computed values.
+
+        Top-level node results stay as ObjectRefs (dependency resolution
+        happens in the task path); nested ones are fetched to concrete values.
+        """
+        from ray_tpu.core.api import get
+        from ray_tpu.core.object_ref import ObjectRef
+
+        if isinstance(x, DAGNode):
+            v = cache[x.node_id]
+            if not top and isinstance(v, ObjectRef):
+                v = get(v)
+            return v
+        if isinstance(x, (list, tuple)):
+            return type(x)(self._resolve(v, cache, args, kwargs) for v in x)
+        if isinstance(x, dict):
+            return {k: self._resolve(v, cache, args, kwargs) for k, v in x.items()}
+        return x
+
+    def experimental_compile(self, *, buffer_size: int = 2,
+                             submit_timeout: float = 60.0):
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, buffer_size=buffer_size,
+                           submit_timeout=submit_timeout)
+
+
+class InputNode(DAGNode):
+    """The DAG's input placeholder. Usable as a context manager:
+
+        with InputNode() as inp:
+            out = actor.fwd.bind(inp)
+    """
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __getitem__(self, idx) -> "InputAttributeNode":
+        return InputAttributeNode(self, idx)
+
+    def __getattr__(self, key: str) -> "InputAttributeNode":
+        if key.startswith("_") or key in ("node_id", "args", "kwargs"):
+            raise AttributeError(key)
+        return InputAttributeNode(self, key)
+
+    def _eval(self, cache, args, kwargs):
+        if kwargs or len(args) != 1:
+            return (args, kwargs)
+        return args[0]
+
+
+class InputAttributeNode(DAGNode):
+    """input[i] / input.key — selects one argument of execute()."""
+
+    def __init__(self, parent: InputNode, key):
+        super().__init__(args=(parent,))
+        self.key = key
+
+    def _eval(self, cache, args, kwargs):
+        if isinstance(self.key, int):
+            return args[self.key]
+        return kwargs[self.key]
+
+
+class ClassMethodNode(DAGNode):
+    """actor.method.bind(...)"""
+
+    def __init__(self, actor_handle, method_name: str, args, kwargs):
+        super().__init__(args=args, kwargs=kwargs)
+        self.actor = actor_handle
+        self.method_name = method_name
+
+    def _eval(self, cache, args, kwargs):
+        r_args = tuple(self._resolve(a, cache, args, kwargs, top=True)
+                       for a in self.args)
+        r_kwargs = {k: self._resolve(v, cache, args, kwargs, top=True)
+                    for k, v in self.kwargs.items()}
+        method = getattr(self.actor, self.method_name)
+        return method.remote(*r_args, **r_kwargs)
+
+    def __repr__(self):
+        return f"ClassMethodNode({self.actor._class_name}.{self.method_name})"
+
+
+class FunctionNode(DAGNode):
+    """fn.bind(...) for @remote functions (uncompiled execution only)."""
+
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args=args, kwargs=kwargs)
+        self.remote_fn = remote_fn
+
+    def _eval(self, cache, args, kwargs):
+        r_args = tuple(self._resolve(a, cache, args, kwargs, top=True)
+                       for a in self.args)
+        r_kwargs = {k: self._resolve(v, cache, args, kwargs, top=True)
+                    for k, v in self.kwargs.items()}
+        return self.remote_fn.remote(*r_args, **r_kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Bundle several terminal nodes; execute() returns a list."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(args=(list(outputs),))
+        self.outputs = list(outputs)
+
+    def _eval(self, cache, args, kwargs):
+        return [cache[n.node_id] for n in self.outputs]
+
+
+class CollectiveOutputNode(DAGNode):
+    """One participant's output of an in-graph collective (see collective.py)."""
+
+    def __init__(self, coll_id: int, src: DAGNode, participants: List[DAGNode],
+                 reduce_op: str):
+        super().__init__(args=(src,))
+        self.coll_id = coll_id
+        self.src = src
+        self.participants = participants
+        self.reduce_op = reduce_op
+
+    @property
+    def actor(self):
+        if not isinstance(self.src, ClassMethodNode):
+            raise TypeError("collective inputs must be actor-method nodes")
+        return self.src.actor
+
+    def upstream(self) -> List["DAGNode"]:
+        # All participants' sources must be computed before any output of the
+        # collective is (the reduce reads every shard).
+        return [p.src for p in self.participants]
+
+    def _eval(self, cache, args, kwargs):
+        # Uncompiled: driver-mediated reduce, computed once per collective
+        # (cached under the coll_id so N participants don't redo N reads).
+        key = ("coll", self.coll_id)
+        if key not in cache:
+            import numpy as np
+
+            from ray_tpu.core.api import get
+
+            vals = [np.asarray(get(cache[p.src.node_id]))
+                    for p in self.participants]
+            acc = vals[0]
+            for v in vals[1:]:
+                acc = acc + v
+            if self.reduce_op == "mean":
+                acc = acc / len(vals)
+            elif self.reduce_op not in ("sum",):
+                raise ValueError(f"unsupported reduce op {self.reduce_op!r}")
+            cache[key] = acc
+        return cache[key]

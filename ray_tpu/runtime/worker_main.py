@@ -188,7 +188,18 @@ class WorkerRuntime:
         if self.actor_instance is None:
             return {"status": "error",
                     "error": TaskError(spec.name, "no actor instance on this worker")}
-        method = getattr(self.actor_instance, spec.method_name, None)
+        if spec.method_name == "__ray_dag_loop__":
+            # Compiled-graph loop (ray_tpu/dag/executor.py): runs READ ->
+            # COMPUTE -> WRITE iterations against this actor instance until
+            # the input channel delivers a close token.
+            from ray_tpu.dag import executor as dag_executor
+
+            instance = self.actor_instance
+
+            def method(plan):
+                return dag_executor.run_loop(instance, plan)
+        else:
+            method = getattr(self.actor_instance, spec.method_name, None)
         if method is None:
             return {"status": "error",
                     "error": TaskError(

@@ -87,3 +87,46 @@ def test_autoscaler_scales_down_idle(cluster):
     time.sleep(0.8)
     report = autoscaler.reconcile(demand=[])
     assert report["terminated"] >= 1
+
+
+def test_autoscaler_reaps_stuck_boot_and_relaunches(cluster):
+    """An instance that never registers must be reaped after boot_grace_s
+    even while demand persists, and a replacement launched (the phantom
+    LAUNCHING capacity must not suppress the relaunch forever)."""
+
+    class StuckProvider(FakeMultiNodeProvider):
+        def __init__(self, cluster):
+            super().__init__(cluster)
+            self.stuck = True
+            self.terminated = []
+
+        def launch(self, instance_type):
+            if self.stuck:
+                self.stuck = False
+                iid = "stuck-instance"
+                self.nodes[iid] = object()  # never becomes a raylet
+                return iid
+            return super().launch(instance_type)
+
+        def terminate(self, instance_id):
+            self.terminated.append(instance_id)
+            if instance_id == "stuck-instance":
+                self.nodes.pop(instance_id, None)
+                return
+            super().terminate(instance_id)
+
+    provider = StuckProvider(cluster)
+    autoscaler = Autoscaler(
+        provider, [InstanceType("cpu-widget", {"CPU": 2, "widget": 1})],
+        idle_timeout_s=60.0, boot_grace_s=0.5, max_workers=4)
+    demand = [{"widget": 1.0}]
+    r = autoscaler.reconcile(demand=demand)
+    assert r["launched"] == 1  # the stuck instance
+    # While within grace, its phantom capacity suppresses a relaunch.
+    assert autoscaler.reconcile(demand=demand)["launched"] == 0
+    time.sleep(0.6)
+    r = autoscaler.reconcile(demand=demand)
+    assert "stuck-instance" in provider.terminated
+    assert r["launched"] == 1  # replacement
+    cluster.wait_for_nodes(2)
+    assert autoscaler.reconcile(demand=demand)["launched"] == 0
