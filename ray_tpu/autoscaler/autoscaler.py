@@ -158,6 +158,11 @@ class Autoscaler:
             if registered:
                 inst.status = "RUNNING"
                 continue
+            if inst.status != "LAUNCHING":
+                # Previously RUNNING but transiently absent from the alive
+                # table (raylet restart, heartbeat blip): leave it to the
+                # idle-timeout path rather than reaping a busy node here.
+                continue
             if now - inst.launched_at > self.boot_grace_s:
                 logger.warning("instance %s never registered within %.0fs; "
                                "terminating", iid, self.boot_grace_s)

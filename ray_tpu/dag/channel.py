@@ -78,12 +78,17 @@ class ShmChannel:
     # -- writer side --------------------------------------------------------
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
         store = _store()
+        if store.contains(self._closed_oid()):
+            raise ChannelClosed()
         if self._wv >= self.capacity:
             # Ring is full until the reader frees the slot `capacity` back.
             old = self._oid(self._wv - self.capacity)
             deadline = None if timeout is None else time.monotonic() + timeout
             sleep = 0.0002
             while store.contains(old):
+                if store.contains(self._closed_oid()):
+                    # Reader abandoned the channel (its loop died): unwedge.
+                    raise ChannelClosed()
                 if deadline is not None and time.monotonic() >= deadline:
                     raise TimeoutError("channel write backpressure timeout")
                 time.sleep(sleep)
@@ -102,8 +107,34 @@ class ShmChannel:
         store.seal(oid)
         self._wv += 1
 
-    def close_write(self) -> None:
-        self.write(CLOSE)
+    def close_write(self, timeout: Optional[float] = None) -> None:
+        self.write(CLOSE, timeout=timeout)
+
+    def _closed_oid(self) -> bytes:
+        return hashlib.blake2b(
+            self.channel_id + b":closed", digest_size=20).digest()
+
+    def close_read(self) -> None:
+        """Reader-side abandonment: seal a tombstone that makes any blocked or
+        future write raise ChannelClosed, and free already-sealed versions the
+        reader will never consume. Unwedges upstream loops whose consumer died
+        (reference analog: channel close in
+        experimental_mutable_object_manager.*)."""
+        store = _store()
+        oid = self._closed_oid()
+        if not store.contains(oid):
+            try:
+                buf = store.create(oid, 1)
+                buf.release()
+                store.seal(oid)
+            except BaseException:
+                pass
+        # Consume (delete) anything already written but unread.
+        for v in range(self._rv, self._rv + self.capacity + 1):
+            try:
+                store.delete(self._oid(v))
+            except BaseException:
+                pass
 
     # -- reader side --------------------------------------------------------
     def read(self, timeout: Optional[float] = None) -> Any:

@@ -142,6 +142,16 @@ class CompiledDAG:
 
         # collective group membership (rank = participant order)
         for coll_id, members in coll_groups.items():
+            declared = len(members[0].participants)
+            if len(members) != declared:
+                # A collective is a barrier across ALL participants; compiling
+                # a DAG that only routes some of them would silently shrink
+                # the world and produce wrong reductions.
+                raise ValueError(
+                    f"collective group {coll_id} has {declared} participants "
+                    f"but only {len(members)} are reachable from the DAG "
+                    f"output; route every collective output into the DAG "
+                    f"(e.g. via MultiOutputNode)")
             members = sorted(members, key=lambda m: m.participants.index(m))
             name = f"__dag{self.uid}_cc{coll_id}"
             world = len(members)
@@ -206,24 +216,43 @@ class CompiledDAG:
         return vals[0] if self._single_output else vals
 
     def _raise_loop_error(self):
-        """A loop died: unwind the rest of the pipeline, surface its error."""
-        from ray_tpu.core.api import get
+        """A loop died: unwind the rest of the pipeline, surface its error.
+
+        Order matters: abandon the output channels first (reader tombstones
+        unwedge loops blocked writing to the driver), then close inputs, then
+        collect loop results — preferring a real task error from a finished
+        loop over a timeout from one still unwinding."""
+        from ray_tpu.core.api import get, wait
+        from ray_tpu.core.exceptions import GetTimeoutError
 
         self._torn_down = True
-        for ch in self._input_channels:
+        for ch in self._output_channels:
             try:
-                ch.close_write()
+                ch.close_read()
             except BaseException:
                 pass
-        first_error = None
-        for ref in self._loop_refs:
+        for ch in self._input_channels:
             try:
-                get(ref, timeout=30)
+                ch.close_write(timeout=5)
+            except BaseException:
+                pass
+        task_error = None
+        timeout_error = None
+        ready, _ = wait(list(self._loop_refs),
+                        num_returns=len(self._loop_refs), timeout=30)
+        for ref in list(ready) + [r for r in self._loop_refs
+                                  if r not in ready]:
+            try:
+                get(ref, timeout=5)
+            except GetTimeoutError as e:
+                timeout_error = timeout_error or e
             except BaseException as e:  # noqa: BLE001 — surface the task error
-                if first_error is None:
-                    first_error = e
-        if first_error is not None:
-            raise first_error
+                if task_error is None:
+                    task_error = e
+        if task_error is not None:
+            raise task_error
+        if timeout_error is not None:
+            raise timeout_error
 
     def teardown(self):
         if self._torn_down:
@@ -231,7 +260,7 @@ class CompiledDAG:
         self._torn_down = True
         for ch in self._input_channels:
             try:
-                ch.close_write()
+                ch.close_write(timeout=10)
             except BaseException:
                 pass
         # Drain each output channel to its close token so the loops can flush

@@ -113,10 +113,22 @@ def run_loop(actor_instance, plan: dict) -> dict:
         logger.exception("compiled DAG loop failed after %d iterations", iterations)
         raise
     finally:
-        # Propagate shutdown downstream so the whole pipeline unwinds.
+        # Propagate shutdown both ways so the whole pipeline unwinds:
+        # downstream sees CLOSE; upstream writers blocked on our full read
+        # channels see the reader tombstone and raise ChannelClosed.
+        for ch in all_reads:
+            try:
+                ch.close_read()
+            except BaseException:
+                pass
+        if input_channel is not None:
+            try:
+                input_channel.close_read()
+            except BaseException:
+                pass
         for ch in all_writes:
             try:
-                ch.close_write()
+                ch.close_write(timeout=10)
             except BaseException:
                 pass
         if input_channel is not None:

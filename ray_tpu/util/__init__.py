@@ -1,3 +1,5 @@
+from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
+from ray_tpu.util.queue import Empty, Full, Queue  # noqa: F401
 from ray_tpu.core.placement_group import (  # noqa: F401
     PACK,
     SPREAD,

@@ -1,0 +1,123 @@
+"""ActorPool: load-balance a stream of method calls over a fixed actor set.
+
+Reference analog: python/ray/util/actor_pool.py (same public surface:
+map / map_unordered / submit / get_next / get_next_unordered / push / pop_idle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, Optional, TypeVar
+
+import ray_tpu
+
+V = TypeVar("V")
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle_actors: List[Any] = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    def map(self, fn: Callable[[Any, V], Any], values: Iterable[V]) -> Iterator[Any]:
+        """Ordered map over values; yields results as they become ready in order."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, V], Any],
+                      values: Iterable[V]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def submit(self, fn: Callable[[Any, V], Any], value: V):
+        """fn(actor, value) must return an ObjectRef (call a .remote method)."""
+        if self._idle_actors:
+            actor = self._idle_actors.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def _return_actor(self, actor):
+        self._idle_actors.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in submission order. A timeout leaves the pool state
+        untouched; a task error is raised only after its actor is recycled."""
+        if not self.has_next():
+            raise StopIteration("no more results")
+        idx = self._next_return_index
+        # The future for idx may not exist yet if its submit is still pending.
+        while idx not in self._index_to_future:
+            self._drain_one(timeout)
+        future = self._index_to_future[idx]
+        ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("timed out waiting for result")
+        del self._index_to_future[idx]
+        self._next_return_index += 1
+        _, actor = self._future_to_actor.pop(future)
+        if actor is not None:
+            self._return_actor(actor)
+        return ray_tpu.get(future)
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Next result in completion order."""
+        if not self.has_next():
+            raise StopIteration("no more results")
+        while not self._future_to_actor:
+            self._drain_one(timeout)
+        ready, _ = ray_tpu.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("timed out waiting for result")
+        future = ready[0]
+        idx, actor = self._future_to_actor.pop(future)
+        del self._index_to_future[idx]
+        if actor is not None:
+            self._return_actor(actor)
+        return ray_tpu.get(future)
+
+    def _drain_one(self, timeout: Optional[float]):
+        """Wait for any still-running task to finish and recycle its actor,
+        keeping its result future around for ordered retrieval."""
+        running = [f for f, (_, a) in self._future_to_actor.items()
+                   if a is not None]
+        if not running:
+            raise RuntimeError("pool has pending submits but no running tasks")
+        ready, _ = ray_tpu.wait(running, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("timed out waiting for an actor to free up")
+        future = ready[0]
+        idx, actor = self._future_to_actor[future]
+        self._future_to_actor[future] = (idx, None)
+        self._return_actor(actor)
+
+    def push(self, actor: Any):
+        """Add a new idle actor to the pool."""
+        busy = {a for _, a in self._future_to_actor.values()}
+        if actor in self._idle_actors or actor in busy:
+            raise ValueError("actor already in pool")
+        self._return_actor(actor)
+
+    def pop_idle(self) -> Optional[Any]:
+        if self._idle_actors:
+            return self._idle_actors.pop()
+        return None
+
+    def has_free(self) -> bool:
+        return bool(self._idle_actors) and not self._pending_submits

@@ -1,0 +1,62 @@
+"""joblib parallel backend running jobs as ray_tpu tasks.
+
+Reference analog: python/ray/util/joblib/ (register_ray +
+ray_backend.RayBackend subclassing joblib's MultiprocessingBackend).
+Usage::
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        Parallel(n_jobs=8)(delayed(f)(i) for i in range(100))
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+
+try:
+    from joblib._parallel_backends import ThreadingBackend
+    from joblib.parallel import register_parallel_backend
+    _HAVE_JOBLIB = True
+except Exception:  # pragma: no cover - joblib always in the image, but gate anyway
+    ThreadingBackend = object
+    _HAVE_JOBLIB = False
+
+
+class RayTpuBackend(ThreadingBackend):
+    """Each joblib batch becomes one ray_tpu task; joblib's own threads just
+    block on ray_tpu.get, so n_jobs concurrency maps to cluster concurrency."""
+
+    supports_timeout = True
+
+    def configure(self, n_jobs=1, parallel=None, **backend_args):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._remote_args = dict(backend_args.get("ray_remote_args", {}))
+        return super().configure(n_jobs=n_jobs, parallel=parallel)
+
+    def effective_n_jobs(self, n_jobs):
+        if n_jobs == -1:
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            return max(int(ray_tpu.cluster_resources().get("CPU", 1)), 1)
+        return super().effective_n_jobs(n_jobs)
+
+    def apply_async(self, func, callback=None):
+        def run_remote():
+            fn = ray_tpu.remote(_call_batch)
+            if self._remote_args:
+                fn = fn.options(**self._remote_args)
+            return ray_tpu.get(fn.remote(func))
+
+        return self._get_pool().apply_async(run_remote, callback=callback)
+
+
+def _call_batch(batch):
+    return batch()
+
+
+def register_ray_tpu():
+    if not _HAVE_JOBLIB:
+        raise ImportError("joblib is not available")
+    register_parallel_backend("ray_tpu", RayTpuBackend)

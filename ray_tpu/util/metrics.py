@@ -1,0 +1,198 @@
+"""User-defined application metrics: Counter / Gauge / Histogram.
+
+Reference analog: python/ray/util/metrics.py (Counter/Gauge/Histogram feeding
+the node metrics agent, exported to Prometheus by
+_private/metrics_agent.py / _private/prometheus_exporter.py).
+
+TPU build: each process keeps an in-process registry; snapshots are pushed
+to the GCS KV under ``metrics:<pid>`` (throttled), where the dashboard /
+``ray_tpu.state.metrics_snapshot`` aggregates them and renders Prometheus
+text exposition format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_REGISTRY: Dict[str, "Metric"] = {}
+_REGISTRY_LOCK = threading.Lock()
+_FLUSH_INTERVAL_S = float(os.environ.get("RAY_TPU_METRICS_FLUSH_S", "1.0"))
+_last_flush = 0.0
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> str:
+    return json.dumps(sorted((tags or {}).items()))
+
+
+class Metric:
+    """Base class; subclasses define how observations fold into the value."""
+
+    TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        if not name:
+            raise ValueError("metric name is required")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        with _REGISTRY_LOCK:
+            _REGISTRY[name] = self
+
+    @property
+    def info(self) -> Dict:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys, "default_tags": self._default_tags}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        for k in tags:
+            if k not in self._tag_keys:
+                raise ValueError(f"unknown tag key {k!r} (declared: {self._tag_keys})")
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        return merged
+
+    def _observe(self, value: float, tags: Optional[Dict[str, str]]):
+        raise NotImplementedError
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"name": self._name, "type": self.TYPE,
+                    "description": self._description,
+                    "values": dict(self._values)}
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value <= 0:
+            raise ValueError("Counter.inc requires a positive value")
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+        _maybe_flush()
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = float(value)
+        _maybe_flush()
+
+
+class Histogram(Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = sorted(boundaries or [0.1, 1.0, 10.0])
+        # per tag-set: [bucket counts..., +Inf count], sum, count
+        self._hist: Dict[str, Dict] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            h = self._hist.setdefault(
+                key, {"buckets": [0] * (len(self._boundaries) + 1),
+                      "sum": 0.0, "count": 0})
+            idx = len(self._boundaries)
+            for i, b in enumerate(self._boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            h["buckets"][idx] += 1
+            h["sum"] += value
+            h["count"] += 1
+            self._values[key] = h["sum"] / max(h["count"], 1)
+        _maybe_flush()
+
+    def snapshot(self) -> Dict:
+        snap = super().snapshot()
+        with self._lock:
+            snap["boundaries"] = list(self._boundaries)
+            snap["histograms"] = {k: dict(v) for k, v in self._hist.items()}
+        return snap
+
+
+def snapshot_all() -> List[Dict]:
+    with _REGISTRY_LOCK:
+        metrics = list(_REGISTRY.values())
+    return [m.snapshot() for m in metrics]
+
+
+def _maybe_flush():
+    """Throttled push of this process's metrics to the GCS KV."""
+    global _last_flush
+    now = time.monotonic()
+    if now - _last_flush < _FLUSH_INTERVAL_S:
+        return
+    _last_flush = now
+    try:
+        flush()
+    except Exception:
+        pass  # metrics must never break the application
+
+
+def flush():
+    from ray_tpu.core import worker as worker_mod
+
+    if not worker_mod.is_initialized():
+        return
+    core = worker_mod.global_worker()
+    node = core.node_id.hex() if getattr(core, "node_id", None) else "unknown"
+    key = f"metrics:{node}:{os.getpid()}".encode()
+    payload = json.dumps(snapshot_all()).encode()
+    core.io.run(core.gcs.call("kv_put", key=key, value=payload))
+
+
+def prometheus_text(snapshots: List[Dict]) -> str:
+    """Render metric snapshots in Prometheus text exposition format
+    (the _private/prometheus_exporter.py analog)."""
+    lines = []
+    for snap in snapshots:
+        name = snap["name"].replace(".", "_").replace("-", "_")
+        if snap.get("description"):
+            lines.append(f"# HELP {name} {snap['description']}")
+        lines.append(f"# TYPE {name} {snap['type']}")
+        if snap["type"] == "histogram":
+            for key, h in snap.get("histograms", {}).items():
+                labels = dict(json.loads(key))
+                cumulative = 0
+                for b, c in zip(snap["boundaries"], h["buckets"]):
+                    cumulative += c
+                    lab = _fmt_labels({**labels, "le": str(b)})
+                    lines.append(f"{name}_bucket{lab} {cumulative}")
+                cumulative += h["buckets"][-1]
+                lab = _fmt_labels({**labels, "le": "+Inf"})
+                lines.append(f"{name}_bucket{lab} {cumulative}")
+                lines.append(f"{name}_sum{_fmt_labels(labels)} {h['sum']}")
+                lines.append(f"{name}_count{_fmt_labels(labels)} {h['count']}")
+        else:
+            for key, v in snap["values"].items():
+                labels = dict(json.loads(key))
+                lines.append(f"{name}{_fmt_labels(labels)} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"

@@ -1,0 +1,157 @@
+"""Drop-in ``multiprocessing.Pool`` replacement over ray_tpu actors.
+
+Reference analog: python/ray/util/multiprocessing/pool.py (Pool with
+map/starmap/apply + async variants, imap/imap_unordered, distributed over
+actor processes instead of local fork).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+
+
+class _PoolWorker:
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_batch(self, fn, batch, star: bool):
+        if star:
+            return [fn(*item) for item in batch]
+        return [fn(item) for item in batch]
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any]):
+        self._refs = refs
+
+    def get(self, timeout: Optional[float] = None) -> List[Any]:
+        batches = ray_tpu.get(self._refs, timeout=timeout)
+        return list(itertools.chain.from_iterable(batches))
+
+    def wait(self, timeout: Optional[float] = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Process pool over cluster actors; chunks work like multiprocessing."""
+
+    def __init__(self, processes: Optional[int] = None, initializer=None,
+                 initargs=(), ray_remote_args: Optional[dict] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            processes = max(int(ray_tpu.cluster_resources().get("CPU", 1)), 1)
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._processes = processes
+        opts = dict(ray_remote_args or {})
+        opts.setdefault("num_cpus", 1)
+        cls = ray_tpu.remote(_PoolWorker)
+        self._actors = [cls.options(**opts).remote(initializer, initargs)
+                        for _ in range(processes)]
+        self._closed = False
+        self._next_apply = 0
+
+    def _check(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    @staticmethod
+    def _chunks(items: List[Any], chunksize: int) -> List[List[Any]]:
+        return [items[i:i + chunksize] for i in range(0, len(items), chunksize)]
+
+    def _default_chunksize(self, n: int) -> int:
+        chunks_per_worker = 4
+        return max(1, n // (self._processes * chunks_per_worker))
+
+    def _run(self, fn: Callable, items: List[Any], chunksize: Optional[int],
+             star: bool) -> AsyncResult:
+        self._check()
+        chunksize = chunksize or self._default_chunksize(len(items))
+        refs = []
+        for i, batch in enumerate(self._chunks(items, chunksize)):
+            actor = self._actors[i % self._processes]
+            refs.append(actor.run_batch.remote(fn, batch, star))
+        return AsyncResult(refs)
+
+    def apply(self, fn: Callable, args=(), kwds=None) -> Any:
+        return self.apply_async(fn, args, kwds).get()[0]
+
+    def apply_async(self, fn: Callable, args=(), kwds=None) -> AsyncResult:
+        self._check()
+        kwds = kwds or {}
+        actor = self._actors[self._next_apply % self._processes]
+        self._next_apply += 1
+        wrapped = _bind(fn, kwds)
+        return AsyncResult([actor.run_batch.remote(wrapped, [tuple(args)], True)])
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self._run(fn, list(iterable), chunksize, star=False).get()
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        return self._run(fn, list(iterable), chunksize, star=False)
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List[Any]:
+        return self._run(fn, list(iterable), chunksize, star=True).get()
+
+    def starmap_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        return self._run(fn, list(iterable), chunksize, star=True)
+
+    def imap(self, fn: Callable, iterable: Iterable, chunksize: int = 1):
+        self._check()
+        pool = ActorPool(self._actors)
+        batches = self._chunks(list(iterable), chunksize)
+        for out in pool.map(
+                lambda a, b: a.run_batch.remote(fn, b, False), batches):
+            yield from out
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable, chunksize: int = 1):
+        self._check()
+        pool = ActorPool(self._actors)
+        batches = self._chunks(list(iterable), chunksize)
+        for out in pool.map_unordered(
+                lambda a, b: a.run_batch.remote(fn, b, False), batches):
+            yield from out
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self.close()
+        for a in self._actors:
+            ray_tpu.kill(a)
+        self._actors = []
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool.join() before close()")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+
+
+def _bind(fn, kwds):
+    def wrapped(*args):
+        return fn(*args, **kwds)
+    return wrapped

@@ -1,0 +1,134 @@
+"""Distributed FIFO queue backed by a detached actor.
+
+Reference analog: python/ray/util/queue.py (Queue wrapping an _QueueActor).
+The TPU build keeps the same shape: a plain asyncio-free actor holds a
+collections.deque; Queue methods are thin RPCs against it, so any worker in
+the cluster can share one queue by name.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int = 0):
+        from collections import deque
+
+        self._maxsize = maxsize
+        self._items = deque()
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def empty(self) -> bool:
+        return not self._items
+
+    def full(self) -> bool:
+        return self._maxsize > 0 and len(self._items) >= self._maxsize
+
+    def put(self, item) -> bool:
+        if self.full():
+            return False
+        self._items.append(item)
+        return True
+
+    def put_batch(self, items) -> int:
+        n = 0
+        for item in items:
+            if not self.put(item):
+                break
+            n += 1
+        return n
+
+    def get(self):
+        if not self._items:
+            return False, None
+        return True, self._items.popleft()
+
+    def get_batch(self, n: int):
+        out = []
+        while self._items and len(out) < n:
+            out.append(self._items.popleft())
+        return out
+
+
+class Queue:
+    """FIFO queue usable from any driver/worker/actor in the cluster."""
+
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        cls = ray_tpu.remote(_QueueActor)
+        self._actor = cls.options(**opts).remote(maxsize)
+        self._maxsize = maxsize
+
+    def __reduce__(self):
+        return (_rebuild_queue, (self._actor, self._maxsize))
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self._actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self._actor.full.remote())
+
+    def put(self, item: Any, block: bool = True, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self._actor.put.remote(item)):
+                return
+            if not block:
+                raise Full()
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full()
+            time.sleep(0.01)
+
+    def put_nowait(self, item: Any):
+        self.put(item, block=False)
+
+    def put_nowait_batch(self, items: List[Any]):
+        n = ray_tpu.get(self._actor.put_batch.remote(list(items)))
+        if n < len(items):
+            raise Full(f"queue accepted only {n}/{len(items)} items")
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self._actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty()
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Empty()
+            time.sleep(0.01)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        return ray_tpu.get(self._actor.get_batch.remote(num_items))
+
+    def shutdown(self, force: bool = True):
+        ray_tpu.kill(self._actor, no_restart=True)
+
+
+def _rebuild_queue(actor, maxsize):
+    q = Queue.__new__(Queue)
+    q._actor = actor
+    q._maxsize = maxsize
+    return q

@@ -1,0 +1,133 @@
+"""Tests for L4 util primitives: Queue, ActorPool, metrics, mp Pool, joblib.
+
+Reference test model: python/ray/tests/test_queue.py, test_actor_pool.py,
+test_metrics_agent.py, util/multiprocessing tests.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, Full, Queue
+from ray_tpu.util import metrics as metrics_mod
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_queue_fifo(cluster):
+    q = Queue()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5
+    assert [q.get() for _ in range(5)] == list(range(5))
+    assert q.empty()
+
+
+def test_queue_nowait_and_maxsize(cluster):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait(3)
+    assert q.get_nowait() == 1
+    with pytest.raises(Empty):
+        Queue().get_nowait()
+
+
+def test_queue_shared_across_tasks(cluster):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    assert ray_tpu.get(producer.remote(q, 10), timeout=60) == 10
+    assert sorted(q.get_nowait_batch(10)) == list(range(10))
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_ordered(cluster):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * i for i in range(8)]
+
+
+def test_actor_pool_unordered(cluster):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = list(pool.map_unordered(lambda a, v: a.double.remote(v), range(8)))
+    assert sorted(out) == [2 * i for i in range(8)]
+
+
+def test_actor_pool_push_pop(cluster):
+    a = _Doubler.remote()
+    pool = ActorPool([])
+    pool.push(a)
+    assert pool.has_free()
+    popped = pool.pop_idle()
+    assert popped is a
+
+
+def test_metrics_counter_gauge_histogram(cluster):
+    c = metrics_mod.Counter("test_requests", "desc", tag_keys=("route",))
+    c.inc(2.0, tags={"route": "/a"})
+    c.inc(3.0, tags={"route": "/a"})
+    g = metrics_mod.Gauge("test_inflight")
+    g.set(7.0)
+    h = metrics_mod.Histogram("test_latency", boundaries=[1.0, 10.0])
+    h.observe(0.5)
+    h.observe(5.0)
+    h.observe(50.0)
+
+    snaps = {s["name"]: s for s in metrics_mod.snapshot_all()}
+    assert list(snaps["test_requests"]["values"].values()) == [5.0]
+    assert list(snaps["test_inflight"]["values"].values()) == [7.0]
+    hist = list(snaps["test_latency"]["histograms"].values())[0]
+    assert hist["count"] == 3 and hist["buckets"] == [1, 1, 1]
+
+    text = metrics_mod.prometheus_text(list(snaps.values()))
+    assert 'test_requests{route="/a"} 5.0' in text
+    assert "test_latency_bucket" in text and 'le="+Inf"' in text
+
+
+def test_multiprocessing_pool(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as p:
+        assert p.map(_square, range(10)) == [i * i for i in range(10)]
+        assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+        assert p.apply(_add, (5, 6)) == 11
+        r = p.map_async(_square, range(4))
+        assert r.get(timeout=60) == [0, 1, 4, 9]
+        assert sorted(p.imap_unordered(_square, range(6))) == [
+            i * i for i in range(6)]
+
+
+def _square(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_joblib_backend(cluster):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=2)(
+            joblib.delayed(_square)(i) for i in range(6))
+    assert out == [i * i for i in range(6)]
