@@ -11,6 +11,7 @@ from typing import Any, Dict, Optional
 from ray_tpu.core import worker as worker_mod
 from ray_tpu.core.task_spec import ActorSpec
 from ray_tpu.runtime.scheduling import PlacementGroupStrategy
+from ray_tpu.runtime_env import prepare_runtime_env
 from ray_tpu.utils.ids import ActorID
 
 
@@ -67,7 +68,8 @@ class ActorClass:
                  resources: Optional[Dict[str, float]] = None, max_restarts: int = 0,
                  max_task_retries: int = 0, max_concurrency: int = 1,
                  name: Optional[str] = None, namespace: str = "default",
-                 lifetime: Optional[str] = None, scheduling_strategy=None):
+                 lifetime: Optional[str] = None, scheduling_strategy=None,
+                 runtime_env: Optional[dict] = None):
         self._cls = cls
         self._num_cpus = num_cpus
         self._num_tpus = num_tpus
@@ -79,6 +81,7 @@ class ActorClass:
         self._namespace = namespace
         self._lifetime = lifetime
         self._scheduling_strategy = scheduling_strategy
+        self._runtime_env = runtime_env
 
     def options(self, **overrides) -> "ActorClass":
         kw = dict(num_cpus=self._num_cpus, num_tpus=self._num_tpus,
@@ -86,7 +89,8 @@ class ActorClass:
                   max_task_retries=self._max_task_retries,
                   max_concurrency=self._max_concurrency, name=self._name,
                   namespace=self._namespace, lifetime=self._lifetime,
-                  scheduling_strategy=self._scheduling_strategy)
+                  scheduling_strategy=self._scheduling_strategy,
+                  runtime_env=self._runtime_env)
         kw.update(overrides)
         return ActorClass(self._cls, **kw)
 
@@ -115,7 +119,9 @@ class ActorClass:
             max_task_retries=self._max_task_retries,
             max_concurrency=self._max_concurrency,
             scheduling_strategy=strategy, placement_group_id=pg_id,
-            placement_group_bundle_index=bundle_index, namespace=self._namespace)
+            placement_group_bundle_index=bundle_index, namespace=self._namespace,
+            runtime_env=prepare_runtime_env(
+                core, core.merge_job_env(self._runtime_env)))
         reply = core.create_actor(spec)
         if not reply.get("ok"):
             raise RuntimeError(f"actor creation failed: {reply.get('error')}")

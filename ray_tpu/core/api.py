@@ -27,15 +27,26 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
          object_store_memory: int = 2 << 30,
          labels: Optional[Dict[str, str]] = None,
          worker_env: Optional[Dict[str, str]] = None,
+         runtime_env: Optional[dict] = None,
+         include_dashboard: Optional[bool] = None,
+         dashboard_port: int = 0,
          ignore_reinit_error: bool = False) -> "RuntimeContext":
     """Start a local cluster (default) or connect to an existing one
-    (address="host:port" of its GCS)."""
+    (address="host:port" of its GCS, or the RAY_TPU_ADDRESS env var set by
+    the job-submission entrypoint runner)."""
     global _head
     if worker_mod.is_initialized():
         if ignore_reinit_error:
             return RuntimeContext()
         raise RuntimeError("ray_tpu.init() already called (use ignore_reinit_error)")
 
+    if address is None:
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
+    if address == "auto":
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
+        if address is None:
+            raise RuntimeError(
+                'init(address="auto") but RAY_TPU_ADDRESS is not set')
     if address is None:
         session_dir = node_mod.new_session_dir()
         processes = node_mod.NodeProcesses(session_dir)
@@ -95,7 +106,30 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
             session_dir=os.path.dirname(head["object_store_path"]),
             node_id=head["node_id"])
     core.job_id = core.io.run(core.gcs.call("register_job"))["job_id"]
+    if runtime_env:
+        from ray_tpu.runtime_env import prepare_runtime_env
+
+        core.job_runtime_env = prepare_runtime_env(core, dict(runtime_env))
     worker_mod.set_global_worker(core)
+    if include_dashboard is None:
+        include_dashboard = (os.environ.get("RAY_TPU_INCLUDE_DASHBOARD") == "1"
+                             and _head is not None)
+    if include_dashboard and _head is not None:
+        try:
+            _head.dashboard_proc, _head.dashboard_url = node_mod.start_dashboard(
+                _head.session_dir, _head.gcs_address, port=dashboard_port)
+            core.io.run(core.gcs.call(
+                "kv_put", key=b"dashboard_url",
+                value=_head.dashboard_url.encode()))
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning("dashboard failed to start: %s", e)
+    from ray_tpu.runtime.log_monitor import attach_driver_log_stream
+    from ray_tpu.util import usage_stats
+
+    attach_driver_log_stream(core)
+    usage_stats.write_report(core.session_dir)
     atexit.register(_atexit_shutdown)
     return RuntimeContext()
 
@@ -115,6 +149,11 @@ def shutdown():
         core.shutdown(kill_cluster=_head is not None)
         worker_mod.set_global_worker(None)
     if _head is not None:
+        if _head.dashboard_proc is not None:
+            try:
+                _head.dashboard_proc.kill()
+            except Exception:
+                pass
         for proc in (_head.raylet_proc, _head.gcs_proc):
             if proc is not None:
                 try:
@@ -143,11 +182,11 @@ def remote(*args, **kwargs):
         if isinstance(target, type):
             allowed = {"num_cpus", "num_tpus", "resources", "max_restarts",
                        "max_task_retries", "max_concurrency", "name", "namespace",
-                       "lifetime", "scheduling_strategy"}
+                       "lifetime", "scheduling_strategy", "runtime_env"}
             opts = {k: v for k, v in kwargs.items() if k in allowed}
             return ActorClass(target, **opts)
         allowed = {"num_returns", "num_cpus", "num_tpus", "resources",
-                   "max_retries", "scheduling_strategy"}
+                   "max_retries", "scheduling_strategy", "runtime_env"}
         opts = {k: v for k, v in kwargs.items() if k in allowed}
         return RemoteFunction(target, **opts)
 
@@ -193,6 +232,13 @@ class RuntimeContext:
     @property
     def current_actor_id(self):
         return worker_mod.global_worker().current_actor_id
+
+    @property
+    def dashboard_url(self):
+        core = worker_mod.global_worker()
+        reply = core.io.run(core.gcs.call("kv_get", key=b"dashboard_url"))
+        blob = reply.get("value")
+        return blob.decode() if blob else None
 
 
 def get_runtime_context() -> RuntimeContext:

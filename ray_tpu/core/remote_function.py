@@ -17,7 +17,8 @@ DEFAULT_MAX_RETRIES = 3
 class RemoteFunction:
     def __init__(self, fn, *, num_returns: int = 1, num_cpus: float = 1.0,
                  num_tpus: float = 0.0, resources: Optional[Dict[str, float]] = None,
-                 max_retries: int = DEFAULT_MAX_RETRIES, scheduling_strategy=None):
+                 max_retries: int = DEFAULT_MAX_RETRIES, scheduling_strategy=None,
+                 runtime_env: Optional[dict] = None):
         self._fn = fn
         self._num_returns = num_returns
         self._num_cpus = num_cpus
@@ -25,6 +26,7 @@ class RemoteFunction:
         self._resources = dict(resources or {})
         self._max_retries = max_retries
         self._scheduling_strategy = scheduling_strategy
+        self._runtime_env = runtime_env
         functools.update_wrapper(self, fn)
 
     def options(self, **overrides) -> "RemoteFunction":
@@ -32,7 +34,8 @@ class RemoteFunction:
             num_returns=self._num_returns, num_cpus=self._num_cpus,
             num_tpus=self._num_tpus, resources=dict(self._resources),
             max_retries=self._max_retries,
-            scheduling_strategy=self._scheduling_strategy)
+            scheduling_strategy=self._scheduling_strategy,
+            runtime_env=self._runtime_env)
         kw.update(overrides)
         return RemoteFunction(self._fn, **kw)
 
@@ -58,7 +61,8 @@ class RemoteFunction:
             resources=self._resource_demand(),
             max_retries=self._max_retries,
             scheduling_strategy=strategy,
-            placement_group_id=pg_id, bundle_index=bundle_index)
+            placement_group_id=pg_id, bundle_index=bundle_index,
+            runtime_env=self._runtime_env)
         return refs[0] if self._num_returns == 1 else refs
 
     def bind(self, *args, **kwargs):

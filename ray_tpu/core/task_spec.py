@@ -32,6 +32,7 @@ class TaskSpec:
     scheduling_strategy: Any = None
     placement_group_id: Optional[bytes] = None
     placement_group_bundle_index: int = -1
+    runtime_env: Optional[dict] = None
 
 
 @dataclass
@@ -50,3 +51,4 @@ class ActorSpec:
     placement_group_id: Optional[bytes] = None
     placement_group_bundle_index: int = -1
     namespace: str = "default"
+    runtime_env: Optional[dict] = None

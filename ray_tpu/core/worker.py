@@ -31,6 +31,8 @@ from ray_tpu.core.exceptions import (
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import ActorSpec, TaskSpec
 from ray_tpu.runtime.object_store import ObjectNotFoundError, ObjectStore
+from ray_tpu.runtime.object_store.spill import SpillManager
+from ray_tpu.runtime.object_store.store import StoreFullError
 from ray_tpu.runtime.rpc import ConnectionLost, EventLoopThread, RpcClient
 from ray_tpu.utils.ids import ObjectID, TaskID
 
@@ -75,6 +77,9 @@ class CoreWorker:
         self.raylet = (self.io.run(self._connect(raylet_address))
                        if raylet_address else None)
         self.store = ObjectStore(store_path, create=False) if store_path else None
+        self.spill = (SpillManager(self.store, os.path.join(session_dir, "spill"))
+                      if self.store is not None else None)
+        self._node_addrs: Dict[bytes, Tuple[str, int]] = {}  # node_id -> raylet addr
         self.memory_store: Dict[bytes, Any] = {}      # oid -> deserialized value
         self._object_locations: Dict[bytes, bytes] = {}  # oid -> node_id (plasma results)
         self.result_futures: Dict[bytes, SyncFuture] = {}
@@ -84,9 +89,11 @@ class CoreWorker:
         self._raylet_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._actor_clients: Dict[bytes, "_ActorClient"] = {}
         self._put_refs: set = set()                   # plasma ids this process created
+        self._lineage: Dict[bytes, dict] = {}         # return oid -> lineage record
         self.current_actor_id: Optional[bytes] = None
         self.current_task_name: Optional[str] = None
         self.job_id = None
+        self.job_runtime_env: Optional[dict] = None   # init(runtime_env=...)
 
     @staticmethod
     async def _connect(addr):
@@ -112,9 +119,15 @@ class CoreWorker:
         self._put_refs.add(oid)
         return ObjectRef(oid, owner=self.node_id)
 
+    def spill_create(self, oid: bytes, size: int, metadata: bytes = b"") -> memoryview:
+        """store.create with spill-before-evict when a spill dir is available."""
+        if self.spill is not None:
+            return self.spill.create_with_spill(oid, size, metadata)
+        return self._require_store().create(oid, size, metadata)
+
     def _write_segments_to_plasma(self, oid: bytes, segments, total: int):
         store = self._require_store()
-        buf = store.create(oid, total)
+        buf = self.spill_create(oid, total)
         try:
             serialization.write_segments(buf, segments)
         except BaseException:
@@ -147,22 +160,104 @@ class CoreWorker:
                 if oid in self.memory_store:
                     return self._raise_if_error(self.memory_store[oid])
             # fell through: result is in plasma
-        location = self._object_locations.get(oid)
-        if location is not None and self.node_id is not None and location != self.node_id:
-            # Result lives in another node's store; the pull protocol lands
-            # with the object manager (M4). Fail loudly instead of hanging.
-            raise ObjectLostError(
-                f"object {ref} lives on node {location.hex()[:12]}; cross-node "
-                "object transfer is not available on this cluster")
-        store = self._require_store()
         try:
-            buf = store.get(oid, timeout=timeout if timeout is not None else None)
+            value = self._get_plasma_value(oid, ref.owner, timeout)
         except ObjectNotFoundError:
             raise GetTimeoutError(f"get() timed out waiting for {ref}")
-        # `pin=buf` keeps the store read reference alive for as long as any
-        # zero-copy array deserialized out of this payload is.
-        value = serialization.deserialize(buf.data, pin=buf)
+        except ObjectLostError:
+            # Lineage reconstruction: re-execute the producing task, then
+            # re-enter the full read path (the new result may be inline).
+            if not self._reconstruct(oid, timeout):
+                raise
+            return self.get_one(ref, timeout)
         return self._raise_if_error(value)
+
+    PULL_CHUNK = 4 << 20
+
+    def _get_plasma_value(self, oid: bytes, owner: Optional[bytes],
+                          timeout: Optional[float]) -> Any:
+        """Plasma read path: local shm store -> local spill dir -> remote pull
+        from the object's location (ObjectManager pull protocol analog,
+        object_manager.proto:60; ours is chunked raylet RPC over the control
+        plane since tensors ride XLA collectives, not the object plane)."""
+        location = self._object_locations.get(oid) or owner
+        remote = (location is not None and self.node_id is not None
+                  and location != self.node_id)
+        store = self.store
+        if store is not None:
+            # With a remote fallback available, don't burn the whole timeout
+            # waiting for a local appearance that will never happen.
+            local_timeout = 0.05 if remote else timeout
+            try:
+                buf = store.get(oid, timeout=local_timeout)
+                # `pin=buf` keeps the store read reference alive for as long
+                # as any zero-copy array deserialized out of this payload is.
+                return serialization.deserialize(buf.data, pin=buf)
+            except ObjectNotFoundError:
+                pass
+            if self.spill is not None and self.spill.restore(oid):
+                buf = store.get(oid, timeout=5)
+                return serialization.deserialize(buf.data, pin=buf)
+        if (remote or store is None) and location is not None:
+            data = self._pull_remote(oid, location)
+            if store is not None:
+                # Cache locally so repeated gets are zero-copy shm reads.
+                try:
+                    view = self.spill_create(oid, len(data))
+                    view[:] = data
+                    view.release()
+                    store.seal(oid)
+                    buf = store.get(oid, timeout=5)
+                    return serialization.deserialize(buf.data, pin=buf)
+                except (ValueError, StoreFullError, ObjectNotFoundError):
+                    pass  # concurrent create/restore or no room: use the copy
+            return serialization.deserialize(memoryview(data))
+        raise ObjectNotFoundError(oid.hex())
+
+    def _node_address(self, node_id: bytes) -> Optional[Tuple[str, int]]:
+        addr = self._node_addrs.get(node_id)
+        if addr is not None:
+            return addr
+        for n in self.io.run(self.gcs.call("get_nodes")):
+            nid = n["node_id"]
+            if isinstance(nid, str):
+                nid = bytes.fromhex(nid)
+            self._node_addrs[nid] = tuple(n["address"])
+        return self._node_addrs.get(node_id)
+
+    def _pull_remote(self, oid: bytes, node_id: bytes) -> bytes:
+        """Chunked pull of a sealed object from another node's raylet."""
+        addr = self._node_address(node_id)
+        if addr is None:
+            raise ObjectLostError(
+                f"object {oid.hex()[:12]} lives on unknown/dead node "
+                f"{node_id.hex()[:12]}")
+
+        async def _pull():
+            client = await self._raylet_for(addr)
+            chunks, off = [], 0
+            while True:
+                reply = await client.call(
+                    "pull_object", oid=oid, offset=off, length=self.PULL_CHUNK)
+                if not reply.get("found"):
+                    raise ObjectLostError(
+                        f"object {oid.hex()[:12]} not found on node "
+                        f"{node_id.hex()[:12]} (evicted or node restarted)")
+                chunk = reply["chunk"]
+                chunks.append(chunk)
+                off += len(chunk)
+                if off >= reply["total"]:
+                    return b"".join(chunks)
+                if not chunk:
+                    raise ObjectLostError(
+                        f"truncated pull of {oid.hex()[:12]}")
+
+        try:
+            return self.io.run(_pull())
+        except (ConnectionLost, OSError):
+            raise ObjectLostError(
+                f"node {node_id.hex()[:12]} unreachable while pulling "
+                f"{oid.hex()[:12]}")
 
     @staticmethod
     def _raise_if_error(value):
@@ -226,14 +321,18 @@ class CoreWorker:
         out, names = [], []
         for name, value in [(None, a) for a in args] + list(kwargs.items()):
             if isinstance(value, ObjectRef):
-                out.append(("r", value.binary()))
+                oid = value.binary()
+                # Prefer the tracked result location over the ref's recorded
+                # owner: task returns live on the node that executed the task.
+                owner = self._object_locations.get(oid) or value.owner or self.node_id
+                out.append(("r", oid, owner))
             else:
                 segments, total = serialization.serialize(value)
                 if total > INLINE_RESULT_MAX and self.store is not None:
                     oid = ObjectID.generate().binary()
                     self._write_segments_to_plasma(oid, segments, total)
                     self._put_refs.add(oid)
-                    out.append(("r", oid))
+                    out.append(("r", oid, self.node_id))
                 else:
                     out.append(("v", serialization.join_segments(segments)))
             names.append(name)
@@ -242,12 +341,13 @@ class CoreWorker:
     def resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
         """Worker-side: materialize TaskSpec args."""
         args, kwargs = [], {}
-        for (kind, payload), name in zip(spec.args, spec.kwarg_names):
+        for arg, name in zip(spec.args, spec.kwarg_names):
+            kind, payload = arg[0], arg[1]
             if kind == "v":
                 value = serialization.deserialize(payload)
             else:
-                buf = self._require_store().get(payload, timeout=60)
-                value = serialization.deserialize(buf.data, pin=buf)
+                owner = arg[2] if len(arg) > 2 else None
+                value = self._get_plasma_value(payload, owner, timeout=60)
             if name is None:
                 args.append(value)
             else:
@@ -259,24 +359,100 @@ class CoreWorker:
     def submit_task(self, fn, args, kwargs, *, name: str, num_returns: int,
                     resources: Dict[str, float], max_retries: int,
                     scheduling_strategy=None, placement_group_id=None,
-                    bundle_index=-1) -> List[ObjectRef]:
+                    bundle_index=-1, runtime_env=None) -> List[ObjectRef]:
+        from ray_tpu import runtime_env as renv_mod
+
         fn_id = self.register_function(fn)
         ser_args, names = self.serialize_args(args, kwargs)
         task_id = TaskID.generate().binary()
+        runtime_env = renv_mod.prepare_runtime_env(
+            self, self.merge_job_env(runtime_env))
         spec = TaskSpec(
             task_id=task_id, fn_id=fn_id, name=name, args=ser_args,
             kwarg_names=names, num_returns=num_returns, resources=resources,
             max_retries=max_retries, scheduling_strategy=scheduling_strategy,
             placement_group_id=placement_group_id,
-            placement_group_bundle_index=bundle_index)
+            placement_group_bundle_index=bundle_index,
+            runtime_env=runtime_env)
         refs = [ObjectRef(ObjectID.for_task_return(TaskID(task_id), i).binary(),
                           owner=self.node_id)
                 for i in range(num_returns)]
         with self._mem_lock:
             for ref in refs:
                 self.result_futures[ref.binary()] = SyncFuture()
+        self._record_lineage(spec, [r.binary() for r in refs])
         self.io.spawn(self._submit_async(spec))
         return refs
+
+    def merge_job_env(self, env: Optional[dict]) -> Optional[dict]:
+        """Per-task/actor env overrides the job-level env; env_vars merge
+        key-wise (reference runtime_env inheritance semantics)."""
+        base = self.job_runtime_env
+        if not base:
+            return env
+        if not env:
+            return dict(base)
+        merged = dict(base)
+        merged.update(env)
+        env_vars = dict(base.get("env_vars") or {})
+        env_vars.update(env.get("env_vars") or {})
+        if env_vars:
+            merged["env_vars"] = env_vars
+        return merged
+
+    # ------------------------------------------------------------ lineage
+
+    LINEAGE_MAX_ENTRIES = 100_000
+    RECONSTRUCTION_ATTEMPTS = 3
+
+    def _record_lineage(self, spec: TaskSpec, return_oids: List[bytes]):
+        """Owner-side lineage for plasma-result reconstruction
+        (TaskManager lineage analog, task_manager.h:219,577; recovery
+        object_recovery_manager.h:38). Stateless tasks only — actor method
+        results are never re-executed out of band."""
+        if spec.actor_id is not None:
+            return
+        import copy
+
+        pristine = copy.deepcopy(spec)
+        rec = {"spec": pristine, "oids": list(return_oids),
+               "attempts": self.RECONSTRUCTION_ATTEMPTS}
+        with self._mem_lock:
+            for oid in return_oids:
+                self._lineage[oid] = rec
+            # Bound lineage memory: drop oldest entries beyond the cap
+            # (lineage bytes cap analog).
+            while len(self._lineage) > self.LINEAGE_MAX_ENTRIES:
+                self._lineage.pop(next(iter(self._lineage)))
+
+    def _reconstruct(self, oid: bytes, timeout: Optional[float]) -> bool:
+        """Re-execute the task whose lineage produced `oid` (the object's
+        primary copy was lost with its node). Returns True if a new attempt
+        was submitted and completed."""
+        with self._mem_lock:
+            rec = self._lineage.get(oid)
+            if rec is None or rec["attempts"] <= 0:
+                return False
+            rec["attempts"] -= 1
+            import copy
+
+            spec = copy.deepcopy(rec["spec"])
+            futs = []
+            for roid in rec["oids"]:
+                self.memory_store.pop(roid, None)
+                self._object_locations.pop(roid, None)
+                fut = SyncFuture()
+                self.result_futures[roid] = fut
+                if roid == oid:
+                    futs.append(fut)
+        logger.warning("reconstructing lost object %s by re-executing %s",
+                       oid.hex()[:12], spec.name)
+        self.io.spawn(self._submit_async(spec))
+        try:
+            futs[0].result(timeout if timeout is not None else 600)
+        except Exception:
+            return False
+        return True
 
     def _scheduling_key(self, spec: TaskSpec) -> Tuple:
         res = tuple(sorted(spec.resources.items()))
@@ -327,19 +503,31 @@ class CoreWorker:
     async def _request_lease(self, key, state: _KeyState, req_id: bytes):
         spec_resources = dict(key[1])
         pg_id, bundle_index = key[2]
-        target = self.raylet
-        try:
-            for _hop in range(4):  # bounded spillback chain
-                reply = await target.call(
-                    "lease_worker", resources=spec_resources, req_id=req_id,
-                    placement_group_id=pg_id, bundle_index=bundle_index)
-                if reply.get("spillback"):
-                    target = await self._raylet_for(tuple(reply["spillback"]))
-                    continue
+        reply = None
+        last_err = None
+        # A spillback target can die between the routing decision (possibly
+        # made from a stale gossip view) and our connect: restart the chain
+        # from the local raylet, whose view self-corrects within a heartbeat.
+        for attempt in range(4):
+            target = self.raylet
+            try:
+                for _hop in range(4):  # bounded spillback chain
+                    reply = await target.call(
+                        "lease_worker", resources=spec_resources, req_id=req_id,
+                        placement_group_id=pg_id, bundle_index=bundle_index)
+                    if reply.get("spillback"):
+                        target = await self._raylet_for(tuple(reply["spillback"]))
+                        continue
+                    break
                 break
-        except Exception as e:
+            except Exception as e:
+                last_err = e
+                reply = None
+                await asyncio.sleep(0.5 * (attempt + 1))
+        if reply is None:
             state.inflight_reqs.discard(req_id)
-            self._fail_queued(state, RayTpuError(f"lease request failed: {e!r}"))
+            self._fail_queued(
+                state, RayTpuError(f"lease request failed: {last_err!r}"))
             return
         state.inflight_reqs.discard(req_id)
         if not reply.get("ok"):
@@ -374,7 +562,8 @@ class CoreWorker:
         wait for pending ObjectRef args; inline values that live only in this
         process's memory store (workers can't see it), keep plasma refs as-is.
         Returns an error to propagate if a dependency failed."""
-        for i, (kind, payload) in enumerate(spec.args):
+        for i, arg in enumerate(spec.args):
+            kind, payload = arg[0], arg[1]
             if kind != "r":
                 continue
             oid = payload
@@ -392,6 +581,13 @@ class CoreWorker:
                     return value
                 segments, _ = serialization.serialize(value)
                 spec.args[i] = ("v", serialization.join_segments(segments))
+            else:
+                # Plasma-resident dependency: the owner recorded at
+                # serialize_args time predates task completion — refresh it
+                # now that the location of the result is known.
+                location = self._object_locations.get(oid)
+                if location is not None:
+                    spec.args[i] = ("r", oid, location)
         return None
 
     async def _run_on_lease(self, key, state: _KeyState, lease: _LeasedWorker,

@@ -49,6 +49,19 @@ def _store():
     return worker_mod.global_worker()._require_store()
 
 
+def _closed_dir() -> str:
+    """Session-shared directory of channel-closed tombstone files. A file
+    (not a store object) because store pressure must never evict the
+    abandonment signal, and tombstones must not pin object-table slots."""
+    import os
+
+    from ray_tpu.core import worker as worker_mod
+
+    path = os.path.join(worker_mod.global_worker().session_dir, "chan_closed")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
 class ShmChannel:
     """Single-writer single-reader bounded channel over the local store.
 
@@ -78,7 +91,7 @@ class ShmChannel:
     # -- writer side --------------------------------------------------------
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
         store = _store()
-        if store.contains(self._closed_oid()):
+        if self._reader_closed():
             raise ChannelClosed()
         if self._wv >= self.capacity:
             # Ring is full until the reader frees the slot `capacity` back.
@@ -86,7 +99,7 @@ class ShmChannel:
             deadline = None if timeout is None else time.monotonic() + timeout
             sleep = 0.0002
             while store.contains(old):
-                if store.contains(self._closed_oid()):
+                if self._reader_closed():
                     # Reader abandoned the channel (its loop died): unwedge.
                     raise ChannelClosed()
                 if deadline is not None and time.monotonic() >= deadline:
@@ -110,25 +123,32 @@ class ShmChannel:
     def close_write(self, timeout: Optional[float] = None) -> None:
         self.write(CLOSE, timeout=timeout)
 
-    def _closed_oid(self) -> bytes:
-        return hashlib.blake2b(
-            self.channel_id + b":closed", digest_size=20).digest()
+    def _closed_path(self) -> str:
+        import os
+
+        return os.path.join(_closed_dir(), self.channel_id.hex())
+
+    def _reader_closed(self) -> bool:
+        import os
+
+        return os.path.exists(self._closed_path())
 
     def close_read(self) -> None:
-        """Reader-side abandonment: seal a tombstone that makes any blocked or
-        future write raise ChannelClosed, and free already-sealed versions the
-        reader will never consume. Unwedges upstream loops whose consumer died
-        (reference analog: channel close in
-        experimental_mutable_object_manager.*)."""
+        """Reader-side abandonment: drop a tombstone file that makes any
+        blocked or future write raise ChannelClosed, and free already-sealed
+        versions the reader will never consume. Unwedges upstream loops whose
+        consumer died (reference analog: channel close in
+        experimental_mutable_object_manager.*). A file rather than a store
+        object: store pressure cannot evict it, and it costs no table slot."""
+        import os
+
         store = _store()
-        oid = self._closed_oid()
-        if not store.contains(oid):
-            try:
-                buf = store.create(oid, 1)
-                buf.release()
-                store.seal(oid)
-            except BaseException:
-                pass
+        try:
+            fd = os.open(self._closed_path(),
+                         os.O_CREAT | os.O_WRONLY, 0o600)
+            os.close(fd)
+        except OSError:
+            pass
         # Consume (delete) anything already written but unread.
         for v in range(self._rv, self._rv + self.capacity + 1):
             try:

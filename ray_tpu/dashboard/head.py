@@ -1,0 +1,312 @@
+"""Dashboard head: REST/JSON API, Prometheus metrics, job submission.
+
+Reference analog: python/ray/dashboard/ (DashboardHead head.py:62, aiohttp
+server) with the job module (dashboard/modules/job/ — REST submit ->
+supervisor) and the metrics module. One process per cluster, typically on
+the head node; all cluster state comes from the GCS over RPC.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+from typing import Dict, Optional
+
+from aiohttp import web
+
+from ray_tpu.runtime.rpc import RpcClient
+
+logger = logging.getLogger(__name__)
+
+JOB_KV_PREFIX = b"jobsub:"
+
+
+def _json(data, status=200):
+    return web.Response(text=json.dumps(data, default=_coerce), status=status,
+                        content_type="application/json")
+
+
+def _coerce(o):
+    if isinstance(o, bytes):
+        return o.hex()
+    return str(o)
+
+
+class JobManager:
+    """Drives submitted entrypoint commands as driver subprocesses.
+
+    Reference analog: dashboard/modules/job/job_manager.py (supervisor actor
+    running the entrypoint shell command); ours runs the driver directly in
+    the dashboard process's node, with status durably in the GCS KV so the
+    state API and CLI can list jobs from anywhere."""
+
+    def __init__(self, gcs: RpcClient, gcs_address: str, session_dir: str):
+        self.gcs = gcs
+        self.gcs_address = gcs_address
+        self.session_dir = session_dir
+        self.procs: Dict[str, subprocess.Popen] = {}
+
+    def _log_path(self, job_id: str) -> str:
+        return os.path.join(self.session_dir, "logs", f"job-{job_id}.log")
+
+    async def _set(self, job_id: str, info: dict):
+        await self.gcs.call("kv_put", key=JOB_KV_PREFIX + job_id.encode(),
+                            value=json.dumps(info).encode())
+
+    async def get(self, job_id: str) -> Optional[dict]:
+        reply = await self.gcs.call("kv_get", key=JOB_KV_PREFIX + job_id.encode())
+        blob = reply.get("value")
+        return json.loads(blob) if blob else None
+
+    async def list(self) -> list:
+        keys = (await self.gcs.call("kv_keys", prefix=JOB_KV_PREFIX))["keys"]
+        out = []
+        for k in keys:
+            reply = await self.gcs.call("kv_get", key=k)
+            if reply.get("value"):
+                out.append(json.loads(reply["value"]))
+        return out
+
+    async def submit(self, entrypoint: str, *, submission_id: Optional[str] = None,
+                     env: Optional[Dict[str, str]] = None,
+                     working_dir: Optional[str] = None,
+                     metadata: Optional[dict] = None) -> str:
+        job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+        info = {"submission_id": job_id, "entrypoint": entrypoint,
+                "status": "PENDING", "start_time": time.time(),
+                "end_time": None, "metadata": metadata or {},
+                "message": "", "log_path": self._log_path(job_id)}
+        await self._set(job_id, info)
+        run_env = dict(os.environ)
+        run_env.update(env or {})
+        # The entrypoint's ray_tpu.init() attaches to this cluster; it must
+        # also resolve this framework's import path even when the submitter
+        # relied on sys.path rather than PYTHONPATH.
+        run_env["RAY_TPU_ADDRESS"] = self.gcs_address
+        run_env["PYTHONPATH"] = ":".join(
+            [p for p in sys.path if p] +
+            ([run_env["PYTHONPATH"]] if run_env.get("PYTHONPATH") else []))
+        os.makedirs(os.path.dirname(self._log_path(job_id)), exist_ok=True)
+        log_file = open(self._log_path(job_id), "wb")
+        try:
+            proc = subprocess.Popen(
+                entrypoint, shell=True, cwd=working_dir or None, env=run_env,
+                stdout=log_file, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        except OSError as e:
+            info.update(status="FAILED", message=repr(e), end_time=time.time())
+            await self._set(job_id, info)
+            return job_id
+        finally:
+            log_file.close()
+        self.procs[job_id] = proc
+        info["status"] = "RUNNING"
+        await self._set(job_id, info)
+        asyncio.ensure_future(self._wait(job_id, proc))
+        return job_id
+
+    async def _wait(self, job_id: str, proc: subprocess.Popen):
+        while proc.poll() is None:
+            await asyncio.sleep(0.5)
+        info = await self.get(job_id) or {}
+        if info.get("status") == "STOPPED":
+            return
+        info["status"] = "SUCCEEDED" if proc.returncode == 0 else "FAILED"
+        if proc.returncode != 0:
+            info["message"] = f"entrypoint exited with code {proc.returncode}"
+        info["end_time"] = time.time()
+        await self._set(job_id, info)
+
+    async def stop(self, job_id: str) -> bool:
+        proc = self.procs.get(job_id)
+        info = await self.get(job_id)
+        if info is None:
+            return False
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except Exception:
+                proc.terminate()
+        info.update(status="STOPPED", end_time=time.time())
+        await self._set(job_id, info)
+        return True
+
+    def logs(self, job_id: str) -> str:
+        try:
+            with open(self._log_path(job_id), "r", errors="replace") as f:
+                return f.read()
+        except FileNotFoundError:
+            return ""
+
+
+class DashboardHead:
+    def __init__(self, gcs_address: str, session_dir: str,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.gcs_address = gcs_address
+        self.session_dir = session_dir
+        self.host = host
+        self.port = port
+        self.gcs: Optional[RpcClient] = None
+        self.jobs: Optional[JobManager] = None
+        self._runner = None
+
+    async def start(self):
+        gcs_host, gcs_port = self.gcs_address.rsplit(":", 1)
+        self.gcs = RpcClient(gcs_host, int(gcs_port))
+        await self.gcs.connect(timeout=30)
+        self.jobs = JobManager(self.gcs, self.gcs_address, self.session_dir)
+        app = web.Application()
+        app.add_routes([
+            web.get("/", self.index),
+            web.get("/api/version", self.version),
+            web.get("/api/nodes", self.nodes),
+            web.get("/api/actors", self.actors),
+            web.get("/api/placement_groups", self.placement_groups),
+            web.get("/api/cluster_resources", self.cluster_resources),
+            web.get("/metrics", self.metrics),
+            web.post("/api/jobs/", self.job_submit),
+            web.get("/api/jobs/", self.job_list),
+            web.get("/api/jobs/{job_id}", self.job_get),
+            web.get("/api/jobs/{job_id}/logs", self.job_logs),
+            web.post("/api/jobs/{job_id}/stop", self.job_stop),
+        ])
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        logger.info("dashboard listening on %s:%d", self.host, self.port)
+        return self
+
+    async def close(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+        if self.gcs is not None:
+            await self.gcs.close()
+
+    # -- handlers ----------------------------------------------------------
+    async def index(self, request):
+        nodes = await self.gcs.call("get_nodes")
+        actors = await self.gcs.call("list_actors")
+        jobs = await self.jobs.list()
+        rows = "".join(
+            f"<tr><td>{n['node_id'][:12] if isinstance(n['node_id'], str) else n['node_id'].hex()[:12]}</td>"
+            f"<td>{'alive' if n.get('alive', True) else 'dead'}</td>"
+            f"<td>{n['resources']}</td></tr>" for n in nodes)
+        html = (
+            "<html><head><title>ray_tpu dashboard</title></head><body>"
+            f"<h1>ray_tpu cluster</h1>"
+            f"<p>{len(nodes)} nodes, {len(actors)} actors, {len(jobs)} jobs</p>"
+            f"<table border=1><tr><th>node</th><th>state</th><th>resources</th></tr>"
+            f"{rows}</table>"
+            "<p>APIs: /api/nodes /api/actors /api/placement_groups "
+            "/api/jobs/ /metrics</p></body></html>")
+        return web.Response(text=html, content_type="text/html")
+
+    async def version(self, request):
+        import ray_tpu
+        return _json({"version": ray_tpu.__version__})
+
+    async def nodes(self, request):
+        return _json(await self.gcs.call("get_nodes", only_alive=False))
+
+    async def actors(self, request):
+        return _json(await self.gcs.call("list_actors"))
+
+    async def placement_groups(self, request):
+        return _json(await self.gcs.call("list_placement_groups"))
+
+    async def cluster_resources(self, request):
+        nodes = await self.gcs.call("get_nodes")
+        total, avail = {}, {}
+        for n in nodes:
+            for k, v in n.get("resources", {}).items():
+                total[k] = total.get(k, 0.0) + v
+            for k, v in n.get("available", {}).items():
+                avail[k] = avail.get(k, 0.0) + v
+        return _json({"total": total, "available": avail})
+
+    async def metrics(self, request):
+        """Aggregate app metrics pushed to the KV by util.metrics plus a few
+        built-in cluster gauges, in Prometheus text format."""
+        from ray_tpu.util.metrics import prometheus_text
+
+        snapshots = []
+        keys = (await self.gcs.call("kv_keys", prefix=b"metrics:"))["keys"]
+        for k in keys:
+            reply = await self.gcs.call("kv_get", key=k)
+            if reply.get("value"):
+                try:
+                    snapshots.extend(json.loads(reply["value"]))
+                except Exception:
+                    continue
+        nodes = await self.gcs.call("get_nodes")
+        alive = sum(1 for n in nodes if n.get("alive", True))
+        builtin = [
+            {"name": "ray_tpu_cluster_nodes", "type": "gauge",
+             "description": "alive nodes", "values": {"[]": float(alive)}},
+        ]
+        text = prometheus_text(builtin + snapshots)
+        return web.Response(text=text, content_type="text/plain")
+
+    # -- job API (dashboard/modules/job REST surface) ----------------------
+    async def job_submit(self, request):
+        body = await request.json()
+        if "entrypoint" not in body:
+            return _json({"error": "entrypoint required"}, status=400)
+        job_id = await self.jobs.submit(
+            body["entrypoint"],
+            submission_id=body.get("submission_id"),
+            env=(body.get("runtime_env") or {}).get("env_vars"),
+            working_dir=(body.get("runtime_env") or {}).get("working_dir"),
+            metadata=body.get("metadata"))
+        return _json({"submission_id": job_id})
+
+    async def job_list(self, request):
+        return _json(await self.jobs.list())
+
+    async def job_get(self, request):
+        info = await self.jobs.get(request.match_info["job_id"])
+        if info is None:
+            return _json({"error": "no such job"}, status=404)
+        return _json(info)
+
+    async def job_logs(self, request):
+        return _json({"logs": self.jobs.logs(request.match_info["job_id"])})
+
+    async def job_stop(self, request):
+        ok = await self.jobs.stop(request.match_info["job_id"])
+        return _json({"stopped": ok})
+
+
+async def _amain(argv):
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8265)
+    args = parser.parse_args(argv)
+    head = DashboardHead(args.gcs_address, args.session_dir,
+                         args.host, args.port)
+    await head.start()
+    print(json.dumps({"port": head.port}), flush=True)
+    while True:
+        await asyncio.sleep(3600)
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(_amain(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

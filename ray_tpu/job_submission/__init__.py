@@ -1,0 +1,82 @@
+"""Job submission SDK: REST client for the dashboard's job API.
+
+Reference analog: python/ray/dashboard/modules/job/sdk.py
+(JobSubmissionClient:35, submit_job:125) — submit an entrypoint shell
+command to the cluster, poll status, fetch logs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+__all__ = ["JobSubmissionClient", "JobStatus"]
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = {SUCCEEDED, FAILED, STOPPED}
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        """address: the dashboard URL, e.g. "http://127.0.0.1:8265"."""
+        self.address = address.rstrip("/")
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.address + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except Exception:
+                detail = ""
+            raise RuntimeError(f"job API {method} {path} failed "
+                               f"({e.code}): {detail}") from None
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        reply = self._request("POST", "/api/jobs/", {
+            "entrypoint": entrypoint, "submission_id": submission_id,
+            "runtime_env": runtime_env, "metadata": metadata})
+        return reply["submission_id"]
+
+    def list_jobs(self) -> List[dict]:
+        return self._request("GET", "/api/jobs/")
+
+    def get_job_info(self, job_id: str) -> dict:
+        return self._request("GET", f"/api/jobs/{job_id}")
+
+    def get_job_status(self, job_id: str) -> str:
+        return self.get_job_info(job_id)["status"]
+
+    def get_job_logs(self, job_id: str) -> str:
+        return self._request("GET", f"/api/jobs/{job_id}/logs")["logs"]
+
+    def stop_job(self, job_id: str) -> bool:
+        return self._request("POST", f"/api/jobs/{job_id}/stop")["stopped"]
+
+    def wait_until_status(self, job_id: str, statuses=JobStatus.TERMINAL,
+                          timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in statuses:
+                return status
+            time.sleep(0.5)
+        raise TimeoutError(f"job {job_id} not in {statuses} after {timeout}s")

@@ -1,3 +1,10 @@
 from ray_tpu.rl.algorithm import PPO, EnvRunner  # noqa: F401
+from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.env import VectorCartPole, make_env  # noqa: F401
+from ray_tpu.rl.impala import IMPALA, ImpalaConfig  # noqa: F401
 from ray_tpu.rl.ppo import PPOConfig  # noqa: F401
+from ray_tpu.rl.replay_buffer import (  # noqa: F401
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+from ray_tpu.rl.sac import SAC, SACConfig  # noqa: F401

@@ -1,0 +1,215 @@
+"""IMPALA: asynchronous actor-learner with V-trace off-policy correction.
+
+Reference analog: rllib/algorithms/impala/ (IMPALA + vtrace). Runners
+produce rollouts continuously with (stale) broadcast weights; the learner
+consumes whichever rollouts are ready each step and corrects the policy lag
+with V-trace (Espeholt et al. 2018), computed inside the jit-compiled
+update via lax.scan (sequential bootstrap, compiler-friendly).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import ppo as ppo_mod
+
+
+@dataclass
+class ImpalaConfig:
+    env: str = "CartPole-v1"
+    obs_dim: int = 4
+    n_actions: int = 2
+    hidden: Tuple[int, ...] = (64, 64)
+    gamma: float = 0.99
+    lr: float = 5e-4
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.01
+    rho_clip: float = 1.0                 # V-trace importance clips
+    c_clip: float = 1.0
+    rollout_length: int = 64
+    num_env_runners: int = 2
+    envs_per_runner: int = 4
+    max_requests_in_flight: int = 2       # async pipeline depth per runner
+
+
+def vtrace(behaviour_logp, target_logp, rewards, values, dones, last_value,
+           gamma, rho_clip, c_clip):
+    """V-trace targets vs and advantages, shapes [T, B]."""
+    rho = jnp.exp(target_logp - behaviour_logp)
+    rho_bar = jnp.minimum(rho, rho_clip)
+    c_bar = jnp.minimum(rho, c_clip)
+    next_values = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    discounts = gamma * (1.0 - dones)
+    deltas = rho_bar * (rewards + discounts * next_values - values)
+
+    def scan_fn(acc, inp):
+        delta_t, discount_t, c_t = inp
+        acc = delta_t + discount_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn, jnp.zeros_like(last_value),
+        (deltas, discounts, c_bar), reverse=True)
+    vs = vs_minus_v + values
+    next_vs = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    advantages = rho_bar * (rewards + discounts * next_vs - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(advantages)
+
+
+def make_update_fn(config: ImpalaConfig, optimizer):
+    def loss_fn(params, batch):
+        T, B = batch["rewards"].shape
+        obs = batch["obs"].reshape(T * B, -1)
+        logits, values_flat = ppo_mod.policy_forward(params, obs)
+        logits = logits.reshape(T, B, -1)
+        values = values_flat.reshape(T, B)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        _, last_value = ppo_mod.policy_forward(params, batch["last_obs"])
+        vs, adv = vtrace(batch["behaviour_logp"], target_logp,
+                         batch["rewards"], values, batch["dones"], last_value,
+                         config.gamma, config.rho_clip, config.c_clip)
+        pg_loss = -(jax.lax.stop_gradient(adv) * target_logp).mean()
+        vf_loss = ((values - vs) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pg_loss + config.vf_coef * vf_loss \
+            - config.entropy_coef * entropy
+        return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    @jax.jit
+    def update(params, opt_state, batch):
+        import optax
+
+        (_, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    return update
+
+
+class ImpalaRunner:
+    """Actor: rollouts with the weights it was handed (possibly stale)."""
+
+    def __init__(self, config: ImpalaConfig, seed: int):
+        from ray_tpu.rl.env import make_env
+
+        self.config = config
+        self.env = make_env(config.env, config.envs_per_runner, seed)
+        self.obs = self.env.reset()
+        self.forward = jax.jit(ppo_mod.policy_forward)
+        self.rng = np.random.default_rng(seed)
+        self.episode_returns = []
+        self._running = np.zeros(config.envs_per_runner)
+
+    def rollout(self, params) -> Dict[str, np.ndarray]:
+        T = self.config.rollout_length
+        obs_b, act_b, logp_b, rew_b, done_b = [], [], [], [], []
+        for _ in range(T):
+            logits, _ = self.forward(params, jnp.asarray(self.obs))
+            logits = np.asarray(logits)
+            probs = np.exp(logits - logits.max(-1, keepdims=True))
+            probs /= probs.sum(-1, keepdims=True)
+            actions = np.array([self.rng.choice(len(p), p=p) for p in probs])
+            logp = np.log(probs[np.arange(len(actions)), actions] + 1e-10)
+            next_obs, reward, done = self.env.step(actions)
+            obs_b.append(self.obs); act_b.append(actions); logp_b.append(logp)
+            rew_b.append(reward); done_b.append(done.astype(np.float32))
+            self._running += reward
+            for i in np.where(done)[0]:
+                self.episode_returns.append(float(self._running[i]))
+                self._running[i] = 0.0
+            self.obs = next_obs
+        return {
+            "obs": np.stack(obs_b).astype(np.float32),          # [T, B, D]
+            "actions": np.stack(act_b).astype(np.int32),
+            "behaviour_logp": np.stack(logp_b).astype(np.float32),
+            "rewards": np.stack(rew_b).astype(np.float32),
+            "dones": np.stack(done_b).astype(np.float32),
+            "last_obs": self.obs.astype(np.float32),
+            "episode_returns": self.episode_returns[-50:],
+        }
+
+
+class IMPALA:
+    """Async pipeline: keep max_requests_in_flight rollouts outstanding per
+    runner; each train() consumes one ready rollout and immediately
+    re-dispatches with fresh weights."""
+
+    def __init__(self, config: ImpalaConfig):
+        import optax
+
+        import ray_tpu
+
+        pcfg = ppo_mod.PPOConfig(obs_dim=config.obs_dim,
+                                 n_actions=config.n_actions,
+                                 hidden=config.hidden)
+        self.config = config
+        self.params = ppo_mod.init_policy(pcfg, jax.random.key(0))
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.update_fn = make_update_fn(config, self.optimizer)
+        Runner = ray_tpu.remote(ImpalaRunner)
+        self.runners = [Runner.remote(config, seed=i)
+                        for i in range(config.num_env_runners)]
+        self._inflight: Dict = {}
+        self.env_steps = 0
+        self.iteration = 0
+        self._dispatch_all()
+
+    def _params_host(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def _dispatch_all(self):
+        params_host = self._params_host()
+        for r in self.runners:
+            while sum(1 for v in self._inflight.values() if v is r) < \
+                    self.config.max_requests_in_flight:
+                self._inflight[r.rollout.remote(params_host)] = r
+
+    def train(self) -> Dict:
+        import time
+
+        import ray_tpu
+
+        t0 = time.perf_counter()
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                timeout=300)
+        if not ready:
+            raise TimeoutError("no rollout became ready")
+        ref = ready[0]
+        runner = self._inflight.pop(ref)
+        roll = ray_tpu.get(ref)
+        # Refill the pipeline with current weights before updating.
+        self._inflight[runner.rollout.remote(self._params_host())] = runner
+        episode_returns = roll.pop("episode_returns")
+        self.env_steps += roll["rewards"].size
+        batch = {k: jnp.asarray(v) for k, v in roll.items()}
+        self.params, self.opt_state, metrics = self.update_fn(
+            self.params, self.opt_state, batch)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": float(np.mean(episode_returns))
+            if episode_returns else 0.0,
+            "num_env_steps": self.env_steps,
+            "time_this_iter_s": time.perf_counter() - t0,
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

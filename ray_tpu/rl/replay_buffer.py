@@ -1,0 +1,83 @@
+"""Replay buffers for off-policy RL.
+
+Reference analog: rllib/utils/replay_buffers/ (ReplayBuffer,
+PrioritizedEpisodeReplayBuffer). Flat numpy ring buffers — sampling feeds
+jit-compiled updates, so everything stays host-side until the batch is
+assembled, then ships to device once per update (HBM-friendly: one big
+transfer instead of per-transition traffic).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    """Uniform FIFO ring buffer over transition dicts of fixed-shape arrays."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._storage: Dict[str, np.ndarray] = {}
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_batch(self, batch: Dict[str, np.ndarray]):
+        n = len(next(iter(batch.values())))
+        if not self._storage:
+            for k, v in batch.items():
+                v = np.asarray(v)
+                self._storage[k] = np.zeros((self.capacity,) + v.shape[1:],
+                                            dtype=v.dtype)
+        idxs = (self._idx + np.arange(n)) % self.capacity
+        for k, v in batch.items():
+            self._storage[k][idxs] = v
+        self._idx = int((self._idx + n) % self.capacity)
+        self._size = int(min(self._size + n, self.capacity))
+        return idxs
+
+    def add(self, **transition):
+        self.add_batch({k: np.asarray(v)[None] for k, v in transition.items()})
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idxs = self._rng.integers(0, self._size, size=batch_size)
+        return {k: v[idxs] for k, v in self._storage.items()}
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (sum-tree-free O(n) sampling is fine
+    at the capacities the update loop can consume)."""
+
+    def __init__(self, capacity: int, alpha: float = 0.6, beta: float = 0.4,
+                 seed: int = 0):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self._priorities = np.zeros(capacity, dtype=np.float64)
+        self._max_priority = 1.0
+
+    def add_batch(self, batch: Dict[str, np.ndarray]):
+        idxs = super().add_batch(batch)
+        self._priorities[idxs] = self._max_priority
+        return idxs
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        prios = self._priorities[:self._size] ** self.alpha
+        probs = prios / prios.sum()
+        idxs = self._rng.choice(self._size, size=batch_size, p=probs)
+        weights = (self._size * probs[idxs]) ** (-self.beta)
+        weights /= weights.max()
+        out = {k: v[idxs] for k, v in self._storage.items()}
+        out["weights"] = weights.astype(np.float32)
+        out["indices"] = idxs
+        return out
+
+    def update_priorities(self, indices: np.ndarray, priorities: np.ndarray):
+        priorities = np.abs(priorities) + 1e-6
+        self._priorities[indices] = priorities
+        self._max_priority = max(self._max_priority, float(priorities.max()))

@@ -1,0 +1,104 @@
+"""Log monitor: tail worker log files and publish lines to GCS pubsub.
+
+Reference analog: python/ray/_private/log_monitor.py:103 (LogMonitor tails
+per-worker files, publishes to GCS pubsub, driver prints with a
+``(pid=..., ip=...)`` prefix). Runs inside the raylet process here — one
+tailer per node over ``<session>/logs/*.log``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import logging
+import os
+from typing import Dict
+
+logger = logging.getLogger(__name__)
+
+LOG_CHANNEL = "worker_logs"
+
+
+class LogMonitor:
+    """Polls the session log dir; publishes new lines via a callback."""
+
+    def __init__(self, logs_dir: str, publish, node_id_hex: str,
+                 poll_interval: float = 0.5, pattern: str = "worker_*.log"):
+        self.logs_dir = logs_dir
+        self.pattern = pattern
+        self.publish = publish          # async fn(channel, message)
+        self.node_id_hex = node_id_hex
+        self.poll_interval = poll_interval
+        self._offsets: Dict[str, int] = {}
+
+    def _scan_once_sync(self):
+        """Collect (fname, [lines]) updates since the previous scan."""
+        updates = []
+        for path in sorted(glob.glob(os.path.join(self.logs_dir, self.pattern))):
+            try:
+                size = os.path.getsize(path)
+                offset = self._offsets.get(path, 0)
+                if size <= offset:
+                    if size < offset:      # truncated/rotated: restart
+                        self._offsets[path] = 0
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read(size - offset)
+                # Only consume complete lines; partial tails wait for the
+                # writer to finish them.
+                last_nl = chunk.rfind(b"\n")
+                if last_nl < 0:
+                    continue
+                self._offsets[path] = offset + last_nl + 1
+                lines = chunk[:last_nl].decode("utf-8", "replace").splitlines()
+                if lines:
+                    updates.append((os.path.basename(path), lines))
+            except OSError:
+                continue
+        return updates
+
+    async def run(self, shutdown: asyncio.Event):
+        while not shutdown.is_set():
+            try:
+                for fname, lines in self._scan_once_sync():
+                    await self.publish(LOG_CHANNEL, {
+                        "node_id": self.node_id_hex,
+                        "file": fname,
+                        "lines": lines,
+                    })
+            except Exception:
+                logger.exception("log monitor scan failed")
+            await asyncio.sleep(self.poll_interval)
+
+
+def attach_driver_log_stream(core) -> None:
+    """Driver-side: subscribe to the worker-log pubsub channel and mirror
+    lines to this process's stderr (log_monitor.py -> driver stdout path in
+    the reference). Enabled unless RAY_TPU_LOG_TO_DRIVER=0."""
+    import sys
+
+    from ray_tpu.runtime.rpc import RpcClient
+
+    if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") == "0":
+        return
+
+    async def on_push(method, data):
+        if method != "pubsub" or data.get("channel") != LOG_CHANNEL:
+            return
+        msg = data["message"]
+        prefix = f"({msg['file'].rsplit('.',1)[0]}, node={msg['node_id'][:8]})"
+        for line in msg["lines"]:
+            print(f"{prefix} {line}", file=sys.stderr)
+
+    async def _connect():
+        host, port = core.gcs.host, core.gcs.port
+        client = RpcClient(host, port, on_push=on_push)
+        await client.connect(timeout=30)
+        await client.call("subscribe", channels=[LOG_CHANNEL])
+        return client
+
+    try:
+        core._log_stream_client = core.io.run(_connect())
+    except Exception:
+        logger.warning("driver log streaming unavailable", exc_info=True)

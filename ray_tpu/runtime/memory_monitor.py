@@ -1,0 +1,75 @@
+"""Node memory monitor + OOM worker-killing policy.
+
+Reference analog: src/ray/common/memory_monitor.{h,cc} (memory_monitor.h:52,
+usage_threshold callback) and src/ray/raylet/worker_killing_policy*.{h,cc}
+(retriable-FIFO: prefer killing the most recently started retriable work so
+long-running tasks survive). The raylet polls usage and, above the threshold,
+kills one worker per tick; the lease/retry machinery resubmits its task.
+
+Test hook: RAY_TPU_MEMORY_MONITOR_TEST_FILE names a file whose content is a
+fake usage fraction — lets OOM tests run without real memory pressure.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_THRESHOLD = float(os.environ.get("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0.95"))
+
+
+def node_memory_usage_fraction() -> Optional[float]:
+    """Used/total from /proc/meminfo (MemAvailable-based, like the
+    reference's cgroup-aware path); None if unreadable."""
+    test_file = os.environ.get("RAY_TPU_MEMORY_MONITOR_TEST_FILE")
+    if test_file:
+        try:
+            with open(test_file) as f:
+                return float(f.read().strip())
+        except Exception:
+            return None
+    try:
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                key, _, rest = line.partition(":")
+                info[key] = int(rest.split()[0])  # kB
+        total = info["MemTotal"]
+        avail = info.get("MemAvailable", info.get("MemFree", 0))
+        return 1.0 - avail / total
+    except Exception:
+        return None
+
+
+def process_rss_bytes(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        return 0
+
+
+class MemoryMonitor:
+    """Polled by the raylet; picks the kill victim when over threshold."""
+
+    def __init__(self, threshold: float = DEFAULT_THRESHOLD):
+        self.threshold = threshold
+
+    def over_threshold(self) -> bool:
+        frac = node_memory_usage_fraction()
+        return frac is not None and frac >= self.threshold
+
+    def pick_victim(self, workers: list) -> Optional[object]:
+        """Retriable-FIFO policy: among busy workers, kill the one whose task
+        started most recently (preferring non-actor workers — actor state is
+        lost on kill; tasks just retry)."""
+        candidates = [w for w in workers if getattr(w, "busy_since", None)]
+        if not candidates:
+            return None
+        non_actors = [w for w in candidates
+                      if not getattr(w, "actor_id", None)]
+        pool = non_actors or candidates
+        return max(pool, key=lambda w: w.busy_since)

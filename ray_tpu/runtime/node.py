@@ -21,6 +21,8 @@ class NodeProcesses:
         self.session_dir = session_dir
         self.gcs_proc: Optional[subprocess.Popen] = None
         self.raylet_proc: Optional[subprocess.Popen] = None
+        self.dashboard_proc: Optional[subprocess.Popen] = None
+        self.dashboard_url: Optional[str] = None
         self.gcs_address: Optional[Tuple[str, int]] = None
         self.raylet_address: Optional[Tuple[str, int]] = None
         self.node_id: Optional[bytes] = None
@@ -81,3 +83,48 @@ def start_raylet(session_dir: str, gcs_address: Tuple[str, int],
     log.close()
     info = json.loads(_wait_file(ready, 60, proc, "raylet"))
     return proc, info
+
+
+def start_dashboard(session_dir: str, gcs_address: Tuple[str, int],
+                    host: str = "127.0.0.1", port: int = 0
+                    ) -> Tuple[subprocess.Popen, str]:
+    """Start the dashboard head (REST/metrics/job API) as a subprocess.
+
+    Reference analog: _private/services.py start_dashboard -> dashboard/head.py.
+    Returns (proc, url). The child prints a {"port": N} JSON line once bound.
+    """
+    import json
+
+    log_path = os.path.join(session_dir, "logs", "dashboard.log")
+    log = open(log_path, "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.dashboard.head",
+         "--gcs-address", f"{gcs_address[0]}:{gcs_address[1]}",
+         "--session-dir", session_dir, "--host", host, "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=log, start_new_session=True)
+    log.close()
+    # Non-blocking read of the child's {"port": N} announce line: readline()
+    # would ignore the deadline if the child hangs before printing.
+    import select
+
+    fd = proc.stdout.fileno()
+    os.set_blocking(fd, False)
+    buf = b""
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"dashboard exited with code {proc.returncode}; see {log_path}")
+        if select.select([fd], [], [], 0.2)[0]:
+            chunk = os.read(fd, 4096)
+            if chunk:
+                buf += chunk
+            if b"\n" in buf:
+                break
+    line = buf.split(b"\n", 1)[0].strip()
+    if not line:
+        proc.kill()
+        raise RuntimeError(
+            f"dashboard did not announce its port within 30s; see {log_path}")
+    bound = json.loads(line)["port"]
+    return proc, f"http://{host}:{bound}"

@@ -23,8 +23,10 @@
 // - Crash-safety: pthread robust mutex; a died-holding-lock client leaves the
 //   store usable (EOWNERDEAD -> consistency restore).
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
+#include <vector>
 #include <cstring>
 #include <cstdio>
 #include <cerrno>
@@ -332,8 +334,12 @@ uint64_t store_num_objects(void* vh) { return ((Handle*)vh)->hdr->num_objects; }
 uint64_t store_seal_count(void* vh) { return ((Handle*)vh)->hdr->seal_count; }
 
 // rc: 0 ok; -1 already exists; -2 out of memory; -3 table full
+// allow_evict=0 makes allocation failure return -2 immediately instead of
+// dropping LRU objects, so the caller can spill them to disk first
+// (local_object_manager.h:41 spill-before-evict semantics).
 int store_create_object(void* vh, const uint8_t* id, uint64_t data_size,
-                        uint64_t meta_size, uint64_t* offset_out) {
+                        uint64_t meta_size, uint64_t* offset_out,
+                        int allow_evict) {
   Handle* h = (Handle*)vh;
   StoreHeader* hdr = h->hdr;
   uint64_t need = align8(data_size + meta_size);
@@ -347,7 +353,7 @@ int store_create_object(void* vh, const uint8_t* id, uint64_t data_size,
   for (;;) {
     off = heap_alloc(h, need, &granted);
     if (off != 0) break;
-    if (!evict_one(h)) { unlock(hdr); return -2; }
+    if (!allow_evict || !evict_one(h)) { unlock(hdr); return -2; }
   }
   uint64_t slot = find_insert_slot(h, id);
   if (slot == (uint64_t)-1) { heap_free(h, off, granted); unlock(hdr); return -3; }
@@ -445,6 +451,30 @@ int store_abort(void* vh, const uint8_t* id) {
   remove_entry(h, slot);
   unlock(h->hdr);
   return 0;
+}
+
+// Fill out up to max ids (each kIdSize bytes) of sealed, unreferenced objects
+// in LRU order (oldest tick first): the spill candidates. Returns count.
+uint64_t store_lru_candidates(void* vh, uint8_t* ids_out, uint64_t max) {
+  Handle* h = (Handle*)vh;
+  lock(h->hdr);
+  struct Cand { uint64_t tick; uint64_t slot; };
+  std::vector<Cand> cands;
+  for (uint64_t i = 0; i < h->hdr->table_size; i++) {
+    ObjectEntry* e = &h->table[i];
+    if (e->state == kSealed && e->refcount == 0)
+      cands.push_back({e->lru_tick, i});
+  }
+  std::sort(cands.begin(), cands.end(),
+            [](const Cand& a, const Cand& b) { return a.tick < b.tick; });
+  uint64_t n = 0;
+  for (const Cand& c : cands) {
+    if (n >= max) break;
+    memcpy(ids_out + n * kIdSize, h->table[c.slot].id, kIdSize);
+    n++;
+  }
+  unlock(h->hdr);
+  return n;
 }
 
 // Fill out up to max ids (each kIdSize bytes) of sealed objects. Returns count.

@@ -46,7 +46,10 @@ class _Lib:
             lib.store_create_object.restype = ctypes.c_int
             lib.store_create_object.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
-                ctypes.POINTER(ctypes.c_uint64)]
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+            lib.store_lru_candidates.restype = ctypes.c_uint64
+            lib.store_lru_candidates.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
             for name in ("store_seal", "store_release", "store_delete", "store_contains",
                          "store_abort"):
                 fn = getattr(lib, name)
@@ -154,13 +157,19 @@ class ObjectStore:
         return self._lib.store_num_objects(self.handle)
 
     # -- object ops --------------------------------------------------------
-    def create(self, object_id: bytes, data_size: int, metadata: bytes = b"") -> memoryview:
-        """Allocate an unsealed object; returns writable view of its data area."""
+    def create(self, object_id: bytes, data_size: int, metadata: bytes = b"",
+               allow_evict: bool = True) -> memoryview:
+        """Allocate an unsealed object; returns writable view of its data area.
+
+        allow_evict=False fails with StoreFullError instead of dropping LRU
+        objects, letting the caller spill them to disk first (the
+        local_object_manager spill-before-evict path)."""
         assert len(object_id) == ID_SIZE
         off = ctypes.c_uint64()
         rc = self._lib.store_create_object(
             self.handle, object_id, ctypes.c_uint64(data_size),
-            ctypes.c_uint64(len(metadata)), ctypes.byref(off))
+            ctypes.c_uint64(len(metadata)), ctypes.byref(off),
+            ctypes.c_int(1 if allow_evict else 0))
         if rc == -1:
             raise ValueError(f"object {object_id.hex()} already exists")
         if rc == -2:
@@ -225,6 +234,14 @@ class ObjectStore:
     def list_objects(self, max_objects: int = 1 << 16) -> list[bytes]:
         buf = ctypes.create_string_buffer(max_objects * ID_SIZE)
         n = self._lib.store_list(self.handle, buf, ctypes.c_uint64(max_objects))
+        raw = buf.raw
+        return [raw[i * ID_SIZE:(i + 1) * ID_SIZE] for i in range(n)]
+
+    def lru_candidates(self, max_objects: int = 64) -> list[bytes]:
+        """Sealed, unreferenced object ids in LRU order: spill candidates."""
+        buf = ctypes.create_string_buffer(max_objects * ID_SIZE)
+        n = self._lib.store_lru_candidates(
+            self.handle, buf, ctypes.c_uint64(max_objects))
         raw = buf.raw
         return [raw[i * ID_SIZE:(i + 1) * ID_SIZE] for i in range(n)]
 

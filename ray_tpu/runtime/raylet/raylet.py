@@ -34,6 +34,7 @@ class WorkerHandle:
     def __init__(self, worker_id: bytes, proc: subprocess.Popen):
         self.worker_id = worker_id
         self.proc = proc
+        self.busy_since: Optional[float] = None  # leased-task start (OOM policy)
         self.address: Optional[Tuple[str, int]] = None
         self.ready = asyncio.Event()
         self.is_actor = False
@@ -85,6 +86,7 @@ class Raylet:
         self._shutdown = asyncio.Event()
         self._monitor_task = None
         self._heartbeat_task = None
+        self._memory_task = None
         self._cluster_view: List[dict] = []
 
     # ---- lifecycle -------------------------------------------------------
@@ -94,6 +96,9 @@ class Raylet:
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         self.store = ObjectStore(self.store_path, capacity=self.object_store_memory,
                                  create=True)
+        from ray_tpu.runtime.object_store.spill import SpillManager
+        self.spill = SpillManager(
+            self.store, os.path.join(self.session_dir, "spill"))
         await self.server.start()
         self.gcs = RpcClient(*self.gcs_address)
         await self.gcs.connect(timeout=30)
@@ -104,6 +109,14 @@ class Raylet:
         assert reply["ok"]
         self._monitor_task = asyncio.ensure_future(self._monitor_workers())
         self._heartbeat_task = asyncio.ensure_future(self._heartbeat_loop())
+        self._memory_task = asyncio.ensure_future(self._memory_monitor_loop())
+        from ray_tpu.runtime.log_monitor import LogMonitor
+        self._log_monitor = LogMonitor(
+            os.path.join(self.session_dir, "logs"),
+            lambda ch, msg: self.gcs.call("publish", channel=ch, message=msg),
+            self.node_id.hex())
+        self._log_task = asyncio.ensure_future(
+            self._log_monitor.run(self._shutdown))
         logger.info("raylet %s up at %s resources=%s", self.node_id.hex()[:12],
                     self.server.address, self.total_resources)
         return self
@@ -121,12 +134,38 @@ class Raylet:
                 pass
             await asyncio.sleep(2.0)
 
+    async def _memory_monitor_loop(self):
+        """Kill one leased worker per tick while the node is over the memory
+        threshold (memory_monitor.h:52 usage callback + retriable-FIFO
+        worker_killing_policy). The child watcher reports the death; the
+        submitter's retry path resubmits the task."""
+        from ray_tpu.runtime.memory_monitor import MemoryMonitor
+
+        monitor = MemoryMonitor()
+        while not self._shutdown.is_set():
+            await asyncio.sleep(1.0)
+            try:
+                if not monitor.over_threshold():
+                    continue
+                victim = monitor.pick_victim(list(self._workers.values()))
+                if victim is None:
+                    continue
+                logger.warning(
+                    "node memory over %.0f%%: killing worker %s (task running "
+                    "%.1fs) to relieve pressure", monitor.threshold * 100,
+                    victim.worker_id.hex()[:12],
+                    time.monotonic() - victim.busy_since)
+                victim.proc.kill()
+            except Exception:
+                logger.exception("memory monitor tick failed")
+
     async def run_forever(self):
         await self._shutdown.wait()
         await self._cleanup()
 
     async def _cleanup(self):
-        for task in (self._monitor_task, self._heartbeat_task):
+        for task in (self._monitor_task, self._heartbeat_task,
+                     self._memory_task, getattr(self, '_log_task', None)):
             if task:
                 task.cancel()
         for w in list(self._workers.values()):
@@ -257,6 +296,7 @@ class Raylet:
                 w.lease_resources = {}
                 w.pg_key = None
                 w.req_id = None
+                w.busy_since = None
                 if not w.is_actor:
                     self._idle.append(w)
                 await self._dispatch_pending()
@@ -287,14 +327,28 @@ class Raylet:
                                            else self._bundles[req.pg_key]["resources"],
                                            req.resources):
                         self._pending.remove(req)
-                        if not req.fut.done():
-                            req.fut.set_result(self._spillback_or_fail(req))
+                        asyncio.ensure_future(self._resolve_spillback(req))
                     continue
                 scheduling.subtract(pool, req.resources)
                 self._pending.remove(req)
                 granted = True
                 logger.debug("dispatch: granting lease res=%s avail=%s", req.resources, self.available)
                 asyncio.ensure_future(self._grant_lease(req))
+
+    async def _resolve_spillback(self, req: PendingLease):
+        if req.fut.done():
+            return
+        reply = self._spillback_or_fail(req)
+        if not reply.get("ok") and "spillback" not in reply:
+            # The gossip view can lag a just-registered node; confirm against
+            # the GCS before declaring the request infeasible cluster-wide.
+            try:
+                self._cluster_view = await self.gcs.call("get_nodes")
+                reply = self._spillback_or_fail(req)
+            except Exception:
+                pass
+        if not req.fut.done():
+            req.fut.set_result(reply)
 
     def _spillback_or_fail(self, req: PendingLease) -> dict:
         """Locally-infeasible lease: route the client to a node whose total
@@ -327,6 +381,7 @@ class Raylet:
             w.pg_key = req.pg_key
             w.is_actor = req.for_actor
             w.req_id = req.req_id
+            w.busy_since = time.monotonic()
             if not req.fut.done():
                 logger.debug("grant_lease: worker=%s addr=%s", w.worker_id.hex()[:8], w.address)
                 req.fut.set_result({
@@ -346,6 +401,7 @@ class Raylet:
                 w.lease_id = None
                 w.lease_resources = {}
                 w.pg_key = None
+                w.busy_since = None
                 if worker_dead:
                     try:
                         w.proc.terminate()
@@ -418,6 +474,28 @@ class Raylet:
         return {"ok": True}
 
     # ---- introspection ----------------------------------------------------
+
+    async def handle_pull_object(self, conn, oid: bytes, offset: int = 0,
+                                 length: int = 4 << 20):
+        """Chunked cross-node object read: shm store first, spill dir second
+        (ObjectManager::HandlePull analog, object_manager.proto:60-61; push is
+        pull-driven here — the requester re-calls until it has total bytes)."""
+        try:
+            buf = self.store.get(oid, timeout=0)
+        except Exception:
+            rec = self.spill.read_chunk(oid, offset, length)
+            if rec is None:
+                return {"found": False}
+            total, metadata, chunk = rec
+            return {"found": True, "total": total, "metadata": metadata,
+                    "chunk": chunk}
+        try:
+            data = buf.data
+            return {"found": True, "total": len(data),
+                    "metadata": bytes(buf.metadata),
+                    "chunk": bytes(data[offset:offset + length])}
+        finally:
+            buf.release()
 
     async def handle_node_stats(self, conn):
         return {

@@ -108,10 +108,18 @@ class WorkerRuntime:
 
     def _execute(self, fn, spec: TaskSpec) -> dict:
         """Runs on the exec thread; returns the RPC reply."""
+        from ray_tpu import runtime_env as renv_mod
+        from ray_tpu.util import tracing
+
+        applied = None
         try:
+            applied = renv_mod.apply_runtime_env(
+                self.core, spec.runtime_env, self.core.session_dir)
             args, kwargs = self.core.resolve_args(spec)
             self.core.current_task_name = spec.name
-            result = fn(*args, **kwargs)
+            with tracing.span(spec.name, "task:execute",
+                              task_id=spec.task_id.hex()[:12]):
+                result = fn(*args, **kwargs)
             returns = []
             values = (result,) if spec.num_returns == 1 else tuple(result)
             if spec.num_returns > 1 and len(values) != spec.num_returns:
@@ -133,7 +141,7 @@ class WorkerRuntime:
                     # A crashed previous attempt may have left an unsealed
                     # create behind; reclaim the id.
                     store.abort(oid)
-                    buf = store.create(oid, total)
+                    buf = self.core.spill_create(oid, total)
                     try:
                         serialization.write_segments(buf, segments)
                     except BaseException:
@@ -150,6 +158,8 @@ class WorkerRuntime:
             return {"status": "error",
                     "error": TaskError(spec.name, tb, cause=_safe_cause(e))}
         finally:
+            if applied is not None:
+                applied.undo()
             self.core.current_task_name = None
 
     async def handle_push_task(self, conn, spec: TaskSpec):
@@ -161,6 +171,11 @@ class WorkerRuntime:
 
     async def handle_create_actor(self, conn, spec: ActorSpec):
         def _create():
+            from ray_tpu import runtime_env as renv_mod
+
+            # Actor envs persist for the actor's lifetime (no undo).
+            renv_mod.apply_runtime_env(
+                self.core, spec.runtime_env, self.core.session_dir)
             cls = self._load_class(spec.class_id)
             args, kwargs = self.core.resolve_args(
                 TaskSpec(task_id=b"\0" * 20, fn_id=b"", name="__init__",

@@ -1,0 +1,244 @@
+"""Runtime environments: per-task/actor working_dir, py_modules, env_vars.
+
+Reference analog: python/ray/_private/runtime_env/ (working_dir.py, py_modules,
+plugin.py; URI-cached materialization by the per-node agent, raylet <->
+agent HTTP in src/ray/raylet/runtime_env_agent_client.cc). The TPU build
+materializes in-process in the worker at task-dispatch time: packages are
+content-addressed zips in the GCS KV, extracted once per node into
+``<session>/runtime_resources/<hash>/`` and prepended to sys.path.
+
+pip/conda/uv envs: the reference materializes networked environments; this
+build targets air-gapped TPU pods, so ``pip`` specs are validated against
+already-importable distributions and otherwise raise (gate:
+RAY_TPU_ALLOW_MISSING_PIP=1 downgrades to a warning).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import logging
+import os
+import sys
+import zipfile
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+PKG_PREFIX = b"pkg:"
+_EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules"}
+MAX_PACKAGE_BYTES = 512 << 20
+
+
+class RuntimeEnv(dict):
+    """Validated runtime environment spec (a plain dict underneath so it
+    pickles into TaskSpec cheaply)."""
+
+    KEYS = {"working_dir", "py_modules", "env_vars", "pip", "config"}
+
+    def __init__(self, *, working_dir: Optional[str] = None,
+                 py_modules: Optional[List[str]] = None,
+                 env_vars: Optional[Dict[str, str]] = None,
+                 pip: Optional[List[str]] = None,
+                 config: Optional[dict] = None):
+        super().__init__()
+        if working_dir is not None:
+            self["working_dir"] = working_dir
+        if py_modules:
+            self["py_modules"] = list(py_modules)
+        if env_vars:
+            bad = {k: v for k, v in env_vars.items()
+                   if not isinstance(k, str) or not isinstance(v, str)}
+            if bad:
+                raise TypeError(f"env_vars must be str->str, got {bad}")
+            self["env_vars"] = dict(env_vars)
+        if pip:
+            self["pip"] = list(pip)
+        if config:
+            self["config"] = dict(config)
+
+
+def zip_directory(path: str) -> bytes:
+    """Deterministic zip of a directory tree (sorted entries, zeroed mtimes)
+    so equal trees produce equal content hashes."""
+    out = io.BytesIO()
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as zf:
+        entries = []
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+            for fname in sorted(files):
+                full = os.path.join(root, fname)
+                entries.append((os.path.relpath(full, path), full))
+        for rel, full in entries:
+            info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+            info.external_attr = (os.stat(full).st_mode & 0xFFFF) << 16
+            with open(full, "rb") as f:
+                zf.writestr(info, f.read())
+    data = out.getvalue()
+    if len(data) > MAX_PACKAGE_BYTES:
+        raise ValueError(f"runtime_env package too large: {len(data)} bytes")
+    return data
+
+
+# path -> (tree signature, uri): avoids re-zip + re-upload of an unchanged
+# directory on every task submission.
+_upload_cache: Dict[str, tuple] = {}
+
+
+def _tree_signature(path: str) -> str:
+    """Cheap change detector: relative paths + sizes + mtimes."""
+    parts = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for fname in sorted(files):
+            full = os.path.join(root, fname)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            parts.append(f"{os.path.relpath(full, path)}:{st.st_size}:"
+                         f"{st.st_mtime_ns}")
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()
+
+
+def upload_package(core, path: str) -> str:
+    """Zip + content-address + upload a directory; returns its pkg URI.
+    Unchanged trees (by path+size+mtime signature) skip both zip and RPC."""
+    path = os.path.abspath(path)
+    sig = _tree_signature(path)
+    cached = _upload_cache.get(path)
+    if cached is not None and cached[0] == sig:
+        return cached[1]
+    data = zip_directory(path)
+    digest = hashlib.sha1(data).hexdigest()
+    uri = f"kv://pkg/{digest}"
+    core.io.run(core.gcs.call("kv_put", key=PKG_PREFIX + digest.encode(),
+                              value=data, overwrite=False))
+    _upload_cache[path] = (sig, uri)
+    return uri
+
+
+def prepare_runtime_env(core, env: Optional[dict]) -> Optional[dict]:
+    """Driver-side: resolve local paths in the spec to uploaded pkg URIs
+    (runs at submit time, once per distinct directory)."""
+    if not env:
+        return env
+    env = dict(env)
+    wd = env.get("working_dir")
+    if wd and not wd.startswith("kv://"):
+        if not os.path.isdir(wd):
+            raise ValueError(f"working_dir {wd!r} is not a directory")
+        env["working_dir"] = upload_package(core, wd)
+    mods = []
+    for m in env.get("py_modules", []):
+        if m.startswith("kv://"):
+            mods.append(m)
+        elif os.path.isdir(m):
+            mods.append(upload_package(core, m))
+        else:
+            raise ValueError(f"py_modules entry {m!r} is not a directory")
+    if mods:
+        env["py_modules"] = mods
+    return env
+
+
+def _fetch_and_extract(core, uri: str, session_dir: str) -> str:
+    digest = uri.rsplit("/", 1)[-1]
+    dest = os.path.join(session_dir, "runtime_resources", digest)
+    if os.path.isdir(dest):
+        return dest  # URI cache hit
+    reply = core.io.run(core.gcs.call("kv_get", key=PKG_PREFIX + digest.encode()))
+    blob = reply.get("value")
+    if blob is None:
+        raise RuntimeError(f"runtime_env package {uri} not found in GCS")
+    tmp = f"{dest}.{os.getpid()}.tmp"
+    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+        zf.extractall(tmp)
+    try:
+        os.replace(tmp, dest)
+    except OSError:
+        # Concurrent extractor won; use theirs.
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def _check_pip(specs: List[str]):
+    import importlib.metadata as md
+    missing = []
+    for spec in specs:
+        name = spec.split("==")[0].split(">=")[0].split("<=")[0].strip()
+        try:
+            md.version(name)
+        except md.PackageNotFoundError:
+            missing.append(spec)
+    if missing:
+        msg = (f"runtime_env pip packages not installed: {missing}; this "
+               "air-gapped build cannot install packages at runtime — bake "
+               "them into the image")
+        if os.environ.get("RAY_TPU_ALLOW_MISSING_PIP") == "1":
+            logger.warning(msg)
+        else:
+            raise RuntimeError(msg)
+
+
+class AppliedEnv:
+    """Worker-side record of one applied env, so it can be rolled back after
+    the task (env_vars) while extracted packages stay cached."""
+
+    def __init__(self):
+        self.saved_env: Dict[str, Optional[str]] = {}
+        self.added_paths: List[str] = []
+        self.prev_cwd: Optional[str] = None
+
+    def undo(self):
+        for key, old in self.saved_env.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+        for p in self.added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+        if self.prev_cwd is not None:
+            try:
+                os.chdir(self.prev_cwd)
+            except OSError:
+                pass
+
+
+def apply_runtime_env(core, env: Optional[dict], session_dir: str) -> AppliedEnv:
+    """Worker-side: materialize and activate a runtime env for a task.
+
+    Fail-safe ordering: validations that can reject the env (pip) run before
+    any process mutation, and a failure mid-application rolls back whatever
+    was already applied — a rejected env must not contaminate the worker for
+    later tasks."""
+    applied = AppliedEnv()
+    if not env:
+        return applied
+    if env.get("pip"):
+        _check_pip(env["pip"])
+    try:
+        for key, value in (env.get("env_vars") or {}).items():
+            applied.saved_env[key] = os.environ.get(key)
+            os.environ[key] = value
+        for uri in env.get("py_modules", []):
+            path = _fetch_and_extract(core, uri, session_dir)
+            if path not in sys.path:
+                sys.path.insert(0, path)
+                applied.added_paths.append(path)
+        wd = env.get("working_dir")
+        if wd:
+            path = _fetch_and_extract(core, wd, session_dir)
+            if path not in sys.path:
+                sys.path.insert(0, path)
+                applied.added_paths.append(path)
+            applied.prev_cwd = os.getcwd()
+            os.chdir(path)
+    except BaseException:
+        applied.undo()
+        raise
+    return applied

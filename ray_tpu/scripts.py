@@ -37,6 +37,13 @@ def cmd_start(args):
         print(f"head started; GCS at {gcs_addr[0]}:{gcs_addr[1]}")
         print(f"  session dir: {session}")
         print(f"  connect with: ray_tpu.init(address='{gcs_addr[0]}:{gcs_addr[1]}')")
+        if not args.no_dashboard:
+            try:
+                _, url = node_mod.start_dashboard(
+                    session, gcs_addr, port=args.dashboard_port)
+                print(f"  dashboard: {url}")
+            except Exception as e:
+                print(f"  dashboard failed to start: {e}")
     else:
         if not args.address:
             sys.exit("--address required to join an existing cluster")
@@ -74,6 +81,48 @@ def cmd_stop(args):
     print("cluster shutdown requested")
 
 
+def _dashboard_url(address: str) -> str:
+    """Resolve the dashboard URL from the GCS KV (set at startup)."""
+    import ray_tpu
+
+    _connect(address)
+    url = ray_tpu.get_runtime_context().dashboard_url
+    if url is None:
+        sys.exit("no dashboard registered for this cluster")
+    return url
+
+
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient, JobStatus
+
+    client = JobSubmissionClient(
+        args.dashboard or _dashboard_url(args.address))
+    if args.job_cmd == "submit":
+        job_id = client.submit_job(entrypoint=" ".join(args.entrypoint))
+        print(job_id)
+        if args.wait:
+            status = client.wait_until_status(job_id)
+            print(client.get_job_logs(job_id), end="")
+            sys.exit(0 if status == JobStatus.SUCCEEDED else 1)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+    elif args.job_cmd == "stop":
+        client.stop_job(args.job_id)
+        print("stopped")
+    elif args.job_cmd == "list":
+        print(json.dumps(client.list_jobs(), indent=2, default=str))
+
+
+def cmd_timeline(args):
+    from ray_tpu.util import tracing
+
+    tracing.dump_chrome_trace(args.output)
+    print(f"wrote {len(tracing.get_spans())} spans to {args.output} "
+          "(open in chrome://tracing)")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -84,7 +133,27 @@ def main(argv=None):
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--num-tpus", type=float, default=None)
     p.add_argument("--object-store-memory", type=int, default=2 << 30)
+    p.add_argument("--no-dashboard", action="store_true")
+    p.add_argument("--dashboard-port", type=int, default=8265)
     p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("job")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    for name in ("submit", "status", "logs", "stop", "list"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("--address", default=None)
+        jp.add_argument("--dashboard", default=None,
+                        help="dashboard URL (overrides --address lookup)")
+        if name == "submit":
+            jp.add_argument("--wait", action="store_true")
+            jp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+        elif name != "list":
+            jp.add_argument("job_id")
+    p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("timeline")
+    p.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("status")
     p.add_argument("--address", required=True)

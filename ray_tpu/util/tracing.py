@@ -1,0 +1,84 @@
+"""Task tracing: spans around submit/execute, optional OpenTelemetry export.
+
+Reference analog: python/ray/util/tracing/tracing_helper.py (lazy otel import
+:36-57; @_tracing_task_invocation wrapping RemoteFunction._remote at
+remote_function.py:302). The TPU build records spans into an in-process ring
+buffer always (cheap), and mirrors them to OpenTelemetry when the user has
+opentelemetry-sdk installed and tracing enabled; ``ray_tpu.scripts timeline``
+dumps the ring as a chrome://tracing JSON file.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+_MAX_SPANS = int(os.environ.get("RAY_TPU_TRACE_BUFFER", "10000"))
+_spans = collections.deque(maxlen=_MAX_SPANS)
+_lock = threading.Lock()
+_enabled = os.environ.get("RAY_TPU_TRACING", "1") != "0"
+
+_otel_tracer = None
+
+
+def _get_otel():
+    """Lazy optional OpenTelemetry tracer (absent in the base image)."""
+    global _otel_tracer
+    if _otel_tracer is None:
+        try:
+            from opentelemetry import trace  # type: ignore
+            _otel_tracer = trace.get_tracer("ray_tpu")
+        except Exception:
+            _otel_tracer = False
+    return _otel_tracer or None
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(value: bool):
+    global _enabled
+    _enabled = value
+
+
+@contextmanager
+def span(name: str, kind: str, **attrs):
+    """Record one span; nests naturally via wall-clock containment."""
+    if not _enabled:
+        yield
+        return
+    otel = _get_otel()
+    ctx = otel.start_as_current_span(name) if otel else None
+    if ctx is not None:
+        ctx.__enter__()
+    start = time.time()
+    try:
+        yield
+    finally:
+        end = time.time()
+        with _lock:
+            _spans.append({"name": name, "cat": kind, "ts": start * 1e6,
+                           "dur": (end - start) * 1e6, "ph": "X",
+                           "pid": os.getpid(),
+                           "tid": threading.get_ident() % 100000,
+                           "args": attrs})
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+
+
+def get_spans() -> list:
+    with _lock:
+        return list(_spans)
+
+
+def dump_chrome_trace(path: str):
+    """Write the span ring in chrome://tracing 'traceEvents' format
+    (the `ray timeline` CLI analog)."""
+    with open(path, "w") as f:
+        json.dump({"traceEvents": get_spans()}, f)

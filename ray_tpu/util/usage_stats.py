@@ -1,0 +1,58 @@
+"""Usage stats: opt-out, record-only telemetry summary.
+
+Reference analog: python/ray/_private/usage/usage_lib.py:95,157 (opt-out
+cluster metadata ping). This build targets air-gapped TPU clusters with zero
+egress, so the report is only written to ``<session>/usage_stats.json`` —
+never transmitted. RAY_TPU_USAGE_STATS_ENABLED=0 disables even that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import time
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") != "0"
+
+
+def collect() -> dict:
+    import ray_tpu
+
+    report = {
+        "schema_version": "0.1",
+        "source": "ray_tpu",
+        "version": ray_tpu.__version__,
+        "python_version": platform.python_version(),
+        "os": platform.system().lower(),
+        "collected_at": time.time(),
+    }
+    try:
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            report["jax_version"] = jax.__version__
+            # Only report backend info if the backend is ALREADY initialized:
+            # stats collection must never cold-start a PJRT client (that can
+            # block for seconds on TPU runtimes).
+            from jax._src import xla_bridge
+
+            if getattr(xla_bridge, "_backends", None):
+                report["backend"] = jax.default_backend()
+                report["num_devices"] = jax.device_count()
+    except Exception:
+        pass
+    return report
+
+
+def write_report(session_dir: str):
+    if not usage_stats_enabled():
+        return
+    try:
+        with open(os.path.join(session_dir, "usage_stats.json"), "w") as f:
+            json.dump(collect(), f)
+    except Exception:
+        pass

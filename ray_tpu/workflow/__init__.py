@@ -1,0 +1,9 @@
+from ray_tpu.workflow.api import (  # noqa: F401
+    cancel,
+    get_metadata,
+    get_output,
+    list_all,
+    resume,
+    run,
+    run_async,
+)

@@ -1,0 +1,243 @@
+"""Durable workflows: run task DAGs with per-step checkpointing and resume.
+
+Reference analog: python/ray/workflow/ (workflow_executor.py,
+workflow_state_from_dag.py, storage layer). A workflow is an ordinary
+ray_tpu.dag graph of FunctionNode steps; each step's result is persisted to
+workflow storage as it completes, so a crashed or cancelled run resumes from
+the last finished step instead of recomputing the prefix.
+
+Storage layout (filesystem, one dir per workflow):
+    <storage>/<workflow_id>/meta.json           status + DAG topology digest
+    <storage>/<workflow_id>/steps/<step_key>    pickled result per step
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.dag.node import (
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_STORAGE = os.environ.get(
+    "RAY_TPU_WORKFLOW_STORAGE", os.path.expanduser("~/.ray_tpu/workflows"))
+
+
+def _storage(storage: Optional[str]) -> str:
+    path = storage or _DEFAULT_STORAGE
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+class _Store:
+    def __init__(self, root: str, workflow_id: str):
+        self.dir = os.path.join(root, workflow_id)
+        self.steps_dir = os.path.join(self.dir, "steps")
+        os.makedirs(self.steps_dir, exist_ok=True)
+
+    # -- meta --------------------------------------------------------------
+    def read_meta(self) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.dir, "meta.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def write_meta(self, meta: dict):
+        tmp = os.path.join(self.dir, f"meta.json.{os.getpid()}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(self.dir, "meta.json"))
+
+    def set_status(self, status: str, **extra):
+        meta = self.read_meta() or {}
+        meta.update(status=status, updated_at=time.time(), **extra)
+        self.write_meta(meta)
+
+    # -- step results ------------------------------------------------------
+    def step_path(self, key: str) -> str:
+        return os.path.join(self.steps_dir, key)
+
+    def has_step(self, key: str) -> bool:
+        return os.path.exists(self.step_path(key))
+
+    def save_step(self, key: str, value: Any):
+        tmp = self.step_path(key) + f".{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)
+        os.replace(tmp, self.step_path(key))
+
+    def load_step(self, key: str) -> Any:
+        with open(self.step_path(key), "rb") as f:
+            return cloudpickle.load(f)
+
+
+def _step_key(node: DAGNode, index: int) -> str:
+    """Stable per-step key: topo index + function name (topology-addressed,
+    like the reference's workflow_state step ids)."""
+    name = "output"
+    if isinstance(node, FunctionNode):
+        name = getattr(node.remote_fn, "__name__", "step")
+    return f"{index:04d}_{name}"
+
+
+def _dag_digest(nodes: List[DAGNode]) -> str:
+    parts = []
+    for i, n in enumerate(nodes):
+        parts.append(f"{i}:{type(n).__name__}:{_step_key(n, i)}")
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()
+
+
+class _Execution:
+    def __init__(self, dag: DAGNode, store: _Store, args, kwargs):
+        self.dag = dag
+        self.store = store
+        self.args = args
+        self.kwargs = kwargs
+
+    def run(self) -> Any:
+        nodes = self.dag.topo_sort()
+        cache: Dict[int, Any] = {}
+        for i, node in enumerate(nodes):
+            key = _step_key(node, i)
+            if isinstance(node, FunctionNode):
+                if self.store.has_step(key):
+                    cache[node.node_id] = self.store.load_step(key)
+                    logger.info("workflow: step %s restored from storage", key)
+                    continue
+                resolved_args = [self._resolve(a, cache) for a in node.args]
+                resolved_kwargs = {k: self._resolve(v, cache)
+                                   for k, v in node.kwargs.items()}
+                ref = node.remote_fn.remote(*resolved_args, **resolved_kwargs)
+                value = ray_tpu.get(ref)
+                self.store.save_step(key, value)
+                cache[node.node_id] = value
+            elif isinstance(node, InputAttributeNode):
+                k = node.key
+                cache[node.node_id] = (self.kwargs[k] if isinstance(k, str)
+                                       else self.args[k])
+            elif isinstance(node, InputNode):
+                cache[node.node_id] = (self.args[0] if len(self.args) == 1
+                                       and not self.kwargs
+                                       else (self.args, self.kwargs))
+            elif isinstance(node, MultiOutputNode):
+                cache[node.node_id] = [self._resolve(o, cache)
+                                       for o in node.outputs]
+        return cache[nodes[-1].node_id]
+
+    def _resolve(self, x, cache):
+        if isinstance(x, DAGNode):
+            return cache[x.node_id]
+        if isinstance(x, (list, tuple)):
+            return type(x)(self._resolve(v, cache) for v in x)
+        if isinstance(x, dict):
+            return {k: self._resolve(v, cache) for k, v in x.items()}
+        return x
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None, args: tuple = (),
+        kwargs: Optional[dict] = None) -> Any:
+    """Execute a task DAG durably; returns the final output."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    workflow_id = workflow_id or f"workflow-{int(time.time())}-{os.getpid()}"
+    root = _storage(storage)
+    store = _Store(root, workflow_id)
+    nodes = dag.topo_sort()
+    meta = store.read_meta()
+    digest = _dag_digest(nodes)
+    if meta and meta.get("digest") not in (None, digest):
+        raise ValueError(
+            f"workflow {workflow_id} already exists with a different DAG")
+    store.write_meta({"workflow_id": workflow_id, "digest": digest,
+                      "status": "RUNNING", "created_at": time.time(),
+                      "updated_at": time.time()})
+    try:
+        result = _Execution(dag, store, args, kwargs or {}).run()
+    except KeyboardInterrupt:
+        store.set_status("CANCELED")
+        raise
+    except Exception as e:
+        store.set_status("FAILED", error=repr(e))
+        raise
+    store.save_step("__output__", result)
+    store.set_status("SUCCESSFUL")
+    return result
+
+
+def run_async(dag: DAGNode, **kw):
+    """Run a workflow in a detached driver thread; returns the workflow_id."""
+    import threading
+
+    workflow_id = kw.setdefault(
+        "workflow_id", f"workflow-{int(time.time())}-{os.getpid()}")
+    t = threading.Thread(target=lambda: _swallow(run, dag, **kw), daemon=True)
+    t.start()
+    return workflow_id
+
+
+def _swallow(fn, *a, **kw):
+    try:
+        fn(*a, **kw)
+    except Exception:
+        logger.exception("async workflow failed")
+
+
+def resume(workflow_id: str, dag: DAGNode, *, storage: Optional[str] = None,
+           args: tuple = (), kwargs: Optional[dict] = None) -> Any:
+    """Resume a failed/cancelled workflow: completed steps are restored from
+    storage, the rest re-execute. The caller re-supplies the DAG (code is not
+    persisted — same contract as re-registering workflow defs on recovery)."""
+    root = _storage(storage)
+    store = _Store(root, workflow_id)
+    meta = store.read_meta()
+    if meta is None:
+        raise ValueError(f"no workflow {workflow_id!r} in {root}")
+    if meta.get("status") == "SUCCESSFUL" and store.has_step("__output__"):
+        return store.load_step("__output__")
+    return run(dag, workflow_id=workflow_id, storage=storage,
+               args=args, kwargs=kwargs)
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    store = _Store(_storage(storage), workflow_id)
+    if not store.has_step("__output__"):
+        raise ValueError(f"workflow {workflow_id} has no output "
+                         f"(status={get_metadata(workflow_id, storage=storage).get('status')})")
+    return store.load_step("__output__")
+
+
+def get_metadata(workflow_id: str, *, storage: Optional[str] = None) -> dict:
+    meta = _Store(_storage(storage), workflow_id).read_meta()
+    if meta is None:
+        raise ValueError(f"no workflow {workflow_id!r}")
+    return meta
+
+
+def list_all(*, storage: Optional[str] = None) -> List[dict]:
+    root = _storage(storage)
+    out = []
+    for wid in sorted(os.listdir(root)):
+        meta = _Store(root, wid).read_meta()
+        if meta:
+            out.append(meta)
+    return out
+
+
+def cancel(workflow_id: str, *, storage: Optional[str] = None):
+    _Store(_storage(storage), workflow_id).set_status("CANCELED")

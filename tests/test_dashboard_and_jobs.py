@@ -1,0 +1,126 @@
+"""Dashboard REST API, job submission, log monitor, tracing, usage stats.
+
+Reference test model: python/ray/dashboard/modules/job/tests/,
+python/ray/tests/test_metrics_agent.py, test_log_monitor.py.
+"""
+
+import json
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def cluster_with_dashboard():
+    ray_tpu.init(num_cpus=2, include_dashboard=True)
+    url = ray_tpu.get_runtime_context().dashboard_url
+    assert url, "dashboard did not start"
+    yield url
+    ray_tpu.shutdown()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_dashboard_api_surface(cluster_with_dashboard):
+    url = cluster_with_dashboard
+    nodes = _get_json(url + "/api/nodes")
+    assert len(nodes) == 1 and nodes[0]["resources"]["CPU"] == 2
+    res = _get_json(url + "/api/cluster_resources")
+    assert res["total"]["CPU"] == 2
+    with urllib.request.urlopen(url + "/", timeout=30) as r:
+        assert b"ray_tpu cluster" in r.read()
+
+
+def test_dashboard_metrics_endpoint(cluster_with_dashboard):
+    from ray_tpu.util import metrics as metrics_mod
+
+    c = metrics_mod.Counter("dash_test_counter", "count things")
+    c.inc(3.0)
+    metrics_mod.flush()
+    with urllib.request.urlopen(cluster_with_dashboard + "/metrics",
+                                timeout=30) as r:
+        text = r.read().decode()
+    assert "ray_tpu_cluster_nodes 1.0" in text
+    assert "dash_test_counter" in text and "3.0" in text
+
+
+def test_job_submit_roundtrip(cluster_with_dashboard, tmp_path):
+    script = tmp_path / "jobscript.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"  # attaches via RAY_TPU_ADDRESS
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x + 1\n"
+        "print('job-result:', ray_tpu.get(f.remote(41), timeout=60))\n"
+        "ray_tpu.shutdown()\n")
+    client = JobSubmissionClient(cluster_with_dashboard)
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} {script}",
+        metadata={"purpose": "test"})
+    status = client.wait_until_status(job_id, timeout=180)
+    logs = client.get_job_logs(job_id)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "job-result: 42" in logs
+    jobs = client.list_jobs()
+    assert any(j["submission_id"] == job_id for j in jobs)
+
+
+def test_job_stop(cluster_with_dashboard):
+    client = JobSubmissionClient(cluster_with_dashboard)
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+    assert client.wait_until_status(
+        job_id, {JobStatus.RUNNING, *JobStatus.TERMINAL}, timeout=60) \
+        == JobStatus.RUNNING
+    assert client.stop_job(job_id)
+    assert client.wait_until_status(job_id, timeout=60) == JobStatus.STOPPED
+
+
+def test_log_monitor_tails_incrementally(tmp_path):
+    from ray_tpu.runtime.log_monitor import LogMonitor
+
+    log = tmp_path / "worker_abc.log"
+    log.write_bytes(b"line1\npartial")
+    published = []
+
+    async def publish(ch, msg):
+        published.append(msg)
+
+    mon = LogMonitor(str(tmp_path), publish, "deadbeef")
+    u1 = mon._scan_once_sync()
+    assert u1 == [("worker_abc.log", ["line1"])]
+    with open(log, "ab") as f:
+        f.write(b"-done\nline3\n")
+    u2 = mon._scan_once_sync()
+    assert u2 == [("worker_abc.log", ["partial-done", "line3"])]
+    assert mon._scan_once_sync() == []
+
+
+def test_tracing_spans_and_timeline(tmp_path):
+    from ray_tpu.util import tracing
+
+    with tracing.span("unit_test_op", "test", foo="bar"):
+        time.sleep(0.01)
+    spans = tracing.get_spans()
+    assert any(s["name"] == "unit_test_op" for s in spans)
+    out = tmp_path / "trace.json"
+    tracing.dump_chrome_trace(str(out))
+    data = json.loads(out.read_text())
+    assert any(e["name"] == "unit_test_op" for e in data["traceEvents"])
+
+
+def test_usage_stats_report(tmp_path):
+    from ray_tpu.util import usage_stats
+
+    usage_stats.write_report(str(tmp_path))
+    report = json.loads((tmp_path / "usage_stats.json").read_text())
+    assert report["source"] == "ray_tpu" and "version" in report

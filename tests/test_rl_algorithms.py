@@ -1,0 +1,106 @@
+"""Off-policy + async RL algorithm tests: DQN, SAC, IMPALA, replay buffers.
+
+Reference test model: rllib/algorithms/{dqn,sac,impala}/tests — short
+training runs asserting learning signals flow (finite losses, steps
+counted), not convergence.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+
+
+@pytest.fixture(scope="module")
+def cluster(cpu_jax):
+    ray_tpu.init(num_cpus=3)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_replay_buffer_ring():
+    buf = ReplayBuffer(capacity=10)
+    buf.add_batch({"x": np.arange(8, dtype=np.float32)})
+    assert len(buf) == 8
+    buf.add_batch({"x": np.arange(8, 16, dtype=np.float32)})
+    assert len(buf) == 10  # wrapped
+    sample = buf.sample(32)
+    assert sample["x"].shape == (32,)
+    # oldest entries (0..5) were overwritten
+    assert sample["x"].min() >= 6
+
+
+def test_prioritized_replay_buffer():
+    buf = PrioritizedReplayBuffer(capacity=100, seed=1)
+    buf.add_batch({"x": np.zeros(50, dtype=np.float32)})
+    s = buf.sample(16)
+    assert "weights" in s and "indices" in s
+    # Give index 0 overwhelming priority: it should dominate samples.
+    prios = np.full(16, 1e-6)
+    buf.update_priorities(s["indices"], prios)
+    buf.update_priorities(np.array([0]), np.array([1e6]))
+    s2 = buf.sample(64)
+    assert (s2["indices"] == 0).mean() > 0.5
+
+
+def test_dqn_trains(cluster):
+    from ray_tpu.rl.dqn import DQN, DQNConfig
+
+    algo = DQN(DQNConfig(num_env_runners=2, envs_per_runner=2,
+                         rollout_length=64, learning_starts=128,
+                         updates_per_iteration=4))
+    try:
+        for _ in range(3):
+            result = algo.train()
+        assert result["training_iteration"] == 3
+        assert result["num_env_steps"] >= 3 * 2 * 2 * 64
+        assert np.isfinite(result["loss"])
+        assert result["epsilon"] < 1.0
+    finally:
+        algo.stop()
+
+
+def test_dqn_prioritized(cluster):
+    from ray_tpu.rl.dqn import DQN, DQNConfig
+
+    algo = DQN(DQNConfig(num_env_runners=1, envs_per_runner=2,
+                         rollout_length=64, learning_starts=64,
+                         updates_per_iteration=2, prioritized_replay=True))
+    try:
+        for _ in range(2):
+            result = algo.train()
+        assert np.isfinite(result["loss"])
+    finally:
+        algo.stop()
+
+
+def test_sac_trains(cluster):
+    from ray_tpu.rl.sac import SAC, SACConfig
+
+    algo = SAC(SACConfig(num_env_runners=2, envs_per_runner=2,
+                         rollout_length=64, learning_starts=128,
+                         updates_per_iteration=4))
+    try:
+        for _ in range(3):
+            result = algo.train()
+        assert result["training_iteration"] == 3
+        assert np.isfinite(result.get("critic_loss", np.nan))
+        assert result.get("alpha", 0) > 0
+    finally:
+        algo.stop()
+
+
+def test_impala_trains(cluster):
+    from ray_tpu.rl.impala import IMPALA, ImpalaConfig
+
+    algo = IMPALA(ImpalaConfig(num_env_runners=2, envs_per_runner=2,
+                               rollout_length=32))
+    try:
+        for _ in range(4):
+            result = algo.train()
+        assert result["training_iteration"] == 4
+        assert np.isfinite(result["pg_loss"])
+        assert result["num_env_steps"] == 4 * 32 * 2
+    finally:
+        algo.stop()

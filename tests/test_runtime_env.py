@@ -1,0 +1,114 @@
+"""Runtime-env tests: env_vars, working_dir, py_modules, pip validation.
+
+Reference test model: python/ray/tests/test_runtime_env*.py.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_env_vars_applied_and_rolled_back(cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTENV_TEST": "42"}})
+    def read_env():
+        return os.environ.get("RTENV_TEST")
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("RTENV_TEST")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "42"
+    # A later task on the same worker must not see the leaked var.
+    assert ray_tpu.get(read_plain.remote(), timeout=60) is None
+
+
+def test_working_dir_package(cluster, tmp_path):
+    pkg = tmp_path / "mypkg"
+    pkg.mkdir()
+    (pkg / "my_rtenv_module.py").write_text("MAGIC = 'from-working-dir'\n")
+    (pkg / "data.txt").write_text("payload")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(pkg)})
+    def use_pkg():
+        import my_rtenv_module
+        with open("data.txt") as f:
+            return my_rtenv_module.MAGIC, f.read()
+
+    magic, payload = ray_tpu.get(use_pkg.remote(), timeout=60)
+    assert magic == "from-working-dir" and payload == "payload"
+
+
+def test_py_modules(cluster, tmp_path):
+    mod_dir = tmp_path / "mods"
+    mod_dir.mkdir()
+    (mod_dir / "extra_mod.py").write_text("VALUE = 7\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_mod():
+        import extra_mod
+        return extra_mod.VALUE
+
+    assert ray_tpu.get(use_mod.remote(), timeout=60) == 7
+
+
+def test_actor_runtime_env_persists(cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_ENV": "yes"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get("ACTOR_ENV")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote(), timeout=60) == "yes"
+    assert ray_tpu.get(a.read.remote(), timeout=60) == "yes"
+
+
+def test_pip_validation(cluster):
+    @ray_tpu.remote(runtime_env={"pip": ["numpy"]})
+    def ok():
+        return "ok"
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == "ok"
+
+    @ray_tpu.remote(max_retries=0,
+                    runtime_env={"pip": ["definitely-not-a-real-pkg-xyz"]})
+    def missing():
+        return "never"
+
+    with pytest.raises(Exception, match="not installed"):
+        ray_tpu.get(missing.remote(), timeout=60)
+
+
+def test_job_level_env_merges(tmp_path):
+    # Separate cluster: job-level runtime_env is an init() argument.
+    ray_tpu.shutdown() if ray_tpu.is_initialized() else None
+    ray_tpu.init(num_cpus=1,
+                 runtime_env={"env_vars": {"JOB_VAR": "base", "BOTH": "job"}})
+    try:
+        @ray_tpu.remote(runtime_env={"env_vars": {"BOTH": "task"}})
+        def read():
+            return os.environ.get("JOB_VAR"), os.environ.get("BOTH")
+
+        assert ray_tpu.get(read.remote(), timeout=60) == ("base", "task")
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_deterministic_package_hash(tmp_path):
+    from ray_tpu.runtime_env import zip_directory
+
+    d = tmp_path / "d"
+    d.mkdir()
+    (d / "a.py").write_text("x = 1\n")
+    z1 = zip_directory(str(d))
+    os.utime(d / "a.py", (0, 0))
+    z2 = zip_directory(str(d))
+    assert z1 == z2

@@ -1,0 +1,99 @@
+"""Serve batching + multiplex tests (no cluster needed — pure library)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.serve.batching import serve_batch
+from ray_tpu.serve.multiplex import Multiplexer, multiplexed
+
+
+def test_batch_coalesces_concurrent_calls():
+    batch_sizes = []
+
+    @serve_batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+    def predict(xs):
+        batch_sizes.append(len(xs))
+        return [x * 2 for x in xs]
+
+    results = {}
+
+    def call(i):
+        results[i] = predict(i)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {i: 2 * i for i in range(8)}
+    assert max(batch_sizes) > 1  # at least some coalescing happened
+
+
+def test_batch_on_method_and_errors():
+    class Model:
+        def __init__(self):
+            self.calls = 0
+
+        @serve_batch(max_batch_size=4, batch_wait_timeout_s=0.01)
+        def predict(self, xs):
+            self.calls += 1
+            if any(x < 0 for x in xs):
+                raise ValueError("negative input")
+            return [x + 100 for x in xs]
+
+    m = Model()
+    assert m.predict(1) == 101
+    with pytest.raises(ValueError, match="negative"):
+        m.predict(-1)
+    assert m.predict(2) == 102  # queue still works after a failed batch
+
+
+def test_batch_size_mismatch_detected():
+    @serve_batch(max_batch_size=2, batch_wait_timeout_s=0.001)
+    def broken(xs):
+        return xs + ["extra"]
+
+    with pytest.raises(ValueError, match="results"):
+        broken("a")
+
+
+def test_multiplexer_lru_eviction():
+    loads, unloads = [], []
+    mux = Multiplexer(lambda mid: loads.append(mid) or f"model-{mid}",
+                      max_num_models=2,
+                      unload_fn=lambda m: unloads.append(m))
+    assert mux.get_model("a") == "model-a"
+    assert mux.get_model("b") == "model-b"
+    assert mux.get_model("a") == "model-a"      # hit: no load
+    assert loads == ["a", "b"]
+    mux.get_model("c")                           # evicts b (LRU)
+    assert unloads == ["model-b"]
+    assert sorted(mux.loaded_model_ids()) == ["a", "c"]
+    mux.get_model("b")                           # reload after eviction
+    assert loads == ["a", "b", "c", "b"]
+
+
+def test_multiplexed_decorator():
+    class Replica:
+        def __init__(self):
+            self.loaded = []
+
+        @multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            self.loaded.append(model_id)
+            return lambda x: f"{model_id}:{x}"
+
+        def predict(self, model_id, x):
+            return self.get_model(model_id)(x)
+
+    r = Replica()
+    assert r.predict("m1", 5) == "m1:5"
+    assert r.predict("m1", 6) == "m1:6"
+    assert r.loaded == ["m1"]
+    assert r.predict("m2", 1) == "m2:1"
+    assert r.predict("m3", 1) == "m3:1"
+    assert r.predict("m1", 7) == "m1:7"  # m1 was evicted, reloads
+    assert r.loaded == ["m1", "m2", "m3", "m1"]

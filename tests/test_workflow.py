@@ -1,0 +1,93 @@
+"""Workflow tests: durable execution, step caching, resume after failure.
+
+Reference test model: python/ray/workflow/tests/ (test_basic_workflows,
+test_recovery).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def double(x):
+    return 2 * x
+
+
+def test_workflow_run_and_output(cluster, tmp_path):
+    with InputNode() as inp:
+        dag = double.bind(add.bind(inp, 10))
+    out = workflow.run(dag, workflow_id="wf1", storage=str(tmp_path),
+                       args=(5,))
+    assert out == 30
+    assert workflow.get_output("wf1", storage=str(tmp_path)) == 30
+    meta = workflow.get_metadata("wf1", storage=str(tmp_path))
+    assert meta["status"] == "SUCCESSFUL"
+    assert [m["workflow_id"] for m in workflow.list_all(storage=str(tmp_path))] == ["wf1"]
+
+
+def test_workflow_resume_skips_completed_steps(cluster, tmp_path):
+    """A step that fails on first run succeeds on resume, and the EXPENSIVE
+    upstream step is restored from storage instead of re-executing."""
+    bomb = tmp_path / "bomb"
+    bomb.write_text("armed")
+    count_file = tmp_path / "count"
+    count_file.write_text("0")
+
+    @ray_tpu.remote(max_retries=0)
+    def expensive(x, count_path):
+        n = int(open(count_path).read()) + 1
+        open(count_path, "w").write(str(n))
+        return x * 100
+
+    @ray_tpu.remote(max_retries=0)
+    def flaky(x, bomb_path):
+        import os
+        if os.path.exists(bomb_path):
+            raise RuntimeError("boom")
+        return x + 1
+
+    with InputNode() as inp:
+        dag = flaky.bind(expensive.bind(inp, str(count_file)), str(bomb))
+
+    with pytest.raises(Exception, match="boom"):
+        workflow.run(dag, workflow_id="wf2", storage=str(tmp_path), args=(3,))
+    assert workflow.get_metadata("wf2", storage=str(tmp_path))["status"] == "FAILED"
+
+    bomb.unlink()  # defuse
+    out = workflow.resume("wf2", dag, storage=str(tmp_path), args=(3,))
+    assert out == 301
+    # expensive ran exactly once across both runs (restored on resume).
+    assert count_file.read_text() == "1"
+
+
+def test_workflow_resume_of_successful_returns_cached(cluster, tmp_path):
+    with InputNode() as inp:
+        dag = add.bind(inp, 1)
+    assert workflow.run(dag, workflow_id="wf3", storage=str(tmp_path),
+                        args=(1,)) == 2
+    assert workflow.resume("wf3", dag, storage=str(tmp_path), args=(1,)) == 2
+
+
+def test_workflow_digest_conflict(cluster, tmp_path):
+    with InputNode() as inp:
+        dag1 = add.bind(inp, 1)
+    workflow.run(dag1, workflow_id="wf4", storage=str(tmp_path), args=(1,))
+    with InputNode() as inp:
+        dag2 = double.bind(add.bind(inp, 1))
+    with pytest.raises(ValueError, match="different DAG"):
+        workflow.run(dag2, workflow_id="wf4", storage=str(tmp_path), args=(1,))
