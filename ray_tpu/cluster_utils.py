@@ -46,6 +46,20 @@ class Cluster:
     def address(self) -> str:
         return f"{self.gcs_address[0]}:{self.gcs_address[1]}"
 
+    def kill_gcs(self):
+        """SIGKILL the GCS process (FT testing)."""
+        self.gcs_proc.kill()
+        self.gcs_proc.wait(timeout=10)
+
+    def restart_gcs(self):
+        """Restart the GCS on the SAME port with its durable sqlite state;
+        raylets/workers reconnect and resume (redis-backed GCS restart
+        analog)."""
+        if self.gcs_proc.poll() is None:
+            self.kill_gcs()
+        self.gcs_proc, self.gcs_address = node_mod.start_gcs(
+            self.session_dir, port=self.gcs_address[1])
+
     def add_node(self, *, num_cpus: float = 1.0, num_tpus: float = 0.0,
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,

@@ -73,7 +73,7 @@ class CoreWorker:
         self.session_dir = session_dir
         self.node_id = node_id
         self.io = EventLoopThread()
-        self.gcs = self.io.run(self._connect(gcs_address))
+        self.gcs = self.io.run(self._connect(gcs_address, auto_reconnect=True))
         self.raylet = (self.io.run(self._connect(raylet_address))
                        if raylet_address else None)
         self.store = ObjectStore(store_path, create=False) if store_path else None
@@ -96,8 +96,8 @@ class CoreWorker:
         self.job_runtime_env: Optional[dict] = None   # init(runtime_env=...)
 
     @staticmethod
-    async def _connect(addr):
-        client = RpcClient(addr[0], addr[1])
+    async def _connect(addr, auto_reconnect: bool = False):
+        client = RpcClient(addr[0], addr[1], auto_reconnect=auto_reconnect)
         await client.connect(timeout=60)
         return client
 

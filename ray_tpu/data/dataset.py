@@ -121,6 +121,115 @@ class Dataset:
     def to_pandas(self):
         return BlockAccessor.concat(list(self.iter_blocks())).to_pandas()
 
+    # ---- column ops ------------------------------------------------------
+
+    def add_column(self, name: str, fn: Callable[[Dict], Any]) -> "Dataset":
+        def _add(batch):
+            batch = dict(batch)
+            batch[name] = np.asarray(fn(batch))
+            return batch
+
+        return self.map_batches(_add)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(lambda b: {k: b[k] for k in cols})
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        drop = set(cols)
+        return self.map_batches(
+            lambda b: {k: v for k, v in b.items() if k not in drop})
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {mapping.get(k, k): v for k, v in b.items()})
+
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None
+                      ) -> "Dataset":
+        rng = np.random.default_rng(seed)
+
+        def _sample(batch):
+            n = len(next(iter(batch.values()), []))
+            keep = rng.random(n) < fraction
+            return {k: np.asarray(v)[keep] for k, v in batch.items()}
+
+        return self.map_batches(_sample)
+
+    # ---- combining -------------------------------------------------------
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        blocks = list(self.iter_blocks())
+        for o in others:
+            blocks.extend(o.iter_blocks())
+        return MaterializedDataset(blocks, self._parallelism)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Horizontal combine: rows align positionally; column collisions
+        take an _1 suffix on `other` (reference Dataset.zip semantics)."""
+        import pyarrow as pa
+
+        left = BlockAccessor.concat(list(self.iter_blocks()))
+        right = BlockAccessor.concat(list(other.iter_blocks()))
+        if left.num_rows != right.num_rows:
+            raise ValueError(
+                f"zip requires equal row counts ({left.num_rows} vs "
+                f"{right.num_rows})")
+        cols = {name: left.column(name) for name in left.column_names}
+        for name in right.column_names:
+            out = name if name not in cols else f"{name}_1"
+            cols[out] = right.column(name)
+        return MaterializedDataset([pa.table(cols)], self._parallelism)
+
+    # ---- groupby ---------------------------------------------------------
+
+    def groupby(self, key: str, *, num_partitions: Optional[int] = None):
+        from ray_tpu.data.grouped import GroupedData
+
+        return GroupedData(self, key, num_partitions)
+
+    def sum(self, on: str):
+        return float(sum(BlockAccessor(b).to_batch()[on].sum()
+                         for b in self.iter_blocks() if b.num_rows))
+
+    def min(self, on: str):
+        return float(min(BlockAccessor(b).to_batch()[on].min()
+                         for b in self.iter_blocks() if b.num_rows))
+
+    def max(self, on: str):
+        return float(max(BlockAccessor(b).to_batch()[on].max()
+                         for b in self.iter_blocks() if b.num_rows))
+
+    def mean(self, on: str):
+        total, count = 0.0, 0
+        for b in self.iter_blocks():
+            if b.num_rows:
+                total += float(BlockAccessor(b).to_batch()[on].sum())
+                count += b.num_rows
+        return total / max(count, 1)
+
+    # ---- writes (datasource write path) ----------------------------------
+
+    def _write(self, path: str, writer_name: str) -> List[str]:
+        """One remote write task per block -> <path>/part-NNNNN.<ext>."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        writer = getattr(ds_mod, writer_name)
+        write_task = ray_tpu_remote_write()
+        refs = [write_task.remote(writer, block, path, i)
+                for i, block in enumerate(self.iter_blocks())]
+        import ray_tpu
+
+        return ray_tpu.get(refs)
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "write_parquet_block")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "write_csv_block")
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(path, "write_json_block")
+
     # ---- train ingestion -------------------------------------------------
 
     def streaming_split(self, n: int) -> List["DataIterator"]:
@@ -173,6 +282,16 @@ class DataIterator:
 
 
 # ---- read API (reference: read_api.py) -----------------------------------
+
+def _run_write(writer, block, path, index):
+    return writer(block, path, index)
+
+
+def ray_tpu_remote_write():
+    import ray_tpu
+
+    return ray_tpu.remote(_run_write)
+
 
 def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
     return Dataset([plan_mod.Read(ds_mod.RangeDatasource(n), parallelism)],

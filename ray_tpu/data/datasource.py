@@ -127,3 +127,39 @@ class JSONDatasource(_FileDatasource):
         from pyarrow import json as pajson
 
         return pajson.read_json(path)
+
+
+# ---- write path (per-block writers used by Dataset.write_*) --------------
+
+def write_parquet_block(block, path: str, index: int) -> str:
+    import os
+
+    import pyarrow.parquet as pq
+
+    out = os.path.join(path, f"part-{index:05d}.parquet")
+    pq.write_table(block, out)
+    return out
+
+
+def write_csv_block(block, path: str, index: int) -> str:
+    import os
+
+    import pyarrow.csv as pacsv
+
+    out = os.path.join(path, f"part-{index:05d}.csv")
+    pacsv.write_csv(block, out)
+    return out
+
+
+def write_json_block(block, path: str, index: int) -> str:
+    import json
+    import os
+
+    from ray_tpu.data.block import BlockAccessor
+
+    out = os.path.join(path, f"part-{index:05d}.json")
+    with open(out, "w") as f:
+        for row in BlockAccessor(block).to_rows():
+            f.write(json.dumps({k: v.item() if hasattr(v, "item") else v
+                                for k, v in row.items()}) + "\n")
+    return out

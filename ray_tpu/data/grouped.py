@@ -1,0 +1,123 @@
+"""Grouped aggregation: hash-shuffle over the object plane, Arrow compute.
+
+Reference analog: python/ray/data/grouped_data.py (GroupedData.aggregate,
+map_groups) over the all-to-all shuffle ops
+(_internal/execution/operators/ shuffle ops). Map tasks hash-partition each
+block on the key into P sub-blocks (multi-return plasma objects); one reduce
+task per partition concatenates its sub-blocks and runs the Arrow group_by
+kernel. Aggregation math stays columnar (Arrow compute) end to end.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, block_from_batch
+
+_AGG_FNS = {"count": "count", "sum": "sum", "mean": "mean", "min": "min",
+            "max": "max", "std": "stddev"}
+
+
+def _partition_block(block: Block, key: str, num_partitions: int):
+    """Map side: split one block into P hash partitions (one return each)."""
+    if block.num_rows == 0:
+        empty = block.slice(0, 0)
+        return [empty] * num_partitions if num_partitions > 1 else empty
+    col = block.column(key).to_numpy(zero_copy_only=False)
+    # Stable hash per value (numpy-vectorized for numeric keys).
+    if col.dtype.kind in "iu":
+        hashes = col.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+    else:
+        hashes = np.array([hash(v) for v in col.tolist()], dtype=np.uint64)
+    parts = (hashes % np.uint64(num_partitions)).astype(np.int64)
+    out = []
+    for p in range(num_partitions):
+        idx = np.nonzero(parts == p)[0]
+        out.append(block.take(pa.array(idx)))
+    return out if num_partitions > 1 else out[0]
+
+
+def _reduce_aggregate(key: str, aggs: List[tuple], *parts: Block) -> Block:
+    merged = BlockAccessor.concat(list(parts))
+    if merged.num_rows == 0:
+        return merged
+    gb = merged.group_by([key])
+    arrow_aggs = [(col, _AGG_FNS[fn]) for col, fn in aggs]
+    return gb.aggregate(arrow_aggs)
+
+
+def _reduce_map_groups(key: str, fn: Callable, *parts: Block) -> Block:
+    merged = BlockAccessor.concat(list(parts))
+    if merged.num_rows == 0:
+        return merged
+    out_blocks = []
+    col = merged.column(key).to_numpy(zero_copy_only=False)
+    for value in np.unique(col):
+        mask = pa.array(col == value)
+        group = merged.filter(mask)
+        result = fn(BlockAccessor(group).to_batch())
+        out_blocks.append(block_from_batch(result))
+    return BlockAccessor.concat(out_blocks)
+
+
+class GroupedData:
+    def __init__(self, dataset, key: str, num_partitions: Optional[int] = None):
+        self._ds = dataset
+        self._key = key
+        self._num_partitions = num_partitions
+
+    def _shuffle_reduce(self, reduce_fn, *reduce_args):
+        from ray_tpu.data.dataset import MaterializedDataset
+
+        blocks = [b for b in self._ds.iter_blocks() if b.num_rows > 0]
+        if not blocks:
+            return MaterializedDataset([])
+        P = self._num_partitions or min(len(blocks), 8)
+        part = ray_tpu.remote(_partition_block).options(num_returns=P)
+        reduce = ray_tpu.remote(reduce_fn)
+        # Map side: per-block partition tasks, P plasma returns each.
+        part_refs = [part.remote(b, self._key, P) for b in blocks]
+        if P == 1:
+            part_refs = [[r] for r in part_refs]
+        # Reduce side: partition p consumes the p-th return of every map.
+        out_refs = [reduce.remote(self._key, *reduce_args,
+                                  *[refs[p] for refs in part_refs])
+                    for p in range(P)]
+        out = [b for b in ray_tpu.get(out_refs) if b.num_rows > 0]
+        return MaterializedDataset(out)
+
+    def aggregate(self, *aggs: tuple):
+        """aggs: (column, fn) pairs with fn in
+        count/sum/mean/min/max/std. Returns a Dataset with one row per key
+        and columns named '<col>_<arrowfn>'."""
+        for col, fn in aggs:
+            if fn not in _AGG_FNS:
+                raise ValueError(f"unknown aggregation {fn!r} "
+                                 f"(have {sorted(_AGG_FNS)})")
+        return self._shuffle_reduce(_reduce_aggregate, list(aggs))
+
+    def count(self):
+        return self.aggregate((self._key, "count"))
+
+    def sum(self, on: str):
+        return self.aggregate((on, "sum"))
+
+    def mean(self, on: str):
+        return self.aggregate((on, "mean"))
+
+    def min(self, on: str):
+        return self.aggregate((on, "min"))
+
+    def max(self, on: str):
+        return self.aggregate((on, "max"))
+
+    def std(self, on: str):
+        return self.aggregate((on, "std"))
+
+    def map_groups(self, fn: Callable[[Dict[str, np.ndarray]], Dict]):
+        """fn(batch-of-one-group) -> batch; groups never straddle tasks."""
+        return self._shuffle_reduce(_reduce_map_groups, fn)

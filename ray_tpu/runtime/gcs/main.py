@@ -11,6 +11,8 @@ def main():
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--ready-file", default=None)
+    parser.add_argument("--storage", default=None,
+                        help="sqlite file for durable GCS state (FT mode)")
     args = parser.parse_args()
 
     logging.basicConfig(
@@ -20,7 +22,7 @@ def main():
     from ray_tpu.runtime.gcs.server import GcsServer
 
     async def run():
-        gcs = GcsServer(args.host, args.port)
+        gcs = GcsServer(args.host, args.port, storage_path=args.storage)
         await gcs.start()
         if args.ready_file:
             tmp = args.ready_file + ".tmp"

@@ -60,6 +60,15 @@ class PlacementGroupManager:
         self._groups: Dict[bytes, PlacementGroupRecord] = {}
         self._lock = asyncio.Lock()
 
+    def restore_record(self, d: dict):
+        """Rebuild a record after a GCS restart (raylets still hold the
+        committed bundles, so CREATED groups stay valid)."""
+        rec = PlacementGroupRecord(d["pg_id"], d["bundles"], d["strategy"],
+                                   d["name"])
+        rec.state = d["state"]
+        rec.locations = list(d["locations"])
+        self._groups[d["pg_id"]] = rec
+
     # ---- queries ----------------------------------------------------------
 
     def bundle_location(self, pg_id: bytes, bundle_index: int) -> Optional[bytes]:
@@ -91,6 +100,7 @@ class PlacementGroupManager:
         if not ok:
             return {"ok": False, "error": err, "placement_group_id": pg_id}
         rec.state = CREATED
+        self.gcs.persist_pg(rec)
         await self.gcs.publish("placement_group", {"event": "created", "pg": rec.view()})
         return {"ok": True, "placement_group_id": pg_id}
 
@@ -209,6 +219,7 @@ class PlacementGroupManager:
                 except Exception:
                     pass
         rec.state = REMOVED
+        self.gcs.persist_pg(rec)
         rec.locations = [None] * len(rec.bundles)
         await self.gcs.publish("placement_group", {"event": "removed", "pg": rec.view()})
         return {"ok": True}
@@ -231,6 +242,7 @@ class PlacementGroupManager:
                 async with self._lock:
                     ok, _ = await self._try_place(rec)
                 rec.state = CREATED if ok else PENDING
+                self.gcs.persist_pg(rec)
                 await self.gcs.publish("placement_group",
                                        {"event": "rescheduled" if ok else "pending",
                                         "pg": rec.view()})

@@ -82,7 +82,16 @@ class ActorRecord:
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 storage_path: Optional[str] = None):
+        from ray_tpu.runtime.gcs.storage import (
+            InMemoryStoreClient,
+            SqliteStoreClient,
+        )
+
+        # StoreClient seam (store_client/: in-memory vs Redis-analog sqlite).
+        self._store = (SqliteStoreClient(storage_path) if storage_path
+                       else InMemoryStoreClient())
         self.server = RpcServer(host, port)
         self.server.register_all(self)
         self.server.on_disconnect = self._on_disconnect
@@ -103,6 +112,7 @@ class GcsServer:
         await self.server.start()
         from ray_tpu.runtime.gcs.placement_groups import PlacementGroupManager
         self._pg_manager = PlacementGroupManager(self)
+        await self._restore()
         self._health_task = asyncio.ensure_future(self._health_check_loop())
         logger.info("GCS listening on %s:%d", self.server.host, self.server.port)
         return self
@@ -110,6 +120,101 @@ class GcsServer:
     @property
     def address(self):
         return self.server.address
+
+    # ---- persistence (gcs FT: restart + reload, redis_store_client.h) ----
+
+    def _persist_actor(self, rec: "ActorRecord"):
+        import pickle
+
+        try:
+            self._store.put("actors", rec.spec.actor_id, pickle.dumps({
+                "spec": rec.spec, "state": rec.state, "address": rec.address,
+                "node_id": rec.node_id, "worker_id": rec.worker_id,
+                "restarts_used": rec.restarts_used,
+                "death_reason": rec.death_reason}))
+        except Exception:
+            logger.exception("actor persist failed")
+
+    def _persist_node(self, rec: "NodeRecord"):
+        import pickle
+
+        try:
+            self._store.put("nodes", rec.node_id, pickle.dumps({
+                "node_id": rec.node_id, "address": rec.address,
+                "resources": rec.resources, "available": rec.available,
+                "object_store_path": rec.object_store_path,
+                "is_head": rec.is_head, "labels": rec.labels,
+                "alive": rec.alive}))
+        except Exception:
+            logger.exception("node persist failed")
+
+    def persist_pg(self, rec):
+        import pickle
+
+        try:
+            self._store.put("placement_groups", rec.pg_id, pickle.dumps({
+                "pg_id": rec.pg_id, "bundles": rec.bundles,
+                "strategy": rec.strategy, "name": rec.name,
+                "state": rec.state, "locations": rec.locations}))
+        except Exception:
+            logger.exception("pg persist failed")
+
+    async def _restore(self):
+        """Reload tables after a GCS restart. Raylets and workers keep
+        running while the GCS is down (only control-plane ops stall); their
+        reconnecting clients re-register/resubscribe when we come back
+        (NotifyGCSRestart analog, node_manager.proto:401)."""
+        import pickle
+
+        for key, value in self._store.load_all("kv"):
+            self._kv[key] = value
+        for _, blob in self._store.load_all("jobs"):
+            job = pickle.loads(blob)
+            self._jobs[job["job_id"]] = job
+            self._job_counter = max(self._job_counter, job["job_id"])
+        restored_nodes = 0
+        for _, blob in self._store.load_all("nodes"):
+            d = pickle.loads(blob)
+            if not d["alive"]:
+                continue
+            rec = NodeRecord(d["node_id"], tuple(d["address"]), d["resources"],
+                             d["object_store_path"], d["is_head"], d["labels"])
+            rec.available = d["available"]
+            self._nodes[d["node_id"]] = rec
+            restored_nodes += 1
+            # Reconnect to the raylet in the background; health checks reap
+            # it if it's truly gone.
+            asyncio.ensure_future(self._reconnect_node(rec))
+        for _, blob in self._store.load_all("actors"):
+            d = pickle.loads(blob)
+            rec = ActorRecord(d["spec"])
+            rec.state = d["state"]
+            rec.address = tuple(d["address"]) if d["address"] else None
+            rec.node_id = d["node_id"]
+            rec.worker_id = d["worker_id"]
+            rec.restarts_used = d["restarts_used"]
+            rec.death_reason = d["death_reason"]
+            self._actors[rec.spec.actor_id] = rec
+            self._actor_locks[rec.spec.actor_id] = asyncio.Lock()
+            if rec.spec.name and rec.state != DEAD:
+                self._named_actors[(rec.spec.namespace, rec.spec.name)] = \
+                    rec.spec.actor_id
+        for _, blob in self._store.load_all("placement_groups"):
+            d = pickle.loads(blob)
+            self._pg_manager.restore_record(d)
+        if restored_nodes or self._actors or self._kv:
+            logger.info("GCS restored: %d nodes, %d actors, %d kv keys",
+                        restored_nodes, len(self._actors), len(self._kv))
+
+    async def _reconnect_node(self, rec: "NodeRecord"):
+        try:
+            client = RpcClient(*rec.address)
+            await client.connect(timeout=10)
+            rec.client = client
+            rec.last_heartbeat = time.monotonic()
+        except Exception:
+            await self._mark_node_dead(rec.node_id,
+                                       "unreachable after GCS restart")
 
     # ---- node management -------------------------------------------------
 
@@ -122,6 +227,7 @@ class GcsServer:
         rec.client = client
         self._nodes[node_id] = rec
         conn.meta["node_id"] = node_id
+        self._persist_node(rec)
         await self.publish("node", {"event": "added", "node": rec.view()})
         logger.info("node %s registered at %s resources=%s",
                     node_id.hex()[:12], rec.address, resources)
@@ -155,6 +261,7 @@ class GcsServer:
         if rec is None or not rec.alive:
             return
         rec.alive = False
+        self._persist_node(rec)
         logger.warning("node %s marked dead: %s", node_id.hex()[:12], reason)
         await self.publish("node", {"event": "removed", "node": rec.view(), "reason": reason})
         # Fail/restart actors that lived on that node.
@@ -180,12 +287,17 @@ class GcsServer:
         if not overwrite and key in self._kv:
             return {"ok": False, "exists": True}
         self._kv[key] = value
+        try:
+            self._store.put("kv", key, value)
+        except Exception:
+            logger.exception("kv persist failed")
         return {"ok": True}
 
     async def handle_kv_get(self, conn, key: bytes):
         return {"value": self._kv.get(key)}
 
     async def handle_kv_del(self, conn, key: bytes):
+        self._store.delete("kv", key)
         return {"ok": self._kv.pop(key, None) is not None}
 
     async def handle_kv_keys(self, conn, prefix: bytes = b""):
@@ -219,6 +331,13 @@ class GcsServer:
         job_id = self._job_counter
         self._jobs[job_id] = {"job_id": job_id, "start_time": time.time(),
                               "metadata": metadata or {}, "alive": True}
+        import pickle
+
+        try:
+            self._store.put("jobs", str(job_id).encode(),
+                            pickle.dumps(self._jobs[job_id]))
+        except Exception:
+            logger.exception("job persist failed")
         return {"job_id": job_id}
 
     async def handle_get_jobs(self, conn):
@@ -237,11 +356,13 @@ class GcsServer:
         record = ActorRecord(spec)
         self._actors[spec.actor_id] = record
         self._actor_locks[spec.actor_id] = asyncio.Lock()
+        self._persist_actor(record)
         try:
             await self._schedule_and_create(record)
         except Exception as e:
             record.state = DEAD
             record.death_reason = f"creation failed: {e!r}"
+            self._persist_actor(record)
             return {"ok": False, "error": record.death_reason}
         return {"ok": True, "address": record.address, "actor_id": spec.actor_id}
 
@@ -296,6 +417,7 @@ class GcsServer:
             record.address = worker_addr
             record.node_id = node.node_id
             record.worker_id = lease["worker_id"]
+            self._persist_actor(record)
             await self.publish("actor", {"event": "alive", "actor": record.view()})
             return
         raise RuntimeError(f"no feasible node for actor {spec.class_name} "
@@ -352,10 +474,12 @@ class GcsServer:
                 except Exception as e:
                     rec.state = DEAD
                     rec.death_reason = f"restart failed: {e!r}"
+                    self._persist_actor(rec)
                     await self.publish("actor", {"event": "dead", "actor": rec.view()})
             else:
                 rec.state = DEAD
                 rec.death_reason = reason
+                self._persist_actor(rec)
                 await self.publish("actor", {"event": "dead", "actor": rec.view()})
 
     # ---- placement groups (delegated, see gcs/placement_groups.py) -------

@@ -91,9 +91,13 @@ def attach_driver_log_stream(core) -> None:
         for line in msg["lines"]:
             print(f"{prefix} {line}", file=sys.stderr)
 
+    async def _resubscribe(client):
+        await client._call_once("subscribe", 30, dict(channels=[LOG_CHANNEL]))
+
     async def _connect():
         host, port = core.gcs.host, core.gcs.port
-        client = RpcClient(host, port, on_push=on_push)
+        client = RpcClient(host, port, on_push=on_push, auto_reconnect=True,
+                           on_reconnect=_resubscribe)
         await client.connect(timeout=30)
         await client.call("subscribe", channels=[LOG_CHANNEL])
         return client

@@ -49,12 +49,25 @@ def _wait_file(path: str, timeout: float, proc: subprocess.Popen, what: str) -> 
     raise RuntimeError(f"timed out waiting for {what} to start")
 
 
-def start_gcs(session_dir: str) -> Tuple[subprocess.Popen, Tuple[str, int]]:
-    ready = os.path.join(session_dir, "gcs_ready")
+def start_gcs(session_dir: str, port: int = 0,
+              storage: Optional[str] = None
+              ) -> Tuple[subprocess.Popen, Tuple[str, int]]:
+    """storage defaults to <session>/gcs.db — GCS restarts recover state
+    (pass storage="" to run purely in-memory)."""
+    if storage is None:
+        storage = os.path.join(session_dir, "gcs.db")
+    ready = os.path.join(session_dir, f"gcs_ready_{os.getpid()}_{port}")
+    try:
+        os.unlink(ready)
+    except OSError:
+        pass
     log = open(os.path.join(session_dir, "logs", "gcs.log"), "ab")
+    cmd = [sys.executable, "-m", "ray_tpu.runtime.gcs.main",
+           "--ready-file", ready, "--port", str(port)]
+    if storage:
+        cmd += ["--storage", storage]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu.runtime.gcs.main", "--ready-file", ready],
-        stdout=log, stderr=subprocess.STDOUT, start_new_session=True)
+        cmd, stdout=log, stderr=subprocess.STDOUT, start_new_session=True)
     log.close()
     addr = _wait_file(ready, 60, proc, "GCS")
     host, port = addr.rsplit(":", 1)

@@ -100,7 +100,9 @@ class Raylet:
         self.spill = SpillManager(
             self.store, os.path.join(self.session_dir, "spill"))
         await self.server.start()
-        self.gcs = RpcClient(*self.gcs_address)
+        self.gcs = RpcClient(*self.gcs_address, auto_reconnect=True,
+                             reconnect_timeout=120,
+                             on_reconnect=self._on_gcs_reconnect)
         await self.gcs.connect(timeout=30)
         reply = await self.gcs.call(
             "register_node", node_id=self.node_id, address=self.server.address,
@@ -121,14 +123,30 @@ class Raylet:
                     self.server.address, self.total_resources)
         return self
 
+    async def _on_gcs_reconnect(self, client):
+        """GCS restarted (NotifyGCSRestart analog): re-register so the new
+        GCS (possibly without durable storage) learns this node again."""
+        try:
+            await client._call_once("register_node", 30, dict(
+                node_id=self.node_id, address=self.server.address,
+                resources=self.total_resources,
+                object_store_path=self.store_path,
+                is_head=self.is_head, labels=self.labels))
+        except Exception:
+            logger.warning("re-register after GCS reconnect failed")
+
     async def _heartbeat_loop(self):
         # Heartbeats push availability up to the GCS; the cluster view pulled
         # back is this raylet's spillback routing table (ray_syncer resource
         # gossip analog, src/ray/common/ray_syncer/).
         while not self._shutdown.is_set():
             try:
-                await self.gcs.call("node_heartbeat", node_id=self.node_id,
-                                    available=self.available)
+                reply = await self.gcs.call("node_heartbeat",
+                                            node_id=self.node_id,
+                                            available=self.available)
+                if reply.get("unknown"):
+                    # Restarted GCS lost us (no durable storage): re-register.
+                    await self._on_gcs_reconnect(self.gcs)
                 self._cluster_view = await self.gcs.call("get_nodes")
             except Exception:
                 pass

@@ -163,10 +163,22 @@ class RpcClient:
     """Async client. Push frames from the server invoke `on_push`."""
 
     def __init__(self, host: str, port: int,
-                 on_push: Optional[Callable[[str, dict], Awaitable[None]]] = None):
+                 on_push: Optional[Callable[[str, dict], Awaitable[None]]] = None,
+                 auto_reconnect: bool = False,
+                 reconnect_timeout: float = 60.0,
+                 on_reconnect: Optional[Callable[["RpcClient"],
+                                                 Awaitable[None]]] = None):
+        """auto_reconnect: on a lost connection, call() transparently redials
+        (up to reconnect_timeout) and retries once — the
+        retryable_grpc_client.cc analog for GCS restarts. on_reconnect runs
+        after a successful redial (e.g. to resubscribe pubsub channels or
+        re-register a node)."""
         self.host = host
         self.port = port
         self.on_push = on_push
+        self.auto_reconnect = auto_reconnect
+        self.reconnect_timeout = reconnect_timeout
+        self.on_reconnect = on_reconnect
         self._reader = None
         self._writer = None
         self._pending: Dict[int, asyncio.Future] = {}
@@ -175,6 +187,7 @@ class RpcClient:
         self._recv_task = None
         self._closed = False
         self._dead = False
+        self._reconnecting: Optional[asyncio.Future] = None
 
     async def connect(self, timeout: float = 30.0):
         deadline = asyncio.get_event_loop().time() + timeout
@@ -230,19 +243,63 @@ class RpcClient:
                     pass  # event loop already closed (interpreter shutdown)
         self._pending.clear()
 
+    async def _reconnect(self):
+        """Single-flight redial; concurrent callers share one attempt."""
+        if self._reconnecting is not None:
+            await asyncio.shield(self._reconnecting)
+            if self._dead:
+                raise ConnectionLost(
+                    f"reconnect to {self.host}:{self.port} failed")
+            return
+        self._reconnecting = asyncio.get_event_loop().create_future()
+        try:
+            if self._recv_task is not None:
+                self._recv_task.cancel()
+            await self.connect(timeout=self.reconnect_timeout)
+            self._dead = False
+            if self.on_reconnect is not None:
+                try:
+                    await self.on_reconnect(self)
+                except Exception:
+                    logger.exception("on_reconnect callback failed")
+            logger.info("reconnected to %s:%d", self.host, self.port)
+        finally:
+            fut, self._reconnecting = self._reconnecting, None
+            if not fut.done():
+                fut.set_result(None)
+
     async def call(self, method: str, timeout: Optional[float] = None, **data):
-        if self._closed or self._dead:
-            raise ConnectionLost(
-                f"connection to {self.host}:{self.port} closed"
-                if self._closed else f"connection to {self.host}:{self.port} lost")
+        attempts = 2 if self.auto_reconnect else 1
+        for attempt in range(attempts):
+            if self._dead and self.auto_reconnect and not self._closed:
+                await self._reconnect()
+            if self._closed or self._dead:
+                raise ConnectionLost(
+                    f"connection to {self.host}:{self.port} closed"
+                    if self._closed
+                    else f"connection to {self.host}:{self.port} lost")
+            try:
+                return await self._call_once(method, timeout, data)
+            except ConnectionLost:
+                if attempt == attempts - 1 or self._closed:
+                    raise
+                # Retry once after redial. GCS-side handlers are idempotent
+                # (register/heartbeat/kv/publish); lease-protocol calls use
+                # non-reconnecting clients so double-grants can't happen.
+
+    async def _call_once(self, method: str, timeout: Optional[float], data):
         self._next_id += 1
         msg_id = self._next_id
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
         payload = _frame((KIND_REQUEST, msg_id, method, data))
-        async with self._lock:
-            self._writer.write(payload)
-            await self._writer.drain()
+        try:
+            async with self._lock:
+                self._writer.write(payload)
+                await self._writer.drain()
+        except (ConnectionResetError, OSError) as e:
+            self._pending.pop(msg_id, None)
+            raise ConnectionLost(str(e))
         if timeout is not None:
             return await asyncio.wait_for(fut, timeout)
         return await fut

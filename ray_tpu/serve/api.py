@@ -43,6 +43,7 @@ def run(target: Union[Deployment, List[Deployment]], *,
             "num_cpus": dep.config.num_cpus,
             "num_tpus": dep.config.num_tpus,
             "resources": dep.config.resources,
+            "autoscaling": dep.config.autoscaling_config,
         }
         ray_tpu.get(controller.deploy.remote(
             dep.name, cloudpickle.dumps(dep.func_or_class), cfg,

@@ -19,9 +19,15 @@ class ServeController:
     CONTROLLER_NAME = "SERVE_CONTROLLER"
 
     def __init__(self):
+        import threading
+
         # deployment name -> {"replicas": [handles], "config", "version"}
         self.deployments: Dict[str, Dict] = {}
         self.version = 0
+        self._lock = threading.Lock()
+        self._autoscale_thread = threading.Thread(
+            target=self._autoscale_loop, daemon=True)
+        self._autoscale_thread.start()
 
     def deploy(self, name: str, target_payload: bytes, config: dict,
                init_args_payload: bytes) -> bool:
@@ -37,20 +43,112 @@ class ServeController:
                     ray_tpu.kill(r)
                 except Exception:
                     pass
+        ac = config.get("autoscaling")
+        n = (max(int(ac.get("min_replicas", 1)), 1) if ac
+             else config["num_replicas"])
         Replica = ray_tpu.remote(ReplicaActor)
         replicas = []
-        for i in range(config["num_replicas"]):
+        for i in range(n):
             replicas.append(Replica.options(
                 num_cpus=config.get("num_cpus", 0),
                 num_tpus=config.get("num_tpus", 0),
+                max_concurrency=config.get("max_ongoing_requests", 16),
                 resources=config.get("resources") or {}).remote(
                 target_payload, init_args, init_kwargs))
         # Wait until replicas construct successfully.
         ray_tpu.get([r.health_check.remote() for r in replicas], timeout=300)
-        self.version += 1
-        self.deployments[name] = {"replicas": replicas, "config": config,
-                                  "version": self.version}
+        with self._lock:
+            self.version += 1
+            self.deployments[name] = {
+                "replicas": replicas, "config": config,
+                "version": self.version, "target_payload": target_payload,
+                "init_args": init_args, "init_kwargs": init_kwargs}
         return True
+
+    # ---- autoscaling (autoscaling_policy.py analog) ----------------------
+
+    def _autoscale_loop(self):
+        import logging
+
+        log = logging.getLogger(__name__)
+        while True:
+            time.sleep(1.0)
+            try:
+                self._autoscale_once()
+            except Exception:
+                log.exception("serve autoscale tick failed")
+
+    def _autoscale_once(self):
+        import math
+
+        for name in list(self.deployments):
+            with self._lock:
+                d = self.deployments.get(name)
+                if d is None:
+                    continue
+                ac = d["config"].get("autoscaling")
+                replicas = list(d["replicas"])
+            if not ac or not replicas:
+                continue
+            try:
+                queues = ray_tpu.get(
+                    [r.queue_len.remote() for r in replicas], timeout=10)
+            except Exception:
+                continue
+            target = max(float(ac.get("target_ongoing_requests", 2)), 0.1)
+            desired = math.ceil(sum(queues) / target) or 0
+            desired = max(int(ac.get("min_replicas", 1)),
+                          min(int(ac.get("max_replicas", len(replicas))),
+                              desired))
+            now = time.monotonic()
+            if desired > len(replicas):
+                self._scale_to(name, desired)
+                d["last_scale"] = now
+            elif desired < len(replicas):
+                # Downscale only after a quiet delay (thrash guard).
+                delay = float(ac.get("downscale_delay_s", 5.0))
+                pending_since = d.setdefault("downscale_since", now)
+                if now - pending_since >= delay:
+                    self._scale_to(name, desired)
+                    d.pop("downscale_since", None)
+                continue
+            d.pop("downscale_since", None)
+
+    def _scale_to(self, name: str, desired: int):
+        import cloudpickle  # noqa: F401  (replica payloads already bytes)
+
+        from ray_tpu.serve.deployment import ReplicaActor
+
+        with self._lock:
+            d = self.deployments.get(name)
+            if d is None:
+                return
+            current = len(d["replicas"])
+            if desired == current:
+                return
+            if desired > current:
+                Replica = ray_tpu.remote(ReplicaActor)
+                cfg = d["config"]
+                new = [Replica.options(
+                    num_cpus=cfg.get("num_cpus", 0),
+                    num_tpus=cfg.get("num_tpus", 0),
+                    max_concurrency=cfg.get("max_ongoing_requests", 16),
+                    resources=cfg.get("resources") or {}).remote(
+                        d["target_payload"], d["init_args"], d["init_kwargs"])
+                    for _ in range(desired - current)]
+                ray_tpu.get([r.health_check.remote() for r in new],
+                            timeout=300)
+                d["replicas"].extend(new)
+            else:
+                victims = d["replicas"][desired:]
+                d["replicas"] = d["replicas"][:desired]
+                for r in victims:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+            self.version += 1
+            d["version"] = self.version
 
     def get_replicas(self, name: str) -> dict:
         d = self.deployments.get(name)

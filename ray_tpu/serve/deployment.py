@@ -19,6 +19,10 @@ class DeploymentConfig:
     num_cpus: float = 0.0
     num_tpus: float = 0.0
     resources: Optional[Dict[str, float]] = None
+    # Reference analog: serve autoscaling_policy.py — replica-queue-driven
+    # target tracking. Keys: min_replicas, max_replicas,
+    # target_ongoing_requests, interval_s, downscale_delay_s.
+    autoscaling_config: Optional[Dict] = None
 
 
 class Deployment:
@@ -35,7 +39,8 @@ class Deployment:
                 max_ongoing_requests: Optional[int] = None,
                 num_cpus: Optional[float] = None,
                 num_tpus: Optional[float] = None,
-                resources: Optional[Dict[str, float]] = None) -> "Deployment":
+                resources: Optional[Dict[str, float]] = None,
+                autoscaling_config: Optional[Dict] = None) -> "Deployment":
         cfg = dataclasses.replace(
             self.config,
             num_replicas=num_replicas if num_replicas is not None
@@ -44,7 +49,9 @@ class Deployment:
             is not None else self.config.max_ongoing_requests,
             num_cpus=num_cpus if num_cpus is not None else self.config.num_cpus,
             num_tpus=num_tpus if num_tpus is not None else self.config.num_tpus,
-            resources=resources if resources is not None else self.config.resources)
+            resources=resources if resources is not None else self.config.resources,
+            autoscaling_config=autoscaling_config if autoscaling_config
+            is not None else self.config.autoscaling_config)
         return Deployment(self.func_or_class, name or self.name, cfg,
                           self.init_args, self.init_kwargs)
 
@@ -56,12 +63,13 @@ class Deployment:
 def deployment(func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 16,
                num_cpus: float = 0.0, num_tpus: float = 0.0,
-               resources: Optional[Dict[str, float]] = None):
+               resources: Optional[Dict[str, float]] = None,
+               autoscaling_config: Optional[Dict] = None):
     def wrap(target):
         return Deployment(
             target, name or target.__name__,
             DeploymentConfig(num_replicas, max_ongoing_requests, num_cpus,
-                             num_tpus, resources))
+                             num_tpus, resources, autoscaling_config))
 
     if func_or_class is not None:
         return wrap(func_or_class)

@@ -1,0 +1,90 @@
+"""Data-layer breadth: groupby shuffle, writes, zip/union, column ops.
+
+Reference test model: python/ray/data/tests/test_all_to_all.py,
+test_consumption.py, test_parquet.py.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _kv_dataset():
+    return rd.from_numpy({
+        "k": np.array([0, 1, 0, 1, 2, 0]),
+        "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0]),
+    }, parallelism=3)
+
+
+def test_groupby_aggregate(cluster):
+    out = _kv_dataset().groupby("k").sum("v").take_all()
+    got = {int(r["k"]): float(r["v_sum"]) for r in out}
+    assert got == {0: 10.0, 1: 6.0, 2: 5.0}
+
+    counts = {int(r["k"]): int(r["k_count"])
+              for r in _kv_dataset().groupby("k").count().take_all()}
+    assert counts == {0: 3, 1: 2, 2: 1}
+
+    means = {int(r["k"]): float(r["v_mean"])
+             for r in _kv_dataset().groupby("k").mean("v").take_all()}
+    assert means[1] == 3.0
+
+
+def test_groupby_map_groups(cluster):
+    out = _kv_dataset().groupby("k").map_groups(
+        lambda batch: {"k": batch["k"][:1], "spread":
+                       [float(batch["v"].max() - batch["v"].min())]})
+    got = {int(r["k"]): r["spread"] for r in out.take_all()}
+    assert got == {0: 5.0, 1: 2.0, 2: 0.0}
+
+
+def test_global_aggregates(cluster):
+    ds = _kv_dataset()
+    assert ds.sum("v") == 21.0
+    assert ds.min("v") == 1.0
+    assert ds.max("v") == 6.0
+    assert abs(ds.mean("v") - 3.5) < 1e-9
+
+
+def test_write_and_read_roundtrip(cluster, tmp_path):
+    ds = rd.range(100, parallelism=4)
+    files = ds.write_parquet(str(tmp_path / "pq"))
+    assert len(files) == 4
+    back = rd.read_parquet(str(tmp_path / "pq"))
+    assert back.count() == 100 and back.sum("id") == sum(range(100))
+
+    ds.write_csv(str(tmp_path / "csv"))
+    assert rd.read_csv(str(tmp_path / "csv")).count() == 100
+
+    ds.write_json(str(tmp_path / "json"))
+    assert rd.read_json(str(tmp_path / "json")).count() == 100
+
+
+def test_zip_union_columns(cluster):
+    a = rd.range(10, parallelism=2)
+    b = rd.from_numpy({"x": np.arange(10) * 10.0}, parallelism=2)
+    z = a.zip(b)
+    rows = z.take_all()
+    assert rows[3]["id"] == 3 and rows[3]["x"] == 30.0
+
+    u = a.union(a)
+    assert u.count() == 20
+
+    c = (a.add_column("sq", lambda batch: batch["id"] ** 2)
+          .select_columns(["sq"]))
+    assert c.take(3) == [{"sq": 0}, {"sq": 1}, {"sq": 4}]
+
+    r = a.rename_columns({"id": "index"})
+    assert "index" in r.take(1)[0]
+
+    s = rd.range(1000, parallelism=2).random_sample(0.1, seed=0)
+    assert 40 < s.count() < 200

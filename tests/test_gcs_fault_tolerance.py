@@ -1,0 +1,83 @@
+"""GCS fault tolerance: kill + restart the control plane mid-job.
+
+Reference test model: python/ray/tests/test_gcs_fault_tolerance.py (GCS
+restarts from Redis; raylets/workers reconnect and resubscribe —
+NotifyGCSRestart, node_manager.proto:401).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_gcs_restart_preserves_state_and_liveness():
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        counter = Counter.options(name="survivor").remote()
+        assert ray_tpu.get(counter.inc.remote(), timeout=60) == 1
+
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        assert ray_tpu.get(f.remote(21), timeout=60) == 42
+
+        c.kill_gcs()
+        # Direct actor calls bypass the GCS: they work while it is down.
+        assert ray_tpu.get(counter.inc.remote(), timeout=60) == 2
+
+        c.restart_gcs()
+        time.sleep(1.0)
+
+        # Control-plane state survived: named actor resolvable, node alive.
+        handle = ray_tpu.get_actor("survivor")
+        assert ray_tpu.get(handle.inc.remote(), timeout=60) == 3
+        nodes = [n for n in ray_tpu.nodes() if n["alive"]]
+        assert len(nodes) == 1
+
+        # New work (function registration goes through the restarted GCS KV).
+        @ray_tpu.remote
+        def g(x):
+            return x + 1
+
+        assert ray_tpu.get(g.remote(1), timeout=120) == 2
+
+        # New actors can be created after the restart.
+        c2 = Counter.remote()
+        assert ray_tpu.get(c2.inc.remote(), timeout=120) == 1
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_sqlite_store_roundtrip(tmp_path):
+    from ray_tpu.runtime.gcs.storage import SqliteStoreClient
+
+    s = SqliteStoreClient(str(tmp_path / "gcs.db"))
+    s.put("kv", b"a", b"1")
+    s.put("kv", b"ab", b"2")
+    s.put("nodes", b"n1", b"x")
+    assert s.get("kv", b"a") == b"1"
+    assert sorted(s.keys("kv", prefix=b"a")) == [b"a", b"ab"]
+    assert s.load_all("nodes") == [(b"n1", b"x")]
+    s.delete("kv", b"a")
+    assert s.get("kv", b"a") is None
+    s.close()
+    # Reopen: data survived.
+    s2 = SqliteStoreClient(str(tmp_path / "gcs.db"))
+    assert s2.get("kv", b"ab") == b"2"
+    s2.close()

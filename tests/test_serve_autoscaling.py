@@ -1,0 +1,69 @@
+"""Serve replica autoscaling: scale up under queue pressure, down when idle.
+
+Reference test model: python/ray/serve/tests/test_autoscaling_policy.py.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _replica_count(name):
+    return next(d["num_replicas"] for d in serve.status() if d["name"] == name)
+
+
+def test_autoscales_up_and_down(cluster):
+    @serve.deployment(name="slow", max_ongoing_requests=4,
+                      autoscaling_config={"min_replicas": 1,
+                                          "max_replicas": 3,
+                                          "target_ongoing_requests": 1,
+                                          "downscale_delay_s": 2.0})
+    class Slow:
+        def __call__(self, x):
+            time.sleep(1.0)
+            return x
+
+    handle = serve.run(Slow.bind())
+    assert _replica_count("slow") == 1
+
+    # Sustained concurrent load: average queue per replica >> target.
+    stop = time.monotonic() + 12
+    results = []
+
+    def hammer():
+        while time.monotonic() < stop:
+            results.append(handle.remote(1).result(timeout=30))
+
+    threads = [threading.Thread(target=hammer) for _ in range(6)]
+    for t in threads:
+        t.start()
+    scaled_up = False
+    while time.monotonic() < stop:
+        if _replica_count("slow") > 1:
+            scaled_up = True
+            break
+        time.sleep(0.5)
+    for t in threads:
+        t.join()
+    assert scaled_up, "deployment never scaled above 1 replica under load"
+    assert results and all(r == 1 for r in results)
+
+    # Idle: back down to min_replicas after the downscale delay.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if _replica_count("slow") == 1:
+            break
+        time.sleep(0.5)
+    assert _replica_count("slow") == 1, "did not scale back down"
