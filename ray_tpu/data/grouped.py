@@ -10,6 +10,7 @@ kernel. Aggregation math stays columnar (Arrow compute) end to end.
 
 from __future__ import annotations
 
+import zlib
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -28,11 +29,16 @@ def _partition_block(block: Block, key: str, num_partitions: int):
         empty = block.slice(0, 0)
         return [empty] * num_partitions if num_partitions > 1 else empty
     col = block.column(key).to_numpy(zero_copy_only=False)
-    # Stable hash per value (numpy-vectorized for numeric keys).
+    # Process-stable hash per value: map tasks run in different worker
+    # processes, so Python's salted hash() would route the same key to
+    # different reduce partitions. crc32 is deterministic and unsigned
+    # (numpy-vectorized for numeric keys).
     if col.dtype.kind in "iu":
         hashes = col.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
     else:
-        hashes = np.array([hash(v) for v in col.tolist()], dtype=np.uint64)
+        hashes = np.array(
+            [zlib.crc32(v if isinstance(v, bytes) else str(v).encode())
+             for v in col.tolist()], dtype=np.uint64)
     parts = (hashes % np.uint64(num_partitions)).astype(np.int64)
     out = []
     for p in range(num_partitions):

@@ -299,6 +299,10 @@ class RpcClient:
                 await self._writer.drain()
         except (ConnectionResetError, OSError) as e:
             self._pending.pop(msg_id, None)
+            # Mark the transport dead so the retry loop in call() redials
+            # instead of re-entering on the same broken writer (the recv
+            # task may not have observed the failure yet).
+            self._dead = True
             raise ConnectionLost(str(e))
         if timeout is not None:
             return await asyncio.wait_for(fut, timeout)

@@ -115,40 +115,69 @@ class ServeController:
             d.pop("downscale_since", None)
 
     def _scale_to(self, name: str, desired: int):
-        import cloudpickle  # noqa: F401  (replica payloads already bytes)
-
         from ray_tpu.serve.deployment import ReplicaActor
 
+        # Snapshot under the lock; start replicas and wait for health checks
+        # OUTSIDE it (a hung constructor must not block deploy()/routing of
+        # every other deployment); re-acquire to commit, re-validating that
+        # the deployment still exists at the same version.
         with self._lock:
             d = self.deployments.get(name)
             if d is None:
                 return
             current = len(d["replicas"])
-            if desired == current:
-                return
-            if desired > current:
-                Replica = ray_tpu.remote(ReplicaActor)
-                cfg = d["config"]
-                new = [Replica.options(
-                    num_cpus=cfg.get("num_cpus", 0),
-                    num_tpus=cfg.get("num_tpus", 0),
-                    max_concurrency=cfg.get("max_ongoing_requests", 16),
-                    resources=cfg.get("resources") or {}).remote(
-                        d["target_payload"], d["init_args"], d["init_kwargs"])
-                    for _ in range(desired - current)]
+            version = d["version"]
+            cfg = d["config"]
+            payload = d["target_payload"]
+            init_args, init_kwargs = d["init_args"], d["init_kwargs"]
+        if desired == current:
+            return
+        if desired > current:
+            Replica = ray_tpu.remote(ReplicaActor)
+            new = [Replica.options(
+                num_cpus=cfg.get("num_cpus", 0),
+                num_tpus=cfg.get("num_tpus", 0),
+                max_concurrency=cfg.get("max_ongoing_requests", 16),
+                resources=cfg.get("resources") or {}).remote(
+                    payload, init_args, init_kwargs)
+                for _ in range(desired - current)]
+            try:
                 ray_tpu.get([r.health_check.remote() for r in new],
                             timeout=300)
-                d["replicas"].extend(new)
-            else:
-                victims = d["replicas"][desired:]
-                d["replicas"] = d["replicas"][:desired]
-                for r in victims:
+            except Exception:
+                for r in new:
                     try:
                         ray_tpu.kill(r)
                     except Exception:
                         pass
-            self.version += 1
-            d["version"] = self.version
+                raise
+            with self._lock:
+                d = self.deployments.get(name)
+                if d is None or d["version"] != version:
+                    # Deployment replaced/deleted while we were starting.
+                    for r in new:
+                        try:
+                            ray_tpu.kill(r)
+                        except Exception:
+                            pass
+                    return
+                d["replicas"].extend(new)
+                self.version += 1
+                d["version"] = self.version
+        else:
+            with self._lock:
+                d = self.deployments.get(name)
+                if d is None or d["version"] != version:
+                    return
+                victims = d["replicas"][desired:]
+                d["replicas"] = d["replicas"][:desired]
+                self.version += 1
+                d["version"] = self.version
+            for r in victims:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
 
     def get_replicas(self, name: str) -> dict:
         d = self.deployments.get(name)

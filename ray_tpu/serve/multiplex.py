@@ -45,23 +45,33 @@ class Multiplexer:
             if model_id in self._models:
                 self._models.move_to_end(model_id)
                 return self._models[model_id]
-        # Load outside the lock (device transfers are slow); last writer wins
-        # on a racing double-load of the same id.
+        # Load outside the lock (device transfers are slow). If another
+        # thread loaded the same id while we did, keep the existing entry
+        # and unload our duplicate — dropping it silently would leak the
+        # device/HBM memory multiplexing exists to manage.
+        prev = getattr(_current_model_id, "value", None)
         _current_model_id.value = model_id
         try:
             model = self.load_fn(model_id)
         finally:
-            _current_model_id.value = None
-        evicted = None
+            _current_model_id.value = prev
+        evicted = []
+        duplicate = None
         with self._lock:
-            self._models[model_id] = model
-            self._models.move_to_end(model_id)
-            self.load_count += 1
-            if len(self._models) > self.max_num_models:
-                _, evicted = self._models.popitem(last=False)
-                self.evict_count += 1
-        if evicted is not None and self.unload_fn is not None:
-            self.unload_fn(evicted)
+            if model_id in self._models:
+                duplicate, model = model, self._models[model_id]
+                self._models.move_to_end(model_id)
+            else:
+                self._models[model_id] = model
+                self.load_count += 1
+                if len(self._models) > self.max_num_models:
+                    evicted.append(self._models.popitem(last=False)[1])
+                    self.evict_count += 1
+        if self.unload_fn is not None:
+            for m in evicted:
+                self.unload_fn(m)
+            if duplicate is not None:
+                self.unload_fn(duplicate)
         return model
 
     def loaded_model_ids(self):
@@ -71,11 +81,12 @@ class Multiplexer:
     def __call__(self, model_id: str, request: Any,
                  handler: Callable[[Any, Any], Any]) -> Any:
         model = self.get_model(model_id)
+        prev = getattr(_current_model_id, "value", None)
         _current_model_id.value = model_id
         try:
             return handler(model, request)
         finally:
-            _current_model_id.value = None
+            _current_model_id.value = prev
 
 
 def multiplexed(*, max_num_models_per_replica: int = 3,

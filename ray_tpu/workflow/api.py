@@ -161,10 +161,23 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
     nodes = dag.topo_sort()
     meta = store.read_meta()
     digest = _dag_digest(nodes)
+    try:
+        args_digest = hashlib.sha1(cloudpickle.dumps(
+            (args, sorted((kwargs or {}).items())))).hexdigest()
+    except Exception:
+        args_digest = None  # unpicklable args: skip the guard
     if meta and meta.get("digest") not in (None, digest):
         raise ValueError(
             f"workflow {workflow_id} already exists with a different DAG")
+    if (meta and args_digest is not None
+            and meta.get("args_digest") not in (None, args_digest)):
+        raise ValueError(
+            f"workflow {workflow_id} already exists with different inputs; "
+            f"resuming it would return results computed from the old args. "
+            f"Use a new workflow_id (or workflow.resume() to continue the "
+            f"original inputs).")
     store.write_meta({"workflow_id": workflow_id, "digest": digest,
+                      "args_digest": args_digest,
                       "status": "RUNNING", "created_at": time.time(),
                       "updated_at": time.time()})
     try:
