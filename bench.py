@@ -59,11 +59,16 @@ def init_backend(retries: int = 3, backoff_s: float = 10.0,
                 [sys.executable, "-c",
                  "import jax; print('PLATFORM=' + jax.default_backend())"],
                 capture_output=True, text=True, timeout=probe_timeout_s)
+            platform = None
             for line in r.stdout.splitlines():
                 if line.startswith("PLATFORM="):
                     platform = line.split("=", 1)[1]
-                    if platform == "tpu":
-                        return "tpu"
+            if platform == "tpu":
+                return "tpu"
+            if r.returncode == 0 and platform is not None:
+                # Clean probe, no TPU plugin: a definitive answer — don't
+                # burn retries/backoff re-asking it.
+                break
             print(f"bench: probe {attempt + 1}/{retries} got non-tpu "
                   f"backend (rc={r.returncode}); stderr tail: "
                   f"{r.stderr[-300:]}", file=sys.stderr)

@@ -32,6 +32,7 @@ from ray_tpu.core.exceptions import (  # noqa: F401
     TaskError,
     WorkerCrashedError,
 )
+from ray_tpu.core.generator import ObjectRefGenerator  # noqa: F401
 from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
 
 __version__ = "0.1.0"

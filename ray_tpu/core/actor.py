@@ -31,7 +31,9 @@ class ActorMethod:
             num_returns=self._num_returns,
             name=f"{self._handle._class_name}.{self._method_name}",
             max_task_retries=self._handle._max_task_retries)
-        return refs[0] if self._num_returns == 1 else refs
+        if self._num_returns in (1, "streaming"):
+            return refs[0]
+        return refs
 
     def bind(self, *args, **kwargs):
         """Build a DAG node for this method call (ray_tpu.dag)."""

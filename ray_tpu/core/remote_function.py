@@ -63,7 +63,9 @@ class RemoteFunction:
             scheduling_strategy=strategy,
             placement_group_id=pg_id, bundle_index=bundle_index,
             runtime_env=self._runtime_env)
-        return refs[0] if self._num_returns == 1 else refs
+        if self._num_returns in (1, "streaming"):
+            return refs[0]
+        return refs
 
     def bind(self, *args, **kwargs):
         """Build a DAG node for this task call (ray_tpu.dag)."""

@@ -28,6 +28,7 @@ from ray_tpu.core import serialization
 from ray_tpu.core.exceptions import (
     ActorDiedError, GetTimeoutError, ObjectLostError, RayTpuError, TaskError,
     WorkerCrashedError)
+from ray_tpu.core.generator import ObjectRefGenerator, _GeneratorState
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import ActorSpec, TaskSpec
 from ray_tpu.runtime.object_store import ObjectNotFoundError, ObjectStore
@@ -90,6 +91,7 @@ class CoreWorker:
         self._actor_clients: Dict[bytes, "_ActorClient"] = {}
         self._put_refs: set = set()                   # plasma ids this process created
         self._lineage: Dict[bytes, dict] = {}         # return oid -> lineage record
+        self._generators: Dict[bytes, _GeneratorState] = {}  # task_id -> state
         self.current_actor_id: Optional[bytes] = None
         self.current_task_name: Optional[str] = None
         self.job_id = None
@@ -354,6 +356,45 @@ class CoreWorker:
                 kwargs[name] = value
         return args, kwargs
 
+    # ------------------------------------------------------- streaming items
+
+    async def _on_worker_push(self, method: str, data: dict):
+        """Pushes from executor workers back to this (submitting) process.
+        Currently: streaming-generator item reports (the
+        ReportGeneratorItemReturns analog, core_worker.proto:462)."""
+        if method != "gen_item":
+            logger.warning("unexpected worker push %r", method)
+            return
+        task_id = data["task_id"]
+        index = data["index"]
+        oid = ObjectID.for_task_return(TaskID(task_id), index).binary()
+        node_id = data.get("node_id")
+        if "payload" in data:
+            with self._mem_lock:
+                self.memory_store[oid] = serialization.deserialize(
+                    data["payload"])
+        elif node_id is not None:
+            self._object_locations[oid] = node_id
+        gen = self._generators.get(task_id)
+        if gen is not None:
+            gen.push(index, ObjectRef(oid, owner=node_id))
+
+    def _make_generator(self, task_id: bytes) -> ObjectRefGenerator:
+        state = _GeneratorState()
+        self._generators[task_id] = state
+        return ObjectRefGenerator(task_id, state)
+
+    STREAMING = -1  # num_returns sentinel on the wire
+
+    @classmethod
+    def _normalize_num_returns(cls, num_returns) -> int:
+        if num_returns == "streaming":
+            return cls.STREAMING
+        n = int(num_returns)
+        if n < 0 and n != cls.STREAMING:
+            raise ValueError(f"invalid num_returns {num_returns!r}")
+        return n
+
     # ------------------------------------------------------------ normal tasks
 
     def submit_task(self, fn, args, kwargs, *, name: str, num_returns: int,
@@ -363,6 +404,7 @@ class CoreWorker:
         from ray_tpu import runtime_env as renv_mod
 
         fn_id = self.register_function(fn)
+        num_returns = self._normalize_num_returns(num_returns)
         ser_args, names = self.serialize_args(args, kwargs)
         task_id = TaskID.generate().binary()
         runtime_env = renv_mod.prepare_runtime_env(
@@ -374,6 +416,10 @@ class CoreWorker:
             placement_group_id=placement_group_id,
             placement_group_bundle_index=bundle_index,
             runtime_env=runtime_env)
+        if num_returns == self.STREAMING:
+            gen = self._make_generator(task_id)
+            self.io.spawn(self._submit_async(spec))
+            return [gen]
         refs = [ObjectRef(ObjectID.for_task_return(TaskID(task_id), i).binary(),
                           owner=self.node_id)
                 for i in range(num_returns)]
@@ -540,7 +586,8 @@ class CoreWorker:
                               tuple(reply["worker_address"]), reply["node_id"],
                               target)
         try:
-            lease.client = RpcClient(*lease.address)
+            lease.client = RpcClient(*lease.address,
+                                     on_push=self._on_worker_push)
             await lease.client.connect(timeout=15)
         except Exception:
             await self._return_lease(state, lease, dead=True)
@@ -606,7 +653,11 @@ class CoreWorker:
         except (ConnectionLost, OSError):
             state.leases.remove(lease)
             await self._return_lease(state, lease, dead=True)
-            if spec.max_retries > 0:
+            # Streaming tasks never retry transparently: items already
+            # consumed by the caller cannot be un-yielded, so a re-execution
+            # would duplicate them (the reference checkpoints the consumed
+            # index; we surface the failure instead).
+            if spec.max_retries > 0 and spec.num_returns != self.STREAMING:
                 spec.max_retries -= 1
                 logger.warning("task %s worker died; retrying", spec.name)
                 state.queue.append(spec)
@@ -662,6 +713,15 @@ class CoreWorker:
             await lease.client.close()
 
     def _complete_task(self, spec: TaskSpec, reply: dict):
+        if spec.num_returns == self.STREAMING:
+            gen = self._generators.pop(spec.task_id, None)
+            if gen is None:
+                return
+            if reply["status"] == "ok":
+                gen.finish(reply["streamed"])
+            else:
+                gen.fail(reply["error"], reply.get("streamed"))
+            return
         if reply["status"] == "ok":
             returns = reply["returns"]
             node_id = reply.get("node_id")
@@ -681,6 +741,11 @@ class CoreWorker:
             self._complete_error(spec, err)
 
     def _complete_error(self, spec: TaskSpec, err: RayTpuError):
+        if spec.num_returns == self.STREAMING:
+            gen = self._generators.pop(spec.task_id, None)
+            if gen is not None:
+                gen.fail(err)
+            return
         with self._mem_lock:
             for i in range(spec.num_returns):
                 oid = ObjectID.for_task_return(TaskID(spec.task_id), i).binary()
@@ -697,22 +762,61 @@ class CoreWorker:
     def submit_actor_task(self, actor_id: bytes, method_name: str, args, kwargs,
                           *, num_returns: int, name: str,
                           max_task_retries: int = 0) -> List[ObjectRef]:
+        num_returns = self._normalize_num_returns(num_returns)
         ser_args, names = self.serialize_args(args, kwargs)
         task_id = TaskID.generate().binary()
         spec = TaskSpec(task_id=task_id, fn_id=b"", name=name, args=ser_args,
                         kwarg_names=names, num_returns=num_returns,
                         max_retries=max_task_retries, actor_id=actor_id,
                         method_name=method_name)
+        client = self._actor_clients.get(actor_id)
+        if client is None:
+            client = self._actor_clients.setdefault(actor_id, _ActorClient(self, actor_id))
+        if num_returns == self.STREAMING:
+            gen = self._make_generator(task_id)
+            self.io.spawn(client.enqueue(spec))
+            return [gen]
         refs = [ObjectRef(ObjectID.for_task_return(TaskID(task_id), i).binary())
                 for i in range(num_returns)]
         with self._mem_lock:
             for ref in refs:
                 self.result_futures[ref.binary()] = SyncFuture()
-        client = self._actor_clients.get(actor_id)
-        if client is None:
-            client = self._actor_clients.setdefault(actor_id, _ActorClient(self, actor_id))
         self.io.spawn(client.enqueue(spec))
         return refs
+
+    def actor_stats(self, actor_id: bytes, timeout: float = 5.0) -> dict:
+        """Query an actor worker's execution stats (queued + ongoing actor
+        tasks) over a direct RPC served on the worker's IO loop — never
+        queued behind user code (used by serve autoscaling)."""
+        return self.actor_stats_many([actor_id], timeout=timeout)[0]
+
+    def actor_stats_many(self, actor_ids: Sequence[bytes],
+                         timeout: float = 5.0) -> List[Optional[dict]]:
+        """Concurrent actor_stats over many actors; one wall-clock timeout
+        budget for the whole batch. Unreachable actors yield None (their
+        query coroutine is cancelled, not leaked)."""
+        clients = []
+        for actor_id in actor_ids:
+            client = self._actor_clients.get(actor_id)
+            if client is None:
+                client = self._actor_clients.setdefault(
+                    actor_id, _ActorClient(self, actor_id))
+            clients.append(client)
+
+        async def _one(client):
+            try:
+                await client._ensure_connected()
+                return await client.client.call("actor_stats", timeout=timeout)
+            except Exception:
+                return None
+
+        async def _all():
+            return await asyncio.gather(
+                *(asyncio.wait_for(_one(c), timeout) for c in clients),
+                return_exceptions=True)
+
+        results = self.io.run(_all(), timeout=timeout + 5)
+        return [r if isinstance(r, dict) else None for r in results]
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
         self.io.run(self.gcs.call("kill_actor", actor_id=actor_id,
@@ -746,7 +850,18 @@ class CoreWorker:
 
 class _ActorClient:
     """Direct submission channel to one actor (actor_task_submitter.h:75):
-    sequence numbers, ordered delivery, reconnect-on-restart."""
+    sequence numbers, ordered delivery, reconnect-on-restart.
+
+    Submission is PIPELINED: up to MAX_INFLIGHT calls are outstanding at
+    once, so a concurrent actor (max_concurrency > 1, or async methods)
+    actually executes concurrently. Sends still happen in seq_no order (the
+    pump creates call tasks in order; writes are FIFO under the client's
+    write lock), so serial actors keep per-caller execution order. After a
+    reconnect (actor restart), retried calls may re-arrive out of order
+    relative to each other — matching the reference's at-most-once,
+    retry-opt-in semantics."""
+
+    MAX_INFLIGHT = 128
 
     def __init__(self, core: CoreWorker, actor_id: bytes):
         self.core = core
@@ -756,6 +871,7 @@ class _ActorClient:
         self.connect_lock = asyncio.Lock()
         self._queue: asyncio.Queue = asyncio.Queue()
         self._pump_task: Optional[asyncio.Task] = None
+        self._sem = asyncio.Semaphore(self.MAX_INFLIGHT)
 
     async def enqueue(self, spec: TaskSpec):
         """Per-caller FIFO: one pump drains the queue so wire order ==
@@ -768,10 +884,20 @@ class _ActorClient:
         while not self._queue.empty():
             spec = self._queue.get_nowait()
             try:
-                await self.submit(spec)
+                dep_err = await self.core._resolve_dependencies(spec)
             except Exception as e:
+                # A failed dependency resolve must not kill the pump (that
+                # would strand every queued spec with hung result futures).
                 self.core._complete_error(spec, ActorDiedError(
-                    self.actor_id.hex(), f"submit failed: {e!r}"))
+                    self.actor_id.hex(), f"dependency resolution failed: {e!r}"))
+                continue
+            if dep_err is not None:
+                self.core._complete_error(spec, dep_err)
+                continue
+            spec.seq_no = self.seq_no
+            self.seq_no += 1
+            await self._sem.acquire()
+            asyncio.ensure_future(self._call_one(spec))
 
     async def _ensure_connected(self):
         if self.client is not None:
@@ -786,7 +912,8 @@ class _ActorClient:
                     raise ActorDiedError(self.actor_id.hex(), "unknown actor")
                 state = info["state"]
                 if state == "ALIVE":
-                    client = RpcClient(*info["address"])
+                    client = RpcClient(*info["address"],
+                                       on_push=self.core._on_worker_push)
                     await client.connect(timeout=15)
                     self.client = client
                     return
@@ -798,33 +925,44 @@ class _ActorClient:
                                          f"stuck in state {state}")
                 await asyncio.sleep(0.1)
 
-    async def submit(self, spec: TaskSpec):
-        dep_err = await self.core._resolve_dependencies(spec)
-        if dep_err is not None:
-            self.core._complete_error(spec, dep_err)
-            return
-        spec.seq_no = self.seq_no
-        self.seq_no += 1
-        attempts = spec.max_retries + 1
-        while attempts > 0:
-            attempts -= 1
-            try:
-                await self._ensure_connected()
-                reply = await self.client.call("push_actor_task", spec=spec)
-                self.core._complete_task(spec, reply)
-                return
-            except (ConnectionLost, OSError) as e:
-                # Connection died: drop the client; next attempt re-resolves
-                # the address (actor may be restarting).
-                if self.client is not None:
-                    await self.client.close()
-                    self.client = None
-                last_err = e
-            except ActorDiedError as e:
-                self.core._complete_error(spec, e)
-                return
-        self.core._complete_error(
-            spec, ActorDiedError(self.actor_id.hex(), f"connection lost: {last_err!r}"))
+    async def _drop_client(self, client: Optional[RpcClient]):
+        """Close-once under concurrent failures: only the task whose client
+        reference is still current tears it down."""
+        if client is not None and self.client is client:
+            self.client = None
+            await client.close()
+
+    async def _call_one(self, spec: TaskSpec):
+        try:
+            # Streaming methods never retry transparently (items already
+            # consumed cannot be un-yielded; see _run_on_lease).
+            attempts = (1 if spec.num_returns == CoreWorker.STREAMING
+                        else spec.max_retries + 1)
+            last_err: Optional[BaseException] = None
+            client: Optional[RpcClient] = None
+            while attempts > 0:
+                attempts -= 1
+                try:
+                    await self._ensure_connected()
+                    client = self.client
+                    reply = await client.call("push_actor_task", spec=spec)
+                    self.core._complete_task(spec, reply)
+                    return
+                except (ConnectionLost, OSError) as e:
+                    # Connection died: drop the client; next attempt
+                    # re-resolves the address (actor may be restarting).
+                    await self._drop_client(client)
+                    last_err = e
+                except ActorDiedError as e:
+                    self.core._complete_error(spec, e)
+                    return
+            self.core._complete_error(spec, ActorDiedError(
+                self.actor_id.hex(), f"connection lost: {last_err!r}"))
+        except Exception as e:
+            self.core._complete_error(spec, ActorDiedError(
+                self.actor_id.hex(), f"submit failed: {e!r}"))
+        finally:
+            self._sem.release()
 
 
 # ---------------------------------------------------------------- globals

@@ -185,7 +185,14 @@ def next_token_ce(logits: jax.Array, targets: jax.Array,
 
 def _layer(config: LlamaConfig, x, layer_params, cos, sin):
     """One decoder layer. x: (b, s, d)."""
+    from ray_tpu.parallel.sharding import constrain
+
     p = layer_params
+    # Keep the loop-carried activation on (batch, seq, None) inside the
+    # scan: left to propagation, GSPMD picks a d-over-fsdp carry sharding
+    # (resharding activations instead of all-gathering weights) and
+    # full-rematerializes every layer.
+    x = constrain(x, ("batch", "seq", None))
     x = attention_sublayer(config, x, p, cos, sin)
     h = rms_norm(x, p["mlp_norm"], config.norm_eps)
     x = x + (swiglu(h @ p["w_gate"], h @ p["w_up"]) @ p["w_down"])
@@ -194,8 +201,14 @@ def _layer(config: LlamaConfig, x, layer_params, cos, sin):
 
 def forward(params: Dict, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
     """tokens: (b, s) int32 -> logits (b, s, vocab) float32."""
+    from ray_tpu.parallel.sharding import constrain
+
     cos, sin = rope_frequencies(config.head_dim, config.max_seq, config.rope_theta)
     x = params["embed"][tokens].astype(config.dtype)
+    # Pin the activation layout at the gather output: without this, GSPMD
+    # propagates a degenerate sharding out of the (vocab, embed)-sharded
+    # table and full-rematerializes (an all-replicate per step).
+    x = constrain(x, ("batch", "seq", None))
 
     layer_fn = partial(_layer, config)
     if config.remat:
@@ -207,7 +220,9 @@ def forward(params: Dict, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], config.norm_eps)
+    x = constrain(x, ("batch", "seq", None))
     logits = (x @ params["lm_head"].astype(config.dtype)).astype(jnp.float32)
+    logits = constrain(logits, ("batch", "seq", "vocab"))
     return logits
 
 

@@ -193,11 +193,20 @@ def moe_block(config: MoEConfig, x: jax.Array, router: jax.Array,
     dispatch = masked_slot.sum(axis=2)
     combine = jnp.einsum("bsk,bskec->bsec", gate_vals, masked_slot)
 
+    from ray_tpu.parallel.sharding import constrain
+
     xin = jnp.einsum("bsec,bsd->ebcd", dispatch, x.astype(jnp.float32))
     xin = xin.astype(config.dtype)
+    # Expert-parallel layout for the dispatched tokens: experts over ep (the
+    # dispatch einsum becomes the all-to-all), batch keeps (dp, fsdp), d
+    # replicated so the fsdp-sharded expert weights all-gather (FSDP) rather
+    # than forcing a degenerate activation reshard.
+    xin = constrain(xin, ("expert", "moe_batch", None, None))
     h = swiglu(jnp.einsum("ebcd,edf->ebcf", xin, w_gate),
                jnp.einsum("ebcd,edf->ebcf", xin, w_up))
+    h = constrain(h, ("expert", "moe_batch", None, "mlp"))
     out_e = jnp.einsum("ebcf,efd->ebcd", h, w_down)
+    out_e = constrain(out_e, ("expert", "moe_batch", None, None))
     out = jnp.einsum("bsec,ebcd->bsd", combine,
                      out_e.astype(jnp.float32)).astype(x.dtype)
 
@@ -227,9 +236,13 @@ def _layer(config: MoEConfig, x, layer_params, cos, sin):
 def forward(params: Dict, tokens: jax.Array,
             config: MoEConfig) -> Tuple[jax.Array, Dict]:
     """tokens: (b, s) int32 -> (logits (b, s, vocab) f32, mean aux losses)."""
+    from ray_tpu.parallel.sharding import constrain
+
     cos, sin = rope_frequencies(config.head_dim, config.max_seq,
                                 config.rope_theta)
     x = params["embed"][tokens].astype(config.dtype)
+    # Pin the gather output layout (see models/llama.py forward).
+    x = constrain(x, ("batch", "seq", None))
 
     layer_fn = partial(_layer, config)
     if config.remat:
@@ -243,7 +256,9 @@ def forward(params: Dict, tokens: jax.Array,
     x, aux = jax.lax.scan(scan_body, x, params["layers"])
     aux = jax.tree.map(jnp.mean, aux)  # mean over layers
     x = rms_norm(x, params["final_norm"], config.norm_eps)
+    x = constrain(x, ("batch", "seq", None))
     logits = (x @ params["lm_head"].astype(config.dtype)).astype(jnp.float32)
+    logits = constrain(logits, ("batch", "seq", "vocab"))
     return logits, aux
 
 

@@ -1,0 +1,181 @@
+"""Ragged paged attention: the serving-decode hot op.
+
+Reference analog: the paged-attention CUDA kernels inside vLLM, which the
+reference repo only places (python/ray/llm/_internal/serve/deployments/llm/
+vllm/vllm_engine.py:222). TPU-native design: one kernel serves BOTH decode
+(one query token per sequence) and chunked prefill (a block of query tokens
+per sequence) — "ragged" means each sequence in the batch has its own query
+count and context length; shapes stay static (bucketed) and per-sequence
+lengths arrive as scalar-prefetch operands.
+
+Layouts:
+  q:            (S, Bq, H, hd)  — Bq = query tokens per sequence this step
+                                  (1 for decode, chunk size for prefill)
+  k/v pages:    (K, P, ps, hd)  — per-layer paged KV pool, K = kv heads
+  block_tables: (S, max_pages)  int32, logical page i of seq s -> pool page
+  kv_lens:      (S,) int32      — context length INCLUDING this step's tokens
+  q_positions:  (S,) int32      — absolute position of q[s, 0]
+
+The Pallas kernel walks only ceil(kv_len/ps) real pages per sequence
+(double-buffered HBM->VMEM DMA), so decode cost is O(actual context), not
+O(max context) — the property the round-1 jnp gather lacked.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def ragged_paged_attention_reference(
+        q, k_pages, v_pages, block_tables, kv_lens, q_positions, *,
+        scale: Optional[float] = None):
+    """jnp reference (CPU tests + fallback). Gathers the full padded context;
+    the Pallas kernel below is the O(actual-context) implementation."""
+    S, Bq, H, hd = q.shape
+    K, P, ps, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+    max_ctx = max_pages * ps
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+    # (K, S, max_pages, ps, hd) -> (S, max_ctx, K, hd)
+    k = k_pages[:, block_tables].transpose(1, 2, 3, 0, 4).reshape(
+        S, max_ctx, K, hd)
+    v = v_pages[:, block_tables].transpose(1, 2, 3, 0, 4).reshape(
+        S, max_ctx, K, hd)
+    if K != H:
+        rep = H // K
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("sqhd,skhd->shqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    k_pos = jnp.arange(max_ctx)[None, None, None, :]
+    q_abs = (q_positions[:, None] + jnp.arange(Bq)[None, :])[:, None, :, None]
+    mask = (k_pos < kv_lens[:, None, None, None]) & (q_abs >= k_pos)
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("shqk,skhd->sqhd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _rpa_kernel(block_tables_ref, kv_lens_ref, q_pos_ref,   # scalar prefetch
+                q_ref, kpages_hbm, vpages_hbm,              # tensor inputs
+                o_ref,                                      # output
+                k_scr, v_scr, sems,                         # scratch
+                *, ps: int, scale: float, Bq: int, G: int, hd: int,
+                max_pages: int):
+    """Grid: (S, K). Block q_ref/o_ref: (1, 1, Bq*G, hd) — the query rows of
+    kv-head `kh` for sequence `s`. KV pages stay in HBM; each page is
+    double-buffer DMA'd into VMEM and folded into an online softmax."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s = pl.program_id(0)
+    kh = pl.program_id(1)
+    kv_len = kv_lens_ref[s]
+    q_pos = q_pos_ref[s]
+    n_pages = pl.cdiv(kv_len, ps)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # (Bq*G, hd)
+    rows = Bq * G
+    # Absolute position of each query row (row r belongs to query r // G).
+    q_abs = q_pos + jax.lax.broadcasted_iota(jnp.int32, (rows, ps), 0) // G
+
+    def page_dma(slot, i):
+        page = block_tables_ref[s, i]
+        return (pltpu.make_async_copy(kpages_hbm.at[kh, page], k_scr.at[slot],
+                                      sems.at[slot, 0]),
+                pltpu.make_async_copy(vpages_hbm.at[kh, page], v_scr.at[slot],
+                                      sems.at[slot, 1]))
+
+    kd, vd = page_dma(0, 0)
+    kd.start()
+    vd.start()
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            nk, nv = page_dma(1 - slot, i + 1)
+            nk.start()
+            nv.start()
+
+        kw, vw = page_dma(slot, i)
+        kw.wait()
+        vw.wait()
+        k_page = k_scr[slot].astype(jnp.float32)          # (ps, hd)
+        v_page = v_scr[slot].astype(jnp.float32)
+        sc = q @ k_page.T                                 # (rows, ps)
+        k_pos = i * ps + jax.lax.broadcasted_iota(jnp.int32, (rows, ps), 1)
+        valid = (k_pos < kv_len) & (q_abs >= k_pos)
+        sc = jnp.where(valid, sc, NEG_INF)
+        m_new = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+        acc_new = alpha * acc + p @ v_page
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((rows, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((rows, 1), dtype=jnp.float32)
+    a0 = jnp.zeros((rows, hd), dtype=jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, a0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
+                           q_positions, *, scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    """Pallas ragged paged attention (see module docstring for layouts)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, Bq, H, hd = q.shape
+    K, P, ps, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+    G = H // K
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # (S, Bq, H, hd) -> (S, K, Bq*G, hd): rows of one kv head contiguous.
+    qt = q.reshape(S, Bq, K, G, hd).transpose(0, 2, 1, 3, 4).reshape(
+        S, K, Bq * G, hd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, K),
+        in_specs=[
+            pl.BlockSpec((1, 1, Bq * G, hd), lambda s, kh, *_: (s, kh, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # k pages stay in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # v pages stay in HBM
+        ],
+        out_specs=pl.BlockSpec((1, 1, Bq * G, hd),
+                               lambda s, kh, *_: (s, kh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, hd), k_pages.dtype),
+            pltpu.VMEM((2, ps, hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _rpa_kernel, ps=ps, scale=scale, Bq=Bq, G=G, hd=hd,
+        max_pages=max_pages)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, K, Bq * G, hd), q.dtype),
+        interpret=interpret,
+    )(block_tables, kv_lens, q_positions, qt, k_pages, v_pages)
+    return out.reshape(S, K, Bq, G, hd).transpose(0, 2, 1, 3, 4).reshape(
+        S, Bq, H, hd)

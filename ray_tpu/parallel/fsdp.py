@@ -98,7 +98,7 @@ def build_train_step(
         }
 
     def init_fn(params):
-        with use_mesh(mesh):
+        with use_mesh(mesh, rules):
             abstract = jax.eval_shape(partial(init_train_state, optimizer=optimizer),
                                       params)
             shardings = _state_shardings(abstract)
@@ -116,7 +116,7 @@ def build_train_step(
                  out_shardings=(state_shardings, repl),
                  donate_argnums=(0,))
         def step_fn(state, batch):
-            with use_mesh(mesh):
+            with use_mesh(mesh, rules):
                 (loss, metrics), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(state["params"], batch)
                 updates, opt_state = optimizer.update(

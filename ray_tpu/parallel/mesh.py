@@ -82,30 +82,40 @@ def build_mesh(config: MeshConfig, devices: Optional[Sequence] = None):
 
 
 _CURRENT_MESH = None
+_CURRENT_RULES = None
 
 
 class use_mesh:
-    """Context manager installing `mesh` as the ambient mesh (used by model
-    code that needs explicit shard_map, e.g. ring attention)."""
+    """Context manager installing `mesh` (and optionally the active
+    logical-axis `rules`) as ambient state. Model code uses it for explicit
+    shard_map (ring attention) and activation sharding constraints
+    (sharding.constrain)."""
 
-    def __init__(self, mesh):
+    def __init__(self, mesh, rules=None):
         self.mesh = mesh
+        self.rules = rules
         self._prev = None
 
     def __enter__(self):
-        global _CURRENT_MESH
-        self._prev = _CURRENT_MESH
+        global _CURRENT_MESH, _CURRENT_RULES
+        self._prev = (_CURRENT_MESH, _CURRENT_RULES)
         _CURRENT_MESH = self.mesh
+        if self.rules is not None:
+            _CURRENT_RULES = self.rules
         return self.mesh
 
     def __exit__(self, *exc):
-        global _CURRENT_MESH
-        _CURRENT_MESH = self._prev
+        global _CURRENT_MESH, _CURRENT_RULES
+        _CURRENT_MESH, _CURRENT_RULES = self._prev
         return False
 
 
 def current_mesh():
     return _CURRENT_MESH
+
+
+def current_rules():
+    return _CURRENT_RULES
 
 
 def single_device_mesh():

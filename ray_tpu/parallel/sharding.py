@@ -28,6 +28,9 @@ TRAIN_RULES: Rules = {
     "mlp": "tp",
     "vocab": "tp",
     "expert": "ep",
+    # Batch axis of expert-dispatched activations (e, b, cap, d): the ep
+    # component of "batch" moves to the expert dim, so batch keeps (dp, fsdp).
+    "moe_batch": ("dp", "fsdp"),
     "layers": None,
     "conv_io": None,
 }
@@ -44,6 +47,7 @@ SERVE_RULES: Rules = {
     "mlp": "tp",
     "vocab": "tp",
     "expert": "ep",
+    "moe_batch": None,
     "layers": None,
     "pages": "dp",
 }
@@ -82,6 +86,29 @@ def shard_tree(tree, logical_tree, rules: Rules, mesh):
     specs = tree_specs(logical_tree, rules)
     return jax.tree.map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), tree, specs)
+
+
+def constrain(x, logical_axes: Sequence[Optional[str]],
+              rules: Optional[Rules] = None):
+    """with_sharding_constraint by logical axis names, using the ambient
+    mesh/rules (parallel.mesh.use_mesh). No-op outside a mesh context, so
+    model code can call it unconditionally.
+
+    This pins activation shardings at layout-transition points (embedding
+    gather output, pre-logits hidden state) where GSPMD's propagation
+    otherwise picks degenerate transitions ("involuntary full
+    rematerialization" — an all-replicate per step on real hardware)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ray_tpu.parallel.mesh import current_mesh, current_rules
+
+    mesh = current_mesh()
+    rules = rules if rules is not None else current_rules()
+    if mesh is None or rules is None:
+        return x
+    spec = spec_for(logical_axes, rules)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 def named_sharding_tree(logical_tree, rules: Rules, mesh):

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import inspect
 import logging
 import os
 import sys
@@ -55,8 +56,12 @@ class WorkerRuntime:
         self.actor_instance = None
         self.actor_spec: Optional[ActorSpec] = None
         self._raylet_client: Optional[RpcClient] = None
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._async_sem: Optional[asyncio.Semaphore] = None
+        self._tasks_pending = 0   # pushed, not yet finished (queued + running)
 
     async def start(self):
+        self.loop = asyncio.get_event_loop()
         # CoreWorker first: user code needs the full API during tasks.
         self.core = CoreWorker(
             mode="worker", gcs_address=self.gcs_addr,
@@ -106,7 +111,79 @@ class WorkerRuntime:
 
     # ---- task execution ---------------------------------------------------
 
-    def _execute(self, fn, spec: TaskSpec) -> dict:
+    STREAMING = -1  # num_returns sentinel (see CoreWorker.STREAMING)
+
+    def _seal_return(self, oid: bytes, segments, total: int) -> None:
+        """Write one large return value into the local plasma store."""
+        store = self.core.store
+        if store.contains(oid):
+            # Retry of a task whose previous attempt already sealed this
+            # return: reuse it (ids are deterministic).
+            return
+        # A crashed previous attempt may have left an unsealed create
+        # behind; reclaim the id.
+        store.abort(oid)
+        buf = self.core.spill_create(oid, total)
+        try:
+            serialization.write_segments(buf, segments)
+        except BaseException:
+            buf.release()
+            store.abort(oid)
+            raise
+        buf.release()
+        store.seal(oid)
+
+    def _package_returns(self, spec: TaskSpec, result) -> list:
+        returns = []
+        values = (result,) if spec.num_returns == 1 else tuple(result)
+        if spec.num_returns > 1 and len(values) != spec.num_returns:
+            raise ValueError(
+                f"task declared num_returns={spec.num_returns} but returned "
+                f"{len(values)} values")
+        for i, value in enumerate(values):
+            segments, total = serialization.serialize(value)
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), i).binary()
+            if total <= INLINE_RESULT_MAX:
+                returns.append(("v", serialization.join_segments(segments)))
+            else:
+                self._seal_return(oid, segments, total)
+                returns.append(("r", oid))
+        return returns
+
+    def _push_gen_item(self, conn, spec: TaskSpec, index: int, value) -> None:
+        """Report one yielded item to the submitter (blocking, from the exec
+        thread): small values ride the push inline; large values seal to the
+        local plasma store and only the location is pushed.
+        ReportGeneratorItemReturns analog (core_worker.proto:462)."""
+        segments, total = serialization.serialize(value)
+        msg = {"task_id": spec.task_id, "index": index,
+               "node_id": self.node_id}
+        if total <= INLINE_RESULT_MAX or self.core.store is None:
+            msg["payload"] = serialization.join_segments(segments)
+        else:
+            oid = ObjectID.for_task_return(TaskID(spec.task_id), index).binary()
+            self._seal_return(oid, segments, total)
+        asyncio.run_coroutine_threadsafe(
+            conn.push("gen_item", msg), self.loop).result(timeout=60)
+
+    def _stream_generator(self, conn, spec: TaskSpec, gen) -> dict:
+        """Drain a sync generator, pushing each item; the final reply carries
+        the item count (also on error, so the caller drains then raises)."""
+        count = 0
+        try:
+            for item in gen:
+                self._push_gen_item(conn, spec, count, item)
+                count += 1
+            return {"status": "ok", "streamed": count,
+                    "node_id": self.node_id}
+        except Exception as e:
+            tb = traceback.format_exc()
+            logger.error("streaming task %s failed at item %d:\n%s",
+                         spec.name, count, tb)
+            return {"status": "error", "streamed": count,
+                    "error": TaskError(spec.name, tb, cause=_safe_cause(e))}
+
+    def _execute(self, fn, spec: TaskSpec, conn=None) -> dict:
         """Runs on the exec thread; returns the RPC reply."""
         from ray_tpu import runtime_env as renv_mod
         from ray_tpu.util import tracing
@@ -120,37 +197,18 @@ class WorkerRuntime:
             with tracing.span(spec.name, "task:execute",
                               task_id=spec.task_id.hex()[:12]):
                 result = fn(*args, **kwargs)
-            returns = []
-            values = (result,) if spec.num_returns == 1 else tuple(result)
-            if spec.num_returns > 1 and len(values) != spec.num_returns:
-                raise ValueError(
-                    f"task declared num_returns={spec.num_returns} but returned "
-                    f"{len(values)} values")
-            for i, value in enumerate(values):
-                segments, total = serialization.serialize(value)
-                oid = ObjectID.for_task_return(TaskID(spec.task_id), i).binary()
-                if total <= INLINE_RESULT_MAX:
-                    returns.append(("v", serialization.join_segments(segments)))
-                else:
-                    store = self.core.store
-                    if store.contains(oid):
-                        # Retry of a task whose previous attempt already sealed
-                        # this return: reuse it (ids are deterministic).
-                        returns.append(("r", oid))
-                        continue
-                    # A crashed previous attempt may have left an unsealed
-                    # create behind; reclaim the id.
-                    store.abort(oid)
-                    buf = self.core.spill_create(oid, total)
-                    try:
-                        serialization.write_segments(buf, segments)
-                    except BaseException:
-                        buf.release()
-                        store.abort(oid)
-                        raise
-                    buf.release()
-                    store.seal(oid)
-                    returns.append(("r", oid))
+                if inspect.iscoroutine(result):
+                    # Sync-invoked coroutine (async def run through the
+                    # thread-pool path): run it to completion on a private
+                    # loop in this thread.
+                    result = asyncio.run(result)
+                if spec.num_returns == self.STREAMING:
+                    if not inspect.isgenerator(result):
+                        raise TypeError(
+                            'num_returns="streaming" requires the task to '
+                            "return a generator")
+                    return self._stream_generator(conn, spec, result)
+            returns = self._package_returns(spec, result)
             return {"status": "ok", "returns": returns, "node_id": self.node_id}
         except Exception as e:
             tb = traceback.format_exc()
@@ -162,10 +220,98 @@ class WorkerRuntime:
                 applied.undo()
             self.core.current_task_name = None
 
+    async def _execute_async(self, fn, spec: TaskSpec, conn=None) -> dict:
+        """Async execution path: coroutine and async-generator functions run
+        directly on the worker's event loop (concurrency-group analog —
+        reference: core_worker/transport/concurrency_group_manager.h with
+        fibers; ours are asyncio tasks bounded by a semaphore). Blocking prep
+        (arg resolution from plasma) stays off-loop."""
+        from ray_tpu import runtime_env as renv_mod
+
+        loop = asyncio.get_event_loop()
+        sem = self._async_sem
+        if sem is None:
+            sem = self._async_sem = asyncio.Semaphore(100)
+        async with sem:
+            applied = None
+            try:
+                def _prep():
+                    a = renv_mod.apply_runtime_env(
+                        self.core, spec.runtime_env, self.core.session_dir)
+                    args, kwargs = self.core.resolve_args(spec)
+                    return a, args, kwargs
+
+                applied, args, kwargs = await loop.run_in_executor(None, _prep)
+                self.core.current_task_name = spec.name
+                if inspect.isasyncgenfunction(getattr(fn, "__func__", fn)):
+                    if spec.num_returns != self.STREAMING:
+                        raise TypeError(
+                            "async generator methods require "
+                            'num_returns="streaming"')
+                    count = 0
+                    try:
+                        async for item in fn(*args, **kwargs):
+                            await loop.run_in_executor(
+                                None, self._push_gen_item_sealed, spec, count,
+                                item, conn)
+                            count += 1
+                        return {"status": "ok", "streamed": count,
+                                "node_id": self.node_id}
+                    except Exception as e:
+                        tb = traceback.format_exc()
+                        logger.error("async streaming %s failed:\n%s",
+                                     spec.name, tb)
+                        return {"status": "error", "streamed": count,
+                                "error": TaskError(spec.name, tb,
+                                                   cause=_safe_cause(e))}
+                result = await fn(*args, **kwargs)
+                if spec.num_returns == self.STREAMING:
+                    if not inspect.isgenerator(result):
+                        raise TypeError(
+                            'num_returns="streaming" requires a generator')
+                    return await loop.run_in_executor(
+                        None, self._stream_generator, conn, spec, result)
+                returns = await loop.run_in_executor(
+                    None, self._package_returns, spec, result)
+                return {"status": "ok", "returns": returns,
+                        "node_id": self.node_id}
+            except Exception as e:
+                tb = traceback.format_exc()
+                logger.error("async task %s failed:\n%s", spec.name, tb)
+                return {"status": "error",
+                        "error": TaskError(spec.name, tb,
+                                           cause=_safe_cause(e))}
+            finally:
+                if applied is not None:
+                    applied.undo()
+                self.core.current_task_name = None
+
+    def _push_gen_item_sealed(self, spec, index, item, conn):
+        """Executor-thread shim so async generators reuse the blocking push
+        (which itself round-trips through the loop for the socket write)."""
+        self._push_gen_item(conn, spec, index, item)
+
+    @staticmethod
+    def _is_async_callable(fn) -> bool:
+        target = getattr(fn, "__func__", fn)
+        return (inspect.iscoroutinefunction(target)
+                or inspect.isasyncgenfunction(target))
+
+    async def _tracked(self, awaitable):
+        """Count in-flight executions (queued + running) for actor_stats."""
+        self._tasks_pending += 1
+        try:
+            return await awaitable
+        finally:
+            self._tasks_pending -= 1
+
     async def handle_push_task(self, conn, spec: TaskSpec):
         fn = self._load_function(spec.fn_id)
         loop = asyncio.get_event_loop()
-        return await loop.run_in_executor(self.exec_pool, self._execute, fn, spec)
+        if self._is_async_callable(fn):
+            return await self._tracked(self._execute_async(fn, spec, conn))
+        return await self._tracked(
+            loop.run_in_executor(self.exec_pool, self._execute, fn, spec, conn))
 
     # ---- actor lifecycle --------------------------------------------------
 
@@ -188,6 +334,11 @@ class WorkerRuntime:
         if spec.max_concurrency > 1:
             self.exec_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=spec.max_concurrency, thread_name_prefix="actor_exec")
+            self._async_sem = asyncio.Semaphore(spec.max_concurrency)
+        else:
+            # Async actors default to high concurrency unless the user caps
+            # it (reference: async actors' max_concurrency defaults to 1000).
+            self._async_sem = asyncio.Semaphore(1000)
         loop = asyncio.get_event_loop()
         try:
             result = await loop.run_in_executor(self.exec_pool, _create)
@@ -221,7 +372,17 @@ class WorkerRuntime:
                         spec.name,
                         f"actor has no method {spec.method_name!r}")}
         loop = asyncio.get_event_loop()
-        return await loop.run_in_executor(self.exec_pool, self._execute, method, spec)
+        if self._is_async_callable(method):
+            return await self._tracked(self._execute_async(method, spec, conn))
+        return await self._tracked(loop.run_in_executor(
+            self.exec_pool, self._execute, method, spec, conn))
+
+    async def handle_actor_stats(self, conn):
+        """Execution-queue stats, served directly on the IO loop so callers
+        (serve autoscaling) never queue behind user code."""
+        return {"pending": self._tasks_pending,
+                "max_concurrency": (self.actor_spec.max_concurrency
+                                    if self.actor_spec else 1)}
 
     async def handle_ping(self, conn):
         return {"ok": True}

@@ -90,11 +90,22 @@ class ServeController:
                 replicas = list(d["replicas"])
             if not ac or not replicas:
                 continue
+            # Queue depth via the worker's direct actor_stats RPC (served on
+            # its IO loop): includes tasks queued behind busy exec threads,
+            # and never blocks behind user code the way an actor-method probe
+            # (queue_len) would. Reference analog: replica queue-length
+            # reporting into autoscaling_state.py.
+            from ray_tpu.core.worker import global_worker
+
+            core = global_worker()
             try:
-                queues = ray_tpu.get(
-                    [r.queue_len.remote() for r in replicas], timeout=10)
+                stats = core.actor_stats_many(
+                    [r._actor_id for r in replicas], timeout=3)
             except Exception:
                 continue
+            queues = [int(s.get("pending", 0)) for s in stats if s is not None]
+            if not queues:
+                continue  # every replica unreachable (restarting/dead)
             target = max(float(ac.get("target_ongoing_requests", 2)), 0.1)
             desired = math.ceil(sum(queues) / target) or 0
             desired = max(int(ac.get("min_replicas", 1)),

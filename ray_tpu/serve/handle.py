@@ -10,6 +10,7 @@ probe round-trip.
 from __future__ import annotations
 
 import random
+import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
@@ -33,12 +34,15 @@ class DeploymentResponse:
 
 
 class DeploymentHandle:
+    REFRESH_INTERVAL_S = 1.0
+
     def __init__(self, deployment_name: str, method_name: str = "__call__"):
         self.deployment_name = deployment_name
         self.method_name = method_name
         self._replicas: List = []
         self._version = -1
         self._inflight: Dict[int, int] = {}
+        self._last_refresh = 0.0
 
     def options(self, method_name: str) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, method_name)
@@ -64,17 +68,28 @@ class DeploymentHandle:
             self._replicas = info["replicas"]
             self._version = info["version"]
             self._inflight = {i: 0 for i in range(len(self._replicas))}
+        self._last_refresh = time.monotonic()
 
     def _pick_replica(self) -> int:
         n = len(self._replicas)
+        if n == 0:
+            raise RuntimeError(
+                f"deployment {self.deployment_name!r} has no replicas")
         if n == 1:
             return 0
         a, b = random.sample(range(n), 2)
         return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        if not self._replicas:
-            self._refresh()
+        # Periodic re-poll so autoscaled replicas join the routing set
+        # (versioned-poll collapse of the reference's LongPollHost pattern).
+        if (not self._replicas
+                or time.monotonic() - self._last_refresh > self.REFRESH_INTERVAL_S):
+            try:
+                self._refresh()
+            except Exception:
+                if not self._replicas:
+                    raise
         idx = self._pick_replica()
         replica = self._replicas[idx]
         self._inflight[idx] = self._inflight.get(idx, 0) + 1

@@ -149,6 +149,42 @@ class _Execution:
         return x
 
 
+def _canonical(x) -> str:
+    """Process-stable repr for input fingerprinting: cloudpickle bytes and
+    set/dict iteration order vary across interpreters (PYTHONHASHSEED), so a
+    raw pickle digest would spuriously reject legitimate resumes."""
+    if isinstance(x, dict):
+        items = sorted(((_canonical(k), _canonical(v)) for k, v in x.items()))
+        return "{" + ",".join(f"{k}:{v}" for k, v in items) + "}"
+    if isinstance(x, (set, frozenset)):
+        return "{" + ",".join(sorted(_canonical(v) for v in x)) + "}"
+    if isinstance(x, (list, tuple)):
+        return "[" + ",".join(_canonical(v) for v in x) + "]"
+    if callable(x):
+        return f"fn:{getattr(x, '__module__', '')}.{getattr(x, '__qualname__', repr(x))}"
+    if isinstance(x, (str, bytes, int, float, bool, type(None))):
+        return repr(x)
+    try:
+        import numpy as np
+
+        if isinstance(x, np.ndarray):
+            return f"nd:{x.shape}:{x.dtype}:{hashlib.sha1(np.ascontiguousarray(x).tobytes()).hexdigest()}"
+    except Exception:
+        pass
+    r = repr(x)
+    if " at 0x" in r:  # default object repr embeds the address: not stable
+        raise ValueError(f"cannot fingerprint {type(x).__name__}")
+    return r
+
+
+def _args_digest(args, kwargs) -> Optional[str]:
+    try:
+        return hashlib.sha1(_canonical(
+            (tuple(args), dict(kwargs or {}))).encode()).hexdigest()
+    except Exception:
+        return None  # un-fingerprintable args: skip the guard
+
+
 def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
         storage: Optional[str] = None, args: tuple = (),
         kwargs: Optional[dict] = None) -> Any:
@@ -161,11 +197,7 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
     nodes = dag.topo_sort()
     meta = store.read_meta()
     digest = _dag_digest(nodes)
-    try:
-        args_digest = hashlib.sha1(cloudpickle.dumps(
-            (args, sorted((kwargs or {}).items())))).hexdigest()
-    except Exception:
-        args_digest = None  # unpicklable args: skip the guard
+    args_digest = _args_digest(args, kwargs)
     if meta and meta.get("digest") not in (None, digest):
         raise ValueError(
             f"workflow {workflow_id} already exists with a different DAG")

@@ -1,0 +1,151 @@
+"""Async actors (concurrent execution) + streaming generators.
+
+Reference test model: python/ray/tests/test_streaming_generator.py and
+test_async_actor (actors with async-def methods overlap execution;
+num_returns="streaming" yields ObjectRefs before the task finishes).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_async_actor_overlaps(cluster):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def slow(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.4)
+            return x * 2
+
+    a = AsyncActor.remote()
+    start = time.monotonic()
+    refs = [a.slow.remote(i) for i in range(10)]
+    results = ray_tpu.get(refs, timeout=30)
+    elapsed = time.monotonic() - start
+    assert results == [i * 2 for i in range(10)]
+    # Serial execution would take >= 4s; concurrent should be ~0.4s.
+    assert elapsed < 2.5, f"async actor did not overlap: {elapsed:.1f}s"
+
+
+def test_threaded_actor_max_concurrency(cluster):
+    @ray_tpu.remote(max_concurrency=5)
+    class Threaded:
+        def slow(self, x):
+            time.sleep(0.4)
+            return x + 1
+
+    a = Threaded.remote()
+    start = time.monotonic()
+    results = ray_tpu.get([a.slow.remote(i) for i in range(5)], timeout=30)
+    elapsed = time.monotonic() - start
+    assert results == [i + 1 for i in range(5)]
+    assert elapsed < 1.5, f"threaded actor did not overlap: {elapsed:.1f}s"
+
+
+def test_serial_actor_keeps_order(cluster):
+    @ray_tpu.remote
+    class Seq:
+        def __init__(self):
+            self.log = []
+
+        def add(self, x):
+            self.log.append(x)
+            return x
+
+        def get_log(self):
+            return list(self.log)
+
+    a = Seq.remote()
+    refs = [a.add.remote(i) for i in range(20)]
+    ray_tpu.get(refs, timeout=30)
+    assert ray_tpu.get(a.get_log.remote(), timeout=10) == list(range(20))
+
+
+def test_streaming_task_generator(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def countdown(n):
+        for i in range(n):
+            time.sleep(0.2)
+            yield i
+
+    start = time.monotonic()
+    gen = countdown.remote(5)
+    assert isinstance(gen, ray_tpu.ObjectRefGenerator)
+    first_ref = gen.next(timeout=10)
+    first_at = time.monotonic() - start
+    # First item must arrive well before the full 1s of generation finishes.
+    assert first_at < 0.8, f"first item took {first_at:.1f}s (not streamed)"
+    values = [ray_tpu.get(first_ref, timeout=10)]
+    for ref in gen:
+        values.append(ray_tpu.get(ref, timeout=10))
+    assert values == list(range(5))
+
+
+def test_streaming_large_items(cluster):
+    import numpy as np
+
+    @ray_tpu.remote(num_returns="streaming")
+    def big_items():
+        for i in range(3):
+            yield np.full((256, 1024), i, dtype=np.float32)  # 1 MiB each
+
+    vals = [ray_tpu.get(r, timeout=30) for r in big_items.remote()]
+    assert len(vals) == 3
+    for i, v in enumerate(vals):
+        assert v.shape == (256, 1024) and float(v[0, 0]) == float(i)
+
+
+def test_streaming_actor_method(cluster):
+    @ray_tpu.remote
+    class Streamer:
+        def tokens(self, n):
+            for i in range(n):
+                yield f"tok{i}"
+
+    s = Streamer.remote()
+    gen = s.tokens.options(num_returns="streaming").remote(4)
+    out = [ray_tpu.get(r, timeout=10) for r in gen]
+    assert out == ["tok0", "tok1", "tok2", "tok3"]
+
+
+def test_streaming_async_generator(cluster):
+    @ray_tpu.remote
+    class AsyncStreamer:
+        async def tokens(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i * 10
+
+    s = AsyncStreamer.remote()
+    gen = s.tokens.options(num_returns="streaming").remote(4)
+    out = [ray_tpu.get(r, timeout=10) for r in gen]
+    assert out == [0, 10, 20, 30]
+
+
+def test_streaming_error_mid_generation(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def flaky():
+        yield 1
+        yield 2
+        raise ValueError("boom")
+
+    gen = flaky.remote()
+    assert ray_tpu.get(gen.next(timeout=10), timeout=10) == 1
+    assert ray_tpu.get(gen.next(timeout=10), timeout=10) == 2
+    with pytest.raises(Exception) as exc_info:
+        for _ in range(3):
+            next(gen)
+    assert "boom" in str(exc_info.value)
