@@ -10,6 +10,7 @@ See SURVEY.md at the repo root for the structural map to the reference.
 from ray_tpu.core.api import (  # noqa: F401
     available_resources,
     cluster_resources,
+    free,
     get,
     get_actor,
     get_runtime_context,

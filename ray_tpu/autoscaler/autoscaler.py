@@ -28,10 +28,25 @@ logger = logging.getLogger(__name__)
 @dataclasses.dataclass
 class InstanceType:
     name: str
-    resources: Dict[str, float]
+    resources: Dict[str, float]   # PER-HOST resources
     max_workers: int = 100
     # TPU topology: whole-slice instances (e.g. {"TPU": 8} labeled v5e-8)
     tpu_slice: Optional[str] = None
+    # Multi-host slices (e.g. "v5e-32" = 8 hosts x 4 chips): launched and
+    # terminated ATOMICALLY — a partial slice is useless (no ICI ring).
+    hosts: int = 1
+
+    @staticmethod
+    def for_pod_type(name: str, pod_type: str,
+                     cpus_per_host: float = 8.0) -> "InstanceType":
+        from ray_tpu.runtime import tpu_topology
+
+        return InstanceType(
+            name=name,
+            resources={"CPU": cpus_per_host,
+                       "TPU": float(tpu_topology.chips_per_host(pod_type))},
+            tpu_slice=pod_type,
+            hosts=tpu_topology.hosts_in_slice(pod_type))
 
 
 @dataclasses.dataclass
@@ -41,6 +56,7 @@ class Instance:
     status: str = "LAUNCHING"   # LAUNCHING | RUNNING | TERMINATING
     node_id: Optional[bytes] = None
     launched_at: float = 0.0
+    slice_id: Optional[str] = None   # multi-host slice membership (atomic)
 
 
 class NodeProvider:
@@ -48,6 +64,11 @@ class NodeProvider:
 
     def launch(self, instance_type: InstanceType) -> str:
         raise NotImplementedError
+
+    def launch_slice(self, instance_type: InstanceType) -> List[str]:
+        """Launch a multi-host slice atomically: `instance_type.hosts` hosts
+        sharing a slice name, worker ids 0..hosts-1. Default: hosts==1."""
+        return [self.launch(instance_type)]
 
     def terminate(self, instance_id: str) -> None:
         raise NotImplementedError
@@ -67,11 +88,7 @@ class FakeMultiNodeProvider(NodeProvider):
         self.cluster = cluster  # ray_tpu.cluster_utils.Cluster
         self.nodes: Dict[str, object] = {}
 
-    def launch(self, instance_type: InstanceType) -> str:
-        labels = {}
-        if instance_type.tpu_slice:
-            labels["tpu-slice"] = f"{instance_type.tpu_slice}-{uuid.uuid4().hex[:6]}"
-            labels["tpu-pod-type"] = instance_type.tpu_slice
+    def _add_host(self, instance_type: InstanceType, labels: dict) -> str:
         res = dict(instance_type.resources)
         num_cpus = res.pop("CPU", 1)
         num_tpus = res.pop("TPU", 0)
@@ -80,6 +97,25 @@ class FakeMultiNodeProvider(NodeProvider):
         iid = f"fake-{uuid.uuid4().hex[:8]}"
         self.nodes[iid] = node
         return iid
+
+    def launch(self, instance_type: InstanceType) -> str:
+        labels = {}
+        if instance_type.tpu_slice:
+            from ray_tpu.runtime import tpu_topology
+
+            labels = tpu_topology.slice_labels(
+                uuid.uuid4().hex[:6], instance_type.tpu_slice, 0)
+        return self._add_host(instance_type, labels)
+
+    def launch_slice(self, instance_type: InstanceType) -> List[str]:
+        if instance_type.hosts <= 1 or not instance_type.tpu_slice:
+            return [self.launch(instance_type)]
+        from ray_tpu.runtime import tpu_topology
+
+        slice_name = uuid.uuid4().hex[:6]
+        return [self._add_host(instance_type, tpu_topology.slice_labels(
+                    slice_name, instance_type.tpu_slice, wid))
+                for wid in range(instance_type.hosts)]
 
     def terminate(self, instance_id: str) -> None:
         node = self.nodes.pop(instance_id, None)
@@ -166,9 +202,15 @@ class Autoscaler:
             if now - inst.launched_at > self.boot_grace_s:
                 logger.warning("instance %s never registered within %.0fs; "
                                "terminating", iid, self.boot_grace_s)
-                self.provider.terminate(iid)
-                del self.instances[iid]
-                self._idle_since.pop(iid, None)
+                # A partial multi-host slice is useless (broken ICI ring):
+                # reap every sibling host with it.
+                doomed = [iid] if inst.slice_id is None else [
+                    j for j, other in self.instances.items()
+                    if other.slice_id == inst.slice_id]
+                for j in doomed:
+                    self.provider.terminate(j)
+                    self.instances.pop(j, None)
+                    self._idle_since.pop(j, None)
             elif inst.status == "LAUNCHING":
                 free.append(dict(
                     self.instance_types[inst.instance_type].resources))
@@ -188,12 +230,16 @@ class Autoscaler:
         launched = 0
         to_launch = self._plan_launches(unmet)
         for type_name in to_launch:
-            if len(self.instances) >= self.max_workers:
+            t = self.instance_types[type_name]
+            if len(self.instances) + t.hosts > self.max_workers:
                 break
-            iid = self.provider.launch(self.instance_types[type_name])
-            self.instances[iid] = Instance(iid, type_name, "LAUNCHING",
-                                           launched_at=time.time())
-            launched += 1
+            iids = self.provider.launch_slice(t)
+            slice_id = uuid.uuid4().hex[:8] if t.hosts > 1 else None
+            for iid in iids:
+                self.instances[iid] = Instance(iid, type_name, "LAUNCHING",
+                                               launched_at=time.time(),
+                                               slice_id=slice_id)
+            launched += len(iids)
 
         terminated = self._terminate_idle(nodes, demand)
         return {"launched": launched, "terminated": terminated,
@@ -224,11 +270,15 @@ class Autoscaler:
                 continue
             # Smallest adequate type; avoid burning TPU slices on CPU work.
             t = min(candidates, key=lambda t: (t.resources.get("TPU", 0),
+                                               t.hosts,
                                                sum(t.resources.values())))
             plan.append(t.name)
+            # A multi-host slice contributes every host's capacity.
             cap = dict(t.resources)
             scheduling.subtract(cap, bundle)
             plan_free.append(cap)
+            for _ in range(t.hosts - 1):
+                plan_free.append(dict(t.resources))
         return plan
 
     def _terminate_idle(self, nodes, demand) -> int:
@@ -242,21 +292,32 @@ class Autoscaler:
             return 0
         now = time.time()
         node_by_id = {n["node_id"]: n for n in nodes}
-        for iid, inst in list(self.instances.items()):
-            if len(self.instances) <= self.min_workers:
-                break
-            node = node_by_id.get(inst.node_id.hex()) if inst.node_id else None
+
+        def node_of(inst):
+            return node_by_id.get(inst.node_id.hex()) if inst.node_id else None
+
+        def idle_expired(iid, inst) -> bool:
+            node = node_of(inst)
             if node is None:
-                # Still booting (reconcile handles boot-grace reaping).
+                return False  # still booting (boot-grace reaping handles it)
+            if node["available"] != node["resources"]:
+                self._idle_since.pop(iid, None)
+                return False
+            since = self._idle_since.setdefault(iid, now)
+            return now - since > self.idle_timeout_s
+
+        # Group by slice: multi-host slices terminate ATOMICALLY, and only
+        # when EVERY host has been idle past the timeout.
+        groups: Dict[Optional[str], List[str]] = {}
+        for iid, inst in self.instances.items():
+            groups.setdefault(inst.slice_id or iid, []).append(iid)
+        for key, iids in list(groups.items()):
+            if len(self.instances) - len(iids) < self.min_workers:
                 continue
-            fully_idle = node["available"] == node["resources"]
-            if fully_idle:
-                since = self._idle_since.setdefault(iid, now)
-                if now - since > self.idle_timeout_s:
+            if all(idle_expired(iid, self.instances[iid]) for iid in iids):
+                for iid in iids:
                     self.provider.terminate(iid)
                     del self.instances[iid]
                     self._idle_since.pop(iid, None)
                     terminated += 1
-            else:
-                self._idle_since.pop(iid, None)
         return terminated

@@ -93,7 +93,7 @@ class JaxDistributedCommunicator(Communicator):
 
     def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
         import jax.numpy as jnp
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
         from jax import lax
 
@@ -120,7 +120,7 @@ class JaxDistributedCommunicator(Communicator):
 
     def allreduce_concat(self, array: np.ndarray) -> np.ndarray:
         import jax.numpy as jnp
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
         from jax import lax
 

@@ -1,0 +1,127 @@
+"""Central runtime config table: typed tunables, env-overridable.
+
+Reference analog: src/ray/common/ray_config_def.h (223 RAY_CONFIG macros,
+overridable via RAY_* env vars and the _system_config dict passed at init,
+serialized to components). Ours: one table; override precedence is
+    _system_config (init kwarg)  >  RAY_TPU_<NAME> env var  >  default.
+Components read `cfg().<name>` at use time, so test fixtures and
+_system_config can retune without import-order games.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Tuple
+
+# name -> (type, default, doc)
+_DEFS: Dict[str, Tuple[type, Any, str]] = {
+    # -- core worker -------------------------------------------------------
+    "inline_result_max": (int, 100 * 1024,
+                          "max bytes for inline (non-plasma) task results"),
+    "lease_idle_timeout_s": (float, 1.0,
+                             "idle worker lease kept warm before return"),
+    "lease_max_inflight_requests": (int, 64,
+                                    "outstanding worker-lease requests per "
+                                    "scheduling key"),
+    "actor_max_inflight_calls": (int, 128,
+                                 "pipelined in-flight calls per actor client"),
+    "pull_chunk_bytes": (int, 4 << 20, "chunk size for remote object pulls"),
+    "lineage_max_entries": (int, 100_000, "owner-side lineage cap"),
+    "reconstruction_attempts": (int, 3,
+                                "re-executions before an object is lost"),
+    # -- raylet / GCS ------------------------------------------------------
+    "heartbeat_interval_s": (float, 2.0, "raylet resource heartbeat period"),
+    "health_check_interval_s": (float, 2.0, "GCS node health check period"),
+    "health_check_failure_threshold": (int, 3,
+                                       "missed health checks before a node "
+                                       "is declared dead"),
+    "worker_monitor_interval_s": (float, 0.2,
+                                  "raylet child-process poll period"),
+    "memory_monitor_interval_s": (float, 1.0, "OOM monitor sample period"),
+    "memory_usage_threshold": (float, 0.95,
+                               "fraction of system memory triggering the "
+                               "OOM killer"),
+    # -- object store ------------------------------------------------------
+    "object_store_memory_default": (int, 2 << 30,
+                                    "default shm store capacity bytes"),
+    "spill_chunk_bytes": (int, 8 << 20, "spill file IO chunk"),
+    "pull_admission_concurrency": (int, 16,
+                                   "concurrent cross-node chunk reads a "
+                                   "raylet serves (admission control)"),
+    "broadcast_fanout": (int, 2, "relay-tree fanout for object broadcast"),
+    # -- data --------------------------------------------------------------
+    "data_max_in_flight": (int, 8,
+                           "bounded in-flight block tasks per stage"),
+    "data_task_timeout_s": (float, 600.0, "per block-task wait timeout"),
+    # -- serve -------------------------------------------------------------
+    "serve_autoscale_interval_s": (float, 1.0, "controller autoscale tick"),
+    "serve_handle_refresh_s": (float, 1.0,
+                               "handle replica-set re-poll period"),
+    "serve_replica_health_timeout_s": (float, 300.0,
+                                       "replica construction deadline"),
+    # -- llm engine --------------------------------------------------------
+    "llm_pipeline_depth": (int, 4,
+                           "async decode steps in flight (latency hiding)"),
+    "llm_prefill_chunk": (int, 128, "default chunked-prefill token budget"),
+    # -- observability -----------------------------------------------------
+    "task_events_max": (int, 10_000,
+                        "task state events retained by the GCS"),
+    "task_events_flush_interval_s": (float, 1.0,
+                                     "worker-side task event batch period"),
+    # -- train -------------------------------------------------------------
+    "train_poll_interval_s": (float, 0.2, "controller worker poll period"),
+    "train_elastic_check_interval_s": (float, 10.0,
+                                       "elastic scaling evaluation period"),
+}
+
+
+class RayTpuConfig:
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        for name, (typ, default, _doc) in _DEFS.items():
+            env = os.environ.get(f"RAY_TPU_{name.upper()}")
+            if env is not None:
+                try:
+                    self._values[name] = (typ(env) if typ is not bool
+                                          else env not in ("0", "false", ""))
+                except ValueError:
+                    raise ValueError(
+                        f"bad value for RAY_TPU_{name.upper()}: {env!r}")
+            else:
+                self._values[name] = default
+
+    def __getattr__(self, name: str):
+        try:
+            return self.__dict__["_values"][name]
+        except KeyError:
+            raise AttributeError(f"unknown config {name!r}") from None
+
+    def apply_overrides(self, overrides: Dict[str, Any]):
+        """init(_system_config=...) path; unknown keys are an error (typos
+        must not silently no-op)."""
+        for k, v in overrides.items():
+            if k not in _DEFS:
+                raise ValueError(f"unknown system config key {k!r}")
+            self._values[k] = _DEFS[k][0](v)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+_instance = None
+_lock = threading.Lock()
+
+
+def cfg() -> RayTpuConfig:
+    global _instance
+    if _instance is None:
+        with _lock:
+            if _instance is None:
+                _instance = RayTpuConfig()
+    return _instance
+
+
+def reset_for_testing():
+    global _instance
+    _instance = None

@@ -107,7 +107,8 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         core = worker_mod.global_worker()
         class_id = core.register_class(self._cls)
-        ser_args, names = core.serialize_args(args, kwargs)
+        ser_args, names, pins = core.serialize_args(args, kwargs)
+        core.pin_args(pins)
         pg_id, bundle_index = None, -1
         strategy = self._scheduling_strategy
         if isinstance(strategy, PlacementGroupStrategy):
@@ -124,7 +125,10 @@ class ActorClass:
             placement_group_bundle_index=bundle_index, namespace=self._namespace,
             runtime_env=prepare_runtime_env(
                 core, core.merge_job_env(self._runtime_env)))
-        reply = core.create_actor(spec)
+        try:
+            reply = core.create_actor(spec)
+        finally:
+            core.unpin_args(pins)
         if not reply.get("ok"):
             raise RuntimeError(f"actor creation failed: {reply.get('error')}")
         return ActorHandle(spec.actor_id, self._cls.__name__, self._max_task_retries)

@@ -24,21 +24,34 @@ _head: Optional[node_mod.NodeProcesses] = None
 def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
          num_tpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
-         object_store_memory: int = 2 << 30,
+         object_store_memory: Optional[int] = None,
          labels: Optional[Dict[str, str]] = None,
          worker_env: Optional[Dict[str, str]] = None,
          runtime_env: Optional[dict] = None,
          include_dashboard: Optional[bool] = None,
          dashboard_port: int = 0,
-         ignore_reinit_error: bool = False) -> "RuntimeContext":
+         ignore_reinit_error: bool = False,
+         remote_client: bool = False,
+         _system_config: Optional[Dict[str, Any]] = None) -> "RuntimeContext":
     """Start a local cluster (default) or connect to an existing one
     (address="host:port" of its GCS, or the RAY_TPU_ADDRESS env var set by
-    the job-submission entrypoint runner)."""
+    the job-submission entrypoint runner). `_system_config` overrides entries
+    of the central config table (ray_tpu/config.py, the ray_config_def.h
+    analog); worker processes inherit them via RAY_TPU_* env vars."""
     global _head
     if worker_mod.is_initialized():
         if ignore_reinit_error:
             return RuntimeContext()
         raise RuntimeError("ray_tpu.init() already called (use ignore_reinit_error)")
+    from ray_tpu.config import cfg
+
+    if _system_config:
+        cfg().apply_overrides(_system_config)
+        # Propagate to node/worker subprocesses.
+        for k, v in _system_config.items():
+            os.environ[f"RAY_TPU_{k.upper()}"] = str(v)
+    if object_store_memory is None:
+        object_store_memory = cfg().object_store_memory_default
 
     if address is None:
         address = os.environ.get("RAY_TPU_ADDRESS") or None
@@ -98,11 +111,17 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
         if not nodes:
             raise RuntimeError(f"no nodes registered at GCS {address}")
         head = next((n for n in nodes if n["is_head"]), nodes[0])
+        # Ray-Client analog (util/client/): a remote driver attaches with NO
+        # local store — put() streams into the head node's store over RPC,
+        # get() pulls chunks back. Auto-detected when the store path isn't
+        # visible (different machine), or forced with remote_client=True.
+        store_path = head["object_store_path"]
+        if remote_client or not os.path.exists(store_path):
+            store_path = None
         core = CoreWorker(
             mode="driver", gcs_address=gcs_address,
             raylet_address=tuple(head["address"]),
-            store_path=head["object_store_path"] if os.path.exists(
-                head["object_store_path"]) else None,
+            store_path=store_path,
             session_dir=os.path.dirname(head["object_store_path"]),
             node_id=head["node_id"])
     core.job_id = core.io.run(core.gcs.call("register_job"))["job_id"]
@@ -213,6 +232,12 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
 
 def kill(actor_handle, *, no_restart: bool = True):
     worker_mod.global_worker().kill_actor(actor_handle._actor_id, no_restart)
+
+
+def free(refs):
+    """Eagerly delete the objects' data everywhere (ray.internal.free
+    analog). The refs become unreadable; lineage is dropped too."""
+    worker_mod.global_worker().free(refs)
 
 
 class RuntimeContext:

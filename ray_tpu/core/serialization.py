@@ -27,6 +27,20 @@ def _align8(n: int) -> int:
     return (n + 7) & ~7
 
 
+def serialize_with_refs(value: Any) -> Tuple[List, int, List]:
+    """serialize() that also reports the ObjectRefs CONTAINED in the pickled
+    graph (the owner pins them so a stored object keeps its inner refs alive
+    — the nested-ref leg of the borrower protocol, reference_count.h:418)."""
+    from ray_tpu.core import object_ref as ref_mod
+
+    ref_mod.start_ref_collection()
+    try:
+        segments, total = serialize(value)
+    finally:
+        contained = ref_mod.finish_ref_collection()
+    return segments, total, contained
+
+
 def serialize(value: Any) -> Tuple[List, int]:
     """Serialize `value` to (segments, total_size).
 

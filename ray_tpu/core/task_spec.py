@@ -33,6 +33,10 @@ class TaskSpec:
     placement_group_id: Optional[bytes] = None
     placement_group_bundle_index: int = -1
     runtime_env: Optional[dict] = None
+    # Submitter-side bookkeeping: object ids pinned until this task
+    # completes (args must survive the submit->execute window even if the
+    # caller drops its refs; reference: task_manager.h holds arg refs).
+    pinned_oids: Optional[List[bytes]] = None
 
 
 @dataclass

@@ -34,13 +34,14 @@ from ray_tpu.core.task_spec import ActorSpec, TaskSpec
 from ray_tpu.runtime.object_store import ObjectNotFoundError, ObjectStore
 from ray_tpu.runtime.object_store.spill import SpillManager
 from ray_tpu.runtime.object_store.store import StoreFullError
-from ray_tpu.runtime.rpc import ConnectionLost, EventLoopThread, RpcClient
+from ray_tpu.runtime.rpc import (ConnectionLost, EventLoopThread, RpcClient,
+                                 RpcServer)
 from ray_tpu.utils.ids import ObjectID, TaskID
 
 logger = logging.getLogger(__name__)
 
-INLINE_RESULT_MAX = 100 * 1024
-LEASE_IDLE_TIMEOUT_S = 1.0
+from ray_tpu.config import cfg
+
 _MISSING = object()
 
 
@@ -92,6 +93,32 @@ class CoreWorker:
         self._put_refs: set = set()                   # plasma ids this process created
         self._lineage: Dict[bytes, dict] = {}         # return oid -> lineage record
         self._generators: Dict[bytes, _GeneratorState] = {}  # task_id -> state
+        # ---- ownership / distributed refcount (reference_count.h analog) --
+        # Owner-side: oid -> {"locations": set[node_id], "borrowers": set[id],
+        #   "containers": set[container_oid], "children": [(oid, addr)],
+        #   "inline": bool}
+        self._owned: Dict[bytes, dict] = {}
+        self._local_refs: Dict[bytes, int] = {}       # live ObjectRef pyobjects
+        self._borrowed: Dict[bytes, Tuple] = {}       # oid -> owner addr
+        self._arg_pins: Dict[bytes, int] = {}         # oid -> in-flight task uses
+        self._deferred_unborrow: set = set()
+        self._pending_borrows: list = []              # in-flight borrow RPCs
+        self._owner_clients: Dict[Tuple, RpcClient] = {}
+        self._owner_locks: Dict[Tuple, "asyncio.Lock"] = {}
+        self._death_sub_client: Optional[RpcClient] = None
+        self.worker_ident = (os.environ.get("RAY_TPU_WORKER_ID")
+                             or "drv" + os.urandom(6).hex())
+        # Every process (driver AND worker) serves the ownership protocol:
+        # borrow/unborrow, containment pins, owner-side object fetch.
+        self.core_server = RpcServer("127.0.0.1", 0)
+        self.core_server.register("borrow", self._h_borrow)
+        self.core_server.register("unborrow", self._h_unborrow)
+        self.core_server.register("pin_container", self._h_pin_container)
+        self.core_server.register("unpin_container", self._h_unpin_container)
+        self.core_server.register("get_object", self._h_get_object)
+        self.core_server.register("force_free", self._h_force_free)
+        self.io.run(self.core_server.start())
+        self.owner_addr = self.core_server.address
         self.current_actor_id: Optional[bytes] = None
         self.current_task_name: Optional[str] = None
         self.job_id = None
@@ -116,10 +143,41 @@ class CoreWorker:
         if isinstance(value, ObjectRef):
             raise TypeError("put() does not accept ObjectRefs")
         oid = ObjectID.generate().binary()
-        segments, total = serialization.serialize(value)
-        self._write_segments_to_plasma(oid, segments, total)
+        segments, total, contained = serialization.serialize_with_refs(value)
+        if self.store is not None:
+            self._write_segments_to_plasma(oid, segments, total)
+        else:
+            # Remote-client driver (Ray Client analog): no colocated store —
+            # materialize into the attached node's store over chunked RPC.
+            self._remote_put(oid, serialization.join_segments(segments))
         self._put_refs.add(oid)
-        return ObjectRef(oid, owner=self.node_id)
+        children = self._pin_children(oid, contained)
+        self._new_owned(oid, location=self.node_id, children=children)
+        ref = ObjectRef(oid, owner=self.node_id, owner_addr=self.owner_addr)
+        self.register_ref(ref)
+        return ref
+
+    def _remote_put(self, oid: bytes, payload: bytes):
+        if self.raylet is None:
+            raise RayTpuError("no attached raylet for remote put")
+        chunk_size = cfg().pull_chunk_bytes
+
+        async def _send():
+            total = len(payload)
+            off = 0
+            while True:
+                end = min(off + chunk_size, total)
+                r = await self.raylet.call(
+                    "put_object", oid=oid, chunk=payload[off:end], offset=off,
+                    total=total, seal=(end >= total))
+                if not r.get("ok"):
+                    raise RayTpuError(f"remote put failed: {r.get('error')}")
+                off = end
+                if off >= total:
+                    return
+
+        self.io.run(_send(), timeout=600)
+        self._object_locations[oid] = self.node_id
 
     def spill_create(self, oid: bytes, size: int, metadata: bytes = b"") -> memoryview:
         """store.create with spill-before-evict when a spill dir is available."""
@@ -162,10 +220,17 @@ class CoreWorker:
                 if oid in self.memory_store:
                     return self._raise_if_error(self.memory_store[oid])
             # fell through: result is in plasma
+        start = time.monotonic()
         try:
             value = self._get_plasma_value(oid, ref.owner, timeout)
         except ObjectNotFoundError:
-            raise GetTimeoutError(f"get() timed out waiting for {ref}")
+            # The plasma wait may have consumed the whole budget: the owner
+            # fallback only gets what remains (never doubles the timeout).
+            remaining = (None if timeout is None else
+                         timeout - (time.monotonic() - start))
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(f"get() timed out waiting for {ref}")
+            value = self._fetch_from_owner(ref, remaining)
         except ObjectLostError:
             # Lineage reconstruction: re-execute the producing task, then
             # re-enter the full read path (the new result may be inline).
@@ -174,7 +239,6 @@ class CoreWorker:
             return self.get_one(ref, timeout)
         return self._raise_if_error(value)
 
-    PULL_CHUNK = 4 << 20
 
     def _get_plasma_value(self, oid: bytes, owner: Optional[bytes],
                           timeout: Optional[float]) -> Any:
@@ -216,6 +280,36 @@ class CoreWorker:
             return serialization.deserialize(memoryview(data))
         raise ObjectNotFoundError(oid.hex())
 
+    def _fetch_from_owner(self, ref: ObjectRef, timeout: Optional[float]):
+        """Last-resort read path: ask the object's OWNER process (nested refs
+        whose value lives only in the owner's memory store, or whose plasma
+        location we never learned). GetObjectStatus analog
+        (core_worker.proto: the owner resolves inlined values/locations)."""
+        addr = ref.owner_addr
+        oid = ref.binary()
+        if addr is None or tuple(addr) == tuple(self.owner_addr):
+            raise GetTimeoutError(f"get() timed out waiting for {ref}")
+        budget = 30.0 if timeout is None else max(0.1, min(timeout, 30.0))
+
+        async def _ask():
+            try:
+                return await asyncio.wait_for(
+                    self._owner_call(tuple(addr), "get_object", oid=oid),
+                    budget)
+            except asyncio.TimeoutError:
+                return None
+
+        reply = self.io.run(_ask(), timeout=budget + 5)
+        if not reply or not reply.get("found"):
+            raise GetTimeoutError(f"get() timed out waiting for {ref}")
+        if "payload" in reply:
+            return serialization.deserialize(memoryview(reply["payload"]))
+        location = reply.get("location")
+        if location is not None:
+            self._object_locations[oid] = location
+            return self._get_plasma_value(oid, location, timeout)
+        raise GetTimeoutError(f"get() timed out waiting for {ref}")
+
     def _node_address(self, node_id: bytes) -> Optional[Tuple[str, int]]:
         addr = self._node_addrs.get(node_id)
         if addr is not None:
@@ -240,7 +334,8 @@ class CoreWorker:
             chunks, off = [], 0
             while True:
                 reply = await client.call(
-                    "pull_object", oid=oid, offset=off, length=self.PULL_CHUNK)
+                    "pull_object", oid=oid, offset=off,
+                    length=cfg().pull_chunk_bytes)
                 if not reply.get("found"):
                     raise ObjectLostError(
                         f"object {oid.hex()[:12]} not found on node "
@@ -317,10 +412,12 @@ class CoreWorker:
 
     # ------------------------------------------------------------ serialization
 
-    def serialize_args(self, args, kwargs) -> Tuple[List, List]:
+    def serialize_args(self, args, kwargs) -> Tuple[List, List, List]:
         """Build TaskSpec args: small values inline; ObjectRefs stay refs;
-        large values spill to plasma (DependencyResolver analog)."""
-        out, names = [], []
+        large values spill to plasma (DependencyResolver analog). Also
+        returns the oids to pin for the task's lifetime (ref args + refs
+        nested inside inline values)."""
+        out, names, pins = [], [], []
         for name, value in [(None, a) for a in args] + list(kwargs.items()):
             if isinstance(value, ObjectRef):
                 oid = value.binary()
@@ -328,17 +425,21 @@ class CoreWorker:
                 # owner: task returns live on the node that executed the task.
                 owner = self._object_locations.get(oid) or value.owner or self.node_id
                 out.append(("r", oid, owner))
+                pins.append(oid)
             else:
-                segments, total = serialization.serialize(value)
-                if total > INLINE_RESULT_MAX and self.store is not None:
+                segments, total, contained = serialization.serialize_with_refs(
+                    value)
+                pins.extend(r.binary() for r in contained)
+                if total > cfg().inline_result_max and self.store is not None:
                     oid = ObjectID.generate().binary()
                     self._write_segments_to_plasma(oid, segments, total)
                     self._put_refs.add(oid)
+                    self._new_owned(oid, location=self.node_id)
                     out.append(("r", oid, self.node_id))
                 else:
                     out.append(("v", serialization.join_segments(segments)))
             names.append(name)
-        return out, names
+        return out, names, pins
 
     def resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
         """Worker-side: materialize TaskSpec args."""
@@ -370,9 +471,13 @@ class CoreWorker:
         oid = ObjectID.for_task_return(TaskID(task_id), index).binary()
         node_id = data.get("node_id")
         if "payload" in data:
+            # Deserialize outside the lock (nested refs re-enter it); also
+            # destroy any displaced value outside it (see _maybe_free).
+            value = serialization.deserialize(data["payload"])
             with self._mem_lock:
-                self.memory_store[oid] = serialization.deserialize(
-                    data["payload"])
+                displaced = self.memory_store.pop(oid, None)
+                self.memory_store[oid] = value
+            del displaced
         elif node_id is not None:
             self._object_locations[oid] = node_id
         gen = self._generators.get(task_id)
@@ -383,6 +488,359 @@ class CoreWorker:
         state = _GeneratorState()
         self._generators[task_id] = state
         return ObjectRefGenerator(task_id, state)
+
+    # ------------------------------------------------------- task events
+
+    def _record_task_event(self, spec: TaskSpec, state: str,
+                           error: Optional[str] = None):
+        """Buffer a task state transition; batches flush to the GCS
+        (task_event_buffer.h:224 -> GcsTaskManager analog). Best-effort —
+        observability must never block or fail the hot path."""
+        with self._mem_lock:
+            buf = getattr(self, "_task_events", None)
+            if buf is None:
+                buf = self._task_events = []
+                self._task_events_flusher_started = False
+            buf.append({
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "state": state,
+                "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+                "time": time.time(),
+                "error": error,
+            })
+            # Bounded buffer: observability never OOMs the submitter.
+            overflow = len(buf) - cfg().task_events_max
+            if overflow > 0:
+                del buf[:overflow]
+            start = not self._task_events_flusher_started
+            self._task_events_flusher_started = True
+        if start:
+            self.io.spawn(self._flush_task_events_loop())
+
+    async def _flush_task_events_loop(self):
+        while True:
+            await asyncio.sleep(cfg().task_events_flush_interval_s)
+            while True:
+                with self._mem_lock:
+                    buf = getattr(self, "_task_events", None)
+                    if not buf:
+                        break
+                    batch = buf[:500]
+                    del buf[:500]  # in-place: appends race-free under lock
+                try:
+                    await self.gcs.call("report_task_events", events=batch)
+                except Exception:
+                    break  # GCS down/old: drop quietly, retry next tick
+
+    # --------------------------------------------- ownership & refcounting
+    #
+    # Reference analog: src/ray/core_worker/reference_count.h:418-615. The
+    # process that creates an object (put / task submission) OWNS it: it
+    # tracks where copies live, which processes borrow it, and which stored
+    # objects contain it. Data is freed everywhere on zero (delete-on-zero);
+    # pins keep in-flight task args alive across the submit/execute window.
+
+    def _new_owned(self, oid: bytes, location: Optional[bytes] = None,
+                   inline: bool = False, children=None) -> dict:
+        rec = self._owned.get(oid)
+        if rec is None:
+            rec = self._owned[oid] = {
+                "locations": set(), "borrowers": set(), "containers": set(),
+                "children": [], "inline": inline}
+        if location is not None:
+            rec["locations"].add(location)
+        if children:
+            rec["children"].extend(children)
+        return rec
+
+    def register_ref(self, ref: ObjectRef, arrived: bool = False):
+        """Count a live ObjectRef pyobject; on first arrival from another
+        process, register this process as a borrower with the owner. The
+        borrow RPC is async; executors drain pending borrows BEFORE replying
+        to a task (take_pending_borrows), closing the window where the
+        submitter unpins args while our borrow is still in flight."""
+        oid = ref.binary()
+        ref._registered = True
+        with self._mem_lock:
+            self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+            needs_borrow = (arrived and oid not in self._owned
+                            and oid not in self._borrowed
+                            and ref.owner_addr is not None
+                            and tuple(ref.owner_addr) != tuple(self.owner_addr))
+            if needs_borrow:
+                self._borrowed[oid] = tuple(ref.owner_addr)
+        if needs_borrow:
+            fut = self.io.spawn(self._owner_call(
+                tuple(ref.owner_addr), "borrow", oid=oid,
+                borrower=self.worker_ident))
+            with self._mem_lock:
+                # Prune completed futures so drivers (which never drain via
+                # take_pending_borrows) don't leak one entry per borrow.
+                self._pending_borrows = [
+                    f for f in self._pending_borrows if not f.done()]
+                self._pending_borrows.append(fut)
+
+    def take_pending_borrows(self) -> list:
+        with self._mem_lock:
+            futs, self._pending_borrows = self._pending_borrows, []
+        return futs
+
+    def ref_dropped(self, oid: bytes):
+        with self._mem_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n > 0:
+                self._local_refs[oid] = n
+                return
+            self._local_refs.pop(oid, None)
+            owner_addr = self._borrowed.get(oid)
+            if owner_addr is not None and self._arg_pins.get(oid):
+                # Still pinned by an in-flight task: unborrow when unpinned.
+                self._deferred_unborrow.add(oid)
+                return
+            if owner_addr is not None:
+                self._borrowed.pop(oid, None)
+        if owner_addr is not None:
+            self.io.spawn(self._owner_call(
+                owner_addr, "unborrow", oid=oid, borrower=self.worker_ident))
+        elif oid in self._owned:
+            self._maybe_free(oid)
+
+    def pin_args(self, oids):
+        with self._mem_lock:
+            for oid in oids:
+                self._arg_pins[oid] = self._arg_pins.get(oid, 0) + 1
+
+    def unpin_args(self, oids):
+        to_unborrow, to_free = [], []
+        with self._mem_lock:
+            for oid in oids:
+                n = self._arg_pins.get(oid, 0) - 1
+                if n > 0:
+                    self._arg_pins[oid] = n
+                    continue
+                self._arg_pins.pop(oid, None)
+                if oid in self._deferred_unborrow:
+                    self._deferred_unborrow.discard(oid)
+                    addr = self._borrowed.pop(oid, None)
+                    if addr is not None:
+                        to_unborrow.append((addr, oid))
+                elif oid in self._owned and not self._local_refs.get(oid):
+                    to_free.append(oid)
+        for addr, oid in to_unborrow:
+            self.io.spawn(self._owner_call(
+                addr, "unborrow", oid=oid, borrower=self.worker_ident))
+        for oid in to_free:
+            self._maybe_free(oid)
+
+    def _maybe_free(self, oid: bytes):
+        """Owner-side delete-on-zero: free the object's data everywhere once
+        nothing holds it (local refs, borrowers, containing objects, pins)."""
+        # Values popped under the lock are destroyed AFTER it is released:
+        # a value containing registered ObjectRefs runs ref_dropped from its
+        # __del__, which re-acquires this (non-reentrant) lock.
+        displaced = []
+        with self._mem_lock:
+            rec = self._owned.get(oid)
+            if rec is None:
+                return
+            if (self._local_refs.get(oid) or rec["borrowers"]
+                    or rec["containers"] or self._arg_pins.get(oid)):
+                return
+            del self._owned[oid]
+            displaced.append(self.memory_store.pop(oid, None))
+            self._lineage.pop(oid, None)
+            children = rec["children"]
+            locations = set(rec["locations"])
+        del displaced
+        self._put_refs.discard(oid)
+        self._object_locations.pop(oid, None)
+        # Drop the data copies.
+        if self.store is not None and self.store.contains(oid):
+            try:
+                self.store.delete(oid)
+            except Exception:
+                pass
+            if self.spill is not None:
+                self.spill.delete(oid)
+            locations.discard(self.node_id)
+        for node in locations:
+            self.io.spawn(self._free_on_node(node, oid))
+        # Release our containment pins on nested refs.
+        for child_oid, child_addr in children:
+            self._unpin_child(child_oid, child_addr, oid)
+
+    def _unpin_child(self, child_oid: bytes, child_addr, container_oid: bytes):
+        if child_addr is None or tuple(child_addr) == tuple(self.owner_addr):
+            with self._mem_lock:
+                rec = self._owned.get(child_oid)
+                if rec is not None:
+                    rec["containers"].discard(container_oid)
+            if rec is not None:
+                self._maybe_free(child_oid)
+        else:
+            self.io.spawn(self._owner_call(
+                tuple(child_addr), "unpin_container", oid=child_oid,
+                container=container_oid))
+
+    def _pin_children(self, container_oid: bytes, refs) -> list:
+        """Record that `container_oid`'s serialized bytes contain `refs`;
+        pin each inner object with its owner so it outlives the container.
+        Returns the children list for the container's owner record."""
+        children = []
+        for ref in refs:
+            child = ref.binary()
+            addr = ref.owner_addr
+            children.append((child, addr))
+            if addr is None or tuple(addr) == tuple(self.owner_addr):
+                with self._mem_lock:
+                    rec = self._owned.get(child)
+                    if rec is not None:
+                        rec["containers"].add(container_oid)
+            else:
+                self.io.spawn(self._owner_call(
+                    tuple(addr), "pin_container", oid=child,
+                    container=container_oid))
+        return children
+
+    def free(self, refs, force: bool = True):
+        """Eagerly delete objects' data (ray.internal.free analog)."""
+        for ref in refs if isinstance(refs, (list, tuple)) else [refs]:
+            oid = ref.binary()
+            if oid in self._owned:
+                with self._mem_lock:
+                    rec = self._owned.get(oid)
+                    if rec is not None:
+                        rec["borrowers"].clear()
+                        rec["containers"].clear()
+                        self._local_refs.pop(oid, None)
+                self._maybe_free(oid)
+            elif ref.owner_addr is not None:
+                self.io.spawn(self._owner_call(
+                    tuple(ref.owner_addr), "force_free", oid=oid))
+
+    async def _free_on_node(self, node_id: bytes, oid: bytes):
+        addr = self._node_address(node_id)
+        if addr is None:
+            return
+        try:
+            client = await self._raylet_for(addr)
+            await client.call("free_object", oid=oid)
+        except Exception:
+            pass
+
+    async def _owner_call(self, addr: Tuple, method: str, **kw):
+        """Ordered, best-effort RPC to an object owner (per-address lock so
+        borrow/unborrow sequences never reorder)."""
+        try:
+            lock = self._owner_locks.setdefault(addr, asyncio.Lock())
+            async with lock:
+                client = self._owner_clients.get(addr)
+                if client is None or client._dead:
+                    client = RpcClient(*addr)
+                    await client.connect(timeout=10)
+                    self._owner_clients[addr] = client
+                return await client.call(method, timeout=30, **kw)
+        except Exception:
+            return None  # owner gone: object is orphaned, nothing to do
+
+    # -- owner-side protocol handlers (served by core_server) --------------
+
+    async def _h_borrow(self, conn, oid: bytes, borrower: str):
+        with self._mem_lock:
+            rec = self._owned.get(oid)
+            if rec is None:
+                return {"found": False}
+            rec["borrowers"].add(borrower)
+        self._ensure_death_subscription()
+        return {"found": True}
+
+    async def _h_unborrow(self, conn, oid: bytes, borrower: str):
+        with self._mem_lock:
+            rec = self._owned.get(oid)
+            if rec is not None:
+                rec["borrowers"].discard(borrower)
+        if rec is not None:
+            self._maybe_free(oid)
+        return {"ok": True}
+
+    async def _h_pin_container(self, conn, oid: bytes, container: bytes):
+        with self._mem_lock:
+            rec = self._owned.get(oid)
+            if rec is None:
+                return {"found": False}
+            rec["containers"].add(container)
+        return {"found": True}
+
+    async def _h_unpin_container(self, conn, oid: bytes, container: bytes):
+        with self._mem_lock:
+            rec = self._owned.get(oid)
+            if rec is not None:
+                rec["containers"].discard(container)
+        if rec is not None:
+            self._maybe_free(oid)
+        return {"ok": True}
+
+    async def _h_get_object(self, conn, oid: bytes):
+        """Owner-side fetch: lets borrowers resolve refs whose value lives
+        only in this process's memory store (nested refs, small results)."""
+        with self._mem_lock:
+            value = self.memory_store.get(oid, _MISSING)
+        if value is not _MISSING and not isinstance(value, RayTpuError):
+            segments, _ = serialization.serialize(value)
+            return {"found": True, "payload": serialization.join_segments(segments)}
+        rec = self._owned.get(oid)
+        if rec is not None and rec["locations"]:
+            return {"found": True, "location": next(iter(rec["locations"]))}
+        if self.store is not None and self.store.contains(oid):
+            return {"found": True, "location": self.node_id}
+        return {"found": False}
+
+    async def _h_force_free(self, conn, oid: bytes):
+        with self._mem_lock:
+            rec = self._owned.get(oid)
+            if rec is not None:
+                rec["borrowers"].clear()
+                rec["containers"].clear()
+                self._local_refs.pop(oid, None)
+        self._maybe_free(oid)
+        return {"ok": True}
+
+    def _ensure_death_subscription(self):
+        """Prune borrowers when their worker process dies (borrower-crash
+        leg of the borrower protocol). Raylets report worker deaths to the
+        GCS, which republishes on the 'worker_death' channel."""
+        if self._death_sub_client is not None:
+            return
+        self._death_sub_client = True  # claim before the async connect
+
+        async def on_push(method, data):
+            if method != "pubsub" or data.get("channel") != "worker_death":
+                return
+            dead = data["message"].get("worker_id")
+            if not dead:
+                return
+            affected = []
+            with self._mem_lock:
+                for oid, rec in list(self._owned.items()):
+                    if dead in rec["borrowers"]:
+                        rec["borrowers"].discard(dead)
+                        affected.append(oid)
+            for oid in affected:
+                self._maybe_free(oid)
+
+        async def _resub(client):
+            await client._call_once("subscribe", 30,
+                                    dict(channels=["worker_death"]))
+
+        async def _connect():
+            client = RpcClient(self.gcs.host, self.gcs.port, on_push=on_push,
+                               auto_reconnect=True, on_reconnect=_resub)
+            await client.connect(timeout=30)
+            await client.call("subscribe", channels=["worker_death"])
+            self._death_sub_client = client
+
+        self.io.spawn(_connect())
 
     STREAMING = -1  # num_returns sentinel on the wire
 
@@ -405,7 +863,7 @@ class CoreWorker:
 
         fn_id = self.register_function(fn)
         num_returns = self._normalize_num_returns(num_returns)
-        ser_args, names = self.serialize_args(args, kwargs)
+        ser_args, names, pins = self.serialize_args(args, kwargs)
         task_id = TaskID.generate().binary()
         runtime_env = renv_mod.prepare_runtime_env(
             self, self.merge_job_env(runtime_env))
@@ -415,17 +873,23 @@ class CoreWorker:
             max_retries=max_retries, scheduling_strategy=scheduling_strategy,
             placement_group_id=placement_group_id,
             placement_group_bundle_index=bundle_index,
-            runtime_env=runtime_env)
+            runtime_env=runtime_env, pinned_oids=pins)
+        self.pin_args(pins)
+        self._record_task_event(spec, "SUBMITTED")
         if num_returns == self.STREAMING:
             gen = self._make_generator(task_id)
             self.io.spawn(self._submit_async(spec))
             return [gen]
-        refs = [ObjectRef(ObjectID.for_task_return(TaskID(task_id), i).binary(),
-                          owner=self.node_id)
-                for i in range(num_returns)]
+        refs = []
         with self._mem_lock:
-            for ref in refs:
-                self.result_futures[ref.binary()] = SyncFuture()
+            for i in range(num_returns):
+                oid = ObjectID.for_task_return(TaskID(task_id), i).binary()
+                self.result_futures[oid] = SyncFuture()
+                refs.append(ObjectRef(oid, owner=self.node_id,
+                                      owner_addr=self.owner_addr))
+        for ref in refs:
+            self._new_owned(ref.binary(), inline=True)
+            self.register_ref(ref)
         self._record_lineage(spec, [r.binary() for r in refs])
         self.io.spawn(self._submit_async(spec))
         return refs
@@ -448,8 +912,6 @@ class CoreWorker:
 
     # ------------------------------------------------------------ lineage
 
-    LINEAGE_MAX_ENTRIES = 100_000
-    RECONSTRUCTION_ATTEMPTS = 3
 
     def _record_lineage(self, spec: TaskSpec, return_oids: List[bytes]):
         """Owner-side lineage for plasma-result reconstruction
@@ -461,14 +923,15 @@ class CoreWorker:
         import copy
 
         pristine = copy.deepcopy(spec)
+        pristine.pinned_oids = None  # pins belong to the original attempt
         rec = {"spec": pristine, "oids": list(return_oids),
-               "attempts": self.RECONSTRUCTION_ATTEMPTS}
+               "attempts": cfg().reconstruction_attempts}
         with self._mem_lock:
             for oid in return_oids:
                 self._lineage[oid] = rec
             # Bound lineage memory: drop oldest entries beyond the cap
             # (lineage bytes cap analog).
-            while len(self._lineage) > self.LINEAGE_MAX_ENTRIES:
+            while len(self._lineage) > cfg().lineage_max_entries:
                 self._lineage.pop(next(iter(self._lineage)))
 
     def _reconstruct(self, oid: bytes, timeout: Optional[float]) -> bool:
@@ -524,7 +987,7 @@ class CoreWorker:
         # Match outstanding lease requests to unassigned work: request more if
         # short, cancel extras if the queue drained (the raylet would otherwise
         # grant stale speculative leases and starve other scheduling keys).
-        want = min(len(state.queue), 64)
+        want = min(len(state.queue), cfg().lease_max_inflight_requests)
         if want > len(state.inflight_reqs):
             for _ in range(want - len(state.inflight_reqs)):
                 req_id = os.urandom(8)
@@ -688,7 +1151,7 @@ class CoreWorker:
         loop = asyncio.get_event_loop()
         self._cancel_return(lease)
         lease.return_timer = loop.call_later(
-            LEASE_IDLE_TIMEOUT_S,
+            cfg().lease_idle_timeout_s,
             lambda: asyncio.ensure_future(self._maybe_return(key, state, lease)))
 
     def _cancel_return(self, lease: _LeasedWorker):
@@ -713,6 +1176,11 @@ class CoreWorker:
             await lease.client.close()
 
     def _complete_task(self, spec: TaskSpec, reply: dict):
+        if spec.pinned_oids:
+            self.unpin_args(spec.pinned_oids)
+            spec.pinned_oids = None
+        if reply.get("status") == "ok":
+            self._record_task_event(spec, "FINISHED")
         if spec.num_returns == self.STREAMING:
             gen = self._generators.pop(spec.task_id, None)
             if gen is None:
@@ -725,34 +1193,61 @@ class CoreWorker:
         if reply["status"] == "ok":
             returns = reply["returns"]
             node_id = reply.get("node_id")
+            # Deserialize OUTSIDE the lock: payloads may contain ObjectRefs
+            # whose unpickling re-enters register_ref (same lock).
+            values = {}
+            for i, ret in enumerate(returns):
+                if ret[0] == "v":
+                    values[i] = serialization.deserialize(ret[1])
+            displaced = []  # destroy evicted values outside the lock
             with self._mem_lock:
-                for i, (kind, payload) in enumerate(returns):
+                for i, ret in enumerate(returns):
+                    kind = ret[0]
+                    children = ret[2] if len(ret) > 2 else None
                     oid = ObjectID.for_task_return(TaskID(spec.task_id), i).binary()
                     if kind == "v":
-                        self.memory_store[oid] = serialization.deserialize(payload)
+                        displaced.append(self.memory_store.pop(oid, None))
+                        self.memory_store[oid] = values[i]
                     elif node_id is not None:
                         # Sealed in the executing node's plasma store.
                         self._object_locations[oid] = node_id
+                    rec = self._owned.get(oid)
+                    if rec is not None:
+                        if kind != "v" and node_id is not None:
+                            rec["locations"].add(node_id)
+                            rec["inline"] = False
+                        # The executor already pinned these children with
+                        # their owners; we unpin when this return is freed.
+                        if children:
+                            rec["children"].extend(children)
                     fut = self.result_futures.pop(oid, None)
                     if fut is not None and not fut.done():
                         fut.set_result(True)
+            del displaced
         else:
             err = reply["error"]
             self._complete_error(spec, err)
 
     def _complete_error(self, spec: TaskSpec, err: RayTpuError):
+        if spec.pinned_oids:
+            self.unpin_args(spec.pinned_oids)
+            spec.pinned_oids = None
+        self._record_task_event(spec, "FAILED", error=repr(err)[:500])
         if spec.num_returns == self.STREAMING:
             gen = self._generators.pop(spec.task_id, None)
             if gen is not None:
                 gen.fail(err)
             return
-        with self._mem_lock:
+        displaced = []  # destroy evicted values outside the lock (see
+        with self._mem_lock:  # _maybe_free for why)
             for i in range(spec.num_returns):
                 oid = ObjectID.for_task_return(TaskID(spec.task_id), i).binary()
+                displaced.append(self.memory_store.pop(oid, None))
                 self.memory_store[oid] = err
                 fut = self.result_futures.pop(oid, None)
                 if fut is not None and not fut.done():
                     fut.set_result(True)
+        del displaced
 
     # ------------------------------------------------------------ actor tasks
 
@@ -763,12 +1258,14 @@ class CoreWorker:
                           *, num_returns: int, name: str,
                           max_task_retries: int = 0) -> List[ObjectRef]:
         num_returns = self._normalize_num_returns(num_returns)
-        ser_args, names = self.serialize_args(args, kwargs)
+        ser_args, names, pins = self.serialize_args(args, kwargs)
         task_id = TaskID.generate().binary()
         spec = TaskSpec(task_id=task_id, fn_id=b"", name=name, args=ser_args,
                         kwarg_names=names, num_returns=num_returns,
                         max_retries=max_task_retries, actor_id=actor_id,
-                        method_name=method_name)
+                        method_name=method_name, pinned_oids=pins)
+        self.pin_args(pins)
+        self._record_task_event(spec, "SUBMITTED")
         client = self._actor_clients.get(actor_id)
         if client is None:
             client = self._actor_clients.setdefault(actor_id, _ActorClient(self, actor_id))
@@ -776,11 +1273,15 @@ class CoreWorker:
             gen = self._make_generator(task_id)
             self.io.spawn(client.enqueue(spec))
             return [gen]
-        refs = [ObjectRef(ObjectID.for_task_return(TaskID(task_id), i).binary())
-                for i in range(num_returns)]
+        refs = []
         with self._mem_lock:
-            for ref in refs:
-                self.result_futures[ref.binary()] = SyncFuture()
+            for i in range(num_returns):
+                oid = ObjectID.for_task_return(TaskID(task_id), i).binary()
+                self.result_futures[oid] = SyncFuture()
+                refs.append(ObjectRef(oid, owner_addr=self.owner_addr))
+        for ref in refs:
+            self._new_owned(ref.binary(), inline=True)
+            self.register_ref(ref)
         self.io.spawn(client.enqueue(spec))
         return refs
 
@@ -838,6 +1339,11 @@ class CoreWorker:
             for client in self._actor_clients.values():
                 if client.client is not None:
                     self.io.run(client.client.close(), timeout=2)
+            for client in self._owner_clients.values():
+                self.io.run(client.close(), timeout=2)
+            if self._death_sub_client not in (None, True):
+                self.io.run(self._death_sub_client.close(), timeout=2)
+            self.io.run(self.core_server.close(), timeout=2)
             self.io.run(self.gcs.close(), timeout=2)
             if self.raylet is not None:
                 self.io.run(self.raylet.close(), timeout=2)
@@ -861,8 +1367,6 @@ class _ActorClient:
     relative to each other — matching the reference's at-most-once,
     retry-opt-in semantics."""
 
-    MAX_INFLIGHT = 128
-
     def __init__(self, core: CoreWorker, actor_id: bytes):
         self.core = core
         self.actor_id = actor_id
@@ -871,7 +1375,7 @@ class _ActorClient:
         self.connect_lock = asyncio.Lock()
         self._queue: asyncio.Queue = asyncio.Queue()
         self._pump_task: Optional[asyncio.Task] = None
-        self._sem = asyncio.Semaphore(self.MAX_INFLIGHT)
+        self._sem = asyncio.Semaphore(cfg().actor_max_inflight_calls)
 
     async def enqueue(self, spec: TaskSpec):
         """Per-caller FIFO: one pump drains the queue so wire order ==

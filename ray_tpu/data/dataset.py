@@ -30,8 +30,13 @@ class Dataset:
 
     def map_batches(self, fn: Callable[[Batch], Batch], *,
                     batch_size: Optional[int] = None,
-                    fn_kwargs: Optional[dict] = None) -> "Dataset":
-        return self._with(plan_mod.MapBatches(fn, batch_size, fn_kwargs))
+                    fn_kwargs: Optional[dict] = None,
+                    compute: str = "tasks",
+                    concurrency: int = 2) -> "Dataset":
+        """compute="actors" runs fn on a pool of stateful actors (fn may be
+        a class instantiated once per actor — model-inference pattern)."""
+        return self._with(plan_mod.MapBatches(fn, batch_size, fn_kwargs,
+                                              compute, concurrency))
 
     def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
         return self._with(plan_mod.MapRows(fn))

@@ -1,15 +1,24 @@
 """Streaming execution of dataset plans over the task runtime.
 
 Reference analog: python/ray/data/_internal/execution/streaming_executor.py:48
-(run:231; scheduling loop streaming_executor_state.py:393/:531). Blocks flow
-through fused map stages as remote tasks with bounded in-flight concurrency
-(backpressure); results stream to the consumer as they finish rather than
-materializing the whole dataset.
+(run:231; scheduling loop streaming_executor_state.py:393/:531) and the
+shuffle operators under _internal/execution/operators/. Blocks flow through
+stages as OBJECT REFS — the driver never materializes intermediate data:
+
+  * fused map stages run as remote tasks (bounded in-flight backpressure);
+  * actor-pool map stages route blocks round-robin over stateful actors
+    (ActorPoolMapOperator analog, map_operator.py:34);
+  * barrier ops (random_shuffle / sort / repartition) run as distributed
+    map/reduce task waves exchanging partitions through the object store —
+    no driver materialization (the round-1 implementation pulled every
+    block to the driver).
+
+Only the final consumer (iter_batches / take) fetches block values.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Iterator, List, Optional
+from typing import Callable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -17,7 +26,7 @@ import ray_tpu
 from ray_tpu.data import plan as plan_mod
 from ray_tpu.data.block import Block, BlockAccessor, block_from_batch
 
-MAX_IN_FLIGHT = 8
+from ray_tpu.config import cfg
 
 
 def _apply_fused(stages_payload: bytes, block: Block) -> Block:
@@ -48,121 +57,312 @@ def _apply_fused(stages_payload: bytes, block: Block) -> Block:
     return block
 
 
-def execute_streaming(ops: List[plan_mod.LogicalOp], parallelism: int,
-                      max_in_flight: int = MAX_IN_FLIGHT) -> Iterator[Block]:
-    """Run the optimized plan; yields output blocks as they complete."""
+class _MapBatchActor:
+    """Actor-pool map worker: holds the (possibly class-based) transform."""
+
+    def __init__(self, payload: bytes):
+        import cloudpickle
+
+        op: plan_mod.MapBatches = cloudpickle.loads(payload)
+        fn = op.fn
+        self.fn = fn() if isinstance(fn, type) else fn
+        self.kwargs = op.fn_kwargs or {}
+
+    def transform(self, block: Block) -> Block:
+        batch = BlockAccessor(block).to_batch()
+        return block_from_batch(self.fn(batch, **self.kwargs))
+
+
+# ------------------------------------------------------------- ref streams
+#
+# A "ref stream" is an iterator of (index, ObjectRef-of-Block); stages
+# compose as generator transformers with their own bounded in-flight sets.
+
+def _ordered(pairs: Iterator[Tuple[int, object]]) -> Iterator[object]:
+    buffered = {}
+    next_idx = 0
+    for idx, ref in pairs:
+        buffered[idx] = ref
+        while next_idx in buffered:
+            yield buffered.pop(next_idx)
+            next_idx += 1
+    while buffered:
+        yield buffered.pop(next_idx)
+        next_idx += 1
+
+
+def _wait_one(pending: dict):
+    ready, _ = ray_tpu.wait(list(pending), num_returns=1,
+                            timeout=cfg().data_task_timeout_s)
+    if not ready:
+        raise TimeoutError("dataset task timed out")
+    return ready
+
+
+def _task_stage(upstream, payload: bytes, max_in_flight: int):
+    @ray_tpu.remote
+    def apply(block):
+        return _apply_fused(payload, block)
+
+    pending = {}
+    for idx, ref in upstream:
+        pending[apply.remote(ref)] = idx
+        while len(pending) >= max_in_flight:
+            for r in _wait_one(pending):
+                yield pending.pop(r), r
+    while pending:
+        for r in _wait_one(pending):
+            yield pending.pop(r), r
+
+
+def _actor_stage(upstream, op: plan_mod.MapBatches):
     import cloudpickle
 
+    Actor = ray_tpu.remote(_MapBatchActor)
+    payload = cloudpickle.dumps(op)
+    pool = [Actor.options(max_concurrency=2).remote(payload)
+            for _ in range(max(1, op.concurrency))]
+    pending = {}
+    i = 0
+    try:
+        for idx, ref in upstream:
+            actor = pool[i % len(pool)]
+            i += 1
+            pending[actor.transform.remote(ref)] = idx
+            while len(pending) >= 2 * len(pool):
+                for r in _wait_one(pending):
+                    yield pending.pop(r), r
+        while pending:
+            for r in _wait_one(pending):
+                yield pending.pop(r), r
+    finally:
+        # Runs on normal completion AND when the consumer stops early
+        # (GeneratorExit) — pool actors must never outlive the stage.
+        for a in pool:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+# -------------------------------------------------- distributed barrier ops
+
+def _count_rows(block: Block) -> int:
+    return block.num_rows
+
+
+def _gather_slices(specs, *blocks) -> Block:
+    """Reduce side of repartition/limit: concat slices of input blocks."""
+    parts = [BlockAccessor(blocks[i]).slice(lo, hi) for i, lo, hi in specs]
+    return BlockAccessor.concat(parts)
+
+
+def _split_random(block: Block, k: int, seed) -> List[Block]:
+    rng = np.random.default_rng(seed)
+    n = block.num_rows
+    assign = rng.integers(0, k, n)
+    return [block.take(np.nonzero(assign == j)[0]) for j in range(k)]
+
+
+def _concat_shuffle(seed, *parts) -> Block:
+    whole = BlockAccessor.concat(list(parts))
+    rng = np.random.default_rng(seed)
+    return whole.take(rng.permutation(whole.num_rows))
+
+
+def _sample_keys(block: Block, key: str, n: int):
+    col = block.column(key).to_numpy(zero_copy_only=False)
+    if len(col) == 0:
+        return col
+    idx = np.random.default_rng(0).integers(0, len(col), min(n, len(col)))
+    return col[idx]
+
+
+def _split_range(block: Block, key: str, bounds) -> List[Block]:
+    col = block.column(key).to_numpy(zero_copy_only=False)
+    assign = np.searchsorted(bounds, col, side="right")
+    return [block.take(np.nonzero(assign == j)[0])
+            for j in range(len(bounds) + 1)]
+
+
+def _concat_sort(key: str, descending: bool, *parts) -> Block:
+    import pyarrow.compute as pc
+
+    whole = BlockAccessor.concat(list(parts))
+    order = "descending" if descending else "ascending"
+    return whole.take(pc.sort_indices(whole, sort_keys=[(key, order)]))
+
+
+def _shuffle_exchange(refs: List, split_fn, concat_fn, k: int,
+                      split_args: Callable[[int], tuple],
+                      concat_args: Callable[[int], tuple]) -> List:
+    """Generic all-to-all: map each block into k partitions (num_returns=k),
+    then one reduce task per partition concatenates its column. The object
+    store carries every partition; the driver only routes refs."""
+    split = ray_tpu.remote(split_fn)
+    concat = ray_tpu.remote(concat_fn)
+    if k == 1:
+        # Degenerate exchange: a single reduce over all inputs.
+        return [concat.remote(*concat_args(0), *refs)]
+    parts = []
+    for i, ref in enumerate(refs):
+        out = split.options(num_returns=k).remote(ref, *split_args(i))
+        parts.append(out)
+    return [concat.remote(*concat_args(j), *[row[j] for row in parts])
+            for j in range(k)]
+
+
+def _apply_barrier_distributed(op, refs: List) -> List:
+    """Barrier ops over block REFS -> block refs, as remote task waves."""
+    count = ray_tpu.remote(_count_rows)
+    if isinstance(op, plan_mod.Limit):
+        counts = ray_tpu.get([count.remote(r) for r in refs], timeout=600)
+        gather = ray_tpu.remote(_gather_slices)
+        out, taken = [], 0
+        for i, (ref, n) in enumerate(zip(refs, counts)):
+            if taken >= op.n:
+                break
+            take = min(n, op.n - taken)
+            out.append(gather.remote([(0, 0, take)], ref) if take < n else ref)
+            taken += take
+        return out
+    if isinstance(op, plan_mod.Repartition):
+        counts = ray_tpu.get([count.remote(r) for r in refs], timeout=600)
+        total = sum(counts)
+        k = max(1, op.num_blocks)
+        per = (total + k - 1) // k
+        # Output j covers global rows [j*per, min((j+1)*per, total)).
+        starts = np.concatenate([[0], np.cumsum(counts)])
+        gather = ray_tpu.remote(_gather_slices)
+        out = []
+        for j in range(k):
+            lo, hi = j * per, min((j + 1) * per, total)
+            if lo >= hi:
+                break
+            specs, needed = [], []
+            for i, n in enumerate(counts):
+                s, e = max(lo, starts[i]), min(hi, starts[i + 1])
+                if s < e:
+                    specs.append((len(needed), int(s - starts[i]),
+                                  int(e - starts[i])))
+                    needed.append(refs[i])
+            out.append(gather.remote(specs, *needed))
+        return out
+    if isinstance(op, plan_mod.RandomShuffle):
+        k = max(1, len(refs))
+        base = op.seed if op.seed is not None else 0xC0FFEE
+        return _shuffle_exchange(
+            refs, _split_random, _concat_shuffle, k,
+            split_args=lambda i: (k, base + i),
+            concat_args=lambda j: (base + 7919 * (j + 1),))
+    if isinstance(op, plan_mod.Sort):
+        k = max(1, len(refs))
+        sample = ray_tpu.remote(_sample_keys)
+        samples = ray_tpu.get(
+            [sample.remote(r, op.key, 32) for r in refs], timeout=600)
+        allkeys = np.sort(np.concatenate([s for s in samples if len(s)]))
+        if len(allkeys) == 0 or k == 1:
+            bounds = np.array([])
+            k = 1
+        else:
+            qs = [int(len(allkeys) * j / k) for j in range(1, k)]
+            bounds = allkeys[qs]
+        if op.descending:
+            # Range-partition ascending, reduce sorts desc, reverse ranges.
+            out = _shuffle_exchange(
+                refs, _split_range, _concat_sort, len(bounds) + 1,
+                split_args=lambda i: (op.key, bounds),
+                concat_args=lambda j: (op.key, True))
+            return out[::-1]
+        return _shuffle_exchange(
+            refs, _split_range, _concat_sort, len(bounds) + 1,
+            split_args=lambda i: (op.key, bounds),
+            concat_args=lambda j: (op.key, False))
+    if isinstance(op, plan_mod.FusedMap):
+        import cloudpickle
+
+        payload = cloudpickle.dumps(op.stages)
+        apply = ray_tpu.remote(_apply_fused)
+        return [apply.remote(payload, r) for r in refs]
+    if isinstance(op, plan_mod.MapBatches) and op.compute == "actors":
+        return [r for _, r in
+                _actor_stage(((i, r) for i, r in enumerate(refs)), op)]
+    raise TypeError(f"unknown barrier op {op}")
+
+
+# ----------------------------------------------------------------- executor
+
+def execute_refs(ops: List[plan_mod.LogicalOp], parallelism: int,
+                 max_in_flight: Optional[int] = None) -> Iterator:
+    """Run the optimized plan; yields BLOCK REFS in order as they complete
+    (streaming until the first barrier op, task waves after)."""
+    import cloudpickle as cp
+
+    if max_in_flight is None:
+        max_in_flight = cfg().data_max_in_flight
     ops = plan_mod.optimize(ops)
     assert ops and isinstance(ops[0], plan_mod.Read), "plan must start with Read"
     read: plan_mod.Read = ops[0]
     rest = ops[1:]
 
-    # Split plan into streamable prefix (fused maps) and barrier suffix
-    # (repartition/shuffle/sort/limit need all blocks).
-    stream_stages: List[plan_mod.FusedMap] = []
+    # Streamable prefix: fused task maps + actor-pool maps, until the first
+    # barrier op (shuffle/sort/repartition/limit need all blocks).
+    stream_stages: List[plan_mod.LogicalOp] = []
     barrier_ops: List[plan_mod.LogicalOp] = []
     for op in rest:
-        if isinstance(op, plan_mod.FusedMap) and not barrier_ops:
+        streamable = isinstance(op, plan_mod.FusedMap) or (
+            isinstance(op, plan_mod.MapBatches) and op.compute == "actors")
+        if streamable and not barrier_ops:
             stream_stages.append(op)
         else:
             barrier_ops.append(op)
 
     tasks = read.datasource.read_tasks(parallelism, read.limit)
 
-    fused_payloads = [cloudpickle.dumps(s.stages) for s in stream_stages]
+    # Fold the read plus any LEADING fused task stages into one task.
+    lead_payloads = []
+    while stream_stages and isinstance(stream_stages[0], plan_mod.FusedMap):
+        lead_payloads.append(cp.dumps(stream_stages.pop(0).stages))
 
     @ray_tpu.remote
     def run_block(read_task_payload, payloads):
-        import cloudpickle as cp
-
         read_task = cp.loads(read_task_payload)
         block = read_task()
         for p in payloads:
             block = _apply_fused(p, block)
         return block
 
-    import cloudpickle as cp
-
-    # Bounded-in-flight dispatch with order preservation: tasks complete in
-    # any order, blocks are yielded in plan order (backpressure loop,
-    # select_operator_to_run analog).
-    queue = [(i, cp.dumps(t)) for i, t in enumerate(tasks)]
-    pending: dict = {}         # ref -> index
-    completed: dict = {}       # index -> Block
-    next_idx = 0
-
-    def submit_more():
-        while queue and len(pending) < max_in_flight:
-            idx, payload = queue.pop(0)
-            pending[run_block.remote(payload, fused_payloads)] = idx
-
-    def stream():
-        nonlocal next_idx
-        submit_more()
-        while pending or completed:
-            while next_idx in completed:
-                yield completed.pop(next_idx)
-                next_idx += 1
-            if not pending:
-                continue
+    def source():
+        pending = {}
+        queue = [(i, cp.dumps(t)) for i, t in enumerate(tasks)]
+        while queue or pending:
+            while queue and len(pending) < max_in_flight:
+                idx, payload = queue.pop(0)
+                pending[run_block.remote(payload, lead_payloads)] = idx
             ready, _ = ray_tpu.wait(list(pending), num_returns=1, timeout=600)
             if not ready:
                 raise TimeoutError("dataset task timed out")
             for ref in ready:
-                idx = pending.pop(ref)
-                completed[idx] = ray_tpu.get(ref, timeout=600)
-            submit_more()
+                yield pending.pop(ref), ref
+
+    stream = source()
+    for op in stream_stages:
+        if isinstance(op, plan_mod.FusedMap):
+            stream = _task_stage(stream, cp.dumps(op.stages), max_in_flight)
+        else:
+            stream = _actor_stage(stream, op)
 
     if not barrier_ops:
-        yield from stream()
+        yield from _ordered(stream)
         return
-
-    # Barrier path: materialize, then apply barrier ops locally (distributed
-    # shuffle lands in a later round).
-    blocks = list(stream())
+    refs = list(_ordered(stream))
     for op in barrier_ops:
-        blocks = _apply_barrier(op, blocks)
-    yield from blocks
+        refs = _apply_barrier_distributed(op, refs)
+    yield from refs
 
 
-def _apply_barrier(op, blocks: List[Block]) -> List[Block]:
-    from ray_tpu.data.block import BlockAccessor
-
-    if isinstance(op, plan_mod.Limit):
-        out, taken = [], 0
-        for b in blocks:
-            if taken >= op.n:
-                break
-            take = min(b.num_rows, op.n - taken)
-            out.append(BlockAccessor(b).slice(0, take))
-            taken += take
-        return out
-    if isinstance(op, plan_mod.Repartition):
-        whole = BlockAccessor.concat(blocks)
-        n = whole.num_rows
-        k = max(1, op.num_blocks)
-        per = (n + k - 1) // k
-        return [BlockAccessor(whole).slice(i * per, min((i + 1) * per, n))
-                for i in range(k) if i * per < n]
-    if isinstance(op, plan_mod.RandomShuffle):
-        whole = BlockAccessor.concat(blocks)
-        rng = np.random.default_rng(op.seed)
-        idx = rng.permutation(whole.num_rows)
-        import pyarrow.compute as pc
-
-        return [whole.take(idx)]
-    if isinstance(op, plan_mod.Sort):
-        whole = BlockAccessor.concat(blocks)
-        import pyarrow.compute as pc
-
-        order = "descending" if op.descending else "ascending"
-        idx = pc.sort_indices(whole, sort_keys=[(op.key, order)])
-        return [whole.take(idx)]
-    if isinstance(op, plan_mod.FusedMap):
-        # FusedMap after a barrier op: run locally.
-        import cloudpickle
-
-        payload = cloudpickle.dumps(op.stages)
-        return [_apply_fused(payload, b) for b in blocks]
-    raise TypeError(f"unknown barrier op {op}")
+def execute_streaming(ops: List[plan_mod.LogicalOp], parallelism: int,
+                      max_in_flight: Optional[int] = None) -> Iterator[Block]:
+    """Run the plan; yields materialized output blocks (final consumer)."""
+    for ref in execute_refs(ops, parallelism, max_in_flight):
+        yield ray_tpu.get(ref, timeout=600)

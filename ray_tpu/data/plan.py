@@ -30,6 +30,11 @@ class MapBatches(LogicalOp):
     fn: Callable
     batch_size: Optional[int] = None
     fn_kwargs: Optional[dict] = None
+    # "tasks" (stateless, fusable) or "actors" (stateful pool — expensive
+    # setup amortized across blocks; ActorPoolMapOperator analog,
+    # map_operator.py:34). fn may be a class: instantiated once per actor.
+    compute: str = "tasks"
+    concurrency: int = 2
     name = "MapBatches"
 
 
@@ -97,10 +102,11 @@ def optimize(ops: List[LogicalOp]) -> List[LogicalOp]:
             out[-1] = dataclasses.replace(out[-1], limit=op.n)
         else:
             out.append(op)
-    # Fuse adjacent map-like ops.
+    # Fuse adjacent map-like ops (actor-pool maps are their own stage).
     fused: List[LogicalOp] = []
     for op in out:
-        if isinstance(op, FUSABLE):
+        if isinstance(op, FUSABLE) and not (
+                isinstance(op, MapBatches) and op.compute == "actors"):
             if fused and isinstance(fused[-1], FusedMap):
                 fused[-1].stages.append(op)
             else:

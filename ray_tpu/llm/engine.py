@@ -6,11 +6,16 @@ paged-attention engine in the TPU build)"). Components:
 
   * BlockManager — host-side page allocator for the KV pool (free list,
     per-sequence block tables, OOM preemption by recompute).
-  * Scheduler — admission: waiting requests join the running batch when KV
-    pages are available; prefill happens on admission, decode runs batched
-    every step.
-  * LLMEngine — add_request / step / generate; step() = (maybe prefills) +
-    one batched decode + sampling + finish detection.
+  * LLMEngine — add_request / step / generate / stream. step() runs chunked
+    prefill for admitted sequences (batched, bucketed) and one batched
+    decode, and emits a RequestOutput PER SAMPLED TOKEN, so callers can
+    stream tokens before requests finish (the ReportGeneratorItemReturns
+    path vLLM uses, core_worker.proto:462, maps to our streaming actors).
+
+Scheduling: admission reserves pages for the whole prompt + 1 token, so
+prefill never stalls mid-prompt; decode preemption (pages exhausted) evicts
+the newest sequence and re-admits it later by recomputing prompt+generated
+tokens (already-emitted tokens are preserved — vLLM's recompute preemption).
 """
 
 from __future__ import annotations
@@ -33,6 +38,7 @@ class RequestOutput:
     finished: bool
     finish_reason: Optional[str] = None
     text: Optional[str] = None
+    new_token_ids: List[int] = dataclasses.field(default_factory=list)
 
 
 class _Request:
@@ -43,11 +49,23 @@ class _Request:
         self.params = params
         self.output: List[int] = []
         self.blocks: List[int] = []
+        self.prefilled = 0          # context tokens already run through
+        self.dispatched = 0         # device-sampled tokens not yet fetched
+        import zlib
+
+        self.seed_val = (params.seed if params.seed is not None
+                         else zlib.crc32(request_id.encode()) & 0x7FFFFFFF)
         self.finished_reason: Optional[str] = None
 
     @property
     def num_tokens(self) -> int:
         return len(self.prompt) + len(self.output)
+
+    @property
+    def context(self) -> List[int]:
+        """Tokens whose KV must exist before decode continues (prompt plus
+        anything generated before a preemption)."""
+        return self.prompt + self.output
 
 
 class BlockManager:
@@ -77,18 +95,43 @@ class BlockManager:
 class LLMEngine:
     def __init__(self, model_runner, *, max_batch_size: int = 8,
                  max_blocks_per_seq: Optional[int] = None,
-                 tokenizer=None):
+                 tokenizer=None, prefill_chunk: Optional[int] = None,
+                 pipeline_depth: Optional[int] = None):
         self.runner = model_runner
         self.block_size = model_runner.block_size
         self.block_manager = BlockManager(model_runner.num_blocks,
                                           model_runner.block_size)
         self.max_batch = max_batch_size
-        self.max_blocks_per_seq = max_blocks_per_seq or (
+        self.max_blocks_per_seq = max_blocks_per_seq or min(
+            model_runner.max_blocks_per_seq,
             model_runner.config.max_seq // model_runner.block_size)
+        # Hard length cap: a sequence may never outgrow its block-table row.
+        self._cap_tokens = min(model_runner.config.max_seq,
+                               self.max_blocks_per_seq * self.block_size)
         self.tokenizer = tokenizer
+        self.prefill_chunk = prefill_chunk or getattr(
+            model_runner, "chunk_size", 128)
         self.waiting: deque = deque()
+        self.prefilling: List[_Request] = []
         self.running: List[_Request] = []
-        self.finished_outputs: List[RequestOutput] = []
+        self._rejected: List[RequestOutput] = []
+        # Async decode pipeline: up to pipeline_depth steps stay in flight,
+        # each chaining its token input from the previous step ON DEVICE;
+        # device->host copies start at dispatch (copy_to_host_async) and are
+        # consumed pipeline_depth ticks later, so the transfer round-trip —
+        # dominant on remote-attached accelerators — amortizes across depth
+        # steps instead of gating every tick (vLLM's async output
+        # processing, deepened).
+        from ray_tpu.config import cfg
+
+        self.pipeline_depth = max(1, pipeline_depth
+                                  if pipeline_depth is not None
+                                  else cfg().llm_pipeline_depth)
+        self._flights: deque = deque()
+        # (req, detached_blocks): pages an in-flight step may still write.
+        # Detached from req.blocks so a re-admitted (preempted) request's
+        # fresh allocation is never confused with the stale pages.
+        self._pending_release: List[tuple] = []
 
     # ---- API -------------------------------------------------------------
 
@@ -101,117 +144,351 @@ class LLMEngine:
         return rid
 
     def has_unfinished(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.prefilling or self.running
+                    or self._flights)
 
     def step(self) -> List[RequestOutput]:
-        """One engine iteration: admit+prefill, batched decode, sample."""
+        """One engine iteration: admit, chunked prefill, batched decode.
+        Emits a RequestOutput for every request that gained tokens (decode
+        emissions trail one tick behind dispatch — async pipeline)."""
         self._admit()
         outputs: List[RequestOutput] = []
-        if self.finished_outputs:
-            # Requests that finished during admission (stop token / length on
-            # the very first sampled token).
-            outputs.extend(self.finished_outputs)
-            self.finished_outputs.clear()
-        if not self.running:
-            return outputs
-        logits = self._decode_batch()
-        finished: List[_Request] = []
-        for i, req in enumerate(self.running):
-            token = sample(logits[i], req.params,
-                           np.asarray(req.prompt + req.output))
-            req.output.append(int(token))
-            if self._is_finished(req):
-                finished.append(req)
-                outputs.append(RequestOutput(
-                    req.id, req.prompt, req.output, True, req.finished_reason,
-                    self._detok(req.output)))
-        for req in finished:
-            self.running.remove(req)
-            self.block_manager.release(req)
+        if self._rejected:
+            outputs.extend(self._rejected)
+            self._rejected.clear()
+        if self.prefilling:
+            outputs.extend(self._prefill_step())
+        if self.running or self._flights:
+            outputs.extend(self._decode_tick())
         return outputs
 
     def generate(self, prompts: List[Sequence[int]],
                  params: Optional[SamplingParams] = None,
                  ) -> List[RequestOutput]:
         ids = [self.add_request(p, params) for p in prompts]
-        collected: Dict[str, RequestOutput] = {}
+        done: Dict[str, RequestOutput] = {}
         while self.has_unfinished():
             for out in self.step():
-                collected[out.request_id] = out
-        return [collected[i] for i in ids]
+                if out.finished:
+                    done[out.request_id] = out
+        return [done[i] for i in ids]
+
+    def stream(self, prompt_token_ids: Sequence[int],
+               params: Optional[SamplingParams] = None):
+        """Single-request token stream: yields token ids as they are
+        sampled; the engine may be concurrently serving other requests only
+        if the caller drives step() elsewhere — this helper drives it."""
+        rid = self.add_request(prompt_token_ids, params)
+        while True:
+            for out in self.step():
+                if out.request_id != rid:
+                    continue
+                for t in out.new_token_ids:
+                    yield t
+                if out.finished:
+                    return
+            if not self.has_unfinished():
+                return
 
     # ---- internals -------------------------------------------------------
 
     def _admit(self):
-        """Move waiting requests into the running batch while KV pages and
-        batch slots allow; prefill each admitted prompt."""
-        import jax.numpy as jnp
-
-        while self.waiting and len(self.running) < self.max_batch:
+        """waiting -> prefilling while pages for (context + 1 token) and
+        batch slots are available."""
+        while (self.waiting
+               and len(self.prefilling) + len(self.running) < self.max_batch):
             req = self.waiting[0]
-            # Reserve room for the prompt plus at least one generated token.
-            if not self.block_manager.can_allocate(req.num_tokens + 1):
+            if req.dispatched:
+                # Preempted with steps still in flight: quarantine until the
+                # stale flights drain (their tokens reference KV in pages
+                # already detached for release — mixing them with a fresh
+                # prefill would corrupt the recomputed sequence).
+                break
+            if len(req.context) + 1 > self._cap_tokens:
+                self.waiting.popleft()
+                req.finished_reason = "length"
+                self._rejected.append(RequestOutput(
+                    req.id, req.prompt, list(req.output), True, "length",
+                    self._detok(req.output)))
+                continue
+            if not self.block_manager.can_allocate(len(req.context) + 1):
                 break
             self.waiting.popleft()
-            assert self.block_manager.allocate(req, req.num_tokens + 1)
-            table = self._block_table(req)
-            logits = self.runner.prefill(
-                jnp.asarray([req.prompt], dtype=jnp.int32), table)
-            token = sample(np.asarray(logits[0]), req.params,
-                           np.asarray(req.prompt))
-            req.output.append(int(token))
-            if self._is_finished(req):
+            assert self.block_manager.allocate(req, len(req.context) + 1)
+            req.prefilled = 0
+            self.prefilling.append(req)
+
+    def _needs_logits(self, reqs) -> bool:
+        """Host sampling (full logits fetch) is only needed for features the
+        device sampler lacks (repetition penalty)."""
+        return any(r.params.repetition_penalty != 1.0 for r in reqs)
+
+    def _sampling_arrays(self, batch, S, counters):
+        temps = np.zeros(S, dtype=np.float32)
+        top_ks = np.zeros(S, dtype=np.int32)
+        top_ps = np.ones(S, dtype=np.float32)
+        seeds = np.zeros(S, dtype=np.int32)
+        for i, req in enumerate(batch):
+            temps[i] = req.params.temperature
+            top_ks[i] = req.params.top_k
+            top_ps[i] = req.params.top_p
+            seeds[i] = req.seed_val
+        return temps, top_ks, top_ps, seeds, np.asarray(counters, np.int32)
+
+    def _prefill_step(self) -> List[RequestOutput]:
+        """One chunk for every prefilling sequence, batched and bucketed.
+        Chunk dispatches are async; only the final token fetch syncs."""
+        batch = self.prefilling[:self.max_batch]
+        chunks = [min(len(r.context) - r.prefilled, self.prefill_chunk)
+                  for r in batch]
+        Bq = self.runner.chunk_bucket(max(chunks))
+        chunks = [min(c, Bq) for c in chunks]
+        S = self.runner.batch_bucket(len(batch))
+        tokens = np.zeros((S, Bq), dtype=np.int32)
+        q_positions = np.zeros(S, dtype=np.int32)
+        kv_lens = np.zeros(S, dtype=np.int32)
+        q_lens = np.zeros(S, dtype=np.int32)
+        tables = np.zeros((S, self.max_blocks_per_seq), dtype=np.int32)
+        counters = np.zeros(S, dtype=np.int32)
+        for i, (req, c) in enumerate(zip(batch, chunks)):
+            ctx = req.context
+            tokens[i, :c] = ctx[req.prefilled:req.prefilled + c]
+            q_positions[i] = req.prefilled
+            kv_lens[i] = req.prefilled + c
+            q_lens[i] = c
+            tables[i, :len(req.blocks)] = req.blocks
+            counters[i] = req.prefilled + c
+        outputs: List[RequestOutput] = []
+        if self._needs_logits(batch):
+            logits = np.asarray(self.runner.step(
+                tokens, q_positions, kv_lens, q_lens, tables))
+            sampled = None
+        else:
+            temps, top_ks, top_ps, seeds, counters = self._sampling_arrays(
+                batch, S, counters)
+            sampled = np.asarray(self.runner.step_sample(
+                tokens, q_positions, kv_lens, q_lens, tables,
+                temps, top_ks, top_ps, seeds, counters))
+            logits = None
+        for i, (req, c) in enumerate(zip(batch, chunks)):
+            req.prefilled += c
+            if req.prefilled < len(req.context):
+                continue  # mid-prompt: this chunk's sample is unused
+            self.prefilling.remove(req)
+            if req.output:
+                # Recomputed after preemption: context already includes
+                # generated tokens; resume decoding without re-sampling.
+                self.running.append(req)
+                continue
+            if sampled is not None:
+                token = int(sampled[i])
+            else:
+                token = int(sample(logits[i], req.params,
+                                   np.asarray(req.context)))
+            req.output.append(token)
+            outputs.append(self._emit(req, [token]))
+            if req.finished_reason:
                 self.block_manager.release(req)
-                self.finished_outputs.append(RequestOutput(
-                    req.id, req.prompt, req.output, True, req.finished_reason,
-                    self._detok(req.output)))
             else:
                 self.running.append(req)
+        return outputs
 
-    def _decode_batch(self) -> np.ndarray:
-        import jax.numpy as jnp
+    # ---- async decode pipeline ------------------------------------------
 
-        # Ensure every request has a page for its next token.
-        for req in self.running:
-            if not self.block_manager.allocate(req, req.num_tokens + 1):
-                # Preempt the newest request (recompute later) to free pages.
+    def _decode_tick(self) -> List[RequestOutput]:
+        """Dispatch one speculative decode step chained off the newest
+        in-flight step, then (only once the pipeline is full, or when
+        nothing could be dispatched) process the OLDEST step's tokens —
+        whose device->host copy has been in flight for pipeline_depth
+        ticks."""
+        if self._needs_logits(self.running):
+            return self._decode_sync()
+        prev = self._flights[-1] if self._flights else None
+        flight = self._dispatch_decode(prev) if self.running else None
+        if flight is not None:
+            self._flights.append(flight)
+        outputs: List[RequestOutput] = []
+        if self._flights and (len(self._flights) > self.pipeline_depth
+                              or flight is None):
+            outputs = self._process_inflight(self._flights.popleft())
+        self._drain_release()
+        return outputs
+
+    def _ensure_pages(self) -> None:
+        """Every running seq needs pages for committed + dispatched + 1
+        tokens; preempt the newest otherwise. Preempted/finished pages that
+        an in-flight step may still write are released only once drained."""
+        for req in list(self.running):
+            if req not in self.running:
+                continue
+            while not self.block_manager.allocate(
+                    req, min(req.num_tokens + req.dispatched + 1,
+                             self._cap_tokens)):
                 victim = self.running[-1]
-                self.block_manager.release(victim)
-                victim.output = []
                 self.running.remove(victim)
+                victim.prefilled = 0
                 self.waiting.appendleft(victim)
+                self._defer_release(victim)
                 if req is victim:
-                    continue
-                assert self.block_manager.allocate(req, req.num_tokens + 1)
-        b = len(self.running)
-        tokens = jnp.asarray([r.output[-1] for r in self.running], dtype=jnp.int32)
-        positions = jnp.asarray([r.num_tokens - 1 for r in self.running],
-                                dtype=jnp.int32)
-        seq_lens = jnp.asarray([r.num_tokens for r in self.running],
-                               dtype=jnp.int32)
-        tables = jnp.concatenate([self._block_table(r)[None] for r in self.running])
-        logits = self.runner.decode(tokens, tables, positions, seq_lens)
-        return np.asarray(logits)
+                    break
 
-    def _block_table(self, req: _Request):
+    def _dispatch_decode(self, prev: Optional[dict]) -> Optional[dict]:
         import jax.numpy as jnp
 
-        table = np.zeros(self.max_blocks_per_seq, dtype=np.int32)
-        table[:len(req.blocks)] = req.blocks
-        return jnp.asarray(table)
+        self._ensure_pages()
+        prev_reqs = set(prev["batch"]) if prev else set()
 
-    def _is_finished(self, req: _Request) -> bool:
+        def eligible(r):
+            if self.block_manager.blocks_needed(
+                    r.num_tokens + r.dispatched + 1) > len(r.blocks):
+                return False
+            # Don't speculate past max_tokens / the length cap (bounded
+            # overshoot; also keeps block tables within their static width).
+            if (len(r.output) + r.dispatched >= r.params.max_tokens
+                    or r.num_tokens + r.dispatched >= self._cap_tokens):
+                return False
+            # A req with device-resident tokens must chain from the newest
+            # flight; if it is not there (just recomputed/odd scheduling),
+            # wait until its flights are processed.
+            if r.dispatched and r not in prev_reqs:
+                return False
+            return True
+
+        batch = [r for r in self.running if eligible(r)]
+        if not batch:
+            return None
+        S = self.runner.batch_bucket(len(batch))
+        host_tokens = np.zeros(S, dtype=np.int32)
+        gather_idx = np.zeros(S, dtype=np.int32)
+        from_prev = np.zeros(S, dtype=bool)
+        q_positions = np.zeros(S, dtype=np.int32)
+        kv_lens = np.zeros(S, dtype=np.int32)
+        q_lens = np.zeros(S, dtype=np.int32)
+        tables = np.zeros((S, self.max_blocks_per_seq), dtype=np.int32)
+        counters = np.zeros(S, dtype=np.int32)
+        prev_rows = ({req: i for i, req in enumerate(prev["batch"])}
+                     if prev else {})
+        for i, req in enumerate(batch):
+            pos = req.num_tokens + req.dispatched - 1  # last token's position
+            if req.dispatched and req in prev_rows:
+                from_prev[i] = True
+                gather_idx[i] = prev_rows[req]
+            else:
+                host_tokens[i] = req.output[-1] if req.output else req.prompt[-1]
+            q_positions[i] = pos
+            kv_lens[i] = pos + 1
+            q_lens[i] = 1
+            tables[i, :len(req.blocks)] = req.blocks
+            counters[i] = pos + 1
+        if prev is not None and from_prev.any():
+            toks = jnp.where(jnp.asarray(from_prev),
+                             prev["tokens"][jnp.asarray(gather_idx)],
+                             jnp.asarray(host_tokens))
+        else:
+            toks = jnp.asarray(host_tokens)
+        temps, top_ks, top_ps, seeds, counters = self._sampling_arrays(
+            batch, S, counters)
+        dev_tokens = self.runner.step_sample(
+            toks[:, None], q_positions, kv_lens, q_lens, tables,
+            temps, top_ks, top_ps, seeds, counters)
+        try:
+            dev_tokens.copy_to_host_async()
+        except AttributeError:
+            pass
+        for req in batch:
+            req.dispatched += 1
+        return {"batch": batch, "tokens": dev_tokens}
+
+    def _process_inflight(self, flight: Optional[dict]) -> List[RequestOutput]:
+        if flight is None:
+            return []
+        fetched = np.asarray(flight["tokens"])  # sync point (overlapped)
+        outputs: List[RequestOutput] = []
+        for i, req in enumerate(flight["batch"]):
+            req.dispatched -= 1
+            if req.finished_reason is not None:
+                continue  # token sampled past the end: discard
+            if req not in self.running:
+                continue  # preempted: will recompute from context
+            token = int(fetched[i])
+            req.output.append(token)
+            outputs.append(self._emit(req, [token]))
+            if req.finished_reason:
+                self.running.remove(req)
+                self._defer_release(req)
+        return outputs
+
+    def _defer_release(self, req: _Request):
+        """Release a seq's pages now, or after in-flight writes drain."""
+        if req.dispatched:
+            blocks, req.blocks = req.blocks, []
+            self._pending_release.append((req, blocks))
+        else:
+            self.block_manager.release(req)
+
+    def _drain_release(self):
+        """Free pages of finished/preempted seqs once no in-flight step can
+        still write into them."""
+        keep = []
+        for req, blocks in self._pending_release:
+            if req.dispatched == 0:
+                self.block_manager.free.extend(blocks)
+            else:
+                keep.append((req, blocks))
+        self._pending_release = keep
+
+    def _decode_sync(self) -> List[RequestOutput]:
+        """Legacy synchronous decode (host sampling with full logits) —
+        used when a request needs repetition penalty."""
+        outputs: List[RequestOutput] = []
+        while self._flights:
+            outputs.extend(self._process_inflight(self._flights.popleft()))
+        self._drain_release()
+        self._ensure_pages()
+        batch = self.running
+        if not batch:
+            return outputs
+        S = self.runner.batch_bucket(len(batch))
+        tokens = np.zeros((S, 1), dtype=np.int32)
+        q_positions = np.zeros(S, dtype=np.int32)
+        kv_lens = np.zeros(S, dtype=np.int32)
+        q_lens = np.zeros(S, dtype=np.int32)
+        tables = np.zeros((S, self.max_blocks_per_seq), dtype=np.int32)
+        for i, req in enumerate(batch):
+            tokens[i, 0] = req.output[-1] if req.output else req.prompt[-1]
+            q_positions[i] = req.num_tokens - 1
+            kv_lens[i] = req.num_tokens
+            q_lens[i] = 1
+            tables[i, :len(req.blocks)] = req.blocks
+        logits = np.asarray(self.runner.step(
+            tokens, q_positions, kv_lens, q_lens, tables))
+        finished: List[_Request] = []
+        for i, req in enumerate(batch):
+            token = sample(logits[i], req.params, np.asarray(req.context))
+            req.output.append(int(token))
+            outputs.append(self._emit(req, [int(token)]))
+            if req.finished_reason:
+                finished.append(req)
+        for req in finished:
+            self.running.remove(req)
+            self.block_manager.release(req)
+        return outputs
+
+    def _emit(self, req: _Request, new_tokens: List[int]) -> RequestOutput:
+        self._check_finished(req)
+        done = req.finished_reason is not None
+        return RequestOutput(
+            req.id, req.prompt, list(req.output), done, req.finished_reason,
+            self._detok(req.output) if done else None, new_tokens)
+
+    def _check_finished(self, req: _Request):
         p = req.params
-        if p.stop_token_ids and req.output[-1] in p.stop_token_ids:
+        if p.stop_token_ids and req.output and req.output[-1] in p.stop_token_ids:
             req.finished_reason = "stop"
-            return True
-        if len(req.output) >= p.max_tokens:
+        elif len(req.output) >= p.max_tokens:
             req.finished_reason = "length"
-            return True
-        if req.num_tokens >= self.runner.config.max_seq:
+        elif req.num_tokens >= self._cap_tokens:
             req.finished_reason = "length"
-            return True
-        return False
 
     def _detok(self, token_ids: List[int]) -> Optional[str]:
         if self.tokenizer is None:

@@ -1,182 +1,251 @@
-"""Model execution for serving: prefill + paged decode.
+"""Model execution for serving: one bucketed step for prefill + decode.
 
 Reference analog: the vLLM engine internals the reference only *places*
-(vllm_engine.py:222, vllm_models.py:117-168). Here the engine is native:
-the KV cache is a paged pool `(layers, num_blocks, block_size, kv_heads,
-head_dim)`; block tables map each sequence's logical positions onto pool
-blocks; decode attention gathers pages (jnp reference impl; the Pallas
-ragged-paged-attention kernel drops into `paged_attention` for TPU decode).
+(vllm_engine.py:222, vllm_models.py:117-168). TPU-native design:
 
-Shapes are static per (batch-bucket, max-blocks) so XLA compiles a small,
-reusable set of programs — no dynamic shapes in the hot loop.
+  * The KV cache is a paged pool `(layers, kv_heads, num_blocks, block_size,
+    head_dim)`; block tables map each sequence's logical positions onto pool
+    pages.
+  * ONE jitted step function serves both chunked prefill (Bq = chunk tokens
+    per sequence) and decode (Bq = 1): new-token KV is scattered into the
+    pool, then ragged paged attention (ops/paged_attention.py — Pallas on
+    TPU, O(actual context)) attends over each sequence's pages.
+  * Shapes are bucketed on (batch, Bq): the engine runs a small fixed set of
+    compiled programs — no recompiles in the hot loop (the round-1 runner
+    recompiled per prompt length and per batch size).
+  * Tensor parallelism: pass a mesh — params/cache shard per SERVE_RULES
+    (heads/kv_heads/mlp/vocab over tp), attention runs under shard_map with
+    per-shard heads.
 """
 
 from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ray_tpu.models import llama as llama_mod
-from ray_tpu.ops.attention import NEG_INF, mha_reference
+from ray_tpu.ops import paged_attention as pa
 from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies, swiglu
 
 
 def init_kv_cache(config: llama_mod.LlamaConfig, num_blocks: int,
                   block_size: int) -> Dict[str, jax.Array]:
-    shape = (config.n_layers, num_blocks, block_size, config.n_kv_heads,
+    shape = (config.n_layers, config.n_kv_heads, num_blocks, block_size,
              config.head_dim)
     return {"k": jnp.zeros(shape, dtype=config.dtype),
             "v": jnp.zeros(shape, dtype=config.dtype)}
 
 
-def _write_kv(cache_layer_k, cache_layer_v, k, v, block_ids, offsets):
-    """Scatter new kv rows into the paged pool.
-
-    cache_layer_*: (num_blocks, bs, K, hd); k/v: (n_tokens, K, hd);
-    block_ids/offsets: (n_tokens,).
-    """
-    return (cache_layer_k.at[block_ids, offsets].set(k),
-            cache_layer_v.at[block_ids, offsets].set(v))
-
-
-def paged_attention(q, cache_k, cache_v, block_tables, seq_lens, *,
-                    block_size: int, scale: float):
-    """Decode attention over paged KV.
-
-    q: (b, H, hd) one query token per sequence.
-    cache_k/v: (num_blocks, bs, K, hd) for ONE layer.
-    block_tables: (b, max_blocks) int32; seq_lens: (b,) lengths INCLUDING the
-    current token (whose kv must already be written).
-    Returns (b, H, hd).
-    """
-    b, H, hd = q.shape
-    K = cache_k.shape[2]
-    max_blocks = block_tables.shape[1]
-    max_ctx = max_blocks * block_size
-    # Gather pages: (b, max_blocks, bs, K, hd) -> (b, max_ctx, K, hd)
-    k = cache_k[block_tables].reshape(b, max_ctx, K, hd)
-    v = cache_v[block_tables].reshape(b, max_ctx, K, hd)
-    if K != H:
-        rep = H // K
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    logits = jnp.einsum("bhd,bkhd->bhk", q, k,
-                        preferred_element_type=jnp.float32) * scale
-    pos = jnp.arange(max_ctx)[None, :]
-    mask = pos < seq_lens[:, None]
-    logits = jnp.where(mask[:, None, :], logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhk,bkhd->bhd", probs, v)
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    # Beyond the precomputed set: next power of two (a new compile, never a
+    # silent cap — capping would overflow the engine's padded arrays).
+    return 1 << (n - 1).bit_length()
 
 
 class ModelRunner:
-    """Jit-compiled prefill and decode over a paged cache."""
+    """Bucketed, jit-compiled unified step over a paged cache."""
+
+    BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
 
     def __init__(self, config: llama_mod.LlamaConfig, params,
-                 num_blocks: int, block_size: int = 16):
+                 num_blocks: int, block_size: int = 16,
+                 mesh=None, attention_impl: str = "auto",
+                 chunk_size: int = 128,
+                 max_blocks_per_seq: Optional[int] = None):
         self.config = config
-        self.params = params
         self.block_size = block_size
         self.num_blocks = num_blocks
-        self.cache = init_kv_cache(config, num_blocks, block_size)
+        self.chunk_size = chunk_size
+        self.max_blocks_per_seq = max_blocks_per_seq or (
+            (config.max_seq + block_size - 1) // block_size)
+        self.mesh = mesh
+        self.tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
+        if attention_impl == "auto":
+            # The Pallas kernel's page DMA needs a 128-aligned trailing dim.
+            attention_impl = ("pallas" if jax.default_backend() == "tpu"
+                              and config.head_dim % 128 == 0 else "reference")
+        self.attention_impl = attention_impl
+        self.params = self._place_params(params)
+        self.cache = self._place_cache(
+            init_kv_cache(config, num_blocks, block_size))
         self.cos, self.sin = rope_frequencies(
             config.head_dim, config.max_seq, config.rope_theta)
-        self._prefill_jit = jax.jit(self._prefill, donate_argnums=(1,))
-        self._decode_jit = jax.jit(self._decode, donate_argnums=(1,))
+        self._step_jit = jax.jit(self._step, donate_argnums=(1,))
+        self._step_sample_jit = jax.jit(self._step_sample, donate_argnums=(1,))
 
-    # ---- prefill ---------------------------------------------------------
+    # ---- placement (TP over the mesh, SERVE_RULES) -----------------------
 
-    def _prefill(self, tokens, cache, block_table):
-        """tokens: (1, s); block_table: (max_blocks,). Returns (logits_last,
-        cache) with the prompt's kv written into the pool."""
+    def _place_params(self, params):
+        if self.mesh is None:
+            return params
+        from ray_tpu.parallel.sharding import SERVE_RULES, shard_tree
+
+        return shard_tree(params, llama_mod.param_logical_axes(self.config),
+                          SERVE_RULES, self.mesh)
+
+    def _place_cache(self, cache):
+        if self.mesh is None:
+            return cache
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = NamedSharding(self.mesh, P(None, "tp", None, None, None))
+        return jax.tree.map(lambda x: jax.device_put(x, spec), cache)
+
+    # ---- attention dispatch ---------------------------------------------
+
+    def _attend(self, q, k_pages, v_pages, block_tables, kv_lens, q_positions,
+                scale):
+        impl = (pa.ragged_paged_attention if self.attention_impl == "pallas"
+                else pa.ragged_paged_attention_reference)
+        fn = partial(impl, scale=scale)
+        if self.tp > 1:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            fn = shard_map(
+                fn, mesh=self.mesh,
+                in_specs=(P(None, None, "tp", None), P("tp"), P("tp"),
+                          P(), P(), P()),
+                out_specs=P(None, None, "tp", None))
+        return fn(q, k_pages, v_pages, block_tables, kv_lens, q_positions)
+
+    # ---- the unified step ------------------------------------------------
+
+    def _step(self, params, cache, tokens, q_positions, kv_lens, q_lens,
+              block_tables):
+        """tokens: (S, Bq) new tokens (padded); q_positions: (S,) absolute
+        position of tokens[s, 0]; kv_lens: (S,) context length AFTER this
+        step's tokens; q_lens: (S,) real token count per row (0 for padding
+        sequences). Returns (last-position logits (S, vocab), cache)."""
         config = self.config
-        p = self.params
-        s = tokens.shape[1]
-        x = p["embed"][tokens].astype(config.dtype)
-        positions = jnp.arange(s)
-        block_ids = block_table[positions // self.block_size]
-        offsets = positions % self.block_size
-
-        def layer_step(carry, layer_params_and_idx):
-            x, cache_k, cache_v = carry
-            lp, li = layer_params_and_idx
-            h = rms_norm(x, lp["attn_norm"], config.norm_eps)
-            b, s, d = x.shape
-            H, K, hd = config.n_heads, config.n_kv_heads, config.head_dim
-            q = (h @ lp["wq"]).reshape(b, s, H, hd)
-            k = (h @ lp["wk"]).reshape(b, s, K, hd)
-            v = (h @ lp["wv"]).reshape(b, s, K, hd)
-            q = apply_rope(q, self.cos, self.sin)
-            k = apply_rope(k, self.cos, self.sin)
-            cache_k = cache_k.at[li, block_ids, offsets].set(k[0])
-            cache_v = cache_v.at[li, block_ids, offsets].set(v[0])
-            attn = mha_reference(q, k, v, causal=True)
-            x = x + (attn.reshape(b, s, H * hd) @ lp["wo"])
-            h = rms_norm(x, lp["mlp_norm"], config.norm_eps)
-            x = x + (swiglu(h @ lp["w_gate"], h @ lp["w_up"]) @ lp["w_down"])
-            return (x, cache_k, cache_v), None
-
-        layer_indices = jnp.arange(config.n_layers)
-        (x, ck, cv), _ = jax.lax.scan(
-            layer_step, (x, cache["k"], cache["v"]),
-            (p["layers"], layer_indices))
-        x = rms_norm(x, p["final_norm"], config.norm_eps)
-        logits = (x[:, -1, :] @ p["lm_head"].astype(config.dtype)).astype(
-            jnp.float32)
-        return logits, {"k": ck, "v": cv}
-
-    def prefill(self, tokens: jax.Array, block_table) -> jax.Array:
-        logits, self.cache = self._prefill_jit(tokens, self.cache, block_table)
-        return logits
-
-    # ---- decode ----------------------------------------------------------
-
-    def _decode(self, tokens, cache, block_tables, positions, seq_lens):
-        """tokens: (b,) last sampled token per seq; positions: (b,) where the
-        new token goes; seq_lens: (b,) lengths AFTER this token."""
-        config = self.config
-        p = self.params
-        b = tokens.shape[0]
+        S, Bq = tokens.shape
         H, K, hd = config.n_heads, config.n_kv_heads, config.head_dim
         scale = 1.0 / math.sqrt(hd)
-        x = p["embed"][tokens].astype(config.dtype)[:, None, :]  # (b,1,d)
+        x = params["embed"][tokens].astype(config.dtype)        # (S, Bq, d)
+        positions = q_positions[:, None] + jnp.arange(Bq)[None, :]
+        valid = jnp.arange(Bq)[None, :] < q_lens[:, None]
+        logical_block = positions // self.block_size
         block_ids = jnp.take_along_axis(
-            block_tables, (positions // self.block_size)[:, None], axis=1)[:, 0]
+            block_tables, jnp.clip(logical_block, 0,
+                                   block_tables.shape[1] - 1), axis=1)
+        # Out-of-range ids drop padded rows' writes (scatter mode="drop").
+        block_ids = jnp.where(valid, block_ids, -1)
         offsets = positions % self.block_size
+        rope_pos = jnp.clip(positions, 0, config.max_seq - 1)
 
-        def layer_step(carry, layer_params_and_idx):
-            x, cache_k, cache_v = carry
-            lp, li = layer_params_and_idx
+        def layer_step(carry, lp_li):
+            x, ck, cv = carry
+            lp, li = lp_li
             h = rms_norm(x, lp["attn_norm"], config.norm_eps)
-            q = (h @ lp["wq"]).reshape(b, 1, H, hd)
-            k = (h @ lp["wk"]).reshape(b, 1, K, hd)
-            v = (h @ lp["wv"]).reshape(b, 1, K, hd)
-            q = apply_rope(q, self.cos, self.sin, positions[:, None])
-            k = apply_rope(k, self.cos, self.sin, positions[:, None])
-            cache_k = cache_k.at[li, block_ids, offsets].set(k[:, 0])
-            cache_v = cache_v.at[li, block_ids, offsets].set(v[:, 0])
-            attn = paged_attention(q[:, 0], cache_k[li], cache_v[li],
-                                   block_tables, seq_lens,
-                                   block_size=self.block_size, scale=scale)
-            x = x + (attn.reshape(b, 1, H * hd) @ lp["wo"])
+            q = (h @ lp["wq"]).reshape(S, Bq, H, hd)
+            k = (h @ lp["wk"]).reshape(S, Bq, K, hd)
+            v = (h @ lp["wv"]).reshape(S, Bq, K, hd)
+            q = apply_rope(q, self.cos, self.sin, rope_pos)
+            k = apply_rope(k, self.cos, self.sin, rope_pos)
+            # Scatter this step's kv into the pool: layer li, every kv head,
+            # page block_ids[s,b], slot offsets[s,b]. Mixed advanced
+            # indexing puts the (S, Bq) index dims first, so the value is
+            # (S, Bq, K, hd) — k/v as computed.
+            ck = ck.at[li, :, block_ids, offsets].set(k, mode="drop")
+            cv = cv.at[li, :, block_ids, offsets].set(v, mode="drop")
+            attn = self._attend(q, ck[li], cv[li], block_tables, kv_lens,
+                                q_positions, scale)
+            x = x + (attn.reshape(S, Bq, H * hd) @ lp["wo"])
             h = rms_norm(x, lp["mlp_norm"], config.norm_eps)
             x = x + (swiglu(h @ lp["w_gate"], h @ lp["w_up"]) @ lp["w_down"])
-            return (x, cache_k, cache_v), None
+            return (x, ck, cv), None
 
         layer_indices = jnp.arange(config.n_layers)
         (x, ck, cv), _ = jax.lax.scan(
             layer_step, (x, cache["k"], cache["v"]),
-            (p["layers"], layer_indices))
-        x = rms_norm(x, p["final_norm"], config.norm_eps)
-        logits = (x[:, 0, :] @ p["lm_head"].astype(config.dtype)).astype(
+            (params["layers"], layer_indices))
+        x = rms_norm(x, params["final_norm"], config.norm_eps)
+        # Only the last REAL position per sequence pays the vocab matmul.
+        last = jnp.take_along_axis(
+            x, jnp.maximum(q_lens - 1, 0)[:, None, None], axis=1)[:, 0]
+        logits = (last @ params["lm_head"].astype(config.dtype)).astype(
             jnp.float32)
         return logits, {"k": ck, "v": cv}
 
-    def decode(self, tokens, block_tables, positions, seq_lens) -> jax.Array:
-        logits, self.cache = self._decode_jit(
-            tokens, self.cache, block_tables, positions, seq_lens)
+    def step(self, tokens, q_positions, kv_lens, q_lens, block_tables):
+        """Run one bucketed step; inputs are host arrays already padded to a
+        (batch, Bq) bucket by the engine. Returns logits (S, vocab)."""
+        logits, self.cache = self._step_jit(
+            self.params, self.cache, tokens, q_positions, kv_lens, q_lens,
+            block_tables)
         return logits
+
+    # ---- on-device sampling ---------------------------------------------
+
+    NEG_INF = -1e30
+
+    def _device_sample(self, logits, temps, top_ks, top_ps, seeds, counters):
+        """Vectorized per-sequence sampling on device: greedy (temp 0),
+        temperature, top-k, top-p, seeded. Keeps the decode loop free of
+        (S, vocab) device->host logit transfers — only sampled token ids
+        cross the wire (the latency win that makes async decode possible).
+        top-p keeps the smallest prefix with mass >= p (crossing token
+        included, vLLM semantics)."""
+        S, V = logits.shape
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temps[:, None], 1e-6)
+        sorted_desc = -jnp.sort(-scaled, axis=-1)
+        k_eff = jnp.where(top_ks > 0, top_ks, V)
+        kth = jnp.take_along_axis(
+            sorted_desc, jnp.clip(k_eff - 1, 0, V - 1)[:, None], axis=1)
+        scaled = jnp.where(scaled >= kth, scaled, self.NEG_INF)
+        probs = jax.nn.softmax(scaled, axis=-1)
+        sp = -jnp.sort(-probs, axis=-1)
+        csum = jnp.cumsum(sp, axis=-1)
+        # Keep token j iff the probability mass BEFORE it is < top_p (the
+        # crossing token stays; robust to fp32 cumsum never reaching 1.0,
+        # which would otherwise collapse top_p=1.0 to greedy).
+        keep_sorted = (csum - sp) < top_ps[:, None]
+        cutoff = jnp.min(jnp.where(keep_sorted, sp, jnp.inf), axis=-1,
+                         keepdims=True)
+        scaled = jnp.where(probs >= cutoff, scaled, self.NEG_INF)
+
+        def one(seed, counter, lg):
+            key = jax.random.fold_in(jax.random.key(seed), counter)
+            return jax.random.categorical(key, lg)
+
+        sampled = jax.vmap(one)(seeds, counters, scaled).astype(jnp.int32)
+        return jnp.where(temps <= 0.0, greedy, sampled)
+
+    def _step_sample(self, params, cache, tokens, q_positions, kv_lens,
+                     q_lens, block_tables, temps, top_ks, top_ps, seeds,
+                     counters):
+        logits, cache = self._step(params, cache, tokens, q_positions,
+                                   kv_lens, q_lens, block_tables)
+        toks = self._device_sample(logits, temps, top_ks, top_ps, seeds,
+                                   counters)
+        return toks, cache
+
+    def step_sample(self, tokens, q_positions, kv_lens, q_lens, block_tables,
+                    temps, top_ks, top_ps, seeds, counters):
+        """Unified step + on-device sampling. `tokens` may be a DEVICE array
+        (the previous step's output — async chaining without host sync).
+        Returns the sampled token ids as a device array; the caller decides
+        when to fetch (overlap the transfer with the next dispatch)."""
+        toks, self.cache = self._step_sample_jit(
+            self.params, self.cache, tokens, q_positions, kv_lens, q_lens,
+            block_tables, temps, top_ks, top_ps, seeds, counters)
+        return toks
+
+    def batch_bucket(self, n: int) -> int:
+        return _bucket(n, self.BATCH_BUCKETS)
+
+    def chunk_bucket(self, n: int) -> int:
+        buckets, b = [], 8
+        while b < self.chunk_size:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.chunk_size)
+        return _bucket(n, buckets)

@@ -3,14 +3,22 @@
 Reference analog: python/ray/llm/_internal/serve/ (VLLMEngine wrapper
 vllm_engine.py:222, vllm_deployment.py, the OpenAI router deployments/
 routers/, build_openai_app). Ours wraps the native paged-attention engine
-(ray_tpu.llm.engine) in a serve deployment; TP placement maps to num_tpus on
-the replica (the reference plans TP x PP placement groups around vLLM,
-vllm_models.py:117-168 — here the engine's mesh lives inside the replica).
+(ray_tpu.llm.engine) in a serve deployment. The engine loop runs on a
+background thread inside the replica (the vLLM MQEngine pattern collapsed
+in-process): request threads enqueue prompts and consume per-request token
+queues, so many requests stream concurrently through one continuously-
+batched engine. TP maps to a mesh inside the replica (SERVE_RULES sharding),
+placed via num_tpus — the reference plans TP x PP placement groups around
+vLLM (vllm_models.py:117-168).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
+import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 from ray_tpu import serve
@@ -26,11 +34,13 @@ class LLMConfig:
     max_batch_size: int = 8
     num_replicas: int = 1
     num_tpus_per_replica: float = 0.0
+    tensor_parallel: int = 1            # tp axis size of the in-replica mesh
+    prefill_chunk: int = 128
     tokenizer: Any = None
 
 
 class LLMServer:
-    """The replica callable: owns one engine instance."""
+    """The replica callable: owns one engine instance + its step loop."""
 
     def __init__(self, llm_config: LLMConfig):
         import jax
@@ -46,20 +56,88 @@ class LLMServer:
             params = Checkpoint(llm_config.params_checkpoint).load_pytree()
         else:
             params = llama.init_params(config, jax.random.key(llm_config.seed))
+        mesh = None
+        if llm_config.tensor_parallel > 1:
+            from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+
+            mesh = build_mesh(
+                MeshConfig(tp=llm_config.tensor_parallel),
+                devices=jax.devices()[:llm_config.tensor_parallel])
         runner = ModelRunner(config, params,
                              num_blocks=llm_config.num_kv_blocks,
-                             block_size=llm_config.block_size)
+                             block_size=llm_config.block_size,
+                             chunk_size=llm_config.prefill_chunk,
+                             mesh=mesh)
         self.engine = LLMEngine(runner,
                                 max_batch_size=llm_config.max_batch_size,
-                                tokenizer=llm_config.tokenizer)
+                                tokenizer=llm_config.tokenizer,
+                                prefill_chunk=llm_config.prefill_chunk)
         self.tokenizer = llm_config.tokenizer
+        self._lock = threading.Lock()
+        # request_id -> per-request event queue; the engine loop fans
+        # RequestOutputs out to these (token-at-a-time streaming).
+        self._streams: Dict[str, queue.Queue] = {}
+        self._loop = threading.Thread(target=self._engine_loop, daemon=True)
+        self._loop.start()
 
-    def __call__(self, request: Dict) -> Dict:
-        return self.completions(request)
+    # ---- engine loop -----------------------------------------------------
 
-    def completions(self, request: Dict) -> Dict:
-        """OpenAI-ish /v1/completions: {"prompt": str|[int], "max_tokens",
-        "temperature", "top_k", "top_p", "stop_token_ids"}."""
+    def _engine_loop(self):
+        import logging
+
+        log = logging.getLogger(__name__)
+        while True:
+            try:
+                with self._lock:
+                    busy = self.engine.has_unfinished()
+                    outs = self.engine.step() if busy else []
+            except Exception as e:
+                # A wedged engine must not silently strand every request:
+                # surface the failure to all waiters and reset to a clean
+                # scheduler state.
+                log.exception("engine step failed; failing active requests")
+                with self._lock:
+                    import numpy as _np
+
+                    # Drain in-flight device steps BEFORE freeing their
+                    # pages (late writes into recycled pages would corrupt
+                    # future sequences), then force-release everything.
+                    for flight in list(self.engine._flights):
+                        try:
+                            _np.asarray(flight["tokens"])
+                        except Exception:
+                            pass
+                    self.engine._flights.clear()
+                    for req, blocks in self.engine._pending_release:
+                        self.engine.block_manager.free.extend(blocks)
+                    self.engine._pending_release.clear()
+                    for req in (list(self.engine.running)
+                                + list(self.engine.prefilling)
+                                + list(self.engine.waiting)):
+                        req.dispatched = 0
+                        self.engine.block_manager.release(req)
+                    self.engine.running.clear()
+                    self.engine.prefilling.clear()
+                    self.engine.waiting.clear()
+                for q in list(self._streams.values()):
+                    q.put(e)
+                continue
+            for out in outs:
+                q = self._streams.get(out.request_id)
+                if q is not None:
+                    q.put(out)
+            if not busy:
+                time.sleep(0.005)
+
+    def _submit(self, prompt, params) -> str:
+        rid = uuid.uuid4().hex[:12]
+        q: queue.Queue = queue.Queue()
+        self._streams[rid] = q
+        with self._lock:
+            self.engine.add_request(prompt, params, request_id=rid)
+        return rid
+
+    def _parse(self, request: Dict):
         from ray_tpu.llm.sampling import SamplingParams
 
         prompt = request.get("prompt", [])
@@ -74,7 +152,28 @@ class LLMServer:
             max_tokens=int(request.get("max_tokens", 32)),
             stop_token_ids=request.get("stop_token_ids"),
             seed=request.get("seed"))
-        out = self.engine.generate([prompt], params)[0]
+        return prompt, params
+
+    # ---- API -------------------------------------------------------------
+
+    def __call__(self, request: Dict) -> Dict:
+        return self.completions(request)
+
+    def completions(self, request: Dict) -> Dict:
+        """OpenAI-ish /v1/completions: {"prompt": str|[int], "max_tokens",
+        "temperature", "top_k", "top_p", "stop_token_ids"}."""
+        prompt, params = self._parse(request)
+        rid = self._submit(prompt, params)
+        q = self._streams[rid]
+        try:
+            while True:
+                out = q.get(timeout=300)
+                if isinstance(out, Exception):
+                    raise out
+                if out.finished:
+                    break
+        finally:
+            self._streams.pop(rid, None)
         return {
             "id": out.request_id,
             "object": "text_completion",
@@ -89,11 +188,37 @@ class LLMServer:
             },
         }
 
+    def completions_stream(self, request: Dict):
+        """Streaming completions: a generator of OpenAI-style chunk events,
+        one per sampled token. Consume through
+        handle.options("completions_stream").remote_stream(request)."""
+        prompt, params = self._parse(request)
+        rid = self._submit(prompt, params)
+        q = self._streams[rid]
+        try:
+            while True:
+                out = q.get(timeout=300)
+                if isinstance(out, Exception):
+                    raise out
+                for t in out.new_token_ids:
+                    yield {"id": rid, "object": "text_completion.chunk",
+                           "token": int(t), "finished": False}
+                if out.finished:
+                    yield {"id": rid, "object": "text_completion.chunk",
+                           "token": None, "finished": True,
+                           "finish_reason": out.finish_reason,
+                           "text": out.text,
+                           "token_ids": out.output_token_ids}
+                    return
+        finally:
+            self._streams.pop(rid, None)
+
 
 def build_llm_deployment(llm_config: LLMConfig, name: str = "llm") -> Any:
     dep = serve.deployment(LLMServer).options(
         name=name, num_replicas=llm_config.num_replicas,
-        num_tpus=llm_config.num_tpus_per_replica)
+        num_tpus=llm_config.num_tpus_per_replica,
+        max_ongoing_requests=llm_config.max_batch_size)
     return dep.bind(llm_config)
 
 

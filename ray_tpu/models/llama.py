@@ -137,7 +137,7 @@ def _attention_dispatch(config: LlamaConfig, q, k, v):
     if impl == "ring":
         from functools import partial as _partial
 
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ray_tpu.parallel.mesh import current_mesh

@@ -96,9 +96,13 @@ def _rpa_kernel(block_tables_ref, kv_lens_ref, q_pos_ref,   # scalar prefetch
                 pltpu.make_async_copy(vpages_hbm.at[kh, page], v_scr.at[slot],
                                       sems.at[slot, 1]))
 
-    kd, vd = page_dma(0, 0)
-    kd.start()
-    vd.start()
+    @pl.when(n_pages > 0)
+    def _():
+        # Padding sequences (kv_len == 0) must not start a DMA that the
+        # zero-iteration loop below would never wait on.
+        kd, vd = page_dma(0, 0)
+        kd.start()
+        vd.start()
 
     def body(i, carry):
         m, l, acc = carry

@@ -1,0 +1,424 @@
+"""Pipeline parallelism: layer-partitioned stages + 1F1B microbatch schedule.
+
+Reference analog: the reference provides PP only as a substrate — compiled
+DAGs with a static per-actor schedule (python/ray/dag/compiled_dag_node.py:767,
+dag_node_operation.py:17-34) plus vLLM's internal PP placement
+(vllm_models.py:121-131). Here PP is first-class and deliberately NOT a mesh
+axis (see parallel/mesh.py): stages are separate programs — on separate
+devices in one process (LocalPipeline: the dryrun/test path and the
+single-host multi-chip path) or separate actors (ActorPipeline: the
+multi-host path, activations riding the object plane the way compiled-graph
+channels do).
+
+Memory model: full activation recomputation — backward re-runs the stage
+forward from the saved stage INPUT (cheap to store), so live memory per
+stage is bounded by the 1F1B in-flight microbatch count, independent of
+model depth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ------------------------------------------------------------- partitioning
+
+def stage_layer_ranges(n_layers: int, n_stages: int) -> List[Tuple[int, int]]:
+    """Split layers into contiguous per-stage ranges (balanced, remainder to
+    the earlier stages which also don't carry the lm_head)."""
+    base, extra = divmod(n_layers, n_stages)
+    ranges, start = [], 0
+    for s in range(n_stages):
+        size = base + (1 if s < extra else 0)
+        ranges.append((start, start + size))
+        start += size
+    return ranges
+
+
+def split_params(params: Dict, n_stages: int) -> List[Dict]:
+    """Slice a stacked-layer Llama param tree into per-stage trees. Stage 0
+    holds the embedding; the last stage holds final_norm + lm_head."""
+    n_layers = jax.tree.leaves(params["layers"])[0].shape[0]
+    ranges = stage_layer_ranges(n_layers, n_stages)
+    stages = []
+    for s, (lo, hi) in enumerate(ranges):
+        st: Dict[str, Any] = {
+            "layers": jax.tree.map(lambda x: x[lo:hi], params["layers"])}
+        if s == 0:
+            st["embed"] = params["embed"]
+        if s == n_stages - 1:
+            st["final_norm"] = params["final_norm"]
+            st["lm_head"] = params["lm_head"]
+        stages.append(st)
+    return stages
+
+
+def merge_params(stage_params: List[Dict]) -> Dict:
+    """Inverse of split_params (checkpoint save / single-device eval)."""
+    layers = jax.tree.map(
+        lambda *xs: jnp.concatenate(xs, axis=0),
+        *[st["layers"] for st in stage_params])
+    return {"embed": stage_params[0]["embed"], "layers": layers,
+            "final_norm": stage_params[-1]["final_norm"],
+            "lm_head": stage_params[-1]["lm_head"]}
+
+
+# ------------------------------------------------------------ stage programs
+
+def stage_apply(stage_params: Dict, x, config, *, is_first: bool,
+                is_last: bool):
+    """One stage's forward: tokens -> hidden (first), hidden -> hidden
+    (middle), hidden -> logits (last)."""
+    from ray_tpu.models import llama as llama_mod
+    from ray_tpu.ops.layers import rms_norm, rope_frequencies
+
+    cos, sin = rope_frequencies(config.head_dim, config.max_seq,
+                                config.rope_theta)
+    if is_first:
+        x = stage_params["embed"][x].astype(config.dtype)
+
+    layer_fn = partial(llama_mod._layer, config)
+    if config.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def body(h, lp):
+        return layer_fn(h, lp, cos, sin), None
+
+    x, _ = jax.lax.scan(body, x, stage_params["layers"])
+    if is_last:
+        x = rms_norm(x, stage_params["final_norm"], config.norm_eps)
+        x = (x @ stage_params["lm_head"].astype(config.dtype)).astype(
+            jnp.float32)
+    return x
+
+
+def last_stage_loss(stage_params: Dict, x, targets, config,
+                    is_first: bool = False):
+    from ray_tpu.models.llama import next_token_ce
+
+    logits = stage_apply(stage_params, x, config, is_first=is_first,
+                         is_last=True)
+    return next_token_ce(logits, targets)
+
+
+# --------------------------------------------------------------- schedule
+
+@dataclasses.dataclass(frozen=True)
+class PipeOp:
+    kind: str        # "fwd" | "bwd"
+    stage: int
+    microbatch: int
+
+
+def one_f_one_b(n_stages: int, n_microbatches: int) -> List[List[PipeOp]]:
+    """Per-stage 1F1B op sequences (the static schedule a compiled DAG would
+    carry, dag_node_operation.py:17). Stage s runs (n_stages - s) warmup
+    forwards, then alternates 1F1B, then drains backwards."""
+    assert n_microbatches >= n_stages, \
+        "1F1B needs at least n_stages microbatches"
+    per_stage: List[List[PipeOp]] = []
+    for s in range(n_stages):
+        ops: List[PipeOp] = []
+        warmup = n_stages - s
+        f = b = 0
+        for _ in range(min(warmup, n_microbatches)):
+            ops.append(PipeOp("fwd", s, f))
+            f += 1
+        while f < n_microbatches:
+            ops.append(PipeOp("bwd", s, b))
+            b += 1
+            ops.append(PipeOp("fwd", s, f))
+            f += 1
+        while b < n_microbatches:
+            ops.append(PipeOp("bwd", s, b))
+            b += 1
+        per_stage.append(ops)
+    return per_stage
+
+
+def global_order(n_stages: int, n_microbatches: int) -> List[PipeOp]:
+    """A single sequential order respecting all inter-stage dependencies
+    (for single-process execution): fwd(s, m) after fwd(s-1, m); bwd(s, m)
+    after bwd(s+1, m) and fwd(s, m)."""
+    per_stage = one_f_one_b(n_stages, n_microbatches)
+    cursors = [0] * n_stages
+    done_f = set()
+    done_b = set()
+    order: List[PipeOp] = []
+    total = sum(len(ops) for ops in per_stage)
+    while len(order) < total:
+        progressed = False
+        for s in range(n_stages):
+            while cursors[s] < len(per_stage[s]):
+                op = per_stage[s][cursors[s]]
+                if op.kind == "fwd":
+                    ready = s == 0 or (s - 1, op.microbatch) in done_f
+                else:
+                    ready = ((s == n_stages - 1
+                              or (s + 1, op.microbatch) in done_b)
+                             and (s, op.microbatch) in done_f)
+                if not ready:
+                    break
+                (done_f if op.kind == "fwd" else done_b).add(
+                    (s, op.microbatch))
+                order.append(op)
+                cursors[s] += 1
+                progressed = True
+        assert progressed, "1F1B schedule deadlocked"
+    return order
+
+
+# ---------------------------------------------------------- local pipeline
+
+class LocalPipeline:
+    """Stages on distinct devices of one process (ICI p2p on real hardware;
+    host transfer on CPU test meshes). Used by dryrun_multichip's pp leg."""
+
+    def __init__(self, config, params, n_stages: int, optimizer,
+                 devices: Optional[Sequence] = None):
+        self.config = config
+        self.n_stages = n_stages
+        self.optimizer = optimizer
+        devices = list(devices or jax.devices()[:n_stages])
+        assert len(devices) >= n_stages
+        self.devices = devices[:n_stages]
+        stages = split_params(params, n_stages)
+        self.stage_params = [
+            jax.device_put(st, d) for st, d in zip(stages, self.devices)]
+        self.opt_states = [
+            jax.device_put(optimizer.init(st), d)
+            for st, d in zip(self.stage_params, self.devices)]
+        self._fwd = []
+        self._bwd = []
+        for s in range(n_stages):
+            is_first, is_last = s == 0, s == n_stages - 1
+            if is_last:
+                def loss_f(p, x, t, _first=is_first):
+                    return last_stage_loss(p, x, t, config, is_first=_first)
+
+                self._fwd.append(None)
+                self._bwd.append(jax.jit(jax.value_and_grad(
+                    loss_f, argnums=(0, 1))))
+            else:
+                f = partial(stage_apply, config=config, is_first=is_first,
+                            is_last=False)
+                self._fwd.append(jax.jit(f))
+
+                def bwd_f(p, x, g, _f=f):
+                    out, vjp = jax.vjp(lambda pp, xx: _f(pp, xx), p, x)
+                    return vjp(g)
+
+                self._bwd.append(jax.jit(bwd_f))
+        self._apply = jax.jit(
+            lambda p, o, g: self._apply_impl(p, o, g))
+
+    def _apply_impl(self, params, opt_state, grads):
+        import optax
+
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    def train_step(self, tokens, n_microbatches: int) -> Dict[str, float]:
+        """One 1F1B training step. tokens: (batch, seq+1) int32; batch must
+        divide into n_microbatches."""
+        B = tokens.shape[0]
+        assert B % n_microbatches == 0
+        mb = B // n_microbatches
+        inputs = tokens[:, :-1]
+        targets = tokens[:, 1:]
+        saved_in: Dict[Tuple[int, int], Any] = {}
+        fwd_out: Dict[Tuple[int, int], Any] = {}
+        grads_in: Dict[Tuple[int, int], Any] = {}
+        stage_grads: List[Any] = [None] * self.n_stages
+        losses = []
+        last = self.n_stages - 1
+        for op in global_order(self.n_stages, n_microbatches):
+            s, m = op.stage, op.microbatch
+            if op.kind == "fwd":
+                if s == 0:
+                    x = jax.device_put(inputs[m * mb:(m + 1) * mb],
+                                       self.devices[0])
+                else:
+                    x = jax.device_put(fwd_out.pop((s - 1, m)),
+                                       self.devices[s])
+                saved_in[(s, m)] = x
+                if s != last:
+                    fwd_out[(s, m)] = self._fwd[s](self.stage_params[s], x)
+            else:
+                if s == last:
+                    x = saved_in.pop((s, m))
+                    t = jax.device_put(targets[m * mb:(m + 1) * mb],
+                                       self.devices[s])
+                    loss, (dp, dx) = self._bwd[s](self.stage_params[s], x, t)
+                    losses.append(loss)
+                else:
+                    x = saved_in.pop((s, m))
+                    g = jax.device_put(grads_in.pop((s, m)), self.devices[s])
+                    dp, dx = self._bwd[s](self.stage_params[s], x, g)
+                if s > 0:
+                    grads_in[(s - 1, m)] = dx
+                stage_grads[s] = dp if stage_grads[s] is None else jax.tree.map(
+                    jnp.add, stage_grads[s], dp)
+        # Optimizer step per stage (grads averaged over microbatches).
+        scale = 1.0 / n_microbatches
+        for s in range(self.n_stages):
+            g = jax.tree.map(lambda v: v * scale, stage_grads[s])
+            self.stage_params[s], self.opt_states[s] = self._apply(
+                self.stage_params[s], self.opt_states[s], g)
+        return {"loss": float(sum(float(l) for l in losses) / len(losses))}
+
+    def merged_params(self) -> Dict:
+        return merge_params([jax.device_get(st) for st in self.stage_params])
+
+
+# ---------------------------------------------------------- actor pipeline
+
+class PipelineStageActor:
+    """One pipeline stage hosted in an actor (multi-host PP). Activations
+    and gradients travel through the object plane — plasma-backed actor
+    calls, the same data path compiled-graph channels ride."""
+
+    def __init__(self, stage_idx: int, n_stages: int, config_bytes: bytes,
+                 stage_params_bytes: bytes, opt_name: str = "adamw",
+                 lr: float = 1e-3):
+        import cloudpickle
+        import optax
+
+        self.config = cloudpickle.loads(config_bytes)
+        self.s = stage_idx
+        self.n = n_stages
+        self.params = cloudpickle.loads(stage_params_bytes)
+        self.optimizer = (optax.adamw(lr) if opt_name == "adamw"
+                          else optax.sgd(lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self._saved: Dict[int, Any] = {}
+        self._grads = None
+        is_first, is_last = self.s == 0, self.s == self.n - 1
+        if is_last:
+            def loss_f(p, x, t, _first=is_first):
+                return last_stage_loss(p, x, t, self.config, is_first=_first)
+
+            self._bwd = jax.jit(jax.value_and_grad(loss_f, argnums=(0, 1)))
+            self._fwd = None
+        else:
+            f = partial(stage_apply, config=self.config, is_first=is_first,
+                        is_last=False)
+            self._fwd = jax.jit(f)
+
+            def bwd_f(p, x, g, _f=f):
+                out, vjp = jax.vjp(lambda pp, xx: _f(pp, xx), p, x)
+                return vjp(g)
+
+            self._bwd = jax.jit(bwd_f)
+
+    def forward(self, mb: int, x):
+        self._saved[mb] = x
+        if self._fwd is None:
+            return True  # last stage: loss + grads computed in backward_last
+        return jax.device_get(self._fwd(self.params, x))
+
+    def backward_last(self, mb: int, targets):
+        x = self._saved.pop(mb)
+        loss, (dp, dx) = self._bwd(self.params, x, targets)
+        self._accumulate(dp)
+        return float(loss), jax.device_get(dx)
+
+    def backward(self, mb: int, grad_out):
+        x = self._saved.pop(mb)
+        dp, dx = self._bwd(self.params, x, grad_out)
+        self._accumulate(dp)
+        return jax.device_get(dx)
+
+    def _accumulate(self, dp):
+        self._grads = dp if self._grads is None else jax.tree.map(
+            jnp.add, self._grads, dp)
+
+    def apply_updates(self, n_microbatches: int) -> bool:
+        import optax
+
+        g = jax.tree.map(lambda v: v / n_microbatches, self._grads)
+        updates, self.opt_state = self.optimizer.update(
+            g, self.opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        self._grads = None
+        return True
+
+    def get_params_bytes(self) -> bytes:
+        import cloudpickle
+
+        return cloudpickle.dumps(jax.device_get(self.params))
+
+
+class ActorPipeline:
+    """Driver-side coordinator for actor-hosted stages: executes the 1F1B
+    dependency order with pipelined actor calls (stages run concurrently
+    thanks to the pipelined actor transport)."""
+
+    def __init__(self, config, params, n_stages: int, *, lr: float = 1e-3,
+                 resources_per_stage: Optional[dict] = None):
+        import cloudpickle
+
+        import ray_tpu
+
+        self.config = config
+        self.n_stages = n_stages
+        stages = split_params(params, n_stages)
+        Stage = ray_tpu.remote(PipelineStageActor)
+        opts = resources_per_stage or {"num_cpus": 0}
+        cfg_b = cloudpickle.dumps(config)
+        self.actors = [
+            Stage.options(**opts).remote(
+                s, n_stages, cfg_b, cloudpickle.dumps(st), "adamw", lr)
+            for s, st in enumerate(stages)]
+
+    def train_step(self, tokens, n_microbatches: int) -> Dict[str, float]:
+        import numpy as np
+
+        import ray_tpu
+
+        B = tokens.shape[0]
+        assert B % n_microbatches == 0
+        mb = B // n_microbatches
+        inputs = np.asarray(tokens[:, :-1])
+        targets = np.asarray(tokens[:, 1:])
+        fwd_ref: Dict[Tuple[int, int], Any] = {}
+        bwd_ref: Dict[Tuple[int, int], Any] = {}
+        loss_refs = []
+        last = self.n_stages - 1
+        for op in global_order(self.n_stages, n_microbatches):
+            s, m = op.stage, op.microbatch
+            a = self.actors[s]
+            if op.kind == "fwd":
+                x = (inputs[m * mb:(m + 1) * mb] if s == 0
+                     else fwd_ref[(s - 1, m)])
+                fwd_ref[(s, m)] = a.forward.remote(m, x)
+            else:
+                if s == last:
+                    loss_ref, dx = a.backward_last.options(
+                        num_returns=2).remote(m, targets[m * mb:(m + 1) * mb])
+                    loss_refs.append(loss_ref)
+                    if s > 0:
+                        bwd_ref[(s - 1, m)] = dx
+                else:
+                    dx = a.backward.remote(m, bwd_ref.pop((s, m)))
+                    if s > 0:
+                        bwd_ref[(s - 1, m)] = dx
+        ray_tpu.get([a.apply_updates.remote(n_microbatches)
+                     for a in self.actors], timeout=600)
+        losses = ray_tpu.get(loss_refs, timeout=600)
+        return {"loss": float(sum(losses) / len(losses))}
+
+    def merged_params(self) -> Dict:
+        import cloudpickle
+
+        import ray_tpu
+
+        blobs = ray_tpu.get([a.get_params_bytes.remote()
+                             for a in self.actors], timeout=600)
+        return merge_params([cloudpickle.loads(b) for b in blobs])

@@ -138,6 +138,21 @@ class PlacementGroupManager:
                 if ok:
                     return [(i, nid) for i in idxs]
             if rec.strategy == STRICT_PACK:
+                # Multi-host slice path: a bundle-per-host TPU group must
+                # land on a CONTIGUOUS worker-id run of ONE slice — never
+                # fragmented across slices (that would put DCN hops inside
+                # the job's ICI mesh). See runtime/tpu_topology.py.
+                if all(b.get("TPU", 0) > 0 for b in rec.bundles):
+                    from ray_tpu.runtime import tpu_topology
+
+                    node_views = [{"node_id": nid, "labels": labels[nid]}
+                                  for nid in snapshot]
+                    plan = tpu_topology.find_contiguous_hosts(
+                        node_views, len(rec.bundles),
+                        fits=lambda i, nid: scheduling.fits(
+                            snapshot[nid], rec.bundles[i]))
+                    if plan is not None:
+                        return plan
                 return None
             # PACK falls back to spreading while preferring fewer nodes.
         if rec.strategy == STRICT_SPREAD:

@@ -432,6 +432,43 @@ class GcsServer:
             return {"found": False}
         return {"found": True, **rec.view()}
 
+    async def handle_report_task_events(self, conn, events):
+        """Batched task state transitions from workers/drivers
+        (GcsTaskManager analog; task_event_buffer.h:224 export path)."""
+        from collections import deque
+
+        from ray_tpu.config import cfg
+
+        store = getattr(self, "_task_events", None)
+        if store is None:
+            store = self._task_events = deque(maxlen=cfg().task_events_max)
+            self._task_latest = {}
+        for ev in events:
+            store.append(ev)
+            cur = self._task_latest.get(ev["task_id"])
+            if cur is None or ev["time"] >= cur["time"]:
+                self._task_latest[ev["task_id"]] = ev
+            # Bound the per-task index alongside the event deque.
+            if len(self._task_latest) > store.maxlen:
+                alive = {e["task_id"] for e in store}
+                self._task_latest = {k: v for k, v in
+                                     self._task_latest.items() if k in alive}
+        return {"ok": True}
+
+    async def handle_list_tasks(self, conn, state=None, name=None,
+                                limit: int = 1000):
+        latest = getattr(self, "_task_latest", {})
+        out = []
+        for ev in sorted(latest.values(), key=lambda e: -e["time"]):
+            if state is not None and ev["state"] != state:
+                continue
+            if name is not None and name not in ev["name"]:
+                continue
+            out.append(ev)
+            if len(out) >= limit:
+                break
+        return out
+
     async def handle_list_actors(self, conn):
         return [r.view() for r in self._actors.values()]
 
@@ -451,9 +488,14 @@ class GcsServer:
 
     async def handle_report_worker_death(self, conn, node_id, worker_id, actor_id=None,
                                          reason=""):
-        """Raylet tells us a worker process exited (node_manager death path)."""
+        """Raylet tells us a worker process exited (node_manager death path).
+        Republished on the 'worker_death' channel so object owners can prune
+        dead borrowers (reference_count.h borrower-failure handling)."""
         if actor_id is not None:
             await self._handle_actor_failure(actor_id, reason or "worker died")
+        await self.publish("worker_death", {
+            "worker_id": worker_id.hex() if isinstance(worker_id, bytes)
+            else worker_id, "reason": reason})
         return {"ok": True}
 
     async def _handle_actor_failure(self, actor_id: bytes, reason: str):

@@ -150,7 +150,8 @@ class Raylet:
                 self._cluster_view = await self.gcs.call("get_nodes")
             except Exception:
                 pass
-            await asyncio.sleep(2.0)
+            from ray_tpu.config import cfg
+            await asyncio.sleep(cfg().heartbeat_interval_s)
 
     async def _memory_monitor_loop(self):
         """Kill one leased worker per tick while the node is over the memory
@@ -493,27 +494,155 @@ class Raylet:
 
     # ---- introspection ----------------------------------------------------
 
+    @property
+    def _pull_sem(self):
+        """Admission control for serving cross-node reads: bound concurrent
+        chunk reads so a broadcast storm cannot starve the raylet's loop
+        (PullManager admission analog, pull_manager.h:51)."""
+        sem = getattr(self, "_pull_sem_obj", None)
+        if sem is None:
+            from ray_tpu.config import cfg
+
+            sem = self._pull_sem_obj = asyncio.Semaphore(
+                cfg().pull_admission_concurrency)
+        return sem
+
     async def handle_pull_object(self, conn, oid: bytes, offset: int = 0,
                                  length: int = 4 << 20):
         """Chunked cross-node object read: shm store first, spill dir second
         (ObjectManager::HandlePull analog, object_manager.proto:60-61; push is
         pull-driven here — the requester re-calls until it has total bytes)."""
+        async with self._pull_sem:
+            try:
+                buf = self.store.get(oid, timeout=0)
+            except Exception:
+                rec = self.spill.read_chunk(oid, offset, length)
+                if rec is None:
+                    return {"found": False}
+                total, metadata, chunk = rec
+                return {"found": True, "total": total, "metadata": metadata,
+                        "chunk": chunk}
+            try:
+                data = buf.data
+                return {"found": True, "total": len(data),
+                        "metadata": bytes(buf.metadata),
+                        "chunk": bytes(data[offset:offset + length])}
+            finally:
+                buf.release()
+
+    async def handle_fetch_and_relay(self, conn, oid: bytes,
+                                     source: Tuple[str, int],
+                                     targets: List[Tuple[str, int]],
+                                     fanout: int = 2):
+        """Broadcast leg: pull `oid` from `source` into the local store, then
+        fan the remaining `targets` out as subtrees relaying from THIS node —
+        O(log n) depth, no single-source bottleneck (PushManager/broadcast
+        analog, push_manager.h:30; the 1 GiB x 50-node envelope case)."""
+        from ray_tpu.config import cfg
+
+        if not self.store.contains(oid):
+            client = RpcClient(*tuple(source))
+            try:
+                await client.connect(timeout=15)
+                chunks, off, total, metadata = [], 0, None, b""
+                while True:
+                    r = await client.call("pull_object", oid=oid, offset=off,
+                                          length=cfg().pull_chunk_bytes)
+                    if not r.get("found"):
+                        return {"ok": False,
+                                "error": "source lost the object"}
+                    total = r["total"]
+                    metadata = r.get("metadata", b"")
+                    chunks.append(r["chunk"])
+                    off += len(r["chunk"])
+                    if off >= total:
+                        break
+                    if not r["chunk"]:
+                        return {"ok": False, "error": "truncated pull"}
+                try:
+                    view = self.store.create(oid, total, metadata)
+                    pos = 0
+                    for c in chunks:
+                        view[pos:pos + len(c)] = c
+                        pos += len(c)
+                    view.release()
+                    self.store.seal(oid)
+                except ValueError:
+                    pass  # concurrent create: someone else sealed it
+            finally:
+                await client.close()
+        if not targets:
+            return {"ok": True, "relayed": 0}
+        # Split targets into `fanout` subtrees, each led by its first node.
+        groups = [targets[i::fanout] for i in range(fanout)]
+        subcalls = []
+        for g in groups:
+            if not g:
+                continue
+            leader, rest = tuple(g[0]), [tuple(t) for t in g[1:]]
+            subcalls.append(self._relay_to(oid, leader, rest, fanout))
+        results = await asyncio.gather(*subcalls, return_exceptions=True)
+        failed = [r for r in results
+                  if isinstance(r, Exception) or not r.get("ok")]
+        if failed:
+            return {"ok": False, "error": f"{len(failed)} subtree(s) failed"}
+        return {"ok": True, "relayed": len(targets)}
+
+    async def _relay_to(self, oid, leader, rest, fanout):
+        client = RpcClient(*leader)
         try:
-            buf = self.store.get(oid, timeout=0)
-        except Exception:
-            rec = self.spill.read_chunk(oid, offset, length)
-            if rec is None:
-                return {"found": False}
-            total, metadata, chunk = rec
-            return {"found": True, "total": total, "metadata": metadata,
-                    "chunk": chunk}
-        try:
-            data = buf.data
-            return {"found": True, "total": len(data),
-                    "metadata": bytes(buf.metadata),
-                    "chunk": bytes(data[offset:offset + length])}
+            await client.connect(timeout=15)
+            return await client.call(
+                "fetch_and_relay", oid=oid, source=self.server.address,
+                targets=rest, fanout=fanout, timeout=600)
         finally:
-            buf.release()
+            await client.close()
+
+    async def handle_put_object(self, conn, oid: bytes, chunk: bytes,
+                                offset: int, total: int,
+                                metadata: bytes = b"", seal: bool = False):
+        """Remote-client write path: a store-less driver (Ray Client analog,
+        util/client/) materializes put() objects into this node's store over
+        chunked RPC; the final chunk seals."""
+        if self.store.contains(oid):
+            return {"ok": True, "existed": True}
+        try:
+            if offset == 0:
+                self.store.abort(oid)  # reclaim a crashed partial create
+                view = self.store.create(oid, total, metadata)
+                self._client_puts = getattr(self, "_client_puts", {})
+                self._client_puts[oid] = view
+            view = self._client_puts[oid]
+            view[offset:offset + len(chunk)] = chunk
+            if seal:
+                view.release()
+                self.store.seal(oid)
+                del self._client_puts[oid]
+            return {"ok": True}
+        except Exception as e:
+            v = getattr(self, "_client_puts", {}).pop(oid, None)
+            if v is not None:
+                try:
+                    v.release()
+                except Exception:
+                    pass
+                self.store.abort(oid)
+            return {"ok": False, "error": repr(e)}
+
+    async def handle_free_object(self, conn, oid: bytes):
+        """Owner-directed delete of a local copy (delete-on-zero leg of the
+        ownership protocol; reference: plasma Delete + spilled-file cleanup
+        in local_object_manager)."""
+        try:
+            self.store.delete(oid)
+        except Exception:
+            pass
+        try:
+            if self.spill is not None:
+                self.spill.delete(oid)
+        except Exception:
+            pass
+        return {"ok": True}
 
     async def handle_node_stats(self, conn):
         return {

@@ -30,7 +30,8 @@ import cloudpickle
 from ray_tpu.core import serialization
 from ray_tpu.core.exceptions import TaskError
 from ray_tpu.core.task_spec import ActorSpec, TaskSpec
-from ray_tpu.core.worker import CoreWorker, INLINE_RESULT_MAX, set_global_worker
+from ray_tpu.config import cfg
+from ray_tpu.core.worker import CoreWorker, set_global_worker
 from ray_tpu.runtime.rpc import RpcClient, RpcServer
 from ray_tpu.utils.ids import ObjectID, TaskID
 
@@ -141,14 +142,42 @@ class WorkerRuntime:
                 f"task declared num_returns={spec.num_returns} but returned "
                 f"{len(values)} values")
         for i, value in enumerate(values):
-            segments, total = serialization.serialize(value)
+            segments, total, contained = serialization.serialize_with_refs(
+                value)
             oid = ObjectID.for_task_return(TaskID(spec.task_id), i).binary()
-            if total <= INLINE_RESULT_MAX:
-                returns.append(("v", serialization.join_segments(segments)))
+            # Nested refs in the return value: pin them with their owners
+            # NOW (while this worker still holds borrows), keyed by the
+            # return oid; the caller records the children and unpins when it
+            # frees the return (reference_count.h nested-ref invariant).
+            children = self._pin_return_children(oid, contained)
+            if total <= cfg().inline_result_max:
+                returns.append(("v", serialization.join_segments(segments),
+                                children))
             else:
                 self._seal_return(oid, segments, total)
-                returns.append(("r", oid))
+                returns.append(("r", oid, children))
         return returns
+
+    def _pin_return_children(self, container_oid: bytes, contained) -> list:
+        children = []
+        for ref in contained:
+            child = ref.binary()
+            addr = ref.owner_addr
+            children.append((child, addr))
+            if addr is None or tuple(addr) == tuple(self.core.owner_addr):
+                with self.core._mem_lock:
+                    rec = self.core._owned.get(child)
+                    if rec is not None:
+                        rec["containers"].add(container_oid)
+            else:
+                # Synchronous on the io loop caller context: we are on the
+                # exec thread, so round-trip through the loop and WAIT — the
+                # pin must land before the reply releases our borrows.
+                asyncio.run_coroutine_threadsafe(
+                    self.core._owner_call(tuple(addr), "pin_container",
+                                          oid=child, container=container_oid),
+                    self.core.io.loop).result(timeout=30)
+        return children
 
     def _push_gen_item(self, conn, spec: TaskSpec, index: int, value) -> None:
         """Report one yielded item to the submitter (blocking, from the exec
@@ -158,7 +187,7 @@ class WorkerRuntime:
         segments, total = serialization.serialize(value)
         msg = {"task_id": spec.task_id, "index": index,
                "node_id": self.node_id}
-        if total <= INLINE_RESULT_MAX or self.core.store is None:
+        if total <= cfg().inline_result_max or self.core.store is None:
             msg["payload"] = serialization.join_segments(segments)
         else:
             oid = ObjectID.for_task_return(TaskID(spec.task_id), index).binary()
@@ -305,13 +334,26 @@ class WorkerRuntime:
         finally:
             self._tasks_pending -= 1
 
+    async def _drain_borrows(self):
+        """Borrow RPCs spawned while deserializing args/results must land
+        before the reply releases the submitter's pins (use-after-free
+        window otherwise — see CoreWorker.register_ref)."""
+        futs = self.core.take_pending_borrows()
+        if futs:
+            await asyncio.gather(
+                *[asyncio.wrap_future(f) for f in futs],
+                return_exceptions=True)
+
     async def handle_push_task(self, conn, spec: TaskSpec):
         fn = self._load_function(spec.fn_id)
         loop = asyncio.get_event_loop()
         if self._is_async_callable(fn):
-            return await self._tracked(self._execute_async(fn, spec, conn))
-        return await self._tracked(
-            loop.run_in_executor(self.exec_pool, self._execute, fn, spec, conn))
+            reply = await self._tracked(self._execute_async(fn, spec, conn))
+        else:
+            reply = await self._tracked(loop.run_in_executor(
+                self.exec_pool, self._execute, fn, spec, conn))
+        await self._drain_borrows()
+        return reply
 
     # ---- actor lifecycle --------------------------------------------------
 
@@ -373,9 +415,12 @@ class WorkerRuntime:
                         f"actor has no method {spec.method_name!r}")}
         loop = asyncio.get_event_loop()
         if self._is_async_callable(method):
-            return await self._tracked(self._execute_async(method, spec, conn))
-        return await self._tracked(loop.run_in_executor(
-            self.exec_pool, self._execute, method, spec, conn))
+            reply = await self._tracked(self._execute_async(method, spec, conn))
+        else:
+            reply = await self._tracked(loop.run_in_executor(
+                self.exec_pool, self._execute, method, spec, conn))
+        await self._drain_borrows()
+        return reply
 
     async def handle_actor_stats(self, conn):
         """Execution-queue stats, served directly on the IO loop so callers

@@ -71,8 +71,10 @@ class ServeController:
         import logging
 
         log = logging.getLogger(__name__)
+        from ray_tpu.config import cfg
+
         while True:
-            time.sleep(1.0)
+            time.sleep(cfg().serve_autoscale_interval_s)
             try:
                 self._autoscale_once()
             except Exception:

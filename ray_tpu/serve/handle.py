@@ -33,8 +33,49 @@ class DeploymentResponse:
                 self._on_done()
 
 
+class _StreamingResponse:
+    """Iterator over a streaming call's item refs; keeps the handle's
+    power-of-two load accounting honest for long-lived streams."""
+
+    def __init__(self, gen, on_done):
+        self._gen = gen
+        self._on_done = on_done
+        self._done = False
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._on_done()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._gen)
+        except BaseException:
+            self._finish()
+            raise
+
+    def next(self, timeout=None):
+        try:
+            return self._gen.next(timeout)
+        except TimeoutError:
+            raise  # transient poll timeout: the stream is still live
+        except BaseException:
+            self._finish()
+            raise
+
+    def __del__(self):
+        self._finish()
+
+
 class DeploymentHandle:
-    REFRESH_INTERVAL_S = 1.0
+    @property
+    def REFRESH_INTERVAL_S(self):
+        from ray_tpu.config import cfg
+
+        return cfg().serve_handle_refresh_s
 
     def __init__(self, deployment_name: str, method_name: str = "__call__"):
         self.deployment_name = deployment_name
@@ -79,6 +120,28 @@ class DeploymentHandle:
             return 0
         a, b = random.sample(range(n), 2)
         return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+
+    def remote_stream(self, *args, **kwargs):
+        """Streaming call: the replica method must return a generator; items
+        stream back as they are yielded (ObjectRefGenerator of item refs).
+        Reference analog: serve streaming responses over
+        ReportGeneratorItemReturns (core_worker.proto:462)."""
+        if (not self._replicas
+                or time.monotonic() - self._last_refresh > self.REFRESH_INTERVAL_S):
+            try:
+                self._refresh()
+            except Exception:
+                if not self._replicas:
+                    raise
+        idx = self._pick_replica()
+        replica = self._replicas[idx]
+        self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        gen = replica.handle_request.options(
+            num_returns="streaming").remote(self.method_name, args, kwargs)
+        return _StreamingResponse(gen, lambda i=idx: self._on_stream_done(i))
+
+    def _on_stream_done(self, idx: int):
+        self._inflight[idx] = max(0, self._inflight.get(idx, 0) - 1)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         # Periodic re-poll so autoscaled replicas join the routing set

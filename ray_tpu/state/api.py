@@ -64,6 +64,33 @@ def list_jobs() -> List[dict]:
     return _gcs_call("get_jobs")
 
 
+def list_tasks(state: Optional[str] = None, name: Optional[str] = None,
+               limit: int = 1000) -> List[dict]:
+    """Latest state per task (SUBMITTED/FINISHED/FAILED), newest first.
+    Filters: exact `state`, substring `name`. Reference analog:
+    `ray list tasks` over GcsTaskManager (python/ray/util/state/)."""
+    return _gcs_call("list_tasks", state=state, name=name, limit=limit)
+
+
+def list_objects(limit: int = 1000) -> List[dict]:
+    """Owned objects of THIS process: id, borrower/container counts,
+    locations (reference: `ray list objects` scoped cluster-wide; ours is
+    owner-scoped — each owner knows its own objects' truth)."""
+    core = worker_mod.global_worker()
+    out = []
+    with core._mem_lock:
+        for oid, rec in list(core._owned.items())[:limit]:
+            out.append({
+                "object_id": oid.hex(),
+                "local_refs": core._local_refs.get(oid, 0),
+                "borrowers": len(rec["borrowers"]),
+                "containers": len(rec["containers"]),
+                "locations": [loc.hex() for loc in rec["locations"]],
+                "pinned": core._arg_pins.get(oid, 0),
+            })
+    return out
+
+
 def node_stats() -> List[dict]:
     """Live per-raylet stats (workers, leases, object store usage)."""
     import asyncio

@@ -21,15 +21,24 @@ from ray_tpu.train.result import Result
 logger = logging.getLogger(__name__)
 
 
+_RESIZE = "__elastic_resize__"
+
+
 class TrainController:
     def __init__(self, train_fn: Callable, *, train_loop_config: Optional[Dict],
                  scaling_config: ScalingConfig, run_config: RunConfig,
-                 backend: Any = "none"):
+                 backend: Any = "none", scaling_policy=None,
+                 failure_policy=None):
+        from ray_tpu.train.elastic import FailurePolicy, FixedScalingPolicy
+
         self.train_fn = train_fn
         self.train_loop_config = train_loop_config or {}
         self.scaling = scaling_config
         self.run_config = run_config
         self.backend = backend
+        self.scaling_policy = scaling_policy or FixedScalingPolicy()
+        self.failure_policy = failure_policy or FailurePolicy(
+            run_config.failure_config.max_failures)
         self.run_name = run_config.name or f"train-{uuid.uuid4().hex[:8]}"
         self.storage_path = run_config.resolved_storage_path()
         ckpt_cfg = run_config.checkpoint_config
@@ -39,14 +48,30 @@ class TrainController:
         self.latest_metrics: Dict = {}
         self.metrics_history: List[Dict] = []
 
-    def run(self, poll_interval: float = 0.2) -> Result:
+    @staticmethod
+    def _available_resources() -> Dict[str, float]:
+        import ray_tpu
+
+        try:
+            return ray_tpu.available_resources()
+        except Exception:
+            return {}
+
+    def run(self, poll_interval: Optional[float] = None) -> Result:
+        import dataclasses as _dc
+
+        from ray_tpu.config import cfg
+        from ray_tpu.train.elastic import FailureDecision
         from ray_tpu.train.worker_group import WorkerGroup
 
-        failures_left = self.run_config.failure_config.max_failures
+        poll_interval = poll_interval or cfg().train_poll_interval_s
         attempt = 0
+        world = self.scaling_policy.initial_workers(
+            self.scaling, self._available_resources())
         while True:
             attempt += 1
-            group = WorkerGroup(self.scaling, f"{self.run_name}-a{attempt}",
+            scaling = _dc.replace(self.scaling, num_workers=world)
+            group = WorkerGroup(scaling, f"{self.run_name}-a{attempt}",
                                 self.storage_path)
             try:
                 group.start(self.backend, group_name=f"{self.run_name}-a{attempt}")
@@ -54,7 +79,7 @@ class TrainController:
                 group.start_training(
                     self.train_fn, self.train_loop_config,
                     latest.path if latest else None)
-                error = self._poll_until_done(group, poll_interval)
+                error = self._poll_until_done(group, poll_interval, world)
             except RayTpuError as e:
                 error = repr(e)
             finally:
@@ -64,11 +89,20 @@ class TrainController:
                               checkpoint=self.ckpt_manager.latest_checkpoint,
                               best_checkpoints=None, path=self.storage_path,
                               metrics_dataframe=self.metrics_history, error=None)
-            if failures_left > 0:
-                failures_left -= 1
-                logger.warning("train run %s failed (%s); restarting "
-                               "(%d retries left)", self.run_name, error,
-                               failures_left)
+            if error == _RESIZE:
+                # Controlled elastic restart: resume from the latest
+                # checkpoint at the new world size (ScalingPolicy analog).
+                world = self._pending_world
+                logger.info("train run %s resizing to %d workers",
+                            self.run_name, world)
+                continue
+            if self.failure_policy.decide(error) == FailureDecision.RETRY:
+                decision = self.scaling_policy.on_failure(
+                    self.scaling, world, self._available_resources())
+                if decision.kind == "resize" and decision.num_workers >= 1:
+                    world = decision.num_workers
+                logger.warning("train run %s failed (%s); restarting with "
+                               "%d workers", self.run_name, error, world)
                 continue
             return Result(metrics=self.latest_metrics,
                           checkpoint=self.ckpt_manager.latest_checkpoint,
@@ -76,9 +110,24 @@ class TrainController:
                           metrics_dataframe=self.metrics_history,
                           error=error)
 
-    def _poll_until_done(self, group, poll_interval: float) -> Optional[str]:
+    def _poll_until_done(self, group, poll_interval: float,
+                         world: int) -> Optional[str]:
+        from ray_tpu.config import cfg
+
+        last_elastic_check = time.monotonic()
         while True:
             polls = group.poll()
+            now = time.monotonic()
+            if (now - last_elastic_check
+                    >= cfg().train_elastic_check_interval_s):
+                last_elastic_check = now
+                decision = self.scaling_policy.periodic(
+                    self.scaling, world, self._available_resources())
+                if (decision.kind == "resize"
+                        and decision.num_workers != world
+                        and self.ckpt_manager.latest_checkpoint is not None):
+                    self._pending_world = decision.num_workers
+                    return _RESIZE
             # Collate per-rank reports into rounds; rank-0 metrics win (the
             # reference reports rank-0 results by default).
             for poll in polls:

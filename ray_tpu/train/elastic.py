@@ -1,0 +1,103 @@
+"""Elastic training policies: scaling + failure handling.
+
+Reference analog: python/ray/train/v2/_internal/execution/scaling_policy/
+and failure_handling/. The controller consults the ScalingPolicy for the
+world size before every worker-group (re)start and periodically during
+training; a resize is a controlled restart — workers checkpoint, the group
+is rebuilt at the new size, and training resumes from the latest checkpoint
+(resharding is the train_fn's responsibility via its backend/mesh, which it
+rebuilds from the restored state at the new world size).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from ray_tpu.train.config import ScalingConfig
+
+
+@dataclasses.dataclass
+class ScalingDecision:
+    kind: str              # "noop" | "resize"
+    num_workers: int = 0
+
+
+class ScalingPolicy:
+    """Decides the worker-group world size from cluster state."""
+
+    def initial_workers(self, scaling: ScalingConfig,
+                        available: Dict[str, float]) -> int:
+        return scaling.num_workers
+
+    def on_failure(self, scaling: ScalingConfig, current: int,
+                   available: Dict[str, float]) -> ScalingDecision:
+        """Called before a failure restart: may shrink the group to what the
+        (possibly degraded) cluster can still place."""
+        return ScalingDecision("resize", current)
+
+    def periodic(self, scaling: ScalingConfig, current: int,
+                 available: Dict[str, float]) -> ScalingDecision:
+        """Called every train_elastic_check_interval_s during training."""
+        return ScalingDecision("noop")
+
+
+class FixedScalingPolicy(ScalingPolicy):
+    """Always the configured size (the default, v1-compatible behavior)."""
+
+
+class ElasticScalingPolicy(ScalingPolicy):
+    """Scale the group within [min_workers, max_workers] to the resources
+    actually available: shrink instead of failing when nodes die, grow when
+    capacity returns (TPU deployments: slice granularity comes from
+    resources_per_worker requesting whole slices)."""
+
+    def __init__(self, min_workers: int, max_workers: int):
+        assert 1 <= min_workers <= max_workers
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+
+    def _fit(self, scaling: ScalingConfig,
+             available: Dict[str, float]) -> int:
+        per = scaling.worker_resources()
+        n = self.max_workers
+        for res, need in per.items():
+            if need > 0:
+                n = min(n, int(available.get(res, 0.0) // need))
+        return max(self.min_workers, min(self.max_workers, n))
+
+    def initial_workers(self, scaling, available) -> int:
+        return self._fit(scaling, available)
+
+    def on_failure(self, scaling, current, available) -> ScalingDecision:
+        return ScalingDecision("resize", self._fit(scaling, available))
+
+    def periodic(self, scaling, current, available) -> ScalingDecision:
+        fit = self._fit(scaling, available)
+        # Growing is worth a restart; shrinking below current only happens
+        # via failure (a healthy group keeps its reserved resources).
+        if fit > current:
+            return ScalingDecision("resize", fit)
+        return ScalingDecision("noop")
+
+
+class FailureDecision:
+    RETRY = "retry"
+    FAIL = "fail"
+
+
+class FailurePolicy:
+    """Decides what to do when the worker group fails.
+    Reference analog: v2 failure_handling/failure_policy.py."""
+
+    def __init__(self, max_failures: int = 0):
+        self.max_failures = max_failures
+        self.failures = 0
+
+    def decide(self, error: str) -> str:
+        self.failures += 1
+        if self.max_failures < 0:  # infinite retries
+            return FailureDecision.RETRY
+        if self.failures <= self.max_failures:
+            return FailureDecision.RETRY
+        return FailureDecision.FAIL

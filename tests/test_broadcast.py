@@ -1,0 +1,81 @@
+"""Object broadcast (relay tree) + pull admission control."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.broadcast import broadcast_object
+
+
+def test_broadcast_replicates_to_all_nodes():
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.runtime.object_store import ObjectStore
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=2)
+        n2 = cluster.add_node(num_cpus=1)
+        n3 = cluster.add_node(num_cpus=1)
+        n4 = cluster.add_node(num_cpus=1)
+        ray_tpu.init(address=cluster.address)
+
+        data = np.arange(1 << 20, dtype=np.uint8)  # 1 MiB
+        ref = ray_tpu.put(data)
+        covered = broadcast_object(ref)
+        assert covered == 3  # every node except the owner's
+
+        # Each node's shared store now holds a local copy (zero-copy reads).
+        for node in (n2, n3, n4):
+            store = ObjectStore(node.store_path, create=False)
+            try:
+                assert store.contains(ref.binary())
+            finally:
+                store.close()
+
+        # Tasks pinned to remote nodes read it locally and correctly.
+        @ray_tpu.remote
+        def readback(x):
+            return int(x[123]), int(x.sum() % 251)
+
+        vals = ray_tpu.get([readback.remote(ref) for _ in range(3)],
+                           timeout=120)
+        assert all(v == (123, int(data.sum() % 251)) for v in vals)
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+def test_broadcast_subset_and_idempotence():
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.runtime.object_store import ObjectStore
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=2)
+        n2 = cluster.add_node(num_cpus=1)
+        n3 = cluster.add_node(num_cpus=1)
+        ray_tpu.init(address=cluster.address)
+        ref = ray_tpu.put(np.ones(200_000, dtype=np.float32))
+        only = [n2.node_id if hasattr(n2, "node_id")
+                else n2.node_id]
+        assert broadcast_object(ref, node_ids=only) == 1
+        s2 = ObjectStore(n2.store_path, create=False)
+        s3 = ObjectStore(n3.store_path, create=False)
+        try:
+            assert s2.contains(ref.binary())
+            assert not s3.contains(ref.binary())
+        finally:
+            s2.close()
+            s3.close()
+        # Re-broadcast is a no-op data-wise (nodes already covered) but
+        # still succeeds.
+        assert broadcast_object(ref, node_ids=only) == 1
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()

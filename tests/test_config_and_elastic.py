@@ -1,0 +1,136 @@
+"""Central config table + elastic train scaling/failure policies.
+
+Reference analogs: src/ray/common/ray_config_def.h (env-overridable tunables)
+and python/ray/train/v2/_internal/execution/{scaling_policy,failure_handling}.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu  # noqa: F401
+
+
+def test_config_defaults_and_env_override(monkeypatch):
+    from ray_tpu import config as config_mod
+
+    config_mod.reset_for_testing()
+    assert config_mod.cfg().inline_result_max == 100 * 1024
+    monkeypatch.setenv("RAY_TPU_INLINE_RESULT_MAX", "4096")
+    monkeypatch.setenv("RAY_TPU_HEARTBEAT_INTERVAL_S", "0.5")
+    config_mod.reset_for_testing()
+    assert config_mod.cfg().inline_result_max == 4096
+    assert config_mod.cfg().heartbeat_interval_s == 0.5
+    config_mod.reset_for_testing()
+
+
+def test_config_system_overrides_and_unknown_key():
+    from ray_tpu import config as config_mod
+
+    config_mod.reset_for_testing()
+    config_mod.cfg().apply_overrides({"data_max_in_flight": 3})
+    assert config_mod.cfg().data_max_in_flight == 3
+    with pytest.raises(ValueError):
+        config_mod.cfg().apply_overrides({"no_such_knob": 1})
+    with pytest.raises(AttributeError):
+        config_mod.cfg().no_such_knob
+    config_mod.reset_for_testing()
+
+
+def test_elastic_policy_fits_resources():
+    from ray_tpu.train.config import ScalingConfig
+    from ray_tpu.train.elastic import ElasticScalingPolicy
+
+    pol = ElasticScalingPolicy(min_workers=1, max_workers=8)
+    sc = ScalingConfig(num_workers=8,
+                       resources_per_worker={"CPU": 2.0})
+    assert pol.initial_workers(sc, {"CPU": 16.0}) == 8
+    assert pol.initial_workers(sc, {"CPU": 5.0}) == 2
+    assert pol.initial_workers(sc, {"CPU": 0.0}) == 1  # min floor
+    # Failure with a degraded cluster shrinks; periodic growth restarts.
+    assert pol.on_failure(sc, 8, {"CPU": 6.0}).num_workers == 3
+    assert pol.periodic(sc, 2, {"CPU": 16.0}).kind == "resize"
+    assert pol.periodic(sc, 8, {"CPU": 16.0}).kind == "noop"
+
+
+def test_failure_policy_budget():
+    from ray_tpu.train.elastic import FailureDecision, FailurePolicy
+
+    pol = FailurePolicy(max_failures=2)
+    assert pol.decide("boom") == FailureDecision.RETRY
+    assert pol.decide("boom") == FailureDecision.RETRY
+    assert pol.decide("boom") == FailureDecision.FAIL
+    assert FailurePolicy(max_failures=-1).decide("x") == FailureDecision.RETRY
+
+
+def test_elastic_train_resumes_at_smaller_world(tmp_path):
+    """Worker dies permanently at world=2 -> ElasticScalingPolicy restarts
+    the run at world=1 from the latest checkpoint and finishes."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        from ray_tpu import train
+        from ray_tpu.train.config import (CheckpointConfig, FailureConfig,
+                                          RunConfig, ScalingConfig)
+        from ray_tpu.train.controller import TrainController
+        from ray_tpu.train.elastic import ElasticScalingPolicy, FailurePolicy
+
+        controller = TrainController(
+            _elastic_train_fn, train_loop_config={},
+            scaling_config=ScalingConfig(num_workers=2,
+                                         resources_per_worker={"CPU": 1.0}),
+            run_config=RunConfig(
+                name="elastic-test", storage_path=str(tmp_path),
+                checkpoint_config=CheckpointConfig(num_to_keep=2),
+                failure_config=FailureConfig(max_failures=2)),
+            scaling_policy=_ShrinkOnFailurePolicy(),
+            failure_policy=FailurePolicy(max_failures=2))
+        result = controller.run(poll_interval=0.1)
+        assert result.error is None, result.error
+        assert result.metrics["step"] == 5
+        assert result.metrics["world"] == 1  # finished at the reduced size
+    finally:
+        ray_tpu.shutdown()
+
+
+class _ShrinkOnFailurePolicy:
+    """Deterministic elastic policy for the test: halve on failure."""
+
+    def initial_workers(self, scaling, available):
+        return scaling.num_workers
+
+    def on_failure(self, scaling, current, available):
+        from ray_tpu.train.elastic import ScalingDecision
+
+        return ScalingDecision("resize", max(1, current // 2))
+
+    def periodic(self, scaling, current, available):
+        from ray_tpu.train.elastic import ScalingDecision
+
+        return ScalingDecision("noop")
+
+
+def _elastic_train_fn(config):
+    import json
+    import os as _os
+
+    from ray_tpu import train as t
+
+    ctx = t.get_context()
+    start = 0
+    ckpt = t.get_checkpoint()
+    if ckpt is not None:
+        with open(_os.path.join(ckpt.path, "state.json")) as f:
+            start = json.load(f)["step"] + 1
+    for step in range(start, 6):
+        if step == 3 and ctx.get_world_size() == 2:
+            raise RuntimeError("lost a worker")
+        metrics = {"step": step, "world": ctx.get_world_size()}
+        if ctx.get_world_rank() == 0:
+            import tempfile
+
+            d = tempfile.mkdtemp()
+            with open(_os.path.join(d, "state.json"), "w") as f:
+                json.dump({"step": step}, f)
+            t.report(metrics, checkpoint=t.Checkpoint(d))
+        else:
+            t.report(metrics)

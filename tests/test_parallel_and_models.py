@@ -83,7 +83,7 @@ def test_flash_attention_non_causal(jx):
 
 def test_ring_attention_matches_reference(jx):
     import jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.ops.attention import mha_reference
@@ -108,7 +108,7 @@ def test_ring_attention_matches_reference(jx):
 def test_ring_attention_differentiable(jx):
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.ops.attention import mha_reference
@@ -133,7 +133,7 @@ def test_ring_attention_differentiable(jx):
 
 def test_ulysses_matches_reference(jx):
     import jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.ops.attention import mha_reference

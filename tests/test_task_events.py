@@ -1,0 +1,79 @@
+"""Task-state observability: task events -> GCS -> state API.
+
+Reference analog: core_worker/task_event_buffer.h:224 -> GcsTaskManager ->
+`ray list tasks` (python/ray/util/state/).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.state import api as state
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def _wait_tasks(pred, timeout=15):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        tasks = state.list_tasks()
+        if pred(tasks):
+            return tasks
+        time.sleep(0.3)
+    raise AssertionError(f"task events never satisfied: {state.list_tasks()}")
+
+
+def test_task_events_lifecycle(cluster):
+    @ray_tpu.remote
+    def fine(x):
+        return x
+
+    @ray_tpu.remote(max_retries=0)
+    def broken():
+        raise ValueError("nope")
+
+    assert ray_tpu.get(fine.remote(1), timeout=60) == 1
+    with pytest.raises(Exception):
+        ray_tpu.get(broken.remote(), timeout=60)
+
+    tasks = _wait_tasks(lambda ts: any(
+        t["name"].endswith("fine") and t["state"] == "FINISHED" for t in ts)
+        and any(t["name"].endswith("broken") and t["state"] == "FAILED"
+                for t in ts))
+    failed = next(t for t in tasks if t["state"] == "FAILED")
+    assert "nope" in (failed["error"] or "")
+    # Filters.
+    assert all(t["state"] == "FINISHED"
+               for t in state.list_tasks(state="FINISHED"))
+    assert all("fine" in t["name"] for t in state.list_tasks(name="fine"))
+
+
+def test_actor_task_events(cluster):
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 7
+
+    a = A.remote()
+    assert ray_tpu.get(a.m.remote(), timeout=60) == 7
+    tasks = _wait_tasks(lambda ts: any(
+        t["name"] == "A.m" and t["state"] == "FINISHED" and t["actor_id"]
+        for t in ts))
+    ev = next(t for t in tasks if t["name"] == "A.m")
+    assert ev["actor_id"] is not None
+
+
+def test_list_objects_owner_view(cluster):
+    import numpy as np
+
+    ref = ray_tpu.put(np.zeros(200_000, dtype=np.uint8))
+    objs = state.list_objects()
+    mine = [o for o in objs if o["object_id"] == ref.binary().hex()]
+    assert mine and mine[0]["local_refs"] >= 1
+    del ref
