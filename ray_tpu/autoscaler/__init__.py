@@ -1,0 +1,15 @@
+from ray_tpu.autoscaler.autoscaler import (  # noqa: F401
+    Autoscaler,
+    FakeMultiNodeProvider,
+    Instance,
+    InstanceType,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.instance_storage import InstanceStorage  # noqa: F401
+from ray_tpu.autoscaler.monitor import AutoscalerMonitor  # noqa: F401
+from ray_tpu.autoscaler.providers import (  # noqa: F401
+    CommandRunner,
+    GCETpuProvider,
+    LocalNodeProvider,
+    get_provider,
+)

@@ -1,0 +1,84 @@
+"""Persistent instance table + event log for the autoscaler.
+
+Reference analog: python/ray/autoscaler/v2/instance_manager/ —
+InstanceStorage (versioned instance table the Reconciler reads/writes) and
+the instance event stream. Ours is sqlite (same engine the GCS store uses),
+so an autoscaler that restarts re-attaches to its launched instances
+instead of leaking or double-launching them.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class InstanceStorage:
+    def __init__(self, path: str = ":memory:"):
+        self._db = sqlite3.connect(path)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS instances ("
+            " instance_id TEXT PRIMARY KEY,"
+            " instance_type TEXT, status TEXT, node_id BLOB,"
+            " launched_at REAL, slice_id TEXT, version INTEGER)")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS events ("
+            " seq INTEGER PRIMARY KEY AUTOINCREMENT,"
+            " ts REAL, instance_id TEXT, event TEXT, detail TEXT)")
+        self._db.commit()
+
+    # -- instance table ----------------------------------------------------
+
+    def upsert(self, inst) -> None:
+        """inst: autoscaler.Instance."""
+        self._db.execute(
+            "INSERT INTO instances VALUES (?,?,?,?,?,?,"
+            " COALESCE((SELECT version+1 FROM instances WHERE instance_id=?),"
+            " 1)) ON CONFLICT(instance_id) DO UPDATE SET"
+            " instance_type=excluded.instance_type, status=excluded.status,"
+            " node_id=excluded.node_id, launched_at=excluded.launched_at,"
+            " slice_id=excluded.slice_id, version=version+1",
+            (inst.instance_id, inst.instance_type, inst.status, inst.node_id,
+             inst.launched_at, inst.slice_id, inst.instance_id))
+        self._db.commit()
+
+    def delete(self, instance_id: str) -> None:
+        self._db.execute("DELETE FROM instances WHERE instance_id=?",
+                         (instance_id,))
+        self._db.commit()
+
+    def load(self) -> List:
+        from ray_tpu.autoscaler.autoscaler import Instance
+
+        rows = self._db.execute(
+            "SELECT instance_id, instance_type, status, node_id, launched_at,"
+            " slice_id FROM instances").fetchall()
+        return [Instance(r[0], r[1], r[2], r[3], r[4], r[5]) for r in rows]
+
+    # -- event log ---------------------------------------------------------
+
+    def log_event(self, instance_id: str, event: str,
+                  detail: Optional[dict] = None) -> None:
+        self._db.execute(
+            "INSERT INTO events (ts, instance_id, event, detail)"
+            " VALUES (?,?,?,?)",
+            (time.time(), instance_id, event,
+             json.dumps(detail or {}, default=repr)))
+        self._db.commit()
+
+    def events(self, instance_id: Optional[str] = None,
+               limit: int = 100) -> List[Tuple]:
+        if instance_id is None:
+            q = ("SELECT ts, instance_id, event, detail FROM events"
+                 " ORDER BY seq DESC LIMIT ?")
+            rows = self._db.execute(q, (limit,)).fetchall()
+        else:
+            q = ("SELECT ts, instance_id, event, detail FROM events"
+                 " WHERE instance_id=? ORDER BY seq DESC LIMIT ?")
+            rows = self._db.execute(q, (instance_id, limit)).fetchall()
+        return [(r[0], r[1], r[2], json.loads(r[3])) for r in rows]
+
+    def close(self):
+        self._db.close()

@@ -1,0 +1,70 @@
+"""Autoscaler monitor: the reconcile loop daemon.
+
+Reference analog: python/ray/autoscaler/_private/monitor.py (the process on
+the head node that drives StandardAutoscaler.update() on an interval) / the
+v2 autoscaler loop. Runs as a thread next to the driver or inside a
+dedicated actor; persists instance state through InstanceStorage so a
+restarted monitor re-attaches instead of double-launching.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class AutoscalerMonitor:
+    def __init__(self, autoscaler, *, interval_s: float = 5.0,
+                 storage=None):
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self.storage = storage
+        if storage is not None:
+            # Re-attach: adopt instances a previous monitor launched.
+            for inst in storage.load():
+                self.autoscaler.instances.setdefault(inst.instance_id, inst)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.rounds = 0
+        self.last_result: dict = {}
+
+    def _persist(self):
+        if self.storage is None:
+            return
+        stored = {i.instance_id for i in self.storage.load()}
+        live = set(self.autoscaler.instances)
+        for iid in stored - live:
+            self.storage.log_event(iid, "terminated")
+            self.storage.delete(iid)
+        for iid in live:
+            self.storage.upsert(self.autoscaler.instances[iid])
+
+    def step(self) -> dict:
+        """One reconcile + persist round (also the unit tests' entrypoint)."""
+        result = self.autoscaler.reconcile()
+        self._persist()
+        self.rounds += 1
+        self.last_result = result
+        return result
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception:
+                logger.exception("autoscaler reconcile round failed")
+
+    def start(self) -> "AutoscalerMonitor":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="autoscaler-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 
 from ray_tpu.core import serialization
+from ray_tpu.runtime import metric_defs
 from ray_tpu.core.exceptions import (
     ActorDiedError, GetTimeoutError, ObjectLostError, RayTpuError, TaskError,
     WorkerCrashedError)
@@ -101,6 +102,11 @@ class CoreWorker:
         self._local_refs: Dict[bytes, int] = {}       # live ObjectRef pyobjects
         self._borrowed: Dict[bytes, Tuple] = {}       # oid -> owner addr
         self._arg_pins: Dict[bytes, int] = {}         # oid -> in-flight task uses
+        # GC-safe drop queue: ObjectRef.__del__ appends here (lock-free);
+        # drained outside GC context (see ref_dropped).
+        import collections
+
+        self._dropped_refs: "collections.deque" = collections.deque()
         self._deferred_unborrow: set = set()
         self._pending_borrows: list = []              # in-flight borrow RPCs
         self._owner_clients: Dict[Tuple, RpcClient] = {}
@@ -140,6 +146,7 @@ class CoreWorker:
         return self.store
 
     def put(self, value: Any) -> ObjectRef:
+        self._drain_dropped_refs()
         if isinstance(value, ObjectRef):
             raise TypeError("put() does not accept ObjectRefs")
         oid = ObjectID.generate().binary()
@@ -206,6 +213,7 @@ class CoreWorker:
         return out
 
     def get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+        self._drain_dropped_refs()
         oid = ref.binary()
         with self._mem_lock:
             if oid in self.memory_store:
@@ -323,6 +331,7 @@ class CoreWorker:
 
     def _pull_remote(self, oid: bytes, node_id: bytes) -> bytes:
         """Chunked pull of a sealed object from another node's raylet."""
+        pull_start = time.monotonic()
         addr = self._node_address(node_id)
         if addr is None:
             raise ObjectLostError(
@@ -350,11 +359,13 @@ class CoreWorker:
                         f"truncated pull of {oid.hex()[:12]}")
 
         try:
-            return self.io.run(_pull())
+            data = self.io.run(_pull())
         except (ConnectionLost, OSError):
             raise ObjectLostError(
                 f"node {node_id.hex()[:12]} unreachable while pulling "
                 f"{oid.hex()[:12]}")
+        metric_defs.PULL_LATENCY.observe(time.monotonic() - pull_start)
+        return data
 
     @staticmethod
     def _raise_if_error(value):
@@ -364,6 +375,7 @@ class CoreWorker:
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        self._drain_dropped_refs()
         assert num_returns <= len(refs)
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
@@ -521,6 +533,7 @@ class CoreWorker:
     async def _flush_task_events_loop(self):
         while True:
             await asyncio.sleep(cfg().task_events_flush_interval_s)
+            self._drain_dropped_refs()   # idle-driver drop processing
             while True:
                 with self._mem_lock:
                     buf = getattr(self, "_task_events", None)
@@ -552,6 +565,7 @@ class CoreWorker:
             rec["locations"].add(location)
         if children:
             rec["children"].extend(children)
+        metric_defs.OBJECTS_OWNED.set(len(self._owned))
         return rec
 
     def register_ref(self, ref: ObjectRef, arrived: bool = False):
@@ -560,6 +574,7 @@ class CoreWorker:
         borrow RPC is async; executors drain pending borrows BEFORE replying
         to a task (take_pending_borrows), closing the window where the
         submitter unpins args while our borrow is still in flight."""
+        self._drain_dropped_refs()
         oid = ref.binary()
         ref._registered = True
         with self._mem_lock:
@@ -587,6 +602,28 @@ class CoreWorker:
         return futs
 
     def ref_dropped(self, oid: bytes):
+        """Called from ObjectRef.__del__ — possibly by the CYCLIC GC at an
+        arbitrary allocation point, including inside a _mem_lock-held
+        section of THIS thread. Taking _mem_lock here could self-deadlock,
+        so __del__ only enqueues (deque.append is atomic and allocation-
+        free) and pokes the io loop; the drop is processed by
+        _drain_dropped_refs on the io thread (plus opportunistically from
+        normal call sites), always outside GC context."""
+        self._dropped_refs.append(oid)
+        try:
+            self.io.loop.call_soon_threadsafe(self._drain_dropped_refs)
+        except RuntimeError:
+            pass  # loop already closed (shutdown): nothing left to free
+
+    def _drain_dropped_refs(self):
+        while True:
+            try:
+                oid = self._dropped_refs.popleft()
+            except IndexError:
+                return
+            self._ref_dropped_now(oid)
+
+    def _ref_dropped_now(self, oid: bytes):
         with self._mem_lock:
             n = self._local_refs.get(oid, 0) - 1
             if n > 0:
@@ -652,6 +689,7 @@ class CoreWorker:
             self._lineage.pop(oid, None)
             children = rec["children"]
             locations = set(rec["locations"])
+            metric_defs.OBJECTS_OWNED.set(len(self._owned))
         del displaced
         self._put_refs.discard(oid)
         self._object_locations.pop(oid, None)
@@ -861,6 +899,8 @@ class CoreWorker:
                     bundle_index=-1, runtime_env=None) -> List[ObjectRef]:
         from ray_tpu import runtime_env as renv_mod
 
+        self._drain_dropped_refs()
+        metric_defs.TASKS_SUBMITTED.inc()
         fn_id = self.register_function(fn)
         num_returns = self._normalize_num_returns(num_returns)
         ser_args, names, pins = self.serialize_args(args, kwargs)
@@ -954,6 +994,7 @@ class CoreWorker:
                 self.result_futures[roid] = fut
                 if roid == oid:
                     futs.append(fut)
+        metric_defs.RECONSTRUCTIONS.inc()
         logger.warning("reconstructing lost object %s by re-executing %s",
                        oid.hex()[:12], spec.name)
         self.io.spawn(self._submit_async(spec))
@@ -1176,6 +1217,7 @@ class CoreWorker:
             await lease.client.close()
 
     def _complete_task(self, spec: TaskSpec, reply: dict):
+        metric_defs.TASKS_FINISHED.inc(tags={"outcome": "ok"})
         if spec.pinned_oids:
             self.unpin_args(spec.pinned_oids)
             spec.pinned_oids = None
@@ -1229,6 +1271,7 @@ class CoreWorker:
             self._complete_error(spec, err)
 
     def _complete_error(self, spec: TaskSpec, err: RayTpuError):
+        metric_defs.TASKS_FINISHED.inc(tags={"outcome": "error"})
         if spec.pinned_oids:
             self.unpin_args(spec.pinned_oids)
             spec.pinned_oids = None
@@ -1257,6 +1300,7 @@ class CoreWorker:
     def submit_actor_task(self, actor_id: bytes, method_name: str, args, kwargs,
                           *, num_returns: int, name: str,
                           max_task_retries: int = 0) -> List[ObjectRef]:
+        metric_defs.ACTOR_CALLS.inc()
         num_returns = self._normalize_num_returns(num_returns)
         ser_args, names, pins = self.serialize_args(args, kwargs)
         task_id = TaskID.generate().binary()

@@ -285,8 +285,10 @@ def _apply_barrier_distributed(op, refs: List) -> List:
         apply = ray_tpu.remote(_apply_fused)
         return [apply.remote(payload, r) for r in refs]
     if isinstance(op, plan_mod.MapBatches) and op.compute == "actors":
-        return [r for _, r in
-                _actor_stage(((i, r) for i, r in enumerate(refs)), op)]
+        # _actor_stage yields in COMPLETION order; restore index order so a
+        # sorted/ordered upstream stays ordered.
+        return list(_ordered(
+            _actor_stage(((i, r) for i, r in enumerate(refs)), op)))
     raise TypeError(f"unknown barrier op {op}")
 
 

@@ -43,10 +43,11 @@ class RequestOutput:
 
 class _Request:
     def __init__(self, request_id: str, prompt: List[int],
-                 params: SamplingParams):
+                 params: SamplingParams, lora_slot: int = 0):
         self.id = request_id
         self.prompt = list(prompt)
         self.params = params
+        self.lora_slot = lora_slot    # 0 = base model (llm/lora.py)
         self.output: List[int] = []
         self.blocks: List[int] = []
         self.prefilled = 0          # context tokens already run through
@@ -56,6 +57,7 @@ class _Request:
         self.seed_val = (params.seed if params.seed is not None
                          else zlib.crc32(request_id.encode()) & 0x7FFFFFFF)
         self.finished_reason: Optional[str] = None
+        self.lora_pinned = lora_slot != 0   # released once on finish
 
     @property
     def num_tokens(self) -> int:
@@ -137,11 +139,34 @@ class LLMEngine:
 
     def add_request(self, prompt_token_ids: Sequence[int],
                     params: Optional[SamplingParams] = None,
-                    request_id: Optional[str] = None) -> str:
+                    request_id: Optional[str] = None,
+                    lora_name: Optional[str] = None) -> str:
         rid = request_id or uuid.uuid4().hex[:12]
+        slot = 0
+        if lora_name:
+            if self.runner.lora is None:
+                raise ValueError(
+                    "engine has no LoRA manager; lora_name unsupported")
+            slot = self.runner.lora.slot_of(lora_name)  # KeyError if absent
+            # Pin until the request finishes: LRU eviction must not hand
+            # this slot to another adapter mid-generation.
+            self.runner.lora.pin(slot)
         self.waiting.append(_Request(rid, list(prompt_token_ids),
-                                     params or SamplingParams()))
+                                     params or SamplingParams(), slot))
         return rid
+
+    def _unpin_lora(self, req: "_Request"):
+        if req.lora_pinned:
+            req.lora_pinned = False
+            self.runner.lora.unpin(req.lora_slot)
+
+    def _lora_idx(self, batch, S) -> Optional[np.ndarray]:
+        if self.runner.lora is None:
+            return None
+        idx = np.zeros(S, dtype=np.int32)
+        for i, req in enumerate(batch):
+            idx[i] = req.lora_slot
+        return idx
 
     def has_unfinished(self) -> bool:
         return bool(self.waiting or self.prefilling or self.running
@@ -207,6 +232,7 @@ class LLMEngine:
             if len(req.context) + 1 > self._cap_tokens:
                 self.waiting.popleft()
                 req.finished_reason = "length"
+                self._unpin_lora(req)
                 self._rejected.append(RequestOutput(
                     req.id, req.prompt, list(req.output), True, "length",
                     self._detok(req.output)))
@@ -259,16 +285,18 @@ class LLMEngine:
             tables[i, :len(req.blocks)] = req.blocks
             counters[i] = req.prefilled + c
         outputs: List[RequestOutput] = []
+        lora_idx = self._lora_idx(batch, S)
         if self._needs_logits(batch):
             logits = np.asarray(self.runner.step(
-                tokens, q_positions, kv_lens, q_lens, tables))
+                tokens, q_positions, kv_lens, q_lens, tables,
+                lora_idx=lora_idx))
             sampled = None
         else:
             temps, top_ks, top_ps, seeds, counters = self._sampling_arrays(
                 batch, S, counters)
             sampled = np.asarray(self.runner.step_sample(
                 tokens, q_positions, kv_lens, q_lens, tables,
-                temps, top_ks, top_ps, seeds, counters))
+                temps, top_ks, top_ps, seeds, counters, lora_idx=lora_idx))
             logits = None
         for i, (req, c) in enumerate(zip(batch, chunks)):
             req.prefilled += c
@@ -390,7 +418,8 @@ class LLMEngine:
             batch, S, counters)
         dev_tokens = self.runner.step_sample(
             toks[:, None], q_positions, kv_lens, q_lens, tables,
-            temps, top_ks, top_ps, seeds, counters)
+            temps, top_ks, top_ps, seeds, counters,
+            lora_idx=self._lora_idx(batch, S))
         try:
             dev_tokens.copy_to_host_async()
         except AttributeError:
@@ -461,7 +490,8 @@ class LLMEngine:
             q_lens[i] = 1
             tables[i, :len(req.blocks)] = req.blocks
         logits = np.asarray(self.runner.step(
-            tokens, q_positions, kv_lens, q_lens, tables))
+            tokens, q_positions, kv_lens, q_lens, tables,
+            lora_idx=self._lora_idx(batch, S)))
         finished: List[_Request] = []
         for i, req in enumerate(batch):
             token = sample(logits[i], req.params, np.asarray(req.context))
@@ -475,8 +505,13 @@ class LLMEngine:
         return outputs
 
     def _emit(self, req: _Request, new_tokens: List[int]) -> RequestOutput:
+        from ray_tpu.runtime import metric_defs
+
+        metric_defs.LLM_TOKENS_GENERATED.inc(len(new_tokens))
         self._check_finished(req)
         done = req.finished_reason is not None
+        if done:
+            self._unpin_lora(req)
         return RequestOutput(
             req.id, req.prompt, list(req.output), done, req.finished_reason,
             self._detok(req.output) if done else None, new_tokens)

@@ -1,0 +1,203 @@
+"""Multi-LoRA serving: batched low-rank adapters over the paged engine.
+
+Reference analog: the LoRA multiplex deployments under
+python/ray/llm/_internal/serve/deployments/llm/multiplex/ (the reference
+delegates the actual multi-LoRA math to vLLM/punica CUDA kernels). TPU-native
+design: adapters live in STACKED device tensors per target projection —
+    A: (n_layers, n_slots, d_in, rank)   B: (n_layers, n_slots, rank, d_out)
+and every sequence in a batch carries a slot index; the per-layer delta is
+two gathered einsums
+    delta[s] = (x[s] @ A[l, slot(s)]) @ B[l, slot(s)] * (alpha / rank)
+— batched over the whole mixed-adapter batch, MXU-shaped, no per-request
+recompiles (slot 0 is the identity/zero adapter, i.e. the base model).
+Slot management is LRU: load_adapter evicts the least-recently-used slot
+when full (the serve.multiplex policy, collapsed into the runner).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Projections that may carry adapters, with (in, out) dims per config.
+TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def target_dims(config) -> Dict[str, Tuple[int, int]]:
+    d, f, hd = config.d_model, config.d_ff, config.head_dim
+    return {
+        "wq": (d, config.n_heads * hd),
+        "wk": (d, config.n_kv_heads * hd),
+        "wv": (d, config.n_kv_heads * hd),
+        "wo": (config.n_heads * hd, d),
+        "w_gate": (d, f),
+        "w_up": (d, f),
+        "w_down": (f, d),
+    }
+
+
+@dataclasses.dataclass
+class LoRAAdapter:
+    """One adapter: per-target stacked factors over layers.
+
+    weights[target] = (A, B) with A: (n_layers, d_in, rank),
+    B: (n_layers, rank, d_out). Missing targets mean identity."""
+
+    name: str
+    rank: int
+    alpha: float
+    weights: Dict[str, Tuple[np.ndarray, np.ndarray]]
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / max(self.rank, 1)
+
+
+def init_adapter(config, name: str, rank: int = 8, alpha: float = 16.0,
+                 targets: Sequence[str] = ("wq", "wv"), key=None,
+                 scale: float = 0.1) -> LoRAAdapter:
+    """Random A, random-small B (standard LoRA init uses zero B; tests use a
+    nonzero scale so adapters measurably change logits)."""
+    key = key if key is not None else jax.random.key(abs(hash(name)) % (2**31))
+    dims = target_dims(config)
+    weights = {}
+    for i, t in enumerate(targets):
+        d_in, d_out = dims[t]
+        ka, kb = jax.random.split(jax.random.fold_in(key, i))
+        a = jax.random.normal(ka, (config.n_layers, d_in, rank),
+                              dtype=jnp.float32) / np.sqrt(d_in)
+        b = (jax.random.normal(kb, (config.n_layers, rank, d_out),
+                               dtype=jnp.float32) / np.sqrt(rank)) * scale
+        weights[t] = (np.asarray(a), np.asarray(b))
+    return LoRAAdapter(name, rank, alpha, weights)
+
+
+class LoRAManager:
+    """Owns the stacked slot tensors + name->slot LRU table.
+
+    Slot 0 is permanently the zero adapter (base model); user adapters
+    occupy slots 1..n_slots-1. All adapters in one manager share `rank`
+    (pad smaller ranks with zeros when loading)."""
+
+    def __init__(self, config, n_slots: int = 8, rank: int = 8,
+                 targets: Sequence[str] = TARGETS, dtype=None):
+        self.config = config
+        self.n_slots = n_slots + 1          # +1 for the base slot
+        self.rank = rank
+        self.targets = tuple(targets)
+        self.dtype = dtype or config.dtype
+        dims = target_dims(config)
+        L = config.n_layers
+        self.stacks = {}
+        for t in self.targets:
+            d_in, d_out = dims[t]
+            self.stacks[t] = (
+                jnp.zeros((L, self.n_slots, d_in, rank), dtype=self.dtype),
+                jnp.zeros((L, self.n_slots, rank, d_out), dtype=self.dtype))
+        # name -> slot; slot use ticks for LRU
+        self._slots: Dict[str, int] = {}
+        self._scaling: Dict[int, float] = {}
+        self._tick = 0
+        self._last_used: Dict[int, int] = {}
+        # slot -> count of queued/in-flight requests using it: a pinned
+        # slot must never be evicted (an LRU reuse would silently switch
+        # a running sequence's adapter mid-generation).
+        self._pins: Dict[int, int] = {}
+
+    def lora_pytree(self) -> Dict:
+        """The stacks, passed into the jitted step (a dict pytree whose
+        leaves have leading dim n_layers, so lax.scan slices per layer)."""
+        return {t: {"a": a, "b": b} for t, (a, b) in self.stacks.items()}
+
+    def slot_of(self, name: Optional[str]) -> int:
+        if not name:
+            return 0
+        if name not in self._slots:
+            raise KeyError(f"LoRA adapter {name!r} not loaded")
+        slot = self._slots[name]
+        self._tick += 1
+        self._last_used[slot] = self._tick
+        return slot
+
+    def pin(self, slot: int):
+        """Mark a slot as referenced by a queued/running request."""
+        if slot:
+            self._pins[slot] = self._pins.get(slot, 0) + 1
+
+    def unpin(self, slot: int):
+        if not slot:
+            return
+        n = self._pins.get(slot, 0) - 1
+        if n > 0:
+            self._pins[slot] = n
+        else:
+            self._pins.pop(slot, None)
+
+    @property
+    def loaded(self) -> List[str]:
+        return sorted(self._slots)
+
+    def load_adapter(self, adapter: LoRAAdapter) -> int:
+        """Install (or refresh) an adapter; returns its slot. Evicts the
+        LRU adapter when all user slots are taken."""
+        if adapter.rank > self.rank:
+            raise ValueError(
+                f"adapter rank {adapter.rank} > manager rank {self.rank}")
+        if adapter.name in self._slots:
+            slot = self._slots[adapter.name]
+        elif len(self._slots) < self.n_slots - 1:
+            used = set(self._slots.values())
+            slot = next(s for s in range(1, self.n_slots) if s not in used)
+        else:
+            evictable = [s for s in self._slots.values()
+                         if not self._pins.get(s)]
+            if not evictable:
+                raise RuntimeError(
+                    "all LoRA slots are referenced by in-flight requests; "
+                    "cannot load a new adapter (raise n_slots)")
+            slot = min(evictable, key=lambda s: self._last_used.get(s, 0))
+            evicted = next(n for n, s in self._slots.items() if s == slot)
+            del self._slots[evicted]
+        self._slots[adapter.name] = slot
+        self._tick += 1
+        self._last_used[slot] = self._tick
+        scaling = adapter.scaling
+        self._scaling[slot] = scaling
+        for t in self.targets:
+            a_stack, b_stack = self.stacks[t]
+            if t in adapter.weights:
+                a, b = adapter.weights[t]
+                r = a.shape[-1]
+                a_pad = np.zeros((a_stack.shape[0], a_stack.shape[2],
+                                  self.rank), dtype=np.float32)
+                a_pad[:, :, :r] = np.asarray(a, dtype=np.float32)
+                b_pad = np.zeros((b_stack.shape[0], self.rank,
+                                  b_stack.shape[3]), dtype=np.float32)
+                # Fold the alpha/rank scaling into B so the kernel needs no
+                # per-slot scale lookup.
+                b_pad[:, :r, :] = np.asarray(b, dtype=np.float32) * scaling
+            else:
+                a_pad = np.zeros((a_stack.shape[0], a_stack.shape[2],
+                                  self.rank), dtype=np.float32)
+                b_pad = np.zeros((b_stack.shape[0], self.rank,
+                                  b_stack.shape[3]), dtype=np.float32)
+            self.stacks[t] = (
+                a_stack.at[:, slot].set(jnp.asarray(a_pad, dtype=self.dtype)),
+                b_stack.at[:, slot].set(jnp.asarray(b_pad, dtype=self.dtype)))
+        return slot
+
+
+def apply_lora(x: jax.Array, lA: jax.Array, lB: jax.Array,
+               lora_idx: jax.Array) -> jax.Array:
+    """Batched delta for one layer's one target.
+
+    x: (S, Bq, d_in); lA: (n_slots, d_in, r); lB: (n_slots, r, d_out);
+    lora_idx: (S,) int32 slot per sequence. Returns (S, Bq, d_out)."""
+    a_sel = lA[lora_idx]            # (S, d_in, r)
+    b_sel = lB[lora_idx]            # (S, r, d_out)
+    mid = jnp.einsum("sbd,sdr->sbr", x, a_sel)
+    return jnp.einsum("sbr,sro->sbo", mid, b_sel)

@@ -58,7 +58,8 @@ class ModelRunner:
                  num_blocks: int, block_size: int = 16,
                  mesh=None, attention_impl: str = "auto",
                  chunk_size: int = 128,
-                 max_blocks_per_seq: Optional[int] = None):
+                 max_blocks_per_seq: Optional[int] = None,
+                 lora_manager=None):
         self.config = config
         self.block_size = block_size
         self.num_blocks = num_blocks
@@ -72,6 +73,10 @@ class ModelRunner:
             attention_impl = ("pallas" if jax.default_backend() == "tpu"
                               and config.head_dim % 128 == 0 else "reference")
         self.attention_impl = attention_impl
+        # Multi-LoRA (llm/lora.py): when a manager is attached, the step
+        # takes the slot stacks + a per-sequence slot index and adds batched
+        # low-rank deltas; without one the step compiles with no LoRA code.
+        self.lora = lora_manager
         self.params = self._place_params(params)
         self.cache = self._place_cache(
             init_kv_cache(config, num_blocks, block_size))
@@ -119,11 +124,13 @@ class ModelRunner:
     # ---- the unified step ------------------------------------------------
 
     def _step(self, params, cache, tokens, q_positions, kv_lens, q_lens,
-              block_tables):
+              block_tables, lora=None, lora_idx=None):
         """tokens: (S, Bq) new tokens (padded); q_positions: (S,) absolute
         position of tokens[s, 0]; kv_lens: (S,) context length AFTER this
         step's tokens; q_lens: (S,) real token count per row (0 for padding
-        sequences). Returns (last-position logits (S, vocab), cache)."""
+        sequences); lora/lora_idx: slot stacks + per-sequence adapter slot
+        (llm/lora.py) when multi-LoRA is active. Returns (last-position
+        logits (S, vocab), cache)."""
         config = self.config
         S, Bq = tokens.shape
         H, K, hd = config.n_heads, config.n_kv_heads, config.head_dim
@@ -139,14 +146,24 @@ class ModelRunner:
         block_ids = jnp.where(valid, block_ids, -1)
         offsets = positions % self.block_size
         rope_pos = jnp.clip(positions, 0, config.max_seq - 1)
+        use_lora = bool(lora)   # static: {}/None compiles the base program
 
-        def layer_step(carry, lp_li):
+        def proj(h, lp, ll, name):
+            out = h @ lp[name]
+            if use_lora and name in ll:
+                from ray_tpu.llm.lora import apply_lora
+
+                out = out + apply_lora(h, ll[name]["a"], ll[name]["b"],
+                                       lora_idx).astype(out.dtype)
+            return out
+
+        def layer_step(carry, scanned):
             x, ck, cv = carry
-            lp, li = lp_li
+            lp, li, ll = scanned
             h = rms_norm(x, lp["attn_norm"], config.norm_eps)
-            q = (h @ lp["wq"]).reshape(S, Bq, H, hd)
-            k = (h @ lp["wk"]).reshape(S, Bq, K, hd)
-            v = (h @ lp["wv"]).reshape(S, Bq, K, hd)
+            q = proj(h, lp, ll, "wq").reshape(S, Bq, H, hd)
+            k = proj(h, lp, ll, "wk").reshape(S, Bq, K, hd)
+            v = proj(h, lp, ll, "wv").reshape(S, Bq, K, hd)
             q = apply_rope(q, self.cos, self.sin, rope_pos)
             k = apply_rope(k, self.cos, self.sin, rope_pos)
             # Scatter this step's kv into the pool: layer li, every kv head,
@@ -157,15 +174,16 @@ class ModelRunner:
             cv = cv.at[li, :, block_ids, offsets].set(v, mode="drop")
             attn = self._attend(q, ck[li], cv[li], block_tables, kv_lens,
                                 q_positions, scale)
-            x = x + (attn.reshape(S, Bq, H * hd) @ lp["wo"])
+            x = x + proj(attn.reshape(S, Bq, H * hd), lp, ll, "wo")
             h = rms_norm(x, lp["mlp_norm"], config.norm_eps)
-            x = x + (swiglu(h @ lp["w_gate"], h @ lp["w_up"]) @ lp["w_down"])
+            x = x + proj(swiglu(proj(h, lp, ll, "w_gate"),
+                                proj(h, lp, ll, "w_up")), lp, ll, "w_down")
             return (x, ck, cv), None
 
         layer_indices = jnp.arange(config.n_layers)
         (x, ck, cv), _ = jax.lax.scan(
             layer_step, (x, cache["k"], cache["v"]),
-            (params["layers"], layer_indices))
+            (params["layers"], layer_indices, lora if use_lora else {}))
         x = rms_norm(x, params["final_norm"], config.norm_eps)
         # Only the last REAL position per sequence pays the vocab matmul.
         last = jnp.take_along_axis(
@@ -174,12 +192,21 @@ class ModelRunner:
             jnp.float32)
         return logits, {"k": ck, "v": cv}
 
-    def step(self, tokens, q_positions, kv_lens, q_lens, block_tables):
+    def _lora_args(self, lora_idx, batch: int):
+        if self.lora is None:
+            return {}, None
+        idx = (jnp.zeros(batch, dtype=jnp.int32) if lora_idx is None
+               else jnp.asarray(lora_idx, dtype=jnp.int32))
+        return self.lora.lora_pytree(), idx
+
+    def step(self, tokens, q_positions, kv_lens, q_lens, block_tables,
+             lora_idx=None):
         """Run one bucketed step; inputs are host arrays already padded to a
         (batch, Bq) bucket by the engine. Returns logits (S, vocab)."""
+        lora, idx = self._lora_args(lora_idx, len(tokens))
         logits, self.cache = self._step_jit(
             self.params, self.cache, tokens, q_positions, kv_lens, q_lens,
-            block_tables)
+            block_tables, lora, idx)
         return logits
 
     # ---- on-device sampling ---------------------------------------------
@@ -221,22 +248,24 @@ class ModelRunner:
 
     def _step_sample(self, params, cache, tokens, q_positions, kv_lens,
                      q_lens, block_tables, temps, top_ks, top_ps, seeds,
-                     counters):
+                     counters, lora=None, lora_idx=None):
         logits, cache = self._step(params, cache, tokens, q_positions,
-                                   kv_lens, q_lens, block_tables)
+                                   kv_lens, q_lens, block_tables, lora,
+                                   lora_idx)
         toks = self._device_sample(logits, temps, top_ks, top_ps, seeds,
                                    counters)
         return toks, cache
 
     def step_sample(self, tokens, q_positions, kv_lens, q_lens, block_tables,
-                    temps, top_ks, top_ps, seeds, counters):
+                    temps, top_ks, top_ps, seeds, counters, lora_idx=None):
         """Unified step + on-device sampling. `tokens` may be a DEVICE array
         (the previous step's output — async chaining without host sync).
         Returns the sampled token ids as a device array; the caller decides
         when to fetch (overlap the transfer with the next dispatch)."""
+        lora, idx = self._lora_args(lora_idx, len(tokens))
         toks, self.cache = self._step_sample_jit(
             self.params, self.cache, tokens, q_positions, kv_lens, q_lens,
-            block_tables, temps, top_ks, top_ps, seeds, counters)
+            block_tables, temps, top_ks, top_ps, seeds, counters, lora, idx)
         return toks
 
     def batch_bucket(self, n: int) -> int:

@@ -37,6 +37,10 @@ class LLMConfig:
     tensor_parallel: int = 1            # tp axis size of the in-replica mesh
     prefill_chunk: int = 128
     tokenizer: Any = None
+    # Multi-LoRA (llm/lora.py): preloaded adapters + slot-table sizing.
+    lora_adapters: Any = None           # list[LoRAAdapter] | None
+    max_loras: int = 8
+    lora_rank: int = 8
 
 
 class LLMServer:
@@ -63,11 +67,19 @@ class LLMServer:
             mesh = build_mesh(
                 MeshConfig(tp=llm_config.tensor_parallel),
                 devices=jax.devices()[:llm_config.tensor_parallel])
+        lora_manager = None
+        if llm_config.lora_adapters:
+            from ray_tpu.llm.lora import LoRAManager
+
+            lora_manager = LoRAManager(config, n_slots=llm_config.max_loras,
+                                       rank=llm_config.lora_rank)
+            for adapter in llm_config.lora_adapters:
+                lora_manager.load_adapter(adapter)
         runner = ModelRunner(config, params,
                              num_blocks=llm_config.num_kv_blocks,
                              block_size=llm_config.block_size,
                              chunk_size=llm_config.prefill_chunk,
-                             mesh=mesh)
+                             mesh=mesh, lora_manager=lora_manager)
         self.engine = LLMEngine(runner,
                                 max_batch_size=llm_config.max_batch_size,
                                 tokenizer=llm_config.tokenizer,
@@ -129,12 +141,17 @@ class LLMServer:
             if not busy:
                 time.sleep(0.005)
 
-    def _submit(self, prompt, params) -> str:
+    def _submit(self, prompt, params, lora_name=None) -> str:
         rid = uuid.uuid4().hex[:12]
         q: queue.Queue = queue.Queue()
         self._streams[rid] = q
-        with self._lock:
-            self.engine.add_request(prompt, params, request_id=rid)
+        try:
+            with self._lock:
+                self.engine.add_request(prompt, params, request_id=rid,
+                                        lora_name=lora_name)
+        except Exception:
+            self._streams.pop(rid, None)
+            raise
         return rid
 
     def _parse(self, request: Dict):
@@ -152,7 +169,22 @@ class LLMServer:
             max_tokens=int(request.get("max_tokens", 32)),
             stop_token_ids=request.get("stop_token_ids"),
             seed=request.get("seed"))
-        return prompt, params
+        return prompt, params, request.get("lora_name")
+
+    # ---- LoRA management (multiplex) ------------------------------------
+
+    def load_lora_adapter(self, adapter) -> Dict:
+        """Dynamically install a LoRAAdapter (LRU-evicting when full)."""
+        if self.engine.runner.lora is None:
+            raise ValueError("replica built without LoRA support "
+                             "(set LLMConfig.lora_adapters)")
+        with self._lock:
+            slot = self.engine.runner.lora.load_adapter(adapter)
+        return {"name": adapter.name, "slot": slot}
+
+    def list_lora_adapters(self) -> Dict:
+        mgr = self.engine.runner.lora
+        return {"adapters": mgr.loaded if mgr is not None else []}
 
     # ---- API -------------------------------------------------------------
 
@@ -162,8 +194,8 @@ class LLMServer:
     def completions(self, request: Dict) -> Dict:
         """OpenAI-ish /v1/completions: {"prompt": str|[int], "max_tokens",
         "temperature", "top_k", "top_p", "stop_token_ids"}."""
-        prompt, params = self._parse(request)
-        rid = self._submit(prompt, params)
+        prompt, params, lora_name = self._parse(request)
+        rid = self._submit(prompt, params, lora_name)
         q = self._streams[rid]
         try:
             while True:
@@ -192,8 +224,8 @@ class LLMServer:
         """Streaming completions: a generator of OpenAI-style chunk events,
         one per sampled token. Consume through
         handle.options("completions_stream").remote_stream(request)."""
-        prompt, params = self._parse(request)
-        rid = self._submit(prompt, params)
+        prompt, params, lora_name = self._parse(request)
+        rid = self._submit(prompt, params, lora_name)
         q = self._streams[rid]
         try:
             while True:

@@ -1,4 +1,10 @@
 from ray_tpu.rl.algorithm import PPO, EnvRunner  # noqa: F401
+from ray_tpu.rl.connectors import (  # noqa: F401
+    Connector,
+    ConnectorPipeline,
+    FrameStack,
+    ObsNormalizer,
+)
 from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.env import VectorCartPole, make_env  # noqa: F401
 from ray_tpu.rl.impala import IMPALA, ImpalaConfig  # noqa: F401
@@ -7,4 +13,13 @@ from ray_tpu.rl.replay_buffer import (  # noqa: F401
     PrioritizedReplayBuffer,
     ReplayBuffer,
 )
+from ray_tpu.rl.offline import (  # noqa: F401
+    BC,
+    MARWIL,
+    EpisodeWriter,
+    MARWILConfig,
+    collect_episodes,
+    read_episodes,
+)
 from ray_tpu.rl.sac import SAC, SACConfig  # noqa: F401
+from ray_tpu.rl.tune_integration import as_trainable, register_algorithm  # noqa: F401

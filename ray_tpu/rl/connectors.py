@@ -1,0 +1,109 @@
+"""Connectors: composable observation/action transform pipelines.
+
+Reference analog: rllib/connectors/ (connectors v2 — env-to-module and
+module-to-env pipelines attached to EnvRunners so preprocessing travels
+with the policy, not the env). Ours are stateful numpy transforms with
+(get_state/set_state) so weights broadcast alongside policy params.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Connector:
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]):
+        pass
+
+    def reset(self):
+        """Called on episode boundaries (per-env state like frame stacks)."""
+
+
+class ObsNormalizer(Connector):
+    """Running mean/std normalization (Welford), updated on trajectories
+    collected by env runners; inference uses frozen statistics."""
+
+    def __init__(self, clip: float = 10.0, update: bool = True):
+        self.clip = clip
+        self.update = update
+        self.count = 0.0
+        self.mean: Optional[np.ndarray] = None
+        self.m2: Optional[np.ndarray] = None
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs, dtype=np.float32)
+        flat = obs.reshape(-1, obs.shape[-1])
+        if self.update:
+            if self.mean is None:
+                self.mean = np.zeros(obs.shape[-1], np.float64)
+                self.m2 = np.ones(obs.shape[-1], np.float64)
+            for row in flat:
+                self.count += 1.0
+                delta = row - self.mean
+                self.mean += delta / self.count
+                self.m2 += delta * (row - self.mean)
+        if self.mean is None or self.count < 2:
+            return obs
+        std = np.sqrt(self.m2 / max(self.count - 1, 1.0)) + 1e-8
+        out = (obs - self.mean.astype(np.float32)) / std.astype(np.float32)
+        return np.clip(out, -self.clip, self.clip)
+
+    def get_state(self):
+        return {"count": self.count,
+                "mean": None if self.mean is None else self.mean.copy(),
+                "m2": None if self.m2 is None else self.m2.copy()}
+
+    def set_state(self, state):
+        self.count = state["count"]
+        self.mean = state["mean"]
+        self.m2 = state["m2"]
+
+
+class FrameStack(Connector):
+    """Stacks the last k observations along the feature axis (vector obs)."""
+
+    def __init__(self, k: int = 4):
+        self.k = k
+        self._frames: deque = deque(maxlen=k)
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        obs = np.asarray(obs, dtype=np.float32)
+        if not self._frames or self._frames[0].shape != obs.shape:
+            self._frames = deque([obs] * self.k, maxlen=self.k)
+        else:
+            self._frames.append(obs)
+        return np.concatenate(list(self._frames), axis=-1)
+
+    def reset(self):
+        self._frames.clear()
+
+
+class ConnectorPipeline(Connector):
+    def __init__(self, connectors: List[Connector]):
+        self.connectors = list(connectors)
+
+    def __call__(self, obs):
+        for c in self.connectors:
+            obs = c(obs)
+        return obs
+
+    def get_state(self):
+        return {i: c.get_state() for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state):
+        for i, c in enumerate(self.connectors):
+            if i in state:
+                c.set_state(state[i])
+
+    def reset(self):
+        for c in self.connectors:
+            c.reset()

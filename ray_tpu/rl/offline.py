@@ -1,0 +1,216 @@
+"""Offline RL: episode storage, BC and MARWIL learners.
+
+Reference analog: rllib/offline/ (episode writers/readers feeding offline
+algorithms) and rllib/algorithms/{bc,marwil}/. TPU-native shape: episodes
+are columnar .npz shards on disk; learners are single jit-compiled update
+functions over stacked batches (the pjit-learner pattern shared with
+rl/ppo.py), so the same code path scales over a mesh's data axes.
+
+MARWIL loss: advantage-weighted behavioral cloning —
+    L = -E[ exp(beta * A_norm) * log pi(a|s) ] + vf_coef * E[(V(s) - R)^2]
+with A = R_monte_carlo - V(s); beta=0 degenerates to plain BC + value fit.
+BC is the beta=0 special case without the value head term.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+from functools import partial
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.ppo import PPOConfig, init_policy, policy_forward
+
+# ------------------------------------------------------------ episode I/O
+
+
+class EpisodeWriter:
+    """Buffers transitions and writes columnar shards:
+    {obs, actions, rewards, dones} per shard (SampleBatch-shaped)."""
+
+    def __init__(self, path: str, shard_size: int = 4096):
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self.shard_size = shard_size
+        self._buf: Dict[str, List[np.ndarray]] = {}
+        self._count = 0
+        self._shard = 0
+
+    def add_batch(self, batch: Dict[str, np.ndarray]):
+        n = len(next(iter(batch.values())))
+        for k, v in batch.items():
+            self._buf.setdefault(k, []).append(np.asarray(v))
+        self._count += n
+        if self._count >= self.shard_size:
+            self.flush()
+
+    def flush(self):
+        if not self._count:
+            return
+        arrays = {k: np.concatenate(v) for k, v in self._buf.items()}
+        out = os.path.join(self.path, f"shard_{self._shard:05d}.npz")
+        np.savez_compressed(out + ".tmp.npz", **arrays)
+        os.replace(out + ".tmp.npz", out)
+        self._shard += 1
+        self._buf.clear()
+        self._count = 0
+
+
+def read_episodes(path: str) -> Dict[str, np.ndarray]:
+    """Load all shards into one columnar batch."""
+    shards = sorted(glob.glob(os.path.join(path, "shard_*.npz")))
+    if not shards:
+        raise FileNotFoundError(f"no episode shards under {path}")
+    cols: Dict[str, List[np.ndarray]] = {}
+    for s in shards:
+        with np.load(s) as z:
+            for k in z.files:
+                cols.setdefault(k, []).append(z[k])
+    return {k: np.concatenate(v) for k, v in cols.items()}
+
+
+def monte_carlo_returns(rewards: np.ndarray, dones: np.ndarray,
+                        gamma: float) -> np.ndarray:
+    """Per-step discounted return-to-go, resetting at episode boundaries."""
+    out = np.zeros_like(rewards, dtype=np.float32)
+    acc = 0.0
+    for i in range(len(rewards) - 1, -1, -1):
+        acc = rewards[i] + gamma * acc * (1.0 - dones[i])
+        out[i] = acc
+    return out
+
+
+# ------------------------------------------------------------ learners
+
+
+@dataclasses.dataclass(frozen=True)
+class MARWILConfig:
+    obs_dim: int = 4
+    n_actions: int = 2
+    hidden: Tuple[int, ...] = (64, 64)
+    beta: float = 1.0            # 0 => plain BC
+    vf_coef: float = 1.0
+    gamma: float = 0.99
+    lr: float = 1e-3
+    batch_size: int = 256
+    epochs: int = 5
+
+
+def _policy_cfg(config: MARWILConfig) -> PPOConfig:
+    return PPOConfig(obs_dim=config.obs_dim, n_actions=config.n_actions,
+                     hidden=config.hidden)
+
+
+def marwil_loss(params, batch, config: MARWILConfig):
+    logits, values = policy_forward(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+    adv = batch["returns"] - values
+    if config.beta > 0.0:
+        # Normalize advantages by a running-free batch estimate; clip the
+        # exponent for stability (rllib clips at 20).
+        norm = jnp.sqrt(jnp.mean(adv ** 2) + 1e-8)
+        weights = jnp.exp(jnp.clip(config.beta * adv / norm, -20.0, 20.0))
+        weights = jax.lax.stop_gradient(weights)
+    else:
+        weights = jnp.ones_like(adv)
+    policy_loss = -jnp.mean(weights * logp)
+    vf_loss = jnp.mean(adv ** 2)
+    total = policy_loss + config.vf_coef * vf_loss
+    return total, {"policy_loss": policy_loss, "vf_loss": vf_loss}
+
+
+def make_marwil_update(config: MARWILConfig, optimizer):
+    @jax.jit
+    def update(params, opt_state, batch):
+        (loss, aux), grads = jax.value_and_grad(
+            marwil_loss, has_aux=True)(params, batch, config)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss, **aux}
+
+    return update
+
+
+class MARWIL:
+    """Offline trainer: fit a policy to stored episodes."""
+
+    def __init__(self, config: MARWILConfig, data_path: str, seed: int = 0):
+        self.config = config
+        data = read_episodes(data_path)
+        self.batch = {
+            "obs": data["obs"].astype(np.float32),
+            "actions": data["actions"].astype(np.int32),
+            "returns": monte_carlo_returns(
+                data["rewards"].astype(np.float32),
+                data["dones"].astype(np.float32), config.gamma),
+        }
+        self.params = init_policy(_policy_cfg(config), jax.random.key(seed))
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.update = make_marwil_update(config, self.optimizer)
+        self.rng = np.random.default_rng(seed)
+
+    def train(self) -> Dict:
+        n = len(self.batch["obs"])
+        bs = min(self.config.batch_size, n)
+        metrics = {}
+        for _ in range(self.config.epochs):
+            idx = self.rng.permutation(n)
+            for start in range(0, n - bs + 1, bs):
+                mb = {k: jnp.asarray(v[idx[start:start + bs]])
+                      for k, v in self.batch.items()}
+                self.params, self.opt_state, metrics = self.update(
+                    self.params, self.opt_state, mb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def action_logits(self, obs: np.ndarray) -> np.ndarray:
+        logits, _ = policy_forward(self.params, jnp.asarray(obs))
+        return np.asarray(logits)
+
+
+class BC(MARWIL):
+    """Behavioral cloning = MARWIL with beta=0 (rllib/algorithms/bc)."""
+
+    def __init__(self, config: Optional[MARWILConfig] = None,
+                 data_path: str = "", seed: int = 0, **overrides):
+        base = config or MARWILConfig()
+        base = dataclasses.replace(base, beta=0.0, vf_coef=overrides.pop(
+            "vf_coef", 0.0), **overrides)
+        super().__init__(base, data_path, seed)
+
+
+def collect_episodes(env_name: str, path: str, *, n_steps: int = 2048,
+                     policy=None, config: Optional[PPOConfig] = None,
+                     seed: int = 0) -> str:
+    """Roll a (possibly random) policy in an env and persist episodes —
+    the offline-data generation utility tests and examples use."""
+    from ray_tpu.rl.env import make_env
+
+    cfg = config or PPOConfig()
+    env = make_env(env_name, 8, seed)
+    obs = env.reset()
+    writer = EpisodeWriter(path)
+    rng = np.random.default_rng(seed)
+    fwd = jax.jit(policy_forward) if policy is not None else None
+    for _ in range(n_steps // 8):
+        if policy is not None:
+            logits = np.asarray(fwd(policy, jnp.asarray(obs))[0])
+            probs = np.exp(logits - logits.max(-1, keepdims=True))
+            probs /= probs.sum(-1, keepdims=True)
+            actions = np.array([rng.choice(len(p), p=p) for p in probs])
+        else:
+            actions = rng.integers(0, cfg.n_actions, size=len(obs))
+        next_obs, reward, done = env.step(actions)
+        writer.add_batch({"obs": obs, "actions": actions, "rewards": reward,
+                          "dones": done.astype(np.float32)})
+        obs = next_obs
+    writer.flush()
+    return path

@@ -1,0 +1,71 @@
+"""RL x Tune: run any algorithm under the Tuner.
+
+Reference analog: rllib Algorithm extends tune.Trainable
+(algorithms/algorithm.py:199 — "Algorithms can be interacted with in tune
+via their string names"), so `Tuner(PPO, param_space=...)` hyperparameter-
+sweeps RL. Ours adapts the (Config dataclass, Algorithm class) pairs into
+a function trainable: the Tune config dict overrides dataclass fields, the
+algorithm trains `iterations` steps, each reported to the session (so
+ASHA/PBT schedulers see per-iteration metrics and can early-stop RL
+trials).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Type
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def register_algorithm(name: str, algo_cls: Type, config_cls: Type):
+    _REGISTRY[name] = (algo_cls, config_cls)
+
+
+def _builtin(name: str):
+    if not _REGISTRY:
+        from ray_tpu.rl.algorithm import PPO
+        from ray_tpu.rl.dqn import DQN, DQNConfig
+        from ray_tpu.rl.impala import IMPALA, ImpalaConfig
+        from ray_tpu.rl.ppo import PPOConfig
+        from ray_tpu.rl.sac import SAC, SACConfig
+
+        register_algorithm("PPO", PPO, PPOConfig)
+        register_algorithm("DQN", DQN, DQNConfig)
+        register_algorithm("SAC", SAC, SACConfig)
+        register_algorithm("IMPALA", IMPALA, ImpalaConfig)
+    return _REGISTRY[name]
+
+
+def as_trainable(algorithm: str, base_config=None, *,
+                 iterations: Optional[int] = None) -> Callable[[Dict], None]:
+    """Build a Tune function-trainable for a registered algorithm.
+
+    The returned fn merges the trial's config dict over `base_config`
+    (dataclass field overrides only — unknown keys are ignored so search
+    spaces can carry extra bookkeeping), trains, and reports every
+    iteration with `training_iteration` set for scheduler rungs."""
+    algo_cls, config_cls = _builtin(algorithm)
+    base = base_config or config_cls()
+
+    def _trainable(config: Dict):
+        from ray_tpu import tune
+
+        fields = {f.name for f in dataclasses.fields(config_cls)}
+        overrides = {k: v for k, v in config.items() if k in fields}
+        algo_config = dataclasses.replace(base, **overrides)
+        n_iters = iterations or getattr(algo_config, "iterations", 10)
+        algo = algo_cls(algo_config)
+        try:
+            for i in range(n_iters):
+                metrics = dict(algo.train())
+                metrics["training_iteration"] = i + 1
+                tune.report(metrics)
+        finally:
+            try:
+                algo.stop()
+            except Exception:
+                pass
+
+    _trainable.__name__ = f"{algorithm.lower()}_trainable"
+    return _trainable

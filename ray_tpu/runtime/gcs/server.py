@@ -394,6 +394,9 @@ class GcsServer:
                 last_err = RuntimeError(lease.get("error", "lease refused"))
                 continue
             worker_addr = tuple(lease["worker_address"])
+            logger.debug("pushing create_actor %s to worker %s at %s",
+                         spec.actor_id.hex()[:12], lease["worker_id"].hex()[:12],
+                         worker_addr)
             worker_client = RpcClient(*worker_addr)
             try:
                 await worker_client.connect(timeout=15)

@@ -1,0 +1,70 @@
+"""Native runtime metric definitions: the central table of what the
+runtime itself measures.
+
+Reference analog: src/ray/stats/metric_defs.cc (every native metric —
+task counts, scheduler state, object store usage, gRPC latencies — defined
+in one place and exported through the metrics agent). Ours defines the
+runtime metrics once; components import and bump them, and every process's
+metrics ride the existing snapshot/Prometheus path (util/metrics.py +
+dashboard /metrics).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+# -- core worker -----------------------------------------------------------
+
+TASKS_SUBMITTED = Counter(
+    "ray_tpu_tasks_submitted_total",
+    "task submissions from this process (normal tasks)")
+TASKS_FINISHED = Counter(
+    "ray_tpu_tasks_finished_total",
+    "tasks whose result landed back at this owner, by outcome",
+    tag_keys=("outcome",))                       # ok | error | retried
+ACTOR_CALLS = Counter(
+    "ray_tpu_actor_calls_total", "actor method submissions")
+OBJECTS_OWNED = Gauge(
+    "ray_tpu_owned_objects", "objects this worker currently owns")
+SPILLED_BYTES = Counter(
+    "ray_tpu_spilled_bytes_total", "bytes spilled to external storage")
+RESTORED_BYTES = Counter(
+    "ray_tpu_restored_bytes_total", "bytes restored from external storage")
+RECONSTRUCTIONS = Counter(
+    "ray_tpu_object_reconstructions_total",
+    "lineage re-executions triggered by lost objects")
+
+# -- raylet ----------------------------------------------------------------
+
+LEASES_GRANTED = Counter(
+    "ray_tpu_leases_granted_total", "worker leases granted by this raylet")
+LEASES_SPILLED = Counter(
+    "ray_tpu_leases_spilled_total",
+    "lease requests redirected to another node (spillback)")
+WORKERS_STARTED = Counter(
+    "ray_tpu_workers_started_total", "worker processes spawned")
+OOM_KILLS = Counter(
+    "ray_tpu_oom_kills_total", "workers killed by the memory monitor")
+PENDING_LEASES = Gauge(
+    "ray_tpu_pending_leases", "queued lease requests on this raylet")
+
+# -- object plane ----------------------------------------------------------
+
+PULLS_SERVED = Counter(
+    "ray_tpu_object_pulls_served_total",
+    "cross-node object chunk reads served")
+PULL_LATENCY = Histogram(
+    "ray_tpu_object_pull_seconds", "end-to-end remote object pull latency",
+    boundaries=[0.001, 0.01, 0.05, 0.25, 1.0, 5.0, 30.0])
+
+# -- serve / llm -----------------------------------------------------------
+
+SERVE_REQUESTS = Counter(
+    "ray_tpu_serve_requests_total", "requests routed through handles",
+    tag_keys=("deployment",))
+LLM_TOKENS_GENERATED = Counter(
+    "ray_tpu_llm_tokens_generated_total", "tokens sampled by LLM engines")
+
+
+ALL_METRICS = [v for v in list(globals().values())
+               if isinstance(v, (Counter, Gauge, Histogram))]

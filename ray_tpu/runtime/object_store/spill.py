@@ -56,6 +56,9 @@ class SpillManager:
                 f.write(buf.metadata)
                 f.write(buf.data)
             os.replace(tmp, path)
+            from ray_tpu.runtime import metric_defs
+
+            metric_defs.SPILLED_BYTES.inc(len(buf.data))
         finally:
             buf.release()
         self.store.delete(oid)
@@ -124,6 +127,9 @@ class SpillManager:
         if rec is None:
             return False
         metadata, data = rec
+        from ray_tpu.runtime import metric_defs
+
+        metric_defs.RESTORED_BYTES.inc(len(data))
         try:
             self.create_with_spill(oid, len(data), metadata)[:] = data
             self.store.seal(oid)

@@ -20,7 +20,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ray_tpu.runtime import scheduling
+from ray_tpu.runtime import metric_defs, scheduling
 from ray_tpu.runtime.object_store import ObjectStore
 from ray_tpu.runtime.rpc import RpcClient, RpcServer
 from ray_tpu.utils.ids import NodeID, WorkerID
@@ -174,6 +174,7 @@ class Raylet:
                     "%.1fs) to relieve pressure", monitor.threshold * 100,
                     victim.worker_id.hex()[:12],
                     time.monotonic() - victim.busy_since)
+                metric_defs.OOM_KILLS.inc()
                 victim.proc.kill()
             except Exception:
                 logger.exception("memory monitor tick failed")
@@ -216,6 +217,7 @@ class Raylet:
     # ---- worker pool (worker_pool.h) -------------------------------------
 
     def _spawn_worker(self) -> WorkerHandle:
+        metric_defs.WORKERS_STARTED.inc()
         worker_id = WorkerID.generate().binary()
         env = dict(os.environ)
         env.update(self.worker_env)
@@ -351,10 +353,13 @@ class Raylet:
                 scheduling.subtract(pool, req.resources)
                 self._pending.remove(req)
                 granted = True
+                metric_defs.LEASES_GRANTED.inc()
                 logger.debug("dispatch: granting lease res=%s avail=%s", req.resources, self.available)
                 asyncio.ensure_future(self._grant_lease(req))
+        metric_defs.PENDING_LEASES.set(len(self._pending))
 
     async def _resolve_spillback(self, req: PendingLease):
+        metric_defs.LEASES_SPILLED.inc()
         if req.fut.done():
             return
         reply = self._spillback_or_fail(req)
@@ -513,6 +518,7 @@ class Raylet:
         (ObjectManager::HandlePull analog, object_manager.proto:60-61; push is
         pull-driven here — the requester re-calls until it has total bytes)."""
         async with self._pull_sem:
+            metric_defs.PULLS_SERVED.inc()
             try:
                 buf = self.store.get(oid, timeout=0)
             except Exception:

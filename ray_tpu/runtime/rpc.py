@@ -22,6 +22,18 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
+
+def _chaos_enabled() -> bool:
+    """Fault injection (runtime/chaos.py) — active only when configured via
+    RAY_TPU_CHAOS or programmatically; one cheap check on the hot path."""
+    import os
+
+    from ray_tpu.runtime import chaos as chaos_mod
+
+    return (chaos_mod._instance is not None and chaos_mod._instance.enabled
+            ) or bool(os.environ.get("RAY_TPU_CHAOS"))
+
+
 _HDR = struct.Struct("<I")
 KIND_REQUEST, KIND_REPLY, KIND_ERROR, KIND_PUSH = 0, 1, 2, 3
 MAX_FRAME = 1 << 31
@@ -107,6 +119,11 @@ class RpcServer:
         try:
             if handler is None:
                 raise RpcError(f"no handler for method {method!r}")
+            if _chaos_enabled():
+                from ray_tpu.runtime.chaos import chaos
+
+                if await chaos().intercept_server(method):
+                    return  # injected drop: caller times out (rpc_chaos.cc)
             result = await handler(conn, **data)
             if msg_id is not None:
                 await conn.send((KIND_REPLY, msg_id, method, result))
@@ -288,6 +305,10 @@ class RpcClient:
                 # non-reconnecting clients so double-grants can't happen.
 
     async def _call_once(self, method: str, timeout: Optional[float], data):
+        if _chaos_enabled():
+            from ray_tpu.runtime.chaos import chaos
+
+            await chaos().intercept_client(method)  # may raise/delay
         self._next_id += 1
         msg_id = self._next_id
         fut = asyncio.get_event_loop().create_future()
@@ -347,6 +368,13 @@ class EventLoopThread:
         self.loop.run_forever()
 
     def run(self, coro, timeout: Optional[float] = None):
+        if threading.current_thread() is self.thread:
+            # Blocking on our own loop can never complete — fail loudly
+            # instead of deadlocking the whole process.
+            coro.close()
+            raise RuntimeError(
+                "EventLoopThread.run() called from the loop thread itself; "
+                "use spawn() or await the coroutine")
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 

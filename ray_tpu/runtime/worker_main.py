@@ -358,6 +358,10 @@ class WorkerRuntime:
     # ---- actor lifecycle --------------------------------------------------
 
     async def handle_create_actor(self, conn, spec: ActorSpec):
+        logger.debug("create_actor %s (%s) max_concurrency=%d",
+                     spec.actor_id.hex()[:12], spec.class_name,
+                     spec.max_concurrency)
+
         def _create():
             from ray_tpu import runtime_env as renv_mod
 
@@ -384,8 +388,17 @@ class WorkerRuntime:
         loop = asyncio.get_event_loop()
         try:
             result = await loop.run_in_executor(self.exec_pool, _create)
+            logger.debug("create_actor %s: instance constructed",
+                         spec.actor_id.hex()[:12])
+            # Borrow RPCs for ObjectRefs deserialized in constructor args
+            # must land before the creator sees the reply and unpins them
+            # (same window handle_push_task closes).
+            await self._drain_borrows()
+            logger.debug("create_actor %s: borrows drained",
+                         spec.actor_id.hex()[:12])
             await self._raylet_client.call("mark_actor", worker_id=self.worker_id,
                                            actor_id=spec.actor_id)
+            logger.debug("create_actor %s: marked", spec.actor_id.hex()[:12])
             return result
         except Exception as e:
             tb = traceback.format_exc()
@@ -450,6 +463,11 @@ def main():
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
         format="[worker %(asctime)s %(levelname)s %(name)s] %(message)s")
+    # SIGUSR1 dumps all thread stacks to stderr (hung-worker diagnosis).
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     runtime = WorkerRuntime()
 
     async def run():

@@ -20,7 +20,7 @@ import sys
 def _connect(address: str):
     import ray_tpu
 
-    ray_tpu.init(address=address)
+    ray_tpu.init(address=address, ignore_reinit_error=True)
 
 
 def cmd_start(args):
@@ -68,8 +68,45 @@ def cmd_list(args):
 
     _connect(args.address)
     fetch = {"nodes": api.list_nodes, "actors": api.list_actors,
-             "pgs": api.list_placement_groups, "jobs": api.list_jobs}[args.what]
+             "pgs": api.list_placement_groups, "jobs": api.list_jobs,
+             "tasks": api.list_tasks, "objects": api.list_objects}[args.what]
     print(json.dumps(fetch(), indent=2, default=str))
+
+
+def cmd_memory(args):
+    """Per-node object store usage + owned-object summary (the `ray memory`
+    analog: where object bytes live across the cluster)."""
+    from ray_tpu.state import api
+
+    _connect(args.address)
+    out = {"nodes": [], "objects": []}
+    for s in api.node_stats():
+        out["nodes"].append({
+            "node_id": s.get("node_id"),
+            "store_bytes_used": s.get("object_store_used"),
+            "store_capacity": s.get("object_store_capacity"),
+            "num_workers": s.get("num_workers"),
+            "num_pending_leases": s.get("num_pending_leases"),
+        })
+    try:
+        objs = api.list_objects(limit=args.limit)
+        out["objects"] = objs
+        out["total_objects"] = len(objs)
+    except Exception as e:  # objects view is best-effort
+        out["objects_error"] = repr(e)
+    print(json.dumps(out, indent=2, default=str))
+
+
+def cmd_drain(args):
+    """Drain a node: the GCS marks it dead for scheduling; its actors
+    restart elsewhere (DrainRaylet analog, node_manager.proto)."""
+    from ray_tpu.core import worker as worker_mod
+
+    _connect(args.address)
+    core = worker_mod.global_worker()
+    node_id = bytes.fromhex(args.node_id)
+    core.io.run(core.gcs.call("drain_node", node_id=node_id))
+    print(json.dumps({"drained": args.node_id}))
 
 
 def cmd_stop(args):
@@ -160,9 +197,20 @@ def main(argv=None):
     p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser("list")
-    p.add_argument("what", choices=["nodes", "actors", "pgs", "jobs"])
+    p.add_argument("what", choices=["nodes", "actors", "pgs", "jobs",
+                                    "tasks", "objects"])
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("memory")
+    p.add_argument("--address", required=True)
+    p.add_argument("--limit", type=int, default=100)
+    p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("drain")
+    p.add_argument("node_id", help="hex node id (see `list nodes`)")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser("stop")
     p.add_argument("--address", required=True)

@@ -156,6 +156,10 @@ class DeploymentHandle:
         idx = self._pick_replica()
         replica = self._replicas[idx]
         self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        from ray_tpu.runtime import metric_defs
+
+        metric_defs.SERVE_REQUESTS.inc(
+            tags={"deployment": self.deployment_name})
         ref = replica.handle_request.remote(self.method_name, args, kwargs)
 
         def on_done(i=idx):

@@ -3,7 +3,11 @@
 Reference analog: python/ray/serve/_private/proxy.py:752 HTTPProxy (ASGI).
 An aiohttp server in an actor: POST /<deployment> with a JSON body calls the
 deployment's __call__ with the parsed payload; `{"method": ...}` in the query
-string selects a different method.
+string selects a different method; `?stream=1` switches to a streaming
+response — the replica method must return a generator, and items flow back
+as server-sent events (`data: <json>` frames, `data: [DONE]` terminator),
+the reference's streaming-response path (proxy.py + router streaming over
+ReportGeneratorItemReturns).
 """
 
 from __future__ import annotations
@@ -33,6 +37,46 @@ class HTTPProxyActor:
 
         handles = {}
 
+        async def stream_dispatch(request, handle, payload):
+            import ray_tpu
+
+            loop = asyncio.get_event_loop()
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache"})
+            await resp.prepare(request)
+            _SENTINEL = object()
+
+            def start():
+                return handle.remote_stream(payload)
+
+            def next_item(gen):
+                # Bounded wait: a stalled replica must not pin an executor
+                # thread forever (the non-stream path bounds at 300s too).
+                try:
+                    return gen.next(timeout=300)
+                except StopIteration:
+                    return _SENTINEL
+
+            try:
+                gen = await loop.run_in_executor(None, start)
+                while True:
+                    ref = await loop.run_in_executor(None, next_item, gen)
+                    if ref is _SENTINEL:
+                        break
+                    item = await loop.run_in_executor(
+                        None, lambda: ray_tpu.get(ref, timeout=300))
+                    await resp.write(b"data: "
+                                     + json.dumps(item, default=repr).encode()
+                                     + b"\n\n")
+            except Exception as e:  # noqa: BLE001
+                await resp.write(b"data: "
+                                 + json.dumps({"error": repr(e)}).encode()
+                                 + b"\n\n")
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+
         async def dispatch(request: "web.Request"):
             name = request.match_info["deployment"]
             method = request.query.get("method", "__call__")
@@ -44,6 +88,8 @@ class HTTPProxyActor:
             except Exception:
                 payload = (await request.read()).decode() or None
             handle = handles[key]
+            if request.query.get("stream") in ("1", "true"):
+                return await stream_dispatch(request, handle, payload)
             loop = asyncio.get_event_loop()
             try:
                 # Handle calls are sync (they ride the driver RPC thread);

@@ -1,9 +1,12 @@
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
     FIFOScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (  # noqa: F401
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,

@@ -85,23 +85,78 @@ class TuneController:
     def __init__(self, trainable: Callable, variants: List[Dict], *,
                  scheduler=None, storage_path: str, run_name: str,
                  max_concurrent: int = 4,
-                 resources_per_trial: Optional[Dict[str, float]] = None):
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 restored_trials: Optional[List[Trial]] = None,
+                 snapshot_interval_s: float = 5.0,
+                 searcher=None, num_samples: int = 0):
         self.trainable = trainable
         self.scheduler = scheduler or FIFOScheduler()
         self.storage_path = os.path.join(storage_path, run_name)
         os.makedirs(self.storage_path, exist_ok=True)
         self.max_concurrent = max_concurrent
         self.resources = resources_per_trial or {"CPU": 0}
-        self.trials = [Trial(f"trial_{i:04d}", cfg)
-                       for i, cfg in enumerate(variants)]
+        # A searcher suggests configs sequentially (conditioning on prior
+        # completions); without one the variant list is pre-expanded.
+        self.searcher = searcher
+        self.num_samples = num_samples
+        if restored_trials is not None:
+            self.trials = restored_trials
+        elif searcher is not None:
+            self.trials = []
+        else:
+            self.trials = [Trial(f"trial_{i:04d}", cfg)
+                           for i, cfg in enumerate(variants)]
+        self.snapshot_interval_s = snapshot_interval_s
+        self._last_snapshot = 0.0
+
+    def _maybe_suggest(self):
+        """Top up PENDING trials from the searcher while capacity and the
+        sample budget allow."""
+        if self.searcher is None:
+            return
+        active = [t for t in self.trials if t.status in (PENDING, RUNNING)]
+        while (len(self.trials) < self.num_samples
+               and len(active) < self.max_concurrent):
+            tid = f"trial_{len(self.trials):04d}"
+            cfg = self.searcher.suggest(tid)
+            if cfg is None:
+                return
+            trial = Trial(tid, cfg)
+            self.trials.append(trial)
+            active.append(trial)
+
+    def _snapshot(self, force: bool = False):
+        from ray_tpu.tune import experiment_state
+
+        now = time.monotonic()
+        if not force and now - self._last_snapshot < self.snapshot_interval_s:
+            return
+        self._last_snapshot = now
+        try:
+            experiment_state.save_snapshot(
+                self.storage_path, self.trials,
+                {"max_concurrent": self.max_concurrent,
+                 "resources": self.resources})
+        except Exception:
+            logger.exception("experiment snapshot failed")
 
     def run(self, poll_interval: float = 0.1) -> List[Trial]:
         import cloudpickle
 
+        from ray_tpu.tune import experiment_state
+
         payload = cloudpickle.dumps(self.trainable)
+        try:
+            experiment_state.save_trainable(self.storage_path, self.trainable)
+        except Exception:
+            logger.exception("could not persist trainable")
         RunnerActor = ray_tpu.remote(TrialRunner)
 
         def start_trial(trial: Trial, checkpoint_dir=None, config=None):
+            if checkpoint_dir is None and trial.checkpoint_dir:
+                # Restored mid-flight trial: resume from its last persisted
+                # checkpoint rather than from scratch.
+                checkpoint_dir = trial.checkpoint_dir
             trial.actor = RunnerActor.options(
                 num_cpus=self.resources.get("CPU", 0),
                 num_tpus=self.resources.get("TPU", 0)).remote(
@@ -113,6 +168,7 @@ class TuneController:
             trial.status = RUNNING
 
         while True:
+            self._maybe_suggest()
             running = [t for t in self.trials if t.status == RUNNING]
             pending = [t for t in self.trials if t.status == PENDING]
             for trial in pending[:max(0, self.max_concurrent - len(running))]:
@@ -148,7 +204,20 @@ class TuneController:
                 elif poll["finished"]:
                     trial.status = TERMINATED
                     self._kill(trial)
+                if (self.searcher is not None
+                        and trial.status in (TERMINATED, ERRORED)):
+                    try:
+                        # Errored trials report None: a crashing config must
+                        # not enter the searcher's observations as a success.
+                        self.searcher.on_trial_complete(
+                            trial.trial_id,
+                            None if trial.status == ERRORED
+                            else trial.last_result)
+                    except Exception:
+                        logger.exception("searcher completion hook failed")
+            self._snapshot()
             time.sleep(poll_interval)
+        self._snapshot(force=True)
         return self.trials
 
     def _persist_checkpoint(self, trial: Trial, src_dir: str) -> str:

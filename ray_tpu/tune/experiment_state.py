@@ -1,0 +1,73 @@
+"""Experiment snapshots: persist and restore a tuning run.
+
+Reference analog: python/ray/tune/execution/experiment_state.py (periodic
+experiment-state snapshots) + Tuner.restore (tuner.py). A snapshot is two
+files in the run's storage dir:
+
+    trainable.pkl           — the cloudpickled trainable (saved once)
+    experiment_state.pkl    — pickled dict: settings + per-trial state
+
+Restore rebuilds the Tuner: TERMINATED/ERRORED trials keep their results;
+PENDING trials re-queue; RUNNING trials (interrupted mid-flight) re-queue
+and, when they have a persisted checkpoint, restart from it (the trainable
+sees checkpoint_dir exactly as after a PBT exploit)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional
+
+TRAINABLE_FILE = "trainable.pkl"
+STATE_FILE = "experiment_state.pkl"
+
+
+def save_trainable(storage_dir: str, trainable) -> None:
+    import cloudpickle
+
+    path = os.path.join(storage_dir, TRAINABLE_FILE)
+    if not os.path.exists(path):
+        with open(path + ".tmp", "wb") as f:
+            f.write(cloudpickle.dumps(trainable))
+        os.replace(path + ".tmp", path)
+
+
+def save_snapshot(storage_dir: str, trials: List, settings: Dict) -> None:
+    """Atomic write of the current trial table."""
+    state = {
+        "settings": settings,
+        "trials": [{
+            "trial_id": t.trial_id,
+            "config": t.config,
+            "status": t.status,
+            "last_result": t.last_result,
+            "history": t.history,
+            "checkpoint_dir": t.checkpoint_dir,
+            "error": t.error,
+            "restarts": t.restarts,
+        } for t in trials],
+    }
+    path = os.path.join(storage_dir, STATE_FILE)
+    with open(path + ".tmp", "wb") as f:
+        pickle.dump(state, f)
+    os.replace(path + ".tmp", path)
+
+
+def load_snapshot(storage_dir: str) -> Optional[Dict]:
+    path = os.path.join(storage_dir, STATE_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def load_trainable(storage_dir: str):
+    import cloudpickle
+
+    with open(os.path.join(storage_dir, TRAINABLE_FILE), "rb") as f:
+        return cloudpickle.loads(f.read())
+
+
+def restorable(storage_dir: str) -> bool:
+    return (os.path.exists(os.path.join(storage_dir, STATE_FILE))
+            and os.path.exists(os.path.join(storage_dir, TRAINABLE_FILE)))

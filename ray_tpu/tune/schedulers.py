@@ -71,6 +71,51 @@ class ASHAScheduler(TrialScheduler):
         return CONTINUE
 
 
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average metric falls below the median of
+    the running averages of all trials at the same step (after a grace
+    period). Reference analog: tune/schedulers/median_stopping_rule.py."""
+
+    def __init__(self, metric: str, mode: str = "max", *,
+                 grace_period: int = 4, min_samples_required: int = 3,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        # trial_id -> (sum, count) of the metric so far
+        self._means: Dict[str, List[float]] = {}
+
+    def _running_avg(self, trial_id: str) -> Optional[float]:
+        s = self._means.get(trial_id)
+        return None if not s or s[1] == 0 else s[0] / s[1]
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        acc = self._means.setdefault(trial_id, [0.0, 0])
+        acc[0] += float(value)
+        acc[1] += 1
+        t = result.get(self.time_attr, acc[1])
+        if t < self.grace or len(self._means) < self.min_samples:
+            return CONTINUE
+        others = [self._running_avg(tid) for tid in self._means
+                  if tid != trial_id]
+        others = [v for v in others if v is not None]
+        if len(others) < self.min_samples - 1:
+            return CONTINUE
+        ranked = sorted(others)
+        mid = len(ranked) // 2
+        median = (ranked[mid] if len(ranked) % 2
+                  else 0.5 * (ranked[mid - 1] + ranked[mid]))
+        mine = self._running_avg(trial_id)
+        worse = mine < median if self.mode == "max" else mine > median
+        return STOP if worse else CONTINUE
+
+
 class PopulationBasedTraining(TrialScheduler):
     """PBT: every `perturbation_interval` iterations, bottom-quantile trials
     exploit (copy checkpoint+config of) a top-quantile trial and explore

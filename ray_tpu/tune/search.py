@@ -8,8 +8,9 @@ stochastic domains sample `num_samples` times.
 from __future__ import annotations
 
 import itertools
+import math
 import random
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 
 class Domain:
@@ -35,14 +36,13 @@ class Uniform(Domain):
 
 class LogUniform(Domain):
     def __init__(self, low, high):
-        import math
-
+        self.low, self.high = low, high
         self.log_low, self.log_high = math.log(low), math.log(high)
 
     def sample(self, rng):
-        import math
-
-        return math.exp(rng.uniform(self.log_low, self.log_high))
+        # Clamp: exp(log(x)) can land an ulp outside [low, high].
+        return min(max(math.exp(rng.uniform(self.log_low, self.log_high)),
+                       self.low), self.high)
 
 
 class RandInt(Domain):
@@ -76,6 +76,139 @@ def randint(low, high) -> RandInt:
 
 def grid_search(values) -> GridSearch:
     return GridSearch(values)
+
+
+class Searcher:
+    """Sequential config suggestion (reference: tune/search/searcher.py —
+    the interface Optuna/HyperOpt integrations implement). The controller
+    asks `suggest` when a trial slot frees and reports back completions, so
+    later suggestions condition on earlier results."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict]):
+        pass
+
+
+class TPESearcher(Searcher):
+    """Native Tree-structured Parzen Estimator over a Domain dict
+    (the role hyperopt plays in the reference, without the dependency).
+
+    Numeric params: candidates drawn from a KDE over the good quantile's
+    values, ranked by the good/bad density ratio. Categorical params:
+    weighted draw by smoothed good-split counts."""
+
+    def __init__(self, param_space: Dict, metric: str, mode: str = "max", *,
+                 n_initial: int = 5, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: int = 0):
+        assert mode in ("max", "min")
+        self.space = param_space
+        self.metric = metric
+        self.mode = mode
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.rng = random.Random(seed)
+        self._configs: Dict[str, Dict] = {}
+        self._scores: List = []   # (score, config)
+
+    def _random_config(self) -> Dict:
+        cfg = {}
+        for k, v in self.space.items():
+            if isinstance(v, GridSearch):
+                cfg[k] = self.rng.choice(v.values)
+            elif isinstance(v, Domain):
+                cfg[k] = v.sample(self.rng)
+            else:
+                cfg[k] = v
+        return cfg
+
+    def suggest(self, trial_id: str) -> Dict:
+        if len(self._scores) < self.n_initial:
+            cfg = self._random_config()
+        else:
+            cfg = self._tpe_config()
+        self._configs[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict]):
+        cfg = self._configs.pop(trial_id, None)
+        if cfg is None or not result:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        score = float(value) if self.mode == "max" else -float(value)
+        self._scores.append((score, cfg))
+
+    # -- TPE internals -----------------------------------------------------
+
+    def _split(self):
+        ranked = sorted(self._scores, key=lambda sc: sc[0], reverse=True)
+        n_good = max(1, int(len(ranked) * self.gamma))
+        return ([c for _, c in ranked[:n_good]],
+                [c for _, c in ranked[n_good:]] or [c for _, c in ranked])
+
+    @staticmethod
+    def _kde_logpdf(x: float, points: List[float], bandwidth: float) -> float:
+        if not points:
+            return 0.0
+        acc = 0.0
+        for p in points:
+            z = (x - p) / bandwidth
+            acc += math.exp(-0.5 * z * z)
+        return math.log(max(acc / (len(points) * bandwidth), 1e-300))
+
+    def _tpe_config(self) -> Dict:
+        good, bad = self._split()
+        cfg = {}
+        for k, v in self.space.items():
+            if isinstance(v, Categorical) or isinstance(v, GridSearch):
+                values = v.categories if isinstance(v, Categorical) else v.values
+                counts = {c: 1.0 for c in values}   # +1 smoothing
+                for g in good:
+                    if g.get(k) in counts:
+                        counts[g[k]] += 1.0
+                total = sum(counts.values())
+                r = self.rng.random() * total
+                acc = 0.0
+                for c, w in counts.items():
+                    acc += w
+                    if r <= acc:
+                        cfg[k] = c
+                        break
+            elif isinstance(v, (Uniform, LogUniform, RandInt)):
+                log_scale = isinstance(v, LogUniform)
+
+                def to_x(val):
+                    return math.log(val) if log_scale else float(val)
+
+                gx = [to_x(g[k]) for g in good if k in g]
+                bx = [to_x(b[k]) for b in bad if k in b]
+                lo, hi = ((v.log_low, v.log_high) if log_scale
+                          else (v.low, v.high))
+                span = max(hi - lo, 1e-12)
+                bw = max(span / max(math.sqrt(len(gx) or 1), 1.0), 1e-6)
+                best, best_ratio = None, -math.inf
+                for _ in range(self.n_candidates):
+                    base = self.rng.choice(gx) if gx else self.rng.uniform(lo, hi)
+                    x = min(max(self.rng.gauss(base, bw), lo), hi)
+                    ratio = (self._kde_logpdf(x, gx, bw)
+                             - self._kde_logpdf(x, bx, bw))
+                    if ratio > best_ratio:
+                        best, best_ratio = x, ratio
+                val = math.exp(best) if log_scale else best
+                if isinstance(v, RandInt):
+                    val = min(max(int(round(val)), v.low), v.high - 1)
+                else:
+                    val = min(max(val, v.low), v.high)
+                cfg[k] = val
+            elif isinstance(v, Domain):
+                cfg[k] = v.sample(self.rng)
+            else:
+                cfg[k] = v
+        return cfg
 
 
 def generate_variants(param_space: Dict, num_samples: int, seed: int = 0

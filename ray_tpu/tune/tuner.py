@@ -21,6 +21,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Any = None
+    search_alg: Any = None   # a tune.search.Searcher (e.g. TPESearcher)
     seed: int = 0
 
 
@@ -81,10 +82,15 @@ class Tuner:
         self.run_config = run_config or RunConfig()
         self.resources_per_trial = resources_per_trial
 
+        self._restored_trials = None
+
     def fit(self) -> ResultGrid:
-        variants = generate_variants(self.param_space,
-                                     self.tune_config.num_samples,
-                                     self.tune_config.seed)
+        # In searcher mode the controller suggests configs sequentially and
+        # ignores pre-expanded variants — don't materialize them.
+        variants = [] if self.tune_config.search_alg is not None else \
+            generate_variants(self.param_space,
+                              self.tune_config.num_samples,
+                              self.tune_config.seed)
         run_name = self.run_config.name or f"tune-{uuid.uuid4().hex[:8]}"
         controller = TuneController(
             self.trainable, variants,
@@ -92,6 +98,61 @@ class Tuner:
             storage_path=self.run_config.storage_path or "/tmp/ray_tpu_results",
             run_name=run_name,
             max_concurrent=self.tune_config.max_concurrent_trials,
-            resources_per_trial=self.resources_per_trial)
+            resources_per_trial=self.resources_per_trial,
+            restored_trials=self._restored_trials,
+            searcher=self.tune_config.search_alg,
+            num_samples=self.tune_config.num_samples)
         trials = controller.run()
         return ResultGrid(trials, self.tune_config.metric, self.tune_config.mode)
+
+    @classmethod
+    def restore(cls, path: str, *, trainable: Callable = None,
+                tune_config: Optional[TuneConfig] = None,
+                resources_per_trial: Optional[Dict[str, float]] = None
+                ) -> "Tuner":
+        """Rebuild a Tuner from a run dir written by a previous fit().
+
+        Reference analog: Tuner.restore (tuner.py) + experiment-state
+        snapshots. `path` is the run dir (storage_path/run_name).
+        Finished trials keep their results; interrupted (RUNNING) and
+        PENDING trials are re-queued — RUNNING ones resume from their last
+        persisted checkpoint when one exists."""
+        import os
+
+        from ray_tpu.tune import experiment_state
+        from ray_tpu.tune.controller import (ERRORED, PENDING, RUNNING,
+                                             TERMINATED)
+
+        state = experiment_state.load_snapshot(path)
+        if state is None:
+            raise FileNotFoundError(f"no experiment snapshot under {path}")
+        if trainable is None:
+            trainable = experiment_state.load_trainable(path)
+        storage_path, run_name = os.path.split(path.rstrip("/"))
+        settings = state.get("settings", {})
+        if resources_per_trial is None:
+            resources_per_trial = settings.get("resources")
+        if tune_config is None:
+            tune_config = TuneConfig()
+            if settings.get("max_concurrent"):
+                tune_config = dataclasses.replace(
+                    tune_config,
+                    max_concurrent_trials=settings["max_concurrent"])
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=RunConfig(name=run_name,
+                                         storage_path=storage_path),
+                    resources_per_trial=resources_per_trial)
+        trials = []
+        for rec in state["trials"]:
+            t = Trial(rec["trial_id"], rec["config"])
+            t.last_result = rec["last_result"]
+            t.history = rec["history"]
+            t.checkpoint_dir = rec["checkpoint_dir"]
+            t.error = rec["error"]
+            t.restarts = rec["restarts"]
+            t.status = rec["status"]
+            if t.status in (RUNNING, PENDING):
+                t.status = PENDING      # re-queue; resumes from checkpoint
+            trials.append(t)
+        tuner._restored_trials = trials
+        return tuner

@@ -1,0 +1,110 @@
+"""Fault-injection utilities for chaos testing.
+
+Reference analog: python/ray/_private/test_utils.py:1512 ResourceKillerActor
+and :1587 NodeKillerBase (actors that kill raylets/components on an
+interval), and the chaos release harness (release/nightly_tests/
+setup_chaos.py). Ours are plain threads driving a `Cluster`
+(ray_tpu.cluster_utils) — the in-process multi-node utility — because the
+killer must outlive the nodes it kills.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class NodeKiller:
+    """Periodically kills a random non-head worker node in the cluster.
+
+    `respawn=True` adds a replacement node (same resources) after each kill,
+    keeping cluster capacity roughly constant while churning node ids —
+    the elastic-recovery scenario."""
+
+    def __init__(self, cluster, interval_s: float = 1.0, *,
+                 respawn: bool = True, seed: int = 0,
+                 max_kills: Optional[int] = None,
+                 node_filter: Optional[Callable] = None):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.respawn = respawn
+        self.max_kills = max_kills
+        self.node_filter = node_filter or (lambda node: True)
+        self.kills: List[str] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            if self.max_kills is not None and len(self.kills) >= self.max_kills:
+                return
+            victims = [n for n in self.cluster.nodes
+                       if n.proc.poll() is None and self.node_filter(n)]
+            if not victims:
+                continue
+            node = self._rng.choice(victims)
+            resources = dict(node.resources)
+            try:
+                self.cluster.remove_node(node, force=True)
+            except Exception:
+                continue
+            self.kills.append(node.node_id.hex()[:12])
+            if self.respawn:
+                try:
+                    num_cpus = resources.pop("CPU", 1.0)
+                    num_tpus = resources.pop("TPU", 0.0)
+                    self.cluster.add_node(num_cpus=num_cpus,
+                                          num_tpus=num_tpus,
+                                          resources=resources or None)
+                except Exception:
+                    pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+class GcsKiller:
+    """Kills and restarts the GCS on an interval (GCS fault-tolerance
+    churn; the reference exercises this via NotifyGCSRestart paths)."""
+
+    def __init__(self, cluster, interval_s: float = 2.0,
+                 downtime_s: float = 0.5, max_kills: Optional[int] = None):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.downtime_s = downtime_s
+        self.max_kills = max_kills
+        self.kills = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            if self.max_kills is not None and self.kills >= self.max_kills:
+                return
+            try:
+                self.cluster.kill_gcs()
+                time.sleep(self.downtime_s)
+                self.cluster.restart_gcs()
+                self.kills += 1
+            except Exception:
+                return
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -159,7 +159,10 @@ def flush():
     node = core.node_id.hex() if getattr(core, "node_id", None) else "unknown"
     key = f"metrics:{node}:{os.getpid()}".encode()
     payload = json.dumps(snapshot_all()).encode()
-    core.io.run(core.gcs.call("kv_put", key=key, value=payload))
+    # Fire-and-forget: inc()/set() run on arbitrary threads INCLUDING the io
+    # loop itself (e.g. _complete_task on the actor submit path); blocking on
+    # the push here would deadlock the loop against its own flush.
+    core.io.spawn(core.gcs.call("kv_put", key=key, value=payload))
 
 
 def prometheus_text(snapshots: List[Dict]) -> str:

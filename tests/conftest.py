@@ -17,6 +17,23 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
 
+# The environment's sitecustomize may have ALREADY imported jax with a TPU
+# plugin (env edits above are then too late for this process): force the
+# in-process config back to CPU and drop any initialized non-CPU backend,
+# else every in-process jit in the suite compiles over the slow remote TPU
+# tunnel (same forcing __graft_entry__._force_cpu_platform does).
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge._backends and "cpu" not in xla_bridge._backends:
+            xla_bridge._clear_backends()
+    except Exception:
+        pass
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402

@@ -101,6 +101,12 @@ def test_multinode_shuffle():
     """groupby/shuffle as remote tasks across a 3-node cluster."""
     from ray_tpu.cluster_utils import Cluster
 
+    # Detach from the module-scoped single-node runtime (its fixture only
+    # tears down after the whole module); this test owns its own cluster.
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
     cluster = Cluster()
     try:
         cluster.add_node(num_cpus=2)

@@ -1,0 +1,132 @@
+"""Serve/LLM breadth: SSE streaming through the HTTP proxy, OpenAI router
+composition (deployment calling deployment), and the Data batch-inference
+processor.
+
+Reference analogs: serve streaming responses (proxy.py), the OpenAI router
+deployments (llm/_internal/serve/deployments/routers/), and
+ray.data.llm.build_llm_processor (data/llm.py:160).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.models import llama
+
+
+def _tiny():
+    return llama.LlamaConfig.tiny(max_seq=64)
+
+
+class _IdTok:
+    """Token-level 'tokenizer': encode maps chars to small ids."""
+
+    def encode(self, text):
+        return [1 + (ord(c) % 200) for c in text][:32]
+
+    def decode(self, ids):
+        return "".join(chr(97 + (int(t) % 26)) for t in ids)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    ray_tpu.init(num_cpus=8)
+    yield
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+def test_sse_streaming_through_http_proxy():
+    """?stream=1 turns a generator method into server-sent events."""
+
+    class Counter:
+        def counts(self, request):
+            n = int(request.get("n", 3))
+            for i in range(n):
+                yield {"i": i}
+
+    serve.run(serve.deployment(Counter).options(name="counter").bind(),
+              http=True)
+    host, port = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/counter?method=counts&stream=1",
+        data=json.dumps({"n": 4}).encode(),
+        headers={"Content-Type": "application/json"})
+    events = []
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            body = line[len("data: "):]
+            if body == "[DONE]":
+                break
+            events.append(json.loads(body))
+    assert [e["i"] for e in events] == [0, 1, 2, 3]
+    serve.delete("counter")
+
+
+def test_openai_router_composition():
+    """Router deployment -> engine deployment via DeploymentHandle; chat
+    completions apply the template; /v1/models lists; unknown model 404s."""
+    from ray_tpu.llm.openai_router import OpenAIRouter
+    from ray_tpu.llm.serving import LLMConfig, build_llm_deployment
+
+    tok = _IdTok()
+    cfg = LLMConfig(model_config=_tiny(), num_kv_blocks=64, block_size=8,
+                    max_batch_size=2, tokenizer=tok)
+    serve.run(build_llm_deployment(cfg, name="engine-a"))
+    router = serve.run(serve.deployment(OpenAIRouter).options(
+        name="openai").bind({"tiny-llama": "engine-a"}, tok))
+
+    models = router.options("models_list").remote(None).result(timeout=120)
+    assert [m["id"] for m in models["data"]] == ["tiny-llama"]
+
+    out = router.options("chat_completions").remote({
+        "model": "tiny-llama",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4}).result(timeout=300)
+    assert out["object"] == "chat.completion"
+    assert len(out["choices"][0]["message"]["token_ids"]) == 4
+    assert out["usage"]["completion_tokens"] == 4
+
+    missing = router.options("chat_completions").remote({
+        "model": "nope", "messages": []}).result(timeout=120)
+    assert missing["error"]["code"] == 404
+
+    # Streaming chat: chunks then a final chunk with finish_reason.
+    refs = list(router.options("chat_completions_stream").remote_stream({
+        "model": "tiny-llama",
+        "messages": [{"role": "user", "content": "go"}],
+        "max_tokens": 3}))
+    chunks = [ray_tpu.get(r, timeout=300) for r in refs]
+    assert chunks[-1]["choices"][0]["finish_reason"] is not None
+    deltas = [c for c in chunks[:-1]]
+    assert len(deltas) == 3
+    serve.delete("openai")
+    serve.delete("engine-a")
+
+
+def test_data_llm_processor():
+    from ray_tpu import data as rd
+    from ray_tpu.data.llm import ProcessorConfig, build_llm_processor
+
+    tok = _IdTok()
+    processor = build_llm_processor(
+        ProcessorConfig(model_config=_tiny(), num_kv_blocks=64, block_size=8,
+                        max_batch_size=4, batch_size=4, max_tokens=3),
+        tokenizer=tok)
+    ds = rd.from_items([{"prompt": f"item {i}"} for i in range(8)],
+                       parallelism=2)
+    rows = processor(ds).take_all()
+    assert len(rows) == 8
+    for row in rows:
+        assert len(row["generated_token_ids"]) == 3
+        assert isinstance(row["generated_text"], str)

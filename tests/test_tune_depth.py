@@ -1,0 +1,122 @@
+"""Tune depth: experiment snapshots/restore, TPE searcher, median stopping.
+
+Reference analog: tune/tests for experiment_state + searcher integrations.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune import (MedianStoppingRule, TuneConfig, Tuner, loguniform,
+                          uniform)
+from ray_tpu.tune.search import TPESearcher
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def _quadratic(config):
+    from ray_tpu import tune
+
+    x = config["x"]
+    for i in range(3):
+        tune.report({"score": -(x - 0.7) ** 2, "training_iteration": i + 1})
+
+
+def test_experiment_snapshot_and_restore(tmp_path):
+    tuner = Tuner(
+        _quadratic,
+        param_space={"x": uniform(0.0, 1.0)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=4,
+                               max_concurrent_trials=2),
+        run_config=RunConfig(name="snap-run", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 4
+    run_dir = os.path.join(str(tmp_path), "snap-run")
+    assert os.path.exists(os.path.join(run_dir, "experiment_state.pkl"))
+    assert os.path.exists(os.path.join(run_dir, "trainable.pkl"))
+
+    # Restore: all trials TERMINATED -> results come back without re-running.
+    restored = Tuner.restore(
+        run_dir, tune_config=TuneConfig(metric="score", mode="max"))
+    grid2 = restored.fit()
+    assert len(grid2) == 4
+    best = grid2.get_best_result()
+    assert best.metrics["score"] <= 0.0
+    ids = sorted(r.trial_id for r in grid2._results)
+    assert ids == sorted(r.trial_id for r in grid._results)
+
+
+def test_restore_requeues_unfinished(tmp_path):
+    """A snapshot with a PENDING trial re-queues and completes it."""
+    from ray_tpu.tune import experiment_state
+    from ray_tpu.tune.controller import PENDING, TERMINATED, Trial
+
+    run_dir = str(tmp_path / "requeue-run")
+    os.makedirs(run_dir, exist_ok=True)
+    experiment_state.save_trainable(run_dir, _quadratic)
+    done = Trial("trial_0000", {"x": 0.5})
+    done.status = TERMINATED
+    done.last_result = {"score": -0.04, "training_iteration": 3}
+    done.history = [done.last_result]
+    todo = Trial("trial_0001", {"x": 0.9})
+    todo.status = PENDING
+    experiment_state.save_snapshot(run_dir, [done, todo], {})
+
+    tuner = Tuner.restore(run_dir,
+                          tune_config=TuneConfig(metric="score", mode="max"))
+    grid = tuner.fit()
+    by_id = {r.trial_id: r for r in grid._results}
+    assert by_id["trial_0000"].metrics["score"] == -0.04
+    assert by_id["trial_0001"].metrics  # re-ran and reported
+
+
+def test_tpe_searcher_converges():
+    """TPE should concentrate samples near the optimum vs pure random."""
+    space = {"x": uniform(0.0, 1.0), "lr": loguniform(1e-4, 1e-1)}
+    searcher = TPESearcher(space, metric="score", mode="max", n_initial=4,
+                           seed=0)
+    # Simulate sequential optimization of -(x-0.7)^2.
+    for i in range(30):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        assert 0.0 <= cfg["x"] <= 1.0
+        assert 1e-4 <= cfg["lr"] <= 1e-1
+        searcher.on_trial_complete(tid, {"score": -(cfg["x"] - 0.7) ** 2})
+    late = [searcher.suggest(f"late{i}") for i in range(8)]
+    mean_err = sum(abs(c["x"] - 0.7) for c in late) / len(late)
+    assert mean_err < 0.25, f"TPE not concentrating: mean|x-0.7|={mean_err}"
+
+
+def test_tpe_with_tuner():
+    tuner = Tuner(
+        _quadratic,
+        param_space={"x": uniform(0.0, 1.0)},
+        tune_config=TuneConfig(metric="score", mode="max", num_samples=6,
+                               max_concurrent_trials=2,
+                               search_alg=TPESearcher(
+                                   {"x": uniform(0.0, 1.0)}, metric="score",
+                                   mode="max", n_initial=2, seed=1)))
+    grid = tuner.fit()
+    assert len(grid) == 6
+    assert grid.get_best_result().metrics["score"] <= 0.0
+
+
+def test_median_stopping_rule():
+    rule = MedianStoppingRule(metric="acc", mode="max", grace_period=2,
+                              min_samples_required=3)
+    from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+    # Three trials; the third is clearly worse after the grace period.
+    for step in range(1, 5):
+        a = rule.on_result("a", {"acc": 0.9, "training_iteration": step})
+        b = rule.on_result("b", {"acc": 0.8, "training_iteration": step})
+        c = rule.on_result("c", {"acc": 0.1, "training_iteration": step})
+    assert a == CONTINUE and b == CONTINUE
+    assert c == STOP
