@@ -160,16 +160,26 @@ def attention_sublayer(config, x, p, cos, sin):
     """Pre-norm GQA attention block with residual. Shared by every decoder
     family in models/ (config needs head_dim/n_heads/n_kv_heads/norm_eps and
     the attention_impl fields _attention_dispatch reads)."""
+    from ray_tpu.parallel.sharding import constrain
+
     b, s, d = x.shape
     hd, H, K = config.head_dim, config.n_heads, config.n_kv_heads
     h = rms_norm(x, p["attn_norm"], config.norm_eps)
-    q = (h @ p["wq"]).reshape(b, s, H, hd)
-    k = (h @ p["wk"]).reshape(b, s, K, hd)
-    v = (h @ p["wv"]).reshape(b, s, K, hd)
+    # Constrain every projection OUTPUT to batch-sharded: with fsdp-sharded
+    # weights, GSPMD then all-gathers the weights (the FSDP recipe) instead
+    # of resharding the activation embed-over-fsdp, which degenerates into
+    # an involuntary full rematerialization per layer.
+    q = constrain((h @ p["wq"]).reshape(b, s, H, hd),
+                  ("batch", "seq", "heads", None))
+    k = constrain((h @ p["wk"]).reshape(b, s, K, hd),
+                  ("batch", "seq", "kv_heads", None))
+    v = constrain((h @ p["wv"]).reshape(b, s, K, hd),
+                  ("batch", "seq", "kv_heads", None))
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn_out = _attention_dispatch(config, q, k, v)
-    return x + (attn_out.reshape(b, s, H * hd) @ p["wo"])
+    out = attn_out.reshape(b, s, H * hd) @ p["wo"]
+    return x + constrain(out, ("batch", "seq", None))
 
 
 def next_token_ce(logits: jax.Array, targets: jax.Array,
@@ -183,11 +193,40 @@ def next_token_ce(logits: jax.Array, targets: jax.Array,
     return -ll.mean()
 
 
+# Per-layer param layout INSIDE the scan: "embed" gathered (None) so each
+# layer's weights are explicitly all-gathered over fsdp right before use —
+# the FSDP recipe (gather weights, compute, discard; grads reduce-scatter
+# back through the constraint's transpose). Left implicit, GSPMD instead
+# reshards the batch-sharded activation embed-over-fsdp, which degenerates
+# into an involuntary full rematerialization per layer. tp axes stay.
+_LAYER_GATHER_AXES = {
+    "attn_norm": (None,),
+    "wq": (None, "heads"),
+    "wk": (None, "kv_heads"),
+    "wv": (None, "kv_heads"),
+    "wo": ("heads", None),
+    "mlp_norm": (None,),
+    "w_gate": (None, "mlp"),
+    "w_up": (None, "mlp"),
+    "w_down": ("mlp", None),
+}
+
+
+def _gather_layer_params(p, extra_axes=None):
+    from ray_tpu.parallel.sharding import constrain
+
+    axes = dict(_LAYER_GATHER_AXES)
+    if extra_axes:
+        axes.update(extra_axes)
+    return {k: (constrain(v, axes[k]) if k in axes else v)
+            for k, v in p.items()}
+
+
 def _layer(config: LlamaConfig, x, layer_params, cos, sin):
     """One decoder layer. x: (b, s, d)."""
     from ray_tpu.parallel.sharding import constrain
 
-    p = layer_params
+    p = _gather_layer_params(layer_params)
     # Keep the loop-carried activation on (batch, seq, None) inside the
     # scan: left to propagation, GSPMD picks a d-over-fsdp carry sharding
     # (resharding activations instead of all-gathering weights) and
@@ -195,7 +234,9 @@ def _layer(config: LlamaConfig, x, layer_params, cos, sin):
     x = constrain(x, ("batch", "seq", None))
     x = attention_sublayer(config, x, p, cos, sin)
     h = rms_norm(x, p["mlp_norm"], config.norm_eps)
-    x = x + (swiglu(h @ p["w_gate"], h @ p["w_up"]) @ p["w_down"])
+    gate = constrain(h @ p["w_gate"], ("batch", "seq", "mlp"))
+    up = constrain(h @ p["w_up"], ("batch", "seq", "mlp"))
+    x = x + constrain(swiglu(gate, up) @ p["w_down"], ("batch", "seq", None))
     return x
 
 
@@ -204,10 +245,13 @@ def forward(params: Dict, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
     from ray_tpu.parallel.sharding import constrain
 
     cos, sin = rope_frequencies(config.head_dim, config.max_seq, config.rope_theta)
-    x = params["embed"][tokens].astype(config.dtype)
-    # Pin the activation layout at the gather output: without this, GSPMD
-    # propagates a degenerate sharding out of the (vocab, embed)-sharded
-    # table and full-rematerializes (an all-replicate per step).
+    # Deliberately all-gather the table's fsdp (embed) factor before the
+    # lookup (rows stay vocab-sharded over tp); the backward reduce-scatters
+    # the table grad through the constraint's transpose. Left implicit,
+    # GSPMD wants the gather cotangent embed-over-fsdp and falls back to an
+    # involuntary full rematerialization.
+    table = constrain(params["embed"], ("vocab", None))
+    x = table[tokens].astype(config.dtype)
     x = constrain(x, ("batch", "seq", None))
 
     layer_fn = partial(_layer, config)
@@ -221,7 +265,9 @@ def forward(params: Dict, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     x = constrain(x, ("batch", "seq", None))
-    logits = (x @ params["lm_head"].astype(config.dtype)).astype(jnp.float32)
+    # lm_head: gather the fsdp (embed/contracting) factor, keep vocab on tp.
+    lm_head = constrain(params["lm_head"], (None, "vocab"))
+    logits = (x @ lm_head.astype(config.dtype)).astype(jnp.float32)
     logits = constrain(logits, ("batch", "seq", "vocab"))
     return logits
 

@@ -195,6 +195,13 @@ def moe_block(config: MoEConfig, x: jax.Array, router: jax.Array,
 
     from ray_tpu.parallel.sharding import constrain
 
+    # Step the token activations down from batch-over-(dp,fsdp,ep) to
+    # batch-over-(dp,fsdp) + ep-replicated BEFORE the dispatch einsum: this
+    # is the intended EP collective (an all-gather over ep), and without the
+    # explicit hop GSPMD falls back to an involuntary full rematerialization
+    # (replicate-everything) to reach the expert layout.
+    x = constrain(x, ("moe_batch", "seq", None))
+    dispatch = constrain(dispatch, ("moe_batch", "seq", None, None))
     xin = jnp.einsum("bsec,bsd->ebcd", dispatch, x.astype(jnp.float32))
     xin = xin.astype(config.dtype)
     # Expert-parallel layout for the dispatched tokens: experts over ep (the
@@ -207,8 +214,13 @@ def moe_block(config: MoEConfig, x: jax.Array, router: jax.Array,
     h = constrain(h, ("expert", "moe_batch", None, "mlp"))
     out_e = jnp.einsum("ebcf,efd->ebcd", h, w_down)
     out_e = constrain(out_e, ("expert", "moe_batch", None, None))
+    combine = constrain(combine, ("moe_batch", "seq", None, None))
     out = jnp.einsum("bsec,ebcd->bsd", combine,
                      out_e.astype(jnp.float32)).astype(x.dtype)
+    # Explicit hop back up: batch-over-(dp,fsdp) -> batch-over-(dp,fsdp,ep)
+    # (a slice over ep), mirroring the gather on the way in, so the residual
+    # add in _layer sees matching layouts.
+    out = constrain(out, ("batch", "seq", None))
 
     # Switch-transformer load-balancing loss: E * sum_e f_e * P_e, where f_e
     # = fraction of (token, choice) pairs routed to e, P_e = mean router prob.
@@ -225,7 +237,20 @@ def moe_block(config: MoEConfig, x: jax.Array, router: jax.Array,
 # ---------------------------------------------------------------- forward
 
 def _layer(config: MoEConfig, x, layer_params, cos, sin):
-    p = layer_params
+    from ray_tpu.models.llama import _gather_layer_params
+    from ray_tpu.parallel.sharding import constrain
+
+    # Same explicit FSDP weight all-gather as llama._layer; expert weights
+    # keep their ep sharding and gather only the fsdp (embed) factor.
+    p = _gather_layer_params(layer_params, extra_axes={
+        "router": (None, None),
+        "w_gate": ("expert", None, "mlp"),
+        "w_up": ("expert", None, "mlp"),
+        "w_down": ("expert", "mlp", None),
+    })
+    # Pin the scan carry (see llama._layer: an unpinned carry lets GSPMD
+    # pick a d-over-fsdp layout and full-rematerialize every layer).
+    x = constrain(x, ("batch", "seq", None))
     x = attention_sublayer(config, x, p, cos, sin)
     h = rms_norm(x, p["mlp_norm"], config.norm_eps)
     moe_out, aux = moe_block(config, h, p["router"], p["w_gate"], p["w_up"],
@@ -240,8 +265,9 @@ def forward(params: Dict, tokens: jax.Array,
 
     cos, sin = rope_frequencies(config.head_dim, config.max_seq,
                                 config.rope_theta)
-    x = params["embed"][tokens].astype(config.dtype)
-    # Pin the gather output layout (see models/llama.py forward).
+    # Gather the table's fsdp factor before the lookup (see llama.forward).
+    table = constrain(params["embed"], ("vocab", None))
+    x = table[tokens].astype(config.dtype)
     x = constrain(x, ("batch", "seq", None))
 
     layer_fn = partial(_layer, config)
@@ -257,7 +283,8 @@ def forward(params: Dict, tokens: jax.Array,
     aux = jax.tree.map(jnp.mean, aux)  # mean over layers
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     x = constrain(x, ("batch", "seq", None))
-    logits = (x @ params["lm_head"].astype(config.dtype)).astype(jnp.float32)
+    lm_head = constrain(params["lm_head"], (None, "vocab"))
+    logits = (x @ lm_head.astype(config.dtype)).astype(jnp.float32)
     logits = constrain(logits, ("batch", "seq", "vocab"))
     return logits, aux
 
