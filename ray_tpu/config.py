@@ -28,6 +28,9 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                                  "pipelined in-flight calls per actor client"),
     "pull_chunk_bytes": (int, 4 << 20, "chunk size for remote object pulls"),
     "lineage_max_entries": (int, 100_000, "owner-side lineage cap"),
+    "max_dependency_reconstructions": (int, 3,
+                                       "per-task cap on recursive lost-arg "
+                                       "recoveries before the error surfaces"),
     "reconstruction_attempts": (int, 3,
                                 "re-executions before an object is lost"),
     # -- raylet / GCS ------------------------------------------------------

@@ -53,7 +53,18 @@ class GetTimeoutError(RayTpuError, TimeoutError):
 
 
 class ObjectLostError(RayTpuError):
-    pass
+    """An object's data is gone everywhere. `oid` (when known) lets the
+    owner's submitter reconstruct the exact lost dependency recursively
+    (object_recovery_manager.h:38 analog)."""
+
+    def __init__(self, message: str, oid: "bytes | None" = None):
+        super().__init__(message)
+        self.oid = oid
+
+    def __reduce__(self):
+        # Default Exception pickling drops kwargs; keep oid across the wire
+        # (the recovery path reads it on the submitting side).
+        return (type(self), (self.args[0] if self.args else "", self.oid))
 
 
 class RuntimeEnvSetupError(RayTpuError):

@@ -14,6 +14,7 @@ thread (the instrumented_io_context analog).
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import hashlib
 import logging
 import os
@@ -336,7 +337,7 @@ class CoreWorker:
         if addr is None:
             raise ObjectLostError(
                 f"object {oid.hex()[:12]} lives on unknown/dead node "
-                f"{node_id.hex()[:12]}")
+                f"{node_id.hex()[:12]}", oid=oid)
 
         async def _pull():
             client = await self._raylet_for(addr)
@@ -348,7 +349,8 @@ class CoreWorker:
                 if not reply.get("found"):
                     raise ObjectLostError(
                         f"object {oid.hex()[:12]} not found on node "
-                        f"{node_id.hex()[:12]} (evicted or node restarted)")
+                        f"{node_id.hex()[:12]} (evicted or node restarted)",
+                        oid=oid)
                 chunk = reply["chunk"]
                 chunks.append(chunk)
                 off += len(chunk)
@@ -356,14 +358,14 @@ class CoreWorker:
                     return b"".join(chunks)
                 if not chunk:
                     raise ObjectLostError(
-                        f"truncated pull of {oid.hex()[:12]}")
+                        f"truncated pull of {oid.hex()[:12]}", oid=oid)
 
         try:
             data = self.io.run(_pull())
         except (ConnectionLost, OSError):
             raise ObjectLostError(
                 f"node {node_id.hex()[:12]} unreachable while pulling "
-                f"{oid.hex()[:12]}")
+                f"{oid.hex()[:12]}", oid=oid)
         metric_defs.PULL_LATENCY.observe(time.monotonic() - pull_start)
         return data
 
@@ -380,9 +382,8 @@ class CoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
         ready: List[ObjectRef] = []
-        sleep = 0.0005
         while len(ready) < num_returns:
-            still = []
+            still, futs = [], []
             for ref in pending:
                 oid = ref.binary()
                 with self._mem_lock:
@@ -393,13 +394,29 @@ class CoreWorker:
                     ready.append(ref)
                 else:
                     still.append(ref)
+                    if fut is not None:
+                        futs.append(fut)
             pending = still
             if len(ready) >= num_returns:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
-            time.sleep(sleep)
-            sleep = min(sleep * 1.5, 0.02)
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            if len(futs) == len(pending):
+                # Every pending ref has a local result future: block until
+                # ANY completes (event-driven, no busy-poll).
+                block = remaining if remaining is not None else 60.0
+            else:
+                # Some refs can only appear by being sealed into plasma by
+                # another process (no completion signal): re-check coarsely.
+                block = 0.02 if remaining is None else min(0.02, remaining)
+            if futs:
+                concurrent.futures.wait(
+                    futs, timeout=block,
+                    return_when=concurrent.futures.FIRST_COMPLETED)
+            else:
+                time.sleep(block)
         return ready, pending
 
     # ------------------------------------------------------------- functions
@@ -974,32 +991,45 @@ class CoreWorker:
             while len(self._lineage) > cfg().lineage_max_entries:
                 self._lineage.pop(next(iter(self._lineage)))
 
-    def _reconstruct(self, oid: bytes, timeout: Optional[float]) -> bool:
-        """Re-execute the task whose lineage produced `oid` (the object's
-        primary copy was lost with its node). Returns True if a new attempt
-        was submitted and completed."""
+    def _reconstruct_start(self, oid: bytes) -> Optional[SyncFuture]:
+        """Kick off re-execution of the task whose lineage produced `oid`;
+        returns the result future (None if no lineage/attempts remain).
+        If a (re-)execution producing `oid` is already in flight, piggyback
+        on its future instead of double-executing the producer."""
         with self._mem_lock:
+            existing = self.result_futures.get(oid)
+            if existing is not None and not existing.done():
+                return existing
             rec = self._lineage.get(oid)
             if rec is None or rec["attempts"] <= 0:
-                return False
+                return None
             rec["attempts"] -= 1
             import copy
 
             spec = copy.deepcopy(rec["spec"])
-            futs = []
+            out = None
             for roid in rec["oids"]:
                 self.memory_store.pop(roid, None)
                 self._object_locations.pop(roid, None)
                 fut = SyncFuture()
                 self.result_futures[roid] = fut
                 if roid == oid:
-                    futs.append(fut)
+                    out = fut
         metric_defs.RECONSTRUCTIONS.inc()
         logger.warning("reconstructing lost object %s by re-executing %s",
                        oid.hex()[:12], spec.name)
         self.io.spawn(self._submit_async(spec))
+        return out
+
+    def _reconstruct(self, oid: bytes, timeout: Optional[float]) -> bool:
+        """Re-execute the task whose lineage produced `oid` (the object's
+        primary copy was lost with its node). Returns True if a new attempt
+        was submitted and completed."""
+        fut = self._reconstruct_start(oid)
+        if fut is None:
+            return False
         try:
-            futs[0].result(timeout if timeout is not None else 600)
+            fut.result(timeout if timeout is not None else 600)
         except Exception:
             return False
         return True
@@ -1181,12 +1211,73 @@ class CoreWorker:
             else:
                 self._schedule_return(key, state, lease)
             return
+        lost_oid = self._lost_arg_oid(spec, reply)
+        if lost_oid is not None:
+            # Recursive object recovery (object_recovery_manager.h:38):
+            # the task failed because one of its ARGS was lost. Release the
+            # lease FIRST — the reconstruction may need the very resources
+            # this lease holds (holding it while awaiting would deadlock a
+            # fully-subscribed cluster) — then recover + resubmit aside.
+            lease.busy = False
+            if state.queue:
+                await self._pump(key, state)
+            else:
+                self._schedule_return(key, state, lease)
+            asyncio.ensure_future(
+                self._recover_and_resubmit(spec, reply, lost_oid))
+            return
         self._complete_task(spec, reply)
         lease.busy = False
         if state.queue:
             await self._pump(key, state)
         else:
             self._schedule_return(key, state, lease)
+
+    def _lost_arg_oid(self, spec: TaskSpec, reply: dict) -> Optional[bytes]:
+        """The oid of a reconstructible lost dependency, or None."""
+        if reply.get("status") != "error" or spec.num_returns == self.STREAMING:
+            return None
+        cause = getattr(reply.get("error"), "cause", None)
+        oid = getattr(cause, "oid", None)
+        if oid is None:
+            return None
+        # Only an ARG-resolution loss is safe to recover by re-running: the
+        # body never executed. An ObjectLostError raised from inside the
+        # body (a get() on some unrelated ref) means the body DID run —
+        # re-executing would duplicate side effects against max_retries.
+        if oid not in {a[1] for a in spec.args if a[0] == "r"}:
+            return None
+        if getattr(spec, "_recon_retries", 0) >= \
+                cfg().max_dependency_reconstructions:
+            return None
+        with self._mem_lock:
+            rec = self._lineage.get(oid)
+            if rec is None or rec["attempts"] <= 0:
+                return None
+        return oid
+
+    async def _recover_and_resubmit(self, spec: TaskSpec, reply: dict,
+                                    oid: bytes):
+        """Reconstruct a lost arg, then resubmit the failed task (user
+        retries are NOT consumed; bounded by max_dependency_reconstructions
+        and the arg's own lineage attempts)."""
+        try:
+            spec._recon_retries = getattr(spec, "_recon_retries", 0) + 1
+            fut = self._reconstruct_start(oid)
+            if fut is not None:
+                await asyncio.wait_for(asyncio.wrap_future(fut), 600)
+                with self._mem_lock:
+                    err = self.memory_store.get(oid)
+                if not isinstance(err, RayTpuError):
+                    # (_resolve_dependencies refreshes the arg's embedded
+                    # location from _object_locations on resubmit.)
+                    logger.warning("recovered lost dependency %s; re-running "
+                                   "%s", oid.hex()[:12], spec.name)
+                    await self._submit_async(spec)
+                    return
+        except Exception:
+            logger.exception("lost-arg recovery for %s failed", spec.name)
+        self._complete_task(spec, reply)
 
     def _schedule_return(self, key, state: _KeyState, lease: _LeasedWorker):
         loop = asyncio.get_event_loop()

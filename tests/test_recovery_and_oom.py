@@ -41,6 +41,38 @@ def test_lineage_reconstruction_after_node_loss():
         c.shutdown()
 
 
+def test_recursive_reconstruction_of_lost_dependency():
+    """Kill the node holding BOTH a task's result and its argument: get()
+    re-executes the consumer, whose lost arg is itself reconstructed
+    recursively (object_recovery_manager recursion, VERDICT weak #11)."""
+    c = Cluster()
+    c.add_node(num_cpus=1, resources={"head": 1})
+    doomed = c.add_node(num_cpus=1, resources={"other": 1})
+    ray_tpu.init(address=c.address)
+    try:
+        c.wait_for_nodes(2)
+
+        @ray_tpu.remote(num_cpus=0, resources={"other": 1})
+        def produce():
+            return np.arange(300_000, dtype=np.float64)
+
+        @ray_tpu.remote(num_cpus=0, resources={"other": 1})
+        def consume(x):
+            return x * 2.0
+
+        a = produce.remote()
+        b = consume.remote(a)
+        ray_tpu.wait([b], num_returns=1, timeout=120)
+        c.remove_node(doomed, force=True)
+        c.add_node(num_cpus=1, resources={"other": 1})
+        c.wait_for_nodes(2)
+        out = ray_tpu.get(b, timeout=180)
+        assert out.shape == (300_000,) and out[7] == 14.0
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
 def test_oom_killer_retries_task(tmp_path, monkeypatch):
     mem_file = str(tmp_path / "mem_frac")
     marker = str(tmp_path / "attempt_marker")
