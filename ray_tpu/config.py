@@ -35,6 +35,9 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                                 "re-executions before an object is lost"),
     # -- raylet / GCS ------------------------------------------------------
     "heartbeat_interval_s": (float, 2.0, "raylet resource heartbeat period"),
+    "job_keepalive_interval_s": (float, 2.0,
+                                 "driver job-heartbeat period (owner-death "
+                                 "detection for auto-started clusters)"),
     "health_check_interval_s": (float, 2.0, "GCS node health check period"),
     "health_check_failure_threshold": (int, 3,
                                        "missed health checks before a node "

@@ -6,6 +6,7 @@ get:2645, put:2813, wait:2878, remote:3266).
 
 from __future__ import annotations
 
+import asyncio
 import atexit
 import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -124,7 +125,38 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
             store_path=store_path,
             session_dir=os.path.dirname(head["object_store_path"]),
             node_id=head["node_id"])
-    core.job_id = core.io.run(core.gcs.call("register_job"))["job_id"]
+    # An auto-started cluster (_head set above) dies with this driver: the
+    # GCS tears everything down when the owning connection drops, so a
+    # SIGKILLed driver can't leak GCS/raylet/worker processes. The token
+    # makes registration idempotent under auto_reconnect retries; the
+    # keepalive loop re-claims the job after transparent reconnects even
+    # when the driver is otherwise idle (no other GCS traffic would redial).
+    import uuid as _uuid
+
+    owns_cluster = _head is not None
+    job_token = _uuid.uuid4().hex
+    core.job_id = core.io.run(core.gcs.call(
+        "register_job", owns_cluster=owns_cluster, token=job_token))["job_id"]
+
+    async def _reclaim_job(client):
+        await client.call("claim_job", job_id=core.job_id,
+                          owns_cluster=owns_cluster)
+
+    core.gcs.on_reconnect = _reclaim_job
+
+    async def _job_keepalive():
+        from ray_tpu.config import cfg as _cfg
+
+        while True:
+            await asyncio.sleep(_cfg().job_keepalive_interval_s)
+            try:
+                await core.gcs.call("claim_job", job_id=core.job_id,
+                                    owns_cluster=owns_cluster, timeout=10)
+            except Exception:
+                pass  # reconnect path retries on the next tick
+
+    if owns_cluster:
+        core._job_keepalive_task = core.io.spawn(_job_keepalive())
     if runtime_env:
         from ray_tpu.runtime_env import prepare_runtime_env
 

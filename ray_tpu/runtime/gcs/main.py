@@ -15,6 +15,9 @@ def main():
                         help="sqlite file for durable GCS state (FT mode)")
     args = parser.parse_args()
 
+    from ray_tpu.utils.debug import register_stack_dump_signal
+
+    register_stack_dump_signal()
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
         format="[gcs %(asctime)s %(levelname)s %(name)s] %(message)s")

@@ -107,6 +107,16 @@ class GcsServer:
         # Job/task event tables (state API)
         self._job_counter = 0
         self._jobs: Dict[int, dict] = {}
+        # Strong refs to fire-and-forget tasks: asyncio holds only weak
+        # refs, so an unpinned background task (e.g. the owner-death
+        # shutdown) can be garbage-collected mid-await and silently vanish.
+        self._bg_tasks: Set[asyncio.Task] = set()
+
+    def _spawn_bg(self, coro) -> "asyncio.Task":
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
     async def start(self):
         await self.server.start()
@@ -255,6 +265,41 @@ class GcsServer:
         node_id = conn.meta.get("node_id")
         if node_id is not None and node_id in self._nodes and self._nodes[node_id].alive:
             await self._mark_node_dead(node_id, "raylet disconnected")
+        job_id = conn.meta.get("job_id")
+        if job_id is not None and job_id in self._jobs:
+            self._jobs[job_id]["alive"] = False
+            self._persist_job(self._jobs[job_id])
+        if conn.meta.get("owns_cluster") and not self._shutdown.is_set():
+            self._spawn_bg(self._shutdown_if_owner_gone(job_id))
+
+    async def _shutdown_if_owner_gone(self, job_id, grace_s: float = 10.0):
+        """Tear the cluster down unless the owning driver reconnects and
+        re-claims its job within the grace period (a transient socket drop
+        of an auto_reconnect client must not kill the cluster — the driver
+        heartbeats its job every couple of seconds, so a live driver always
+        re-claims well inside the grace)."""
+        await asyncio.sleep(grace_s)
+        job = self._jobs.get(job_id)
+        if job is not None and job.get("alive"):
+            return
+        if self._shutdown.is_set():
+            return
+        logger.warning("cluster-owning driver (job %s) disconnected; "
+                       "shutting the cluster down", job_id)
+        await self._do_shutdown()
+
+    async def handle_claim_job(self, conn, job_id, owns_cluster: bool = False):
+        """Re-attach a driver connection to its job (register_job docstring).
+        Doubles as the driver's job heartbeat: called periodically so even
+        an otherwise-idle driver re-claims after a transparent reconnect."""
+        conn.meta["job_id"] = job_id
+        if owns_cluster:
+            conn.meta["owns_cluster"] = True
+        job = self._jobs.get(job_id)
+        if job is not None and not job.get("alive"):
+            job["alive"] = True
+            self._persist_job(job)
+        return {"ok": True}
 
     async def _mark_node_dead(self, node_id: bytes, reason: str):
         rec = self._nodes.get(node_id)
@@ -326,19 +371,47 @@ class GcsServer:
 
     # ---- job table --------------------------------------------------------
 
-    async def handle_register_job(self, conn, metadata=None):
+    async def handle_register_job(self, conn, metadata=None,
+                                  owns_cluster: bool = False,
+                                  token: Optional[str] = None):
+        """`owns_cluster=True` marks this driver connection as the owner of
+        an auto-started cluster: if the driver dies (connection drops
+        without a graceful shutdown), the whole cluster is torn down —
+        otherwise a SIGKILLed driver leaks GCS/raylet/worker processes
+        forever (reference: ray.init()-owned clusters die with the driver).
+
+        `token` makes registration idempotent under the client's
+        auto_reconnect retry: a lost reply must not create a second job
+        whose orphaned owner connection would later tear the cluster down
+        under a live driver."""
+        if token:
+            for job in self._jobs.values():
+                if job.get("token") == token:
+                    conn.meta["job_id"] = job["job_id"]
+                    if owns_cluster:
+                        conn.meta["owns_cluster"] = True
+                    job["alive"] = True
+                    self._persist_job(job)
+                    return {"job_id": job["job_id"]}
         self._job_counter += 1
         job_id = self._job_counter
+        conn.meta["job_id"] = job_id
+        if owns_cluster:
+            conn.meta["owns_cluster"] = True
         self._jobs[job_id] = {"job_id": job_id, "start_time": time.time(),
-                              "metadata": metadata or {}, "alive": True}
+                              "metadata": metadata or {}, "alive": True,
+                              "token": token}
+        self._persist_job(self._jobs[job_id])
+        return {"job_id": job_id}
+
+    def _persist_job(self, job: dict):
         import pickle
 
         try:
-            self._store.put("jobs", str(job_id).encode(),
-                            pickle.dumps(self._jobs[job_id]))
+            self._store.put("jobs", str(job["job_id"]).encode(),
+                            pickle.dumps(job))
         except Exception:
             logger.exception("job persist failed")
-        return {"job_id": job_id}
 
     async def handle_get_jobs(self, conn):
         return list(self._jobs.values())
@@ -544,17 +617,20 @@ class GcsServer:
     # ---- shutdown ---------------------------------------------------------
 
     async def handle_shutdown_cluster(self, conn):
-        asyncio.ensure_future(self._do_shutdown())
+        self._spawn_bg(self._do_shutdown())
         return {"ok": True}
 
     async def _do_shutdown(self):
+        logger.info("cluster shutdown: notifying %d nodes", len(self._nodes))
         await asyncio.sleep(0.05)  # let the reply flush
         for rec in self._nodes.values():
             if rec.alive and rec.client is not None:
                 try:
                     await rec.client.call("shutdown_node", timeout=5)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.warning("shutdown_node to %s failed: %r",
+                                   rec.node_id.hex()[:12], e)
+        logger.info("cluster shutdown: nodes notified; stopping GCS")
         self._shutdown.set()
 
     async def wait_for_shutdown(self):

@@ -20,6 +20,9 @@ def main():
     parser.add_argument("--worker-env", default="{}")
     args = parser.parse_args()
 
+    from ray_tpu.utils.debug import register_stack_dump_signal
+
+    register_stack_dump_signal()
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
         format="[raylet %(asctime)s %(levelname)s %(name)s] %(message)s")

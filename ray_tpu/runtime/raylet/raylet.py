@@ -181,7 +181,9 @@ class Raylet:
 
     async def run_forever(self):
         await self._shutdown.wait()
+        logger.info("raylet shutting down")
         await self._cleanup()
+        logger.info("raylet cleanup complete")
 
     async def _cleanup(self):
         for task in (self._monitor_task, self._heartbeat_task,
@@ -211,6 +213,7 @@ class Raylet:
         await self.server.close()
 
     async def handle_shutdown_node(self, conn):
+        logger.info("shutdown_node received")
         self._shutdown.set()
         return {"ok": True}
 

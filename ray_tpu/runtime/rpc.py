@@ -137,11 +137,20 @@ class RpcServer:
                 logger.exception("push handler %s failed", method)
 
     async def close(self):
+        # Close live connections BEFORE wait_closed(): since 3.12,
+        # wait_closed() blocks until every connection handler returns, and
+        # our handlers run until the peer disconnects — two processes
+        # closing their servers while holding clients to each other would
+        # deadlock (GCS <-> raylet shutdown did exactly that).
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
         for conn in list(self._conns):
             conn.close()
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5)
+            except asyncio.TimeoutError:
+                pass
 
 
 class ServerConnection:

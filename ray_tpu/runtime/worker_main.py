@@ -463,11 +463,9 @@ def main():
     logging.basicConfig(
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
         format="[worker %(asctime)s %(levelname)s %(name)s] %(message)s")
-    # SIGUSR1 dumps all thread stacks to stderr (hung-worker diagnosis).
-    import faulthandler
-    import signal
+    from ray_tpu.utils.debug import register_stack_dump_signal
 
-    faulthandler.register(signal.SIGUSR1, all_threads=True)
+    register_stack_dump_signal()
     runtime = WorkerRuntime()
 
     async def run():

@@ -1,0 +1,13 @@
+"""Process-debugging helpers shared by the runtime entry points."""
+
+from __future__ import annotations
+
+
+def register_stack_dump_signal() -> None:
+    """SIGUSR1 dumps every thread's stack to stderr — the first tool for
+    diagnosing a hung GCS/raylet/worker without restarting it (the stderr
+    of runtime processes lands in the session's per-process log file)."""
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)

@@ -1,0 +1,77 @@
+"""A SIGKILLed driver must not leak its auto-started cluster.
+
+Reference behavior: a ray.init()-owned local cluster dies with the driver.
+Ours: init() registers the driver connection as the cluster owner; the GCS
+tears everything down when that connection drops without a graceful
+shutdown (after a reconnect grace period).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+DRIVER = """
+import os, sys, time
+import ray_tpu
+
+ray_tpu.init(num_cpus=1)
+print("READY", flush=True)
+time.sleep(120)   # killed long before this expires
+"""
+
+
+def _cluster_pids_alive(session_pids):
+    alive = []
+    for pid in session_pids:
+        try:
+            os.kill(pid, 0)
+            alive.append(pid)
+        except OSError:
+            pass
+    return alive
+
+
+def test_sigkilled_driver_tears_down_cluster(tmp_path):
+    script = tmp_path / "driver.py"
+    script.write_text(DRIVER)
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + ":" + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen([sys.executable, str(script)],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    pids = []
+    try:
+        ready = proc.stdout.readline().strip()
+        assert ready == "READY", ready
+
+        # Find the cluster's processes before killing the driver. The [.]
+        # keeps this test's own command lines from matching the pattern.
+        out = subprocess.run(["pgrep", "-f", r"python -m ray_tpu[.]runtime"],
+                             capture_output=True, text=True)
+        pids = [int(p) for p in out.stdout.split()]
+        assert pids, "no cluster processes found"
+
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        # Grace period (5 s) + teardown: everything must exit.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if not _cluster_pids_alive(pids):
+                break
+            time.sleep(0.5)
+        leaked = _cluster_pids_alive(pids)
+        assert not leaked, f"cluster processes leaked after driver death: {leaked}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        # Belt and braces: never leak into other tests even on failure.
+        # Kill only the pids observed above — a broad pkill -f would match
+        # unrelated shells whose command lines mention the pattern.
+        for pid in _cluster_pids_alive(pids):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
