@@ -35,6 +35,9 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                                 "re-executions before an object is lost"),
     # -- raylet / GCS ------------------------------------------------------
     "heartbeat_interval_s": (float, 2.0, "raylet resource heartbeat period"),
+    "worker_prestart": (int, 0,
+                        "idle workers spawned at raylet start (0 = spawn on "
+                        "first lease; capped by the node's CPU count)"),
     "job_keepalive_interval_s": (float, 2.0,
                                  "driver job-heartbeat period (owner-death "
                                  "detection for auto-started clusters)"),

@@ -170,6 +170,7 @@ class DashboardHead:
             web.get("/api/actors", self.actors),
             web.get("/api/placement_groups", self.placement_groups),
             web.get("/api/cluster_resources", self.cluster_resources),
+            web.get("/api/tasks", self.tasks),
             web.get("/metrics", self.metrics),
             web.post("/api/jobs/", self.job_submit),
             web.get("/api/jobs/", self.job_list),
@@ -193,22 +194,21 @@ class DashboardHead:
 
     # -- handlers ----------------------------------------------------------
     async def index(self, request):
-        nodes = await self.gcs.call("get_nodes")
-        actors = await self.gcs.call("list_actors")
-        jobs = await self.jobs.list()
-        rows = "".join(
-            f"<tr><td>{n['node_id'][:12] if isinstance(n['node_id'], str) else n['node_id'].hex()[:12]}</td>"
-            f"<td>{'alive' if n.get('alive', True) else 'dead'}</td>"
-            f"<td>{n['resources']}</td></tr>" for n in nodes)
-        html = (
-            "<html><head><title>ray_tpu dashboard</title></head><body>"
-            f"<h1>ray_tpu cluster</h1>"
-            f"<p>{len(nodes)} nodes, {len(actors)} actors, {len(jobs)} jobs</p>"
-            f"<table border=1><tr><th>node</th><th>state</th><th>resources</th></tr>"
-            f"{rows}</table>"
-            "<p>APIs: /api/nodes /api/actors /api/placement_groups "
-            "/api/jobs/ /metrics</p></body></html>")
-        return web.Response(text=html, content_type="text/html")
+        """The dashboard UI: a dependency-free single page polling the REST
+        surface (the reference ships a React frontend; same information)."""
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "static", "index.html")
+        with open(path, encoding="utf-8") as f:
+            return web.Response(text=f.read(), content_type="text/html")
+
+    async def tasks(self, request):
+        try:
+            limit = int(request.query.get("limit", "200"))
+        except ValueError:
+            return _json({"error": "limit must be an integer"}, status=400)
+        return _json(await self.gcs.call(
+            "list_tasks", state=request.query.get("state"),
+            name=request.query.get("name"), limit=limit))
 
     async def version(self, request):
         import ray_tpu

@@ -111,6 +111,11 @@ class GcsServer:
         # refs, so an unpinned background task (e.g. the owner-death
         # shutdown) can be garbage-collected mid-await and silently vanish.
         self._bg_tasks: Set[asyncio.Task] = set()
+        # Resource-view change log (ray_syncer analog; see _bump_view).
+        import collections
+
+        self._view_version = 0
+        self._view_log: "collections.deque" = collections.deque(maxlen=1024)
 
     def _spawn_bg(self, coro) -> "asyncio.Task":
         task = asyncio.ensure_future(coro)
@@ -192,6 +197,9 @@ class GcsServer:
             rec.available = d["available"]
             self._nodes[d["node_id"]] = rec
             restored_nodes += 1
+            # Seed the view log so delta-synced raylets learn restored
+            # (possibly idle, never-bumping) nodes.
+            self._bump_view(rec)
             # Reconnect to the raylet in the background; health checks reap
             # it if it's truly gone.
             asyncio.ensure_future(self._reconnect_node(rec))
@@ -238,19 +246,55 @@ class GcsServer:
         self._nodes[node_id] = rec
         conn.meta["node_id"] = node_id
         self._persist_node(rec)
+        self._bump_view(rec)
         await self.publish("node", {"event": "added", "node": rec.view()})
         logger.info("node %s registered at %s resources=%s",
                     node_id.hex()[:12], rec.address, resources)
         return {"ok": True, "nodes": [n.view() for n in self._nodes.values()]}
 
-    async def handle_node_heartbeat(self, conn, node_id, available=None):
+    # ---- resource-view sync (ray_syncer analog) --------------------------
+    #
+    # Reference: src/ray/common/ray_syncer/ — every raylet needs an
+    # eventually-consistent view of cluster resources for spillback routing.
+    # Instead of each raylet pulling the FULL node table every heartbeat
+    # (O(N^2) bytes/sec cluster-wide), the GCS keeps a versioned change log
+    # and piggybacks only the deltas since the raylet's known version on the
+    # heartbeat reply; an idle cluster exchanges empty deltas.
+
+    def _bump_view(self, rec: "NodeRecord"):
+        self._view_version += 1
+        self._view_log.append((self._view_version, rec.view()))
+
+    def _view_deltas(self, known_version: int):
+        if (known_version > self._view_version
+                or (self._view_log
+                    and known_version < self._view_log[0][0] - 1)):
+            # Behind the capped log, or AHEAD of us (our epoch reset on a
+            # GCS restart while the raylet kept its old version): full
+            # snapshot either way — matching on raw version numbers across
+            # epochs would silently drop changes.
+            return {"version": self._view_version, "full": [
+                n.view() for n in self._nodes.values()]}
+        latest: Dict[bytes, dict] = {}
+        for ver, view in self._view_log:
+            if ver > known_version:
+                latest[view["node_id"]] = view
+        return {"version": self._view_version,
+                "deltas": list(latest.values())}
+
+    async def handle_node_heartbeat(self, conn, node_id, available=None,
+                                    known_version: Optional[int] = None):
         rec = self._nodes.get(node_id)
         if rec is None:
             return {"ok": False, "unknown": True}
         rec.last_heartbeat = time.monotonic()
-        if available is not None:
+        if available is not None and rec.available != available:
             rec.available = dict(available)
-        return {"ok": True}
+            self._bump_view(rec)
+        reply = {"ok": True}
+        if known_version is not None:
+            reply["view"] = self._view_deltas(known_version)
+        return reply
 
     async def handle_get_nodes(self, conn, only_alive=True):
         return [n.view() for n in self._nodes.values() if n.alive or not only_alive]
@@ -307,6 +351,7 @@ class GcsServer:
             return
         rec.alive = False
         self._persist_node(rec)
+        self._bump_view(rec)
         logger.warning("node %s marked dead: %s", node_id.hex()[:12], reason)
         await self.publish("node", {"event": "removed", "node": rec.view(), "reason": reason})
         # Fail/restart actors that lived on that node.

@@ -88,6 +88,9 @@ class Raylet:
         self._heartbeat_task = None
         self._memory_task = None
         self._cluster_view: List[dict] = []
+        # Incremental resource-view sync state (see _heartbeat_loop).
+        self._view_version = 0
+        self._view_nodes: Dict[bytes, dict] = {}
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -119,9 +122,29 @@ class Raylet:
             self.node_id.hex())
         self._log_task = asyncio.ensure_future(
             self._log_monitor.run(self._shutdown))
+        # Worker prestart (worker_pool.h:234 analog): warm idle workers so
+        # the first lease skips process-spawn latency. Bounded by CPU count;
+        # off by default (worker_prestart=0) — each prestart is a real
+        # process.
+        from ray_tpu.config import cfg as _cfg
+
+        prestart = min(int(self.total_resources.get("CPU", 0)),
+                       _cfg().worker_prestart)
+        self._prestart_tasks = [
+            asyncio.ensure_future(self._prestart_one())
+            for _ in range(max(0, prestart))]
         logger.info("raylet %s up at %s resources=%s", self.node_id.hex()[:12],
                     self.server.address, self.total_resources)
         return self
+
+    async def _prestart_one(self):
+        w = self._spawn_worker()
+        try:
+            await asyncio.wait_for(w.ready.wait(), timeout=120)
+        except asyncio.TimeoutError:
+            return
+        if w.address is not None and w.lease_id is None:
+            self._idle.append(w)
 
     async def _on_gcs_reconnect(self, client):
         """GCS restarted (NotifyGCSRestart analog): re-register so the new
@@ -136,22 +159,45 @@ class Raylet:
             logger.warning("re-register after GCS reconnect failed")
 
     async def _heartbeat_loop(self):
-        # Heartbeats push availability up to the GCS; the cluster view pulled
-        # back is this raylet's spillback routing table (ray_syncer resource
-        # gossip analog, src/ray/common/ray_syncer/).
+        # Heartbeats push availability up to the GCS; the reply piggybacks
+        # version-gated DELTAS of the cluster view — this raylet's spillback
+        # routing table (ray_syncer resource gossip analog,
+        # src/ray/common/ray_syncer/). An idle cluster exchanges no node
+        # data at all; a full snapshot only flows on first sync or after
+        # falling behind the GCS's capped change log.
         while not self._shutdown.is_set():
             try:
-                reply = await self.gcs.call("node_heartbeat",
-                                            node_id=self.node_id,
-                                            available=self.available)
+                reply = await self.gcs.call(
+                    "node_heartbeat", node_id=self.node_id,
+                    available=self.available,
+                    known_version=self._view_version)
                 if reply.get("unknown"):
                     # Restarted GCS lost us (no durable storage): re-register.
                     await self._on_gcs_reconnect(self.gcs)
-                self._cluster_view = await self.gcs.call("get_nodes")
+                    self._view_version = 0
+                    self._view_nodes.clear()
+                else:
+                    self._apply_view(reply.get("view"))
             except Exception:
                 pass
             from ray_tpu.config import cfg
             await asyncio.sleep(cfg().heartbeat_interval_s)
+
+    def _apply_view(self, view: Optional[dict]):
+        if not view:
+            return
+        if "full" in view:
+            self._view_nodes = {n["node_id"]: n for n in view["full"]}
+        else:
+            for n in view.get("deltas", ()):
+                self._view_nodes[n["node_id"]] = n
+        # Dead nodes delivered their final not-alive delta: drop them so
+        # the table stays bounded by LIVE nodes under churn.
+        for nid in [nid for nid, n in self._view_nodes.items()
+                    if not n.get("alive", True)]:
+            del self._view_nodes[nid]
+        self._view_version = view["version"]
+        self._cluster_view = list(self._view_nodes.values())
 
     async def _memory_monitor_loop(self):
         """Kill one leased worker per tick while the node is over the memory

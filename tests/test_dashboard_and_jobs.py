@@ -36,7 +36,11 @@ def test_dashboard_api_surface(cluster_with_dashboard):
     res = _get_json(url + "/api/cluster_resources")
     assert res["total"]["CPU"] == 2
     with urllib.request.urlopen(url + "/", timeout=30) as r:
-        assert b"ray_tpu cluster" in r.read()
+        body = r.read()
+    # The UI page itself, plus the tasks API it polls.
+    assert b"ray_tpu dashboard" in body and b"/api/tasks" in body
+    tasks = _get_json(url + "/api/tasks")
+    assert isinstance(tasks, list)
 
 
 def test_dashboard_metrics_endpoint(cluster_with_dashboard):
