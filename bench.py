@@ -79,8 +79,13 @@ def init_backend(retries: int = 3, backoff_s: float = 10.0,
             time.sleep(backoff_s * (1.5 ** attempt))
     print("bench: TPU backend unavailable; falling back to CPU",
           file=sys.stderr)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    # Env vars alone are NOT enough: the host's sitecustomize may have
+    # already imported jax with the TPU plugin registered, in which case
+    # main()'s first jax call would still initialize (and hang on) the
+    # broken backend. Force the in-process config too.
+    from __graft_entry__ import _force_cpu_platform
+
+    _force_cpu_platform(1)
     return "cpu"
 
 

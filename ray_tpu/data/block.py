@@ -17,6 +17,51 @@ Block = pa.Table
 Batch = Dict[str, np.ndarray]
 
 
+class NdarrayType(pa.ExtensionType):
+    """Arrow extension for array-valued cells of ARBITRARY shape/dtype
+    (reference analog: air ArrowTensorArray). Storage = npy-serialized
+    bytes per cell, so ragged shapes concat fine and dtype survives."""
+
+    def __init__(self):
+        pa.ExtensionType.__init__(self, pa.binary(), "ray_tpu.ndarray")
+
+    def __arrow_ext_serialize__(self):
+        return b""
+
+    @classmethod
+    def __arrow_ext_deserialize__(cls, storage_type, serialized):
+        return cls()
+
+
+try:
+    pa.register_extension_type(NdarrayType())
+except pa.ArrowKeyError:
+    pass  # already registered (module re-import)
+
+
+def _ndarray_cells_to_arrow(cells: np.ndarray) -> pa.ExtensionArray:
+    import io
+
+    payloads = []
+    for cell in cells:
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(cell), allow_pickle=False)
+        payloads.append(buf.getvalue())
+    return pa.ExtensionArray.from_storage(
+        NdarrayType(), pa.array(payloads, type=pa.binary()))
+
+
+def _arrow_to_ndarray_cells(col) -> np.ndarray:
+    import io
+
+    storage = col.combine_chunks().storage if hasattr(col, "combine_chunks") \
+        else col.storage
+    out = np.empty(len(storage), dtype=object)
+    for i, payload in enumerate(storage):
+        out[i] = np.load(io.BytesIO(payload.as_py()), allow_pickle=False)
+    return out
+
+
 def block_from_batch(batch: Union[Batch, "pa.Table", Any]) -> Block:
     if isinstance(batch, pa.Table):
         return batch
@@ -30,6 +75,10 @@ def block_from_batch(batch: Union[Batch, "pa.Table", Any]) -> Block:
                 # Tensor columns: fixed-shape lists.
                 arrays[k] = pa.FixedSizeListArray.from_arrays(
                     pa.array(v.reshape(-1)), int(np.prod(v.shape[1:])))
+            elif (v.dtype == object and len(v)
+                  and isinstance(v[0], np.ndarray)):
+                # Array-valued cells (possibly ragged shapes).
+                arrays[k] = _ndarray_cells_to_arrow(v)
             else:
                 arrays[k] = pa.array(v)
         return pa.table(arrays)
@@ -64,6 +113,8 @@ class BlockAccessor:
                 flat = col.combine_chunks().flatten()
                 width = col.type.list_size
                 out[name] = np.asarray(flat).reshape(-1, width)
+            elif isinstance(col.type, NdarrayType):
+                out[name] = _arrow_to_ndarray_cells(col)
             else:
                 out[name] = col.to_numpy(zero_copy_only=False)
         return out

@@ -235,6 +235,9 @@ class Dataset:
     def write_json(self, path: str) -> List[str]:
         return self._write(path, "write_json_block")
 
+    def write_numpy(self, path: str) -> List[str]:
+        return self._write(path, "write_numpy_block")
+
     # ---- train ingestion -------------------------------------------------
 
     def streaming_split(self, n: int) -> List["DataIterator"]:
@@ -341,3 +344,53 @@ def read_csv(paths, *, parallelism: int = 8) -> Dataset:
 def read_json(paths, *, parallelism: int = 8) -> Dataset:
     return Dataset([plan_mod.Read(ds_mod.JSONDatasource(paths), parallelism)],
                    parallelism)
+
+
+def read_text(paths, *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(ds_mod.TextDatasource(paths), parallelism)],
+                   parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(ds_mod.BinaryDatasource(paths), parallelism)],
+                   parallelism)
+
+
+def read_numpy(paths, *, column: str = "data", parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(
+        ds_mod.NumpyFileDatasource(paths, column), parallelism)], parallelism)
+
+
+def read_images(paths, *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(ds_mod.ImageDatasource(paths), parallelism)],
+                   parallelism)
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = 1) -> Dataset:
+    return Dataset([plan_mod.Read(
+        ds_mod.SQLDatasource(sql, connection_factory), parallelism)],
+        parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(
+        ds_mod.WebDatasetDatasource(paths), parallelism)], parallelism)
+
+
+def from_arrow(tables, *, parallelism: int = 8) -> Dataset:
+    tables = [tables] if not isinstance(tables, (list, tuple)) else list(tables)
+    return from_blocks(tables, parallelism)
+
+
+def from_torch(torch_dataset, *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(
+        ds_mod.TorchDatasource(torch_dataset), parallelism)], parallelism)
+
+
+def from_huggingface(hf_dataset, *, parallelism: int = 8) -> Dataset:
+    """HuggingFace datasets arrive as Arrow under the hood (reference:
+    read_api.from_huggingface)."""
+    table = hf_dataset.data.table if hasattr(hf_dataset, "data") else None
+    if table is None:
+        raise TypeError("expected a huggingface datasets.Dataset")
+    return from_blocks([table.combine_chunks()], parallelism)

@@ -18,6 +18,20 @@ from ray_tpu.data.block import Block, block_from_batch, block_from_rows
 ReadTask = Callable[[], Block]
 
 
+def _partition(n: int, parallelism: int) -> List[tuple]:
+    """Ceil-divide [0, n) into at most `parallelism` contiguous (lo, hi)
+    ranges (shared by every range-partitioned datasource)."""
+    parallelism = max(1, min(parallelism, n or 1))
+    per = (n + parallelism - 1) // parallelism
+    out = []
+    for i in range(parallelism):
+        lo, hi = i * per, min((i + 1) * per, n)
+        if lo >= hi:
+            break
+        out.append((lo, hi))
+    return out
+
+
 class Datasource:
     def read_tasks(self, parallelism: int, limit: Optional[int]) -> List[ReadTask]:
         raise NotImplementedError
@@ -30,17 +44,9 @@ class RangeDatasource(Datasource):
 
     def read_tasks(self, parallelism, limit):
         n = self.n if limit is None else min(self.n, limit)
-        parallelism = max(1, min(parallelism, n))
-        per = (n + parallelism - 1) // parallelism
-        tasks = []
-        for i in range(parallelism):
-            lo, hi = i * per, min((i + 1) * per, n)
-            if lo >= hi:
-                break
-            col = self.column
-            tasks.append(lambda lo=lo, hi=hi: block_from_batch(
-                {col: np.arange(lo, hi)}))
-        return tasks
+        col = self.column
+        return [lambda lo=lo, hi=hi: block_from_batch({col: np.arange(lo, hi)})
+                for lo, hi in _partition(n, parallelism)]
 
 
 class ItemsDatasource(Datasource):
@@ -49,14 +55,10 @@ class ItemsDatasource(Datasource):
 
     def read_tasks(self, parallelism, limit):
         items = self.items if limit is None else self.items[:limit]
-        parallelism = max(1, min(parallelism, len(items) or 1))
-        per = (len(items) + parallelism - 1) // parallelism
         tasks = []
-        for i in range(parallelism):
-            chunk = items[i * per:(i + 1) * per]
-            if not chunk:
-                break
-            if chunk and isinstance(chunk[0], dict):
+        for lo, hi in _partition(len(items), parallelism):
+            chunk = items[lo:hi]
+            if isinstance(chunk[0], dict):
                 tasks.append(lambda c=chunk: block_from_rows(c))
             else:
                 tasks.append(lambda c=chunk: block_from_batch(
@@ -72,16 +74,9 @@ class NumpyDatasource(Datasource):
         n = len(next(iter(self.arrays.values())))
         if limit is not None:
             n = min(n, limit)
-        parallelism = max(1, min(parallelism, n))
-        per = (n + parallelism - 1) // parallelism
-        tasks = []
-        for i in range(parallelism):
-            lo, hi = i * per, min((i + 1) * per, n)
-            if lo >= hi:
-                break
-            tasks.append(lambda lo=lo, hi=hi: block_from_batch(
-                {k: v[lo:hi] for k, v in self.arrays.items()}))
-        return tasks
+        return [lambda lo=lo, hi=hi: block_from_batch(
+                    {k: v[lo:hi] for k, v in self.arrays.items()})
+                for lo, hi in _partition(n, parallelism)]
 
 
 class _FileDatasource(Datasource):
@@ -162,4 +157,150 @@ def write_json_block(block, path: str, index: int) -> str:
         for row in BlockAccessor(block).to_rows():
             f.write(json.dumps({k: v.item() if hasattr(v, "item") else v
                                 for k, v in row.items()}) + "\n")
+    return out
+
+
+class TextDatasource(_FileDatasource):
+    """One row per line (reference: read_api.py read_text)."""
+
+    def _read_file(self, path):
+        with open(path, encoding="utf-8") as f:
+            lines = f.read().splitlines()   # handles \n and \r\n alike
+        return block_from_batch({"text": np.asarray(lines, dtype=object)})
+
+
+class BinaryDatasource(_FileDatasource):
+    """One row per file: bytes + path (read_binary_files)."""
+
+    def _read_file(self, path):
+        with open(path, "rb") as f:
+            data = f.read()
+        return block_from_batch({
+            "bytes": np.asarray([data], dtype=object),
+            "path": np.asarray([path], dtype=object)})
+
+
+class NumpyFileDatasource(_FileDatasource):
+    """.npy (one unnamed column) or .npz (one column per array) files
+    (read_numpy)."""
+
+    def __init__(self, paths, column: str = "data"):
+        super().__init__(paths)
+        self.column = column
+
+    def _read_file(self, path):
+        loaded = np.load(path, allow_pickle=False)
+        if isinstance(loaded, np.ndarray):
+            return block_from_batch({self.column: loaded})
+        return block_from_batch({k: loaded[k] for k in loaded.files})
+
+
+class ImageDatasource(_FileDatasource):
+    """Decoded HWC uint8 arrays (read_images; requires Pillow)."""
+
+    def _read_file(self, path):
+        try:
+            from PIL import Image
+        except ImportError as e:
+            raise ImportError("read_images requires Pillow") from e
+        with Image.open(path) as im:
+            arr = np.asarray(im.convert("RGB"))
+        # One array-valued row: a plain asarray([arr], dtype=object) would
+        # explode the image into per-pixel Python objects.
+        cell = np.empty(1, dtype=object)
+        cell[0] = arr
+        return block_from_batch({
+            "image": cell, "path": np.asarray([path], dtype=object)})
+
+
+class SQLDatasource(Datasource):
+    """DBAPI reads (reference: read_sql over any PEP-249 connection).
+    `connection_factory` must be picklable (read tasks run in workers)."""
+
+    def __init__(self, sql: str, connection_factory: Callable):
+        self.sql = sql
+        self.connection_factory = connection_factory
+
+    def read_tasks(self, parallelism, limit):
+        sql, factory = self.sql, self.connection_factory
+        lim = limit
+
+        def read():
+            conn = factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql)
+                cols = [d[0] for d in cur.description]
+                rows = cur.fetchall() if lim is None else cur.fetchmany(lim)
+                return block_from_rows(
+                    [dict(zip(cols, r)) for r in rows])
+            finally:
+                conn.close()
+
+        return [read]
+
+
+class WebDatasetDatasource(_FileDatasource):
+    """Tar shards of grouped samples: files sharing a basename become one
+    row keyed by extension (reference: read_webdataset)."""
+
+    def _read_file(self, path):
+        import tarfile
+
+        samples: Dict[str, Dict[str, Any]] = {}
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if not member.isfile():
+                    continue
+                dirname, _, fname = member.name.rpartition("/")
+                base, dot, ext = fname.partition(".")
+                if dirname:
+                    base = f"{dirname}/{base}"
+                data = tf.extractfile(member).read()
+                samples.setdefault(base, {"__key__": base})[ext or "data"] = data
+        # Ragged samples (an extension present in only some) pad with None:
+        # block columns must be uniform.
+        keys: List[str] = []
+        for s in samples.values():
+            keys.extend(k for k in s if k not in keys)
+        rows = [{k: s.get(k) for k in keys} for s in samples.values()]
+        return block_from_rows(rows)
+
+
+class TorchDatasource(Datasource):
+    """Map-style torch Dataset -> rows (reference: from_torch)."""
+
+    def __init__(self, torch_dataset):
+        self.ds = torch_dataset
+
+    def read_tasks(self, parallelism, limit):
+        n = len(self.ds)
+        if limit is not None:
+            n = min(n, limit)
+        ds = self.ds
+        return [lambda lo=lo, hi=hi: block_from_rows(
+                    [{"item": ds[j]} for j in range(lo, hi)])
+                for lo, hi in _partition(n, parallelism)]
+
+
+def write_numpy_block(block, path: str, index: int) -> str:
+    from ray_tpu.data.block import BlockAccessor
+
+    out = os.path.join(path, f"part-{index:05d}.npz")
+    batch = {}
+    for k, v in BlockAccessor(block).to_batch().items():
+        if v.dtype == object:
+            # read_numpy loads with allow_pickle=False (untrusted files),
+            # so object columns must become pickle-free U/S arrays here or
+            # the round trip would fail.
+            try:
+                v = np.asarray(v.tolist())
+                assert v.dtype != object
+            except Exception:
+                raise ValueError(
+                    f"write_numpy: column {k!r} holds mixed/non-primitive "
+                    "objects; only numeric, string, and bytes columns are "
+                    "npz-serializable")
+        batch[k] = v
+    np.savez(out, **batch)
     return out

@@ -31,12 +31,34 @@ def _get_controller():
 
 def run(target: Union[Deployment, List[Deployment]], *,
         http: bool = False, http_port: int = 0) -> DeploymentHandle:
-    """Deploy one or more deployments; returns a handle to the first."""
+    """Deploy one or more deployments; returns a handle to the first.
+
+    Model composition (reference: serve deployment graphs — api.py:591 with
+    bound child deployments): a Deployment appearing anywhere in another's
+    bound init args is deployed first and replaced by a DeploymentHandle,
+    so the parent replica calls children through ordinary handles."""
     import cloudpickle
 
     controller = _get_controller()
     deployments = [target] if isinstance(target, Deployment) else list(target)
-    for dep in deployments:
+    deployed: set = set()
+
+    def resolve(obj):
+        if isinstance(obj, Deployment):
+            deploy(obj)
+            return DeploymentHandle(obj.name)
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(resolve(x) for x in obj)
+        if isinstance(obj, dict):
+            return {k: resolve(v) for k, v in obj.items()}
+        return obj
+
+    def deploy(dep: Deployment):
+        if dep.name in deployed:
+            return
+        deployed.add(dep.name)  # before recursing: breaks bind cycles
+        init_args = tuple(resolve(a) for a in dep.init_args)
+        init_kwargs = {k: resolve(v) for k, v in dep.init_kwargs.items()}
         cfg = {
             "num_replicas": dep.config.num_replicas,
             "max_ongoing_requests": dep.config.max_ongoing_requests,
@@ -47,7 +69,10 @@ def run(target: Union[Deployment, List[Deployment]], *,
         }
         ray_tpu.get(controller.deploy.remote(
             dep.name, cloudpickle.dumps(dep.func_or_class), cfg,
-            cloudpickle.dumps((dep.init_args, dep.init_kwargs))), timeout=600)
+            cloudpickle.dumps((init_args, init_kwargs))), timeout=600)
+
+    for dep in deployments:
+        deploy(dep)
     if http:
         start_http_proxy(port=http_port)
     return DeploymentHandle(deployments[0].name)

@@ -24,7 +24,12 @@ class DeploymentResponse:
         self._on_done = on_done
         self._done = False
 
-    def result(self, timeout: Optional[float] = 60.0):
+    def result(self, timeout: Optional[float] = 60.0,
+               timeout_s: Optional[float] = None):
+        """`timeout_s` is the reference's spelling (DeploymentResponse
+        .result(timeout_s=...)); both names are accepted."""
+        if timeout_s is not None:
+            timeout = timeout_s
         try:
             return ray_tpu.get(self._ref, timeout=timeout)
         finally:

@@ -117,3 +117,33 @@ def test_llm_deployment_completions(cluster):
     out2 = handle.remote({"prompt": [1, 2, 3], "max_tokens": 5}).result(
         timeout=300)
     assert out2["choices"][0]["token_ids"] == out["choices"][0]["token_ids"]
+
+
+@serve.deployment
+class Preprocessor:
+    def __call__(self, text):
+        return text.strip().lower()
+
+
+@serve.deployment
+class Composed:
+    """Model composition: child deployments bound as init args arrive as
+    DeploymentHandles (reference: serve deployment graphs)."""
+
+    def __init__(self, pre, greeter):
+        self.pre = pre
+        self.greeter = greeter
+
+    def __call__(self, text):
+        cleaned = self.pre.remote(text).result(timeout_s=60)
+        return self.greeter.remote(cleaned).result(timeout_s=60)
+
+
+def test_model_composition(cluster):
+    app = Composed.bind(Preprocessor.bind(),
+                        Greeter.options(name="inner_greet").bind("yo"))
+    handle = serve.run(app)
+    assert handle.remote("  World  ").result(timeout_s=60) == "yo world"
+    # The children deployed too (visible in status).
+    names = {d["name"] for d in serve.status()}
+    assert {"Composed", "Preprocessor", "inner_greet"} <= names
