@@ -3,6 +3,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     get_deployment_handle,
     run,
     shutdown,
+    start_grpc_proxy,
     start_http_proxy,
     status,
 )

@@ -15,6 +15,7 @@ from ray_tpu.serve.handle import DeploymentHandle
 
 _controller = None
 _proxy = None
+_grpc_proxy = None
 
 
 def _get_controller():
@@ -103,10 +104,29 @@ def start_http_proxy(port: int = 0):
     return tuple(ray_tpu.get(_proxy.address.remote(), timeout=60))
 
 
+def start_grpc_proxy(port: int = 0):
+    """Start (or return) the gRPC ingress (reference: proxy.py:532
+    gRPCProxy); returns (host, port). See serve/grpc_proxy.py for the
+    generic JSON-over-bytes service contract."""
+    global _grpc_proxy
+    if _grpc_proxy is None:
+        from ray_tpu.serve.grpc_proxy import GrpcProxy
+
+        Proxy = ray_tpu.remote(GrpcProxy)
+        _grpc_proxy = Proxy.options(name="SERVE_GRPC_PROXY").remote(port=port)
+    return tuple(ray_tpu.get(_grpc_proxy.address.remote(), timeout=60))
+
+
 def shutdown():
-    global _controller, _proxy
+    global _controller, _proxy, _grpc_proxy
     for name in [d["name"] for d in status()]:
         delete(name)
+    if _grpc_proxy is not None:
+        try:
+            ray_tpu.kill(_grpc_proxy)
+        except Exception:
+            pass
+        _grpc_proxy = None
     if _proxy is not None:
         try:
             ray_tpu.kill(_proxy)

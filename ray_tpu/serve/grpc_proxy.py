@@ -1,0 +1,121 @@
+"""gRPC ingress for serve.
+
+Reference analog: python/ray/serve/_private/proxy.py:532 gRPCProxy. The
+reference compiles user protos; ours exposes a fixed generic service
+(`ray_tpu.serve.ServeAPI`) with JSON-over-bytes messages so no protoc step
+is needed:
+
+  * Predict       (unary-unary):  request bytes = JSON
+        {"deployment": str, "method": str = "__call__", "payload": any}
+    reply bytes = JSON {"result": any} or {"error": str}
+  * PredictStream (unary-stream): same request; the replica method must
+    return a generator; each yielded item streams back as one JSON frame
+    (the reference's streaming path over ReportGeneratorItemReturns).
+
+Runs as a plain object inside the proxy actor process next to the HTTP
+proxy, sharing the DeploymentHandle routing (power-of-two replica choice).
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures as _futures
+from typing import Dict
+
+SERVICE = "ray_tpu.serve.ServeAPI"
+
+
+class GrpcProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import grpc
+
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        self._handles: Dict[tuple, DeploymentHandle] = {}
+
+        def handle_for(deployment: str, method: str) -> DeploymentHandle:
+            key = (deployment, method)
+            if key not in self._handles:
+                self._handles[key] = DeploymentHandle(deployment, method)
+            return self._handles[key]
+
+        def _parse(request: bytes):
+            req = json.loads(request)
+            return (req["deployment"], req.get("method", "__call__"),
+                    req.get("payload"), float(req.get("timeout", 300.0)))
+
+        def predict(request: bytes, context) -> bytes:
+            try:
+                deployment, method, payload, timeout = _parse(request)
+                result = handle_for(deployment, method).remote(
+                    payload).result(timeout=timeout)
+                return json.dumps({"result": result}, default=repr).encode()
+            except Exception as e:  # noqa: BLE001 — errors ride the reply
+                return json.dumps({"error": repr(e)}).encode()
+
+        def predict_stream(request: bytes, context):
+            try:
+                deployment, method, payload, timeout = _parse(request)
+                gen = handle_for(deployment, method).remote_stream(payload)
+                import ray_tpu
+
+                for ref in gen:
+                    item = ray_tpu.get(ref, timeout=timeout)
+                    yield json.dumps({"item": item}, default=repr).encode()
+            except Exception as e:  # noqa: BLE001
+                yield json.dumps({"error": repr(e)}).encode()
+
+        handler = grpc.method_handlers_generic_handler(SERVICE, {
+            "Predict": grpc.unary_unary_rpc_method_handler(predict),
+            "PredictStream": grpc.unary_stream_rpc_method_handler(
+                predict_stream),
+        })
+        self._server = grpc.server(
+            _futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+        self._server.start()
+
+    def address(self):
+        return (self.host, self.port)
+
+    def stop(self):
+        self._server.stop(grace=1.0)
+
+
+class GrpcServeClient:
+    """Minimal client for the generic service (tests / SDK)."""
+
+    def __init__(self, address: str):
+        import grpc
+
+        self._channel = grpc.insecure_channel(address)
+        self._predict = self._channel.unary_unary(f"/{SERVICE}/Predict")
+        self._stream = self._channel.unary_stream(f"/{SERVICE}/PredictStream")
+
+    def predict(self, deployment: str, payload, method: str = "__call__",
+                timeout: float = 300.0):
+        # The timeout rides the request too: the server bounds its backend
+        # wait with it, so the client deadline governs end to end.
+        reply = json.loads(self._predict(
+            json.dumps({"deployment": deployment, "method": method,
+                        "payload": payload, "timeout": timeout}).encode(),
+            timeout=timeout))
+        if "error" in reply:
+            raise RuntimeError(reply["error"])
+        return reply["result"]
+
+    def predict_stream(self, deployment: str, payload,
+                       method: str = "__call__", timeout: float = 300.0):
+        for frame in self._stream(
+                json.dumps({"deployment": deployment, "method": method,
+                            "payload": payload,
+                            "timeout": timeout}).encode(), timeout=timeout):
+            item = json.loads(frame)
+            if "error" in item:
+                raise RuntimeError(item["error"])
+            yield item["item"]
+
+    def close(self):
+        self._channel.close()

@@ -1,4 +1,12 @@
 from ray_tpu.train.backend import allreduce_gradients  # noqa: F401
+from ray_tpu.train.callbacks import (  # noqa: F401
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    MlflowLoggerCallback,
+    TensorBoardLoggerCallback,
+    WandbLoggerCallback,
+)
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager  # noqa: F401
 from ray_tpu.train.config import (  # noqa: F401
     CheckpointConfig,

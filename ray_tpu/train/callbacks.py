@@ -1,0 +1,195 @@
+"""Run callbacks + experiment-tracking integrations.
+
+Reference analog: python/ray/air/integrations/{wandb,mlflow,comet}.py and
+tune's LoggerCallback family — result hooks fired by the run controller,
+with adapters for external trackers. Offline-first: the JSON and CSV
+loggers always work; TensorBoard uses torch's bundled SummaryWriter;
+wandb/mlflow adapters import lazily and raise a clear error when the
+library is absent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+
+class Callback:
+    """Hooks fired by TrainController (and Tune trials via
+    tune_integration): override any subset."""
+
+    def on_run_start(self, run_name: str, path: str) -> None:
+        pass
+
+    def on_result(self, metrics: Dict, iteration: int) -> None:
+        pass
+
+    def on_checkpoint(self, checkpoint_path: str, metrics: Dict) -> None:
+        pass
+
+    def on_run_end(self, result) -> None:
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]]):
+        self._callbacks = list(callbacks or [])
+
+    def fire(self, hook: str, *args) -> None:
+        for cb in self._callbacks:
+            try:
+                getattr(cb, hook)(*args)
+            except Exception:
+                if hook == "on_run_start":
+                    # Setup failures (missing wandb/mlflow, bad tracking
+                    # URI) must fail FAST — swallowing them silently
+                    # disables tracking for the whole run.
+                    raise
+                # Per-result/end hooks must never fail the run itself.
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "callback %r failed in %s", cb, hook)
+
+
+class JsonLoggerCallback(Callback):
+    """result.json: one JSON line per reported result (tune's json logger)."""
+
+    def __init__(self):
+        self._f = None
+
+    def on_run_start(self, run_name, path):
+        os.makedirs(path, exist_ok=True)
+        self._f = open(os.path.join(path, "result.json"), "a")
+
+    def on_result(self, metrics, iteration):
+        if self._f is None:
+            return
+        rec = {"iteration": iteration, "time": time.time(), **metrics}
+        self._f.write(json.dumps(rec, default=repr) + "\n")
+        self._f.flush()
+
+    def on_run_end(self, result):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class CSVLoggerCallback(Callback):
+    """progress.csv with a header from the first result's keys."""
+
+    def __init__(self):
+        self._f = None
+        self._keys: Optional[List[str]] = None
+
+    def on_run_start(self, run_name, path):
+        os.makedirs(path, exist_ok=True)
+        target = os.path.join(path, "progress.csv")
+        # Resumed run (same name/dir): reuse the existing header so appended
+        # rows keep the column layout instead of a second mid-file header.
+        if os.path.exists(target) and os.path.getsize(target) > 0:
+            with open(target) as f:
+                self._keys = f.readline().strip().split(",")
+        self._f = open(target, "a")
+
+    def on_result(self, metrics, iteration):
+        if self._f is None:
+            return
+        if self._keys is None:
+            self._keys = ["iteration"] + sorted(metrics)
+            self._f.write(",".join(self._keys) + "\n")
+        row = {"iteration": iteration, **metrics}
+        self._f.write(",".join(str(row.get(k, "")) for k in self._keys) + "\n")
+        self._f.flush()
+
+    def on_run_end(self, result):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class TensorBoardLoggerCallback(Callback):
+    """Scalar metrics to TensorBoard event files (torch SummaryWriter)."""
+
+    def __init__(self):
+        self._writer = None
+
+    def on_run_start(self, run_name, path):
+        from torch.utils.tensorboard import SummaryWriter
+
+        self._writer = SummaryWriter(log_dir=os.path.join(path, "tb"))
+
+    def on_result(self, metrics, iteration):
+        if self._writer is None:
+            return
+        for k, v in metrics.items():
+            if isinstance(v, (int, float)):
+                self._writer.add_scalar(k, v, iteration)
+        self._writer.flush()
+
+    def on_run_end(self, result):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+class WandbLoggerCallback(Callback):
+    """Weights & Biases adapter (air/integrations/wandb.py analog)."""
+
+    def __init__(self, project: str, **init_kwargs):
+        self.project = project
+        self.init_kwargs = init_kwargs
+        self._run = None
+
+    def on_run_start(self, run_name, path):
+        try:
+            import wandb
+        except ImportError as e:
+            raise ImportError(
+                "WandbLoggerCallback requires the `wandb` package") from e
+        self._run = wandb.init(project=self.project, name=run_name,
+                               dir=path, **self.init_kwargs)
+
+    def on_result(self, metrics, iteration):
+        if self._run is not None:
+            self._run.log(metrics, step=iteration)
+
+    def on_run_end(self, result):
+        if self._run is not None:
+            self._run.finish()
+            self._run = None
+
+
+class MlflowLoggerCallback(Callback):
+    """MLflow adapter (air/integrations/mlflow.py analog)."""
+
+    def __init__(self, experiment_name: str = "ray_tpu",
+                 tracking_uri: Optional[str] = None):
+        self.experiment_name = experiment_name
+        self.tracking_uri = tracking_uri
+        self._mlflow = None
+
+    def on_run_start(self, run_name, path):
+        try:
+            import mlflow
+        except ImportError as e:
+            raise ImportError(
+                "MlflowLoggerCallback requires the `mlflow` package") from e
+        self._mlflow = mlflow
+        if self.tracking_uri:
+            mlflow.set_tracking_uri(self.tracking_uri)
+        mlflow.set_experiment(self.experiment_name)
+        mlflow.start_run(run_name=run_name)
+
+    def on_result(self, metrics, iteration):
+        if self._mlflow is not None:
+            self._mlflow.log_metrics(
+                {k: v for k, v in metrics.items()
+                 if isinstance(v, (int, float))}, step=iteration)
+
+    def on_run_end(self, result):
+        if self._mlflow is not None:
+            self._mlflow.end_run()
+            self._mlflow = None

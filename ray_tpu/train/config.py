@@ -50,6 +50,9 @@ class RunConfig:
     checkpoint_config: CheckpointConfig = dataclasses.field(
         default_factory=CheckpointConfig)
     failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    # Result/lifecycle hooks (train/callbacks.py: Json/CSV/TensorBoard/
+    # Wandb/Mlflow loggers, or user Callback subclasses).
+    callbacks: Optional[list] = None
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -47,6 +47,9 @@ class TrainController:
             ckpt_cfg.checkpoint_score_attribute, ckpt_cfg.checkpoint_score_order)
         self.latest_metrics: Dict = {}
         self.metrics_history: List[Dict] = []
+        from ray_tpu.train.callbacks import CallbackList
+
+        self.callbacks = CallbackList(run_config.callbacks)
 
     @staticmethod
     def _available_resources() -> Dict[str, float]:
@@ -58,16 +61,28 @@ class TrainController:
             return {}
 
     def run(self, poll_interval: Optional[float] = None) -> Result:
+        from ray_tpu.config import cfg
+
+        poll_interval = poll_interval or cfg().train_poll_interval_s
+        world = self.scaling_policy.initial_workers(
+            self.scaling, self._available_resources())
+        self.callbacks.fire("on_run_start", self.run_name, self.storage_path)
+        self._final_result = None
+        try:
+            return self._run_attempts(poll_interval, world)
+        finally:
+            # Fires on EVERY exit (normal, error result, exception,
+            # KeyboardInterrupt): trackers must end their runs and loggers
+            # close their files.
+            self.callbacks.fire("on_run_end", self._final_result)
+
+    def _run_attempts(self, poll_interval: float, world: int) -> Result:
         import dataclasses as _dc
 
-        from ray_tpu.config import cfg
         from ray_tpu.train.elastic import FailureDecision
         from ray_tpu.train.worker_group import WorkerGroup
 
-        poll_interval = poll_interval or cfg().train_poll_interval_s
         attempt = 0
-        world = self.scaling_policy.initial_workers(
-            self.scaling, self._available_resources())
         while True:
             attempt += 1
             scaling = _dc.replace(self.scaling, num_workers=world)
@@ -85,10 +100,12 @@ class TrainController:
             finally:
                 group.shutdown()
             if error is None:
-                return Result(metrics=self.latest_metrics,
-                              checkpoint=self.ckpt_manager.latest_checkpoint,
-                              best_checkpoints=None, path=self.storage_path,
-                              metrics_dataframe=self.metrics_history, error=None)
+                self._final_result = Result(
+                    metrics=self.latest_metrics,
+                    checkpoint=self.ckpt_manager.latest_checkpoint,
+                    best_checkpoints=None, path=self.storage_path,
+                    metrics_dataframe=self.metrics_history, error=None)
+                return self._final_result
             if error == _RESIZE:
                 # Controlled elastic restart: resume from the latest
                 # checkpoint at the new world size (ScalingPolicy analog).
@@ -104,11 +121,12 @@ class TrainController:
                 logger.warning("train run %s failed (%s); restarting with "
                                "%d workers", self.run_name, error, world)
                 continue
-            return Result(metrics=self.latest_metrics,
-                          checkpoint=self.ckpt_manager.latest_checkpoint,
-                          best_checkpoints=None, path=self.storage_path,
-                          metrics_dataframe=self.metrics_history,
-                          error=error)
+            self._final_result = Result(
+                metrics=self.latest_metrics,
+                checkpoint=self.ckpt_manager.latest_checkpoint,
+                best_checkpoints=None, path=self.storage_path,
+                metrics_dataframe=self.metrics_history, error=error)
+            return self._final_result
 
     def _poll_until_done(self, group, poll_interval: float,
                          world: int) -> Optional[str]:
@@ -138,9 +156,14 @@ class TrainController:
                         metrics = item["metrics"]
                         self.latest_metrics = metrics
                         self.metrics_history.append(metrics)
+                        self.callbacks.fire("on_result", metrics,
+                                            len(self.metrics_history))
                         if item.get("checkpoint_path"):
                             self.ckpt_manager.register(item["checkpoint_path"],
                                                        metrics)
+                            self.callbacks.fire(
+                                "on_checkpoint", item["checkpoint_path"],
+                                metrics)
             errors = [p["error"] for p in polls if p["error"]]
             if errors:
                 return errors[0]

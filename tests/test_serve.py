@@ -147,3 +147,39 @@ def test_model_composition(cluster):
     # The children deployed too (visible in status).
     names = {d["name"] for d in serve.status()}
     assert {"Composed", "Preprocessor", "inner_greet"} <= names
+
+
+def test_grpc_proxy(cluster):
+    from ray_tpu.serve.grpc_proxy import GrpcServeClient
+
+    serve.run(Greeter.options(name="grpc_greet").bind("hola"))
+    host, port = serve.start_grpc_proxy()
+    client = GrpcServeClient(f"{host}:{port}")
+    try:
+        assert client.predict("grpc_greet", "mundo") == "hola mundo"
+        assert client.predict("grpc_greet", "mundo",
+                              method="shout") == "HOLA MUNDO"
+        with pytest.raises(RuntimeError):
+            client.predict("no_such_deployment", "x", timeout=30)
+    finally:
+        client.close()
+
+
+@serve.deployment
+class TokenStreamer:
+    def __call__(self, n):
+        for i in range(int(n)):
+            yield f"tok{i}"
+
+
+def test_grpc_proxy_streaming(cluster):
+    from ray_tpu.serve.grpc_proxy import GrpcServeClient
+
+    serve.run(TokenStreamer.bind())
+    host, port = serve.start_grpc_proxy()
+    client = GrpcServeClient(f"{host}:{port}")
+    try:
+        items = list(client.predict_stream("TokenStreamer", 4))
+        assert items == ["tok0", "tok1", "tok2", "tok3"]
+    finally:
+        client.close()

@@ -154,3 +154,52 @@ def test_checkpoint_manager_topk(tmp_path):
     # Only top-2 kept on disk.
     kept = [d for d in os.listdir(tmp_path / "run") if d.startswith("checkpoint")]
     assert len(kept) == 2
+
+
+def test_logger_callbacks(cluster, tmp_path):
+    """Json/CSV/TensorBoard loggers receive results (air integrations
+    analog); custom callbacks see every hook."""
+    import json
+
+    import ray_tpu.train as train
+    from ray_tpu.train.callbacks import (
+        Callback, CSVLoggerCallback, JsonLoggerCallback,
+        TensorBoardLoggerCallback)
+
+    events = []
+
+    class Probe(Callback):
+        def on_run_start(self, run_name, path):
+            events.append(("start", run_name))
+
+        def on_result(self, metrics, iteration):
+            events.append(("result", iteration, metrics["loss"]))
+
+        def on_run_end(self, result):
+            events.append(("end", result.error))
+
+    def loop(config):
+        for i in range(3):
+            train.report({"loss": 1.0 / (i + 1), "step": i})
+
+    trainer = train.DataParallelTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=2),
+        run_config=train.RunConfig(
+            name="cb_run", storage_path=str(tmp_path),
+            callbacks=[Probe(), JsonLoggerCallback(), CSVLoggerCallback(),
+                       TensorBoardLoggerCallback()]))
+    result = trainer.fit()
+    assert result.error is None
+    assert events[0] == ("start", "cb_run")
+    assert events[-1] == ("end", None)
+    assert sum(1 for e in events if e[0] == "result") == 3
+
+    import os
+
+    run_dir = os.path.join(str(tmp_path), "cb_run")
+    with open(os.path.join(run_dir, "result.json")) as f:
+        lines = [json.loads(ln) for ln in f]
+    assert len(lines) == 3 and lines[-1]["loss"] == pytest.approx(1 / 3)
+    assert os.path.exists(os.path.join(run_dir, "progress.csv"))
+    assert os.listdir(os.path.join(run_dir, "tb"))
