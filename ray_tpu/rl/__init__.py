@@ -1,4 +1,5 @@
 from ray_tpu.rl.algorithm import PPO, EnvRunner  # noqa: F401
+from ray_tpu.rl.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rl.connectors import (  # noqa: F401
     Connector,
     ConnectorPipeline,

@@ -1,0 +1,47 @@
+"""APPO: asynchronous PPO on the IMPALA actor-learner substrate.
+
+Reference analog: rllib/algorithms/appo/ — IMPALA's async rollout pipeline
+(stale-weights runners, V-trace off-policy correction) combined with PPO's
+clipped surrogate objective instead of the plain policy-gradient loss.
+Reuses ImpalaRunner, the async dispatch loop, and the shared V-trace loss
+prelude (impala.vtrace_prelude); only the policy-gradient term differs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ray_tpu.rl import impala as impala_mod
+from ray_tpu.rl.impala import IMPALA, ImpalaConfig
+
+
+@dataclass
+class APPOConfig(ImpalaConfig):
+    clip_eps: float = 0.3                # PPO surrogate clip
+
+
+def make_update_fn(config: APPOConfig, optimizer):
+    def clipped_surrogate(target_logp, behaviour_logp, adv):
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        # Clip against the BEHAVIOUR policy: the rollout was collected
+        # with stale weights (appo's is_ratio).
+        ratio = jnp.exp(target_logp - behaviour_logp)
+        clipped = jnp.clip(ratio, 1.0 - config.clip_eps,
+                           1.0 + config.clip_eps)
+        pg_loss = -jnp.minimum(ratio * adv, clipped * adv).mean()
+        clip_frac = (jnp.abs(ratio - 1.0) > config.clip_eps).mean()
+        return pg_loss, {"clip_frac": clip_frac}
+
+    return impala_mod.make_update_fn(config, optimizer,
+                                     pg_loss_fn=clipped_surrogate)
+
+
+class APPO(IMPALA):
+    """IMPALA's pipeline with the PPO surrogate update."""
+
+    def __init__(self, config: APPOConfig):
+        super().__init__(config)
+        # Replace the IMPALA update with the clipped-surrogate one.
+        self.update_fn = make_update_fn(config, self.optimizer)

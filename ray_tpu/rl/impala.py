@@ -61,27 +61,44 @@ def vtrace(behaviour_logp, target_logp, rewards, values, dones, last_value,
     return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(advantages)
 
 
-def make_update_fn(config: ImpalaConfig, optimizer):
+def vtrace_prelude(params, batch, config):
+    """Shared forward + V-trace scaffolding for IMPALA-family losses
+    (IMPALA's plain PG, APPO's clipped surrogate). Returns
+    (target_logp, logp_all, values, vs, adv)."""
+    T, B = batch["rewards"].shape
+    obs = batch["obs"].reshape(T * B, -1)
+    logits, values_flat = ppo_mod.policy_forward(params, obs)
+    logits = logits.reshape(T, B, -1)
+    values = values_flat.reshape(T, B)
+    logp_all = jax.nn.log_softmax(logits)
+    target_logp = jnp.take_along_axis(
+        logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+    _, last_value = ppo_mod.policy_forward(params, batch["last_obs"])
+    vs, adv = vtrace(batch["behaviour_logp"], target_logp,
+                     batch["rewards"], values, batch["dones"], last_value,
+                     config.gamma, config.rho_clip, config.c_clip)
+    return target_logp, logp_all, values, vs, adv
+
+
+def make_update_fn(config: ImpalaConfig, optimizer, pg_loss_fn=None):
+    """`pg_loss_fn(target_logp, behaviour_logp, adv) -> (loss, extra_metrics)`
+    swaps the policy-gradient term (APPO passes the clipped surrogate)."""
+
     def loss_fn(params, batch):
-        T, B = batch["rewards"].shape
-        obs = batch["obs"].reshape(T * B, -1)
-        logits, values_flat = ppo_mod.policy_forward(params, obs)
-        logits = logits.reshape(T, B, -1)
-        values = values_flat.reshape(T, B)
-        logp_all = jax.nn.log_softmax(logits)
-        target_logp = jnp.take_along_axis(
-            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
-        _, last_value = ppo_mod.policy_forward(params, batch["last_obs"])
-        vs, adv = vtrace(batch["behaviour_logp"], target_logp,
-                         batch["rewards"], values, batch["dones"], last_value,
-                         config.gamma, config.rho_clip, config.c_clip)
-        pg_loss = -(jax.lax.stop_gradient(adv) * target_logp).mean()
+        target_logp, logp_all, values, vs, adv = vtrace_prelude(
+            params, batch, config)
+        if pg_loss_fn is None:
+            pg_loss = -(jax.lax.stop_gradient(adv) * target_logp).mean()
+            extra = {}
+        else:
+            pg_loss, extra = pg_loss_fn(target_logp,
+                                        batch["behaviour_logp"], adv)
         vf_loss = ((values - vs) ** 2).mean()
         entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
         total = pg_loss + config.vf_coef * vf_loss \
             - config.entropy_coef * entropy
         return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
-                       "entropy": entropy}
+                       "entropy": entropy, **extra}
 
     @jax.jit
     def update(params, opt_state, batch):

@@ -1,5 +1,6 @@
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
+    HyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,

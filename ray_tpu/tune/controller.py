@@ -204,6 +204,13 @@ class TuneController:
                 elif poll["finished"]:
                     trial.status = TERMINATED
                     self._kill(trial)
+                if trial.status in (TERMINATED, ERRORED):
+                    # Cohort-tracking schedulers (HyperBand) must stop
+                    # waiting on this trial's rung results.
+                    try:
+                        self.scheduler.on_trial_complete(trial.trial_id)
+                    except Exception:
+                        logger.exception("scheduler on_trial_complete failed")
                 if (self.searcher is not None
                         and trial.status in (TERMINATED, ERRORED)):
                     try:

@@ -19,6 +19,10 @@ class TrialScheduler:
     def on_result(self, trial_id: str, result: Dict) -> str:
         return CONTINUE
 
+    def on_trial_complete(self, trial_id: str) -> None:
+        """Trial finished, errored, or was stopped: schedulers tracking
+        cohorts (HyperBand) must not wait on it any longer."""
+
     def exploit_target(self, trial_id: str):
         return None
 
@@ -68,6 +72,102 @@ class ASHAScheduler(TrialScheduler):
                     bad = value < cutoff if self.mode == "max" else value > cutoff
                     if bad:
                         return STOP
+        return CONTINUE
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference: schedulers/hyperband.py): trials
+    are assigned round-robin to brackets with different (budget, halving)
+    trade-offs; within a bracket, successive halving keeps the top
+    1/reduction_factor at each rung. Unlike ASHA, halving decisions wait
+    for the whole rung cohort, so no trial is stopped on a partial view."""
+
+    def __init__(self, metric: str, mode: str = "max", *,
+                 max_t: int = 81, reduction_factor: int = 3,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        s_max = int(math.log(max_t) / math.log(reduction_factor))
+        # Bracket i starts trials at budget max_t * rf^-(s_max - i).
+        self.brackets: List[Dict] = []
+        for s in range(s_max, -1, -1):
+            r0 = max(1, int(max_t * self.rf ** (-s)))
+            milestones = []
+            t = r0
+            while t < max_t:
+                milestones.append(t)
+                t *= self.rf
+            self.brackets.append({"milestones": milestones,
+                                  "rungs": {}, "trials": set()})
+        self._assign: Dict[str, int] = {}
+        self._next_bracket = 0
+        self._decided: Dict[tuple, str] = {}
+
+    def _bracket_of(self, trial_id: str) -> Dict:
+        if trial_id not in self._assign:
+            self._assign[trial_id] = self._next_bracket
+            self.brackets[self._next_bracket]["trials"].add(trial_id)
+            self._next_bracket = (self._next_bracket + 1) % len(self.brackets)
+        return self.brackets[self._assign[trial_id]]
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        b = self._assign.get(trial_id)
+        if b is None:
+            return
+        bracket = self.brackets[b]
+        bracket["trials"].discard(trial_id)
+        # A shrunken cohort may now be complete at some rung: re-evaluate so
+        # the survivors' deferred decisions exist for their next report.
+        for milestone in bracket["milestones"]:
+            rung = bracket["rungs"].get(milestone)
+            if rung:
+                rung.pop(trial_id, None)
+                self._maybe_halve(b, milestone)
+
+    def _maybe_halve(self, bracket_idx: int, milestone: int) -> None:
+        bracket = self.brackets[bracket_idx]
+        rung = bracket["rungs"].get(milestone, {})
+        cohort = bracket["trials"]
+        waiting = [tid for tid in cohort if tid not in rung]
+        if not rung or waiting:
+            return  # synchronous: wait for every live trial in the cohort
+        keep = max(1, len(rung) // self.rf)
+        ranked = sorted(rung, key=rung.get, reverse=(self.mode == "max"))
+        survivors = set(ranked[:keep])
+        for tid in list(rung):
+            decision = CONTINUE if tid in survivors else STOP
+            self._decided[(bracket_idx, milestone, tid)] = decision
+            if decision == STOP:
+                cohort.discard(tid)
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        bracket = self._bracket_of(trial_id)
+        b = self._assign[trial_id]
+        # A halving decided after this trial passed the rung (it reported
+        # early, or the cohort completed via on_trial_complete) is delivered
+        # at its NEXT report.
+        for milestone in bracket["milestones"]:
+            if milestone <= t and self._decided.get(
+                    (b, milestone, trial_id)) == STOP:
+                return STOP
+        for milestone in bracket["milestones"]:
+            if t == milestone:
+                rung = bracket["rungs"].setdefault(milestone, {})
+                rung[trial_id] = float(value)
+                self._maybe_halve(b, milestone)
+                decision = self._decided.get((b, milestone, trial_id))
+                if decision is not None:
+                    return decision
         return CONTINUE
 
 

@@ -104,3 +104,18 @@ def test_impala_trains(cluster):
         assert result["num_env_steps"] == 4 * 32 * 2
     finally:
         algo.stop()
+
+
+def test_appo_trains(cluster):
+    from ray_tpu.rl.appo import APPO, APPOConfig
+
+    algo = APPO(APPOConfig(num_env_runners=2, envs_per_runner=2,
+                           rollout_length=32))
+    try:
+        for _ in range(4):
+            result = algo.train()
+        assert result["training_iteration"] == 4
+        assert np.isfinite(result["pg_loss"])
+        assert 0.0 <= result["clip_frac"] <= 1.0
+    finally:
+        algo.stop()

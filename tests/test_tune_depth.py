@@ -120,3 +120,55 @@ def test_median_stopping_rule():
         c = rule.on_result("c", {"acc": 0.1, "training_iteration": step})
     assert a == CONTINUE and b == CONTINUE
     assert c == STOP
+
+
+def test_hyperband_scheduler_halves_cohorts():
+    """Synchronous HyperBand: only the top 1/rf of a rung cohort survives.
+    Decisions reached after a trial passed the rung (it reported before the
+    cohort filled) are delivered at that trial's NEXT report."""
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler
+
+    sched = HyperBandScheduler("score", mode="max", max_t=9,
+                               reduction_factor=3)
+    # Force all trials into bracket 0 (milestones [1, 3]).
+    sched._next_bracket = 0
+    sched.brackets = [sched.brackets[0]]
+    # t0 reports milestone 1 while alone in the cohort: solo-halved, survives
+    # provisionally.
+    assert sched.on_result("t0", {"training_iteration": 1,
+                                  "score": 0.1}) == CONTINUE
+    assert sched.on_result("t1", {"training_iteration": 1,
+                                  "score": 0.5}) == CONTINUE
+    # t2 completes the cohort and wins; its decision is immediate.
+    assert sched.on_result("t2", {"training_iteration": 1,
+                                  "score": 0.9}) == CONTINUE
+    # The losers learn their fate at their NEXT report (iteration 2).
+    assert sched.on_result("t0", {"training_iteration": 2,
+                                  "score": 0.1}) == STOP
+    assert sched.on_result("t1", {"training_iteration": 2,
+                                  "score": 0.5}) == STOP
+    # max_t reached stops unconditionally.
+    assert sched.on_result("t2", {"training_iteration": 9,
+                                  "score": 1.0}) == STOP
+
+
+def test_hyperband_completed_trial_unblocks_cohort():
+    """A trial that errors/finishes leaves its cohort (on_trial_complete),
+    so the rung halves with the remaining trials instead of deadlocking."""
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler
+
+    sched = HyperBandScheduler("score", mode="max", max_t=9,
+                               reduction_factor=3)
+    sched._next_bracket = 0
+    sched.brackets = [sched.brackets[0]]
+    for tid, score in [("a", 0.2), ("b", 0.8), ("c", 0.5)]:
+        sched.on_result(tid, {"training_iteration": 1, "score": score})
+    # All three proceed past milestone 1 (b won); c errors before rung 3.
+    assert sched.on_result("b", {"training_iteration": 3,
+                                 "score": 0.9}) == CONTINUE
+    sched.on_trial_complete("c")
+    # Cohort at rung 3 is now just {a, b}: a's report completes it.
+    a_decision = sched.on_result("a", {"training_iteration": 3,
+                                       "score": 0.1})
+    b_next = sched.on_result("b", {"training_iteration": 4, "score": 0.9})
+    assert a_decision == STOP and b_next == CONTINUE
