@@ -160,6 +160,12 @@ def cmd_timeline(args):
           "(open in chrome://tracing)")
 
 
+def cmd_microbenchmark(args):
+    from ray_tpu.util import microbenchmark
+
+    microbenchmark.main(scale=args.scale, as_json=args.json)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -215,6 +221,12 @@ def main(argv=None):
     p = sub.add_parser("stop")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("microbenchmark",
+                       help="core runtime ops/s (ray_perf.py analog)")
+    p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_microbenchmark)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -1,0 +1,134 @@
+"""Core-runtime microbenchmarks.
+
+Reference analog: python/ray/_private/ray_perf.py:93-315 (the `ray
+microbenchmark` CLI): put/get ops, task throughput sync/async, 1:1 and
+n:n actor call rates — the numbers the release pipeline tracks per build.
+Run via `python -m ray_tpu.scripts microbenchmark [--scale N]`.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List
+
+
+def _rate(n: int, seconds: float) -> float:
+    return n / max(seconds, 1e-9)
+
+
+def run(scale: float = 1.0, num_cpus: int = 4) -> List[Dict]:
+    import numpy as np
+
+    import ray_tpu
+
+    owns_cluster = not ray_tpu.is_initialized()
+    if owns_cluster:
+        ray_tpu.init(num_cpus=num_cpus)
+    results: List[Dict] = []
+
+    def record(name: str, n: int, seconds: float, unit: str = "ops/s"):
+        results.append({"benchmark": name, "value": round(_rate(n, seconds), 1),
+                        "unit": unit, "n": n})
+
+    try:
+        # -- object store ------------------------------------------------
+        n = int(1000 * scale)
+        t0 = time.perf_counter()
+        refs = [ray_tpu.put(i) for i in range(n)]
+        record("put_small_ops", n, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        ray_tpu.get(refs)
+        record("get_small_ops", n, time.perf_counter() - t0)
+        del refs
+
+        m = max(4, int(16 * scale))
+        payload = np.zeros(1 << 20, dtype=np.uint8)  # 1 MiB
+        t0 = time.perf_counter()
+        big = [ray_tpu.put(payload) for _ in range(m)]
+        dt = time.perf_counter() - t0
+        results.append({"benchmark": "put_1mib_gbps",
+                        "value": round(m / (1 << 10) / max(dt, 1e-9), 3),
+                        "unit": "GiB/s", "n": m})
+        t0 = time.perf_counter()
+        ray_tpu.get(big)
+        dt = time.perf_counter() - t0
+        results.append({"benchmark": "get_1mib_gbps",
+                        "value": round(m / (1 << 10) / max(dt, 1e-9), 3),
+                        "unit": "GiB/s", "n": m})
+        del big
+
+        # -- tasks -------------------------------------------------------
+        @ray_tpu.remote
+        def nop():
+            return None
+
+        # Warm the WHOLE worker pool (a single probe task would leave the
+        # batch benchmarks measuring process-spawn ramp, not steady state).
+        ray_tpu.get([nop.remote() for _ in range(num_cpus * 8)], timeout=300)
+        n = int(100 * scale)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ray_tpu.get(nop.remote(), timeout=120)
+        record("tasks_sync", n, time.perf_counter() - t0)
+
+        n = int(500 * scale)
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n)], timeout=300)
+        record("tasks_async_batch", n, time.perf_counter() - t0)
+
+        # -- actors ------------------------------------------------------
+        @ray_tpu.remote
+        class Actor:
+            def noop(self):
+                return None
+
+        a = Actor.remote()
+        ray_tpu.get(a.noop.remote(), timeout=120)
+        n = int(200 * scale)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ray_tpu.get(a.noop.remote(), timeout=120)
+        record("actor_calls_sync_1_1", n, time.perf_counter() - t0)
+
+        n = int(1000 * scale)
+        t0 = time.perf_counter()
+        ray_tpu.get([a.noop.remote() for _ in range(n)], timeout=300)
+        record("actor_calls_async_1_1", n, time.perf_counter() - t0)
+
+        workers = [Actor.remote() for _ in range(4)]
+        for w in workers:
+            ray_tpu.get(w.noop.remote(), timeout=120)
+        n = int(250 * scale)
+        t0 = time.perf_counter()
+        ray_tpu.get([w.noop.remote() for w in workers for _ in range(n)],
+                    timeout=300)
+        record("actor_calls_async_n_n", n * len(workers),
+               time.perf_counter() - t0)
+        # Benchmark actors must not outlive the run on a shared cluster.
+        for actor in [a, *workers]:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+    finally:
+        if owns_cluster:
+            ray_tpu.shutdown()
+    return results
+
+
+def main(scale: float = 1.0, as_json: bool = False) -> List[Dict]:
+    results = run(scale=scale)
+    if as_json:
+        print(json.dumps(results))
+    else:
+        width = max(len(r["benchmark"]) for r in results)
+        for r in results:
+            digits = 3 if r["unit"] == "GiB/s" else 1
+            print(f"{r['benchmark']:<{width}}  {r['value']:>12,.{digits}f} "
+                  f"{r['unit']} (n={r['n']})")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -104,3 +104,16 @@ def test_cli_drain_node(capsys):
         except Exception:
             pass
         cluster.shutdown()
+
+
+def test_microbenchmark_runs():
+    """`ray_tpu microbenchmark` (ray_perf.py analog) produces every core
+    metric with positive rates."""
+    from ray_tpu.util import microbenchmark
+
+    results = microbenchmark.run(scale=0.05, num_cpus=2)
+    names = {r["benchmark"] for r in results}
+    assert {"put_small_ops", "get_small_ops", "tasks_sync",
+            "tasks_async_batch", "actor_calls_async_1_1",
+            "actor_calls_async_n_n"} <= names
+    assert all(r["value"] > 0 for r in results)
