@@ -1,0 +1,120 @@
+"""Accelerator manager registry.
+
+Reference analog: python/ray/_private/accelerators/ — an ABC
+(accelerator.py) with one manager per vendor (tpu.py:70, nvidia_gpu.py, ...)
+resolving detection, visibility-env isolation, and per-node labels. This
+build is TPU-first: the TPU manager wraps runtime/resources.py; the GPU
+manager detects NVIDIA devices so mixed clusters schedule a "GPU" resource
+(compute on GPUs is out of scope — jax here targets TPU/CPU); new vendors
+register a subclass.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional, Tuple, Type
+
+
+class AcceleratorManager:
+    """One per accelerator family (accelerator.py ABC analog)."""
+
+    # The resource name this manager contributes, e.g. "TPU".
+    resource_name: str = ""
+
+    @staticmethod
+    def detect_count() -> int:
+        """Number of local devices (0 = family absent on this node)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def node_labels() -> Dict[str, str]:
+        """Scheduler-visible labels (topology, slice ids, ...)."""
+        return {}
+
+    @staticmethod
+    def visibility_env(device_ids: Tuple[int, ...]) -> Dict[str, str]:
+        """Env vars isolating a worker to `device_ids`."""
+        return {}
+
+
+class TPUAcceleratorManager(AcceleratorManager):
+    resource_name = "TPU"
+
+    @staticmethod
+    def detect_count() -> int:
+        from ray_tpu.runtime import resources
+
+        return resources.detect_tpu_chips()
+
+    @staticmethod
+    def node_labels() -> Dict[str, str]:
+        from ray_tpu.runtime import resources
+
+        return resources.tpu_slice_labels()
+
+    @staticmethod
+    def visibility_env(device_ids: Tuple[int, ...]) -> Dict[str, str]:
+        from ray_tpu.runtime import resources
+
+        return resources.visible_chip_env(device_ids)
+
+
+class NvidiaGPUAcceleratorManager(AcceleratorManager):
+    """Detection + isolation only (nvidia_gpu.py analog): lets mixed
+    clusters schedule a "GPU" resource; the compute path stays jax."""
+
+    resource_name = "GPU"
+
+    @staticmethod
+    def detect_count() -> int:
+        fake = os.environ.get("RAY_TPU_FAKE_GPUS")
+        if fake:
+            return int(fake)
+        visible = os.environ.get("CUDA_VISIBLE_DEVICES")
+        if visible is not None:
+            return len([d for d in visible.split(",") if d.strip() != ""])
+        return len(glob.glob("/dev/nvidia[0-9]*"))
+
+    @staticmethod
+    def visibility_env(device_ids: Tuple[int, ...]) -> Dict[str, str]:
+        return {"CUDA_VISIBLE_DEVICES": ",".join(map(str, device_ids))}
+
+
+_MANAGERS: List[Type[AcceleratorManager]] = [
+    TPUAcceleratorManager,
+    NvidiaGPUAcceleratorManager,
+]
+
+
+def register(manager: Type[AcceleratorManager]) -> None:
+    _MANAGERS.append(manager)
+
+
+def all_managers() -> List[Type[AcceleratorManager]]:
+    return list(_MANAGERS)
+
+
+def get_manager(resource_name: str) -> Optional[Type[AcceleratorManager]]:
+    for m in _MANAGERS:
+        if m.resource_name == resource_name:
+            return m
+    return None
+
+
+def detect_accelerators() -> Dict[str, float]:
+    """Every present accelerator family's {resource_name: count}."""
+    out: Dict[str, float] = {}
+    for m in _MANAGERS:
+        n = m.detect_count()
+        if n > 0:
+            out[m.resource_name] = float(n)
+    return out
+
+
+def accelerator_labels() -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    for m in _MANAGERS:
+        if m.detect_count() > 0:
+            labels.update(m.node_labels())
+    return labels

@@ -26,9 +26,13 @@ def detect_tpu_chips() -> int:
     accel = glob.glob("/dev/accel*")
     if accel:
         return len(accel)
-    vfio = glob.glob("/dev/vfio/[0-9]*")
-    if vfio:
-        return len(vfio)
+    # /dev/vfio nodes are NOT TPU-specific (GPU passthrough binds vfio-pci
+    # too): only trust them as chips when the environment says this host is
+    # part of a TPU pod/slice.
+    if detect_tpu_pod_type():
+        vfio = glob.glob("/dev/vfio/[0-9]*")
+        if vfio:
+            return len(vfio)
     return 0
 
 
@@ -80,6 +84,13 @@ def node_resources(num_cpus: Optional[float] = None,
             memory = 0
     if memory:
         out["memory"] = float(memory)
+    # Non-TPU accelerator families via the manager registry (GPU, plugins):
+    # TPU stays first-class above; others contribute when present.
+    from ray_tpu.runtime import accelerators as accel_mod
+
+    for name, n in accel_mod.detect_accelerators().items():
+        if name != "TPU" and name not in out:
+            out[name] = n
     for k, v in (resources or {}).items():
         out[k] = float(v)
     return out

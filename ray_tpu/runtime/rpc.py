@@ -6,9 +6,10 @@ frame over TCP; the programming model mirrors gRPC async services: named
 handlers on servers, awaitable calls on clients, plus server->client pushes
 for pubsub. Transport is swappable behind these two classes.
 
-Frame: [u32 length][pickle payload]
+Frame: [4-byte magic "RTP"+version][u32 length][pickle payload]
 Payload: (kind, msg_id, method, data)
   kind: 0 = request, 1 = reply, 2 = error reply, 3 = push (one-way)
+A bad magic drops the connection (ProtocolMismatch) before any pickle runs.
 """
 
 from __future__ import annotations
@@ -34,7 +35,14 @@ def _chaos_enabled() -> bool:
             ) or bool(os.environ.get("RAY_TPU_CHAOS"))
 
 
-_HDR = struct.Struct("<I")
+# Wire format (the protobuf-IDL analog, src/ray/protobuf/): every frame is
+# `magic+version | length | pickle(body)`. The magic rejects foreign/garbage
+# connections at the first frame instead of failing inside pickle, and the
+# embedded version turns a mixed-version cluster into a loud, diagnosable
+# error instead of undefined unpickling behavior.
+PROTOCOL_VERSION = 1
+_MAGIC = b"RTP" + bytes([PROTOCOL_VERSION])
+_HDR = struct.Struct("<4sI")
 KIND_REQUEST, KIND_REPLY, KIND_ERROR, KIND_PUSH = 0, 1, 2, 3
 MAX_FRAME = 1 << 31
 
@@ -47,9 +55,19 @@ class ConnectionLost(RpcError):
     pass
 
 
+class ProtocolMismatch(RpcError):
+    pass
+
+
 async def _read_frame(reader: asyncio.StreamReader):
     hdr = await reader.readexactly(_HDR.size)
-    (length,) = _HDR.unpack(hdr)
+    magic, length = _HDR.unpack(hdr)
+    if magic != _MAGIC:
+        if magic[:3] == b"RTP":
+            raise ProtocolMismatch(
+                f"peer speaks ray_tpu wire protocol v{magic[3]}, this "
+                f"process speaks v{PROTOCOL_VERSION}")
+        raise ProtocolMismatch(f"not a ray_tpu peer (bad magic {magic!r})")
     if length > MAX_FRAME:
         raise RpcError(f"frame too large: {length}")
     body = await reader.readexactly(length)
@@ -58,7 +76,7 @@ async def _read_frame(reader: asyncio.StreamReader):
 
 def _frame(obj) -> bytes:
     body = pickle.dumps(obj, protocol=5)
-    return _HDR.pack(len(body)) + body
+    return _HDR.pack(_MAGIC, len(body)) + body
 
 
 class RpcServer:
@@ -97,6 +115,19 @@ class RpcServer:
                 try:
                     kind, msg_id, method, data = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError, EOFError):
+                    break
+                except ProtocolMismatch as e:
+                    logger.warning("dropping connection: %s", e)
+                    # Best-effort: answer with OUR magic so a version-skewed
+                    # ray_tpu peer diagnoses the mismatch on its side too
+                    # (its reader raises ProtocolMismatch naming versions)
+                    # instead of seeing a bare EOF.
+                    try:
+                        writer.write(_frame((KIND_ERROR, None,
+                                             "__protocol__", str(e))))
+                        await writer.drain()
+                    except Exception:
+                        pass
                     break
                 if kind == KIND_REQUEST:
                     asyncio.ensure_future(self._dispatch(conn, msg_id, method, data))
@@ -247,6 +278,15 @@ class RpcClient:
                     asyncio.ensure_future(self._run_push(method, data))
         except (asyncio.IncompleteReadError, ConnectionResetError, EOFError, OSError):
             pass
+        except ProtocolMismatch as e:
+            # Version skew is terminal and loud: no reconnect churn against
+            # an incompatible peer, pending calls see the real reason.
+            logger.error("wire protocol mismatch with %s:%s: %s",
+                         self.host, self.port, e)
+            self._closed = True
+            self._dead = True
+            self._fail_pending(e)
+            return
         except Exception:
             logger.exception("rpc client recv loop error")
         finally:
