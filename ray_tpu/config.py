@@ -60,6 +60,9 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                                    "raylet serves (admission control)"),
     "broadcast_fanout": (int, 2, "relay-tree fanout for object broadcast"),
     # -- data --------------------------------------------------------------
+    "data_store_highwater": (float, 0.8,
+                             "object-store fill fraction where dataset "
+                             "producers start throttling"),
     "data_max_in_flight": (int, 8,
                            "bounded in-flight block tasks per stage"),
     "data_task_timeout_s": (float, 600.0, "per block-task wait timeout"),

@@ -294,6 +294,35 @@ def _apply_barrier_distributed(op, refs: List) -> List:
 
 # ----------------------------------------------------------------- executor
 
+_throttled = False   # current backpressure state (edge-counted metric)
+
+
+def _effective_inflight(max_in_flight: int) -> int:
+    """Resource-managed backpressure (streaming_executor_state.py:531 /
+    backpressure_policy/ analog): the count cap shrinks as the LOCAL object
+    store fills, so a fast producer can't drive the store into eviction/
+    spill churn faster than consumers drain it. Never 0: spilling happens
+    only at object-create time (spill.py create_with_spill), so at least
+    one in-flight task must keep running to relieve pressure — a zero cap
+    would livelock a barrier plan that pins its produced refs."""
+    global _throttled
+    from ray_tpu.core.worker import global_worker
+    from ray_tpu.runtime import metric_defs
+
+    try:
+        store = global_worker().store
+        if store is None or store.capacity <= 0:
+            return max_in_flight
+        pressure = store.used / store.capacity
+    except Exception:
+        return max_in_flight
+    throttle = pressure >= cfg().data_store_highwater
+    if throttle and not _throttled:
+        metric_defs.DATA_BACKPRESSURE.inc()   # count transitions, not polls
+    _throttled = throttle
+    return max(1, max_in_flight // 4) if throttle else max_in_flight
+
+
 def execute_refs(ops: List[plan_mod.LogicalOp], parallelism: int,
                  max_in_flight: Optional[int] = None) -> Iterator:
     """Run the optimized plan; yields BLOCK REFS in order as they complete
@@ -338,10 +367,11 @@ def execute_refs(ops: List[plan_mod.LogicalOp], parallelism: int,
         pending = {}
         queue = [(i, cp.dumps(t)) for i, t in enumerate(tasks)]
         while queue or pending:
-            while queue and len(pending) < max_in_flight:
+            while queue and len(pending) < _effective_inflight(max_in_flight):
                 idx, payload = queue.pop(0)
                 pending[run_block.remote(payload, lead_payloads)] = idx
-            ready, _ = ray_tpu.wait(list(pending), num_returns=1, timeout=600)
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1,
+                                    timeout=cfg().data_task_timeout_s)
             if not ready:
                 raise TimeoutError("dataset task timed out")
             for ref in ready:

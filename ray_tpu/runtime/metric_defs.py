@@ -57,6 +57,13 @@ PULL_LATENCY = Histogram(
     "ray_tpu_object_pull_seconds", "end-to-end remote object pull latency",
     boundaries=[0.001, 0.01, 0.05, 0.25, 1.0, 5.0, 30.0])
 
+# -- data ------------------------------------------------------------------
+
+DATA_BACKPRESSURE = Counter(
+    "ray_tpu_data_backpressure_total",
+    "dataset producer throttle ENGAGEMENTS (idle->throttled transitions) "
+    "under object-store pressure")
+
 # -- serve / llm -----------------------------------------------------------
 
 SERVE_REQUESTS = Counter(

@@ -127,3 +127,46 @@ def test_multinode_shuffle():
         except Exception:
             pass
         cluster.shutdown()
+
+
+def test_store_pressure_throttles_producers():
+    """Resource-managed backpressure: under a nearly-full local store the
+    producer cap shrinks (and pipelines still complete)."""
+    import numpy as np
+
+    from ray_tpu.data import execution
+    from ray_tpu import data as rd
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        from ray_tpu.core.worker import global_worker
+        from ray_tpu.runtime import metric_defs
+
+        core = global_worker()
+        # Fill the store just past the high-water mark with pinned objects
+        # (32 MiB steps so we overshoot 0.80 but stay well under full).
+        cap = core.store.capacity
+        filler = []
+        while core.store.used < cap * 0.82:
+            filler.append(ray_tpu.put(
+                np.zeros(32 << 20, dtype=np.uint8)))
+        execution._throttled = False
+        before = sum(
+            metric_defs.DATA_BACKPRESSURE.snapshot()["values"].values())
+        assert execution._effective_inflight(8) < 8
+
+        # A dataset still completes under pressure (throttled, not stuck).
+        ds = rd.range(50, parallelism=8).map_batches(
+            lambda b: {"id": b["id"] * 2})
+        assert sorted(r["id"] for r in ds.take_all()) == [
+            i * 2 for i in range(50)]
+        after = sum(
+            metric_defs.DATA_BACKPRESSURE.snapshot()["values"].values())
+        # The direct probe above engaged once; the dataset run itself must
+        # have engaged at least once more (fresh edge after reset).
+        execution._throttled = False
+        assert execution._effective_inflight(8) < 8
+        assert after > before
+        del filler
+    finally:
+        ray_tpu.shutdown()
