@@ -142,6 +142,26 @@ def one_f_one_b(n_stages: int, n_microbatches: int) -> List[List[PipeOp]]:
     return per_stage
 
 
+def virtual_stage_schedule(n_devices: int, v: int,
+                           n_microbatches: int) -> List[List[PipeOp]]:
+    """Per-DEVICE op sequences for a VIRTUAL-stage pipeline: the model is
+    cut into n_devices*v chunks; device d hosts chunks d, d+n_devices, ...
+    (round-robin, the Megatron virtual-pipeline PLACEMENT — it balances
+    per-device memory and enables finer microbatch granularity).
+
+    The op order is depth-(n_devices*v) 1F1B restricted to each device —
+    NOT Megatron's interleaved steady-state order, so the bubble fraction
+    matches depth-p*v 1F1B rather than the interleaved (p-1)/(v*m) bound;
+    a bubble-optimal reorder can be layered on this placement later.
+    PipeOp.stage is the VIRTUAL stage (chunk) id; device = stage %
+    n_devices. Requires n_microbatches >= n_devices * v."""
+    n_virtual = n_devices * v
+    per_device: List[List[PipeOp]] = [[] for _ in range(n_devices)]
+    for op in global_order(n_virtual, n_microbatches):
+        per_device[op.stage % n_devices].append(op)
+    return per_device
+
+
 def global_order(n_stages: int, n_microbatches: int) -> List[PipeOp]:
     """A single sequential order respecting all inter-stage dependencies
     (for single-process execution): fwd(s, m) after fwd(s-1, m); bwd(s, m)
@@ -181,23 +201,31 @@ class LocalPipeline:
     host transfer on CPU test meshes). Used by dryrun_multichip's pp leg."""
 
     def __init__(self, config, params, n_stages: int, optimizer,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None, interleave: int = 1):
+        """`interleave=v` enables virtual-stage partitioning: layers split
+        into n_stages*v chunks, chunk c on device c % n_stages (see
+        virtual_stage_schedule). train_step then needs n_microbatches >=
+        n_stages * v."""
         self.config = config
         self.n_stages = n_stages
+        self.n_virtual = n_stages * max(1, interleave)
         self.optimizer = optimizer
         devices = list(devices or jax.devices()[:n_stages])
         assert len(devices) >= n_stages
         self.devices = devices[:n_stages]
-        stages = split_params(params, n_stages)
+        # Device of each VIRTUAL stage (round-robin under interleaving).
+        self.chunk_devices = [self.devices[c % n_stages]
+                              for c in range(self.n_virtual)]
+        stages = split_params(params, self.n_virtual)
         self.stage_params = [
-            jax.device_put(st, d) for st, d in zip(stages, self.devices)]
+            jax.device_put(st, d) for st, d in zip(stages, self.chunk_devices)]
         self.opt_states = [
             jax.device_put(optimizer.init(st), d)
-            for st, d in zip(self.stage_params, self.devices)]
+            for st, d in zip(self.stage_params, self.chunk_devices)]
         self._fwd = []
         self._bwd = []
-        for s in range(n_stages):
-            is_first, is_last = s == 0, s == n_stages - 1
+        for s in range(self.n_virtual):
+            is_first, is_last = s == 0, s == self.n_virtual - 1
             if is_last:
                 def loss_f(p, x, t, _first=is_first):
                     return last_stage_loss(p, x, t, config, is_first=_first)
@@ -229,24 +257,29 @@ class LocalPipeline:
         divide into n_microbatches."""
         B = tokens.shape[0]
         assert B % n_microbatches == 0
+        assert n_microbatches >= self.n_virtual, (
+            f"1F1B over {self.n_virtual} virtual stages "
+            f"({self.n_stages} devices x interleave "
+            f"{self.n_virtual // self.n_stages}) needs n_microbatches >= "
+            f"{self.n_virtual}, got {n_microbatches}")
         mb = B // n_microbatches
         inputs = tokens[:, :-1]
         targets = tokens[:, 1:]
         saved_in: Dict[Tuple[int, int], Any] = {}
         fwd_out: Dict[Tuple[int, int], Any] = {}
         grads_in: Dict[Tuple[int, int], Any] = {}
-        stage_grads: List[Any] = [None] * self.n_stages
+        stage_grads: List[Any] = [None] * self.n_virtual
         losses = []
-        last = self.n_stages - 1
-        for op in global_order(self.n_stages, n_microbatches):
+        last = self.n_virtual - 1
+        for op in global_order(self.n_virtual, n_microbatches):
             s, m = op.stage, op.microbatch
             if op.kind == "fwd":
                 if s == 0:
                     x = jax.device_put(inputs[m * mb:(m + 1) * mb],
-                                       self.devices[0])
+                                       self.chunk_devices[0])
                 else:
                     x = jax.device_put(fwd_out.pop((s - 1, m)),
-                                       self.devices[s])
+                                       self.chunk_devices[s])
                 saved_in[(s, m)] = x
                 if s != last:
                     fwd_out[(s, m)] = self._fwd[s](self.stage_params[s], x)
@@ -254,12 +287,13 @@ class LocalPipeline:
                 if s == last:
                     x = saved_in.pop((s, m))
                     t = jax.device_put(targets[m * mb:(m + 1) * mb],
-                                       self.devices[s])
+                                       self.chunk_devices[s])
                     loss, (dp, dx) = self._bwd[s](self.stage_params[s], x, t)
                     losses.append(loss)
                 else:
                     x = saved_in.pop((s, m))
-                    g = jax.device_put(grads_in.pop((s, m)), self.devices[s])
+                    g = jax.device_put(grads_in.pop((s, m)),
+                                       self.chunk_devices[s])
                     dp, dx = self._bwd[s](self.stage_params[s], x, g)
                 if s > 0:
                     grads_in[(s - 1, m)] = dx
@@ -267,7 +301,7 @@ class LocalPipeline:
                     jnp.add, stage_grads[s], dp)
         # Optimizer step per stage (grads averaged over microbatches).
         scale = 1.0 / n_microbatches
-        for s in range(self.n_stages):
+        for s in range(self.n_virtual):
             g = jax.tree.map(lambda v: v * scale, stage_grads[s])
             self.stage_params[s], self.opt_states[s] = self._apply(
                 self.stage_params[s], self.opt_states[s], g)
