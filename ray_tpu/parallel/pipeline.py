@@ -162,6 +162,47 @@ def virtual_stage_schedule(n_devices: int, v: int,
     return per_device
 
 
+def megatron_interleaved_schedule(n_devices: int, v: int,
+                                  n_microbatches: int) -> List[List[PipeOp]]:
+    """Per-DEVICE op sequences for the Megatron interleaved 1F1B schedule
+    (Narayanan et al. 2021; Megatron-LM schedules.py): chunks placed as in
+    virtual_stage_schedule, but the op ORDER cycles microbatch groups of
+    size n_devices through the v local chunks — warmup of
+    (p-d-1)*2 + (v-1)*p forwards, then fwd/bwd steady state, then drain.
+    Simulation-validated properties (see tests): deadlock-free under
+    blocking in-order per-device execution, complete (one fwd + one bwd
+    per chunk x microbatch), and a pipeline bubble of 2*(p-1)/v ticks vs
+    2*(p*v-1) for the plain virtual order. Requires m % p == 0."""
+    p, total = n_devices, n_microbatches * v
+    assert n_microbatches % p == 0,         "interleaved schedule needs n_microbatches % n_devices == 0"
+
+    def chunk_of(op_id: int, forward: bool) -> int:
+        c = (op_id % (p * v)) // p
+        return c if forward else (v - 1 - c)
+
+    def mb_of(op_id: int) -> int:
+        return (op_id // (p * v)) * p + op_id % p
+
+    out: List[List[PipeOp]] = []
+    for d in range(p):
+        ops: List[PipeOp] = []
+        warmup = min((p - d - 1) * 2 + (v - 1) * p, total)
+        f = b = 0
+        for _ in range(warmup):
+            ops.append(PipeOp("fwd", chunk_of(f, True) * p + d, mb_of(f)))
+            f += 1
+        while f < total:
+            ops.append(PipeOp("fwd", chunk_of(f, True) * p + d, mb_of(f)))
+            f += 1
+            ops.append(PipeOp("bwd", chunk_of(b, False) * p + d, mb_of(b)))
+            b += 1
+        while b < total:
+            ops.append(PipeOp("bwd", chunk_of(b, False) * p + d, mb_of(b)))
+            b += 1
+        out.append(ops)
+    return out
+
+
 def global_order(n_stages: int, n_microbatches: int) -> List[PipeOp]:
     """A single sequential order respecting all inter-stage dependencies
     (for single-process execution): fwd(s, m) after fwd(s-1, m); bwd(s, m)

@@ -206,3 +206,59 @@ def test_virtual_stage_local_pipeline_matches_single_program(setup):
     for a, b in zip(jax.tree.leaves(ref_params), jax.tree.leaves(merged)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+def _simulate_ticks(p, v, per_device):
+    """Parallel blocking in-order execution; returns ticks (raises on
+    deadlock)."""
+    n_virtual = p * v
+    cursors = [0] * p
+    done = set()
+    total = sum(len(ops) for ops in per_device)
+    executed, t = 0, 0
+    while executed < total:
+        t += 1
+        fired = []
+        for d in range(p):
+            if cursors[d] >= len(per_device[d]):
+                continue
+            op = per_device[d][cursors[d]]
+            if op.kind == "fwd":
+                ready = op.stage == 0 or \
+                    ("fwd", op.stage - 1, op.microbatch) in done
+            else:
+                ready = (("fwd", op.stage, op.microbatch) in done
+                         and (op.stage == n_virtual - 1 or
+                              ("bwd", op.stage + 1, op.microbatch) in done))
+            if ready:
+                fired.append((d, op))
+        assert fired, "schedule deadlocked"
+        for d, op in fired:
+            done.add((op.kind, op.stage, op.microbatch))
+            cursors[d] += 1
+            executed += 1
+    return t
+
+
+def test_megatron_interleaved_schedule_beats_plain_bubble():
+    """The interleaved order is deadlock-free, complete, and strictly
+    shrinks the pipeline bubble vs the plain virtual-stage order."""
+    from ray_tpu.parallel.pipeline import (
+        megatron_interleaved_schedule, virtual_stage_schedule)
+
+    for p, v, m in [(2, 2, 4), (4, 2, 8), (2, 3, 6), (4, 4, 16)]:
+        mega = megatron_interleaved_schedule(p, v, m)
+        seen = set()
+        for d, ops in enumerate(mega):
+            for op in ops:
+                assert op.stage % p == d
+                seen.add((op.kind, op.stage, op.microbatch))
+        assert len(seen) == 2 * p * v * m
+        ideal = 2 * m * v
+        mega_ticks = _simulate_ticks(p, v, mega)
+        plain_ticks = _simulate_ticks(p, v, virtual_stage_schedule(p, v, m))
+        assert mega_ticks < plain_ticks, (p, v, m, mega_ticks, plain_ticks)
+        # Interleaved bubble stays within 2*(p-1) ticks (vs the plain
+        # order's O(p*v) bubble), matching the (p-1)/(v*m) bound.
+        assert mega_ticks - ideal <= 2 * (p - 1), \
+            (p, v, m, mega_ticks - ideal)
