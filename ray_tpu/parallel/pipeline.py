@@ -174,7 +174,8 @@ def megatron_interleaved_schedule(n_devices: int, v: int,
     per chunk x microbatch), and a pipeline bubble of 2*(p-1)/v ticks vs
     2*(p*v-1) for the plain virtual order. Requires m % p == 0."""
     p, total = n_devices, n_microbatches * v
-    assert n_microbatches % p == 0,         "interleaved schedule needs n_microbatches % n_devices == 0"
+    assert n_microbatches % p == 0, \
+        "interleaved schedule needs n_microbatches % n_devices == 0"
 
     def chunk_of(op_id: int, forward: bool) -> int:
         c = (op_id % (p * v)) // p
@@ -203,36 +204,43 @@ def megatron_interleaved_schedule(n_devices: int, v: int,
     return out
 
 
+def linearize(per_queue: List[List[PipeOp]], n_virtual: int) -> List[PipeOp]:
+    """Merge per-queue op sequences into one dependency-valid global order,
+    preserving each queue's internal order (queues = stages or devices).
+    fwd(s, m) needs fwd(s-1, m); bwd(s, m) needs fwd(s, m) and
+    bwd(s+1, m). Asserts the sequences are deadlock-free."""
+    cursors = [0] * len(per_queue)
+    done = set()
+    order: List[PipeOp] = []
+    total = sum(len(ops) for ops in per_queue)
+    while len(order) < total:
+        progressed = False
+        for q in range(len(per_queue)):
+            while cursors[q] < len(per_queue[q]):
+                op = per_queue[q][cursors[q]]
+                if op.kind == "fwd":
+                    ready = (op.stage == 0
+                             or ("fwd", op.stage - 1, op.microbatch) in done)
+                else:
+                    ready = (("fwd", op.stage, op.microbatch) in done
+                             and (op.stage == n_virtual - 1
+                                  or ("bwd", op.stage + 1,
+                                      op.microbatch) in done))
+                if not ready:
+                    break
+                done.add((op.kind, op.stage, op.microbatch))
+                order.append(op)
+                cursors[q] += 1
+                progressed = True
+        assert progressed, "pipeline schedule deadlocked"
+    return order
+
+
 def global_order(n_stages: int, n_microbatches: int) -> List[PipeOp]:
     """A single sequential order respecting all inter-stage dependencies
     (for single-process execution): fwd(s, m) after fwd(s-1, m); bwd(s, m)
     after bwd(s+1, m) and fwd(s, m)."""
-    per_stage = one_f_one_b(n_stages, n_microbatches)
-    cursors = [0] * n_stages
-    done_f = set()
-    done_b = set()
-    order: List[PipeOp] = []
-    total = sum(len(ops) for ops in per_stage)
-    while len(order) < total:
-        progressed = False
-        for s in range(n_stages):
-            while cursors[s] < len(per_stage[s]):
-                op = per_stage[s][cursors[s]]
-                if op.kind == "fwd":
-                    ready = s == 0 or (s - 1, op.microbatch) in done_f
-                else:
-                    ready = ((s == n_stages - 1
-                              or (s + 1, op.microbatch) in done_b)
-                             and (s, op.microbatch) in done_f)
-                if not ready:
-                    break
-                (done_f if op.kind == "fwd" else done_b).add(
-                    (s, op.microbatch))
-                order.append(op)
-                cursors[s] += 1
-                progressed = True
-        assert progressed, "1F1B schedule deadlocked"
-    return order
+    return linearize(one_f_one_b(n_stages, n_microbatches), n_stages)
 
 
 # ---------------------------------------------------------- local pipeline
@@ -355,102 +363,123 @@ class LocalPipeline:
 # ---------------------------------------------------------- actor pipeline
 
 class PipelineStageActor:
-    """One pipeline stage hosted in an actor (multi-host PP). Activations
-    and gradients travel through the object plane — plasma-backed actor
-    calls, the same data path compiled-graph channels ride."""
+    """Pipeline chunks hosted in an actor (multi-host PP). One actor per
+    DEVICE/host; with interleaving it hosts several VIRTUAL stages
+    (chunks). Activations and gradients travel through the object plane —
+    plasma-backed actor calls, the same data path compiled-graph channels
+    ride."""
 
-    def __init__(self, stage_idx: int, n_stages: int, config_bytes: bytes,
-                 stage_params_bytes: bytes, opt_name: str = "adamw",
+    def __init__(self, chunk_ids, n_virtual: int, config_bytes: bytes,
+                 chunk_params_bytes: bytes, opt_name: str = "adamw",
                  lr: float = 1e-3):
         import cloudpickle
         import optax
 
         self.config = cloudpickle.loads(config_bytes)
-        self.s = stage_idx
-        self.n = n_stages
-        self.params = cloudpickle.loads(stage_params_bytes)
+        self.chunk_ids = list(chunk_ids)
+        self.n = n_virtual
+        chunk_params = cloudpickle.loads(chunk_params_bytes)
         self.optimizer = (optax.adamw(lr) if opt_name == "adamw"
                           else optax.sgd(lr))
-        self.opt_state = self.optimizer.init(self.params)
-        self._saved: Dict[int, Any] = {}
-        self._grads = None
-        is_first, is_last = self.s == 0, self.s == self.n - 1
-        if is_last:
-            def loss_f(p, x, t, _first=is_first):
-                return last_stage_loss(p, x, t, self.config, is_first=_first)
+        self.params: Dict[int, Any] = {}
+        self.opt_state: Dict[int, Any] = {}
+        self._fwd: Dict[int, Any] = {}
+        self._bwd: Dict[int, Any] = {}
+        self._saved: Dict[Tuple[int, int], Any] = {}
+        self._grads: Dict[int, Any] = {}
+        for c, params in zip(self.chunk_ids, chunk_params):
+            self.params[c] = params
+            self.opt_state[c] = self.optimizer.init(params)
+            is_first, is_last = c == 0, c == n_virtual - 1
+            if is_last:
+                def loss_f(p, x, t, _first=is_first):
+                    return last_stage_loss(p, x, t, self.config,
+                                           is_first=_first)
 
-            self._bwd = jax.jit(jax.value_and_grad(loss_f, argnums=(0, 1)))
-            self._fwd = None
-        else:
-            f = partial(stage_apply, config=self.config, is_first=is_first,
-                        is_last=False)
-            self._fwd = jax.jit(f)
+                self._bwd[c] = jax.jit(
+                    jax.value_and_grad(loss_f, argnums=(0, 1)))
+                self._fwd[c] = None
+            else:
+                f = partial(stage_apply, config=self.config,
+                            is_first=is_first, is_last=False)
+                self._fwd[c] = jax.jit(f)
 
-            def bwd_f(p, x, g, _f=f):
-                out, vjp = jax.vjp(lambda pp, xx: _f(pp, xx), p, x)
-                return vjp(g)
+                def bwd_f(p, x, g, _f=f):
+                    out, vjp = jax.vjp(lambda pp, xx: _f(pp, xx), p, x)
+                    return vjp(g)
 
-            self._bwd = jax.jit(bwd_f)
+                self._bwd[c] = jax.jit(bwd_f)
 
-    def forward(self, mb: int, x):
-        self._saved[mb] = x
-        if self._fwd is None:
-            return True  # last stage: loss + grads computed in backward_last
-        return jax.device_get(self._fwd(self.params, x))
+    def forward(self, chunk: int, mb: int, x):
+        self._saved[(chunk, mb)] = x
+        if self._fwd[chunk] is None:
+            return True  # last chunk: loss + grads computed in backward_last
+        return jax.device_get(self._fwd[chunk](self.params[chunk], x))
 
-    def backward_last(self, mb: int, targets):
-        x = self._saved.pop(mb)
-        loss, (dp, dx) = self._bwd(self.params, x, targets)
-        self._accumulate(dp)
+    def backward_last(self, chunk: int, mb: int, targets):
+        x = self._saved.pop((chunk, mb))
+        loss, (dp, dx) = self._bwd[chunk](self.params[chunk], x, targets)
+        self._accumulate(chunk, dp)
         return float(loss), jax.device_get(dx)
 
-    def backward(self, mb: int, grad_out):
-        x = self._saved.pop(mb)
-        dp, dx = self._bwd(self.params, x, grad_out)
-        self._accumulate(dp)
+    def backward(self, chunk: int, mb: int, grad_out):
+        x = self._saved.pop((chunk, mb))
+        dp, dx = self._bwd[chunk](self.params[chunk], x, grad_out)
+        self._accumulate(chunk, dp)
         return jax.device_get(dx)
 
-    def _accumulate(self, dp):
-        self._grads = dp if self._grads is None else jax.tree.map(
-            jnp.add, self._grads, dp)
+    def _accumulate(self, chunk: int, dp):
+        cur = self._grads.get(chunk)
+        self._grads[chunk] = dp if cur is None else jax.tree.map(
+            jnp.add, cur, dp)
 
     def apply_updates(self, n_microbatches: int) -> bool:
         import optax
 
-        g = jax.tree.map(lambda v: v / n_microbatches, self._grads)
-        updates, self.opt_state = self.optimizer.update(
-            g, self.opt_state, self.params)
-        self.params = optax.apply_updates(self.params, updates)
-        self._grads = None
+        for c in self.chunk_ids:
+            g = jax.tree.map(lambda v: v / n_microbatches, self._grads[c])
+            updates, self.opt_state[c] = self.optimizer.update(
+                g, self.opt_state[c], self.params[c])
+            self.params[c] = optax.apply_updates(self.params[c], updates)
+        self._grads = {}
         return True
 
     def get_params_bytes(self) -> bytes:
         import cloudpickle
 
-        return cloudpickle.dumps(jax.device_get(self.params))
+        return cloudpickle.dumps(
+            [jax.device_get(self.params[c]) for c in self.chunk_ids])
 
 
 class ActorPipeline:
-    """Driver-side coordinator for actor-hosted stages: executes the 1F1B
-    dependency order with pipelined actor calls (stages run concurrently
-    thanks to the pipelined actor transport)."""
+    """Driver-side coordinator for actor-hosted stages: submits ops in a
+    dependency-valid global order with pipelined actor calls (stages run
+    concurrently thanks to the pipelined actor transport). `interleave=v`
+    gives each actor v round-robin chunks and submits per-actor ops in the
+    Megatron interleaved order (megatron_interleaved_schedule), so each
+    actor's execution queue realizes the small-bubble schedule."""
 
     def __init__(self, config, params, n_stages: int, *, lr: float = 1e-3,
-                 resources_per_stage: Optional[dict] = None):
+                 resources_per_stage: Optional[dict] = None,
+                 interleave: int = 1):
         import cloudpickle
 
         import ray_tpu
 
         self.config = config
         self.n_stages = n_stages
-        stages = split_params(params, n_stages)
+        self.interleave = max(1, interleave)
+        self.n_virtual = n_stages * self.interleave
+        chunks = split_params(params, self.n_virtual)
         Stage = ray_tpu.remote(PipelineStageActor)
         opts = resources_per_stage or {"num_cpus": 0}
         cfg_b = cloudpickle.dumps(config)
-        self.actors = [
-            Stage.options(**opts).remote(
-                s, n_stages, cfg_b, cloudpickle.dumps(st), "adamw", lr)
-            for s, st in enumerate(stages)]
+        self.actors = []
+        for d in range(n_stages):
+            ids = list(range(d, self.n_virtual, n_stages))
+            self.actors.append(Stage.options(**opts).remote(
+                ids, self.n_virtual, cfg_b,
+                cloudpickle.dumps([chunks[c] for c in ids]), "adamw", lr))
 
     def train_step(self, tokens, n_microbatches: int) -> Dict[str, float]:
         import numpy as np
@@ -465,29 +494,65 @@ class ActorPipeline:
         fwd_ref: Dict[Tuple[int, int], Any] = {}
         bwd_ref: Dict[Tuple[int, int], Any] = {}
         loss_refs = []
-        last = self.n_stages - 1
-        for op in global_order(self.n_stages, n_microbatches):
+        last = self.n_virtual - 1
+        for op in self._submission_order(n_microbatches):
             s, m = op.stage, op.microbatch
-            a = self.actors[s]
+            a = self.actors[s % self.n_stages]
             if op.kind == "fwd":
                 x = (inputs[m * mb:(m + 1) * mb] if s == 0
-                     else fwd_ref[(s - 1, m)])
-                fwd_ref[(s, m)] = a.forward.remote(m, x)
+                     else fwd_ref.pop((s - 1, m)))
+                fwd_ref[(s, m)] = a.forward.remote(s, m, x)
             else:
                 if s == last:
                     loss_ref, dx = a.backward_last.options(
-                        num_returns=2).remote(m, targets[m * mb:(m + 1) * mb])
+                        num_returns=2).remote(
+                            s, m, targets[m * mb:(m + 1) * mb])
                     loss_refs.append(loss_ref)
                     if s > 0:
                         bwd_ref[(s - 1, m)] = dx
                 else:
-                    dx = a.backward.remote(m, bwd_ref.pop((s, m)))
+                    dx = a.backward.remote(s, m, bwd_ref.pop((s, m)))
                     if s > 0:
                         bwd_ref[(s - 1, m)] = dx
         ray_tpu.get([a.apply_updates.remote(n_microbatches)
                      for a in self.actors], timeout=600)
         losses = ray_tpu.get(loss_refs, timeout=600)
         return {"loss": float(sum(losses) / len(losses))}
+
+    def _submission_order(self, n_microbatches: int) -> List[PipeOp]:
+        """A dependency-valid GLOBAL linearization whose per-actor
+        subsequence equals the chosen per-device schedule (actor queues
+        execute in submission order, so this fixes each actor's real
+        execution order)."""
+        if self.interleave == 1:
+            return global_order(self.n_stages, n_microbatches)
+        per_device = megatron_interleaved_schedule(
+            self.n_stages, self.interleave, n_microbatches)
+        p, n_virtual = self.n_stages, self.n_virtual
+        cursors = [0] * p
+        done = set()
+        order: List[PipeOp] = []
+        total = sum(len(ops) for ops in per_device)
+        while len(order) < total:
+            progressed = False
+            for d in range(p):
+                while cursors[d] < len(per_device[d]):
+                    op = per_device[d][cursors[d]]
+                    if op.kind == "fwd":
+                        ready = op.stage == 0 or                             ("fwd", op.stage - 1, op.microbatch) in done
+                    else:
+                        ready = (("fwd", op.stage, op.microbatch) in done
+                                 and (op.stage == n_virtual - 1 or
+                                      ("bwd", op.stage + 1,
+                                       op.microbatch) in done))
+                    if not ready:
+                        break
+                    done.add((op.kind, op.stage, op.microbatch))
+                    order.append(op)
+                    cursors[d] += 1
+                    progressed = True
+            assert progressed, "interleaved schedule deadlocked"
+        return order
 
     def merged_params(self) -> Dict:
         import cloudpickle
@@ -496,4 +561,11 @@ class ActorPipeline:
 
         blobs = ray_tpu.get([a.get_params_bytes.remote()
                              for a in self.actors], timeout=600)
-        return merge_params([cloudpickle.loads(b) for b in blobs])
+        # Each actor returns ITS chunks (ids d, d+p, ...): reassemble in
+        # global chunk order before merging.
+        chunks: List[Any] = [None] * self.n_virtual
+        for d, blob in enumerate(blobs):
+            lst = cloudpickle.loads(blob)
+            for i, c in enumerate(range(d, self.n_virtual, self.n_stages)):
+                chunks[c] = lst[i]
+        return merge_params(chunks)

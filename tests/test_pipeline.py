@@ -262,3 +262,25 @@ def test_megatron_interleaved_schedule_beats_plain_bubble():
         # order's O(p*v) bubble), matching the (p-1)/(v*m) bound.
         assert mega_ticks - ideal <= 2 * (p - 1), \
             (p, v, m, mega_ticks - ideal)
+
+
+def test_interleaved_actor_pipeline_matches_single_program(setup):
+    import jax
+
+    from ray_tpu.parallel.pipeline import ActorPipeline
+
+    config, params, tokens = setup
+    ref_loss, ref_params = _reference_step(config, params, tokens)
+    ray_tpu.init(num_cpus=2)
+    try:
+        pipe = ActorPipeline(config, params, n_stages=2, lr=1e-3,
+                             interleave=2)
+        metrics = pipe.train_step(tokens, n_microbatches=4)
+        assert abs(metrics["loss"] - ref_loss) < 1e-4
+        merged = pipe.merged_params()
+        for a, b in zip(jax.tree.leaves(ref_params),
+                        jax.tree.leaves(merged)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+    finally:
+        ray_tpu.shutdown()
