@@ -107,6 +107,34 @@ def last_stage_loss(stage_params: Dict, x, targets, config,
     return next_token_ce(logits, targets)
 
 
+def build_chunk_programs(config, chunk_ids, n_virtual: int):
+    """Jitted per-chunk programs shared by LocalPipeline and
+    PipelineStageActor: fwd[c] (None for the last chunk — its loss+grads
+    come from bwd[c]) and bwd[c] (value_and_grad of the loss for the last
+    chunk; vjp of the stage forward otherwise)."""
+    fwd: Dict[int, Any] = {}
+    bwd: Dict[int, Any] = {}
+    for c in chunk_ids:
+        is_first, is_last = c == 0, c == n_virtual - 1
+        if is_last:
+            def loss_f(p, x, t, _first=is_first):
+                return last_stage_loss(p, x, t, config, is_first=_first)
+
+            fwd[c] = None
+            bwd[c] = jax.jit(jax.value_and_grad(loss_f, argnums=(0, 1)))
+        else:
+            f = partial(stage_apply, config=config, is_first=is_first,
+                        is_last=False)
+            fwd[c] = jax.jit(f)
+
+            def bwd_f(p, x, g, _f=f):
+                out, vjp = jax.vjp(lambda pp, xx: _f(pp, xx), p, x)
+                return vjp(g)
+
+            bwd[c] = jax.jit(bwd_f)
+    return fwd, bwd
+
+
 # --------------------------------------------------------------- schedule
 
 @dataclasses.dataclass(frozen=True)
@@ -271,27 +299,10 @@ class LocalPipeline:
         self.opt_states = [
             jax.device_put(optimizer.init(st), d)
             for st, d in zip(self.stage_params, self.chunk_devices)]
-        self._fwd = []
-        self._bwd = []
-        for s in range(self.n_virtual):
-            is_first, is_last = s == 0, s == self.n_virtual - 1
-            if is_last:
-                def loss_f(p, x, t, _first=is_first):
-                    return last_stage_loss(p, x, t, config, is_first=_first)
-
-                self._fwd.append(None)
-                self._bwd.append(jax.jit(jax.value_and_grad(
-                    loss_f, argnums=(0, 1))))
-            else:
-                f = partial(stage_apply, config=config, is_first=is_first,
-                            is_last=False)
-                self._fwd.append(jax.jit(f))
-
-                def bwd_f(p, x, g, _f=f):
-                    out, vjp = jax.vjp(lambda pp, xx: _f(pp, xx), p, x)
-                    return vjp(g)
-
-                self._bwd.append(jax.jit(bwd_f))
+        fwd, bwd = build_chunk_programs(config, range(self.n_virtual),
+                                        self.n_virtual)
+        self._fwd = [fwd[c] for c in range(self.n_virtual)]
+        self._bwd = [bwd[c] for c in range(self.n_virtual)]
         self._apply = jax.jit(
             lambda p, o, g: self._apply_impl(p, o, g))
 
@@ -390,25 +401,8 @@ class PipelineStageActor:
         for c, params in zip(self.chunk_ids, chunk_params):
             self.params[c] = params
             self.opt_state[c] = self.optimizer.init(params)
-            is_first, is_last = c == 0, c == n_virtual - 1
-            if is_last:
-                def loss_f(p, x, t, _first=is_first):
-                    return last_stage_loss(p, x, t, self.config,
-                                           is_first=_first)
-
-                self._bwd[c] = jax.jit(
-                    jax.value_and_grad(loss_f, argnums=(0, 1)))
-                self._fwd[c] = None
-            else:
-                f = partial(stage_apply, config=self.config,
-                            is_first=is_first, is_last=False)
-                self._fwd[c] = jax.jit(f)
-
-                def bwd_f(p, x, g, _f=f):
-                    out, vjp = jax.vjp(lambda pp, xx: _f(pp, xx), p, x)
-                    return vjp(g)
-
-                self._bwd[c] = jax.jit(bwd_f)
+        self._fwd, self._bwd = build_chunk_programs(
+            self.config, self.chunk_ids, n_virtual)
 
     def forward(self, chunk: int, mb: int, x):
         self._saved[(chunk, mb)] = x
