@@ -394,8 +394,6 @@ class PipelineStageActor:
                           else optax.sgd(lr))
         self.params: Dict[int, Any] = {}
         self.opt_state: Dict[int, Any] = {}
-        self._fwd: Dict[int, Any] = {}
-        self._bwd: Dict[int, Any] = {}
         self._saved: Dict[Tuple[int, int], Any] = {}
         self._grads: Dict[int, Any] = {}
         for c, params in zip(self.chunk_ids, chunk_params):

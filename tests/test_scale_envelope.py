@@ -1,0 +1,89 @@
+"""Scaled-down scalability-envelope checks.
+
+Reference analog: release/benchmarks/ (the published envelope — tasks
+queued on one node, object args to a single task, returns from a single
+task, many actors). Full-scale numbers need a cluster; these assert the
+same MECHANISMS survive two orders of magnitude below the reference
+envelope on one dev box, so regressions in queueing/arg-pinning/return
+packaging surface in CI.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_many_queued_tasks(cluster):
+    """10k trivial tasks queued at once all complete (reference row:
+    1M+ queued on one node)."""
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    refs = [inc.remote(i) for i in range(10_000)]
+    out = ray_tpu.get(refs, timeout=600)
+    assert out[0] == 1 and out[-1] == 10_000
+    assert len(out) == 10_000
+
+
+def test_many_args_to_single_task(cluster):
+    """2k object args resolve into one task (reference row: 10k+)."""
+
+    @ray_tpu.remote
+    def total(*parts):
+        return sum(parts)
+
+    parts = [ray_tpu.put(i) for i in range(2_000)]
+    assert ray_tpu.get(total.remote(*parts), timeout=300) == \
+        sum(range(2_000))
+
+
+def test_many_returns_from_single_task(cluster):
+    """500 returns from one task (reference row: 3k+)."""
+
+    @ray_tpu.remote(num_returns=500)
+    def spread():
+        return tuple(range(500))
+
+    refs = spread.remote()
+    assert len(refs) == 500
+    vals = ray_tpu.get(refs, timeout=300)
+    assert vals == list(range(500))
+
+
+def test_many_plasma_objects_in_one_get(cluster):
+    """1k plasma-resident objects fetched in a single get (reference
+    row: 10k+)."""
+    refs = [ray_tpu.put(np.full(64_000, i, dtype=np.int32))
+            for i in range(1_000)]
+    out = ray_tpu.get(refs, timeout=600)
+    assert len(out) == 1_000
+    assert int(out[512][0]) == 512
+
+
+def test_many_actors(cluster):
+    """200 concurrent actors created and called (reference row: 40k+
+    cluster-wide)."""
+
+    @ray_tpu.remote
+    class Cell:
+        def __init__(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    actors = [Cell.remote(i) for i in range(200)]
+    vals = ray_tpu.get([a.get.remote() for a in actors], timeout=600)
+    assert vals == list(range(200))
+    for a in actors:
+        ray_tpu.kill(a)
