@@ -152,13 +152,18 @@ class Autoscaler:
     # -- demand ------------------------------------------------------------
 
     def get_demand(self) -> List[Dict[str, float]]:
-        """Unmet resource demand: queued leases per raylet + pending PGs."""
-        from ray_tpu.state.api import _gcs_call, node_stats
+        """Unmet resource demand: per-scheduling-class lease backlog
+        (real shapes, including cluster-wide-infeasible parked classes),
+        aggregated by the GCS from raylet heartbeats — one RPC, not a
+        node_stats fan-out — + pending PGs."""
+        from ray_tpu.state.api import _gcs_call
 
         demand: List[Dict[str, float]] = []
-        for stats in node_stats():
-            for _ in range(stats.get("num_pending_leases", 0)):
-                demand.append({"CPU": 1.0})  # raylet doesn't expose shapes yet
+        for node in _gcs_call("cluster_demand"):
+            for entry in node["backlog"]:
+                shape = dict(entry.get("shape", {})) or {"CPU": 1.0}
+                demand.extend(dict(shape)
+                              for _ in range(entry.get("count", 1)))
         for pg in _gcs_call("list_placement_groups"):
             if pg["state"] in ("PENDING", "RESCHEDULING"):
                 demand.extend(pg["bundles"])

@@ -149,6 +149,13 @@ class LoRAManager:
                 f"adapter rank {adapter.rank} > manager rank {self.rank}")
         if adapter.name in self._slots:
             slot = self._slots[adapter.name]
+            if self._pins.get(slot):
+                # Refreshing a live slot would switch a running sequence's
+                # adapter weights mid-generation — the same hazard pinning
+                # guards against on the eviction path.
+                raise RuntimeError(
+                    f"LoRA adapter {adapter.name!r} is referenced by "
+                    "in-flight requests; retry the refresh once they drain")
         elif len(self._slots) < self.n_slots - 1:
             used = set(self._slots.values())
             slot = next(s for s in range(1, self.n_slots) if s not in used)

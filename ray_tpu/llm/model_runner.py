@@ -69,8 +69,10 @@ class ModelRunner:
         self.mesh = mesh
         self.tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
         if attention_impl == "auto":
+            from ray_tpu.ops import is_tpu_backend
+
             # The Pallas kernel's page DMA needs a 128-aligned trailing dim.
-            attention_impl = ("pallas" if jax.default_backend() == "tpu"
+            attention_impl = ("pallas" if is_tpu_backend()
                               and config.head_dim % 128 == 0 else "reference")
         self.attention_impl = attention_impl
         # Multi-LoRA (llm/lora.py): when a manager is attached, the step

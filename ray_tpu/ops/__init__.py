@@ -1,0 +1,27 @@
+"""TPU compute ops (attention, layers, paged attention).
+
+Backend detection lives here: TPU chips can surface under jax platform
+names other than "tpu" — notably "axon", a PJRT plugin that proxies a
+remote TPU and aliases the Pallas "tpu" lowering rules — so every
+"am I on real TPU hardware?" decision (e.g. Pallas interpret mode) must
+go through :func:`is_tpu_backend`, never a raw
+``jax.default_backend() == "tpu"`` comparison.
+"""
+
+from __future__ import annotations
+
+TPU_PLATFORMS = ("tpu", "axon")
+
+
+def is_tpu_backend() -> bool:
+    """True when jax's default backend executes on TPU hardware (native
+    libtpu or a proxying PJRT plugin with TPU lowering rules)."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend in TPU_PLATFORMS:
+        return True
+    try:
+        return "tpu" in jax.devices()[0].device_kind.lower()
+    except Exception:
+        return False

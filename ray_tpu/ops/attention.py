@@ -122,7 +122,9 @@ def flash_attention_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
     block_q = min(block_q, sq)
     block_k = min(block_k, skv)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from ray_tpu.ops import is_tpu_backend
+
+        interpret = not is_tpu_backend()
 
     # Layout: fold (b, h) into the grid's first axis; operate on (seq, d).
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)

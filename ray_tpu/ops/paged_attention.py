@@ -150,7 +150,9 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
     G = H // K
     scale = scale if scale is not None else 1.0 / math.sqrt(hd)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from ray_tpu.ops import is_tpu_backend
+
+        interpret = not is_tpu_backend()
 
     # (S, Bq, H, hd) -> (S, K, Bq*G, hd): rows of one kv head contiguous.
     qt = q.reshape(S, Bq, K, G, hd).transpose(0, 2, 1, 3, 4).reshape(

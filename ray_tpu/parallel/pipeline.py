@@ -520,31 +520,7 @@ class ActorPipeline:
             return global_order(self.n_stages, n_microbatches)
         per_device = megatron_interleaved_schedule(
             self.n_stages, self.interleave, n_microbatches)
-        p, n_virtual = self.n_stages, self.n_virtual
-        cursors = [0] * p
-        done = set()
-        order: List[PipeOp] = []
-        total = sum(len(ops) for ops in per_device)
-        while len(order) < total:
-            progressed = False
-            for d in range(p):
-                while cursors[d] < len(per_device[d]):
-                    op = per_device[d][cursors[d]]
-                    if op.kind == "fwd":
-                        ready = op.stage == 0 or                             ("fwd", op.stage - 1, op.microbatch) in done
-                    else:
-                        ready = (("fwd", op.stage, op.microbatch) in done
-                                 and (op.stage == n_virtual - 1 or
-                                      ("bwd", op.stage + 1,
-                                       op.microbatch) in done))
-                    if not ready:
-                        break
-                    done.add((op.kind, op.stage, op.microbatch))
-                    order.append(op)
-                    cursors[d] += 1
-                    progressed = True
-            assert progressed, "interleaved schedule deadlocked"
-        return order
+        return linearize(per_device, self.n_virtual)
 
     def merged_params(self) -> Dict:
         import cloudpickle

@@ -43,6 +43,8 @@ class NodeRecord:
         self.alive = True
         self.last_heartbeat = time.monotonic()
         self.client: Optional[RpcClient] = None
+        # Latest per-scheduling-class lease backlog reported by heartbeat.
+        self.backlog: List[dict] = []
 
     def view(self) -> dict:
         return {
@@ -116,6 +118,14 @@ class GcsServer:
 
         self._view_version = 0
         self._view_log: "collections.deque" = collections.deque(maxlen=1024)
+        # Epoch/instance id: version numbers are meaningless across GCS
+        # restarts (a restored raylet's old-epoch version can be <= the new
+        # epoch's current version and silently skip restore-seeded entries),
+        # so every view reply carries this id and a mismatch forces a full
+        # snapshot.
+        import uuid
+
+        self._view_epoch = uuid.uuid4().hex
 
     def _spawn_bg(self, coro) -> "asyncio.Task":
         task = asyncio.ensure_future(coro)
@@ -265,39 +275,57 @@ class GcsServer:
         self._view_version += 1
         self._view_log.append((self._view_version, rec.view()))
 
-    def _view_deltas(self, known_version: int):
-        if (known_version > self._view_version
+    def _view_deltas(self, known_version: int,
+                     known_epoch: Optional[str] = None):
+        if (known_epoch != self._view_epoch
+                or known_version > self._view_version
                 or (self._view_log
                     and known_version < self._view_log[0][0] - 1)):
-            # Behind the capped log, or AHEAD of us (our epoch reset on a
-            # GCS restart while the raylet kept its old version): full
-            # snapshot either way — matching on raw version numbers across
-            # epochs would silently drop changes.
-            return {"version": self._view_version, "full": [
-                n.view() for n in self._nodes.values()]}
+            # Different GCS epoch (restart — raw version numbers don't
+            # compare across epochs), behind the capped log, or AHEAD of us:
+            # full snapshot either way — delta-matching would silently drop
+            # changes.
+            return {"version": self._view_version,
+                    "epoch": self._view_epoch,
+                    "full": [n.view() for n in self._nodes.values()]}
         latest: Dict[bytes, dict] = {}
         for ver, view in self._view_log:
             if ver > known_version:
                 latest[view["node_id"]] = view
         return {"version": self._view_version,
+                "epoch": self._view_epoch,
                 "deltas": list(latest.values())}
 
     async def handle_node_heartbeat(self, conn, node_id, available=None,
-                                    known_version: Optional[int] = None):
+                                    backlog=None,
+                                    known_version: Optional[int] = None,
+                                    known_epoch: Optional[str] = None):
         rec = self._nodes.get(node_id)
         if rec is None:
             return {"ok": False, "unknown": True}
         rec.last_heartbeat = time.monotonic()
+        if backlog is not None:
+            # Per-scheduling-class lease backlog (autoscaler demand feed,
+            # gcs_autoscaler_state_manager.cc analog). Not part of the
+            # versioned view — demand is advisory, not routing state.
+            rec.backlog = backlog
         if available is not None and rec.available != available:
             rec.available = dict(available)
             self._bump_view(rec)
         reply = {"ok": True}
         if known_version is not None:
-            reply["view"] = self._view_deltas(known_version)
+            reply["view"] = self._view_deltas(known_version, known_epoch)
         return reply
 
     async def handle_get_nodes(self, conn, only_alive=True):
         return [n.view() for n in self._nodes.values() if n.alive or not only_alive]
+
+    async def handle_cluster_demand(self, conn):
+        """Heartbeat-aggregated per-node lease backlog (autoscaler demand
+        feed — GcsAutoscalerStateManager analog): one RPC instead of a
+        node_stats fan-out to every raylet."""
+        return [{"node_id": n.node_id, "backlog": n.backlog}
+                for n in self._nodes.values() if n.alive and n.backlog]
 
     async def handle_drain_node(self, conn, node_id):
         await self._mark_node_dead(node_id, "drained")

@@ -13,6 +13,7 @@ placement-group bundle reservations (PlacementGroupResourceManager analog).
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import os
 import subprocess
@@ -78,7 +79,19 @@ class Raylet:
         self.gcs: Optional[RpcClient] = None
         self._workers: Dict[bytes, WorkerHandle] = {}
         self._idle: List[WorkerHandle] = []
-        self._pending: List[PendingLease] = []
+        # Per-scheduling-class lease queues (ClusterTaskManager analog,
+        # cluster_task_manager.cc:49 QueueAndScheduleTask / :188
+        # ScheduleAndDispatchTasks): a scheduling class = (resource shape,
+        # bundle), one FIFO per class, round-robin dispatch across classes
+        # so a backlogged shape can't head-of-line-block the others. All
+        # members of a class share one shape and pool, so a non-fitting
+        # head blocks only its class and dispatch is O(classes), not
+        # O(pending). Cluster-wide-infeasible classes park in _infeasible
+        # (they also feed autoscaler demand via heartbeat backlog) and are
+        # retried whenever the cluster resource view changes.
+        self._queues: "collections.OrderedDict[tuple, collections.deque]" = \
+            collections.OrderedDict()
+        self._infeasible: Dict[tuple, collections.deque] = {}
         # Placement-group bundle reservations: (pg_id, bundle_index) ->
         # {"resources": ..., "available": ...}; prepared-but-uncommitted hold
         # resources too (2PC).
@@ -90,6 +103,7 @@ class Raylet:
         self._cluster_view: List[dict] = []
         # Incremental resource-view sync state (see _heartbeat_loop).
         self._view_version = 0
+        self._view_epoch = None  # GCS instance id; mismatch -> full resync
         self._view_nodes: Dict[bytes, dict] = {}
 
     # ---- lifecycle -------------------------------------------------------
@@ -170,11 +184,14 @@ class Raylet:
                 reply = await self.gcs.call(
                     "node_heartbeat", node_id=self.node_id,
                     available=self.available,
-                    known_version=self._view_version)
+                    backlog=self._backlog(),
+                    known_version=self._view_version,
+                    known_epoch=self._view_epoch)
                 if reply.get("unknown"):
                     # Restarted GCS lost us (no durable storage): re-register.
                     await self._on_gcs_reconnect(self.gcs)
                     self._view_version = 0
+                    self._view_epoch = None
                     self._view_nodes.clear()
                 else:
                     self._apply_view(reply.get("view"))
@@ -197,7 +214,10 @@ class Raylet:
                     if not n.get("alive", True)]:
             del self._view_nodes[nid]
         self._view_version = view["version"]
+        self._view_epoch = view.get("epoch")
         self._cluster_view = list(self._view_nodes.values())
+        if self._infeasible and (view.get("full") or view.get("deltas")):
+            self._retry_infeasible()
 
     async def _memory_monitor_loop(self):
         """Kill one leased worker per tick while the node is over the memory
@@ -344,21 +364,53 @@ class Raylet:
             if idx is None:
                 return {"ok": False, "error": "placement group bundle not on this node"}
             pg_key = (placement_group_id, idx)
-        logger.debug("lease_worker: res=%s avail=%s pending=%d", resources, self.available, len(self._pending))
+        logger.debug("lease_worker: res=%s avail=%s pending=%d", resources,
+                     self.available, self._pending_count())
         fut = asyncio.get_event_loop().create_future()
-        self._pending.append(PendingLease(resources, for_actor, pg_key, fut, req_id))
+        req = PendingLease(resources, for_actor, pg_key, fut, req_id)
+        key = self._sched_class(resources, pg_key)
+        self._queues.setdefault(key, collections.deque()).append(req)
         await self._dispatch_pending()
         return await fut
+
+    @staticmethod
+    def _sched_class(resources: Dict[str, float],
+                     pg_key: Optional[Tuple[bytes, int]]) -> tuple:
+        """Scheduling-class key: resource shape + bundle. All requests in a
+        class draw the same amounts from the same pool, so feasibility is a
+        property of the CLASS, not the request."""
+        shape = tuple(sorted((k, float(v)) for k, v in resources.items()
+                             if v > scheduling.EPS))
+        return (shape, pg_key)
+
+    def _pending_count(self) -> int:
+        return (sum(len(q) for q in self._queues.values())
+                + sum(len(q) for q in self._infeasible.values()))
+
+    def _backlog(self) -> List[dict]:
+        """Per-class backlog for heartbeats/stats (autoscaler demand feed;
+        GcsAutoscalerStateManager analog)."""
+        out = []
+        for (shape, pg_key), q in list(self._queues.items()) + \
+                list(self._infeasible.items()):
+            if q:
+                out.append({"shape": dict(shape), "count": len(q),
+                            "infeasible": (shape, pg_key) in self._infeasible})
+        return out
 
     async def handle_cancel_lease_request(self, conn, req_id: bytes):
         """Cancel a lease request: still-queued -> dequeue; already granted
         (grant raced the caller's timeout) -> reclaim the worker."""
-        for req in self._pending:
-            if req.req_id == req_id:
-                self._pending.remove(req)
-                if not req.fut.done():
-                    req.fut.set_result({"ok": False, "canceled": True})
-                return {"ok": True}
+        for table in (self._queues, self._infeasible):
+            for key, q in list(table.items()):
+                for req in q:
+                    if req.req_id == req_id:
+                        q.remove(req)
+                        if not q:
+                            del table[key]
+                        if not req.fut.done():
+                            req.fut.set_result({"ok": False, "canceled": True})
+                        return {"ok": True}
         for w in self._workers.values():
             if w.req_id == req_id and w.lease_id is not None:
                 scheduling.add(self._lease_pool(w.pg_key), w.lease_resources)
@@ -380,65 +432,125 @@ class Raylet:
         return None
 
     async def _dispatch_pending(self):
-        """FIFO-with-skip dispatch: grant every queued lease that fits."""
-        granted = True
-        while granted:
-            granted = False
-            for req in list(self._pending):
-                try:
-                    pool = self._lease_pool(req.pg_key)
-                except RuntimeError as e:
-                    self._pending.remove(req)
-                    if not req.fut.done():
-                        req.fut.set_result({"ok": False, "error": str(e)})
-                    continue
-                if not scheduling.fits(pool, req.resources):
-                    if not scheduling.fits(self.total_resources if req.pg_key is None
-                                           else self._bundles[req.pg_key]["resources"],
-                                           req.resources):
-                        self._pending.remove(req)
-                        asyncio.ensure_future(self._resolve_spillback(req))
-                    continue
-                scheduling.subtract(pool, req.resources)
-                self._pending.remove(req)
-                granted = True
-                metric_defs.LEASES_GRANTED.inc()
-                logger.debug("dispatch: granting lease res=%s avail=%s", req.resources, self.available)
-                asyncio.ensure_future(self._grant_lease(req))
-        metric_defs.PENDING_LEASES.set(len(self._pending))
+        """Per-class round-robin dispatch (ScheduleAndDispatchTasks analog,
+        cluster_task_manager.cc:188 + local_task_manager.cc:57).
 
-    async def _resolve_spillback(self, req: PendingLease):
-        metric_defs.LEASES_SPILLED.inc()
-        if req.fut.done():
-            return
-        reply = self._spillback_or_fail(req)
-        if not reply.get("ok") and "spillback" not in reply:
+        Each pass walks the scheduling classes once; within a class, grants
+        run strictly FIFO from the head while the class's pool fits the
+        shape. A class whose head can't be placed locally either blocks
+        (in-use resources will free up), spills its whole queue (another
+        node's total capacity fits — the shape is identical for every
+        member), or parks as infeasible. Classes that received a grant
+        rotate to the back so a hot shape can't starve the rest."""
+        progressed = True
+        while progressed:
+            progressed = False
+            for key in list(self._queues.keys()):
+                q = self._queues.get(key)
+                if not q:
+                    self._queues.pop(key, None)
+                    continue
+                granted_here = 0
+                while q:
+                    req = q[0]
+                    if req.fut.done():  # canceled under us
+                        q.popleft()
+                        continue
+                    try:
+                        pool = self._lease_pool(req.pg_key)
+                    except RuntimeError as e:
+                        q.popleft()
+                        if not req.fut.done():
+                            req.fut.set_result({"ok": False, "error": str(e)})
+                        continue
+                    if not scheduling.fits(pool, req.resources):
+                        cap = (self.total_resources if req.pg_key is None
+                               else self._bundles[req.pg_key]["resources"])
+                        if not scheduling.fits(cap, req.resources):
+                            # Never placeable here: spill/park the whole
+                            # class (identical shape -> identical verdict).
+                            del self._queues[key]
+                            asyncio.ensure_future(
+                                self._resolve_spillback_class(key, q))
+                        break  # class blocked locally; next class
+                    scheduling.subtract(pool, req.resources)
+                    q.popleft()
+                    granted_here += 1
+                    progressed = True
+                    metric_defs.LEASES_GRANTED.inc()
+                    logger.debug("dispatch: granting lease res=%s avail=%s",
+                                 req.resources, self.available)
+                    asyncio.ensure_future(self._grant_lease(req))
+                if not self._queues.get(key):
+                    self._queues.pop(key, None)
+                elif granted_here:
+                    self._queues.move_to_end(key)
+        metric_defs.PENDING_LEASES.set(self._pending_count())
+
+    async def _resolve_spillback_class(self, key: tuple, q: "collections.deque"):
+        """A class that can never run locally: route every member to the
+        best remote node, or park the class as infeasible until the cluster
+        view changes (reference keeps infeasible tasks queued and feeds
+        them to the autoscaler rather than erroring,
+        cluster_task_manager.cc infeasible_tasks_)."""
+        reply = self._spillback_for_shape(dict(key[0]))
+        if reply is None:
             # The gossip view can lag a just-registered node; confirm against
-            # the GCS before declaring the request infeasible cluster-wide.
+            # the GCS before declaring the class infeasible cluster-wide.
             try:
                 self._cluster_view = await self.gcs.call("get_nodes")
-                reply = self._spillback_or_fail(req)
+                reply = self._spillback_for_shape(dict(key[0]))
             except Exception:
                 pass
-        if not req.fut.done():
-            req.fut.set_result(reply)
+        live = collections.deque(r for r in q if not r.fut.done())
+        if reply is None:
+            if live:
+                logger.warning(
+                    "lease class %s infeasible cluster-wide; parking %d "
+                    "request(s) until resources appear", key[0], len(live))
+                old = self._infeasible.get(key)
+                if old:
+                    old.extend(live)
+                else:
+                    self._infeasible[key] = live
+                metric_defs.PENDING_LEASES.set(self._pending_count())
+            return
+        for req in live:
+            if not req.fut.done():
+                metric_defs.LEASES_SPILLED.inc()
+                req.fut.set_result(reply)
 
-    def _spillback_or_fail(self, req: PendingLease) -> dict:
-        """Locally-infeasible lease: route the client to a node whose total
-        capacity fits (HandleRequestWorkerLease spillback reply,
-        cluster_resource_scheduler.cc:149 GetBestSchedulableNode)."""
+    def _spillback_for_shape(self, resources: Dict[str, float]) -> Optional[dict]:
+        """Best remote node whose TOTAL capacity fits the shape
+        (HandleRequestWorkerLease spillback reply,
+        cluster_resource_scheduler.cc:149 GetBestSchedulableNode), or None."""
         candidates = [
             n for n in self._cluster_view
             if n.get("alive") and n["node_id"] != self.node_id
-            and scheduling.fits(n["resources"], req.resources)]
+            and scheduling.fits(n["resources"], resources)]
         if not candidates:
-            return {"ok": False,
-                    "error": f"infeasible resources {req.resources}: no node in the "
-                             "cluster has enough total capacity"}
+            return None
         best = min(candidates, key=lambda n: scheduling.utilization_score(
-            n["resources"], n.get("available", n["resources"]), req.resources))
+            n["resources"], n.get("available", n["resources"]), resources))
         return {"ok": False, "spillback": tuple(best["address"]),
                 "spillback_node": best["node_id"]}
+
+    def _retry_infeasible(self):
+        """Cluster view changed: re-queue parked classes that some node's
+        total capacity now satisfies (or that now fit locally)."""
+        for key in list(self._infeasible.keys()):
+            shape = dict(key[0])
+            cap = self.total_resources if key[1] is None else \
+                self._bundles.get(key[1], {}).get("resources", {})
+            if (scheduling.fits(cap, shape)
+                    or self._spillback_for_shape(shape) is not None):
+                q = self._infeasible.pop(key)
+                old = self._queues.get(key)
+                if old:
+                    old.extend(q)
+                else:
+                    self._queues[key] = q
+                asyncio.ensure_future(self._dispatch_pending())
 
     async def _grant_lease(self, req: PendingLease):
         try:
@@ -523,6 +635,7 @@ class Raylet:
         if b is None:
             return {"ok": False}
         b["committed"] = True
+        self._retry_infeasible()
         await self._dispatch_pending()
         return {"ok": True}
 
@@ -706,7 +819,8 @@ class Raylet:
             "available": self.available,
             "num_workers": len(self._workers),
             "num_idle": len(self._idle),
-            "num_pending_leases": len(self._pending),
+            "num_pending_leases": self._pending_count(),
+            "backlog": self._backlog(),
             "object_store_used": self.store.used if self.store else 0,
             "object_store_capacity": self.store.capacity if self.store else 0,
             "bundles": [

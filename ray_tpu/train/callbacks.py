@@ -82,6 +82,7 @@ class CSVLoggerCallback(Callback):
 
     def __init__(self):
         self._f = None
+        self._writer = None
         self._keys: Optional[List[str]] = None
 
     def on_run_start(self, run_name, path):
@@ -90,24 +91,36 @@ class CSVLoggerCallback(Callback):
         # Resumed run (same name/dir): reuse the existing header so appended
         # rows keep the column layout instead of a second mid-file header.
         if os.path.exists(target) and os.path.getsize(target) > 0:
-            with open(target) as f:
-                self._keys = f.readline().strip().split(",")
-        self._f = open(target, "a")
+            import csv
+
+            with open(target, newline="") as f:
+                self._keys = next(csv.reader(f), None)
+        self._f = open(target, "a", newline="")
+        self._writer = None
 
     def on_result(self, metrics, iteration):
         if self._f is None:
             return
+        import csv
+
         if self._keys is None:
             self._keys = ["iteration"] + sorted(metrics)
-            self._f.write(",".join(self._keys) + "\n")
-        row = {"iteration": iteration, **metrics}
-        self._f.write(",".join(str(row.get(k, "")) for k in self._keys) + "\n")
+        if self._writer is None:
+            # DictWriter quotes embedded commas/newlines and makes the
+            # header contract explicit: keys not in the first result are
+            # dropped by policy, not by accident.
+            self._writer = csv.DictWriter(self._f, fieldnames=self._keys,
+                                          extrasaction="ignore")
+            if self._f.tell() == 0:
+                self._writer.writeheader()
+        self._writer.writerow({"iteration": iteration, **metrics})
         self._f.flush()
 
     def on_run_end(self, result):
         if self._f is not None:
             self._f.close()
             self._f = None
+            self._writer = None
 
 
 class TensorBoardLoggerCallback(Callback):

@@ -41,37 +41,45 @@ def test_view_deltas_and_full_resync():
         gcs._bump_view(a)
         gcs._bump_view(b)
 
+        epoch = gcs._view_epoch
         # From version 0: both nodes arrive as deltas.
-        view = gcs._view_deltas(0)
+        view = gcs._view_deltas(0, epoch)
         assert view["version"] == 2
         assert {n["node_id"] for n in view["deltas"]} == {a.node_id, b.node_id}
 
         # Caught up: empty deltas.
-        view = gcs._view_deltas(2)
+        view = gcs._view_deltas(2, epoch)
         assert view["deltas"] == []
+
+        # Unknown/stale epoch (e.g. GCS restarted): full snapshot even when
+        # the version numbers happen to line up.
+        view = gcs._view_deltas(2, "someone-elses-epoch")
+        assert "full" in view and view["epoch"] == epoch
 
         # One availability change -> exactly one delta.
         reply = await gcs.handle_node_heartbeat(
-            conn, a.node_id, available={"CPU": 1.0}, known_version=2)
+            conn, a.node_id, available={"CPU": 1.0}, known_version=2,
+            known_epoch=epoch)
         assert [n["node_id"] for n in reply["view"]["deltas"]] == [a.node_id]
         assert reply["view"]["deltas"][0]["available"] == {"CPU": 1.0}
 
         # Unchanged availability does NOT bump the version.
         v = gcs._view_version
         await gcs.handle_node_heartbeat(
-            conn, a.node_id, available={"CPU": 1.0}, known_version=v)
+            conn, a.node_id, available={"CPU": 1.0}, known_version=v,
+            known_epoch=epoch)
         assert gcs._view_version == v
 
         # Falling behind the capped log forces a full snapshot.
         for _ in range(1100):
             gcs._bump_view(a)
-        view = gcs._view_deltas(3)
+        view = gcs._view_deltas(3, epoch)
         assert "full" in view and len(view["full"]) == 2
 
         # Node death appears as a not-alive delta.
         v = gcs._view_version
         await gcs._mark_node_dead(b.node_id, "test")
-        view = gcs._view_deltas(v)
+        view = gcs._view_deltas(v, epoch)
         dead = [n for n in view["deltas"] if n["node_id"] == b.node_id]
         assert dead and dead[0]["alive"] is False
 
@@ -118,15 +126,18 @@ def test_worker_prestart_speeds_first_task():
             time.sleep(0.2)
         assert stats.get("num_idle", 0) >= 2, stats
 
+        started_before = stats.get("num_workers", 0)
+
         @ray_tpu.remote
         def f():
             return 1
 
-        t0 = time.monotonic()
         assert ray_tpu.get(f.remote(), timeout=30) == 1
-        first_task = time.monotonic() - t0
-        # A cold spawn takes ~0.5-1.5s (python + jax-less import chain);
-        # reusing a warm worker must be well under that.
-        assert first_task < 0.5, f"first task took {first_task:.2f}s"
+        # Assert the MECHANISM, not wall-clock (flaky on loaded CI): the
+        # first lease reused a prestarted worker, so no new process was
+        # spawned and at least one warm worker remains idle.
+        stats = core.io.run(core.raylet.call("node_stats"))
+        assert stats.get("num_workers", 0) <= started_before, stats
+        assert stats.get("num_idle", 0) >= 1, stats
     finally:
         ray_tpu.shutdown()
