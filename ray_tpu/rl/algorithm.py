@@ -61,7 +61,9 @@ class EnvRunner:
             for i in np.where(done)[0]:
                 self.episode_returns.append(float(self._running_return[i]))
                 self._running_return[i] = 0.0
-            self.obs = next_obs
+            # next_obs keeps terminal rows (the true s'); act next on
+            # the post-auto-reset state or boundary transitions corrupt.
+            self.obs = self.env.current_obs()
         _, last_value = self.forward(params, jnp.asarray(self.obs))
         return {
             "obs": np.stack(obs_buf),

@@ -58,24 +58,33 @@ def q_forward(params: Dict, obs: jax.Array) -> jax.Array:
     return x @ last["w"] + last["b"]
 
 
+def double_dqn_target(params, target_params, batch, gamma: float):
+    """Double-DQN TD target: the online net picks the argmax action, the
+    target net evaluates it; (1-done) masks the bootstrap. Shared by DQN
+    (online) and CQL (offline, rl/cql.py)."""
+    next_q_online = q_forward(params, batch["next_obs"])
+    next_actions = jnp.argmax(next_q_online, axis=1)
+    next_q_target = q_forward(target_params, batch["next_obs"])
+    next_q = jnp.take_along_axis(
+        next_q_target, next_actions[:, None], axis=1)[:, 0]
+    return batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+        jax.lax.stop_gradient(next_q)
+
+
+def huber(td: jax.Array) -> jax.Array:
+    return jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2, jnp.abs(td) - 0.5)
+
+
 def make_update_fn(config: DQNConfig, optimizer):
     def loss_fn(params, target_params, batch):
         q = q_forward(params, batch["obs"])
         q_taken = jnp.take_along_axis(
             q, batch["actions"][:, None], axis=1)[:, 0]
-        # Double DQN: online net picks the argmax, target net evaluates it.
-        next_q_online = q_forward(params, batch["next_obs"])
-        next_actions = jnp.argmax(next_q_online, axis=1)
-        next_q_target = q_forward(target_params, batch["next_obs"])
-        next_q = jnp.take_along_axis(
-            next_q_target, next_actions[:, None], axis=1)[:, 0]
-        target = batch["rewards"] + config.gamma * (1.0 - batch["dones"]) * \
-            jax.lax.stop_gradient(next_q)
-        td = q_taken - target
-        huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
-                          jnp.abs(td) - 0.5)
-        weights = batch.get("weights", jnp.ones_like(huber))
-        return (weights * huber).mean(), td
+        td = q_taken - double_dqn_target(params, target_params, batch,
+                                         config.gamma)
+        losses = huber(td)
+        weights = batch.get("weights", jnp.ones_like(losses))
+        return (weights * losses).mean(), td
 
     @jax.jit
     def update(params, target_params, opt_state, batch):
@@ -124,7 +133,9 @@ class DQNRunner:
             for i in np.where(done)[0]:
                 self.episode_returns.append(float(self._running[i]))
                 self._running[i] = 0.0
-            self.obs = next_obs
+            # next_obs keeps terminal rows (the true s'); act next on
+            # the post-auto-reset state or boundary transitions corrupt.
+            self.obs = self.env.current_obs()
         return {
             "obs": np.concatenate(obs_b).astype(np.float32),
             "actions": np.concatenate(act_b).astype(np.int32),

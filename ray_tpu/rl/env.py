@@ -58,9 +58,17 @@ class VectorCartPole:
         done = (np.abs(x) > 2.4) | (np.abs(th) > 0.2095) | \
             (self.steps >= self.max_steps)
         reward = np.ones(self.n, dtype=np.float32)
-        obs = self.state.copy()
+        obs = self.state.copy()  # TRUE next state (terminal rows included)
         self._reset_done(done)
         return obs, reward, done
+
+    def current_obs(self) -> np.ndarray:
+        """Observation to act on NEXT step: equals step()'s returned obs for
+        live rows and the post-auto-reset state for done rows. Runners must
+        use this (not the returned obs) to continue the rollout — carrying
+        the terminal observation across an episode boundary pairs a dead
+        episode's state with the fresh episode's dynamics."""
+        return self.state.copy()
 
 
 ENVS = {"CartPole-v1": VectorCartPole}

@@ -144,7 +144,9 @@ class ImpalaRunner:
             for i in np.where(done)[0]:
                 self.episode_returns.append(float(self._running[i]))
                 self._running[i] = 0.0
-            self.obs = next_obs
+            # next_obs keeps terminal rows (the true s'); act next on
+            # the post-auto-reset state or boundary transitions corrupt.
+            self.obs = self.env.current_obs()
         return {
             "obs": np.stack(obs_b).astype(np.float32),          # [T, B, D]
             "actions": np.stack(act_b).astype(np.int32),

@@ -31,8 +31,10 @@ from ray_tpu.rl.ppo import PPOConfig, init_policy, policy_forward
 
 
 class EpisodeWriter:
-    """Buffers transitions and writes columnar shards:
-    {obs, actions, rewards, dones} per shard (SampleBatch-shaped)."""
+    """Buffers transitions and writes columnar shards — collect_episodes
+    stores {obs, actions, rewards, dones, next_obs} per shard
+    (SampleBatch-shaped; next_obs keeps terminal states so TD learners get
+    complete transitions)."""
 
     def __init__(self, path: str, shard_size: int = 4096):
         os.makedirs(path, exist_ok=True)
@@ -73,6 +75,20 @@ def read_episodes(path: str) -> Dict[str, np.ndarray]:
             for k in z.files:
                 cols.setdefault(k, []).append(z[k])
     return {k: np.concatenate(v) for k, v in cols.items()}
+
+
+def iterate_minibatches(rng: np.random.Generator, batch: Dict[str, np.ndarray],
+                        batch_size: int, epochs: int) -> Iterator[Dict]:
+    """Shuffled drop-remainder minibatches over a columnar batch, shared by
+    the offline trainers (MARWIL/BC here, CQL in rl/cql.py) so epoch
+    semantics can't drift between them."""
+    n = len(next(iter(batch.values())))
+    bs = min(batch_size, n)
+    for _ in range(epochs):
+        idx = rng.permutation(n)
+        for start in range(0, n - bs + 1, bs):
+            yield {k: jnp.asarray(v[idx[start:start + bs]])
+                   for k, v in batch.items()}
 
 
 def monte_carlo_returns(rewards: np.ndarray, dones: np.ndarray,
@@ -159,16 +175,12 @@ class MARWIL:
         self.rng = np.random.default_rng(seed)
 
     def train(self) -> Dict:
-        n = len(self.batch["obs"])
-        bs = min(self.config.batch_size, n)
         metrics = {}
-        for _ in range(self.config.epochs):
-            idx = self.rng.permutation(n)
-            for start in range(0, n - bs + 1, bs):
-                mb = {k: jnp.asarray(v[idx[start:start + bs]])
-                      for k, v in self.batch.items()}
-                self.params, self.opt_state, metrics = self.update(
-                    self.params, self.opt_state, mb)
+        for mb in iterate_minibatches(self.rng, self.batch,
+                                      self.config.batch_size,
+                                      self.config.epochs):
+            self.params, self.opt_state, metrics = self.update(
+                self.params, self.opt_state, mb)
         return {k: float(v) for k, v in metrics.items()}
 
     def action_logits(self, obs: np.ndarray) -> np.ndarray:
@@ -210,7 +222,10 @@ def collect_episodes(env_name: str, path: str, *, n_steps: int = 2048,
             actions = rng.integers(0, cfg.n_actions, size=len(obs))
         next_obs, reward, done = env.step(actions)
         writer.add_batch({"obs": obs, "actions": actions, "rewards": reward,
-                          "dones": done.astype(np.float32)})
-        obs = next_obs
+                          "dones": done.astype(np.float32),
+                          "next_obs": next_obs})
+        # next_obs keeps terminal rows (the true s' for the stored
+        # transition); act next on the post-auto-reset state.
+        obs = env.current_obs()
     writer.flush()
     return path

@@ -158,7 +158,9 @@ class SACRunner:
             for i in np.where(done)[0]:
                 self.episode_returns.append(float(self._running[i]))
                 self._running[i] = 0.0
-            self.obs = next_obs
+            # next_obs keeps terminal rows (the true s'); act next on
+            # the post-auto-reset state or boundary transitions corrupt.
+            self.obs = self.env.current_obs()
         return {
             "obs": np.concatenate(obs_b).astype(np.float32),
             "actions": np.concatenate(act_b).astype(np.int32),

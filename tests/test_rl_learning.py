@@ -1,0 +1,124 @@
+"""Learning-QUALITY tests: losses that go down is not enough — reward must
+go up, so a silently-broken loss (sign flip, detached grad, wrong target)
+fails the suite.
+
+Reference test model: rllib/tuned_examples/ (CI runs algorithms to a reward
+threshold); scaled to the 1-core dev box with fixed seeds and bounded
+iteration counts, asserting improvement over the untrained/behavior policy
+rather than full convergence.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster(cpu_jax):
+    ray_tpu.init(num_cpus=3)
+    yield
+    ray_tpu.shutdown()
+
+
+def _mean_tail(history, k=3):
+    return float(np.mean(history[-k:]))
+
+
+def test_ppo_improves_cartpole(cluster):
+    """PPO lifts CartPole return well above the random-policy baseline
+    (~20) within a bounded budget (rllib/tuned_examples/ppo analog)."""
+    from ray_tpu.rl.algorithm import PPO
+    from ray_tpu.rl.ppo import PPOConfig
+
+    algo = PPO(PPOConfig(num_env_runners=2, envs_per_runner=4,
+                         rollout_length=128, minibatches=4, epochs=4))
+    try:
+        history = []
+        for _ in range(20):
+            r = algo.train()
+            if r["episode_return_mean"]:
+                history.append(r["episode_return_mean"])
+        early = float(np.mean(history[:3]))
+        late = _mean_tail(history)
+        assert late > early + 15, (early, late, history)
+        assert late > 45, (late, history)  # random policy: ~20
+    finally:
+        algo.stop()
+
+
+def test_dqn_improves_cartpole(cluster):
+    from ray_tpu.rl.dqn import DQN, DQNConfig
+
+    algo = DQN(DQNConfig(num_env_runners=2, envs_per_runner=4,
+                         rollout_length=64, learning_starts=256,
+                         train_batch_size=128, updates_per_iteration=48,
+                         epsilon_decay_steps=3_000, lr=2e-3,
+                         target_update_tau=0.05))
+    try:
+        history = []
+        for _ in range(24):
+            r = algo.train()
+            if r["episode_return_mean"]:
+                history.append(r["episode_return_mean"])
+        early = float(np.mean(history[:3]))
+        late = _mean_tail(history)
+        assert late > early + 10, (early, late, history)
+        assert late > 40, (late, history)
+    finally:
+        algo.stop()
+
+
+def test_cql_beats_behavior_policy(cpu_jax, tmp_path):
+    """CQL trained on a RANDOM-policy dataset must act better than the
+    behavior policy that produced the data (the whole point of offline
+    RL), evaluated greedily in the live env
+    (rllib/algorithms/cql analog on the discrete critic)."""
+    from ray_tpu.rl.cql import CQL, CQLConfig
+    from ray_tpu.rl.env import make_env
+    from ray_tpu.rl.offline import collect_episodes, read_episodes
+
+    path = collect_episodes("CartPole-v1", str(tmp_path / "data"),
+                            n_steps=8_192, seed=0)
+    data = read_episodes(path)
+    assert "next_obs" in data  # transition-complete shards
+
+    # Behavior (random) policy baseline: mean episode length in the data.
+    dones = data["dones"]
+    behavior_return = len(dones) / max(1.0, float(dones.sum()))
+
+    algo = CQL(CQLConfig(alpha=1.0, epochs=30, batch_size=512,
+                         lr=3e-4), path, seed=0)
+    algo.train()
+
+    env = make_env("CartPole-v1", 8, seed=123)
+    obs = env.reset()
+    done_count, step_count = 0.0, 0
+    for _ in range(400):
+        obs, _r, done = env.step(algo.greedy_actions(obs))
+        done_count += float(done.sum())
+        step_count += len(done)
+    eval_return = step_count / max(1.0, done_count)
+    assert eval_return > behavior_return * 1.5, \
+        (behavior_return, eval_return)
+    assert eval_return > 40, (behavior_return, eval_return)
+
+
+def test_cql_conservatism_vs_dqn_offline(cpu_jax, tmp_path):
+    """The conservative term must actually bite: on the same offline data,
+    CQL's Q-values for out-of-distribution (greedy) actions stay below
+    plain offline double-DQN's (alpha=0), the over-estimation CQL exists
+    to fix."""
+    from ray_tpu.rl.cql import CQL, CQLConfig
+    from ray_tpu.rl.offline import collect_episodes
+
+    path = collect_episodes("CartPole-v1", str(tmp_path / "data"),
+                            n_steps=4_096, seed=1)
+    conservative = CQL(CQLConfig(alpha=2.0, epochs=15, batch_size=512), path)
+    plain = CQL(CQLConfig(alpha=0.0, epochs=15, batch_size=512), path)
+    conservative.train()
+    plain.train()
+    obs = conservative.batch["obs"][:512]
+    q_cons = conservative.q_values(obs).max(-1).mean()
+    q_plain = plain.q_values(obs).max(-1).mean()
+    assert q_cons < q_plain, (q_cons, q_plain)
