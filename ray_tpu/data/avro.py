@@ -1,0 +1,328 @@
+"""Avro Object Container File I/O without the avro package.
+
+Reference analog: python/ray/data/read_api.py read_avro (delegates to the
+`avro`/`fastavro` packages). The OCF format is small enough to speak
+directly: header (magic, metadata map with JSON schema + codec, 16-byte
+sync marker) followed by data blocks (record count, byte size, payload,
+sync marker). Codecs: null and deflate (raw RFC-1951, no zlib header).
+
+Supported schema subset — the types a columnar pipeline produces:
+null, boolean, int, long, float, double, bytes, string, enum, fixed,
+record (named fields), array, map, and unions thereof.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, Iterator, List, Tuple
+
+MAGIC = b"Obj\x01"
+
+
+# ------------------------------------------------------------ primitives
+
+def _zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63) if n < 0 else n << 1
+
+
+def _zigzag_decode(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def write_long(out: io.BytesIO, n: int) -> None:
+    n = _zigzag_encode(n)
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            return
+
+
+def read_long(buf: io.BytesIO) -> int:
+    result = shift = 0
+    while True:
+        raw = buf.read(1)
+        if not raw:
+            raise EOFError("truncated avro varint")
+        b = raw[0]
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return _zigzag_decode(result)
+        shift += 7
+
+
+def _write_bytes(out, data: bytes) -> None:
+    write_long(out, len(data))
+    out.write(data)
+
+
+def _read_bytes(buf) -> bytes:
+    n = read_long(buf)
+    return buf.read(n)
+
+
+# ------------------------------------------------------------ datum codec
+
+def write_datum(out, schema, value) -> None:
+    stype = schema["type"] if isinstance(schema, dict) else schema
+    if isinstance(stype, list):  # union spelled as the schema itself
+        schema, stype = {"type": stype}, "union"
+    if isinstance(schema, dict) and isinstance(schema.get("type"), list):
+        stype = "union"
+    if stype == "union":
+        branches = schema["type"] if isinstance(schema, dict) else schema
+        idx = _union_index(branches, value)
+        write_long(out, idx)
+        write_datum(out, branches[idx], value)
+    elif stype == "null":
+        pass
+    elif stype == "boolean":
+        out.write(b"\x01" if value else b"\x00")
+    elif stype in ("int", "long"):
+        write_long(out, int(value))
+    elif stype == "float":
+        out.write(struct.pack("<f", float(value)))
+    elif stype == "double":
+        out.write(struct.pack("<d", float(value)))
+    elif stype == "bytes":
+        _write_bytes(out, bytes(value))
+    elif stype == "string":
+        if isinstance(value, str):
+            _write_bytes(out, value.encode("utf-8"))
+        elif isinstance(value, (bytes, bytearray)):
+            _write_bytes(out, bytes(value))
+        else:
+            # bytes(int) would silently write NUL runs — refuse instead.
+            raise TypeError(
+                f"avro string field got {type(value).__name__}: {value!r}")
+    elif stype == "enum":
+        write_long(out, schema["symbols"].index(value))
+    elif stype == "fixed":
+        out.write(bytes(value))
+    elif stype == "record":
+        for field in schema["fields"]:
+            write_datum(out, field["type"], value[field["name"]])
+    elif stype == "array":
+        items = list(value)
+        if items:
+            write_long(out, len(items))
+            for item in items:
+                write_datum(out, schema["items"], item)
+        write_long(out, 0)
+    elif stype == "map":
+        if value:
+            write_long(out, len(value))
+            for k, v in value.items():
+                _write_bytes(out, k.encode("utf-8"))
+                write_datum(out, schema["values"], v)
+        write_long(out, 0)
+    else:
+        raise ValueError(f"unsupported avro type {stype!r}")
+
+
+def _union_index(branches, value) -> int:
+    def name(b):
+        return b["type"] if isinstance(b, dict) else b
+
+    if value is None:
+        return next(i for i, b in enumerate(branches) if name(b) == "null")
+    for i, b in enumerate(branches):
+        n = name(b)
+        if n == "null":
+            continue
+        if n == "boolean" and isinstance(value, bool):
+            return i
+        if n in ("int", "long") and isinstance(value, int):
+            return i
+        if n in ("float", "double") and isinstance(value, float):
+            return i
+        if n == "string" and isinstance(value, str):
+            return i
+        if n == "bytes" and isinstance(value, (bytes, bytearray)):
+            return i
+        if n in ("record", "array", "map", "enum", "fixed"):
+            return i
+    # Fall back to the first non-null branch.
+    return next(i for i, b in enumerate(branches) if name(b) != "null")
+
+
+def read_datum(buf, schema):
+    stype = schema["type"] if isinstance(schema, dict) else schema
+    if isinstance(stype, list):
+        branches = stype
+        idx = read_long(buf)
+        return read_datum(buf, branches[idx])
+    if stype == "union":
+        branches = schema["type"]
+        idx = read_long(buf)
+        return read_datum(buf, branches[idx])
+    if stype == "null":
+        return None
+    if stype == "boolean":
+        return buf.read(1) == b"\x01"
+    if stype in ("int", "long"):
+        return read_long(buf)
+    if stype == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if stype == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if stype == "bytes":
+        return _read_bytes(buf)
+    if stype == "string":
+        return _read_bytes(buf).decode("utf-8")
+    if stype == "enum":
+        return schema["symbols"][read_long(buf)]
+    if stype == "fixed":
+        return buf.read(schema["size"])
+    if stype == "record":
+        return {f["name"]: read_datum(buf, f["type"])
+                for f in schema["fields"]}
+    if stype == "array":
+        out: List = []
+        while True:
+            count = read_long(buf)
+            if count == 0:
+                return out
+            if count < 0:  # block with byte size prefix
+                read_long(buf)
+                count = -count
+            for _ in range(count):
+                out.append(read_datum(buf, schema["items"]))
+    if stype == "map":
+        result: Dict = {}
+        while True:
+            count = read_long(buf)
+            if count == 0:
+                return result
+            if count < 0:
+                read_long(buf)
+                count = -count
+            for _ in range(count):
+                k = _read_bytes(buf).decode("utf-8")
+                result[k] = read_datum(buf, schema["values"])
+    raise ValueError(f"unsupported avro type {stype!r}")
+
+
+# ----------------------------------------------------------- file format
+
+def write_file(path: str, schema: Dict, rows: List[Dict], *,
+               codec: str = "deflate", records_per_block: int = 4096) -> int:
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"unsupported codec {codec!r}")
+    sync = os.urandom(16)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        meta = io.BytesIO()
+        entries = {"avro.schema": json.dumps(schema).encode("utf-8"),
+                   "avro.codec": codec.encode("utf-8")}
+        write_long(meta, len(entries))
+        for k, v in entries.items():
+            _write_bytes(meta, k.encode("utf-8"))
+            _write_bytes(meta, v)
+        write_long(meta, 0)
+        f.write(meta.getvalue())
+        f.write(sync)
+        for start in range(0, len(rows), records_per_block):
+            chunk = rows[start:start + records_per_block]
+            body = io.BytesIO()
+            for row in chunk:
+                write_datum(body, schema, row)
+            payload = body.getvalue()
+            if codec == "deflate":
+                comp = zlib.compressobj(wbits=-15)
+                payload = comp.compress(payload) + comp.flush()
+            head = io.BytesIO()
+            write_long(head, len(chunk))
+            write_long(head, len(payload))
+            f.write(head.getvalue())
+            f.write(payload)
+            f.write(sync)
+    return len(rows)
+
+
+def read_file(path: str) -> Tuple[Dict, List[Dict]]:
+    with open(path, "rb") as f:
+        raw = f.read()
+    buf = io.BytesIO(raw)
+    if buf.read(4) != MAGIC:
+        raise ValueError(f"{path}: not an avro object container file")
+    meta: Dict[str, bytes] = {}
+    while True:
+        count = read_long(buf)
+        if count == 0:
+            break
+        if count < 0:
+            read_long(buf)
+            count = -count
+        for _ in range(count):
+            k = _read_bytes(buf).decode("utf-8")
+            meta[k] = _read_bytes(buf)
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode("utf-8")
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"{path}: unsupported codec {codec!r}")
+    sync = buf.read(16)
+    rows: List[Dict] = []
+    while buf.tell() < len(raw):
+        count = read_long(buf)
+        size = read_long(buf)
+        payload = buf.read(size)
+        if codec == "deflate":
+            payload = zlib.decompress(payload, wbits=-15)
+        block = io.BytesIO(payload)
+        for _ in range(count):
+            rows.append(read_datum(block, schema))
+        if buf.read(16) != sync:
+            raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
+    return schema, rows
+
+
+# ------------------------------------------------------- schema inference
+
+def _primitive_type(sample) -> str:
+    import numpy as np
+
+    if isinstance(sample, (bool, np.bool_)):
+        return "boolean"
+    if isinstance(sample, (int, np.integer)):
+        return "long"
+    if isinstance(sample, (float, np.floating)):
+        return "double"
+    if isinstance(sample, (bytes, bytearray)):
+        return "bytes"
+    return "string"
+
+
+def infer_schema(rows: List[Dict], name: str = "Row") -> Dict:
+    """Record schema from sample rows; columns with missing/None values
+    become nullable unions. Array items and map values take the type of
+    the first non-empty element seen across the sample."""
+    import numpy as np
+
+    fields = []
+    from ray_tpu.data.block import union_keys
+
+    keys = union_keys(rows)
+    for k in keys:
+        values = [r.get(k) for r in rows]
+        nullable = any(v is None for v in values)
+        sample = next((v for v in values if v is not None), None)
+        if isinstance(sample, (list, tuple, np.ndarray)):
+            inner = next((x for v in values if v is not None
+                          for x in v), None)
+            t: Any = {"type": "array", "items": _primitive_type(inner)}
+        elif isinstance(sample, dict):
+            inner = next((x for v in values if v
+                          for x in v.values()), None)
+            t = {"type": "map", "values": _primitive_type(inner)}
+        else:
+            t = _primitive_type(sample)
+        fields.append({"name": k, "type": ["null", t] if nullable else t})
+    return {"type": "record", "name": name, "fields": fields}

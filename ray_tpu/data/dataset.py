@@ -22,6 +22,7 @@ class Dataset:
     def __init__(self, ops: List[plan_mod.LogicalOp], parallelism: int = 8):
         self._ops = ops
         self._parallelism = parallelism
+        self._last_stats = None  # DatasetStats of the most recent execution
 
     # ---- transforms (lazy) ----------------------------------------------
 
@@ -62,9 +63,23 @@ class Dataset:
     # ---- execution -------------------------------------------------------
 
     def iter_blocks(self) -> Iterator[Block]:
-        from ray_tpu.data.execution import execute_streaming
+        from ray_tpu.data.execution import DatasetStats, execute_streaming
 
-        yield from execute_streaming(self._ops, self._parallelism)
+        stats = DatasetStats()
+        yield from execute_streaming(self._ops, self._parallelism,
+                                     stats=stats)
+        self._last_stats = stats.finalize()
+
+    def stats(self) -> str:
+        """Per-operator wall/blocks/rows/bytes of the most recent execution
+        (reference analog: Dataset.stats(), data/_internal/stats.py).
+        Executes the plan if it has not run yet."""
+        if self._last_stats is None:
+            for _ in self.iter_blocks():
+                pass
+        if self._last_stats is None:  # materialized: nothing executed
+            return "No execution stats (already-materialized blocks)."
+        return self._last_stats.summary()
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
@@ -238,6 +253,12 @@ class Dataset:
     def write_numpy(self, path: str) -> List[str]:
         return self._write(path, "write_numpy_block")
 
+    def write_tfrecords(self, path: str) -> List[str]:
+        return self._write(path, "write_tfrecords_block")
+
+    def write_avro(self, path: str) -> List[str]:
+        return self._write(path, "write_avro_block")
+
     # ---- train ingestion -------------------------------------------------
 
     def streaming_split(self, n: int) -> List["DataIterator"]:
@@ -265,6 +286,7 @@ class MaterializedDataset(Dataset):
         self._blocks = blocks
         self._parallelism = parallelism
         self._ops = []
+        self._last_stats = None
 
     def iter_blocks(self) -> Iterator[Block]:
         yield from self._blocks
@@ -375,6 +397,16 @@ def read_sql(sql: str, connection_factory, *, parallelism: int = 1) -> Dataset:
 def read_webdataset(paths, *, parallelism: int = 8) -> Dataset:
     return Dataset([plan_mod.Read(
         ds_mod.WebDatasetDatasource(paths), parallelism)], parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(
+        ds_mod.TFRecordDatasource(paths), parallelism)], parallelism)
+
+
+def read_avro(paths, *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(
+        ds_mod.AvroDatasource(paths), parallelism)], parallelism)
 
 
 def from_arrow(tables, *, parallelism: int = 8) -> Dataset:

@@ -213,6 +213,59 @@ class ImageDatasource(_FileDatasource):
             "image": cell, "path": np.asarray([path], dtype=object)})
 
 
+class TFRecordDatasource(_FileDatasource):
+    """tf.train.Example records without a TensorFlow dependency
+    (reference: read_tfrecords; framing + proto codec in data/tfrecord.py)."""
+
+    def _read_file(self, path):
+        from ray_tpu.data import tfrecord
+
+        rows = [tfrecord.decode_example(rec)
+                for rec in tfrecord.read_records(path)]
+        # Uniform columns: pad features absent in some records with None.
+        keys: List[str] = []
+        for r in rows:
+            keys.extend(k for k in r if k not in keys)
+        return block_from_rows([{k: r.get(k) for k in keys} for r in rows])
+
+
+class AvroDatasource(_FileDatasource):
+    """Avro object container files, null/deflate codecs (reference:
+    read_avro; the OCF codec lives in data/avro.py)."""
+
+    def _read_file(self, path):
+        from ray_tpu.data import avro as avro_mod
+
+        _schema, rows = avro_mod.read_file(path)
+        return block_from_rows(rows)
+
+
+def write_tfrecords_block(block, path: str, index: int) -> str:
+    from ray_tpu.data import tfrecord
+    from ray_tpu.data.block import BlockAccessor
+
+    out = os.path.join(path, f"part-{index:05d}.tfrecords")
+    tfrecord.write_records(
+        out, (tfrecord.encode_example(row)
+              for row in BlockAccessor(block).to_rows()))
+    return out
+
+
+def write_avro_block(block, path: str, index: int) -> str:
+    from ray_tpu.data import avro as avro_mod
+    from ray_tpu.data.block import BlockAccessor
+
+    out = os.path.join(path, f"part-{index:05d}.avro")
+    rows = []
+    for row in BlockAccessor(block).to_rows():
+        rows.append({k: (v.item() if hasattr(v, "item")
+                         and getattr(v, "ndim", 1) == 0 else v)
+                     for k, v in row.items()})
+    schema = avro_mod.infer_schema(rows or [{}])
+    avro_mod.write_file(out, schema, rows)
+    return out
+
+
 class SQLDatasource(Datasource):
     """DBAPI reads (reference: read_sql over any PEP-249 connection).
     `connection_factory` must be picklable (read tasks run in workers)."""

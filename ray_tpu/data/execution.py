@@ -18,6 +18,7 @@ Only the final consumer (iter_batches / take) fetches block values.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -27,6 +28,60 @@ from ray_tpu.data import plan as plan_mod
 from ray_tpu.data.block import Block, BlockAccessor, block_from_batch
 
 from ray_tpu.config import cfg
+
+
+# ----------------------------------------------------------------- stats
+
+def _block_meta(block: Block) -> dict:
+    return {"rows": block.num_rows, "bytes": block.nbytes}
+
+
+class DatasetStats:
+    """Per-operator execution stats (reference analog:
+    python/ray/data/_internal/stats.py — `Dataset.stats()`). Map stages
+    return (block, meta) pairs with num_returns=2 so per-block rows/bytes
+    ride tiny side objects instead of pulling blocks to the driver; wall
+    time is measured driver-side per stage generator."""
+
+    def __init__(self):
+        self.stages: List[dict] = []
+
+    def stage(self, name: str) -> dict:
+        entry = {"name": name, "wall_s": 0.0, "blocks": 0,
+                 "rows": None, "bytes": None, "_meta_refs": []}
+        self.stages.append(entry)
+        return entry
+
+    def finalize(self):
+        for s in self.stages:
+            refs = s.pop("_meta_refs", [])
+            if refs:
+                metas = ray_tpu.get(refs, timeout=600)
+                s["rows"] = sum(m["rows"] for m in metas)
+                s["bytes"] = sum(m["bytes"] for m in metas)
+        return self
+
+    def summary(self) -> str:
+        lines = ["Operator statistics (per executed stage):"]
+        for s in self.stages:
+            extra = ""
+            if s["rows"] is not None:
+                extra = f", {s['rows']} rows, {s['bytes'] / 1e6:.2f} MB"
+            lines.append(f"  {s['name']}: {s['wall_s'] * 1000:.0f}ms wall, "
+                         f"{s['blocks']} blocks{extra}")
+        return "\n".join(lines)
+
+
+def _timed(stage_entry: Optional[dict], stream):
+    """Wrap an (idx, ref) stream, accumulating wall time + block count."""
+    if stage_entry is None:
+        yield from stream
+        return
+    t0 = time.perf_counter()
+    for item in stream:
+        stage_entry["blocks"] += 1
+        stage_entry["wall_s"] = time.perf_counter() - t0
+        yield item
 
 
 def _apply_fused(stages_payload: bytes, block: Block) -> Block:
@@ -68,9 +123,10 @@ class _MapBatchActor:
         self.fn = fn() if isinstance(fn, type) else fn
         self.kwargs = op.fn_kwargs or {}
 
-    def transform(self, block: Block) -> Block:
+    def transform(self, block: Block):
         batch = BlockAccessor(block).to_batch()
-        return block_from_batch(self.fn(batch, **self.kwargs))
+        out = block_from_batch(self.fn(batch, **self.kwargs))
+        return out, _block_meta(out)
 
 
 # ------------------------------------------------------------- ref streams
@@ -99,14 +155,19 @@ def _wait_one(pending: dict):
     return ready
 
 
-def _task_stage(upstream, payload: bytes, max_in_flight: int):
-    @ray_tpu.remote
+def _task_stage(upstream, payload: bytes, max_in_flight: int,
+                stage_entry: Optional[dict] = None):
+    @ray_tpu.remote(num_returns=2)
     def apply(block):
-        return _apply_fused(payload, block)
+        out = _apply_fused(payload, block)
+        return out, _block_meta(out)
 
     pending = {}
     for idx, ref in upstream:
-        pending[apply.remote(ref)] = idx
+        block_ref, meta_ref = apply.remote(ref)
+        pending[block_ref] = idx
+        if stage_entry is not None:
+            stage_entry["_meta_refs"].append(meta_ref)
         while len(pending) >= max_in_flight:
             for r in _wait_one(pending):
                 yield pending.pop(r), r
@@ -115,7 +176,8 @@ def _task_stage(upstream, payload: bytes, max_in_flight: int):
             yield pending.pop(r), r
 
 
-def _actor_stage(upstream, op: plan_mod.MapBatches):
+def _actor_stage(upstream, op: plan_mod.MapBatches,
+                 stage_entry: Optional[dict] = None):
     import cloudpickle
 
     Actor = ray_tpu.remote(_MapBatchActor)
@@ -128,7 +190,11 @@ def _actor_stage(upstream, op: plan_mod.MapBatches):
         for idx, ref in upstream:
             actor = pool[i % len(pool)]
             i += 1
-            pending[actor.transform.remote(ref)] = idx
+            block_ref, meta_ref = actor.transform.options(
+                num_returns=2).remote(ref)
+            pending[block_ref] = idx
+            if stage_entry is not None:
+                stage_entry["_meta_refs"].append(meta_ref)
             while len(pending) >= 2 * len(pool):
                 for r in _wait_one(pending):
                     yield pending.pop(r), r
@@ -324,7 +390,8 @@ def _effective_inflight(max_in_flight: int) -> int:
 
 
 def execute_refs(ops: List[plan_mod.LogicalOp], parallelism: int,
-                 max_in_flight: Optional[int] = None) -> Iterator:
+                 max_in_flight: Optional[int] = None,
+                 stats: Optional[DatasetStats] = None) -> Iterator:
     """Run the optimized plan; yields BLOCK REFS in order as they complete
     (streaming until the first barrier op, task waves after)."""
     import cloudpickle as cp
@@ -355,13 +422,20 @@ def execute_refs(ops: List[plan_mod.LogicalOp], parallelism: int,
     while stream_stages and isinstance(stream_stages[0], plan_mod.FusedMap):
         lead_payloads.append(cp.dumps(stream_stages.pop(0).stages))
 
-    @ray_tpu.remote
+    @ray_tpu.remote(num_returns=2)
     def run_block(read_task_payload, payloads):
         read_task = cp.loads(read_task_payload)
         block = read_task()
         for p in payloads:
             block = _apply_fused(p, block)
-        return block
+        return block, _block_meta(block)
+
+    read_entry = None
+    if stats is not None:
+        name = type(read.datasource).__name__
+        if lead_payloads:
+            name += f"+{len(lead_payloads)} fused map(s)"
+        read_entry = stats.stage(f"Read[{name}]")
 
     def source():
         pending = {}
@@ -369,7 +443,10 @@ def execute_refs(ops: List[plan_mod.LogicalOp], parallelism: int,
         while queue or pending:
             while queue and len(pending) < _effective_inflight(max_in_flight):
                 idx, payload = queue.pop(0)
-                pending[run_block.remote(payload, lead_payloads)] = idx
+                block_ref, meta_ref = run_block.remote(payload, lead_payloads)
+                pending[block_ref] = idx
+                if read_entry is not None:
+                    read_entry["_meta_refs"].append(meta_ref)
             ready, _ = ray_tpu.wait(list(pending), num_returns=1,
                                     timeout=cfg().data_task_timeout_s)
             if not ready:
@@ -377,24 +454,37 @@ def execute_refs(ops: List[plan_mod.LogicalOp], parallelism: int,
             for ref in ready:
                 yield pending.pop(ref), ref
 
-    stream = source()
+    stream = _timed(read_entry, source())
     for op in stream_stages:
+        entry = None
         if isinstance(op, plan_mod.FusedMap):
-            stream = _task_stage(stream, cp.dumps(op.stages), max_in_flight)
+            if stats is not None:
+                entry = stats.stage(f"Map[{len(op.stages)} fused]")
+            stream = _task_stage(stream, cp.dumps(op.stages), max_in_flight,
+                                 entry)
         else:
-            stream = _actor_stage(stream, op)
+            if stats is not None:
+                entry = stats.stage(f"MapBatches[actors x{op.concurrency}]")
+            stream = _actor_stage(stream, op, entry)
+        stream = _timed(entry, stream)
 
     if not barrier_ops:
         yield from _ordered(stream)
         return
     refs = list(_ordered(stream))
     for op in barrier_ops:
+        t0 = time.perf_counter()
         refs = _apply_barrier_distributed(op, refs)
+        if stats is not None:
+            entry = stats.stage(type(op).__name__)
+            entry["wall_s"] = time.perf_counter() - t0
+            entry["blocks"] = len(refs)
     yield from refs
 
 
 def execute_streaming(ops: List[plan_mod.LogicalOp], parallelism: int,
-                      max_in_flight: Optional[int] = None) -> Iterator[Block]:
+                      max_in_flight: Optional[int] = None,
+                      stats: Optional[DatasetStats] = None) -> Iterator[Block]:
     """Run the plan; yields materialized output blocks (final consumer)."""
-    for ref in execute_refs(ops, parallelism, max_in_flight):
+    for ref in execute_refs(ops, parallelism, max_in_flight, stats):
         yield ray_tpu.get(ref, timeout=600)

@@ -1,0 +1,228 @@
+"""TFRecord I/O without TensorFlow.
+
+Reference analog: python/ray/data/read_api.py read_tfrecords /
+Dataset.write_tfrecords (which delegate to TF or a pyarrow extension).
+TPU-native stance: TFRecord is just a framing format + tf.train.Example
+protos, both simple enough to speak directly — a TPU shop feeding JAX input
+pipelines should not need a TensorFlow import for its storage format.
+
+Wire format per record:
+    uint64 LE  length
+    uint32 LE  masked crc32c(length bytes)
+    bytes      data
+    uint32 LE  masked crc32c(data)
+
+tf.train.Example subset (proto3 wire format, hand-coded):
+    Example{ features:1 = Features{ feature:1 = map<string, Feature> } }
+    Feature{ bytes_list:1 | float_list:2 | int64_list:3 }
+    *List{ value:1 (repeated; numeric lists packed or unpacked) }
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib  # noqa: F401  (parity with avro module; not used here)
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+# ---------------------------------------------------------------- crc32c
+
+_CRC_TABLE = np.zeros(256, dtype=np.uint32)
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ (0x82F63B78 if _c & 1 else 0)
+    _CRC_TABLE[_i] = _c
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    table = _CRC_TABLE
+    for b in data:
+        crc = (crc >> 8) ^ int(table[(crc ^ b) & 0xFF])
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ------------------------------------------------------------- framing
+
+def write_records(path: str, records: Iterator[bytes]) -> int:
+    n = 0
+    with open(path, "wb") as f:
+        for data in records:
+            length = struct.pack("<Q", len(data))
+            f.write(length)
+            f.write(struct.pack("<I", _masked_crc(length)))
+            f.write(data)
+            f.write(struct.pack("<I", _masked_crc(data)))
+            n += 1
+    return n
+
+
+def read_records(path: str, *, verify: bool = True) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) < 12:
+                raise ValueError(f"{path}: truncated record header")
+            (length,) = struct.unpack("<Q", header[:8])
+            (lcrc,) = struct.unpack("<I", header[8:])
+            if verify and _masked_crc(header[:8]) != lcrc:
+                raise ValueError(f"{path}: length crc mismatch")
+            data = f.read(length)
+            tail = f.read(4)
+            if len(data) < length or len(tail) < 4:
+                raise ValueError(f"{path}: truncated record body")
+            if verify and _masked_crc(data) != struct.unpack("<I", tail)[0]:
+                raise ValueError(f"{path}: data crc mismatch")
+            yield data
+
+
+# ------------------------------------------------- protobuf wire helpers
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _iter_fields(buf: bytes):
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            val, pos = buf[pos:pos + 8], pos + 8
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val, pos = buf[pos:pos + ln], pos + ln
+        elif wire == 5:
+            val, pos = buf[pos:pos + 4], pos + 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+# --------------------------------------------------- Example encode/decode
+
+def encode_example(row: Dict) -> bytes:
+    """Row dict -> serialized tf.train.Example. int -> int64_list,
+    float -> float_list, bytes/str -> bytes_list; list/ndarray values
+    become multi-value lists."""
+    feats = bytearray()
+    for key, value in row.items():
+        if value is None:
+            continue  # absent feature (TF semantics; ragged-row padding)
+        if isinstance(value, np.ndarray):
+            value = value.tolist()
+        elif isinstance(value, np.generic):
+            value = value.item()  # np.bool_/np.int64/np.float32 -> python
+        if not isinstance(value, (list, tuple)):
+            value = [value]
+        value = [v.item() if isinstance(v, np.generic) else v for v in value]
+        if value and isinstance(value[0], (bool, int, np.integer)):
+            payload = bytearray()
+            for v in value:
+                payload += _varint(int(v) & 0xFFFFFFFFFFFFFFFF)
+            # int64_list with packed values
+            feature = _len_delim(3, _tag(1, 2) + _varint(len(payload))
+                                 + bytes(payload))
+        elif value and isinstance(value[0], (float, np.floating)):
+            payload = b"".join(struct.pack("<f", float(v)) for v in value)
+            feature = _len_delim(2, _tag(1, 2) + _varint(len(payload))
+                                 + payload)
+        else:
+            items = b""
+            for v in value:
+                if isinstance(v, str):
+                    v = v.encode("utf-8")
+                items += _len_delim(1, bytes(v))
+            feature = _len_delim(1, items)
+        entry = _len_delim(1, key.encode("utf-8")) + _len_delim(2, feature)
+        feats += _len_delim(1, entry)
+    # Example{features:1 = Features{feature:1 = repeated map entries}}:
+    # `feats` is already the Features message body.
+    return _len_delim(1, bytes(feats))
+
+
+def _decode_list(kind: int, buf: bytes) -> List:
+    values: List = []
+    for field, wire, val in _iter_fields(buf):
+        if field != 1:
+            continue
+        if kind == 1:              # bytes_list
+            values.append(val)
+        elif kind == 2:            # float_list
+            if wire == 5:
+                values.append(struct.unpack("<f", val)[0])
+            else:                  # packed
+                values.extend(
+                    struct.unpack(f"<{len(val) // 4}f", val))
+        else:                      # int64_list
+            if wire == 0:
+                v = val
+                values.append(v - (1 << 64) if v >= (1 << 63) else v)
+            else:                  # packed varints
+                pos = 0
+                while pos < len(val):
+                    v, pos = _read_varint(val, pos)
+                    values.append(v - (1 << 64) if v >= (1 << 63) else v)
+    return values
+
+
+def decode_example(data: bytes) -> Dict:
+    """Serialized Example -> {name: scalar or list}."""
+    row: Dict = {}
+    for field, _w, features in _iter_fields(data):
+        if field != 1:
+            continue
+        for f2, _w2, feat_map in _iter_fields(features):
+            if f2 != 1:
+                continue
+            name, feature = None, None
+            for f3, _w3, v3 in _iter_fields(feat_map):
+                if f3 == 1:
+                    name = v3.decode("utf-8")
+                elif f3 == 2:
+                    feature = v3
+            if name is None or feature is None:
+                continue
+            value: List = []
+            for kind, _w4, payload in _iter_fields(feature):
+                value = _decode_list(kind, payload)
+            row[name] = value[0] if len(value) == 1 else value
+    return row

@@ -31,14 +31,26 @@ def _get_controller():
 
 
 def run(target: Union[Deployment, List[Deployment]], *,
-        http: bool = False, http_port: int = 0) -> DeploymentHandle:
+        http: bool = False, http_port: int = 0,
+        local_testing_mode: bool = False) -> DeploymentHandle:
     """Deploy one or more deployments; returns a handle to the first.
 
     Model composition (reference: serve deployment graphs — api.py:591 with
     bound child deployments): a Deployment appearing anywhere in another's
     bound init args is deployed first and replaced by a DeploymentHandle,
-    so the parent replica calls children through ordinary handles."""
+    so the parent replica calls children through ordinary handles.
+
+    local_testing_mode=True (reference:
+    serve/_private/local_testing_mode.py) runs everything in-process — no
+    cluster, no actors — with the same handle surface."""
     import cloudpickle
+
+    if local_testing_mode:
+        from ray_tpu.serve.local_testing import run_local
+
+        deployments = ([target] if isinstance(target, Deployment)
+                       else list(target))
+        return run_local(deployments)
 
     controller = _get_controller()
     deployments = [target] if isinstance(target, Deployment) else list(target)
@@ -80,15 +92,28 @@ def run(target: Union[Deployment, List[Deployment]], *,
 
 
 def get_deployment_handle(name: str) -> DeploymentHandle:
+    from ray_tpu.serve.local_testing import get_local_handle
+
+    local = get_local_handle(name)
+    if local is not None:
+        return local
     return DeploymentHandle(name)
 
 
 def status() -> List[dict]:
+    from ray_tpu.serve.local_testing import _local_deployments, local_status
+
+    if _local_deployments:
+        return local_status()
     controller = _get_controller()
     return ray_tpu.get(controller.list_deployments.remote(), timeout=60)
 
 
 def delete(name: str) -> bool:
+    from ray_tpu.serve.local_testing import delete_local
+
+    if delete_local(name):
+        return True
     controller = _get_controller()
     return ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
 
@@ -119,6 +144,11 @@ def start_grpc_proxy(port: int = 0):
 
 def shutdown():
     global _controller, _proxy, _grpc_proxy
+    from ray_tpu.serve.local_testing import shutdown_local
+
+    shutdown_local()
+    if _controller is None:
+        return  # local-only session: nothing cluster-side to tear down
     for name in [d["name"] for d in status()]:
         delete(name)
     if _grpc_proxy is not None:

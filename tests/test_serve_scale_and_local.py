@@ -1,0 +1,138 @@
+"""Serve at replica scale + local testing mode.
+
+Reference analogs: serve/_private/local_testing_mode.py:1 (in-process
+deployments for tests) and long_poll.py:204 (config propagation to many
+replicas — ours is versioned polling; this suite measures propagation lag
+and router assignment latency at a replica count far above the rest of
+the suite).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+# ----------------------------------------------------- local testing mode
+
+def test_local_testing_mode_basic():
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+        def plus(self, x, y=0):
+            return x + y
+
+    h = serve.run(Doubler.bind(), local_testing_mode=True)
+    try:
+        assert h.remote(21).result() == 42
+        assert h.options("plus").remote(1, y=2).result() == 3
+        assert h.plus.remote(5).result() == 5  # attribute method routing
+        assert serve.status()[0]["local_testing_mode"] is True
+        # get_deployment_handle resolves to the local registry.
+        h2 = serve.get_deployment_handle("Doubler")
+        assert h2.remote(2).result() == 4
+    finally:
+        serve.shutdown()
+    with pytest.raises(ValueError, match="no local deployment"):
+        h.remote(1)
+
+
+def test_local_testing_mode_composition_and_streaming():
+    @serve.deployment
+    class Tokenizer:
+        def __call__(self, s):
+            return s.split()
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, tok):
+            self.tok = tok
+
+        def __call__(self, s):
+            return len(self.tok.remote(s).result())
+
+        def stream(self, n):
+            for i in range(n):
+                yield i * i
+
+    h = serve.run(Pipeline.bind(Tokenizer.bind()), local_testing_mode=True)
+    try:
+        assert h.remote("a b c").result() == 3
+        assert list(h.options("stream").remote_stream(4)) == [0, 1, 4, 9]
+    finally:
+        serve.shutdown()
+
+
+def test_local_testing_mode_errors_and_timeouts():
+    @serve.deployment
+    class Slow:
+        def __call__(self):
+            time.sleep(5)
+
+        def boom(self):
+            raise RuntimeError("kaput")
+
+    h = serve.run(Slow.bind(), local_testing_mode=True)
+    try:
+        with pytest.raises(RuntimeError, match="kaput"):
+            h.boom.remote().result()
+        with pytest.raises(TimeoutError):
+            h.remote().result(timeout=0.2)
+    finally:
+        serve.shutdown()
+
+
+# ------------------------------------------------------- replica scale
+
+@pytest.mark.slow
+def test_many_replicas_routing_and_propagation(cpu_jax):
+    """50 replicas (reference envelope regime, long_poll.py:204): measures
+    deploy->routable config-propagation lag and router assignment latency,
+    and checks pow-2 balancing spreads load across most of the fleet."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        @serve.deployment
+        class Echo:
+            def __call__(self, i):
+                import os
+
+                return os.getpid()
+
+        t0 = time.monotonic()
+        h = serve.run(Echo.options(num_replicas=50).bind())
+        # Propagation lag: first moment the full replica set is routable.
+        deadline = time.monotonic() + 420
+        while time.monotonic() < deadline:
+            h._refresh()
+            if len(h._replicas) >= 50:
+                break
+            time.sleep(1.0)
+        propagation_s = time.monotonic() - t0
+        assert len(h._replicas) >= 50, len(h._replicas)
+
+        # Router assignment latency: time to PICK + SUBMIT (not execute).
+        lat = []
+        responses = []
+        for i in range(300):
+            t = time.perf_counter()
+            responses.append(h.remote(i))
+            lat.append(time.perf_counter() - t)
+        pids = {r.result(timeout=120) for r in responses}
+        p50 = sorted(lat)[150] * 1000
+        p95 = sorted(lat)[285] * 1000
+        print(f"\n50-replica serve: propagation={propagation_s:.1f}s "
+              f"assign p50={p50:.2f}ms p95={p95:.2f}ms "
+              f"distinct_replicas={len(pids)}")
+        # pow-2 choices over 300 requests must hit a large share of the
+        # fleet (uniform-random two-choice coverage), and assignment must
+        # be far below any RPC round trip.
+        assert len(pids) >= 25, len(pids)
+        assert p50 < 50, p50
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
