@@ -97,15 +97,27 @@ class ShmChannel:
             # Ring is full until the reader frees the slot `capacity` back.
             old = self._oid(self._wv - self.capacity)
             deadline = None if timeout is None else time.monotonic() + timeout
-            sleep = 0.0002
-            while store.contains(old):
+            while True:
+                # Sample the event generation BEFORE the check: the reader's
+                # delete bumps the store futex, so a slot freed between the
+                # check and the wait still wakes us immediately.
+                gen = store.event_gen
+                if not store.contains(old):
+                    break
                 if self._reader_closed():
                     # Reader abandoned the channel (its loop died): unwedge.
                     raise ChannelClosed()
-                if deadline is not None and time.monotonic() >= deadline:
-                    raise TimeoutError("channel write backpressure timeout")
-                time.sleep(sleep)
-                sleep = min(sleep * 2, 0.005)
+                # 50 ms cap keeps the reader-closed check live (closing
+                # writes a file marker, not a store event); clamp to the
+                # remaining budget so timeout overshoot stays bounded.
+                wait_ms = 50
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            "channel write backpressure timeout")
+                    wait_ms = min(50, int(remaining * 1000) + 1)
+                store.wait_event(gen, wait_ms)
         segments, total = serialization.serialize(value)
         oid = self._oid(self._wv)
         store.abort(oid)  # reclaim a stale unsealed create, if any

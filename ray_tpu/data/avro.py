@@ -92,23 +92,31 @@ def write_datum(out, schema, value) -> None:
     elif stype == "double":
         out.write(struct.pack("<d", float(value)))
     elif stype == "bytes":
-        _write_bytes(out, bytes(value))
+        if isinstance(value, (bytes, bytearray)):
+            _write_bytes(out, bytes(value))
+        elif isinstance(value, str):
+            _write_bytes(out, value.encode("utf-8"))
+        else:
+            # bytes(int) would write a NUL run — stringify instead.
+            _write_bytes(out, str(value).encode("utf-8"))
     elif stype == "string":
         if isinstance(value, str):
             _write_bytes(out, value.encode("utf-8"))
         elif isinstance(value, (bytes, bytearray)):
             _write_bytes(out, bytes(value))
         else:
-            # bytes(int) would silently write NUL runs — refuse instead.
-            raise TypeError(
-                f"avro string field got {type(value).__name__}: {value!r}")
+            # Heterogenous columns infer "string"; stringify explicitly
+            # (bytes(int) would silently write NUL runs — never that).
+            _write_bytes(out, str(value).encode("utf-8"))
     elif stype == "enum":
         write_long(out, schema["symbols"].index(value))
     elif stype == "fixed":
         out.write(bytes(value))
     elif stype == "record":
         for field in schema["fields"]:
-            write_datum(out, field["type"], value[field["name"]])
+            # .get: sparse rows are legal (infer_schema makes the field a
+            # nullable union, whose null branch encodes the None).
+            write_datum(out, field["type"], value.get(field["name"]))
     elif stype == "array":
         items = list(value)
         if items:
@@ -300,29 +308,58 @@ def _primitive_type(sample) -> str:
     return "string"
 
 
+def _merged_primitive_type(samples) -> str:
+    """Type covering EVERY sample, not just the first: a column mixing ints
+    and floats must infer 'double' (inferring 'long' from the first row
+    would silently truncate 2.5 -> 2 at write time), and any column
+    containing bytes must infer 'bytes' (non-UTF-8 payloads written under
+    'string' would make the file unreadable)."""
+    merged = None
+    saw_bytes = False
+    for s in samples:
+        if s is None:
+            continue
+        t = _primitive_type(s)
+        saw_bytes = saw_bytes or t == "bytes"
+        if merged is None or merged == t:
+            merged = t
+        elif {merged, t} == {"long", "double"}:
+            merged = "double"
+        elif {merged, t} == {"boolean", "long"}:
+            merged = "long"
+        else:
+            merged = "string"  # heterogenous: stringify losslessly-ish
+    if merged == "string" and saw_bytes:
+        return "bytes"
+    return merged if merged is not None else "string"
+
+
 def infer_schema(rows: List[Dict], name: str = "Row") -> Dict:
     """Record schema from sample rows; columns with missing/None values
-    become nullable unions. Array items and map values take the type of
-    the first non-empty element seen across the sample."""
+    become nullable unions. Array item and map value types cover every
+    element seen across the sample (mixed int/float promotes to double)."""
     import numpy as np
 
     fields = []
-    from ray_tpu.data.block import union_keys
-
-    keys = union_keys(rows)
+    # Ordered union of all row keys (first-seen order): rows may be sparse.
+    keys: List[str] = []
+    seen = set()
+    for r in rows:
+        for k in r:
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
     for k in keys:
         values = [r.get(k) for r in rows]
-        nullable = any(v is None for v in values)
+        nullable = any(v is None for v in values) or any(k not in r for r in rows)
         sample = next((v for v in values if v is not None), None)
         if isinstance(sample, (list, tuple, np.ndarray)):
-            inner = next((x for v in values if v is not None
-                          for x in v), None)
-            t: Any = {"type": "array", "items": _primitive_type(inner)}
+            inner = [x for v in values if v is not None for x in v]
+            t: Any = {"type": "array", "items": _merged_primitive_type(inner)}
         elif isinstance(sample, dict):
-            inner = next((x for v in values if v
-                          for x in v.values()), None)
-            t = {"type": "map", "values": _primitive_type(inner)}
+            inner = [x for v in values if v for x in v.values()]
+            t = {"type": "map", "values": _merged_primitive_type(inner)}
         else:
-            t = _primitive_type(sample)
+            t = _merged_primitive_type(values)
         fields.append({"name": k, "type": ["null", t] if nullable else t})
     return {"type": "record", "name": name, "fields": fields}

@@ -85,11 +85,25 @@ def block_from_batch(batch: Union[Batch, "pa.Table", Any]) -> Block:
     raise TypeError(f"cannot make a block from {type(batch)}")
 
 
+def _rows_column_to_numpy(values: List[Any]) -> np.ndarray:
+    """Column values -> numpy, tolerating ragged list cells (variable-length
+    feature lists, e.g. TFRecord int64_list columns): those become
+    object-dtype cells of ndarrays instead of a np.asarray ValueError."""
+    try:
+        return np.asarray(values)
+    except ValueError:
+        out = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            out[i] = np.asarray(v) if isinstance(v, (list, tuple)) else v
+        return out
+
+
 def block_from_rows(rows: List[Dict[str, Any]]) -> Block:
     if not rows:
         return pa.table({})
     cols = {k: [r[k] for r in rows] for k in rows[0]}
-    return block_from_batch({k: np.asarray(v) for k, v in cols.items()})
+    return block_from_batch(
+        {k: _rows_column_to_numpy(v) for k, v in cols.items()})
 
 
 class BlockAccessor:

@@ -226,7 +226,18 @@ class TFRecordDatasource(_FileDatasource):
         keys: List[str] = []
         for r in rows:
             keys.extend(k for k in r if k not in keys)
-        return block_from_rows([{k: r.get(k) for k in keys} for r in rows])
+        # decode_example always yields lists (the Example proto can't tell a
+        # scalar from a 1-element list). Collapse a column to scalars only
+        # when EVERY present value has length 1 — per-file-consistent, never
+        # ragged within a column.
+        scalar_cols = {
+            k for k in keys
+            if all(len(r[k]) == 1 for r in rows if r.get(k) is not None)}
+        return block_from_rows([
+            {k: (r[k][0] if k in scalar_cols else r[k])
+             if r.get(k) is not None else None
+             for k in keys}
+            for r in rows])
 
 
 class AvroDatasource(_FileDatasource):

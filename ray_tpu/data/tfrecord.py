@@ -21,27 +21,11 @@ tf.train.Example subset (proto3 wire format, hand-coded):
 from __future__ import annotations
 
 import struct
-import zlib  # noqa: F401  (parity with avro module; not used here)
 from typing import Dict, Iterator, List
 
 import numpy as np
 
-# ---------------------------------------------------------------- crc32c
-
-_CRC_TABLE = np.zeros(256, dtype=np.uint32)
-for _i in range(256):
-    _c = _i
-    for _ in range(8):
-        _c = (_c >> 1) ^ (0x82F63B78 if _c & 1 else 0)
-    _CRC_TABLE[_i] = _c
-
-
-def crc32c(data: bytes) -> int:
-    crc = 0xFFFFFFFF
-    table = _CRC_TABLE
-    for b in data:
-        crc = (crc >> 8) ^ int(table[(crc ^ b) & 0xFF])
-    return crc ^ 0xFFFFFFFF
+from ray_tpu.data._crc32c import crc32c
 
 
 def _masked_crc(data: bytes) -> int:
@@ -154,14 +138,18 @@ def encode_example(row: Dict) -> bytes:
         if not isinstance(value, (list, tuple)):
             value = [value]
         value = [v.item() if isinstance(v, np.generic) else v for v in value]
-        if value and isinstance(value[0], (bool, int, np.integer)):
+        # Classify by ALL elements: [1, 2.5] must take the float_list branch
+        # (int64_list would silently truncate 2.5 -> 2).
+        if value and all(isinstance(v, (bool, int, np.integer))
+                         for v in value):
             payload = bytearray()
             for v in value:
                 payload += _varint(int(v) & 0xFFFFFFFFFFFFFFFF)
             # int64_list with packed values
             feature = _len_delim(3, _tag(1, 2) + _varint(len(payload))
                                  + bytes(payload))
-        elif value and isinstance(value[0], (float, np.floating)):
+        elif value and all(isinstance(v, (bool, int, float, np.integer,
+                                          np.floating)) for v in value):
             payload = b"".join(struct.pack("<f", float(v)) for v in value)
             feature = _len_delim(2, _tag(1, 2) + _varint(len(payload))
                                  + payload)
@@ -205,7 +193,12 @@ def _decode_list(kind: int, buf: bytes) -> List:
 
 
 def decode_example(data: bytes) -> Dict:
-    """Serialized Example -> {name: scalar or list}."""
+    """Serialized Example -> {name: list of values}.
+
+    Always lists: the Example proto cannot distinguish a scalar from a
+    1-element list, so collapsing here would make a column ragged whenever
+    list lengths vary across records ([7] -> 7 but [7, 8] -> [7, 8]). The
+    datasource collapses uniformly-1-length columns per file instead."""
     row: Dict = {}
     for field, _w, features in _iter_fields(data):
         if field != 1:
@@ -224,5 +217,5 @@ def decode_example(data: bytes) -> Dict:
             value: List = []
             for kind, _w4, payload in _iter_fields(feature):
                 value = _decode_list(kind, payload)
-            row[name] = value[0] if len(value) == 1 else value
+            row[name] = value
     return row

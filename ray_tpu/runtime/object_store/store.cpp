@@ -32,13 +32,16 @@
 #include <cerrno>
 #include <fcntl.h>
 #include <pthread.h>
+#include <linux/futex.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
+#include <ctime>
 #include <unistd.h>
 
 extern "C" {
 
-static const uint64_t kMagic = 0x5241595F54505531ULL;  // "RAY_TPU1"
+static const uint64_t kMagic = 0x5241595F54505532ULL;  // "RAY_TPU2"
 static const uint32_t kIdSize = 20;
 
 enum EntryState : uint32_t {
@@ -78,6 +81,14 @@ struct StoreHeader {
   uint64_t lru_clock;
   uint64_t num_objects;
   uint64_t seal_count;      // bumped on every seal (cheap readiness signal)
+  uint32_t event_gen;       // futex word: bumped on seal/delete/abort/evict so
+                            // waiters (get, channel backpressure) block on a
+                            // kernel futex instead of spin-polling. Plasma's
+                            // analog is the per-client notification socket
+                            // (reference: src/ray/object_manager/plasma/
+                            // store.h:55); shared-memory futex needs no
+                            // server round-trip.
+  uint32_t _pad_ev;
   pthread_mutex_t mutex;
 };
 
@@ -109,6 +120,16 @@ static void lock(StoreHeader* hdr) {
 }
 
 static void unlock(StoreHeader* hdr) { pthread_mutex_unlock(&hdr->mutex); }
+
+// Advance the event generation and wake every futex waiter. Called after any
+// state change a waiter could be blocked on (seal makes an object readable;
+// delete/abort/evict frees a channel ring slot). No FUTEX_PRIVATE_FLAG: the
+// word is shared across processes.
+static void bump_event(StoreHeader* hdr) {
+  __atomic_fetch_add(&hdr->event_gen, 1, __ATOMIC_ACQ_REL);
+  syscall(SYS_futex, &hdr->event_gen, FUTEX_WAKE, INT32_MAX,
+          nullptr, nullptr, 0);
+}
 
 // Find entry slot for id. Returns slot index or (uint64_t)-1.
 static uint64_t find_slot(Handle* h, const uint8_t* id) {
@@ -333,6 +354,29 @@ uint64_t store_used(void* vh) { return ((Handle*)vh)->hdr->used_bytes; }
 uint64_t store_num_objects(void* vh) { return ((Handle*)vh)->hdr->num_objects; }
 uint64_t store_seal_count(void* vh) { return ((Handle*)vh)->hdr->seal_count; }
 
+// Current event generation; read it BEFORE a lookup, then pass it to
+// store_wait_event so a state change between lookup and wait is never missed.
+uint32_t store_event_gen(void* vh) {
+  return __atomic_load_n(&((Handle*)vh)->hdr->event_gen, __ATOMIC_ACQUIRE);
+}
+
+// Block until the event generation differs from `seen` or timeout_ms elapses
+// (timeout_ms < 0 = wait forever). rc: 0 = changed/woken, 1 = timed out.
+int store_wait_event(void* vh, uint32_t seen, int timeout_ms) {
+  StoreHeader* hdr = ((Handle*)vh)->hdr;
+  struct timespec ts;
+  struct timespec* tsp = nullptr;
+  if (timeout_ms >= 0) {
+    ts.tv_sec = timeout_ms / 1000;
+    ts.tv_nsec = (long)(timeout_ms % 1000) * 1000000L;
+    tsp = &ts;
+  }
+  if (__atomic_load_n(&hdr->event_gen, __ATOMIC_ACQUIRE) != seen) return 0;
+  long rc = syscall(SYS_futex, &hdr->event_gen, FUTEX_WAIT, seen,
+                    tsp, nullptr, 0);
+  return (rc == -1 && errno == ETIMEDOUT) ? 1 : 0;
+}
+
 // rc: 0 ok; -1 already exists; -2 out of memory; -3 table full
 // allow_evict=0 makes allocation failure return -2 immediately instead of
 // dropping LRU objects, so the caller can spill them to disk first
@@ -350,10 +394,12 @@ int store_create_object(void* vh, const uint8_t* id, uint64_t data_size,
   // satisfy the request contiguously; freed neighbours coalesce as they go.
   uint64_t granted = 0;
   uint64_t off;
+  int evicted_any = 0;
   for (;;) {
     off = heap_alloc(h, need, &granted);
     if (off != 0) break;
     if (!allow_evict || !evict_one(h)) { unlock(hdr); return -2; }
+    evicted_any = 1;
   }
   uint64_t slot = find_insert_slot(h, id);
   if (slot == (uint64_t)-1) { heap_free(h, off, granted); unlock(hdr); return -3; }
@@ -368,6 +414,7 @@ int store_create_object(void* vh, const uint8_t* id, uint64_t data_size,
   e->lru_tick = hdr->lru_clock++;
   hdr->num_objects++;
   unlock(hdr);
+  if (evicted_any) bump_event(hdr);
   *offset_out = off;
   return 0;
 }
@@ -383,6 +430,7 @@ int store_seal(void* vh, const uint8_t* id) {
   e->refcount--;  // drop creator reference
   h->hdr->seal_count++;
   unlock(h->hdr);
+  bump_event(h->hdr);
   return 0;
 }
 
@@ -436,6 +484,7 @@ int store_delete(void* vh, const uint8_t* id) {
   if (e->refcount > 0 || e->state != kSealed) { unlock(h->hdr); return -2; }
   remove_entry(h, slot);
   unlock(h->hdr);
+  bump_event(h->hdr);
   return 0;
 }
 
@@ -450,6 +499,7 @@ int store_abort(void* vh, const uint8_t* id) {
   if (e->state != kCreated) { unlock(h->hdr); return -2; }
   remove_entry(h, slot);
   unlock(h->hdr);
+  bump_event(h->hdr);
   return 0;
 }
 

@@ -62,6 +62,11 @@ class _Lib:
                 ctypes.POINTER(ctypes.c_uint64)]
             lib.store_list.restype = ctypes.c_uint64
             lib.store_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+            lib.store_event_gen.restype = ctypes.c_uint32
+            lib.store_event_gen.argtypes = [ctypes.c_void_p]
+            lib.store_wait_event.restype = ctypes.c_int
+            lib.store_wait_event.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int]
             inst = object.__new__(cls)
             inst.lib = lib
             cls._instance = inst
@@ -206,10 +211,16 @@ class ObjectStore:
         self.seal(object_id)
 
     def get(self, object_id: bytes, timeout: Optional[float] = 0) -> StoreBuffer:
-        """Get a sealed object; blocks up to `timeout` seconds for it to appear."""
+        """Get a sealed object; blocks up to `timeout` seconds for it to appear.
+
+        Blocking rides the store's seal futex (plasma notification-socket
+        analog, reference src/ray/object_manager/plasma/store.h:55): the
+        event generation is sampled BEFORE the lookup, so a seal landing
+        between lookup and wait wakes us immediately — no spin-poll. The
+        wait is capped at 100 ms per lap purely as a liveness backstop."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        sleep = 0.0002
         while True:
+            gen = self._lib.store_event_gen(self.handle)
             off = ctypes.c_uint64()
             dsz = ctypes.c_uint64()
             msz = ctypes.c_uint64()
@@ -220,13 +231,28 @@ class ObjectStore:
                 data = self._view[o:o + d]
                 metadata = bytes(self._view[o + d:o + d + m]) if m else b""
                 return StoreBuffer(self, object_id, data, metadata)
-            if deadline is not None and time.monotonic() >= deadline:
-                raise ObjectNotFoundError(object_id.hex())
-            time.sleep(sleep)
-            sleep = min(sleep * 2, 0.01)
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ObjectNotFoundError(object_id.hex())
+                wait_ms = min(int(remaining * 1000) + 1, 100)
+            else:
+                wait_ms = 100
+            self._lib.store_wait_event(self.handle, gen, wait_ms)
 
     def contains(self, object_id: bytes) -> bool:
         return bool(self._lib.store_contains(self.handle, object_id))
+
+    @property
+    def event_gen(self) -> int:
+        """Store-wide event generation (bumped on seal/delete/abort/evict)."""
+        return self._lib.store_event_gen(self.handle)
+
+    def wait_event(self, seen_gen: int, timeout_ms: int) -> bool:
+        """Block until the generation moves past `seen_gen` (sampled before
+        the caller's state check) or timeout. True if an event arrived."""
+        return self._lib.store_wait_event(
+            self.handle, ctypes.c_uint32(seen_gen), int(timeout_ms)) == 0
 
     def delete(self, object_id: bytes) -> bool:
         return self._lib.store_delete(self.handle, object_id) == 0

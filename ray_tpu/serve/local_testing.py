@@ -46,10 +46,14 @@ class LocalDeploymentResponse:
                timeout_s: Optional[float] = None):
         if timeout_s is not None:
             timeout = timeout_s
-        try:
-            ok, value = self._q.get(timeout=timeout)
-        except queue.Empty:
-            raise TimeoutError("local deployment call timed out")
+        # Cache the outcome: result() must be repeatable (the real
+        # DeploymentResponse allows any number of result() calls).
+        if not hasattr(self, "_outcome"):
+            try:
+                self._outcome = self._q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError("local deployment call timed out")
+        ok, value = self._outcome
         if not ok:
             raise value
         return value

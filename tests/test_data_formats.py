@@ -36,10 +36,26 @@ def test_example_proto_round_trip():
     row = {"label": 3, "weights": [1.5, -2.0], "name": b"cart",
            "ids": [7, 8, 9]}
     got = decode_example(encode_example(row))
-    assert got["label"] == 3
+    # Always lists at the proto level: Example cannot distinguish a scalar
+    # from a 1-element list; the datasource collapses uniform columns.
+    assert got["label"] == [3]
     assert got["ids"] == [7, 8, 9]
-    assert got["name"] == b"cart"
+    assert got["name"] == [b"cart"]
     assert np.allclose(got["weights"], [1.5, -2.0])
+
+
+def test_tfrecord_varlen_lists_not_ragged(tmp_path, cluster):
+    """A column mixing 1-element and longer lists must come back uniformly
+    as lists (collapsing only the first would make the column ragged)."""
+    from ray_tpu.data import read_tfrecords
+    from ray_tpu.data.tfrecord import encode_example, write_records
+
+    p = str(tmp_path / "r.tfrecords")
+    write_records(p, iter([encode_example({"ids": [7], "tag": 1}),
+                           encode_example({"ids": [7, 8], "tag": 2})]))
+    rows = read_tfrecords([p]).take_all()
+    assert [list(r["ids"]) for r in rows] == [[7], [7, 8]]
+    assert [r["tag"] for r in rows] == [1, 2]  # uniform 1-length: scalars
 
 
 def test_tfrecord_framing_detects_corruption(tmp_path):
@@ -138,3 +154,47 @@ def test_dataset_stats(cluster):
     assert read_stage["rows"] == 1000
     assert read_stage["bytes"] > 0
     assert read_stage["blocks"] == 4
+
+
+def test_avro_sparse_rows_round_trip(tmp_path):
+    """Rows missing some keys write via nullable unions (record branch must
+    .get, not index)."""
+    from ray_tpu.data import avro
+
+    p = str(tmp_path / "sparse.avro")
+    rows = [{"a": 1}, {"b": 2}]
+    avro.write_file(p, avro.infer_schema(rows), rows)
+    _schema, back = avro.read_file(p)
+    assert back == [{"a": 1, "b": None}, {"a": None, "b": 2}]
+
+
+def test_avro_mixed_numeric_promotes(tmp_path):
+    """int-first then float must infer double (no silent truncation)."""
+    from ray_tpu.data import avro
+
+    p = str(tmp_path / "mix.avro")
+    rows = [{"x": 1}, {"x": 2.5}]
+    avro.write_file(p, avro.infer_schema(rows), rows)
+    _schema, back = avro.read_file(p)
+    assert [r["x"] for r in back] == [1.0, 2.5]
+
+
+def test_avro_bytes_column_round_trips(tmp_path):
+    """A column containing non-UTF-8 bytes must infer 'bytes': writing it
+    under 'string' would produce an unreadable file."""
+    from ray_tpu.data import avro
+
+    p = str(tmp_path / "bytes.avro")
+    rows = [{"c": "text"}, {"c": b"\xff\xfe"}]
+    avro.write_file(p, avro.infer_schema(rows), rows)
+    _schema, back = avro.read_file(p)
+    assert back[0]["c"] == b"text"
+    assert back[1]["c"] == b"\xff\xfe"
+
+
+def test_tfrecord_mixed_numeric_list_promotes():
+    """[1, 2.5] must encode as float_list, not int64_list truncating 2.5."""
+    from ray_tpu.data.tfrecord import decode_example, encode_example
+
+    got = decode_example(encode_example({"x": [1, 2.5]}))
+    assert np.allclose(got["x"], [1.0, 2.5])
