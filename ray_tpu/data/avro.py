@@ -351,7 +351,7 @@ def infer_schema(rows: List[Dict], name: str = "Row") -> Dict:
                 keys.append(k)
     for k in keys:
         values = [r.get(k) for r in rows]
-        nullable = any(v is None for v in values) or any(k not in r for r in rows)
+        nullable = any(v is None for v in values)  # .get: missing key -> None
         sample = next((v for v in values if v is not None), None)
         if isinstance(sample, (list, tuple, np.ndarray)):
             inner = [x for v in values if v is not None for x in v]

@@ -150,4 +150,54 @@ class BlockAccessor:
         blocks = [b for b in blocks if b.num_rows > 0]
         if not blocks:
             return pa.table({})
-        return pa.concat_tables(blocks)
+        first = blocks[0].schema
+        if all(b.schema.equals(first) for b in blocks[1:]):
+            return pa.concat_tables(blocks)
+        return pa.concat_tables(_reconcile_schemas(blocks),
+                                promote_options="permissive")
+
+
+def _is_list_type(t) -> bool:
+    return (pa.types.is_list(t) or pa.types.is_large_list(t)
+            or pa.types.is_fixed_size_list(t))
+
+
+def _reconcile_schemas(blocks: List[Block]) -> List[Block]:
+    """Unify blocks whose schemas disagree: a column that is scalar T in one
+    block and list<T> in another (e.g. TFRecord's per-file scalar collapse
+    when list lengths vary across files) promotes the scalar side to
+    1-element lists; columns absent from a block fill with nulls."""
+    names: List[str] = []
+    for b in blocks:
+        names.extend(n for n in b.schema.names if n not in names)
+    target = {}
+    for n in names:
+        types = [b.schema.field(n).type for b in blocks
+                 if n in b.schema.names]
+        list_t = next((t for t in types if _is_list_type(t)), None)
+        if list_t is None:
+            target[n] = types[0]
+        elif all(t.equals(list_t) for t in types):
+            target[n] = list_t  # uniform (incl. fixed_size): leave alone
+        else:
+            # Mixed scalar/fixed/variable: normalize to variable list<T>.
+            target[n] = pa.list_(list_t.value_type)
+    out = []
+    for b in blocks:
+        cols = {}
+        for n in names:
+            if n not in b.schema.names:
+                cols[n] = pa.nulls(b.num_rows, type=target[n])
+                continue
+            col = b[n]
+            t = target[n]
+            if _is_list_type(t) and not _is_list_type(col.type):
+                # Rare reconciliation path: python-level wrap is fine.
+                col = pa.array(
+                    [None if v is None else [v] for v in col.to_pylist()],
+                    type=t)
+            elif not col.type.equals(t) and _is_list_type(col.type):
+                col = col.cast(t)  # fixed_size_list -> list
+            cols[n] = col
+        out.append(pa.table(cols))
+    return out

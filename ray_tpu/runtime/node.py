@@ -33,6 +33,16 @@ def new_session_dir() -> str:
     base = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
     session = os.path.join(base, f"session_{int(time.time())}_{uuid.uuid4().hex[:8]}")
     os.makedirs(os.path.join(session, "logs"), exist_ok=True)
+    # session_latest lets same-host attachers (CLI status/join, driver
+    # init(address=...)) find the auth token without an env var (reference
+    # analog: /tmp/ray/session_latest).
+    latest = os.path.join(base, "session_latest")
+    tmp = f"{latest}.{os.getpid()}.tmp"
+    try:
+        os.symlink(session, tmp)
+        os.replace(tmp, latest)
+    except OSError:
+        pass
     return session
 
 
@@ -49,11 +59,40 @@ def _wait_file(path: str, timeout: float, proc: subprocess.Popen, what: str) -> 
     raise RuntimeError(f"timed out waiting for {what} to start")
 
 
+def ensure_auth_token(session_dir: str) -> None:
+    """Mint the per-session wire-auth token (rpc.py challenge-response).
+
+    Every cluster process descends from the process that starts the GCS, so
+    setting RAY_TPU_AUTH_TOKEN here propagates to GCS/raylet/worker/driver
+    children via env inheritance; the 0600 session file lets a same-host
+    operator attach out-of-band. An already-set env token is kept (attach
+    to an existing cluster / explicit operator-provided token)."""
+    if os.environ.get("RAY_TPU_AUTH_TOKEN"):
+        token_hex = os.environ["RAY_TPU_AUTH_TOKEN"]
+        try:
+            bytes.fromhex(token_hex)
+        except ValueError:
+            raise RuntimeError(
+                "RAY_TPU_AUTH_TOKEN must be a hex string; "
+                f"got {len(token_hex)} chars of non-hex")
+    else:
+        token_hex = os.urandom(32).hex()
+        os.environ["RAY_TPU_AUTH_TOKEN"] = token_hex
+    path = os.path.join(session_dir, "auth_token")
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(token_hex)
+    from ray_tpu.runtime import rpc
+
+    rpc.set_session_token(bytes.fromhex(token_hex))
+
+
 def start_gcs(session_dir: str, port: int = 0,
               storage: Optional[str] = None
               ) -> Tuple[subprocess.Popen, Tuple[str, int]]:
     """storage defaults to <session>/gcs.db — GCS restarts recover state
     (pass storage="" to run purely in-memory)."""
+    ensure_auth_token(session_dir)
     if storage is None:
         storage = os.path.join(session_dir, "gcs.db")
     ready = os.path.join(session_dir, f"gcs_ready_{os.getpid()}_{port}")

@@ -46,6 +46,59 @@ _HDR = struct.Struct("<4sI")
 KIND_REQUEST, KIND_REPLY, KIND_ERROR, KIND_PUSH = 0, 1, 2, 3
 MAX_FRAME = 1 << 31
 
+# ---------------------------------------------------------------- wire auth
+#
+# A pickle wire must earn what protobuf gets for free: anyone who can reach
+# a port must NOT get arbitrary-code execution via pickle.loads. Every
+# cluster session mints a random token (start_gcs, node.py); servers send a
+# 32-byte challenge on accept and require HMAC-SHA256(token, challenge)
+# back BEFORE the first frame is parsed. No token in the process -> auth is
+# off (bare RpcServer unit tests); cluster processes always inherit the
+# token via RAY_TPU_AUTH_TOKEN / the 0600 session file.
+_AUTH_MAGIC = b"RTA" + bytes([PROTOCOL_VERSION])
+_CHALLENGE_SIZE = 32
+_session_token: Optional[bytes] = None
+_token_loaded = False
+
+
+def set_session_token(token: Optional[bytes]) -> None:
+    global _session_token, _token_loaded
+    _session_token = token or None
+    _token_loaded = True
+
+
+def get_session_token() -> Optional[bytes]:
+    global _session_token, _token_loaded
+    if not _token_loaded:
+        import os
+
+        tok = os.environ.get("RAY_TPU_AUTH_TOKEN", "")
+        if not tok:
+            # Same-host attach without the env var: read the latest
+            # session's token file (written 0600 by node.ensure_auth_token).
+            base = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
+            path = os.path.join(base, "session_latest", "auth_token")
+            try:
+                with open(path) as f:
+                    tok = f.read().strip()
+            except OSError:
+                tok = ""
+        try:
+            _session_token = bytes.fromhex(tok) if tok else None
+        except ValueError as e:
+            raise AuthError(
+                "RAY_TPU_AUTH_TOKEN must be a hex string (64 hex chars for "
+                f"the standard 32-byte token); got {len(tok)} chars") from e
+        _token_loaded = True
+    return _session_token
+
+
+def _hmac_answer(token: bytes, challenge: bytes) -> bytes:
+    import hashlib
+    import hmac as hmac_mod
+
+    return hmac_mod.new(token, challenge, hashlib.sha256).digest()
+
 
 class RpcError(Exception):
     pass
@@ -59,10 +112,18 @@ class ProtocolMismatch(RpcError):
     pass
 
 
+class AuthError(RpcError):
+    pass
+
+
 async def _read_frame(reader: asyncio.StreamReader):
     hdr = await reader.readexactly(_HDR.size)
     magic, length = _HDR.unpack(hdr)
     if magic != _MAGIC:
+        if magic[:3] == b"RTA":
+            raise ProtocolMismatch(
+                "server requires wire authentication but this process has "
+                "no session token (RAY_TPU_AUTH_TOKEN unset)")
         if magic[:3] == b"RTP":
             raise ProtocolMismatch(
                 f"peer speaks ray_tpu wire protocol v{magic[3]}, this "
@@ -108,6 +169,33 @@ class RpcServer:
         return (self.host, self.port)
 
     async def _on_conn(self, reader, writer):
+        token = get_session_token()
+        if token is not None:
+            # Challenge-response BEFORE any frame is read: a peer that
+            # cannot produce HMAC(token, challenge) is dropped without a
+            # single pickle.loads of its bytes.
+            import os as _os
+
+            challenge = _os.urandom(_CHALLENGE_SIZE)
+            try:
+                writer.write(_AUTH_MAGIC + challenge)
+                await writer.drain()
+                answer = await asyncio.wait_for(
+                    reader.readexactly(_CHALLENGE_SIZE), 10.0)
+            except Exception:
+                answer = None
+            import hmac as _hmac
+
+            if answer is None or not _hmac.compare_digest(
+                    answer, _hmac_answer(token, challenge)):
+                logger.warning(
+                    "dropping unauthenticated connection from %s",
+                    writer.get_extra_info("peername"))
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+                return
         conn = ServerConnection(reader, writer)
         self._conns.add(conn)
         try:
@@ -258,6 +346,25 @@ class RpcClient:
                     raise
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, 0.5)
+        token = get_session_token()
+        if token is not None:
+            try:
+                hello = await asyncio.wait_for(
+                    self._reader.readexactly(len(_AUTH_MAGIC)
+                                             + _CHALLENGE_SIZE), 10.0)
+            except (asyncio.TimeoutError, asyncio.IncompleteReadError) as e:
+                self._writer.close()
+                raise AuthError(
+                    "this process has a session token but the server did "
+                    "not send an auth challenge (token/config mismatch)"
+                ) from e
+            if hello[:len(_AUTH_MAGIC)] != _AUTH_MAGIC:
+                self._writer.close()
+                raise AuthError(
+                    f"expected auth challenge, got {hello[:4]!r}")
+            self._writer.write(
+                _hmac_answer(token, hello[len(_AUTH_MAGIC):]))
+            await self._writer.drain()
         self._lock = asyncio.Lock()
         self._recv_task = asyncio.ensure_future(self._recv_loop())
         return self

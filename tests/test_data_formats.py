@@ -198,3 +198,23 @@ def test_tfrecord_mixed_numeric_list_promotes():
 
     got = decode_example(encode_example({"x": [1, 2.5]}))
     assert np.allclose(got["x"], [1.0, 2.5])
+
+
+def test_tfrecord_cross_file_scalar_list_mix(tmp_path, cluster):
+    """File A collapses a column to scalars (every record 1-element), file
+    B keeps it lists: cross-block concat must reconcile, not ArrowInvalid."""
+    from ray_tpu.data import read_tfrecords
+    from ray_tpu.data.tfrecord import encode_example, write_records
+
+    a = str(tmp_path / "a.tfrecords")
+    b = str(tmp_path / "b.tfrecords")
+    write_records(a, iter([encode_example({"ids": [1]}),
+                           encode_example({"ids": [2]})]))
+    write_records(b, iter([encode_example({"ids": [3, 4]})]))
+    ds = read_tfrecords([a, b])
+    rows = ds.take_all()
+    as_lists = [list(r["ids"]) if not np.isscalar(r["ids"]) else [r["ids"]]
+                for r in rows]
+    assert sorted(as_lists) == [[1], [2], [3, 4]]
+    df = ds.to_pandas()  # forces concat across the two file blocks
+    assert len(df) == 3
