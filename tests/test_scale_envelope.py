@@ -22,42 +22,42 @@ def cluster():
 
 
 def test_many_queued_tasks(cluster):
-    """10k trivial tasks queued at once all complete (reference row:
+    """100k trivial tasks queued at once all complete (reference row:
     1M+ queued on one node)."""
 
     @ray_tpu.remote
     def inc(x):
         return x + 1
 
-    refs = [inc.remote(i) for i in range(10_000)]
-    out = ray_tpu.get(refs, timeout=600)
-    assert out[0] == 1 and out[-1] == 10_000
-    assert len(out) == 10_000
+    refs = [inc.remote(i) for i in range(100_000)]
+    out = ray_tpu.get(refs, timeout=900)
+    assert out[0] == 1 and out[-1] == 100_000
+    assert len(out) == 100_000
 
 
 def test_many_args_to_single_task(cluster):
-    """2k object args resolve into one task (reference row: 10k+)."""
+    """5k object args resolve into one task (reference row: 10k+)."""
 
     @ray_tpu.remote
     def total(*parts):
         return sum(parts)
 
-    parts = [ray_tpu.put(i) for i in range(2_000)]
-    assert ray_tpu.get(total.remote(*parts), timeout=300) == \
-        sum(range(2_000))
+    parts = [ray_tpu.put(i) for i in range(5_000)]
+    assert ray_tpu.get(total.remote(*parts), timeout=600) == \
+        sum(range(5_000))
 
 
 def test_many_returns_from_single_task(cluster):
-    """500 returns from one task (reference row: 3k+)."""
+    """1k returns from one task (reference row: 3k+)."""
 
-    @ray_tpu.remote(num_returns=500)
+    @ray_tpu.remote(num_returns=1000)
     def spread():
-        return tuple(range(500))
+        return tuple(range(1000))
 
     refs = spread.remote()
-    assert len(refs) == 500
+    assert len(refs) == 1000
     vals = ray_tpu.get(refs, timeout=300)
-    assert vals == list(range(500))
+    assert vals == list(range(1000))
 
 
 def test_many_plasma_objects_in_one_get(cluster):
