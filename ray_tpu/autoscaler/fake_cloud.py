@@ -1,0 +1,142 @@
+"""Fake cloud instance API: an EXTERNAL-process reconciliation target.
+
+Reference analog: the kuberay operator pattern
+(python/ray/autoscaler/_private/kuberay/) — the autoscaler never creates
+nodes directly; it posts desired instances to an external API (k8s) that
+provisions ASYNCHRONOUSLY and can fail, and reconciles against what that
+API reports. This module is the k8s stand-in: a threaded HTTP server with
+lazy time-based status transitions (PENDING -> RUNNING at ready_at) and a
+chaos control surface (provision delay, fail-next-N launches).
+
+Run: python -m ray_tpu.autoscaler.fake_cloud --port 0 --ready-file PATH
+API:
+  POST   /instances  {"type": str, "count": int}      -> {"ids": [...]}
+  GET    /instances                                   -> {"instances": [...]}
+  DELETE /instances/<id>                              -> {}
+  POST   /control    {"provision_delay_s"?, "fail_next"?} -> {}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.instances: Dict[str, dict] = {}
+        self.provision_delay_s = 0.0
+        self.fail_next = 0
+
+    def tick(self):
+        """Lazy transitions: PENDING becomes RUNNING (or FAILED) at ready_at."""
+        now = time.time()
+        for inst in self.instances.values():
+            if inst["status"] == "PENDING" and now >= inst["ready_at"]:
+                inst["status"] = "FAILED" if inst["doomed"] else "RUNNING"
+
+    def create(self, type_name: str, count: int) -> list:
+        ids = []
+        slice_id = uuid.uuid4().hex[:8] if count > 1 else None
+        for i in range(count):
+            iid = f"fc-{uuid.uuid4().hex[:8]}"
+            doomed = False
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                doomed = True
+            self.instances[iid] = {
+                "id": iid, "type": type_name, "status": "PENDING",
+                "slice_id": slice_id, "worker_index": i,
+                "ready_at": time.time() + self.provision_delay_s,
+                "doomed": doomed,
+            }
+            ids.append(iid)
+        return ids
+
+
+def make_server(port: int = 0) -> ThreadingHTTPServer:
+    state = _State()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _reply(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> dict:
+            n = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(n) or b"{}")
+
+        def do_GET(self):
+            if self.path == "/instances":
+                with state.lock:
+                    state.tick()
+                    insts = [dict(i) for i in state.instances.values()]
+                self._reply({"instances": insts})
+            else:
+                self._reply({"error": "not found"}, 404)
+
+        def do_POST(self):
+            if self.path == "/instances":
+                req = self._body()
+                with state.lock:
+                    ids = state.create(req["type"], int(req.get("count", 1)))
+                self._reply({"ids": ids})
+            elif self.path == "/control":
+                req = self._body()
+                with state.lock:
+                    if "provision_delay_s" in req:
+                        state.provision_delay_s = float(
+                            req["provision_delay_s"])
+                    if "fail_next" in req:
+                        state.fail_next = int(req["fail_next"])
+                self._reply({})
+            else:
+                self._reply({"error": "not found"}, 404)
+
+        def do_DELETE(self):
+            if self.path.startswith("/instances/"):
+                iid = self.path.rsplit("/", 1)[1]
+                with state.lock:
+                    inst = state.instances.get(iid)
+                    if inst is not None:
+                        inst["status"] = "TERMINATED"
+                self._reply({})
+            else:
+                self._reply({"error": "not found"}, 404)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    srv.state = state  # type: ignore[attr-defined]
+    return srv
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--ready-file", default="")
+    args = ap.parse_args()
+    srv = make_server(args.port)
+    if args.ready_file:
+        tmp = args.ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"127.0.0.1:{srv.server_address[1]}")
+        import os
+
+        os.replace(tmp, args.ready_file)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -141,9 +141,117 @@ class GCETpuProvider(NodeProvider):
         return out
 
 
+class CloudAPIProvider(NodeProvider):
+    """Reconciling provider against an EXTERNAL cloud instance API
+    (ray_tpu/autoscaler/fake_cloud.py in tests; the kuberay-operator
+    pattern, reference autoscaler/_private/kuberay/): launches are POSTs
+    that provision asynchronously, listings come from the API's view, and
+    failures surface as instances that never reach RUNNING.
+
+    Node materialization: a real cloud VM boots a raylet that registers
+    with the GCS. When bound to an in-process Cluster (tests), the provider
+    simulates that boot by adding a cluster node the first time it sees the
+    instance RUNNING; get_node_id stays None while the instance PENDs,
+    which is exactly what the reconciler's boot-grace logic keys on."""
+
+    def __init__(self, api_address: str, cluster=None):
+        self.api = api_address.rstrip("/")
+        if not self.api.startswith(("http://", "https://")):
+            self.api = f"http://{self.api}"
+        self.cluster = cluster
+        self.types: Dict[str, InstanceType] = {}
+        self._nodes: Dict[str, object] = {}   # iid -> ClusterNode
+        self._listing: Dict[str, dict] = {}
+        self._listing_at = 0.0
+
+    # -- HTTP plumbing -----------------------------------------------------
+    def _req(self, method: str, path: str, body: Optional[dict] = None):
+        import json as json_mod
+        import urllib.request
+
+        data = json_mod.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.api + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json_mod.loads(r.read())
+
+    def _list(self) -> Dict[str, dict]:
+        """Instance listing with a short cache: one reconcile tick calls
+        get_node_id per booting instance, and each would otherwise be a
+        full-list round-trip against a rate-limited cloud API."""
+        import time as time_mod
+
+        now = time_mod.monotonic()
+        if now - self._listing_at > 0.2:
+            self._listing = {
+                i["id"]: i
+                for i in self._req("GET", "/instances")["instances"]}
+            self._listing_at = now
+        return self._listing
+
+    # -- NodeProvider ------------------------------------------------------
+    def launch(self, instance_type: InstanceType) -> str:
+        if instance_type.hosts > 1:
+            # launch() returns ONE tracked instance; silently creating
+            # hosts-1 untracked cloud instances would leak quota forever.
+            raise ValueError(
+                f"{instance_type.name} is a {instance_type.hosts}-host "
+                "slice; use launch_slice()")
+        return self.launch_slice(instance_type)[0]
+
+    def launch_slice(self, instance_type: InstanceType) -> List[str]:
+        self.types[instance_type.name] = instance_type
+        ids = self._req("POST", "/instances",
+                        {"type": instance_type.name,
+                         "count": instance_type.hosts})["ids"]
+        self._listing_at = 0.0  # mutation: next read must refetch
+        return ids
+
+    def terminate(self, instance_id: str) -> None:
+        self._req("DELETE", f"/instances/{instance_id}")
+        self._listing_at = 0.0
+        node = self._nodes.pop(instance_id, None)
+        if node is not None and self.cluster is not None:
+            self.cluster.remove_node(node, force=False)
+
+    def non_terminated(self) -> List[str]:
+        return [iid for iid, inst in self._list().items()
+                if inst["status"] in ("PENDING", "RUNNING")]
+
+    def get_node_id(self, instance_id: str) -> Optional[bytes]:
+        inst = self._list().get(instance_id)
+        if inst is None or inst["status"] != "RUNNING":
+            return None
+        node = self._nodes.get(instance_id)
+        if node is None:
+            if self.cluster is None:
+                return None
+            # Simulated VM boot: the instance's raylet comes up and
+            # registers (in production this happens on the VM itself).
+            t = self.types.get(inst["type"])
+            res = dict(t.resources) if t else {"CPU": 1.0}
+            labels = None
+            if t is not None and t.tpu_slice:
+                # Slice-aware placement gangs hosts by these labels
+                # (runtime/tpu_topology.py:73-77); a TPU node without them
+                # can never host a STRICT_PACK slice bundle.
+                labels = {
+                    "tpu-slice-name": inst.get("slice_id") or instance_id,
+                    "tpu-worker-id": str(inst.get("worker_index", 0)),
+                    "tpu-pod-type": t.tpu_slice,
+                }
+            node = self.cluster.add_node(
+                num_cpus=res.pop("CPU", 1), num_tpus=res.pop("TPU", 0),
+                resources=res or None, labels=labels)
+            self._nodes[instance_id] = node
+        return getattr(node, "node_id", None)
+
+
 PROVIDERS = {
     "local": LocalNodeProvider,
     "gce_tpu": GCETpuProvider,
+    "cloud_api": CloudAPIProvider,
 }
 
 

@@ -1,0 +1,191 @@
+"""Autoscaler v2 reconciler against an EXTERNAL fake cloud API process.
+
+Reference analog: the kuberay operator pattern
+(python/ray/autoscaler/_private/kuberay/) — async provisioning, failures
+surfacing as never-Ready instances, reconcile-don't-relaunch while booting,
+atomic slice reaping.
+"""
+
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, InstanceType
+from ray_tpu.autoscaler.providers import CloudAPIProvider
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def fake_cloud(tmp_path_factory):
+    ready = str(tmp_path_factory.mktemp("fc") / "ready")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.autoscaler.fake_cloud",
+         "--ready-file", ready],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 30
+    while not os.path.exists(ready):
+        assert time.monotonic() < deadline, "fake cloud did not start"
+        assert proc.poll() is None, "fake cloud died"
+        time.sleep(0.05)
+    addr = open(ready).read()
+    yield addr
+    proc.kill()
+    proc.wait()
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster()
+    c.add_node(num_cpus=1)  # head
+    ray_tpu.init(address=f"{c.gcs_address[0]}:{c.gcs_address[1]}")
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _control(addr, **kw):
+    req = urllib.request.Request(
+        f"http://{addr}/control", data=json.dumps(kw).encode(),
+        method="POST", headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=10).read()
+
+
+def _instances(addr):
+    with urllib.request.urlopen(f"http://{addr}/instances", timeout=10) as r:
+        return {i["id"]: i for i in json.loads(r.read())["instances"]}
+
+
+def test_async_provision_no_relaunch_then_ready(fake_cloud, cluster):
+    """Launch posts to the API; while the instance PENDs, repeated
+    reconciles must NOT relaunch; once RUNNING the node registers and the
+    demand is met."""
+    _control(fake_cloud, provision_delay_s=1.5, fail_next=0)
+    provider = CloudAPIProvider(fake_cloud, cluster=cluster)
+    asc = Autoscaler(provider, [InstanceType("c2", {"CPU": 2})],
+                     idle_timeout_s=3600, max_workers=4, boot_grace_s=60)
+    demand = [{"CPU": 2.0}]
+    r1 = asc.reconcile(demand=demand)
+    assert r1["launched"] == 1
+    # Async: instance is PENDING at the API, no node yet.
+    iid = next(iter(asc.instances))
+    assert _instances(fake_cloud)[iid]["status"] == "PENDING"
+    # Booting capacity suppresses relaunch.
+    for _ in range(3):
+        assert asc.reconcile(demand=demand)["launched"] == 0
+    # Provisioning completes; the provider materializes the node ("VM
+    # boot"), the reconciler binds it and marks RUNNING.
+    time.sleep(1.6)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        out = asc.reconcile(demand=demand)
+        inst = asc.instances[iid]
+        if inst.status == "RUNNING" and out["unmet_demand"] == 0 \
+                and out["launched"] == 0:
+            break
+        time.sleep(0.3)
+    else:
+        pytest.fail(f"instance never became RUNNING+placed: {asc.instances}")
+    assert _instances(fake_cloud)[iid]["status"] == "RUNNING"
+
+
+def test_failed_provision_reaped_and_replaced(fake_cloud, cluster):
+    """A launch the cloud fails never registers; after boot grace the
+    reconciler terminates it at the API and launches a replacement."""
+    _control(fake_cloud, provision_delay_s=0.1, fail_next=1)
+    provider = CloudAPIProvider(fake_cloud, cluster=cluster)
+    asc = Autoscaler(provider, [InstanceType("c8", {"CPU": 8})],
+                     idle_timeout_s=3600, max_workers=4, boot_grace_s=1.0)
+    demand = [{"CPU": 8.0}]  # bigger than any leftover node: must launch
+    assert asc.reconcile(demand=demand)["launched"] == 1
+    doomed = next(iter(asc.instances))
+    time.sleep(0.2)
+    assert _instances(fake_cloud)[doomed]["status"] == "FAILED"
+    # Within boot grace: reconciler still waits on it, no relaunch.
+    assert asc.reconcile(demand=demand)["launched"] == 0
+    time.sleep(1.0)
+    # Past grace: reaped at the API + replacement launched.
+    out = asc.reconcile(demand=demand)
+    assert out["launched"] == 1
+    assert doomed not in asc.instances
+    assert _instances(fake_cloud)[doomed]["status"] == "TERMINATED"
+    replacement = next(iter(asc.instances))
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        out = asc.reconcile(demand=demand)
+        if (asc.instances[replacement].status == "RUNNING"
+                and out["unmet_demand"] == 0):
+            break
+        time.sleep(0.3)
+    else:
+        pytest.fail("replacement never served the demand")
+
+
+def test_failed_slice_host_reaps_whole_slice(fake_cloud, cluster):
+    """Multi-host slice with one FAILED host: after boot grace the whole
+    slice is terminated atomically (a partial slice has no ICI ring)."""
+    _control(fake_cloud, provision_delay_s=0.1, fail_next=1)
+    provider = CloudAPIProvider(fake_cloud, cluster=None)  # no node binding
+    t = InstanceType("v5e-16", {"CPU": 4, "TPU": 4},
+                     tpu_slice="v5e-16", hosts=4)
+    asc = Autoscaler(provider, [t], idle_timeout_s=3600,
+                     max_workers=8, boot_grace_s=1.0)
+    demand = [{"TPU": 4.0}]
+    out = asc.reconcile(demand=demand)
+    assert out["launched"] == 4  # whole slice, one API create
+    ids = list(asc.instances)
+    slice_ids = {_instances(fake_cloud)[i]["slice_id"] for i in ids}
+    assert len(slice_ids) == 1  # one atomic create at the API
+    time.sleep(1.3)
+    asc.reconcile(demand=demand)
+    # Whole slice reaped with the failed host (+ a fresh slice relaunched).
+    api_view = _instances(fake_cloud)
+    assert all(api_view[i]["status"] == "TERMINATED" for i in ids)
+    assert all(i not in asc.instances for i in ids)
+
+
+def test_materialized_slice_nodes_carry_tpu_labels(fake_cloud, cluster):
+    """Slice nodes booted through the cloud provider must carry the
+    tpu-slice-name/tpu-worker-id labels that STRICT_PACK slice placement
+    gangs on (runtime/tpu_topology.py) - resources alone are not enough."""
+    from ray_tpu.state.api import list_nodes
+
+    _control(fake_cloud, provision_delay_s=0.0, fail_next=0)
+    provider = CloudAPIProvider(fake_cloud, cluster=cluster)
+    t = InstanceType("v5e-8x2", {"CPU": 2, "TPU": 4},
+                     tpu_slice="v5e-8", hosts=2)
+    asc = Autoscaler(provider, [t], idle_timeout_s=3600,
+                     max_workers=16, boot_grace_s=60)
+    demand = [{"TPU": 4.0}, {"TPU": 4.0}]
+    asc.reconcile(demand=demand)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        out = asc.reconcile(demand=demand)
+        if out["unmet_demand"] == 0 and all(
+                i.status == "RUNNING" for i in asc.instances.values()):
+            break
+        time.sleep(0.3)
+    else:
+        pytest.fail(f"slice never fully booted: {asc.instances}")
+    tpu_nodes = [n for n in list_nodes()
+                 if n["alive"] and n["resources"].get("TPU")]
+    assert len(tpu_nodes) >= 2
+    slice_names = {n["labels"].get("tpu-slice-name") for n in tpu_nodes[-2:]}
+    worker_ids = sorted(n["labels"].get("tpu-worker-id")
+                        for n in tpu_nodes[-2:])
+    assert len(slice_names) == 1 and None not in slice_names
+    assert worker_ids == ["0", "1"]
+
+
+def test_multihost_launch_without_slice_api_raises(fake_cloud):
+    """launch() on a multi-host type must refuse (it would orphan
+    hosts-1 untracked cloud instances)."""
+    provider = CloudAPIProvider(fake_cloud)
+    t = InstanceType("v5e-16", {"TPU": 4}, tpu_slice="v5e-16", hosts=4)
+    with pytest.raises(ValueError, match="launch_slice"):
+        provider.launch(t)
