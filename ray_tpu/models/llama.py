@@ -39,6 +39,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "full": recompute everything in backward (min memory, ~fwd again of
+    # extra FLOPs). "dots": save matmul outputs without batch dims
+    # (projections/MLP), recompute elementwise + attention scores — the
+    # usual TPU sweet spot when HBM allows (scaling-book remat recipe).
+    remat_policy: str = "full"     # full | dots
     attention_impl: str = "auto"   # reference | flash | ring
     sp_axis: str = "sp"
 
@@ -256,8 +261,14 @@ def forward(params: Dict, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
 
     layer_fn = partial(_layer, config)
     if config.remat:
-        layer_fn = jax.checkpoint(
-            layer_fn, policy=jax.checkpoint_policies.nothing_saveable)
+        if config.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', "
+                f"got {config.remat_policy!r}")
+        policy = (jax.checkpoint_policies.nothing_saveable
+                  if config.remat_policy == "full" else
+                  jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
     def scan_body(x, layer_params):
         return layer_fn(x, layer_params, cos, sin), None

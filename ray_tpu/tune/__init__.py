@@ -3,6 +3,7 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     HyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (  # noqa: F401

@@ -163,6 +163,10 @@ class TuneController:
                 trial.trial_id, self.storage_path)
             cfg = config if config is not None else trial.config
             trial.config = cfg
+            if hasattr(self.scheduler, "on_trial_config"):
+                # Config-aware schedulers (PB2's GP conditions on the
+                # hyperparameters each trial is running).
+                self.scheduler.on_trial_config(trial.trial_id, cfg)
             ray_tpu.get(trial.actor.start.remote(payload, cfg, checkpoint_dir),
                         timeout=120)
             trial.status = RUNNING

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import math
 import random
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
@@ -278,4 +278,140 @@ class PopulationBasedTraining(TrialScheduler):
             elif isinstance(out[key], (int, float)):
                 factor = self.rng.choice([0.8, 1.25])
                 out[key] = type(out[key])(out[key] * factor)
+        return out
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits: PBT whose explore() selects new
+    hyperparameters by GP-UCB over observed (time, config) -> reward-delta
+    data instead of random perturbation.
+
+    Reference analog: python/ray/tune/schedulers/pb2.py (GPy-backed); this
+    is a dependency-free numpy GP (RBF kernel, fixed hyperparameters on
+    standardized data) — the PB2 selection rule without the GPy stack.
+
+    hyperparam_bounds: {key: (low, high)} continuous bounds; keys listed in
+    log_scale_keys are modeled in log10 space (learning rates).
+    """
+
+    def __init__(self, metric: str, mode: str = "max", *,
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Dict[str, Tuple[float, float]],
+                 quantile_fraction: float = 0.25, seed: int = 0,
+                 log_scale_keys: Tuple[str, ...] = (),
+                 time_attr: str = "training_iteration"):
+        super().__init__(metric, mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed,
+                         time_attr=time_attr)
+        if not hyperparam_bounds:
+            raise ValueError("PB2 needs hyperparam_bounds")
+        self.bounds = dict(hyperparam_bounds)
+        self.log_keys = set(log_scale_keys)
+        self.keys = sorted(self.bounds)
+        self.configs: Dict[str, Dict] = {}       # trial_id -> live config
+        self._prev: Dict[str, Tuple[float, float]] = {}  # tid -> (t, score)
+        self.data: list = []                     # rows: [t, *x, delta]
+
+    # Controller hook: runs at every (re)start, including exploit restarts.
+    def on_trial_config(self, trial_id: str, config: Dict) -> None:
+        self.configs[trial_id] = dict(config)
+        # Drop the pre-restart (t, score) anchor: an exploit copies a better
+        # trial's weights, and crediting that score jump to the NEW config
+        # would feed the GP a huge spurious delta.
+        self._prev.pop(trial_id, None)
+
+    def _x_of(self, config: Dict) -> list:
+        out = []
+        for k in self.keys:
+            v = float(config.get(k, self.bounds[k][0]))
+            out.append(math.log10(max(v, 1e-12)) if k in self.log_keys
+                       else v)
+        return out
+
+    def _norm_bounds(self) -> list:
+        out = []
+        for k in self.keys:
+            lo, hi = self.bounds[k]
+            if k in self.log_keys:
+                lo, hi = math.log10(max(lo, 1e-12)), math.log10(max(hi, 1e-12))
+            out.append((float(lo), float(hi)))
+        return out
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        score = result.get(self.metric)
+        t = float(result.get(self.time_attr, 0))
+        if score is not None:
+            prev = self._prev.get(trial_id)
+            cfg = self.configs.get(trial_id)
+            if prev is not None and cfg is not None and t > prev[0]:
+                delta = (score - prev[1]) / (t - prev[0])
+                if self.mode == "min":
+                    delta = -delta
+                self.data.append([t] + self._x_of(cfg) + [delta])
+                if len(self.data) > 512:
+                    self.data = self.data[-512:]
+            self._prev[trial_id] = (t, float(score))
+        return super().on_result(trial_id, result)
+
+    # -- GP-UCB selection --------------------------------------------------
+    def _gp_ucb_choice(self, t_now: float):
+        import numpy as np
+
+        nb = self._norm_bounds()
+        d = len(self.keys)
+        # Candidate set: random in bounds at the current time.
+        n_cand = 256
+        cand = np.empty((n_cand, d))
+        for j, (lo, hi) in enumerate(nb):
+            cand[:, j] = np.asarray(
+                [self.rng.uniform(lo, hi) for _ in range(n_cand)])
+        if len(self.data) < 4:
+            return cand[0]
+        arr = np.asarray(self.data, dtype=np.float64)
+        Xr, y = arr[:, :-1], arr[:, -1]
+        # Normalize inputs to [0,1] (time by its own range), standardize y.
+        t_lo, t_hi = Xr[:, 0].min(), max(Xr[:, 0].max(), t_now)
+        scale = [(t_lo, max(t_hi - t_lo, 1e-9))] + [
+            (lo, max(hi - lo, 1e-9)) for lo, hi in nb]
+        X = (Xr - np.asarray([s[0] for s in scale])) / np.asarray(
+            [s[1] for s in scale])
+        y_mu, y_sd = y.mean(), max(y.std(), 1e-9)
+        ys = (y - y_mu) / y_sd
+        Xc = np.hstack([np.full((n_cand, 1), t_now), cand])
+        Xc = (Xc - np.asarray([s[0] for s in scale])) / np.asarray(
+            [s[1] for s in scale])
+        # RBF GP with fixed hyperparameters on standardized data.
+        ell, sf2, sn2 = 0.3, 1.0, 0.01
+        def k(a, b):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return sf2 * np.exp(-d2 / (2 * ell * ell))
+        K = k(X, X) + sn2 * np.eye(len(X))
+        try:
+            L = np.linalg.cholesky(K)
+        except np.linalg.LinAlgError:
+            return cand[0]
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, ys))
+        Ks = k(Xc, X)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.clip(sf2 - (v * v).sum(0), 1e-12, None)
+        beta = 2.0 * np.log(max(len(self.data), 2) * n_cand)
+        ucb = mu + np.sqrt(beta * var)
+        return cand[int(np.argmax(ucb))]
+
+    def explore(self, config: Dict) -> Dict:
+        t_now = max((t for t, _ in self._prev.values()), default=0.0)
+        x = self._gp_ucb_choice(t_now)
+        out = dict(config)
+        for j, key in enumerate(self.keys):
+            v = float(x[j])
+            if key in self.log_keys:
+                v = 10.0 ** v
+            lo, hi = self.bounds[key]
+            v = min(max(v, lo), hi)
+            if isinstance(config.get(key), int):
+                v = int(round(v))
+            out[key] = v
         return out

@@ -7,3 +7,10 @@ from ray_tpu.workflow.api import (  # noqa: F401
     run,
     run_async,
 )
+from ray_tpu.workflow.events import (  # noqa: F401
+    EventListener,
+    HTTPEventProvider,
+    HTTPListener,
+    TimerListener,
+    wait_for_event,
+)

@@ -89,9 +89,13 @@ class _Store:
 def _step_key(node: DAGNode, index: int) -> str:
     """Stable per-step key: topo index + function name (topology-addressed,
     like the reference's workflow_state step ids)."""
+    from ray_tpu.workflow.events import EventNode
+
     name = "output"
     if isinstance(node, FunctionNode):
         name = getattr(node.remote_fn, "__name__", "step")
+    elif isinstance(node, EventNode):
+        name = f"event_{node.listener_cls.__name__}"
     return f"{index:04d}_{name}"
 
 
@@ -110,11 +114,44 @@ class _Execution:
         self.kwargs = kwargs
 
     def run(self) -> Any:
+        from ray_tpu.workflow.events import EventNode
+
         nodes = self.dag.topo_sort()
         cache: Dict[int, Any] = {}
         for i, node in enumerate(nodes):
             key = _step_key(node, i)
-            if isinstance(node, FunctionNode):
+            if isinstance(node, EventNode):
+                # External-event step: checkpointed like any step, so a
+                # resume replays the stored event instead of re-polling;
+                # the listener ack runs only AFTER the durable write
+                # (commit-then-confirm, reference http_event_provider.py).
+                if self.store.has_step(key):
+                    event = self.store.load_step(key)
+                    # Re-ack on restore: the previous run may have died
+                    # between the durable write and the ack, leaving the
+                    # provider holding the sender's POST. poll is skipped
+                    # (exactly-once), the confirm is at-least-once.
+                    try:
+                        replay = node.listener_cls()
+                        replay.wait_args = node.listener_args
+                        replay.wait_kwargs = node.listener_kwargs
+                        replay.event_checkpointed(event)
+                    except Exception:
+                        logger.exception(
+                            "workflow: event %s re-ack failed", key)
+                    cache[node.node_id] = event
+                    logger.info("workflow: event %s restored from storage",
+                                key)
+                    continue
+                listener = node.listener_cls()
+                listener.wait_args = node.listener_args
+                listener.wait_kwargs = node.listener_kwargs
+                event = listener.poll_for_event(*node.listener_args,
+                                                **node.listener_kwargs)
+                self.store.save_step(key, event)
+                listener.event_checkpointed(event)
+                cache[node.node_id] = event
+            elif isinstance(node, FunctionNode):
                 if self.store.has_step(key):
                     cache[node.node_id] = self.store.load_step(key)
                     logger.info("workflow: step %s restored from storage", key)

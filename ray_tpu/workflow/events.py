@@ -1,0 +1,206 @@
+"""Workflow event system: durable external-event steps.
+
+Reference analog: python/ray/workflow/event_listener.py (EventListener,
+TimerListener) and python/ray/workflow/http_event_provider.py
+(HTTPEventProvider named actor + HTTPListener). Redesigned for this
+engine: an event is just a workflow STEP whose value comes from the
+outside world — the engine checkpoints the received event through the
+same per-step storage as any other step (exactly-once: a resumed workflow
+replays the checkpointed event instead of re-polling), then acks the
+listener (`event_checkpointed`) so the provider can confirm delivery.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Optional, Tuple
+
+from ray_tpu.dag.node import DAGNode
+
+
+class EventListener:
+    """Subclass and pass to wait_for_event. poll_for_event blocks until the
+    event arrives and returns its payload; event_checkpointed runs AFTER
+    the engine has durably stored the event (commit ack).
+
+    The engine sets `wait_args`/`wait_kwargs` (the wait_for_event
+    arguments) on every instance before calling either method — on resume,
+    event_checkpointed may run on a FRESH instance whose poll was skipped
+    (the event replays from storage), so ack logic must key off wait_args,
+    not poll-time state."""
+
+    wait_args: Tuple = ()
+    wait_kwargs: dict = {}
+
+    def poll_for_event(self, *args, **kwargs) -> Any:
+        raise NotImplementedError
+
+    def event_checkpointed(self, event: Any) -> None:
+        pass
+
+
+class TimerListener(EventListener):
+    """Fires at an absolute unix timestamp (reference: TimerListener)."""
+
+    def poll_for_event(self, timestamp: float) -> float:
+        time.sleep(max(0.0, timestamp - time.time()))
+        return timestamp
+
+
+class EventNode(DAGNode):
+    """A DAG node whose value is an external event. Executed by the
+    workflow engine in-driver: listeners keep local state and the ack must
+    happen after the engine's checkpoint write."""
+
+    def __init__(self, listener_cls, args: Tuple, kwargs: dict):
+        super().__init__((), {})
+        self.listener_cls = listener_cls
+        self.listener_args = tuple(args)
+        self.listener_kwargs = dict(kwargs or {})
+
+    def _eval(self, cache, args, kwargs):  # uncompiled dag.execute() path
+        listener = self.listener_cls()
+        listener.wait_args = self.listener_args
+        listener.wait_kwargs = self.listener_kwargs
+        event = listener.poll_for_event(*self.listener_args,
+                                        **self.listener_kwargs)
+        listener.event_checkpointed(event)
+        return event
+
+
+def wait_for_event(listener_cls, *args, **kwargs) -> EventNode:
+    """DAG node that waits for an external event (reference:
+    workflow.wait_for_event). The event payload becomes the node's value;
+    downstream steps consume it like any task result."""
+    if not (isinstance(listener_cls, type)
+            and issubclass(listener_cls, EventListener)):
+        raise TypeError("wait_for_event needs an EventListener subclass")
+
+    def has_node(x):
+        if isinstance(x, DAGNode):
+            return True
+        if isinstance(x, (list, tuple)):
+            return any(has_node(v) for v in x)
+        if isinstance(x, dict):
+            return any(has_node(v) for v in x.values())
+        return False
+
+    if has_node(args) or has_node(kwargs):
+        # The engine passes listener args verbatim (an event step has no
+        # upstream deps); a DAG node here would reach poll_for_event raw.
+        raise TypeError(
+            "wait_for_event arguments must be plain values, not DAG nodes")
+    return EventNode(listener_cls, args, kwargs)
+
+
+# --------------------------------------------------------- HTTP provider
+
+class HTTPEventProvider:
+    """A small HTTP endpoint external systems POST events to
+    (reference: http_event_provider.py's named-actor aiohttp server;
+    ours is a threaded stdlib server — no event-loop coupling).
+
+        provider = HTTPEventProvider()          # .address -> (host, port)
+        POST http://host:port/event/send_event/<workflow_id>
+             {"event_key": k, "event_payload": p}    -> 200 after delivery
+
+    The POST response is held until the workflow checkpoints the event
+    (event_checkpointed ack) or times out — at-least-once from the
+    sender's view, exactly-once in the workflow via step storage."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 ack_timeout_s: float = 60.0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self._events = {}       # (workflow_id, event_key) -> payload
+        self._acked = set()
+        self._cv = threading.Condition()
+        ack_timeout = ack_timeout_s
+        provider = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                parts = self.path.strip("/").split("/")
+                # event/send_event/<workflow_id>
+                if len(parts) != 3 or parts[:2] != ["event", "send_event"]:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                workflow_id = parts[2]
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n))
+                    key = req["event_key"]
+                    payload = req["event_payload"]
+                except Exception:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                with provider._cv:
+                    provider._events[(workflow_id, key)] = payload
+                    provider._cv.notify_all()
+                    ok = provider._cv.wait_for(
+                        lambda: (workflow_id, key) in provider._acked,
+                        timeout=ack_timeout)
+                body = json.dumps(
+                    {"status": "delivered" if ok else "timeout"}).encode()
+                self.send_response(200 if ok else 500)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._srv.server_address[:2]
+
+    # Listener-facing API ---------------------------------------------------
+    def get_event(self, workflow_id: str, event_key: str,
+                  timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while (workflow_id, event_key) not in self._events:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"no event {event_key!r} for {workflow_id!r}")
+                self._cv.wait(timeout=remaining)
+            return self._events[(workflow_id, event_key)]
+
+    def report_checkpointed(self, workflow_id: str, event_key: str) -> None:
+        with self._cv:
+            self._acked.add((workflow_id, event_key))
+            self._cv.notify_all()
+
+    def shutdown(self):
+        self._srv.shutdown()
+        self._thread.join(timeout=5)
+
+
+class HTTPListener(EventListener):
+    """Listens for events delivered to an HTTPEventProvider in this
+    process (reference: HTTPListener polling the named provider actor)."""
+
+    provider: Optional[HTTPEventProvider] = None  # set by tests/apps
+
+    def poll_for_event(self, workflow_id: str, event_key: str,
+                       timeout: Optional[float] = None) -> Any:
+        if self.provider is None:
+            raise RuntimeError("HTTPListener.provider is not set")
+        return self.provider.get_event(workflow_id, event_key, timeout)
+
+    def event_checkpointed(self, event: Any) -> None:
+        # Keyed off wait_args (not poll state): on resume this runs on a
+        # fresh instance to re-confirm a held/re-sent POST.
+        if self.provider is not None and len(self.wait_args) >= 2:
+            self.provider.report_checkpointed(*self.wait_args[:2])

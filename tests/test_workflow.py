@@ -91,3 +91,115 @@ def test_workflow_digest_conflict(cluster, tmp_path):
         dag2 = double.bind(add.bind(inp, 1))
     with pytest.raises(ValueError, match="different DAG"):
         workflow.run(dag2, workflow_id="wf4", storage=str(tmp_path), args=(1,))
+
+
+# ------------------------------------------------------------- events
+
+def test_wait_for_event_timer(cluster, tmp_path):
+    """A TimerListener event step gates downstream execution
+    (reference: workflow/event_listener.py TimerListener)."""
+    import time
+
+    from ray_tpu.workflow import TimerListener, wait_for_event
+
+    @ray_tpu.remote
+    def after(ts):
+        return ("fired", ts)
+
+    fire_at = time.time() + 0.3
+    dag = after.bind(wait_for_event(TimerListener, fire_at))
+    t0 = time.time()
+    out = workflow.run(dag, workflow_id="wf-timer",
+                       storage=str(tmp_path / "wf"))
+    assert out == ("fired", fire_at)
+    assert time.time() - t0 >= 0.25
+
+
+def test_http_event_provider_end_to_end(cluster, tmp_path):
+    """External POST delivers the event; the sender's response is held
+    until the workflow checkpoints it (commit-then-confirm)."""
+    import json
+    import threading
+    import urllib.request
+
+    from ray_tpu.workflow import (HTTPEventProvider, HTTPListener,
+                                  wait_for_event)
+
+    provider = HTTPEventProvider()
+    HTTPListener.provider = provider
+    try:
+        host, port = provider.address
+
+        @ray_tpu.remote
+        def consume(ev):
+            return {"got": ev}
+
+        dag = consume.bind(
+            wait_for_event(HTTPListener, "wf-http", "approval"))
+
+        sender_result = {}
+
+        def sender():
+            # Post after the workflow starts polling.
+            import time as time_mod
+
+            time_mod.sleep(0.4)
+            req = urllib.request.Request(
+                f"http://{host}:{port}/event/send_event/wf-http",
+                data=json.dumps({"event_key": "approval",
+                                 "event_payload": {"approved": True}}
+                                ).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                sender_result.update(json.loads(r.read()))
+
+        t = threading.Thread(target=sender)
+        t.start()
+        out = workflow.run(dag, workflow_id="wf-http",
+                           storage=str(tmp_path / "wf"))
+        t.join(timeout=30)
+        assert out == {"got": {"approved": True}}
+        # Sender saw the post-checkpoint ack.
+        assert sender_result.get("status") == "delivered"
+    finally:
+        HTTPListener.provider = None
+        provider.shutdown()
+
+
+def test_event_checkpoint_replayed_on_resume(cluster, tmp_path):
+    """A resumed workflow replays the stored event instead of re-polling
+    (exactly-once)."""
+    import time
+
+    from ray_tpu.workflow import EventListener, wait_for_event
+
+    polls = []
+
+    class OneShot(EventListener):
+        def poll_for_event(self):
+            polls.append(time.time())
+            return "the-event"
+
+    @ray_tpu.remote
+    def fail_after(ev):
+        raise RuntimeError("downstream-fails")
+
+    dag = fail_after.bind(wait_for_event(OneShot))
+    with pytest.raises(Exception, match="downstream-fails"):
+        workflow.run(dag, workflow_id="wf-replay",
+                     storage=str(tmp_path / "wf"))
+    assert len(polls) == 1
+
+    @ray_tpu.remote
+    def succeed(ev):
+        return ("ok", ev)
+
+    dag2 = succeed.bind(wait_for_event(OneShot))
+    # Different downstream -> different digest; same event step index. Use
+    # resume on the ORIGINAL dag shape but a healthy function this time is
+    # not possible without redefining; instead resume the failed workflow
+    # and assert the event step was NOT re-polled.
+    with pytest.raises(Exception, match="downstream-fails"):
+        workflow.resume("wf-replay", dag, storage=str(tmp_path / "wf"))
+    assert len(polls) == 1  # event replayed from storage, not re-polled
