@@ -535,6 +535,7 @@ class CoreWorker:
                 "name": spec.name,
                 "state": state,
                 "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+                "worker": self.worker_ident,  # timeline lane key
                 "time": time.time(),
                 "error": error,
             })

@@ -28,6 +28,10 @@ logger = logging.getLogger(__name__)
 JOB_KV_PREFIX = b"jobsub:"
 
 
+def _id_str(v) -> str:
+    return v.hex() if isinstance(v, bytes) else str(v or "")
+
+
 def _json(data, status=200):
     return web.Response(text=json.dumps(data, default=_coerce), status=status,
                         content_type="application/json")
@@ -167,7 +171,10 @@ class DashboardHead:
             web.get("/", self.index),
             web.get("/api/version", self.version),
             web.get("/api/nodes", self.nodes),
+            web.get("/api/nodes/{node_id}", self.node_detail),
             web.get("/api/actors", self.actors),
+            web.get("/api/actors/{actor_id}", self.actor_detail),
+            web.get("/api/timeline", self.timeline),
             web.get("/api/placement_groups", self.placement_groups),
             web.get("/api/cluster_resources", self.cluster_resources),
             web.get("/api/tasks", self.tasks),
@@ -219,6 +226,98 @@ class DashboardHead:
 
     async def actors(self, request):
         return _json(await self.gcs.call("list_actors"))
+
+    async def node_detail(self, request):
+        """Node drill-down: full record + the actors placed on it (the
+        reference dashboard's node page)."""
+        node_id = request.match_info["node_id"]
+        nodes = await self.gcs.call("get_nodes", only_alive=False)
+        # GCS returns raw bytes ids in-process; URLs carry hex prefixes.
+        node = next((n for n in nodes
+                     if _id_str(n["node_id"]).startswith(node_id)), None)
+        if node is None:
+            return _json({"error": f"no node {node_id}"}, status=404)
+        actors = await self.gcs.call("list_actors")
+        node["actors"] = [
+            a for a in actors
+            if _id_str(a.get("node_id") or b"") == _id_str(node["node_id"])]
+        return _json(node)
+
+    async def actor_detail(self, request):
+        """Actor drill-down: full record + its recent task transitions."""
+        actor_id = request.match_info["actor_id"]
+        actors = await self.gcs.call("list_actors")
+        actor = next((a for a in actors
+                      if _id_str(a["actor_id"]).startswith(actor_id)), None)
+        if actor is None:
+            return _json({"error": f"no actor {actor_id}"}, status=404)
+        aid_hex = _id_str(actor["actor_id"])
+        events = await self.gcs.call("task_timeline", limit=5000)
+        actor["task_events"] = [
+            e for e in events
+            if _id_str(e.get("actor_id") or b"") == aid_hex][-200:]
+        return _json(actor)
+
+    async def timeline(self, request):
+        """Execution bars for the timeline view: RUNNING..FINISHED/FAILED
+        pairs per task, laned by executing worker (`ray timeline` /
+        chrome-trace analog; /api/timeline?format=chrome downloads a
+        chrome://tracing-loadable JSON)."""
+        try:
+            limit = int(request.query.get("limit", "2000"))
+            if limit <= 0:
+                raise ValueError
+        except ValueError:
+            return _json({"error": "limit must be a positive integer"},
+                         status=400)
+        events = await self.gcs.call("task_timeline", limit=limit)
+        # Pair by task_id, tolerating any arrival/clock order: driver
+        # batches (SUBMITTED/FINISHED) interleave with worker batches
+        # (RUNNING), and inter-node clock skew can even put a FINISHED
+        # stamp before its RUNNING stamp.
+        open_at: dict = {}
+        done_at: dict = {}
+        bars = []
+
+        def close(start, end_ev):
+            bars.append({
+                "task_id": start["task_id"], "name": end_ev["name"],
+                "worker": start.get("worker") or "?",
+                "start": start["time"],
+                "end": max(end_ev["time"], start["time"]),  # skew clamp
+                "ok": end_ev["state"] == "FINISHED",
+                "actor_id": end_ev.get("actor_id"),
+            })
+
+        for ev in sorted(events, key=lambda e: e["time"]):
+            tid = ev["task_id"]
+            if ev["state"] == "RUNNING":
+                if tid in done_at:
+                    close(ev, done_at.pop(tid))
+                else:
+                    open_at[tid] = ev
+            elif ev["state"] in ("FINISHED", "FAILED"):
+                if tid in open_at:
+                    close(open_at.pop(tid), ev)
+                else:
+                    done_at[tid] = ev  # RUNNING may arrive later (skew)
+        now = time.time()
+        for start in open_at.values():  # still running: open-ended bar
+            bars.append({
+                "task_id": start["task_id"], "name": start["name"],
+                "worker": start.get("worker") or "?",
+                "start": start["time"], "end": max(now, start["time"]),
+                "ok": None, "actor_id": start.get("actor_id"),
+            })
+        if request.query.get("format") == "chrome":
+            trace = [{
+                "name": b["name"], "ph": "X", "ts": b["start"] * 1e6,
+                "dur": (b["end"] - b["start"]) * 1e6,
+                "pid": "ray_tpu", "tid": b["worker"],
+                "args": {"task_id": b["task_id"]},
+            } for b in bars]
+            return _json({"traceEvents": trace})
+        return _json(bars)
 
     async def placement_groups(self, request):
         return _json(await self.gcs.call("list_placement_groups"))

@@ -618,6 +618,15 @@ class GcsServer:
                 break
         return out
 
+    async def handle_task_timeline(self, conn, limit: int = 2000):
+        """Full state-transition log (not just latest-per-task): the
+        dashboard timeline pairs RUNNING->FINISHED/FAILED per task into
+        per-worker execution bars (GcsTaskManager export / `ray timeline`
+        analog)."""
+        store = getattr(self, "_task_events", None) or []
+        events = list(store)[-limit:]
+        return events
+
     async def handle_list_actors(self, conn):
         return [r.view() for r in self._actors.values()]
 

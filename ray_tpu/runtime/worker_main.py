@@ -223,6 +223,10 @@ class WorkerRuntime:
                 self.core, spec.runtime_env, self.core.session_dir)
             args, kwargs = self.core.resolve_args(spec)
             self.core.current_task_name = spec.name
+            # RUNNING is recorded by the EXECUTING worker (the driver only
+            # sees SUBMITTED/FINISHED), giving the dashboard timeline its
+            # per-worker execution bars (task_event_buffer.h analog).
+            self.core._record_task_event(spec, "RUNNING")
             with tracing.span(spec.name, "task:execute",
                               task_id=spec.task_id.hex()[:12]):
                 result = fn(*args, **kwargs)
@@ -272,6 +276,7 @@ class WorkerRuntime:
 
                 applied, args, kwargs = await loop.run_in_executor(None, _prep)
                 self.core.current_task_name = spec.name
+                self.core._record_task_event(spec, "RUNNING")
                 if inspect.isasyncgenfunction(getattr(fn, "__func__", fn)):
                     if spec.num_returns != self.STREAMING:
                         raise TypeError(

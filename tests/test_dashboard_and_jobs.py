@@ -128,3 +128,56 @@ def test_usage_stats_report(tmp_path):
     usage_stats.write_report(str(tmp_path))
     report = json.loads((tmp_path / "usage_stats.json").read_text())
     assert report["source"] == "ray_tpu" and "version" in report
+
+
+def test_dashboard_drilldown_and_timeline(cluster_with_dashboard):
+    """Node/actor drill-down endpoints + the RUNNING->FINISHED timeline
+    (reference: dashboard node/actor pages + `ray timeline`)."""
+    import time
+
+    url = cluster_with_dashboard
+
+    @ray_tpu.remote
+    class Counter:
+        def bump(self):
+            return 1
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.bump.remote(), timeout=30) == 1
+
+    @ray_tpu.remote
+    def work(x):
+        time.sleep(0.05)
+        return x
+
+    ray_tpu.get([work.remote(i) for i in range(4)], timeout=60)
+    time.sleep(1.5)  # task-event flush interval
+
+    nodes = _get_json(url + "/api/nodes")
+    detail = _get_json(f"{url}/api/nodes/{nodes[0]['node_id'][:12]}")
+    assert detail["node_id"] == nodes[0]["node_id"]
+    assert "actors" in detail
+
+    actors = _get_json(url + "/api/actors")
+    aid = actors[0]["actor_id"]
+    adetail = _get_json(f"{url}/api/actors/{aid[:12]}")
+    assert adetail["actor_id"] == aid
+    assert "task_events" in adetail
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        bars = _get_json(url + "/api/timeline")
+        named = [b for b in bars if b["name"].endswith("work")]
+        if len(named) >= 4:
+            break
+        time.sleep(0.5)
+    assert len(named) >= 4, bars
+    for b in named:
+        assert b["end"] >= b["start"]
+        assert b["worker"], b
+        assert b["ok"] is True
+
+    chrome = _get_json(url + "/api/timeline?format=chrome")
+    evs = [e for e in chrome["traceEvents"]
+           if e["name"].endswith("work")]
+    assert evs and all(e["ph"] == "X" and e["dur"] > 0 for e in evs)
