@@ -47,6 +47,10 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                                        "is declared dead"),
     "worker_monitor_interval_s": (float, 0.2,
                                   "raylet child-process poll period"),
+    "worker_pool_max_idle": (int, 8,
+                             "idle workers kept per raylet; beyond this the "
+                             "oldest idle worker is terminated (bounds pool "
+                             "growth across distinct runtime_envs)"),
     "memory_monitor_interval_s": (float, 1.0, "OOM monitor sample period"),
     "memory_usage_threshold": (float, 0.95,
                                "fraction of system memory triggering the "
@@ -55,6 +59,12 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
     "object_store_memory_default": (int, 2 << 30,
                                     "default shm store capacity bytes"),
     "spill_chunk_bytes": (int, 8 << 20, "spill file IO chunk"),
+    "spill_high_watermark": (float, 0.85,
+                             "store fill fraction where the raylet starts "
+                             "proactive background spilling (0 disables)"),
+    "spill_low_watermark": (float, 0.70,
+                            "proactive spilling stops below this fill "
+                            "fraction"),
     "pull_admission_concurrency": (int, 16,
                                    "concurrent cross-node chunk reads a "
                                    "raylet serves (admission control)"),

@@ -1035,10 +1035,24 @@ class CoreWorker:
             return False
         return True
 
+    @staticmethod
+    def _env_key(runtime_env: Optional[dict]) -> Optional[str]:
+        """Stable runtime_env fingerprint: leases (and therefore pooled
+        workers) are only shared between tasks with the SAME env
+        (worker_pool.h runtime-env-keyed pool)."""
+        if not runtime_env:
+            return None
+        import hashlib
+        import json as json_mod
+
+        return hashlib.sha1(json_mod.dumps(
+            runtime_env, sort_keys=True, default=repr).encode()
+        ).hexdigest()[:16]
+
     def _scheduling_key(self, spec: TaskSpec) -> Tuple:
         res = tuple(sorted(spec.resources.items()))
         pg = (spec.placement_group_id, spec.placement_group_bundle_index)
-        return (spec.fn_id, res, pg)
+        return (spec.fn_id, res, pg, self._env_key(spec.runtime_env))
 
     async def _submit_async(self, spec: TaskSpec):
         key = self._scheduling_key(spec)
@@ -1095,7 +1109,8 @@ class CoreWorker:
                 for _hop in range(4):  # bounded spillback chain
                     reply = await target.call(
                         "lease_worker", resources=spec_resources, req_id=req_id,
-                        placement_group_id=pg_id, bundle_index=bundle_index)
+                        placement_group_id=pg_id, bundle_index=bundle_index,
+                        env_key=key[3] if len(key) > 3 else None)
                     if reply.get("spillback"):
                         target = await self._raylet_for(tuple(reply["spillback"]))
                         continue

@@ -44,15 +44,21 @@ class WorkerHandle:
         self.lease_resources: Dict[str, float] = {}
         self.pg_key: Optional[Tuple[bytes, int]] = None
         self.req_id: Optional[bytes] = None
+        # runtime_env fingerprint of work this process has executed: a
+        # worker contaminated by env A's py_modules/working_dir is never
+        # reused for env B (worker_pool.h runtime-env-keyed PopWorker).
+        self.env_key: Optional[str] = None
 
 
 class PendingLease:
-    def __init__(self, resources, for_actor, pg_key, fut, req_id=None):
+    def __init__(self, resources, for_actor, pg_key, fut, req_id=None,
+                 env_key=None):
         self.resources = resources
         self.for_actor = for_actor
         self.pg_key = pg_key
         self.fut = fut
         self.req_id = req_id
+        self.env_key = env_key
         self.enqueued = time.monotonic()
 
 
@@ -100,6 +106,7 @@ class Raylet:
         self._monitor_task = None
         self._heartbeat_task = None
         self._memory_task = None
+        self._spill_task = None
         self._cluster_view: List[dict] = []
         # Incremental resource-view sync state (see _heartbeat_loop).
         self._view_version = 0
@@ -129,6 +136,7 @@ class Raylet:
         self._monitor_task = asyncio.ensure_future(self._monitor_workers())
         self._heartbeat_task = asyncio.ensure_future(self._heartbeat_loop())
         self._memory_task = asyncio.ensure_future(self._memory_monitor_loop())
+        self._spill_task = asyncio.ensure_future(self._proactive_spill_loop())
         from ray_tpu.runtime.log_monitor import LogMonitor
         self._log_monitor = LogMonitor(
             os.path.join(self.session_dir, "logs"),
@@ -158,7 +166,7 @@ class Raylet:
         except asyncio.TimeoutError:
             return
         if w.address is not None and w.lease_id is None:
-            self._idle.append(w)
+            self._park_idle(w)
 
     async def _on_gcs_reconnect(self, client):
         """GCS restarted (NotifyGCSRestart analog): re-register so the new
@@ -253,7 +261,8 @@ class Raylet:
 
     async def _cleanup(self):
         for task in (self._monitor_task, self._heartbeat_task,
-                     self._memory_task, getattr(self, '_log_task', None)):
+                     self._memory_task, self._spill_task,
+                     getattr(self, '_log_task', None)):
             if task:
                 task.cancel()
         for w in list(self._workers.values()):
@@ -284,6 +293,23 @@ class Raylet:
         return {"ok": True}
 
     # ---- worker pool (worker_pool.h) -------------------------------------
+
+    def _park_idle(self, w: WorkerHandle):
+        """Return a worker to the idle pool, bounded: with env-keyed reuse,
+        distinct runtime_envs would otherwise strand ever more mismatched
+        idle processes (reference: idle-worker killing, worker_pool.cc).
+        Oldest idle worker dies first when over the cap."""
+        from ray_tpu.config import cfg
+
+        self._idle.append(w)
+        cap = max(1, cfg().worker_pool_max_idle)
+        while len(self._idle) > cap:
+            victim = self._idle.pop(0)
+            self._workers.pop(victim.worker_id, None)
+            try:
+                victim.proc.terminate()
+            except Exception:
+                pass
 
     def _spawn_worker(self) -> WorkerHandle:
         metric_defs.WORKERS_STARTED.inc()
@@ -316,6 +342,43 @@ class Raylet:
         w.ready.set()
         conn.meta["worker_id"] = worker_id
         return {"ok": True}
+
+    async def _proactive_spill_loop(self):
+        """Background spilling above a fill watermark: the raylet (not a
+        task worker mid-put) absorbs the disk IO, so workers rarely hit
+        StoreFullError's inline spill-before-evict path. The raylet IS the
+        node's dedicated IO process in this serverless-store design
+        (reference analog: worker_pool.h:381 dedicated spill I/O workers +
+        local_object_manager spill triggers)."""
+        from ray_tpu.config import cfg
+
+        high = cfg().spill_high_watermark
+        low = cfg().spill_low_watermark
+        if high <= 0:
+            return
+        while not self._shutdown.is_set():
+            await asyncio.sleep(0.25)
+            try:
+                store = self.store
+                if store is None or store.capacity == 0:
+                    continue
+                if store.used / store.capacity < high:
+                    continue
+                target = int(store.capacity * low)
+                # Off-loop: file IO must not stall lease dispatch.
+                await asyncio.get_event_loop().run_in_executor(
+                    None, self._spill_down_to, target)
+            except Exception:
+                logger.exception("proactive spill pass failed")
+
+    def _spill_down_to(self, target_bytes: int):
+        need = self.store.used - target_bytes
+        if need <= 0:
+            return
+        freed = self.spill.spill_until(need)
+        if freed:
+            logger.info("proactive spill: %d bytes -> disk (used %.0f%%)",
+                        freed, 100 * self.store.used / self.store.capacity)
 
     async def _monitor_workers(self):
         """Child watcher: detect worker process exits (worker death path)."""
@@ -357,7 +420,8 @@ class Raylet:
                                   for_actor: bool = False,
                                   placement_group_id: Optional[bytes] = None,
                                   bundle_index: int = -1,
-                                  req_id: Optional[bytes] = None):
+                                  req_id: Optional[bytes] = None,
+                                  env_key: Optional[str] = None):
         pg_key = None
         if placement_group_id is not None:
             idx = bundle_index if bundle_index >= 0 else self._any_bundle_index(placement_group_id)
@@ -367,21 +431,24 @@ class Raylet:
         logger.debug("lease_worker: res=%s avail=%s pending=%d", resources,
                      self.available, self._pending_count())
         fut = asyncio.get_event_loop().create_future()
-        req = PendingLease(resources, for_actor, pg_key, fut, req_id)
-        key = self._sched_class(resources, pg_key)
+        req = PendingLease(resources, for_actor, pg_key, fut, req_id,
+                           env_key=env_key)
+        key = self._sched_class(resources, pg_key, env_key)
         self._queues.setdefault(key, collections.deque()).append(req)
         await self._dispatch_pending()
         return await fut
 
     @staticmethod
     def _sched_class(resources: Dict[str, float],
-                     pg_key: Optional[Tuple[bytes, int]]) -> tuple:
-        """Scheduling-class key: resource shape + bundle. All requests in a
-        class draw the same amounts from the same pool, so feasibility is a
-        property of the CLASS, not the request."""
+                     pg_key: Optional[Tuple[bytes, int]],
+                     env_key: Optional[str] = None) -> tuple:
+        """Scheduling-class key: resource shape + bundle + runtime-env
+        fingerprint. All requests in a class draw the same amounts from the
+        same pool AND can share pooled workers, so feasibility and worker
+        reuse are properties of the CLASS, not the request."""
         shape = tuple(sorted((k, float(v)) for k, v in resources.items()
                              if v > scheduling.EPS))
-        return (shape, pg_key)
+        return (shape, pg_key, env_key)
 
     def _pending_count(self) -> int:
         return (sum(len(q) for q in self._queues.values())
@@ -391,11 +458,11 @@ class Raylet:
         """Per-class backlog for heartbeats/stats (autoscaler demand feed;
         GcsAutoscalerStateManager analog)."""
         out = []
-        for (shape, pg_key), q in list(self._queues.items()) + \
+        for key, q in list(self._queues.items()) + \
                 list(self._infeasible.items()):
             if q:
-                out.append({"shape": dict(shape), "count": len(q),
-                            "infeasible": (shape, pg_key) in self._infeasible})
+                out.append({"shape": dict(key[0]), "count": len(q),
+                            "infeasible": key in self._infeasible})
         return out
 
     async def handle_cancel_lease_request(self, conn, req_id: bytes):
@@ -420,7 +487,7 @@ class Raylet:
                 w.req_id = None
                 w.busy_since = None
                 if not w.is_actor:
-                    self._idle.append(w)
+                    self._park_idle(w)
                 await self._dispatch_pending()
                 return {"ok": True, "reclaimed": True}
         return {"ok": False}
@@ -554,10 +621,25 @@ class Raylet:
 
     async def _grant_lease(self, req: PendingLease):
         try:
-            if self._idle and not req.for_actor:
-                w = self._idle.pop()
-            else:
+            w = None
+            if not req.for_actor:
+                # runtime_env-keyed reuse: only a worker that ran the SAME
+                # env (or a fresh prestarted one, env_key None) is eligible
+                # — process state from another env must not leak in. Exact
+                # matches win over fresh workers so the fresh pool stays
+                # available for other envs.
+                for want_fresh in (False, True):
+                    for cand in reversed(self._idle):
+                        if cand.env_key == (None if want_fresh
+                                            else req.env_key):
+                            self._idle.remove(cand)
+                            w = cand
+                            break
+                    if w is not None:
+                        break
+            if w is None:
                 w = self._spawn_worker()
+            w.env_key = req.env_key
             await asyncio.wait_for(w.ready.wait(), timeout=120)
             if w.address is None:
                 raise RuntimeError("worker died during startup")
@@ -593,7 +675,7 @@ class Raylet:
                     except Exception:
                         pass
                 elif not w.is_actor:
-                    self._idle.append(w)
+                    self._park_idle(w)
                 await self._dispatch_pending()
                 return {"ok": True}
         return {"ok": False}

@@ -141,3 +141,66 @@ def test_worker_prestart_speeds_first_task():
         assert stats.get("num_idle", 0) >= 1, stats
     finally:
         ray_tpu.shutdown()
+
+
+def test_worker_pool_keyed_by_runtime_env():
+    """A pooled worker that executed env A is not reused for env B
+    (worker_pool.h runtime-env-keyed PopWorker): process state
+    (py_modules imports, env leakage) must not cross envs."""
+    import os
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def whoami():
+            return os.getpid(), os.environ.get("MARK")
+
+        # Same env -> lease reuse -> same worker process.
+        a1 = ray_tpu.get(whoami.options(
+            runtime_env={"env_vars": {"MARK": "A"}}).remote(), timeout=60)
+        a2 = ray_tpu.get(whoami.options(
+            runtime_env={"env_vars": {"MARK": "A"}}).remote(), timeout=60)
+        assert a1[1] == a2[1] == "A"
+        assert a1[0] == a2[0]  # pooled reuse within one env
+
+        # Different env -> different worker process than env A's.
+        b = ray_tpu.get(whoami.options(
+            runtime_env={"env_vars": {"MARK": "B"}}).remote(), timeout=60)
+        assert b[1] == "B"
+        assert b[0] != a1[0] and b[0] != a2[0], (a1, a2, b)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_proactive_spill_keeps_store_below_watermark():
+    """The raylet spills LRU objects in the background once the store
+    crosses the high watermark, so a worker's put never has to block on
+    inline spill (dedicated-IO-worker analog)."""
+    import time
+
+    import numpy as np
+
+    ray_tpu.init(num_cpus=1, object_store_memory=64 << 20,
+                 _system_config={"spill_high_watermark": 0.5,
+                                 "spill_low_watermark": 0.3})
+    try:
+        refs = [ray_tpu.put(np.full(4 << 20, i, dtype=np.uint8))
+                for i in range(6)]  # 24MB into a 64MB store: crosses 50%... 
+        # push over the watermark
+        refs += [ray_tpu.put(np.full(4 << 20, 100 + i, dtype=np.uint8))
+                 for i in range(4)]
+        from ray_tpu.core.worker import global_worker
+
+        store = global_worker().store
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if store.used / store.capacity <= 0.5:
+                break
+            time.sleep(0.25)
+        assert store.used / store.capacity <= 0.5, (
+            store.used, store.capacity)
+        # Spilled objects remain retrievable (restore path).
+        vals = ray_tpu.get(refs, timeout=60)
+        assert int(vals[0][0]) == 0 and int(vals[-1][0]) == 103
+    finally:
+        ray_tpu.shutdown()
