@@ -8,6 +8,7 @@ from ray_tpu.rl.connectors import (  # noqa: F401
 )
 from ray_tpu.rl.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rl.dreamerv3 import DreamerV3, DreamerV3Config  # noqa: F401
 from ray_tpu.rl.env import VectorCartPole, make_env  # noqa: F401
 from ray_tpu.rl.impala import IMPALA, ImpalaConfig  # noqa: F401
 from ray_tpu.rl.ppo import PPOConfig  # noqa: F401

@@ -305,7 +305,9 @@ class Raylet:
         cap = max(1, cfg().worker_pool_max_idle)
         while len(self._idle) > cap:
             victim = self._idle.pop(0)
-            self._workers.pop(victim.worker_id, None)
+            # Keep the handle in _workers: _monitor_workers polls, reaps,
+            # and reports the death like every other kill path (popping it
+            # here would leak an unreaped zombie if SIGTERM is ignored).
             try:
                 victim.proc.terminate()
             except Exception:

@@ -51,9 +51,17 @@ def test_custom_manager_registration():
 
 def test_wire_protocol_rejects_foreign_bytes():
     """A non-ray_tpu client (wrong magic) is dropped before any pickle
-    runs; a version-skewed peer gets a versioned error."""
+    runs; a version-skewed peer gets a versioned error. Runs with auth OFF
+    (a prior test's cluster may have left a session token in the process);
+    the authed handshake path is covered by test_wire_auth.py."""
+    from ray_tpu.runtime import rpc
     from ray_tpu.runtime.rpc import (
         _MAGIC, _frame, _read_frame, ProtocolMismatch, RpcServer)
+
+    rpc.set_session_token(None)
+
+    def _restore():
+        rpc._token_loaded = False  # later tests reload from env
 
     async def run():
         server = RpcServer("127.0.0.1", 0)
@@ -93,4 +101,7 @@ def test_wire_protocol_rejects_foreign_bytes():
         assert frame[:4] == _MAGIC
         await server.close()
 
-    asyncio.run(run())
+    try:
+        asyncio.run(run())
+    finally:
+        _restore()

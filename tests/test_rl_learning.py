@@ -122,3 +122,26 @@ def test_cql_conservatism_vs_dqn_offline(cpu_jax, tmp_path):
     q_cons = conservative.q_values(obs).max(-1).mean()
     q_plain = plain.q_values(obs).max(-1).mean()
     assert q_cons < q_plain, (q_cons, q_plain)
+
+
+def test_dreamerv3_improves_cartpole(cpu_jax):
+    """DreamerV3's imagination-trained policy lifts CartPole return above
+    the random baseline (~20) within a bounded budget
+    (rllib/algorithms/dreamerv3 tuned-example analog). Smoke + learning:
+    the world model, imagination rollout, and actor-critic all engage."""
+    from ray_tpu.rl.dreamerv3 import DreamerV3, DreamerV3Config
+
+    algo = DreamerV3(DreamerV3Config(
+        envs=8, rollout_length=64, batch_size=8, seq_len=16, horizon=8,
+        learning_starts=512, updates_per_iteration=8), seed=0)
+    history = []
+    for _ in range(30):
+        r = algo.train()
+        if r["episode_return_mean"]:
+            history.append(r["episode_return_mean"])
+    assert r["episodes_total"] > 10
+    assert np.isfinite(r["wm_loss"])
+    final = _mean_tail(history)
+    assert final > 60.0, (
+        f"no learning: final={final:.1f} "
+        f"history={[round(h, 1) for h in history]}")
