@@ -131,3 +131,26 @@ def test_joblib_backend(cluster):
         out = joblib.Parallel(n_jobs=2)(
             joblib.delayed(_square)(i) for i in range(6))
     assert out == [i * i for i in range(6)]
+
+
+def test_sklearn_gridsearch_on_ray_tpu_backend(cluster):
+    """Real consumer integration: sklearn GridSearchCV parallelizes its
+    CV fits through the ray_tpu joblib backend (reference: ray.util.joblib
+    register_ray + sklearn docs pattern)."""
+    joblib = pytest.importorskip("joblib")
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn.datasets import make_classification
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import GridSearchCV
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    X, y = make_classification(n_samples=200, n_features=8, random_state=0)
+    search = GridSearchCV(
+        LogisticRegression(max_iter=200),
+        {"C": [0.1, 1.0, 10.0]}, cv=3, n_jobs=4)
+    with joblib.parallel_backend("ray_tpu"):
+        search.fit(X, y)
+    assert search.best_score_ > 0.7
+    assert search.best_params_["C"] in (0.1, 1.0, 10.0)
