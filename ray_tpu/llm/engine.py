@@ -58,6 +58,8 @@ class _Request:
                          else zlib.crc32(request_id.encode()) & 0x7FFFFFFF)
         self.finished_reason: Optional[str] = None
         self.lora_pinned = lora_slot != 0   # released once on finish
+        self.prefix_hashes: Optional[List[int]] = None  # lazy, per prompt
+        self.registered_blocks = 0  # prompt blocks made cache-addressable
 
     @property
     def num_tokens(self) -> int:
@@ -71,38 +73,142 @@ class _Request:
 
 
 class BlockManager:
-    def __init__(self, num_blocks: int, block_size: int):
+    """Paged-KV allocator with automatic prefix caching.
+
+    vLLM analog (reference: vllm's automatic prefix caching, placed by
+    ray.llm at deployments/llm/vllm/): every FULL prompt block registers
+    under a rolling content hash h_i = hash((h_{i-1}, block_tokens));
+    a new request reuses the longest cached chain (refcounted, copy-free —
+    cached blocks are immutable full blocks, and writes only ever target a
+    sequence's own fresh tail blocks), skipping that prefix's prefill
+    compute entirely. Freed cached blocks park in an LRU reuse pool and
+    are recycled only under allocation pressure, so a hot system prompt
+    stays resident."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 enable_prefix_caching: bool = True):
+        from collections import OrderedDict
+
         self.block_size = block_size
         self.free: deque = deque(range(num_blocks))
+        self.caching = enable_prefix_caching
+        self.refcount: Dict[int, int] = {}       # live blocks
+        self.cached: Dict[int, int] = {}         # hash -> block_id
+        self.block_hash: Dict[int, int] = {}     # block_id -> hash
+        self.reusable: "OrderedDict[int, None]" = OrderedDict()  # LRU
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
 
     def blocks_needed(self, num_tokens: int) -> int:
         return (num_tokens + self.block_size - 1) // self.block_size
 
+    def _available(self) -> int:
+        return len(self.free) + len(self.reusable)
+
     def can_allocate(self, num_tokens: int) -> bool:
-        return len(self.free) >= self.blocks_needed(num_tokens)
+        return self._available() >= self.blocks_needed(num_tokens)
+
+    def _take_free_block(self) -> int:
+        if self.free:
+            return self.free.popleft()
+        # Evict the least-recently-used parked cached block.
+        bid, _ = self.reusable.popitem(last=False)
+        h = self.block_hash.pop(bid)
+        self.cached.pop(h, None)
+        return bid
 
     def allocate(self, req: _Request, num_tokens: int) -> bool:
         need = self.blocks_needed(num_tokens) - len(req.blocks)
-        if need > len(self.free):
+        if need > self._available():
             return False
         for _ in range(max(0, need)):
-            req.blocks.append(self.free.popleft())
+            bid = self._take_free_block()
+            self.refcount[bid] = self.refcount.get(bid, 0) + 1
+            req.blocks.append(bid)
         return True
 
     def release(self, req: _Request):
-        self.free.extend(req.blocks)
+        self.release_blocks(req.blocks)
         req.blocks = []
+
+    def release_blocks(self, blocks: List[int]):
+        """THE release path for detached block lists too (deferred release,
+        error recovery): anything pushing block ids straight onto .free
+        would bypass refcounts and corrupt/leak shared cached blocks."""
+        for bid in blocks:
+            n = self.refcount.get(bid, 1) - 1
+            if n > 0:
+                self.refcount[bid] = n
+                continue
+            self.refcount.pop(bid, None)
+            if bid in self.block_hash:
+                # Still addressable by content: park for reuse.
+                self.reusable[bid] = None
+                self.reusable.move_to_end(bid)
+            else:
+                self.free.append(bid)
+
+    # ---- prefix caching --------------------------------------------------
+    def prefix_hashes(self, prompt: Sequence[int],
+                      lora_slot: int = 0) -> List[int]:
+        """Rolling hash per FULL prompt block (position-and-content chain,
+        so identical blocks at different depths never collide). The chain
+        is seeded with the LoRA slot: adapters change wk/wv (llm/lora.py
+        TARGETS), so KV content differs per adapter and cross-adapter
+        sharing would be silently wrong."""
+        out: List[int] = []
+        h = hash(("prefix-chain", lora_slot))
+        bs = self.block_size
+        for i in range(len(prompt) // bs):
+            h = hash((h, tuple(prompt[i * bs:(i + 1) * bs])))
+            out.append(h)
+        return out
+
+    def match_prefix(self, req: _Request, hashes: List[int]) -> int:
+        """Attach the longest cached chain to req; returns tokens skipped.
+        The prompt's final token is ALWAYS recomputed (its logits seed the
+        first sampled token), capping reuse at (len(prompt)-1)//bs blocks."""
+        if not self.caching:
+            return 0
+        limit = min(len(hashes), (len(req.prompt) - 1) // self.block_size)
+        skipped = 0
+        for i in range(limit):
+            bid = self.cached.get(hashes[i])
+            if bid is None:
+                break
+            if self.refcount.get(bid, 0) == 0:
+                self.reusable.pop(bid, None)
+            self.refcount[bid] = self.refcount.get(bid, 0) + 1
+            req.blocks.append(bid)
+            skipped += self.block_size
+        if skipped:
+            self.prefix_hits += 1
+            self.prefix_tokens_saved += skipped
+        return skipped
+
+    def register_block(self, req: _Request, index: int, h: int):
+        """A full prompt block finished prefilling: make it addressable.
+        First writer wins; a duplicate stays private to its sequence."""
+        if not self.caching:
+            return
+        bid = req.blocks[index]
+        if bid in self.block_hash or h in self.cached:
+            return
+        self.cached[h] = bid
+        self.block_hash[bid] = h
 
 
 class LLMEngine:
     def __init__(self, model_runner, *, max_batch_size: int = 8,
                  max_blocks_per_seq: Optional[int] = None,
                  tokenizer=None, prefill_chunk: Optional[int] = None,
-                 pipeline_depth: Optional[int] = None):
+                 pipeline_depth: Optional[int] = None,
+                 enable_prefix_caching: bool = True):
         self.runner = model_runner
         self.block_size = model_runner.block_size
-        self.block_manager = BlockManager(model_runner.num_blocks,
-                                          model_runner.block_size)
+        self.block_manager = BlockManager(
+            model_runner.num_blocks, model_runner.block_size,
+            enable_prefix_caching=enable_prefix_caching)
         self.max_batch = max_batch_size
         self.max_blocks_per_seq = max_blocks_per_seq or min(
             model_runner.max_blocks_per_seq,
@@ -240,8 +346,20 @@ class LLMEngine:
             if not self.block_manager.can_allocate(len(req.context) + 1):
                 break
             self.waiting.popleft()
+            # Prefix cache: attach the longest cached chain of full prompt
+            # blocks and skip their prefill compute entirely (recompute
+            # admits after preemption re-match too — their KV may still be
+            # resident).
+            cached_tokens = 0
+            if self.block_manager.caching:
+                if req.prefix_hashes is None:
+                    req.prefix_hashes = self.block_manager.prefix_hashes(
+                        req.prompt, req.lora_slot)
+                cached_tokens = self.block_manager.match_prefix(
+                    req, req.prefix_hashes)
+                req.registered_blocks = len(req.blocks)
             assert self.block_manager.allocate(req, len(req.context) + 1)
-            req.prefilled = 0
+            req.prefilled = cached_tokens
             self.prefilling.append(req)
 
     def _needs_logits(self, reqs) -> bool:
@@ -300,6 +418,15 @@ class LLMEngine:
             logits = None
         for i, (req, c) in enumerate(zip(batch, chunks)):
             req.prefilled += c
+            # Newly completed FULL prompt blocks become cache-addressable
+            # (their KV is now written and immutable).
+            if self.block_manager.caching:
+                full = min(req.prefilled, len(req.prompt)) // self.block_size
+                while req.registered_blocks < full:
+                    j = req.registered_blocks
+                    self.block_manager.register_block(
+                        req, j, req.prefix_hashes[j])
+                    req.registered_blocks += 1
             if req.prefilled < len(req.context):
                 continue  # mid-prompt: this chunk's sample is unused
             self.prefilling.remove(req)
@@ -461,7 +588,7 @@ class LLMEngine:
         keep = []
         for req, blocks in self._pending_release:
             if req.dispatched == 0:
-                self.block_manager.free.extend(blocks)
+                self.block_manager.release_blocks(blocks)
             else:
                 keep.append((req, blocks))
         self._pending_release = keep

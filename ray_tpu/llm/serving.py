@@ -121,7 +121,7 @@ class LLMServer:
                             pass
                     self.engine._flights.clear()
                     for req, blocks in self.engine._pending_release:
-                        self.engine.block_manager.free.extend(blocks)
+                        self.engine.block_manager.release_blocks(blocks)
                     self.engine._pending_release.clear()
                     for req in (list(self.engine.running)
                                 + list(self.engine.prefilling)

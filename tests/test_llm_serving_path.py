@@ -80,8 +80,11 @@ def test_preemption_preserves_output(setup):
     outs = engine.generate(prompts, SamplingParams(max_tokens=8))
     for prompt, out in zip(prompts, outs):
         assert out.output_token_ids == naive_greedy(params, config, prompt, 8)
-    # All pages returned.
-    assert len(engine.block_manager.free) == 10
+    # All pages returned (cached prompt blocks park in the reusable pool;
+    # nothing stays referenced).
+    mgr = engine.block_manager
+    assert len(mgr.free) + len(mgr.reusable) == 10
+    assert not mgr.refcount
 
 
 def test_engine_stream_yields_progressively(setup):
