@@ -330,6 +330,21 @@ def serve_bench_result(backend: str) -> dict:
         ttfts.append(first_at)
         decode_times.append(total - first_at)
         decoded += gen_tokens - 1
+    # Prefix-cache TTFT: a request whose prompt shares a long cached
+    # prefix (the agent/system-prompt serving pattern) skips that
+    # prefill compute entirely.
+    shared = rng.randint(1, config.vocab_size, prompt_len).tolist()
+    t0 = time.perf_counter()
+    for i, _tok in enumerate(engine.stream(
+            shared, SamplingParams(max_tokens=4))):
+        if i == 0:
+            cold_ttft = time.perf_counter() - t0
+    tail = rng.randint(1, config.vocab_size, 8).tolist()
+    t0 = time.perf_counter()
+    for i, _tok in enumerate(engine.stream(
+            shared[:-8] + tail, SamplingParams(max_tokens=4))):
+        if i == 0:
+            warm_ttft = time.perf_counter() - t0
     ttfts.sort()
     p50 = ttfts[len(ttfts) // 2]
     p95 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.95))]
@@ -337,6 +352,12 @@ def serve_bench_result(backend: str) -> dict:
     return {
         "ttft_p50_ms": round(p50 * 1000, 2),
         "ttft_p95_ms": round(p95 * 1000, 2),
+        "prefix_cache": {
+            "cold_ttft_ms": round(cold_ttft * 1000, 2),
+            "cached_prefix_ttft_ms": round(warm_ttft * 1000, 2),
+            "tokens_saved": int(
+                engine.block_manager.prefix_tokens_saved),
+        },
         "vs_target": round(0.150 / max(p50, 1e-9), 3),  # >1 beats 150ms
         "decode_tokens_per_sec": round(decode_tok_s, 1),
         "prompt_len": prompt_len,

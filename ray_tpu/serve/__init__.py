@@ -10,6 +10,7 @@ from ray_tpu.serve.api import (  # noqa: F401
 from ray_tpu.serve.batching import serve_batch as batch  # noqa: F401
 from ray_tpu.serve.deployment import Deployment, deployment  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401
+from ray_tpu.serve import http_adapters  # noqa: F401
 from ray_tpu.serve.multiplex import (  # noqa: F401
     Multiplexer,
     get_multiplexed_model_id,

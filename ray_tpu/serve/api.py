@@ -79,6 +79,7 @@ def run(target: Union[Deployment, List[Deployment]], *,
             "num_tpus": dep.config.num_tpus,
             "resources": dep.config.resources,
             "autoscaling": dep.config.autoscaling_config,
+            "http_adapter": dep.config.http_adapter,
         }
         ray_tpu.get(controller.deploy.remote(
             dep.name, cloudpickle.dumps(dep.func_or_class), cfg,

@@ -23,6 +23,10 @@ class DeploymentConfig:
     # target tracking. Keys: min_replicas, max_replicas,
     # target_ongoing_requests, interval_s, downscale_delay_s.
     autoscaling_config: Optional[Dict] = None
+    # Registry name of an ingress adapter (serve/http_adapters.py): the
+    # HTTP proxy converts the request before dispatch; handle callers and
+    # gRPC are unaffected (reference: serve http_adapters).
+    http_adapter: Optional[str] = None
 
 
 class Deployment:
@@ -40,7 +44,8 @@ class Deployment:
                 num_cpus: Optional[float] = None,
                 num_tpus: Optional[float] = None,
                 resources: Optional[Dict[str, float]] = None,
-                autoscaling_config: Optional[Dict] = None) -> "Deployment":
+                autoscaling_config: Optional[Dict] = None,
+                http_adapter: Optional[str] = None) -> "Deployment":
         cfg = dataclasses.replace(
             self.config,
             num_replicas=num_replicas if num_replicas is not None
@@ -51,7 +56,9 @@ class Deployment:
             num_tpus=num_tpus if num_tpus is not None else self.config.num_tpus,
             resources=resources if resources is not None else self.config.resources,
             autoscaling_config=autoscaling_config if autoscaling_config
-            is not None else self.config.autoscaling_config)
+            is not None else self.config.autoscaling_config,
+            http_adapter=http_adapter if http_adapter is not None
+            else self.config.http_adapter)
         return Deployment(self.func_or_class, name or self.name, cfg,
                           self.init_args, self.init_kwargs)
 
@@ -64,12 +71,14 @@ def deployment(func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 16,
                num_cpus: float = 0.0, num_tpus: float = 0.0,
                resources: Optional[Dict[str, float]] = None,
-               autoscaling_config: Optional[Dict] = None):
+               autoscaling_config: Optional[Dict] = None,
+               http_adapter: Optional[str] = None):
     def wrap(target):
         return Deployment(
             target, name or target.__name__,
             DeploymentConfig(num_replicas, max_ongoing_requests, num_cpus,
-                             num_tpus, resources, autoscaling_config))
+                             num_tpus, resources, autoscaling_config,
+                             http_adapter))
 
     if func_or_class is not None:
         return wrap(func_or_class)

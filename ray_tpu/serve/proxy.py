@@ -15,7 +15,9 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
-from typing import Optional
+from typing import Dict, Optional
+
+import ray_tpu
 
 
 class HTTPProxyActor:
@@ -77,16 +79,78 @@ class HTTPProxyActor:
             await resp.write_eof()
             return resp
 
+        adapter_cache: Dict[str, tuple] = {}  # name -> (expires, fn|None)
+
+        def _adapter_for(name: str):
+            """Deployment's declared http_adapter, 5s-cached (config is
+            near-static; a redeploy republishes within one TTL). An unknown
+            adapter NAME raises (misconfiguration must surface, not
+            silently fall back to raw JSON); a transient controller RPC
+            failure reuses the stale cache entry when one exists."""
+            import time as time_mod
+
+            from ray_tpu.serve import http_adapters
+            from ray_tpu.serve.api import _get_controller
+
+            now = time_mod.monotonic()
+            hit = adapter_cache.get(name)
+            if hit is not None and hit[0] > now:
+                return hit[1]
+            try:
+                adapter_name = None
+                for d in ray_tpu.get(
+                        _get_controller().list_deployments.remote(),
+                        timeout=30):
+                    if d["name"] == name:
+                        adapter_name = d["config"].get("http_adapter")
+                        break
+            except Exception:
+                if hit is not None:
+                    return hit[1]  # stale beats changing request semantics
+                raise
+            fn = http_adapters.get(adapter_name) if adapter_name else None
+            adapter_cache[name] = (now + 5.0, fn)
+            return fn
+
         async def dispatch(request: "web.Request"):
             name = request.match_info["deployment"]
             method = request.query.get("method", "__call__")
             key = (name, method)
             if key not in handles:
                 handles[key] = DeploymentHandle(name, method)
-            try:
-                payload = await request.json()
-            except Exception:
-                payload = (await request.read()).decode() or None
+            # Cache hit resolves inline (no executor hop on the hot path);
+            # only a miss/expiry pays the controller round-trip.
+            import time as time_mod
+
+            hit = adapter_cache.get(name)
+            if hit is not None and hit[0] > time_mod.monotonic():
+                adapter = hit[1]
+            else:
+                try:
+                    adapter = await asyncio.get_event_loop().run_in_executor(
+                        None, _adapter_for, name)
+                except ValueError as e:  # unknown adapter name: config bug
+                    return web.json_response({"error": str(e)}, status=500)
+                except Exception as e:
+                    return web.json_response(
+                        {"error": f"adapter lookup failed: {e!r}"},
+                        status=503)
+            if adapter is not None:
+                from ray_tpu.serve.http_adapters import HTTPRequest
+
+                body = await request.read()
+                try:
+                    payload = adapter(HTTPRequest(
+                        body, request.content_type or "",
+                        dict(request.query)))
+                except Exception as e:
+                    return web.json_response(
+                        {"error": f"http_adapter failed: {e!r}"}, status=400)
+            else:
+                try:
+                    payload = await request.json()
+                except Exception:
+                    payload = (await request.read()).decode() or None
             handle = handles[key]
             if request.query.get("stream") in ("1", "true"):
                 return await stream_dispatch(request, handle, payload)

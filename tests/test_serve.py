@@ -183,3 +183,78 @@ def test_grpc_proxy_streaming(cluster):
         assert items == ["tok0", "tok1", "tok2", "tok3"]
     finally:
         client.close()
+
+
+def test_http_adapter_json_to_ndarray(cluster):
+    """A deployment declaring http_adapter receives the CONVERTED value
+    from the HTTP ingress; handle callers bypass adapters (reference:
+    serve/http_adapters.py json_to_ndarray)."""
+    import urllib.request
+
+    import numpy as np
+
+    from ray_tpu import serve
+
+    @serve.deployment(http_adapter="json_to_ndarray")
+    def sum_model(arr):
+        assert isinstance(arr, np.ndarray), type(arr)
+        return {"sum": float(arr.sum()), "shape": list(arr.shape)}
+
+    handle = serve.run(sum_model.bind())
+    host, port = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/sum_model",
+        data=json.dumps({"array": [[1, 2], [3, 4]]}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        out = json.loads(r.read())["result"]
+    assert out == {"sum": 10.0, "shape": [2, 2]}
+
+    # Handle callers are NOT adapted: they pass values directly.
+    direct = handle.remote(np.ones((2, 3))).result(timeout=60)
+    assert direct["sum"] == 6.0
+
+    # Adapter failures surface as 400, not 500.
+    bad = urllib.request.Request(
+        f"http://{host}:{port}/sum_model", data=b"not json{",
+        method="POST")
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(bad, timeout=60)
+    assert ei.value.code == 400
+
+
+def test_http_adapter_misconfig_surfaces(cluster):
+    """A typo'd adapter name returns 500 (config bug surfaced), and a
+    wrong-keyed json_to_ndarray payload returns 400 with the expected
+    shape named."""
+    import urllib.error
+    import urllib.request
+
+    from ray_tpu import serve
+
+    @serve.deployment(name="typo_dep", http_adapter="json_to_ndarry")
+    def typo_dep(x):
+        return x
+
+    serve.run(typo_dep.bind())
+    host, port = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/typo_dep", data=b"[1,2]", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=60)
+    assert ei.value.code == 500
+    assert "json_to_ndarry" in json.loads(ei.value.read())["error"]
+
+    @serve.deployment(name="nd_dep", http_adapter="json_to_ndarray")
+    def nd_dep(arr):
+        return {"n": int(arr.size)}
+
+    serve.run(nd_dep.bind())
+    bad = urllib.request.Request(
+        f"http://{host}:{port}/nd_dep",
+        data=json.dumps({"data": [1, 2]}).encode(), method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(bad, timeout=60)
+    assert ei.value.code == 400
+    assert "array" in json.loads(ei.value.read())["error"]
