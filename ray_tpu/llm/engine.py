@@ -203,7 +203,8 @@ class LLMEngine:
                  max_blocks_per_seq: Optional[int] = None,
                  tokenizer=None, prefill_chunk: Optional[int] = None,
                  pipeline_depth: Optional[int] = None,
-                 enable_prefix_caching: bool = True):
+                 enable_prefix_caching: bool = True,
+                 speculative_ngram: int = 0):
         self.runner = model_runner
         self.block_size = model_runner.block_size
         self.block_manager = BlockManager(
@@ -240,6 +241,12 @@ class LLMEngine:
         # Detached from req.blocks so a re-admitted (preempted) request's
         # fresh allocation is never confused with the stale pages.
         self._pending_release: List[tuple] = []
+        # n-gram (prompt-lookup) speculative decoding: propose up to K
+        # tokens per step from the sequence's own history, verify in one
+        # multi-position step. 0 = off; engages only for all-greedy
+        # batches (exact acceptance needs argmax determinism).
+        self.spec_ngram = int(speculative_ngram)
+        self.spec_tokens_accepted = 0
 
     # ---- API -------------------------------------------------------------
 
@@ -458,6 +465,15 @@ class LLMEngine:
         ticks."""
         if self._needs_logits(self.running):
             return self._decode_sync()
+        if (self.spec_ngram > 0
+                and all(r.params.temperature <= 0.0 for r in self.running)):
+            if self._flights:
+                # Drain the async pipeline one step per tick (a sampled
+                # request may have primed it); spec engages once empty.
+                outputs = self._process_inflight(self._flights.popleft())
+                self._drain_release()
+                return outputs
+            return self._decode_spec()
         prev = self._flights[-1] if self._flights else None
         flight = self._dispatch_decode(prev) if self.running else None
         if flight is not None:
@@ -592,6 +608,112 @@ class LLMEngine:
             else:
                 keep.append((req, blocks))
         self._pending_release = keep
+
+    # ---- n-gram speculative decode --------------------------------------
+
+    @staticmethod
+    def _ngram_propose(context: List[int], k: int, n: int = 3) -> List[int]:
+        """Prompt-lookup proposal (vLLM's ngram speculative method): find
+        the most recent earlier occurrence of the trailing (n-1)-gram and
+        propose the k tokens that followed it."""
+        if len(context) < n:
+            return []
+        key = tuple(context[-(n - 1):])
+        for i in range(len(context) - n, -1, -1):
+            if tuple(context[i:i + n - 1]) == key:
+                return list(context[i + n - 1:i + n - 1 + k])
+        return []
+
+    def _decode_spec(self) -> List[RequestOutput]:
+        """Greedy speculative decode via prompt lookup: each sequence's
+        step carries [last_token, proposal...]; the verify head returns the
+        model's greedy token at every position, and the longest agreeing
+        prefix (plus the model's own next token) is accepted. Repetitive
+        outputs advance several tokens per step; a miss costs nothing
+        beyond the (tiny) multi-position vocab matmul. KV written for
+        rejected positions is overwritten by the next step's scatter (the
+        kv_len accounting only ever covers accepted tokens).
+
+        Determinism note: acceptance compares the verify head's argmax
+        against the plain head's; exact in fp32, while bf16 argmax TIES
+        may resolve differently across the two matmul shapes (same caveat
+        as any speculative scheme under finite precision)."""
+        outputs: List[RequestOutput] = []
+        self._drain_release()
+        batch = self.running[:self.max_batch]
+        if not batch:
+            return outputs
+        k = self.spec_ngram
+        # Proposals FIRST: pages are reserved for what will actually be
+        # written (num_tokens + len(prop) + 1), not the worst-case k — a
+        # missed proposal must not cause allocation pressure/preemption a
+        # plain decode wouldn't.
+        proposals = []
+        for r in batch:
+            room = self._cap_tokens - (r.num_tokens + 1)
+            budget = min(k, max(0, room),
+                         r.params.max_tokens - len(r.output) - 1)
+            proposals.append(
+                self._ngram_propose(r.context, budget) if budget > 0 else [])
+        for req, prop in zip(list(batch), list(proposals)):
+            if not self.block_manager.allocate(
+                    req, min(req.num_tokens + len(prop) + 1,
+                             self._cap_tokens)):
+                # Page pressure: plain 1-token verify this tick.
+                proposals = [[] for _ in batch]
+                self._ensure_pages()  # may preempt; re-filter the batch
+                keep = [(r, p) for r, p in zip(batch, proposals)
+                        if r in self.running]
+                if not keep:
+                    return outputs
+                batch = [r for r, _ in keep]
+                proposals = [p for _, p in keep]
+                break
+        width = 1 + max((len(p) for p in proposals), default=1)
+        Bq = self.runner.chunk_bucket(width)
+        S = self.runner.batch_bucket(len(batch))
+        tokens = np.zeros((S, Bq), dtype=np.int32)
+        q_positions = np.zeros(S, dtype=np.int32)
+        kv_lens = np.zeros(S, dtype=np.int32)
+        q_lens = np.zeros(S, dtype=np.int32)
+        tables = np.zeros((S, self.max_blocks_per_seq), dtype=np.int32)
+        for i, (req, prop) in enumerate(zip(batch, proposals)):
+            row = [req.output[-1] if req.output else req.prompt[-1]] + prop
+            tokens[i, :len(row)] = row
+            q_positions[i] = req.num_tokens - 1
+            kv_lens[i] = req.num_tokens + len(prop)
+            q_lens[i] = len(row)
+            tables[i, :len(req.blocks)] = req.blocks
+        got = np.asarray(self.runner.step_verify(
+            tokens, q_positions, kv_lens, q_lens, tables,
+            lora_idx=self._lora_idx(batch, S)))
+        finished: List[_Request] = []
+        for i, (req, prop) in enumerate(zip(batch, proposals)):
+            accepted: List[int] = []
+            for j, proposed_tok in enumerate(prop):
+                if int(got[i, j]) != proposed_tok:
+                    break
+                accepted.append(proposed_tok)
+            # The model's own next token after the agreed prefix.
+            accepted.append(int(got[i, len(accepted)]))
+            # Never exceed max_tokens mid-bonus.
+            room = req.params.max_tokens - len(req.output)
+            accepted = accepted[:max(1, room)]
+            # Honor stop tokens inside the accepted run.
+            stops = req.params.stop_token_ids or ()
+            for j, t in enumerate(accepted):
+                if t in stops:
+                    accepted = accepted[:j + 1]
+                    break
+            req.output.extend(accepted)
+            self.spec_tokens_accepted += len(accepted) - 1
+            outputs.append(self._emit(req, accepted))
+            if req.finished_reason:
+                finished.append(req)
+        for req in finished:
+            self.running.remove(req)
+            self.block_manager.release(req)
+        return outputs
 
     def _decode_sync(self) -> List[RequestOutput]:
         """Legacy synchronous decode (host sampling with full logits) —

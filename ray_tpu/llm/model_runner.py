@@ -86,6 +86,7 @@ class ModelRunner:
             config.head_dim, config.max_seq, config.rope_theta)
         self._step_jit = jax.jit(self._step, donate_argnums=(1,))
         self._step_sample_jit = jax.jit(self._step_sample, donate_argnums=(1,))
+        self._step_verify_jit = jax.jit(self._step_verify, donate_argnums=(1,))
 
     # ---- placement (TP over the mesh, SERVE_RULES) -----------------------
 
@@ -125,14 +126,15 @@ class ModelRunner:
 
     # ---- the unified step ------------------------------------------------
 
-    def _step(self, params, cache, tokens, q_positions, kv_lens, q_lens,
-              block_tables, lora=None, lora_idx=None):
+    def _backbone(self, params, cache, tokens, q_positions, kv_lens, q_lens,
+                  block_tables, lora=None, lora_idx=None):
         """tokens: (S, Bq) new tokens (padded); q_positions: (S,) absolute
         position of tokens[s, 0]; kv_lens: (S,) context length AFTER this
         step's tokens; q_lens: (S,) real token count per row (0 for padding
         sequences); lora/lora_idx: slot stacks + per-sequence adapter slot
-        (llm/lora.py) when multi-LoRA is active. Returns (last-position
-        logits (S, vocab), cache)."""
+        (llm/lora.py) when multi-LoRA is active. Returns (final hidden
+        states (S, Bq, d), cache); the heads below pay the vocab matmul
+        only where they need it."""
         config = self.config
         S, Bq = tokens.shape
         H, K, hd = config.n_heads, config.n_kv_heads, config.head_dim
@@ -187,12 +189,31 @@ class ModelRunner:
             layer_step, (x, cache["k"], cache["v"]),
             (params["layers"], layer_indices, lora if use_lora else {}))
         x = rms_norm(x, params["final_norm"], config.norm_eps)
-        # Only the last REAL position per sequence pays the vocab matmul.
+        return x, {"k": ck, "v": cv}
+
+    def _step(self, params, cache, tokens, q_positions, kv_lens, q_lens,
+              block_tables, lora=None, lora_idx=None):
+        """Standard head: only the last REAL position per sequence pays the
+        vocab matmul. Returns (logits (S, vocab), cache)."""
+        x, cache = self._backbone(params, cache, tokens, q_positions,
+                                  kv_lens, q_lens, block_tables, lora,
+                                  lora_idx)
         last = jnp.take_along_axis(
             x, jnp.maximum(q_lens - 1, 0)[:, None, None], axis=1)[:, 0]
-        logits = (last @ params["lm_head"].astype(config.dtype)).astype(
+        logits = (last @ params["lm_head"].astype(self.config.dtype)).astype(
             jnp.float32)
-        return logits, {"k": ck, "v": cv}
+        return logits, cache
+
+    def _step_verify(self, params, cache, tokens, q_positions, kv_lens,
+                     q_lens, block_tables, lora=None, lora_idx=None):
+        """Speculative-verify head: greedy argmax at EVERY position of the
+        chunk (the (S*Bq, vocab) matmul is tiny at verify widths; logits
+        never leave the device). Returns (token ids (S, Bq) int32, cache)."""
+        x, cache = self._backbone(params, cache, tokens, q_positions,
+                                  kv_lens, q_lens, block_tables, lora,
+                                  lora_idx)
+        logits = x @ params["lm_head"].astype(self.config.dtype)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
     def _lora_args(self, lora_idx, batch: int):
         if self.lora is None:
@@ -210,6 +231,17 @@ class ModelRunner:
             self.params, self.cache, tokens, q_positions, kv_lens, q_lens,
             block_tables, lora, idx)
         return logits
+
+    def step_verify(self, tokens, q_positions, kv_lens, q_lens, block_tables,
+                    lora_idx=None):
+        """One bucketed verify step: returns greedy token ids (S, Bq) —
+        position j's id is the model's next token after consuming
+        tokens[:, :j+1] (the speculative-decoding acceptance input)."""
+        lora, idx = self._lora_args(lora_idx, len(tokens))
+        toks, self.cache = self._step_verify_jit(
+            self.params, self.cache, tokens, q_positions, kv_lens, q_lens,
+            block_tables, lora, idx)
+        return toks
 
     # ---- on-device sampling ---------------------------------------------
 

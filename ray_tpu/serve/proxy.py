@@ -106,7 +106,11 @@ class HTTPProxyActor:
                         break
             except Exception:
                 if hit is not None:
-                    return hit[1]  # stale beats changing request semantics
+                    # Stale beats changing request semantics; re-arm a short
+                    # TTL so an outage costs one probe per second, not one
+                    # blocking 30s lookup per request.
+                    adapter_cache[name] = (now + 1.0, hit[1])
+                    return hit[1]
                 raise
             fn = http_adapters.get(adapter_name) if adapter_name else None
             adapter_cache[name] = (now + 5.0, fn)
