@@ -66,6 +66,13 @@ d = jax.devices()[0]
 print('KIND=' + d.device_kind)
 print('NDEV=%d' % jax.device_count())
 print('INIT_SECS=%.1f' % (time.monotonic() - t0), file=sys.stderr)
+# Device listing can succeed while EXECUTION is wedged (axon relay failure
+# mode seen r3): a real compile+run must finish or the probe is a failure.
+import jax.numpy as jnp
+x = jnp.ones((128, 128)) @ jnp.ones((128, 128))
+assert float(x[0, 0]) == 128.0
+print('EXEC_OK=1')
+print('EXEC_SECS=%.1f' % (time.monotonic() - t0), file=sys.stderr)
 """
 
 
@@ -134,11 +141,16 @@ def init_backend(probes: int = 6, probe_timeout_s: float = 300.0,
                          secs=round(time.monotonic() - t0, 1),
                          stdout=r.stdout[-500:], stderr=r.stderr[-3000:])
             platform = None
+            exec_ok = False
             for line in r.stdout.splitlines():
                 if line.startswith("PLATFORM="):
                     platform = line.split("=", 1)[1]
+                elif line.startswith("EXEC_OK="):
+                    exec_ok = True
+            entry["exec_ok"] = exec_ok
             PROBE_LOG.append(entry)
-            if platform in TPU_PLATFORMS and r.returncode == 0:
+            if (platform in TPU_PLATFORMS and r.returncode == 0
+                    and exec_ok):
                 if variant == "unset":
                     os.environ.pop("JAX_PLATFORMS", None)
                 return platform
