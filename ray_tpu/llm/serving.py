@@ -41,6 +41,11 @@ class LLMConfig:
     lora_adapters: Any = None           # list[LoRAAdapter] | None
     max_loras: int = 8
     lora_rank: int = 8
+    # Engine features (llm/engine.py): automatic prefix caching (shared
+    # system prompts skip prefill) and n-gram speculative decoding
+    # (greedy-only; tokens proposed from the sequence's own history).
+    enable_prefix_caching: bool = True
+    speculative_ngram: int = 0
 
 
 class LLMServer:
@@ -80,10 +85,12 @@ class LLMServer:
                              block_size=llm_config.block_size,
                              chunk_size=llm_config.prefill_chunk,
                              mesh=mesh, lora_manager=lora_manager)
-        self.engine = LLMEngine(runner,
-                                max_batch_size=llm_config.max_batch_size,
-                                tokenizer=llm_config.tokenizer,
-                                prefill_chunk=llm_config.prefill_chunk)
+        self.engine = LLMEngine(
+            runner, max_batch_size=llm_config.max_batch_size,
+            tokenizer=llm_config.tokenizer,
+            prefill_chunk=llm_config.prefill_chunk,
+            enable_prefix_caching=llm_config.enable_prefix_caching,
+            speculative_ngram=llm_config.speculative_ngram)
         self.tokenizer = llm_config.tokenizer
         self._lock = threading.Lock()
         # request_id -> per-request event queue; the engine loop fans
