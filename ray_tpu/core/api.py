@@ -95,7 +95,12 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
         # Connect-only mode: pick the head (or first) node's raylet as local.
         import asyncio
 
+        from ray_tpu.runtime import rpc as rpc_mod
         from ray_tpu.runtime.rpc import RpcClient
+
+        # Resolve the auth token by the address being attached to (NOT
+        # session_latest, which mis-resolves with two clusters on one host).
+        rpc_mod.load_token_for_address(host, int(port))
 
         async def _discover():
             client = RpcClient(*gcs_address)

@@ -136,6 +136,8 @@ def write_datum(out, schema, value) -> None:
 
 
 def _union_index(branches, value) -> int:
+    import numpy as np
+
     def name(b):
         return b["type"] if isinstance(b, dict) else b
 
@@ -145,11 +147,13 @@ def _union_index(branches, value) -> int:
         n = name(b)
         if n == "null":
             continue
-        if n == "boolean" and isinstance(value, bool):
+        if n == "boolean" and isinstance(value, (bool, np.bool_)):
             return i
-        if n in ("int", "long") and isinstance(value, int):
+        if n in ("int", "long") and isinstance(value, (int, np.integer)) \
+                and not isinstance(value, (bool, np.bool_)):
             return i
-        if n in ("float", "double") and isinstance(value, float):
+        if n in ("float", "double") and isinstance(value,
+                                                   (float, np.floating)):
             return i
         if n == "string" and isinstance(value, str):
             return i
@@ -308,30 +312,28 @@ def _primitive_type(sample) -> str:
     return "string"
 
 
-def _merged_primitive_type(samples) -> str:
-    """Type covering EVERY sample, not just the first: a column mixing ints
-    and floats must infer 'double' (inferring 'long' from the first row
-    would silently truncate 2.5 -> 2 at write time), and any column
-    containing bytes must infer 'bytes' (non-UTF-8 payloads written under
-    'string' would make the file unreadable)."""
-    merged = None
-    saw_bytes = False
+def _merged_primitive_type(samples):
+    """Type covering EVERY sample, not just the first. Lossless rules only:
+    a column mixing ints and floats infers 'double' (a numeric widening —
+    inferring 'long' from the first row would truncate 2.5 -> 2 at write
+    time); ANY other mix becomes a real Avro union of the observed branch
+    types (write_datum tags each value with its branch), never a silent
+    stringification — [True, 2.5] must round-trip as [True, 2.5], not
+    ['True', '2.5']."""
+    types: List[str] = []
     for s in samples:
         if s is None:
             continue
         t = _primitive_type(s)
-        saw_bytes = saw_bytes or t == "bytes"
-        if merged is None or merged == t:
-            merged = t
-        elif {merged, t} == {"long", "double"}:
-            merged = "double"
-        elif {merged, t} == {"boolean", "long"}:
-            merged = "long"
-        else:
-            merged = "string"  # heterogenous: stringify losslessly-ish
-    if merged == "string" and saw_bytes:
-        return "bytes"
-    return merged if merged is not None else "string"
+        if t not in types:
+            types.append(t)
+    if not types:
+        return "string"
+    if set(types) == {"long", "double"}:
+        return "double"
+    if len(types) == 1:
+        return types[0]
+    return types  # union spelled as the schema itself (Avro spec 1.11 §Unions)
 
 
 def infer_schema(rows: List[Dict], name: str = "Row") -> Dict:
@@ -361,5 +363,9 @@ def infer_schema(rows: List[Dict], name: str = "Row") -> Dict:
             t = {"type": "map", "values": _merged_primitive_type(inner)}
         else:
             t = _merged_primitive_type(values)
-        fields.append({"name": k, "type": ["null", t] if nullable else t})
+        if nullable:
+            # Unions can't nest (spec): flatten a union column into one
+            # union with a null branch rather than ["null", [...]]
+            t = ["null"] + t if isinstance(t, list) else ["null", t]
+        fields.append({"name": k, "type": t})
     return {"type": "record", "name": name, "fields": fields}

@@ -21,6 +21,8 @@ tokens (already-emitted tokens are preserved — vLLM's recompute preemption).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import os
 import uuid
 from collections import deque
 from typing import Dict, List, Optional, Sequence
@@ -28,6 +30,10 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ray_tpu.llm.sampling import SamplingParams, sample
+
+# Per-process key for the prefix-cache digest chain: unpredictable to
+# clients, so cache addresses can't be forged across tenants.
+_PREFIX_CACHE_SALT = os.urandom(16)
 
 
 @dataclasses.dataclass
@@ -58,7 +64,7 @@ class _Request:
                          else zlib.crc32(request_id.encode()) & 0x7FFFFFFF)
         self.finished_reason: Optional[str] = None
         self.lora_pinned = lora_slot != 0   # released once on finish
-        self.prefix_hashes: Optional[List[int]] = None  # lazy, per prompt
+        self.prefix_hashes: Optional[List[bytes]] = None  # lazy, per prompt
         self.registered_blocks = 0  # prompt blocks made cache-addressable
 
     @property
@@ -77,7 +83,7 @@ class BlockManager:
 
     vLLM analog (reference: vllm's automatic prefix caching, placed by
     ray.llm at deployments/llm/vllm/): every FULL prompt block registers
-    under a rolling content hash h_i = hash((h_{i-1}, block_tokens));
+    under a keyed rolling digest h_i = blake2b(h_{i-1}, block_tokens);
     a new request reuses the longest cached chain (refcounted, copy-free —
     cached blocks are immutable full blocks, and writes only ever target a
     sequence's own fresh tail blocks), skipping that prefix's prefill
@@ -93,8 +99,8 @@ class BlockManager:
         self.free: deque = deque(range(num_blocks))
         self.caching = enable_prefix_caching
         self.refcount: Dict[int, int] = {}       # live blocks
-        self.cached: Dict[int, int] = {}         # hash -> block_id
-        self.block_hash: Dict[int, int] = {}     # block_id -> hash
+        self.cached: Dict[bytes, int] = {}       # digest -> block_id
+        self.block_hash: Dict[int, bytes] = {}   # block_id -> digest
         self.reusable: "OrderedDict[int, None]" = OrderedDict()  # LRU
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
@@ -150,21 +156,39 @@ class BlockManager:
 
     # ---- prefix caching --------------------------------------------------
     def prefix_hashes(self, prompt: Sequence[int],
-                      lora_slot: int = 0) -> List[int]:
-        """Rolling hash per FULL prompt block (position-and-content chain,
-        so identical blocks at different depths never collide). The chain
-        is seeded with the LoRA slot: adapters change wk/wv (llm/lora.py
-        TARGETS), so KV content differs per adapter and cross-adapter
-        sharing would be silently wrong."""
-        out: List[int] = []
-        h = hash(("prefix-chain", lora_slot))
+                      lora_slot: int = 0) -> List[bytes]:
+        """Keyed rolling digest per FULL prompt block (position-and-content
+        chain, so identical blocks at different depths never collide). The
+        chain is seeded with the LoRA slot: adapters change wk/wv
+        (llm/lora.py TARGETS), so KV content differs per adapter and
+        cross-adapter sharing would be silently wrong.
+
+        blake2b keyed with a per-process random salt, NOT builtin hash():
+        hash(int)==int is attacker-predictable, letting a multi-tenant
+        client construct a block whose chain value collides with another
+        user's cached block — silent cross-request KV reuse (the vLLM
+        prefix-cache collision vulnerability)."""
+        out: List[bytes] = []
+        h = b"prefix-chain"
         bs = self.block_size
-        for i in range(len(prompt) // bs):
-            h = hash((h, tuple(prompt[i * bs:(i + 1) * bs])))
+        slot = int(lora_slot).to_bytes(8, "little", signed=True)
+        n_blocks = len(prompt) // bs
+        if n_blocks == 0:
+            return out
+        # One vectorized tobytes per block (fixed-width little-endian i64),
+        # not per-token int.to_bytes: this runs at every admission on the
+        # prefill scheduling path.
+        flat = np.asarray(prompt[:n_blocks * bs], dtype="<i8")
+        for i in range(n_blocks):
+            m = hashlib.blake2b(key=_PREFIX_CACHE_SALT, digest_size=16)
+            m.update(h)
+            m.update(slot)
+            m.update(flat[i * bs:(i + 1) * bs].tobytes())
+            h = m.digest()
             out.append(h)
         return out
 
-    def match_prefix(self, req: _Request, hashes: List[int]) -> int:
+    def match_prefix(self, req: _Request, hashes: List[bytes]) -> int:
         """Attach the longest cached chain to req; returns tokens skipped.
         The prompt's final token is ALWAYS recomputed (its logits seed the
         first sampled token), capping reuse at (len(prompt)-1)//bs blocks."""
@@ -186,7 +210,7 @@ class BlockManager:
             self.prefix_tokens_saved += skipped
         return skipped
 
-    def register_block(self, req: _Request, index: int, h: int):
+    def register_block(self, req: _Request, index: int, h: bytes):
         """A full prompt block finished prefilling: make it addressable.
         First writer wins; a duplicate stays private to its sequence."""
         if not self.caching:

@@ -200,8 +200,10 @@ class ModelRunner:
                                   lora_idx)
         last = jnp.take_along_axis(
             x, jnp.maximum(q_lens - 1, 0)[:, None, None], axis=1)[:, 0]
-        logits = (last @ params["lm_head"].astype(self.config.dtype)).astype(
-            jnp.float32)
+        # fp32 accumulation out of the matmul (not a post-hoc cast, which
+        # would keep bf16 rounding): logits feed sampling/argmax decisions.
+        logits = jnp.matmul(last, params["lm_head"].astype(self.config.dtype),
+                            preferred_element_type=jnp.float32)
         return logits, cache
 
     def _step_verify(self, params, cache, tokens, q_positions, kv_lens,
@@ -212,7 +214,13 @@ class ModelRunner:
         x, cache = self._backbone(params, cache, tokens, q_positions,
                                   kv_lens, q_lens, block_tables, lora,
                                   lora_idx)
-        logits = x @ params["lm_head"].astype(self.config.dtype)
+        # Same matmul expression as _step's head — fp32 accumulation via
+        # preferred_element_type, NOT a post-hoc cast (a monotone bf16->f32
+        # cast can't change argmax). Identical rounding on both heads keeps
+        # the "spec-decode exactly matches non-speculative greedy"
+        # acceptance property under bf16 production configs.
+        logits = jnp.matmul(x, params["lm_head"].astype(self.config.dtype),
+                            preferred_element_type=jnp.float32)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
     def _lora_args(self, lora_idx, batch: int):

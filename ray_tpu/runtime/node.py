@@ -110,6 +110,12 @@ def start_gcs(session_dir: str, port: int = 0,
     log.close()
     addr = _wait_file(ready, 60, proc, "GCS")
     host, port = addr.rsplit(":", 1)
+    # Record the address in the session dir so same-host attachers can
+    # resolve the RIGHT session's auth token by the address they attach to
+    # (session_latest alone mis-resolves when two clusters share a host —
+    # rpc.load_token_for_address scans these files).
+    with open(os.path.join(session_dir, "gcs_address"), "w") as f:
+        f.write(f"{host}:{port}")
     return proc, (host, int(port))
 
 

@@ -6,10 +6,13 @@ frame over TCP; the programming model mirrors gRPC async services: named
 handlers on servers, awaitable calls on clients, plus server->client pushes
 for pubsub. Transport is swappable behind these two classes.
 
-Frame: [4-byte magic "RTP"+version][u32 length][pickle payload]
+Frame: [4-byte magic "RTP"+version][u32 length][pickle payload][16B MAC*]
 Payload: (kind, msg_id, method, data)
   kind: 0 = request, 1 = reply, 2 = error reply, 3 = push (one-way)
 A bad magic drops the connection (ProtocolMismatch) before any pickle runs.
+*When a session token is set, connections mutually authenticate at accept
+and every frame carries a keyed-blake2b MAC over (direction, seq, body),
+verified before pickle.loads — see the wire-auth section below.
 """
 
 from __future__ import annotations
@@ -49,14 +52,27 @@ MAX_FRAME = 1 << 31
 # ---------------------------------------------------------------- wire auth
 #
 # A pickle wire must earn what protobuf gets for free: anyone who can reach
-# a port must NOT get arbitrary-code execution via pickle.loads. Every
-# cluster session mints a random token (start_gcs, node.py); servers send a
-# 32-byte challenge on accept and require HMAC-SHA256(token, challenge)
-# back BEFORE the first frame is parsed. No token in the process -> auth is
-# off (bare RpcServer unit tests); cluster processes always inherit the
-# token via RAY_TPU_AUTH_TOKEN / the 0600 session file.
+# a port must NOT get arbitrary-code execution via pickle.loads — in EITHER
+# direction. Every cluster session mints a random token (start_gcs, node.py)
+# and each connection runs a MUTUAL challenge-response:
+#
+#   server -> client : "RTA"+ver + sc (32-byte challenge)
+#   client -> server : cc (32-byte challenge) + HMAC(token, "c"+sc+cc)
+#   server -> client : HMAC(token, "s"+sc+cc)
+#
+# The client proof gates the server (no pickle from unauthenticated
+# clients); the server proof gates the client (a spoofed/hijacked endpoint
+# — port reuse after a raylet dies, TCP injection — cannot feed the client
+# pickle frames). Both sides then derive a per-session MAC key
+# HMAC(token, "k"+sc+cc) and every frame carries a 16-byte
+# blake2b(key=mac_key, direction+seq+body) tag verified BEFORE pickle.loads,
+# so injected or replayed bytes are dropped at the framing layer. No token
+# in the process -> auth is off (bare RpcServer unit tests); cluster
+# processes always inherit the token via RAY_TPU_AUTH_TOKEN / the 0600
+# session file.
 _AUTH_MAGIC = b"RTA" + bytes([PROTOCOL_VERSION])
 _CHALLENGE_SIZE = 32
+_MAC_SIZE = 16
 _session_token: Optional[bytes] = None
 _token_loaded = False
 
@@ -76,6 +92,10 @@ def get_session_token() -> Optional[bytes]:
         if not tok:
             # Same-host attach without the env var: read the latest
             # session's token file (written 0600 by node.ensure_auth_token).
+            # NOTE: with multiple live sessions on one host this can be the
+            # WRONG session's token — attach paths that know the GCS
+            # address call load_token_for_address() first, which resolves
+            # by address and pins the token explicitly.
             base = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
             path = os.path.join(base, "session_latest", "auth_token")
             try:
@@ -93,11 +113,103 @@ def get_session_token() -> Optional[bytes]:
     return _session_token
 
 
+def load_token_for_address(host: str, port: int) -> bool:
+    """Resolve the auth token for the session that owns host:port.
+
+    Scans session dirs for a gcs_address record matching the address being
+    attached to, so an attacher on a host running several clusters gets the
+    RIGHT token instead of whatever session_latest points at. An explicit
+    RAY_TPU_AUTH_TOKEN always wins (operator override). Returns True if a
+    token was pinned."""
+    import glob
+    import os
+
+    if os.environ.get("RAY_TPU_AUTH_TOKEN"):
+        return False
+    want = {f"{host}:{port}"}
+    if host in ("127.0.0.1", "localhost", "0.0.0.0"):
+        want = {f"{h}:{port}" for h in ("127.0.0.1", "localhost", "0.0.0.0")}
+    base = os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu")
+    candidates = sorted(glob.glob(os.path.join(base, "session_*")),
+                        key=lambda p: -os.path.getmtime(p)
+                        if os.path.exists(p) else 0)
+    for session in candidates:
+        if os.path.basename(session) == "session_latest":
+            continue
+        try:
+            with open(os.path.join(session, "gcs_address")) as f:
+                addr = f.read().strip()
+            if addr not in want:
+                continue
+            with open(os.path.join(session, "auth_token")) as f:
+                tok = f.read().strip()
+        except OSError:
+            continue
+        try:
+            set_session_token(bytes.fromhex(tok))
+            return True
+        except ValueError:
+            continue
+    return False
+
+
 def _hmac_answer(token: bytes, challenge: bytes) -> bytes:
     import hashlib
     import hmac as hmac_mod
 
     return hmac_mod.new(token, challenge, hashlib.sha256).digest()
+
+
+def _client_proof(token: bytes, sc: bytes, cc: bytes) -> bytes:
+    return _hmac_answer(token, b"c" + sc + cc)
+
+
+def _server_proof(token: bytes, sc: bytes, cc: bytes) -> bytes:
+    return _hmac_answer(token, b"s" + sc + cc)
+
+
+def _session_mac_key(token: bytes, sc: bytes, cc: bytes) -> bytes:
+    return _hmac_answer(token, b"k" + sc + cc)
+
+
+class _FrameMac:
+    """Per-connection frame authenticator (one per direction pair).
+
+    The tag binds direction + monotonically increasing sequence + body, so a
+    frame can't be injected, replayed, reordered, or reflected back. blake2b
+    keyed mode (RFC 7693) — faster than HMAC-SHA256 on the hot path."""
+
+    __slots__ = ("key", "send_dir", "recv_dir", "send_seq", "recv_seq")
+
+    def __init__(self, key: bytes, is_client: bool):
+        import hashlib  # noqa: F401  (ensures module is loaded before use)
+
+        self.key = key
+        self.send_dir = b"C" if is_client else b"S"
+        self.recv_dir = b"S" if is_client else b"C"
+        self.send_seq = 0
+        self.recv_seq = 0
+
+    def _tag(self, direction: bytes, seq: int, body: bytes) -> bytes:
+        import hashlib
+
+        m = hashlib.blake2b(key=self.key, digest_size=_MAC_SIZE)
+        m.update(direction)
+        m.update(seq.to_bytes(8, "little"))
+        m.update(body)
+        return m.digest()
+
+    def seal(self, body: bytes) -> bytes:
+        tag = self._tag(self.send_dir, self.send_seq, body)
+        self.send_seq += 1
+        return tag
+
+    def verify(self, body: bytes, tag: bytes) -> bool:
+        import hmac as hmac_mod
+
+        want = self._tag(self.recv_dir, self.recv_seq, body)
+        self.recv_seq += 1
+        return hmac_mod.compare_digest(want, tag)
 
 
 class RpcError(Exception):
@@ -116,7 +228,8 @@ class AuthError(RpcError):
     pass
 
 
-async def _read_frame(reader: asyncio.StreamReader):
+async def _read_frame(reader: asyncio.StreamReader,
+                      mac: Optional[_FrameMac] = None):
     hdr = await reader.readexactly(_HDR.size)
     magic, length = _HDR.unpack(hdr)
     if magic != _MAGIC:
@@ -132,12 +245,21 @@ async def _read_frame(reader: asyncio.StreamReader):
     if length > MAX_FRAME:
         raise RpcError(f"frame too large: {length}")
     body = await reader.readexactly(length)
+    if mac is not None:
+        tag = await reader.readexactly(_MAC_SIZE)
+        if not mac.verify(body, tag):
+            # Injected/replayed bytes on an authenticated connection: drop
+            # the connection WITHOUT unpickling the body.
+            raise AuthError("frame MAC verification failed")
     return pickle.loads(body)
 
 
-def _frame(obj) -> bytes:
+def _frame(obj, mac: Optional[_FrameMac] = None) -> bytes:
     body = pickle.dumps(obj, protocol=5)
-    return _HDR.pack(_MAGIC, len(body)) + body
+    out = _HDR.pack(_MAGIC, len(body)) + body
+    if mac is not None:
+        out += mac.seal(body)
+    return out
 
 
 class RpcServer:
@@ -170,24 +292,26 @@ class RpcServer:
 
     async def _on_conn(self, reader, writer):
         token = get_session_token()
+        mac: Optional[_FrameMac] = None
         if token is not None:
-            # Challenge-response BEFORE any frame is read: a peer that
-            # cannot produce HMAC(token, challenge) is dropped without a
-            # single pickle.loads of its bytes.
+            # Mutual challenge-response BEFORE any frame is read: a peer
+            # that cannot produce HMAC(token, ...) is dropped without a
+            # single pickle.loads of its bytes, and we prove knowledge of
+            # the token back so the client talks to no impostor.
+            import hmac as _hmac
             import os as _os
 
-            challenge = _os.urandom(_CHALLENGE_SIZE)
+            sc = _os.urandom(_CHALLENGE_SIZE)
             try:
-                writer.write(_AUTH_MAGIC + challenge)
+                writer.write(_AUTH_MAGIC + sc)
                 await writer.drain()
                 answer = await asyncio.wait_for(
-                    reader.readexactly(_CHALLENGE_SIZE), 10.0)
+                    reader.readexactly(_CHALLENGE_SIZE + 32), 10.0)
             except Exception:
                 answer = None
-            import hmac as _hmac
-
             if answer is None or not _hmac.compare_digest(
-                    answer, _hmac_answer(token, challenge)):
+                    answer[_CHALLENGE_SIZE:],
+                    _client_proof(token, sc, answer[:_CHALLENGE_SIZE])):
                 logger.warning(
                     "dropping unauthenticated connection from %s",
                     writer.get_extra_info("peername"))
@@ -196,13 +320,28 @@ class RpcServer:
                 except Exception:
                     pass
                 return
-        conn = ServerConnection(reader, writer)
+            cc = answer[:_CHALLENGE_SIZE]
+            try:
+                writer.write(_server_proof(token, sc, cc))
+                await writer.drain()
+            except Exception:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+                return
+            mac = _FrameMac(_session_mac_key(token, sc, cc), is_client=False)
+        conn = ServerConnection(reader, writer, mac=mac)
         self._conns.add(conn)
         try:
             while True:
                 try:
-                    kind, msg_id, method, data = await _read_frame(reader)
+                    kind, msg_id, method, data = await _read_frame(reader, mac)
                 except (asyncio.IncompleteReadError, ConnectionResetError, EOFError):
+                    break
+                except AuthError as e:
+                    logger.warning("dropping connection from %s: %s",
+                                   conn.peername, e)
                     break
                 except ProtocolMismatch as e:
                     logger.warning("dropping connection: %s", e)
@@ -212,7 +351,7 @@ class RpcServer:
                     # instead of seeing a bare EOF.
                     try:
                         writer.write(_frame((KIND_ERROR, None,
-                                             "__protocol__", str(e))))
+                                             "__protocol__", str(e)), mac))
                         await writer.drain()
                     except Exception:
                         pass
@@ -275,15 +414,18 @@ class RpcServer:
 class ServerConnection:
     """Server side of one client connection (usable for pushes to client)."""
 
-    def __init__(self, reader, writer):
+    def __init__(self, reader, writer, mac: Optional[_FrameMac] = None):
         self.reader = reader
         self.writer = writer
+        self._mac = mac
         self._lock = asyncio.Lock()
         self.meta: Dict[str, Any] = {}  # handlers stash identity here
 
     async def send(self, payload):
-        data = _frame(payload)
         async with self._lock:
+            # Sealing must happen under the lock: the MAC sequence number
+            # must match the byte order frames hit the socket in.
+            data = _frame(payload, self._mac)
             self.writer.write(data)
             await self.writer.drain()
 
@@ -326,6 +468,7 @@ class RpcClient:
         self.on_reconnect = on_reconnect
         self._reader = None
         self._writer = None
+        self._mac: Optional[_FrameMac] = None
         self._pending: Dict[int, asyncio.Future] = {}
         self._next_id = 0
         self._lock: Optional[asyncio.Lock] = None
@@ -347,7 +490,11 @@ class RpcClient:
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, 0.5)
         token = get_session_token()
+        self._mac = None
         if token is not None:
+            import hmac as _hmac
+            import os as _os
+
             try:
                 hello = await asyncio.wait_for(
                     self._reader.readexactly(len(_AUTH_MAGIC)
@@ -362,9 +509,29 @@ class RpcClient:
                 self._writer.close()
                 raise AuthError(
                     f"expected auth challenge, got {hello[:4]!r}")
-            self._writer.write(
-                _hmac_answer(token, hello[len(_AUTH_MAGIC):]))
+            sc = hello[len(_AUTH_MAGIC):]
+            cc = _os.urandom(_CHALLENGE_SIZE)
+            self._writer.write(cc + _client_proof(token, sc, cc))
             await self._writer.drain()
+            # Mutual: the server must prove token knowledge BACK before we
+            # parse a single frame from it — otherwise a spoofed endpoint
+            # (port reuse after a raylet dies, TCP hijack) could feed this
+            # process pickle frames.
+            try:
+                proof = await asyncio.wait_for(
+                    self._reader.readexactly(32), 10.0)
+            except (asyncio.TimeoutError, asyncio.IncompleteReadError) as e:
+                self._writer.close()
+                raise AuthError(
+                    "server closed without proving token knowledge "
+                    "(wrong token — multiple sessions on host? — or "
+                    "impostor endpoint)") from e
+            if not _hmac.compare_digest(proof,
+                                        _server_proof(token, sc, cc)):
+                self._writer.close()
+                raise AuthError("server failed mutual authentication")
+            self._mac = _FrameMac(_session_mac_key(token, sc, cc),
+                                  is_client=True)
         self._lock = asyncio.Lock()
         self._recv_task = asyncio.ensure_future(self._recv_loop())
         return self
@@ -372,7 +539,8 @@ class RpcClient:
     async def _recv_loop(self):
         try:
             while True:
-                kind, msg_id, method, data = await _read_frame(self._reader)
+                kind, msg_id, method, data = await _read_frame(self._reader,
+                                                               self._mac)
                 if kind in (KIND_REPLY, KIND_ERROR):
                     fut = self._pending.pop(msg_id, None)
                     if fut is not None and not fut.done():
@@ -385,6 +553,11 @@ class RpcClient:
                     asyncio.ensure_future(self._run_push(method, data))
         except (asyncio.IncompleteReadError, ConnectionResetError, EOFError, OSError):
             pass
+        except AuthError as e:
+            # Injected bytes on the wire: poison the connection, never
+            # unpickle. Reconnect (if enabled) re-runs the handshake.
+            logger.error("dropping connection to %s:%s: %s",
+                         self.host, self.port, e)
         except ProtocolMismatch as e:
             # Version skew is terminal and loud: no reconnect churn against
             # an incompatible peer, pending calls see the real reason.
@@ -469,9 +642,11 @@ class RpcClient:
         msg_id = self._next_id
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
-        payload = _frame((KIND_REQUEST, msg_id, method, data))
         try:
             async with self._lock:
+                # Seal under the lock: MAC sequence == socket byte order.
+                payload = _frame((KIND_REQUEST, msg_id, method, data),
+                                 self._mac)
                 self._writer.write(payload)
                 await self._writer.drain()
         except (ConnectionResetError, OSError) as e:
@@ -486,8 +661,8 @@ class RpcClient:
         return await fut
 
     async def push(self, method: str, **data):
-        payload = _frame((KIND_PUSH, None, method, data))
         async with self._lock:
+            payload = _frame((KIND_PUSH, None, method, data), self._mac)
             self._writer.write(payload)
             await self._writer.drain()
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -48,6 +49,13 @@ def cmd_start(args):
         if not args.address:
             sys.exit("--address required to join an existing cluster")
         host, port = args.address.rsplit(":", 1)
+        # Resolve the joined cluster's token by its address before the
+        # raylet (a child inheriting our env) first dials the GCS.
+        from ray_tpu.runtime import rpc as rpc_mod
+
+        if rpc_mod.load_token_for_address(host, int(port)):
+            os.environ["RAY_TPU_AUTH_TOKEN"] = (
+                rpc_mod.get_session_token().hex())
         session = node_mod.new_session_dir()
         res = resources_mod.node_resources(args.num_cpus, args.num_tpus)
         labels = resources_mod.tpu_slice_labels()

@@ -180,16 +180,33 @@ def test_avro_mixed_numeric_promotes(tmp_path):
 
 
 def test_avro_bytes_column_round_trips(tmp_path):
-    """A column containing non-UTF-8 bytes must infer 'bytes': writing it
-    under 'string' would produce an unreadable file."""
+    """A column mixing str and non-UTF-8 bytes infers a string/bytes union:
+    each value round-trips under its own branch (writing everything under
+    'string' would produce an unreadable file; coercing to 'bytes' would
+    mangle the str)."""
     from ray_tpu.data import avro
 
     p = str(tmp_path / "bytes.avro")
     rows = [{"c": "text"}, {"c": b"\xff\xfe"}]
     avro.write_file(p, avro.infer_schema(rows), rows)
     _schema, back = avro.read_file(p)
-    assert back[0]["c"] == b"text"
+    assert back[0]["c"] == "text"
     assert back[1]["c"] == b"\xff\xfe"
+
+
+def test_avro_heterogeneous_column_real_union(tmp_path):
+    """[True, 2.5, 'x'] must round-trip VALUES INTACT via a real Avro union
+    — not silently stringify to ['True', '2.5', 'x'] (advisor r3)."""
+    from ray_tpu.data import avro
+
+    p = str(tmp_path / "union.avro")
+    rows = [{"c": True}, {"c": 2.5}, {"c": "x"}, {"c": 7}, {"c": None}]
+    schema = avro.infer_schema(rows)
+    (field,) = [f for f in schema["fields"] if f["name"] == "c"]
+    assert isinstance(field["type"], list) and "null" in field["type"]
+    avro.write_file(p, schema, rows)
+    _schema, back = avro.read_file(p)
+    assert [r["c"] for r in back] == [True, 2.5, "x", 7, None]
 
 
 def test_tfrecord_mixed_numeric_list_promotes():
