@@ -376,6 +376,38 @@ def kernels_bench(on_tpu: bool) -> dict:
             "ok": False, "error": f"{type(exc).__name__}: {exc}",
             "trace": traceback.format_exc()[-1500:]}
 
+    # --- flash attention backward (training path) ------------------------
+    try:
+        b, sq, h, d = (4, 2048, 16, 128) if on_tpu else (1, 128, 2, 64)
+        key = jax.random.key(3)
+        q = jax.random.normal(key, (b, sq, h, d), dtype=jnp.bfloat16)
+        k = jax.random.normal(key, (b, sq, h // 2, d), dtype=jnp.bfloat16)
+        v = jax.random.normal(key, (b, sq, h // 2, d), dtype=jnp.bfloat16)
+        grad_flash = jax.jit(jax.grad(lambda q, k, v: (att.flash_attention(
+            q, k, v, causal=True, interpret=interpret) ** 2).sum(),
+            argnums=(0, 1, 2)))
+        grad_ref = jax.jit(jax.grad(lambda q, k, v: (att.mha_reference(
+            q, k, v, causal=True) ** 2).sum(), argnums=(0, 1, 2)))
+        gf = grad_flash(q, k, v)
+        gr = grad_ref(q, k, v)
+        err = max(float(np.max(np.abs(np.asarray(a, dtype=np.float32)
+                                      - np.asarray(b_, dtype=np.float32))))
+                  for a, b_ in zip(gf, gr))
+        us = timeit(lambda *a: grad_flash(*a)[0], q, k, v)
+        # bwd attention flops ~ 2.5x fwd (dq + dkv recompute), causal halves
+        flops = 10 * b * h * sq * sq * d / 2
+        out["flash_attention_bwd"] = {
+            "ok": err < 0.75,  # grad-of-square amplifies bf16 noise
+            "max_err": round(err, 4),
+            "us_per_op": round(us, 1),
+            "tflops": round(flops / (us * 1e-6) / 1e12, 2),
+            "shape": [b, sq, h, d],
+        }
+    except Exception as exc:
+        out["flash_attention_bwd"] = {
+            "ok": False, "error": f"{type(exc).__name__}: {exc}",
+            "trace": traceback.format_exc()[-1500:]}
+
     # --- ragged paged attention (decode shape) ---------------------------
     try:
         if on_tpu:

@@ -154,9 +154,13 @@ def _attention_dispatch(config: LlamaConfig, q, k, v):
                 "attention_impl='ring' needs an ambient mesh: wrap the step "
                 "in ray_tpu.parallel.mesh.use_mesh(mesh)")
         spec = P(("dp", "fsdp", "ep"), config.sp_axis, "tp", None)
+        # check_vma=False: the flash kernel's interpret-mode discharge hits
+        # a jax vma propagation gap on dynamic_slice indices (jax suggests
+        # exactly this workaround); Mosaic lowering is unaffected.
         fn = shard_map(
             _partial(ring_attention, axis_name=config.sp_axis, causal=True),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
         return fn(q, k, v)
     return attention(q, k, v, causal=True, impl=impl)
 

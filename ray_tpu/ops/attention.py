@@ -1,13 +1,21 @@
-"""Attention ops: XLA-fused reference + Pallas flash-attention forward.
+"""Attention ops: XLA-fused reference + Pallas flash attention (fwd + bwd).
 
 Design (TPU-first):
-  * Training uses the jnp reference: XLA on TPU fuses the fp32 softmax into
-    the two matmuls and handles the backward pass; at training block sizes
-    this keeps the MXU busy without hand-scheduling.
-  * Serving/prefill uses the Pallas flash kernel (no backward needed): online
-    softmax over KV blocks, O(seq) memory, causal-block skipping. This is the
-    TTFT hot path the reference outsources to vLLM's CUDA kernels.
-  * GQA (n_kv_heads < n_heads) supported everywhere by logical repeat.
+  * flash_attention is DIFFERENTIABLE (custom_vjp): the forward kernel
+    also emits the per-row logsumexp; the backward recomputes attention
+    blockwise in two Pallas kernels (dQ; dK/dV) — FlashAttention-2's
+    schedule — so training never materializes the (b, h, s, s) logits.
+  * The core returns (out, lse) so sequence-parallel callers
+    (parallel/ring.py) can merge per-chunk results by logsumexp; the lse
+    cotangent folds into the backward's delta term (ds = p*(dp-Δ+g_lse)).
+  * mha_reference stays as the O(s^2)-memory jnp reference: XLA fuses the
+    fp32 softmax into the matmuls; it is the numerics oracle in tests and
+    the fallback for shapes the kernels don't tile well.
+  * Serving/prefill uses the same forward kernel (no backward needed):
+    online softmax over KV blocks, O(seq) memory, causal-block skipping —
+    the TTFT hot path the reference outsources to vLLM's CUDA kernels.
+  * GQA (n_kv_heads < n_heads) supported everywhere by logical repeat;
+    grads through the repeat sum over the group automatically.
 """
 
 from __future__ import annotations
@@ -61,10 +69,13 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array, *,
 # Pallas flash-attention forward (TPU)
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_kv: int,
-                      causal: bool, scale: float, block_q: int):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                      seq_kv: int, true_kv: int, causal: bool, scale: float,
+                      block_q: int):
     """Grid: (batch*heads, num_q_blocks). Blocks:
-    q_ref: (block_q, d), k_ref/v_ref: (seq_kv, d) resident, o_ref: (block_q, d).
+    q_ref: (block_q, d), k_ref/v_ref: (seq_kv, d) resident, o_ref:
+    (block_q, d), lse_ref: (block_q,) — per-row logsumexp of the SCALED
+    logits, consumed by the backward kernels and by ring-attention merges.
 
     Online softmax over KV blocks; with causal=True, KV blocks entirely above
     the diagonal are skipped (the scheduling win of flash attention).
@@ -90,11 +101,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_kv: int,
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = q @ k_blk.T  # (block_q, block_k)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if true_kv != seq_kv:  # padded tail block: mask padded keys
+            s = jnp.where(k_pos < true_kv, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -104,60 +117,325 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_kv: int,
 
     m, l, acc = jax.lax.fori_loop(0, max_kb, body, (m, l, acc))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, seq_kv: int, true_kv: int,
+                         causal: bool, scale: float, block_q: int):
+    """dQ pass. Grid: (batch*heads, num_q_blocks); recomputes p blockwise
+    from (q, k, lse) — no stored logits. delta_ref carries
+    rowsum(dO*O) - g_lse (the lse cotangent folds in here; see _flash_bwd).
+    """
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]       # (block_q, 1)
+    delta = delta_ref[0][:, None]
+    d = q.shape[-1]
+
+    q_start = qi * block_q
+    num_k_blocks = pl.cdiv(seq_kv, block_k)
+    max_kb = jnp.where(
+        causal, (q_start + block_q - 1) // block_k + 1, num_k_blocks)
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k_blk.T) * scale
+        p = jnp.exp(s - lse)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        if true_kv != seq_kv:
+            p = jnp.where(k_pos < true_kv, p, 0.0)
+        dp = do @ v_blk.T
+        ds = p * (dp - delta)
+        return dq + ds @ k_blk
+
+    dq = jax.lax.fori_loop(0, max_kb, body,
+                           jnp.zeros((block_q, d), dtype=jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, seq_q: int,
+                          true_kv: int, mask_kv_tail: bool, causal: bool,
+                          scale: float, block_k: int):
+    """dK/dV pass. Grid: (batch*heads, num_k_blocks); loops over q blocks at
+    or below the diagonal (causal skip mirrored from the forward). Padded q
+    rows (seq_q is the PADDED length) contribute nothing without masking:
+    their dO and delta are zero-padded, so ds == 0 and p^T @ dO adds 0."""
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)   # (block_k, d)
+    v_blk = v_ref[0].astype(jnp.float32)
+    d = k_blk.shape[-1]
+
+    k_start = kb * block_k
+    num_q_blocks = pl.cdiv(seq_q, block_q)
+    # Causal: q blocks strictly above the diagonal contribute nothing.
+    min_qb = jnp.where(causal, k_start // block_q, 0)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(qi * block_q, block_q)][:, None]
+        delta_blk = delta_ref[0, pl.ds(qi * block_q, block_q)][:, None]
+        s = (q_blk @ k_blk.T) * scale   # (block_q, block_k)
+        p = jnp.exp(s - lse_blk)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        if mask_kv_tail:  # padded tail keys must not receive dK/dV
+            p = jnp.where(k_pos < true_kv, p, 0.0)
+        dv_new = dv + p.T @ do_blk
+        dp = do_blk @ v_blk.T
+        ds = p * (dp - delta_blk)
+        dk_new = dk + ds.T @ q_blk
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(
+        min_qb, num_q_blocks, body,
+        (jnp.zeros((block_k, d), dtype=jnp.float32),
+         jnp.zeros((block_k, d), dtype=jnp.float32)))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _vma(*xs):
+    """Union of the inputs' varying-mesh-axes sets: pallas_call out_shapes
+    inside shard_map (ring attention) must declare how outputs vary
+    (jax>=0.7 check_vma); outside shard_map this is the empty set."""
+    out = frozenset()
+    for x in xs:
+        try:
+            out = out | jax.typeof(x).vma
+        except AttributeError:
+            return None
+    return out
+
+
+def _sds(shape, dtype, vma):
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
+def _fold(x):
+    """(b, s, h, d) -> (b*h, s, d) for the kernels' grid layout."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Run the forward kernel; q/k/v in public (b, s, h, d) layout with
+    h == hkv (GQA repeat handled by callers). Returns (out, lse) with lse
+    shaped (b, h, sq) in fp32."""
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    vma = _vma(q, k, v)
+    qt, kt, vt = _fold(q), _fold(k), _fold(v)
+    # Pad sequence dims up to block multiples: in-kernel pl.ds slices CLAMP
+    # at the array edge, which would silently mislabel tail rows. Padded
+    # keys are masked inside the kernels (true_kv); padded q rows are
+    # sliced off the outputs.
+    sq_p = -(-sq // block_q) * block_q
+    skv_p = -(-skv // block_k) * block_k
+    if sq_p != sq:
+        qt = jnp.pad(qt, ((0, 0), (0, sq_p - sq), (0, 0)))
+    if skv_p != skv:
+        kt = jnp.pad(kt, ((0, 0), (0, skv_p - skv), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, skv_p - skv), (0, 0)))
+    grid = (b * h, sq_p // block_q)
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, seq_kv=skv_p, true_kv=skv,
+        causal=causal, scale=scale, block_q=block_q)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_shape=[
+            _sds((b * h, sq_p, d), q.dtype, vma),
+            _sds((b * h, sq_p), jnp.float32, vma),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return _unfold(out[:, :sq], b, h), lse[:, :sq].reshape(b, h, sq)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_call(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_call(q, k, v, causal, scale, block_q, block_k,
+                           interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
+    from jax.experimental import pallas as pl
+
+    q, k, v, out, lse = res
+    g_out, g_lse = cts
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    vma = _vma(q, k, v, g_out)
+    qt, kt, vt = _fold(q), _fold(k), _fold(v)
+    dot = _fold(g_out.astype(jnp.float32))
+    ot = _fold(out.astype(jnp.float32))
+    lse_t = lse.reshape(b * h, sq)
+    # delta = rowsum(dO*O); an lse cotangent shifts it (d lse/d s = p, so
+    # ds = p*(dp - delta + g_lse) == p*(dp - (delta - g_lse))).
+    delta = jnp.sum(dot * ot, axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.reshape(b * h, sq).astype(jnp.float32)
+
+    # Same tail-block padding as the forward (pl.ds clamps at array edges).
+    # lse pads with +1e30 so padded q rows give p = exp(s - 1e30) == 0;
+    # dO/delta pad with zeros, making padded rows exact no-ops.
+    sq_p = -(-sq // block_q) * block_q
+    skv_p = -(-skv // block_k) * block_k
+    if sq_p != sq:
+        pad = ((0, 0), (0, sq_p - sq))
+        qt = jnp.pad(qt, pad + ((0, 0),))
+        dot = jnp.pad(dot, pad + ((0, 0),))
+        lse_t = jnp.pad(lse_t, pad, constant_values=1e30)
+        delta = jnp.pad(delta, pad)
+    if skv_p != skv:
+        kt = jnp.pad(kt, ((0, 0), (0, skv_p - skv), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, skv_p - skv), (0, 0)))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          seq_kv=skv_p, true_kv=skv, causal=causal,
+                          scale=scale, block_q=block_q),
+        grid=(b * h, sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=_sds((b * h, sq_p, d), q.dtype, vma),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse_t, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, seq_q=sq_p,
+                          true_kv=skv, mask_kv_tail=skv_p != skv,
+                          causal=causal, scale=scale, block_k=block_k),
+        grid=(b * h, skv_p // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq_p, d), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, sq_p, d), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, sq_p), lambda bh, kb: (bh, 0)),
+            pl.BlockSpec((1, sq_p), lambda bh, kb: (bh, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+        ],
+        out_shape=[
+            _sds((b * h, skv_p, d), k.dtype, vma),
+            _sds((b * h, skv_p, d), v.dtype, vma),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse_t, delta)
+
+    return (_unfold(dq[:, :sq], b, h), _unfold(dk[:, :skv], b, h),
+            _unfold(dv[:, :skv], b, h))
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _flash_prep(q, k, v, scale, interpret):
+    """Shared GQA repeat + defaults for the flash entry points."""
+    h, hkv = q.shape[2], k.shape[2]
+    if hkv != h:
+        k = repeat_kv(k, h // hkv)
+        v = repeat_kv(v, h // hkv)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        from ray_tpu.ops import is_tpu_backend
+
+        interpret = not is_tpu_backend()
+    return k, v, scale, interpret
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = 256, block_k: int = 256,
+                    interpret: Optional[bool] = None,
+                    return_lse: bool = False):
+    """Differentiable Pallas flash attention (fwd + custom_vjp bwd).
+    q: (b, sq, h, d), k/v: (b, skv, hkv, d). With return_lse=True also
+    returns the (b, h, sq) logsumexp (for sequence-parallel merges)."""
+    k, v, scale, interpret = _flash_prep(q, k, v, scale, interpret)
+    out, lse = _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return (out, lse) if return_lse else out
 
 
 def flash_attention_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         causal: bool = True, scale: Optional[float] = None,
                         block_q: int = 256, block_k: int = 256,
                         interpret: Optional[bool] = None) -> jax.Array:
-    """Pallas flash forward. q: (b, sq, h, d), k/v: (b, skv, hkv, d)."""
-    from jax.experimental import pallas as pl
-
-    b, sq, h, d = q.shape
-    skv, hkv = k.shape[1], k.shape[2]
-    if hkv != h:
-        k = repeat_kv(k, h // hkv)
-        v = repeat_kv(v, h // hkv)
-    scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    block_q = min(block_q, sq)
-    block_k = min(block_k, skv)
-    if interpret is None:
-        from ray_tpu.ops import is_tpu_backend
-
-        interpret = not is_tpu_backend()
-
-    # Layout: fold (b, h) into the grid's first axis; operate on (seq, d).
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, skv, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, skv, d)
-
-    grid = (b * h, pl.cdiv(sq, block_q))
-    kernel = functools.partial(
-        _flash_fwd_kernel, block_k=block_k, seq_kv=skv, causal=causal,
-        scale=scale, block_q=block_q)
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, skv, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, skv, d), lambda bh, qi: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        interpret=interpret,
-    )(qt, kt, vt)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    """Forward-only entry point (serving hot path; no residual outputs)."""
+    k, v, scale, interpret = _flash_prep(q, k, v, scale, interpret)
+    out, _ = _flash_call(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
 
 
 def attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None,
               impl: str = "auto") -> jax.Array:
-    """Dispatch: "reference" (training, XLA-fused, differentiable) or
-    "flash" (serving forward)."""
+    """Dispatch: "reference" (XLA-fused jnp), "flash" (Pallas fwd+bwd —
+    O(seq) memory, differentiable). "auto" picks flash on TPU when the
+    head dim tiles the MXU lane width, else the fused reference."""
     if impl == "auto":
-        impl = "reference"
+        from ray_tpu.ops import is_tpu_backend
+
+        d = q.shape[-1]
+        impl = ("flash" if is_tpu_backend() and d % 128 == 0
+                and q.shape[1] >= 256 else "reference")
     if impl == "reference":
         return mha_reference(q, k, v, causal=causal, scale=scale)
     if impl == "flash":
-        return flash_attention_fwd(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale)
     raise ValueError(f"unknown attention impl {impl!r}")

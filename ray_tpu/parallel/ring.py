@@ -3,12 +3,23 @@
 Absent from the reference entirely (SURVEY §2.4 marks SP/CP "must be built
 natively"). Design: the sequence dimension is sharded over `sp`; each device
 holds one query block and rotates KV blocks around the ICI ring with
-`lax.ppermute`, accumulating attention with an online softmax (log-sum-exp
-carry). Communication overlaps compute naturally because XLA pipelines the
-ppermute with the per-block attention matmuls.
+`lax.ppermute`. Each arriving chunk is attended with the Pallas flash
+kernel (ops/attention.py — O(seq) memory, never materializing the
+(b, h, s, s) logits) and chunks merge by logsumexp. Communication overlaps
+compute naturally because XLA pipelines the ppermute with the per-chunk
+kernels.
 
-Differentiable: the accumulation is plain jnp and ppermute has a transpose
-rule, so the same code trains (backward re-rotates blocks in reverse).
+Chunk masking exploits that shards are aligned, equal-length runs of the
+global sequence: a KV chunk from rank src is — relative to this rank's
+queries — entirely in the past (src < my: unmasked), the diagonal
+(src == my: standard causal), or entirely in the future (src > my: fully
+masked, contributes nothing). So the flash kernel needs no absolute
+positions; a 3-way lax.switch picks the case per step.
+
+Differentiable end-to-end: the flash kernel has a custom_vjp (its lse
+output's cotangent folds into the backward delta term), the lse merge is
+plain jnp, and ppermute has a transpose rule (backward re-rotates blocks
+in reverse).
 """
 
 from __future__ import annotations
@@ -21,21 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.ops.attention import NEG_INF, repeat_kv
-
-
-def _block_attn(q, k, v, scale, pos_q, pos_k, causal):
-    """One KV block's contribution: returns (unnormalized acc, lse parts)."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    if causal:
-        mask = pos_q[:, None] >= pos_k[None, :]
-        s = jnp.where(mask[None, None, :, :], s, NEG_INF)
-    m = s.max(axis=-1)                                  # (b, h, q)
-    p = jnp.exp(s - m[..., None])
-    l = p.sum(axis=-1)                                  # (b, h, q)
-    acc = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
-    return acc, m, l
+from ray_tpu.ops.attention import NEG_INF, flash_attention, repeat_kv
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -55,36 +52,58 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     sp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
 
-    pos_q = my * sq + jnp.arange(sq)
+    def chunk_attn(k_blk, v_blk, src):
+        """(out, lse) for one KV chunk via the flash kernel; 3-way switch
+        on the chunk's position relative to the diagonal."""
 
-    def step(i, carry):
-        k_blk, v_blk, m, l, acc = carry
-        # The KV block currently held started at rank (my - i) mod sp.
-        src = (my - i) % sp
-        pos_k = src * sq + jnp.arange(sq)
-        blk_acc, blk_m, blk_l = _block_attn(q, k_blk, v_blk, scale, pos_q,
-                                            pos_k, causal)
-        m_new = jnp.maximum(m, blk_m)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(blk_m - m_new)
-        l_new = alpha * l + beta * blk_l
-        acc_new = (acc * alpha.transpose(0, 2, 1)[..., None]
-                   + blk_acc * beta.transpose(0, 2, 1)[..., None])
-        # Rotate KV around the ring (device p sends to p+1).
-        perm = [(p, (p + 1) % sp) for p in range(sp)]
-        k_next = lax.ppermute(k_blk, axis_name, perm)
-        v_next = lax.ppermute(v_blk, axis_name, perm)
-        return k_next, v_next, m_new, l_new, acc_new
+        def past(_):
+            return flash_attention(q, k_blk, v_blk, causal=False,
+                                   scale=scale, return_lse=True)
 
-    m0 = jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32)
-    l0 = jnp.zeros((b, h, sq), dtype=jnp.float32)
-    acc0 = jnp.zeros((b, sq, h, d), dtype=jnp.float32)
-    carry = (k, v, m0, l0, acc0)
+        def diagonal(_):
+            return flash_attention(q, k_blk, v_blk, causal=True,
+                                   scale=scale, return_lse=True)
+
+        def future(_):
+            # Constants must carry the same varying-mesh-axes set as the
+            # flash branches or lax.switch rejects the branch types.
+            from ray_tpu.ops.attention import _vma
+
+            vma = _vma(q, k_blk, v_blk)
+            z = jnp.zeros((b, sq, h, d), dtype=q.dtype)
+            neg = jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32)
+            if vma:
+                z = lax.pvary(z, tuple(vma))
+                neg = lax.pvary(neg, tuple(vma))
+            return z, neg
+
+        if not causal:
+            return past(None)
+        case = jnp.int32(0) + (src == my) + 2 * (src > my)
+        return lax.switch(case, [past, diagonal, future], None)
+
+    def merge(out, lse, blk_out, blk_lse):
+        """Numerically-stable softmax merge of two normalized partials."""
+        lse_new = jnp.logaddexp(lse, blk_lse)           # (b, h, sq)
+        w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+        w_blk = jnp.exp(blk_lse - lse_new).transpose(0, 2, 1)[..., None]
+        return (out.astype(jnp.float32) * w_old
+                + blk_out.astype(jnp.float32) * w_blk), lse_new
+
+    # Step 0 attends the LOCAL chunk (src == my: the diagonal — every row
+    # has at least its own token, so the carry lse starts finite and the
+    # merge never sees exp(-inf - -inf)).
+    out, lse = chunk_attn(k, v, my)
+    out = out.astype(jnp.float32)
+    k_blk, v_blk = k, v
+    perm = [(p, (p + 1) % sp) for p in range(sp)]
     # Python loop: sp is static, XLA unrolls and pipelines ppermute/compute.
-    for i in range(sp):
-        carry = step(i, carry)
-    _, _, m, l, acc = carry
-    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    for i in range(1, sp):
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = (my - i) % sp
+        blk_out, blk_lse = chunk_attn(k_blk, v_blk, src)
+        out, lse = merge(out, lse, blk_out, blk_lse)
     return out.astype(q.dtype)
 
 

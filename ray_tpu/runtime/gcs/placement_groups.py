@@ -59,6 +59,7 @@ class PlacementGroupManager:
         self.gcs = gcs
         self._groups: Dict[bytes, PlacementGroupRecord] = {}
         self._lock = asyncio.Lock()
+        self._retry_task: Optional[asyncio.Task] = None
 
     def restore_record(self, d: dict):
         """Rebuild a record after a GCS restart (raylets still hold the
@@ -93,24 +94,83 @@ class PlacementGroupManager:
 
     async def create(self, pg_id: bytes, bundles: List[Dict[str, float]],
                      strategy: str = PACK, name: str = ""):
+        """Two infeasibility classes (reference: pending PGs queue in
+        GcsPlacementGroupManager and retry as the cluster changes):
+
+        * capacity-infeasible — no assignment exists even against TOTAL
+          node resources (e.g. STRICT_PACK across fragmented slices): fail
+          the create loudly, the group can never be satisfied as-is.
+        * currently-infeasible — an assignment exists by capacity but not
+          by current availability (resources still draining from a group
+          torn down moments ago, workers mid-exit): the group stays
+          PENDING and a retry loop re-places it as the resource view
+          changes; pg.wait() observes CREATED when it lands. This is the
+          elastic-restart path: shrink-after-failure re-requests its PG
+          before the failed group's reservations finish releasing.
+        """
         rec = PlacementGroupRecord(pg_id, bundles, strategy, name)
         self._groups[pg_id] = rec
         async with self._lock:
             ok, err = await self._try_place(rec)
         if not ok:
-            return {"ok": False, "error": err, "placement_group_id": pg_id}
+            if self._plan(rec, by_capacity=True) is None:
+                self._groups.pop(pg_id, None)
+                return {"ok": False, "error": err,
+                        "placement_group_id": pg_id}
+            # Persist the PENDING record: a GCS restart must restore it
+            # (restore_record + kick) or pg.wait() would hang forever.
+            self.gcs.persist_pg(rec)
+            self._ensure_retry_loop()
+            return {"ok": True, "placement_group_id": pg_id,
+                    "state": PENDING}
         rec.state = CREATED
         self.gcs.persist_pg(rec)
         await self.gcs.publish("placement_group", {"event": "created", "pg": rec.view()})
         return {"ok": True, "placement_group_id": pg_id}
 
-    def _plan(self, rec: PlacementGroupRecord) -> Optional[List[Tuple[int, bytes]]]:
-        """Pick a node per bundle against a snapshot of available resources.
+    def _ensure_retry_loop(self):
+        if self._retry_task is None or self._retry_task.done():
+            self._retry_task = asyncio.ensure_future(self._retry_pending_loop())
 
-        Returns [(bundle_index, node_id)] or None if infeasible.
+    async def _retry_pending_loop(self):
+        """Re-place PENDING groups until none remain. Cheap (a plan against
+        the in-memory view) and self-terminating; woken again by create()/
+        remove()/node events."""
+        from ray_tpu.config import cfg
+
+        interval = getattr(cfg(), "pg_retry_interval_s", 0.2)
+        while True:
+            await asyncio.sleep(interval)
+            pending = [r for r in self._groups.values() if r.state == PENDING]
+            if not pending:
+                return
+            for rec in pending:
+                async with self._lock:
+                    if rec.state != PENDING:
+                        continue
+                    ok, _err = await self._try_place(rec)
+                if ok:
+                    rec.state = CREATED
+                    self.gcs.persist_pg(rec)
+                    await self.gcs.publish(
+                        "placement_group",
+                        {"event": "created", "pg": rec.view()})
+
+    def kick(self):
+        """Resources may have freed (PG removed, node joined/recovered):
+        wake the pending retry loop."""
+        if any(r.state == PENDING for r in self._groups.values()):
+            self._ensure_retry_loop()
+
+    def _plan(self, rec: PlacementGroupRecord,
+              by_capacity: bool = False) -> Optional[List[Tuple[int, bytes]]]:
+        """Pick a node per bundle against a snapshot of available resources
+        (or TOTAL resources with by_capacity=True — the can-this-ever-fit
+        check). Returns [(bundle_index, node_id)] or None if infeasible.
         """
         nodes = [n for n in self.gcs._nodes.values() if n.alive]
-        snapshot = {n.node_id: dict(n.available) for n in nodes}
+        snapshot = {n.node_id: dict(n.resources if by_capacity
+                                    else n.available) for n in nodes}
         totals = {n.node_id: n.resources for n in nodes}
         labels = {n.node_id: n.labels for n in nodes}
         plan: List[Tuple[int, bytes]] = []
@@ -237,6 +297,7 @@ class PlacementGroupManager:
         self.gcs.persist_pg(rec)
         rec.locations = [None] * len(rec.bundles)
         await self.gcs.publish("placement_group", {"event": "removed", "pg": rec.view()})
+        self.kick()  # freed bundles may unblock a pending group
         return {"ok": True}
 
     async def on_node_dead(self, node_id: bytes):
@@ -257,6 +318,8 @@ class PlacementGroupManager:
                 async with self._lock:
                     ok, _ = await self._try_place(rec)
                 rec.state = CREATED if ok else PENDING
+                if not ok:
+                    self._ensure_retry_loop()
                 self.gcs.persist_pg(rec)
                 await self.gcs.publish("placement_group",
                                        {"event": "rescheduled" if ok else "pending",

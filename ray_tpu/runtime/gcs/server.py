@@ -230,6 +230,9 @@ class GcsServer:
         for _, blob in self._store.load_all("placement_groups"):
             d = pickle.loads(blob)
             self._pg_manager.restore_record(d)
+        # Restored PENDING groups need the retry loop running again or
+        # they would only re-place on the next unrelated create/remove.
+        self._pg_manager.kick()
         if restored_nodes or self._actors or self._kv:
             logger.info("GCS restored: %d nodes, %d actors, %d kv keys",
                         restored_nodes, len(self._actors), len(self._kv))
