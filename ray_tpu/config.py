@@ -51,6 +51,12 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                              "idle workers kept per raylet; beyond this the "
                              "oldest idle worker is terminated (bounds pool "
                              "growth across distinct runtime_envs)"),
+    "runtime_env_cache_bytes": (int, 10 << 30,
+                                "per-node budget for materialized runtime-env "
+                                "URIs (packages, pip venvs); unpinned URIs "
+                                "evict LRU-first beyond this"),
+    "pg_retry_interval_s": (float, 0.2,
+                            "GCS retry period for PENDING placement groups"),
     "memory_monitor_interval_s": (float, 1.0, "OOM monitor sample period"),
     "memory_usage_threshold": (float, 0.95,
                                "fraction of system memory triggering the "

@@ -1511,9 +1511,10 @@ class _ActorClient:
 
     Submission is PIPELINED: up to MAX_INFLIGHT calls are outstanding at
     once, so a concurrent actor (max_concurrency > 1, or async methods)
-    actually executes concurrently. Sends still happen in seq_no order (the
-    pump creates call tasks in order; writes are FIFO under the client's
-    write lock), so serial actors keep per-caller execution order. After a
+    actually executes concurrently. Wire order is GUARANTEED to be seq_no
+    order because the pump itself performs every send (call_send) before
+    spawning the reply-waiter task — task-per-call sending would let late
+    calls overtake early ones parked on the connection-setup lock. After a
     reconnect (actor restart), retried calls may re-arrive out of order
     relative to each other — matching the reference's at-most-once,
     retry-opt-in semantics."""
@@ -1552,7 +1553,30 @@ class _ActorClient:
             spec.seq_no = self.seq_no
             self.seq_no += 1
             await self._sem.acquire()
-            asyncio.ensure_future(self._call_one(spec))
+            # SEND from the pump itself (strictly ordered), then hand the
+            # reply future to a concurrent waiter task. Spawning whole
+            # call coroutines instead would let late specs overtake early
+            # ones still parked on the connection-setup lock: tasks wake
+            # from a lock one loop-iteration at a time while fresh tasks
+            # run straight through the connected fast path — observed as
+            # a contiguous run of early calls executing AFTER later ones
+            # (the test_actor_ordering flake).
+            fut = client = None
+            try:
+                await self._ensure_connected()
+                client = self.client
+                fut = await client.call_send("push_actor_task", spec=spec)
+            except ActorDiedError as e:
+                self.core._complete_error(spec, e)
+                self._sem.release()
+                continue
+            except Exception:
+                # Transient send/connect failure: _call_one's retry loop
+                # redials and re-sends (documented: retried calls may
+                # re-arrive out of order, matching reference at-most-once
+                # + opt-in-retry semantics).
+                fut = None
+            asyncio.ensure_future(self._call_one(spec, client, fut))
 
     async def _ensure_connected(self):
         if self.client is not None:
@@ -1587,20 +1611,30 @@ class _ActorClient:
             self.client = None
             await client.close()
 
-    async def _call_one(self, spec: TaskSpec):
+    async def _call_one(self, spec: TaskSpec,
+                        sent_client: Optional[RpcClient] = None,
+                        sent_fut: Optional[asyncio.Future] = None):
+        """Await the pump-sent reply (sent_fut); on connection loss, retry
+        the full call per spec.max_retries (re-sends happen here, out of
+        the ordered pump — acceptable: retry reordering is documented)."""
         try:
             # Streaming methods never retry transparently (items already
             # consumed cannot be un-yielded; see _run_on_lease).
             attempts = (1 if spec.num_returns == CoreWorker.STREAMING
                         else spec.max_retries + 1)
             last_err: Optional[BaseException] = None
-            client: Optional[RpcClient] = None
+            client = sent_client
             while attempts > 0:
                 attempts -= 1
                 try:
-                    await self._ensure_connected()
-                    client = self.client
-                    reply = await client.call("push_actor_task", spec=spec)
+                    if sent_fut is not None:
+                        fut, sent_fut = sent_fut, None
+                        reply = await fut
+                    else:
+                        await self._ensure_connected()
+                        client = self.client
+                        reply = await client.call("push_actor_task",
+                                                  spec=spec)
                     self.core._complete_task(spec, reply)
                     return
                 except (ConnectionLost, OSError) as e:

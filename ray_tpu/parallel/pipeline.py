@@ -178,11 +178,11 @@ def virtual_stage_schedule(n_devices: int, v: int,
     per-device memory and enables finer microbatch granularity).
 
     The op order is depth-(n_devices*v) 1F1B restricted to each device —
-    NOT Megatron's interleaved steady-state order, so the bubble fraction
-    matches depth-p*v 1F1B rather than the interleaved (p-1)/(v*m) bound;
-    a bubble-optimal reorder can be layered on this placement later.
-    PipeOp.stage is the VIRTUAL stage (chunk) id; device = stage %
-    n_devices. Requires n_microbatches >= n_devices * v."""
+    the simple baseline kept for comparison in the bubble-accounting test;
+    production paths use megatron_interleaved_schedule below, which hits
+    the (p-1)/(v*m) interleaved bubble bound. PipeOp.stage is the VIRTUAL
+    stage (chunk) id; device = stage % n_devices. Requires
+    n_microbatches >= n_devices * v."""
     n_virtual = n_devices * v
     per_device: List[List[PipeOp]] = [[] for _ in range(n_devices)]
     for op in global_order(n_virtual, n_microbatches):
@@ -271,6 +271,26 @@ def global_order(n_stages: int, n_microbatches: int) -> List[PipeOp]:
     return linearize(one_f_one_b(n_stages, n_microbatches), n_stages)
 
 
+def submission_order(n_devices: int, interleave: int,
+                     n_microbatches: int) -> List[PipeOp]:
+    """The dependency-valid GLOBAL linearization whose per-device
+    subsequence is the production schedule: plain 1F1B without
+    interleaving, Megatron interleaved steady state with it. Shared by
+    LocalPipeline (execution order) and ActorPipeline (submission order —
+    actor queues execute in submission order, so this fixes each actor's
+    real execution order)."""
+    if interleave <= 1:
+        return global_order(n_devices, n_microbatches)
+    if n_microbatches % n_devices != 0:
+        # Megatron's interleaved order needs m % p == 0; other microbatch
+        # counts (legal for the plain order: only m >= p*v) fall back to
+        # depth-p*v 1F1B rather than rejecting the step.
+        return global_order(n_devices * interleave, n_microbatches)
+    per_device = megatron_interleaved_schedule(
+        n_devices, interleave, n_microbatches)
+    return linearize(per_device, n_devices * interleave)
+
+
 # ---------------------------------------------------------- local pipeline
 
 class LocalPipeline:
@@ -331,7 +351,9 @@ class LocalPipeline:
         stage_grads: List[Any] = [None] * self.n_virtual
         losses = []
         last = self.n_virtual - 1
-        for op in global_order(self.n_virtual, n_microbatches):
+        interleave = self.n_virtual // self.n_stages
+        for op in submission_order(self.n_stages, interleave,
+                                   n_microbatches):
             s, m = op.stage, op.microbatch
             if op.kind == "fwd":
                 if s == 0:
@@ -512,15 +534,8 @@ class ActorPipeline:
         return {"loss": float(sum(losses) / len(losses))}
 
     def _submission_order(self, n_microbatches: int) -> List[PipeOp]:
-        """A dependency-valid GLOBAL linearization whose per-actor
-        subsequence equals the chosen per-device schedule (actor queues
-        execute in submission order, so this fixes each actor's real
-        execution order)."""
-        if self.interleave == 1:
-            return global_order(self.n_stages, n_microbatches)
-        per_device = megatron_interleaved_schedule(
-            self.n_stages, self.interleave, n_microbatches)
-        return linearize(per_device, self.n_virtual)
+        return submission_order(self.n_stages, self.interleave,
+                                n_microbatches)
 
     def merged_params(self) -> Dict:
         import cloudpickle

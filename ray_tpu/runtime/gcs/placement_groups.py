@@ -111,7 +111,13 @@ class PlacementGroupManager:
         rec = PlacementGroupRecord(pg_id, bundles, strategy, name)
         self._groups[pg_id] = rec
         async with self._lock:
+            # State transition under the SAME lock as placement: the retry
+            # loop must never observe a successfully-placed record still
+            # PENDING (it would place it a second time, leaking the first
+            # set of bundle reservations).
             ok, err = await self._try_place(rec)
+            if ok:
+                rec.state = CREATED
         if not ok:
             if self._plan(rec, by_capacity=True) is None:
                 self._groups.pop(pg_id, None)
@@ -123,7 +129,6 @@ class PlacementGroupManager:
             self._ensure_retry_loop()
             return {"ok": True, "placement_group_id": pg_id,
                     "state": PENDING}
-        rec.state = CREATED
         self.gcs.persist_pg(rec)
         await self.gcs.publish("placement_group", {"event": "created", "pg": rec.view()})
         return {"ok": True, "placement_group_id": pg_id}
@@ -149,8 +154,9 @@ class PlacementGroupManager:
                     if rec.state != PENDING:
                         continue
                     ok, _err = await self._try_place(rec)
+                    if ok:
+                        rec.state = CREATED  # same-lock transition (above)
                 if ok:
-                    rec.state = CREATED
                     self.gcs.persist_pg(rec)
                     await self.gcs.publish(
                         "placement_group",
@@ -317,7 +323,7 @@ class PlacementGroupManager:
                 rec.locations = [None] * len(rec.bundles)
                 async with self._lock:
                     ok, _ = await self._try_place(rec)
-                rec.state = CREATED if ok else PENDING
+                    rec.state = CREATED if ok else PENDING
                 if not ok:
                     self._ensure_retry_loop()
                 self.gcs.persist_pg(rec)

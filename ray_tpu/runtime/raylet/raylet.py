@@ -112,6 +112,16 @@ class Raylet:
         self._view_version = 0
         self._view_epoch = None  # GCS instance id; mismatch -> full resync
         self._view_nodes: Dict[bytes, dict] = {}
+        # Node-level runtime-env agent (reference: _private/runtime_env/
+        # agent/): refcounts materialized env URIs across this node's
+        # workers and GCs unpinned ones over a byte budget.
+        from ray_tpu.config import cfg as _cfg
+        from ray_tpu.runtime_envs.cache import UriCache
+
+        self._env_cache = UriCache(
+            max_bytes=getattr(_cfg(), "runtime_env_cache_bytes", 10 << 30),
+            delete_fn=self._delete_env_uri)
+        self._env_holds: Dict[str, set] = {}  # worker_ident -> {uri}
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -382,6 +392,80 @@ class Raylet:
             logger.info("proactive spill: %d bytes -> disk (used %.0f%%)",
                         freed, 100 * self.store.used / self.store.capacity)
 
+    # ---- runtime-env agent (per-node URI refcount + GC) ------------------
+
+    def _delete_env_uri(self, uri: str) -> int:
+        from ray_tpu.runtime_envs.plugin import _REGISTRY, _ensure_builtin
+
+        _ensure_builtin()
+        cache_dir = os.path.join(self.session_dir, "runtime_resources")
+        for plugin in _REGISTRY.values():
+            try:
+                freed = plugin.delete(uri, cache_dir)
+                if freed:
+                    return freed
+            except Exception:
+                logger.exception("env uri delete failed: %s via %s",
+                                 uri, plugin.name)
+        return 0
+
+    def _env_uri_size(self, uri: str) -> int:
+        """Plugin-dispatched size accounting (plugins own URI layouts;
+        custom env kinds would otherwise be recorded as 0 bytes and escape
+        the byte budget)."""
+        from ray_tpu.runtime_envs.plugin import _REGISTRY, _ensure_builtin
+
+        _ensure_builtin()
+        cache_dir = os.path.join(self.session_dir, "runtime_resources")
+        for plugin in _REGISTRY.values():
+            try:
+                size = plugin.size(uri, cache_dir)
+                if size:
+                    return size
+            except Exception:
+                continue
+        return 0
+
+    async def handle_env_hold(self, conn, uris: List[str], worker: str = "",
+                              release_others: bool = False):
+        """A worker materialized/activated these env URIs: pin them. With
+        release_others=True, drop the worker's pins on URIs NOT in this
+        set (env switch on a reused worker must not accumulate pins for
+        envs it no longer runs). Size accounting via plugin dispatch.
+
+        Ordering: hold() BEFORE add() — add() can trigger eviction, and a
+        just-materialized unpinned URI must never be its own victim while
+        the worker that extracted it is importing from it."""
+        held = self._env_holds.setdefault(worker or "anon", set())
+        if release_others:
+            for uri in list(held - set(uris)):
+                held.discard(uri)
+                self._env_cache.release(uri)
+        for uri in uris:
+            if uri in held:
+                continue
+            held.add(uri)
+            self._env_cache.hold(uri)
+            if not self._env_cache.contains(uri):
+                self._env_cache.add(uri, self._env_uri_size(uri))
+        return {"ok": True}
+
+    async def handle_env_release(self, conn, uris: List[str],
+                                 worker: str = ""):
+        held = self._env_holds.get(worker or "anon", set())
+        for uri in uris:
+            if uri in held:
+                held.discard(uri)
+                self._env_cache.release(uri)
+        return {"ok": True}
+
+    async def handle_env_stats(self, conn):
+        return self._env_cache.stats()
+
+    def _release_env_holds(self, worker_ident: str):
+        for uri in self._env_holds.pop(worker_ident, set()):
+            self._env_cache.release(uri)
+
     async def _monitor_workers(self):
         """Child watcher: detect worker process exits (worker death path)."""
         while not self._shutdown.is_set():
@@ -391,6 +475,7 @@ class Raylet:
                     del self._workers[w.worker_id]
                     if w in self._idle:
                         self._idle.remove(w)
+                    self._release_env_holds(w.worker_id.hex())
                     reason = f"worker exited with code {w.proc.returncode}"
                     if w.lease_resources:
                         scheduling.add(self._lease_pool(w.pg_key), w.lease_resources)

@@ -633,7 +633,18 @@ class RpcClient:
                 # (register/heartbeat/kv/publish); lease-protocol calls use
                 # non-reconnecting clients so double-grants can't happen.
 
-    async def _call_once(self, method: str, timeout: Optional[float], data):
+    async def call_send(self, method: str, **data) -> asyncio.Future:
+        """Send a request NOW (write completes before this returns) and
+        hand back the pending reply future without awaiting it. Callers
+        that must guarantee wire order across many logical tasks (the
+        actor-submission pump) send from ONE ordered coroutine via this
+        and await replies concurrently elsewhere — spawning whole call
+        coroutines per task lets late tasks overtake early ones that are
+        still parked on a connection-setup lock."""
+        if self._closed or self._dead:
+            raise ConnectionLost(
+                f"connection to {self.host}:{self.port} "
+                + ("closed" if self._closed else "lost"))
         if _chaos_enabled():
             from ray_tpu.runtime.chaos import chaos
 
@@ -656,6 +667,10 @@ class RpcClient:
             # task may not have observed the failure yet).
             self._dead = True
             raise ConnectionLost(str(e))
+        return fut
+
+    async def _call_once(self, method: str, timeout: Optional[float], data):
+        fut = await self.call_send(method, **data)
         if timeout is not None:
             return await asyncio.wait_for(fut, timeout)
         return await fut

@@ -119,26 +119,15 @@ def upload_package(core, path: str) -> str:
 
 
 def prepare_runtime_env(core, env: Optional[dict]) -> Optional[dict]:
-    """Driver-side: resolve local paths in the spec to uploaded pkg URIs
-    (runs at submit time, once per distinct directory)."""
+    """Driver-side: each plugin resolves its key (local paths -> uploaded
+    pkg URIs; runs at submit time, once per distinct directory)."""
     if not env:
         return env
+    from ray_tpu.runtime_envs.plugin import plugins_for
+
     env = dict(env)
-    wd = env.get("working_dir")
-    if wd and not wd.startswith("kv://"):
-        if not os.path.isdir(wd):
-            raise ValueError(f"working_dir {wd!r} is not a directory")
-        env["working_dir"] = upload_package(core, wd)
-    mods = []
-    for m in env.get("py_modules", []):
-        if m.startswith("kv://"):
-            mods.append(m)
-        elif os.path.isdir(m):
-            mods.append(upload_package(core, m))
-        else:
-            raise ValueError(f"py_modules entry {m!r} is not a directory")
-    if mods:
-        env["py_modules"] = mods
+    for plugin in plugins_for(env):
+        env[plugin.name] = plugin.resolve(core, env[plugin.name])
     return env
 
 
@@ -163,25 +152,6 @@ def _fetch_and_extract(core, uri: str, session_dir: str) -> str:
     return dest
 
 
-def _check_pip(specs: List[str]):
-    import importlib.metadata as md
-    missing = []
-    for spec in specs:
-        name = spec.split("==")[0].split(">=")[0].split("<=")[0].strip()
-        try:
-            md.version(name)
-        except md.PackageNotFoundError:
-            missing.append(spec)
-    if missing:
-        msg = (f"runtime_env pip packages not installed: {missing}; this "
-               "air-gapped build cannot install packages at runtime — bake "
-               "them into the image")
-        if os.environ.get("RAY_TPU_ALLOW_MISSING_PIP") == "1":
-            logger.warning(msg)
-        else:
-            raise RuntimeError(msg)
-
-
 class AppliedEnv:
     """Worker-side record of one applied env, so it can be rolled back after
     the task (env_vars) while extracted packages stay cached."""
@@ -190,6 +160,7 @@ class AppliedEnv:
         self.saved_env: Dict[str, Optional[str]] = {}
         self.added_paths: List[str] = []
         self.prev_cwd: Optional[str] = None
+        self.held_uris: List[str] = []
 
     def undo(self):
         for key, old in self.saved_env.items():
@@ -209,36 +180,66 @@ class AppliedEnv:
                 pass
 
 
-def apply_runtime_env(core, env: Optional[dict], session_dir: str) -> AppliedEnv:
-    """Worker-side: materialize and activate a runtime env for a task.
+def build_env_context(core, env: Optional[dict], session_dir: str):
+    """Run every plugin's create() for this env into one RuntimeEnvContext
+    (no process mutation yet). The agent/worker applies the context."""
+    from ray_tpu.runtime_envs.plugin import RuntimeEnvContext, plugins_for
 
-    Fail-safe ordering: validations that can reject the env (pip) run before
-    any process mutation, and a failure mid-application rolls back whatever
-    was already applied — a rejected env must not contaminate the worker for
+    ctx = RuntimeEnvContext()
+    if not env:
+        return ctx
+    ctx._env_config = env.get("config") or {}  # plugin-visible knobs
+    for plugin in plugins_for(env):
+        plugin.create(core, env[plugin.name], ctx, session_dir)
+    return ctx
+
+
+def apply_runtime_env(core, env: Optional[dict], session_dir: str) -> AppliedEnv:
+    """Worker-side: materialize (via the plugin registry) and activate a
+    runtime env for a task.
+
+    Fail-safe ordering: plugin create() runs fully — including validations
+    that can reject the env (pip check mode) — before any process
+    mutation, and a failure mid-application rolls back whatever was
+    already applied: a rejected env must not contaminate the worker for
     later tasks."""
     applied = AppliedEnv()
     if not env:
         return applied
-    if env.get("pip"):
-        _check_pip(env["pip"])
+    ctx = build_env_context(core, env, session_dir)
     try:
-        for key, value in (env.get("env_vars") or {}).items():
+        for key, value in ctx.env_vars.items():
             applied.saved_env[key] = os.environ.get(key)
             os.environ[key] = value
-        for uri in env.get("py_modules", []):
-            path = _fetch_and_extract(core, uri, session_dir)
+        for path in ctx.py_paths:
             if path not in sys.path:
                 sys.path.insert(0, path)
                 applied.added_paths.append(path)
-        wd = env.get("working_dir")
-        if wd:
-            path = _fetch_and_extract(core, wd, session_dir)
-            if path not in sys.path:
-                sys.path.insert(0, path)
-                applied.added_paths.append(path)
+        if ctx.cwd:
             applied.prev_cwd = os.getcwd()
-            os.chdir(path)
+            os.chdir(ctx.cwd)
+        # Node-level refcounting: tell the raylet's env agent which URIs
+        # this worker now pins (release happens on worker exit or env
+        # switch — see raylet EnvAgent).
+        if ctx.uris:
+            applied.held_uris = list(ctx.uris)
+            _notify_agent_hold(core, ctx.uris)
     except BaseException:
         applied.undo()
         raise
     return applied
+
+
+def _notify_agent_hold(core, uris: List[str]):
+    """Fire-and-forget URI holds to this node's raylet env agent."""
+    try:
+        if getattr(core, "raylet", None) is None:
+            return
+        worker = getattr(core, "worker_ident", "") or ""
+        # release_others: switching envs on a reused worker must drop pins
+        # for URIs the worker no longer runs, or eviction starves.
+        core.io.spawn(core.raylet.call(
+            "env_hold", uris=list(uris), worker=worker,
+            release_others=True))
+    except Exception:
+        logger.debug("env_hold notify failed", exc_info=True)

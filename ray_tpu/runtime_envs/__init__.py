@@ -1,0 +1,10 @@
+"""Runtime-env plugin system (see plugin.py for the interface)."""
+
+from ray_tpu.runtime_envs.cache import UriCache
+from ray_tpu.runtime_envs.plugin import (RuntimeEnvContext, RuntimeEnvPlugin,
+                                         get_plugin, plugins_for,
+                                         register_plugin, unregister_plugin)
+
+__all__ = ["RuntimeEnvContext", "RuntimeEnvPlugin", "UriCache",
+           "register_plugin", "unregister_plugin", "get_plugin",
+           "plugins_for"]

@@ -1,0 +1,110 @@
+"""Refcounted, byte-budgeted URI cache for materialized runtime envs.
+
+Reference analog: _private/runtime_env/uri_cache.py URICache — URIs in use
+are pinned; unused URIs stay cached (warm reuse) until the byte budget is
+exceeded, then evict LRU-first via the owning plugin's delete().
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class UriCache:
+    def __init__(self, max_bytes: int = 10 << 30,
+                 delete_fn: Optional[Callable[[str], int]] = None):
+        """delete_fn(uri) -> bytes freed; defaults to plugin dispatch."""
+        self.max_bytes = max_bytes
+        self._delete_fn = delete_fn
+        self._lock = threading.Lock()
+        self._refs: Dict[str, int] = {}
+        self._sizes: Dict[str, int] = {}
+        self._last_used: Dict[str, float] = {}
+        self.total_bytes = 0
+
+    def add(self, uri: str, size: int):
+        """Record a materialized URI (idempotent; updates size)."""
+        with self._lock:
+            self.total_bytes += size - self._sizes.get(uri, 0)
+            self._sizes[uri] = size
+            self._last_used[uri] = time.monotonic()
+        self.evict_if_needed()
+
+    def hold(self, uri: str):
+        with self._lock:
+            self._refs[uri] = self._refs.get(uri, 0) + 1
+            self._last_used[uri] = time.monotonic()
+
+    def release(self, uri: str):
+        with self._lock:
+            n = self._refs.get(uri, 0) - 1
+            if n <= 0:
+                self._refs.pop(uri, None)
+            else:
+                self._refs[uri] = n
+        self.evict_if_needed()
+
+    def pinned(self, uri: str) -> bool:
+        with self._lock:
+            return self._refs.get(uri, 0) > 0
+
+    def contains(self, uri: str) -> bool:
+        with self._lock:
+            return uri in self._sizes
+
+    def evict_if_needed(self) -> List[str]:
+        """Evict unpinned URIs LRU-first until under budget. Returns the
+        URIs evicted."""
+        evicted: List[str] = []
+        while True:
+            with self._lock:
+                if self.total_bytes <= self.max_bytes:
+                    return evicted
+                candidates: List[Tuple[float, str]] = sorted(
+                    (self._last_used.get(u, 0.0), u)
+                    for u in self._sizes if self._refs.get(u, 0) == 0)
+                if not candidates:
+                    return evicted  # everything pinned: over budget but live
+                _, victim = candidates[0]
+                size = self._sizes.pop(victim)
+                self._last_used.pop(victim, None)
+                self.total_bytes -= size
+            try:
+                freed = (self._delete_fn or self._default_delete)(victim)
+                logger.info("runtime_env cache evicted %s (%d bytes)",
+                            victim, freed or size)
+            except Exception:
+                logger.exception("runtime_env cache delete failed for %s",
+                                 victim)
+            evicted.append(victim)
+
+    def _default_delete(self, uri: str) -> int:
+        from ray_tpu.runtime_envs.plugin import _REGISTRY
+
+        for plugin in _REGISTRY.values():
+            try:
+                freed = plugin.delete(uri, self._cache_dir_for(uri))
+                if freed:
+                    return freed
+            except Exception:
+                continue
+        return 0
+
+    @staticmethod
+    def _cache_dir_for(uri: str) -> str:
+        import os
+
+        base = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+        return os.path.join(base, "runtime_resources")
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"uris": len(self._sizes),
+                    "pinned": sum(1 for v in self._refs.values() if v > 0),
+                    "total_bytes": self.total_bytes,
+                    "max_bytes": self.max_bytes}

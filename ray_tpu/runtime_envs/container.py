@@ -1,0 +1,46 @@
+"""Container runtime envs: worker-launch command wrapping.
+
+Reference analog: _private/runtime_env/image_uri.py — the worker process
+launches inside `docker/podman run` with the session dir and object-store
+path bind-mounted. Materialization here is a COMMAND-PREFIX hook: the
+plugin validates the spec and emits the wrapper argv on the context; the
+worker pool consumes ctx.command_prefix when forking workers for this env
+(air-gapped TPU pods ship a baked image, so pulling is the runtime's job,
+not ours — a missing runtime binary raises at create time, not at fork
+time).
+"""
+
+from __future__ import annotations
+
+import logging
+import shutil
+from typing import Any, List
+
+from ray_tpu.runtime_envs.plugin import RuntimeEnvContext, RuntimeEnvPlugin
+
+logger = logging.getLogger(__name__)
+
+
+class ContainerPlugin(RuntimeEnvPlugin):
+    name = "container"
+    priority = 1
+
+    def create(self, core, value: Any, ctx: RuntimeEnvContext,
+               cache_dir: str) -> None:
+        if isinstance(value, str):
+            value = {"image": value}
+        image = value.get("image")
+        if not image:
+            raise ValueError("container env needs an 'image'")
+        runtime = value.get("runtime", "docker")
+        if shutil.which(runtime) is None:
+            raise RuntimeError(
+                f"container runtime {runtime!r} not found on this node; "
+                "container runtime_envs need docker/podman on every node")
+        argv: List[str] = [runtime, "run", "--rm", "--network=host",
+                           "-v", f"{cache_dir}:{cache_dir}",
+                           "-v", "/dev/shm:/dev/shm"]
+        for extra in value.get("run_options", []) or []:
+            argv.append(str(extra))
+        argv.append(image)
+        ctx.command_prefix = argv

@@ -1,0 +1,114 @@
+"""Package-shaped plugins: env_vars, py_modules, working_dir.
+
+Reference analog: _private/runtime_env/{working_dir.py,py_modules.py} —
+content-addressed zips, URI-cached extraction. The upload/extract
+primitives live in ray_tpu.runtime_env (zip_directory, upload_package,
+_fetch_and_extract) and are reused here; these classes adapt them to the
+plugin interface so custom env kinds ride the same machinery.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+from typing import Any, List
+
+from ray_tpu.runtime_envs.plugin import RuntimeEnvContext, RuntimeEnvPlugin
+
+logger = logging.getLogger(__name__)
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
+
+
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 0  # apply first so later plugins may read them
+
+    def create(self, core, value: Any, ctx: RuntimeEnvContext,
+               cache_dir: str) -> None:
+        ctx.env_vars.update(value or {})
+
+
+class _PackagePluginBase(RuntimeEnvPlugin):
+    """Shared resolve/extract for zip-package env kinds."""
+
+    def _resolve_one(self, core, path: str) -> str:
+        from ray_tpu.runtime_env import upload_package
+
+        if path.startswith("kv://"):
+            return path
+        if not os.path.isdir(path):
+            raise ValueError(f"{self.name} entry {path!r} is not a directory")
+        return upload_package(core, path)
+
+    def _extract(self, core, uri: str, cache_dir: str) -> str:
+        from ray_tpu.runtime_env import _fetch_and_extract
+
+        # cache_dir is <session>/; _fetch_and_extract manages
+        # <session>/runtime_resources/<digest>.
+        return _fetch_and_extract(core, uri, cache_dir)
+
+    def delete(self, uri: str, cache_dir: str) -> int:
+        if not uri.startswith("kv://"):
+            return 0
+        digest = uri.rsplit("/", 1)[-1]
+        dest = os.path.join(cache_dir, digest)
+        if not os.path.isdir(dest):
+            return 0
+        freed = _dir_bytes(dest)
+        shutil.rmtree(dest, ignore_errors=True)
+        return freed
+
+    def size(self, uri: str, cache_dir: str) -> int:
+        if not uri.startswith("kv://"):
+            return 0
+        dest = os.path.join(cache_dir, uri.rsplit("/", 1)[-1])
+        return _dir_bytes(dest) if os.path.isdir(dest) else 0
+
+
+class PyModulesPlugin(_PackagePluginBase):
+    name = "py_modules"
+    priority = 5
+
+    def resolve(self, core, value: Any) -> Any:
+        return [self._resolve_one(core, m) for m in (value or [])]
+
+    def uris(self, value: Any) -> List[str]:
+        return [m for m in (value or []) if m.startswith("kv://")]
+
+    def create(self, core, value: Any, ctx: RuntimeEnvContext,
+               cache_dir: str) -> None:
+        for uri in value or []:
+            path = self._extract(core, uri, cache_dir)
+            ctx.py_paths.append(path)
+            ctx.uris.append(uri)
+
+
+class WorkingDirPlugin(_PackagePluginBase):
+    name = "working_dir"
+    priority = 6
+
+    def resolve(self, core, value: Any) -> Any:
+        return self._resolve_one(core, value) if value else value
+
+    def uris(self, value: Any) -> List[str]:
+        return [value] if value and value.startswith("kv://") else []
+
+    def create(self, core, value: Any, ctx: RuntimeEnvContext,
+               cache_dir: str) -> None:
+        if not value:
+            return
+        path = self._extract(core, value, cache_dir)
+        ctx.py_paths.append(path)
+        ctx.cwd = path
+        ctx.uris.append(value)

@@ -1,0 +1,147 @@
+"""pip runtime envs: air-gapped presence check OR venv materialization.
+
+Reference analog: _private/runtime_env/pip.py (virtualenv built per spec
+hash, --system-site-packages so the base image's heavyweight deps — jax! —
+resolve without reinstallation). Two modes:
+
+  * check (default; air-gapped TPU pods): specs are validated against
+    already-importable distributions; missing packages raise
+    (RAY_TPU_ALLOW_MISSING_PIP=1 downgrades to a warning).
+  * install (RAY_TPU_PIP_MODE=install, or env config {"pip_mode":
+    "install"}): materialize a venv at
+    <session>/runtime_resources/pip/<spec-hash>, `pip install` the specs
+    into it, and prepend its site-packages to the worker's sys.path. The
+    venv is URI-cached (pip://<hash>) and refcount-GC'd like any package.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import shutil
+import subprocess
+import sys
+from typing import Any, List
+
+from ray_tpu.runtime_envs.plugin import RuntimeEnvContext, RuntimeEnvPlugin
+
+logger = logging.getLogger(__name__)
+
+
+def _spec_hash(specs: List[str]) -> str:
+    return hashlib.sha1("\n".join(sorted(specs)).encode()).hexdigest()[:16]
+
+
+def pip_mode(env_config: dict) -> str:
+    mode = (env_config or {}).get("pip_mode") or os.environ.get(
+        "RAY_TPU_PIP_MODE", "check")
+    if mode not in ("check", "install"):
+        raise ValueError(f"pip_mode must be check|install, got {mode!r}")
+    return mode
+
+
+def check_installed(specs: List[str]):
+    """Air-gapped mode: every spec must already be importable."""
+    import importlib.metadata as md
+
+    missing = []
+    for spec in specs:
+        name = spec.split("==")[0].split(">=")[0].split("<=")[0].strip()
+        try:
+            md.version(name)
+        except md.PackageNotFoundError:
+            missing.append(spec)
+    if missing:
+        msg = (f"runtime_env pip packages not installed: {missing}; this "
+               "air-gapped build cannot install packages at runtime — bake "
+               "them into the image or set RAY_TPU_PIP_MODE=install where "
+               "network/index access exists")
+        if os.environ.get("RAY_TPU_ALLOW_MISSING_PIP") == "1":
+            logger.warning(msg)
+        else:
+            raise RuntimeError(msg)
+
+
+def _venv_site_packages(venv_dir: str) -> str:
+    v = sys.version_info
+    return os.path.join(venv_dir, "lib", f"python{v.major}.{v.minor}",
+                        "site-packages")
+
+
+def materialize_venv(specs: List[str], cache_dir: str) -> str:
+    """Build (or reuse) the venv for these specs; returns its
+    site-packages path. --system-site-packages keeps the base image's jax
+    stack resolving without a reinstall."""
+    h = _spec_hash(specs)
+    venv_dir = os.path.join(cache_dir, "pip", h)
+    marker = os.path.join(venv_dir, ".ready")
+    site = _venv_site_packages(venv_dir)
+    if os.path.exists(marker):
+        return site
+    tmp = f"{venv_dir}.{os.getpid()}.tmp"
+    shutil.rmtree(tmp, ignore_errors=True)
+    try:
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages", tmp],
+            check=True, capture_output=True, text=True, timeout=300)
+        pip = os.path.join(tmp, "bin", "pip")
+        r = subprocess.run(
+            [pip, "install", "--no-input", *specs],
+            capture_output=True, text=True, timeout=1800)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"pip install {specs} failed:\n{r.stderr[-2000:]}")
+        os.makedirs(os.path.dirname(venv_dir), exist_ok=True)
+        try:
+            os.replace(tmp, venv_dir)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)  # concurrent builder won
+        with open(marker, "w") as f:
+            f.write("\n".join(specs))
+        return site
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+class PipPlugin(RuntimeEnvPlugin):
+    name = "pip"
+    priority = 4  # before working_dir/py_modules: their code may import it
+
+    def uris(self, value: Any) -> List[str]:
+        return [f"pip://{_spec_hash(list(value))}"] if value else []
+
+    def create(self, core, value: Any, ctx: RuntimeEnvContext,
+               cache_dir: str) -> None:
+        specs = list(value or [])
+        if not specs:
+            return
+        mode = pip_mode(getattr(ctx, "_env_config", {}) or {})
+        if mode == "check":
+            check_installed(specs)
+            return
+        site = materialize_venv(
+            specs, os.path.join(cache_dir, "runtime_resources"))
+        ctx.py_paths.append(site)
+        ctx.uris.append(f"pip://{_spec_hash(specs)}")
+
+    def delete(self, uri: str, cache_dir: str) -> int:
+        if not uri.startswith("pip://"):
+            return 0
+        from ray_tpu.runtime_envs.packages import _dir_bytes
+
+        venv_dir = os.path.join(cache_dir, "pip", uri[len("pip://"):])
+        if not os.path.isdir(venv_dir):
+            return 0
+        freed = _dir_bytes(venv_dir)
+        shutil.rmtree(venv_dir, ignore_errors=True)
+        return freed
+
+    def size(self, uri: str, cache_dir: str) -> int:
+        if not uri.startswith("pip://"):
+            return 0
+        from ray_tpu.runtime_envs.packages import _dir_bytes
+
+        venv_dir = os.path.join(cache_dir, "pip", uri[len("pip://"):])
+        return _dir_bytes(venv_dir) if os.path.isdir(venv_dir) else 0

@@ -1,0 +1,130 @@
+"""Runtime-env plugin interface.
+
+Reference analog: python/ray/_private/runtime_env/plugin.py (RuntimeEnvPlugin
+ABC: per-key create/delete with URI-addressed caching, priority-ordered
+application) and the per-node agent (runtime_env/agent/) that owns the
+node's materialized-URI cache. TPU-first shape: plugins materialize into a
+node-shared session cache and mutate a RuntimeEnvContext (sys.path
+additions, env vars, cwd, worker-command prefix) that the worker applies;
+the raylet's EnvAgent (runtime/raylet/raylet.py) refcounts URIs across
+workers and garbage-collects over a byte budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class RuntimeEnvContext:
+    """The materialized form of an env: everything a worker must apply.
+
+    Reference analog: _private/runtime_env/context.py RuntimeEnvContext
+    (py_executable, env_vars, command_prefix)."""
+
+    py_paths: List[str] = dataclasses.field(default_factory=list)
+    env_vars: Dict[str, str] = dataclasses.field(default_factory=dict)
+    cwd: Optional[str] = None
+    # Wrapper for the worker launch command (container plugin): e.g.
+    # ["docker", "run", "--rm", "-v", ..., IMAGE] — consumed by the worker
+    # pool when it forks workers for this env.
+    command_prefix: List[str] = dataclasses.field(default_factory=list)
+    uris: List[str] = dataclasses.field(default_factory=list)
+
+
+class RuntimeEnvPlugin:
+    """One env-spec key's materializer. Subclasses set `name` to the spec
+    key they own and implement resolve/create/delete.
+
+    Lifecycle: driver-side `resolve()` rewrites local values into URIs
+    (uploads); worker/agent-side `create()` materializes a URI into the
+    node cache and records its effect on the context; `delete()` removes
+    one cached URI (called by the cache when refcount hits zero under
+    byte pressure)."""
+
+    name: str = ""
+    priority: int = 10  # lower runs first (env_vars before working_dir...)
+
+    def resolve(self, core, value: Any) -> Any:
+        """Driver-side, at task submission: turn local paths into
+        content-addressed URIs (uploading as needed). Default: pass
+        through."""
+        return value
+
+    def uris(self, value: Any) -> List[str]:
+        """URIs this value pins while any worker uses the env."""
+        return []
+
+    def create(self, core, value: Any, ctx: RuntimeEnvContext,
+               cache_dir: str) -> None:
+        """Materialize into cache_dir and record effects on ctx."""
+
+    def delete(self, uri: str, cache_dir: str) -> int:
+        """Remove one cached URI; returns bytes freed."""
+        return 0
+
+    def size(self, uri: str, cache_dir: str) -> int:
+        """On-disk bytes of one cached URI (0 = not this plugin's URI).
+        Feeds the node agent's byte-budget accounting."""
+        return 0
+
+
+_REGISTRY: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin):
+    if not plugin.name:
+        raise ValueError("plugin needs a name (the env-spec key it owns)")
+    _REGISTRY[plugin.name] = plugin
+
+
+def unregister_plugin(name: str):
+    _REGISTRY.pop(name, None)
+
+
+def get_plugin(name: str) -> Optional[RuntimeEnvPlugin]:
+    _ensure_builtin()
+    return _REGISTRY.get(name)
+
+
+def plugins_for(env: Dict[str, Any]) -> List[RuntimeEnvPlugin]:
+    """Plugins owning keys present in the env, priority-ordered."""
+    _ensure_builtin()
+    out = [p for k, p in _REGISTRY.items() if env.get(k) is not None]
+    return sorted(out, key=lambda p: p.priority)
+
+
+_builtin_loaded = False
+
+
+def _ensure_builtin():
+    global _builtin_loaded
+    if _builtin_loaded:
+        return
+    _builtin_loaded = True
+    from ray_tpu.runtime_envs import container, packages, pip_env
+
+    for p in (packages.EnvVarsPlugin(), packages.PyModulesPlugin(),
+              packages.WorkingDirPlugin(), pip_env.PipPlugin(),
+              container.ContainerPlugin()):
+        _REGISTRY.setdefault(p.name, p)
+    # Operator plugins (reference: RAY_RUNTIME_ENV_PLUGINS): a
+    # comma-separated list of "module.path:ClassName" importable on EVERY
+    # node — workers must be able to materialize the env kinds the driver
+    # submits, so registration-by-import-path, not by pickled instance.
+    import importlib
+    import os
+
+    spec = os.environ.get("RAY_TPU_RUNTIME_ENV_PLUGINS", "")
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        try:
+            mod_name, cls_name = entry.split(":")
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            plugin = cls()
+            _REGISTRY.setdefault(plugin.name, plugin)
+        except Exception:
+            logger.exception("failed to load runtime_env plugin %r", entry)

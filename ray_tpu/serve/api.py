@@ -145,8 +145,10 @@ def start_grpc_proxy(port: int = 0):
 
 def shutdown():
     global _controller, _proxy, _grpc_proxy
+    from ray_tpu.serve.config_watcher import ConfigWatcher
     from ray_tpu.serve.local_testing import shutdown_local
 
+    ConfigWatcher.reset()
     shutdown_local()
     if _controller is None:
         return  # local-only session: nothing cluster-side to tear down

@@ -1,0 +1,141 @@
+"""Push-based serve config propagation.
+
+Reference analog: python/ray/serve/_private/long_poll.py:204 LongPollHost —
+the controller PUSHES config changes to proxies/handles instead of being
+polled. Ours rides the GCS pubsub channel (one-way KIND_PUSH frames,
+runtime/rpc.py): the ServeController publishes
+{deployment, version, event} on every deploy/scale/delete, and every
+process holding handles runs one shared ConfigWatcher subscription that
+records the freshest version per deployment. DeploymentHandle and the HTTP
+proxy consult the watcher before each request: a version newer than what
+they routed with triggers an immediate (<100 ms end-to-end) refresh; no
+polling loop runs while the subscription is healthy. If the subscription
+is down or has no entry yet (subscribed after the event), callers fall
+back to the old time-based refresh interval — push is the fast path,
+polling only the degraded mode.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+CHANNEL = "serve_config"
+
+
+class ConfigWatcher:
+    """Per-process singleton subscription to the serve_config channel."""
+
+    _instance: Optional["ConfigWatcher"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.versions: Dict[str, int] = {}
+        self._client = None
+        self._starting = False
+        self._connected = False
+
+    @classmethod
+    def get(cls) -> "ConfigWatcher":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = ConfigWatcher()
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        """Test hook: drop the singleton (e.g. across cluster restarts)."""
+        with cls._lock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None and inst._client is not None:
+            try:
+                from ray_tpu.core.worker import global_worker
+
+                global_worker().io.spawn(inst._client.close())
+            except Exception:
+                pass
+
+    # ---- subscription ----------------------------------------------------
+
+    def ensure_started(self):
+        if self._client is not None:
+            if self._client._dead and not self._client._closed:
+                # Push-only connections never issue calls, so a dead GCS
+                # link (GCS restart) would stay dead: kick the client's
+                # auto-reconnect (redials + resubscribes via on_reconnect)
+                # with a cheap call. Handles keep using the time-based
+                # fallback until the stream is healthy again.
+                try:
+                    from ray_tpu.core.worker import global_worker
+
+                    global_worker().io.spawn(
+                        self._client.call("subscribe", channels=[CHANNEL]))
+                except Exception:
+                    pass
+            return
+        if self._starting:
+            return
+        self._starting = True
+        try:
+            from ray_tpu.core.worker import global_worker
+            from ray_tpu.runtime.rpc import RpcClient
+
+            core = global_worker()
+
+            async def on_push(method, data):
+                if method != "pubsub" or data.get("channel") != CHANNEL:
+                    return
+                msg = data.get("message") or {}
+                name, version = msg.get("deployment"), msg.get("version")
+                if name is None or version is None:
+                    return
+                if version > self.versions.get(name, -1):
+                    self.versions[name] = version
+
+            async def resub(client):
+                await client._call_once("subscribe", 30,
+                                        dict(channels=[CHANNEL]))
+
+            async def connect():
+                client = RpcClient(core.gcs.host, core.gcs.port,
+                                   on_push=on_push, auto_reconnect=True,
+                                   on_reconnect=resub)
+                await client.connect(timeout=30)
+                await client.call("subscribe", channels=[CHANNEL])
+                self._client = client
+                self._connected = True
+
+            core.io.run(connect(), timeout=35)
+        except Exception:
+            logger.exception("serve config watcher failed to start; "
+                             "handles fall back to periodic refresh")
+            self._starting = False  # allow a later retry
+            return
+
+    @property
+    def healthy(self) -> bool:
+        return (self._connected and self._client is not None
+                and not self._client._dead)
+
+    def version(self, deployment: str) -> Optional[int]:
+        """Freshest pushed version, or None if no event seen yet."""
+        return self.versions.get(deployment)
+
+
+def publish_change(deployment: str, version: int, event: str):
+    """Controller side: fire-and-forget push to every subscriber."""
+    try:
+        from ray_tpu.core.worker import global_worker
+
+        core = global_worker()
+        core.io.spawn(core.gcs.call(
+            "publish", channel=CHANNEL,
+            message={"deployment": deployment, "version": version,
+                     "event": event, "ts": time.time()}))
+    except Exception:
+        logger.exception("serve config publish failed (%s %s)",
+                         deployment, event)

@@ -3,8 +3,10 @@
 Reference analog: python/ray/serve/_private/controller.py:84 ServeController
 + deployment_state.py:1248 (replica state machine) + long_poll.py:204 config
 propagation. Ours: a named actor owning the replica actors per deployment;
-handles pull the replica list with a version number and refresh on change
-(the long-poll pattern collapsed to versioned polling).
+every config change (deploy/scale/delete) is PUSHED to proxies and handles
+over the GCS pubsub channel (serve/config_watcher.py — the LongPollHost
+analog riding KIND_PUSH frames); the version number doubles as the
+fallback refresh key when a subscriber's push stream is down.
 """
 
 from __future__ import annotations
@@ -59,11 +61,20 @@ class ServeController:
         ray_tpu.get([r.health_check.remote() for r in replicas], timeout=300)
         with self._lock:
             self.version += 1
+            version = self.version
             self.deployments[name] = {
                 "replicas": replicas, "config": config,
-                "version": self.version, "target_payload": target_payload,
+                "version": version, "target_payload": target_payload,
                 "init_args": init_args, "init_kwargs": init_kwargs}
+        self._publish(name, version, "deployed")
         return True
+
+    @staticmethod
+    def _publish(name: str, version: int, event: str):
+        """Push the change to proxies/handles (LongPollHost analog)."""
+        from ray_tpu.serve.config_watcher import publish_change
+
+        publish_change(name, version, event)
 
     # ---- autoscaling (autoscaling_policy.py analog) ----------------------
 
@@ -177,6 +188,8 @@ class ServeController:
                 d["replicas"].extend(new)
                 self.version += 1
                 d["version"] = self.version
+                new_version = self.version
+            self._publish(name, new_version, "scaled_up")
         else:
             with self._lock:
                 d = self.deployments.get(name)
@@ -186,6 +199,8 @@ class ServeController:
                 d["replicas"] = d["replicas"][:desired]
                 self.version += 1
                 d["version"] = self.version
+                new_version = self.version
+            self._publish(name, new_version, "scaled_down")
             for r in victims:
                 try:
                     ray_tpu.kill(r)
@@ -201,7 +216,8 @@ class ServeController:
 
     def list_deployments(self) -> List[dict]:
         return [{"name": k, "num_replicas": len(v["replicas"]),
-                 "config": v["config"]} for k, v in self.deployments.items()]
+                 "config": v["config"], "version": v["version"]}
+                for k, v in self.deployments.items()]
 
     def delete_deployment(self, name: str) -> bool:
         d = self.deployments.pop(name, None)
@@ -213,6 +229,7 @@ class ServeController:
             except Exception:
                 pass
         self.version += 1
+        self._publish(name, self.version, "deleted")
         return True
 
     def global_version(self) -> int:

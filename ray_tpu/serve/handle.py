@@ -109,12 +109,39 @@ class DeploymentHandle:
         info = ray_tpu.get(controller.get_replicas.remote(self.deployment_name),
                            timeout=60)
         if not info["found"]:
+            # Acknowledge the deletion push so _needs_refresh doesn't pay
+            # a blocking controller round-trip on EVERY subsequent call of
+            # a surviving handle.
+            from ray_tpu.serve.config_watcher import ConfigWatcher
+
+            pushed = ConfigWatcher.get().version(self.deployment_name)
+            if pushed is not None:
+                self._version = pushed
+            self._last_refresh = time.monotonic()
             raise ValueError(f"no deployment named {self.deployment_name!r}")
         if info["version"] != self._version:
             self._replicas = info["replicas"]
             self._version = info["version"]
             self._inflight = {i: 0 for i in range(len(self._replicas))}
         self._last_refresh = time.monotonic()
+
+    def _needs_refresh(self) -> bool:
+        """Push-first (LongPollHost analog): the shared ConfigWatcher gets
+        controller pushes on every deploy/scale/delete, so a handle only
+        talks to the controller when its routing set is actually stale.
+        Time-based refresh remains ONLY as the degraded mode (watcher
+        not yet started / subscription down / no event seen yet)."""
+        if not self._replicas:
+            return True
+        from ray_tpu.serve.config_watcher import ConfigWatcher
+
+        watcher = ConfigWatcher.get()
+        watcher.ensure_started()
+        pushed = watcher.version(self.deployment_name)
+        if pushed is not None and watcher.healthy:
+            return pushed != self._version
+        return (time.monotonic() - self._last_refresh
+                > self.REFRESH_INTERVAL_S)
 
     def _pick_replica(self) -> int:
         n = len(self._replicas)
@@ -131,8 +158,7 @@ class DeploymentHandle:
         stream back as they are yielded (ObjectRefGenerator of item refs).
         Reference analog: serve streaming responses over
         ReportGeneratorItemReturns (core_worker.proto:462)."""
-        if (not self._replicas
-                or time.monotonic() - self._last_refresh > self.REFRESH_INTERVAL_S):
+        if self._needs_refresh():
             try:
                 self._refresh()
             except Exception:
@@ -149,10 +175,9 @@ class DeploymentHandle:
         self._inflight[idx] = max(0, self._inflight.get(idx, 0) - 1)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        # Periodic re-poll so autoscaled replicas join the routing set
-        # (versioned-poll collapse of the reference's LongPollHost pattern).
-        if (not self._replicas
-                or time.monotonic() - self._last_refresh > self.REFRESH_INTERVAL_S):
+        # Push-triggered refresh (controller pubsub via ConfigWatcher);
+        # time-based re-poll only as the degraded fallback.
+        if self._needs_refresh():
             try:
                 self._refresh()
             except Exception:

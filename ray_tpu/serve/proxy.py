@@ -79,41 +79,73 @@ class HTTPProxyActor:
             await resp.write_eof()
             return resp
 
-        adapter_cache: Dict[str, tuple] = {}  # name -> (expires, fn|None)
+        # name -> (config_version, expires, fn|None). Validity is
+        # PUSH-KEYED: while the ConfigWatcher subscription is healthy, an
+        # entry is fresh iff its version matches the controller's latest
+        # pushed version — a redeploy invalidates in one push (<100 ms),
+        # no TTL wait. The TTL only governs the degraded mode (watcher
+        # down / no event seen for this deployment yet).
+        adapter_cache: Dict[str, tuple] = {}
+        # Controller-outage throttle: after a failed lookup the stale entry
+        # serves unconditionally until the deadline, even when a push says
+        # it is outdated — otherwise every request during an outage pays a
+        # blocking 30 s controller round-trip before serving stale.
+        lookup_backoff: Dict[str, float] = {}
+
+        def _cache_hit(name: str):
+            import time as time_mod
+
+            from ray_tpu.serve.config_watcher import ConfigWatcher
+
+            hit = adapter_cache.get(name)
+            if hit is None:
+                return None
+            now = time_mod.monotonic()
+            if lookup_backoff.get(name, 0.0) > now:
+                return hit
+            watcher = ConfigWatcher.get()
+            pushed = watcher.version(name)
+            if watcher.healthy and pushed is not None:
+                return hit if hit[0] == pushed else None
+            return hit if hit[1] > now else None
 
         def _adapter_for(name: str):
-            """Deployment's declared http_adapter, 5s-cached (config is
-            near-static; a redeploy republishes within one TTL). An unknown
-            adapter NAME raises (misconfiguration must surface, not
-            silently fall back to raw JSON); a transient controller RPC
-            failure reuses the stale cache entry when one exists."""
+            """Deployment's declared http_adapter. An unknown adapter NAME
+            raises (misconfiguration must surface, not silently fall back
+            to raw JSON); a transient controller RPC failure reuses the
+            stale cache entry when one exists."""
             import time as time_mod
 
             from ray_tpu.serve import http_adapters
             from ray_tpu.serve.api import _get_controller
+            from ray_tpu.serve.config_watcher import ConfigWatcher
 
+            ConfigWatcher.get().ensure_started()
             now = time_mod.monotonic()
-            hit = adapter_cache.get(name)
-            if hit is not None and hit[0] > now:
-                return hit[1]
+            hit = _cache_hit(name)
+            if hit is not None:
+                return hit[2]
+            stale = adapter_cache.get(name)
             try:
-                adapter_name = None
+                adapter_name, version = None, -1
                 for d in ray_tpu.get(
                         _get_controller().list_deployments.remote(),
                         timeout=30):
                     if d["name"] == name:
                         adapter_name = d["config"].get("http_adapter")
+                        version = d.get("version", -1)
                         break
             except Exception:
-                if hit is not None:
-                    # Stale beats changing request semantics; re-arm a short
-                    # TTL so an outage costs one probe per second, not one
-                    # blocking 30s lookup per request.
-                    adapter_cache[name] = (now + 1.0, hit[1])
-                    return hit[1]
+                if stale is not None:
+                    # Stale beats changing request semantics; arm the
+                    # outage backoff so the outage costs one probe per
+                    # second, not one blocking 30 s lookup per request.
+                    lookup_backoff[name] = now + 1.0
+                    return stale[2]
                 raise
+            lookup_backoff.pop(name, None)
             fn = http_adapters.get(adapter_name) if adapter_name else None
-            adapter_cache[name] = (now + 5.0, fn)
+            adapter_cache[name] = (version, now + 5.0, fn)
             return fn
 
         async def dispatch(request: "web.Request"):
@@ -123,12 +155,10 @@ class HTTPProxyActor:
             if key not in handles:
                 handles[key] = DeploymentHandle(name, method)
             # Cache hit resolves inline (no executor hop on the hot path);
-            # only a miss/expiry pays the controller round-trip.
-            import time as time_mod
-
-            hit = adapter_cache.get(name)
-            if hit is not None and hit[0] > time_mod.monotonic():
-                adapter = hit[1]
+            # only a miss/invalidation pays the controller round-trip.
+            hit = _cache_hit(name)
+            if hit is not None:
+                adapter = hit[2]
             else:
                 try:
                     adapter = await asyncio.get_event_loop().run_in_executor(

@@ -1,0 +1,14 @@
+"""Test plugin loaded on every node via RAY_TPU_RUNTIME_ENV_PLUGINS."""
+
+from ray_tpu.runtime_envs import RuntimeEnvPlugin
+
+
+class StampPlugin(RuntimeEnvPlugin):
+    name = "stamp"
+    priority = 2
+
+    def resolve(self, core, value):
+        return f"resolved-{value}"
+
+    def create(self, core, value, ctx, cache_dir):
+        ctx.env_vars["RTENV_STAMP"] = value

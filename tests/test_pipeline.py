@@ -262,6 +262,11 @@ def test_megatron_interleaved_schedule_beats_plain_bubble():
         # order's O(p*v) bubble), matching the (p-1)/(v*m) bound.
         assert mega_ticks - ideal <= 2 * (p - 1), \
             (p, v, m, mega_ticks - ideal)
+        # And the idle-slot FRACTION matches Megatron's published bound:
+        # bubble/ideal = (p-1)/(v*m), to within simulation granularity.
+        frac = (mega_ticks - ideal) / ideal
+        bound = (p - 1) / (v * m)
+        assert frac <= bound + 1e-9, (p, v, m, frac, bound)
 
 
 def test_interleaved_actor_pipeline_matches_single_program(setup):

@@ -112,3 +112,139 @@ def test_deterministic_package_hash(tmp_path):
     os.utime(d / "a.py", (0, 0))
     z2 = zip_directory(str(d))
     assert z1 == z2
+
+
+# ---- plugin interface (reference: _private/runtime_env/plugin.py) --------
+
+@pytest.fixture
+def ensure_cluster():
+    # An earlier test (job-level env) tears down the module cluster and
+    # builds its own; re-init here if needed.
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=2)
+    yield
+
+
+def test_plugin_registry_and_custom_plugin(tmp_path, monkeypatch):
+    """A plugin named via RAY_TPU_RUNTIME_ENV_PLUGINS (importable on every
+    node — the reference's RAY_RUNTIME_ENV_PLUGINS contract) participates
+    in driver-side resolve and worker-side create."""
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_PLUGINS",
+                       "tests.rtenv_stamp_plugin:StampPlugin")
+    import ray_tpu.runtime_envs.plugin as plugin_mod
+
+    monkeypatch.setattr(plugin_mod, "_builtin_loaded", False)
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote(runtime_env={"stamp": "x1"})
+        def read():
+            return os.environ.get("RTENV_STAMP")
+
+        assert ray_tpu.get(read.remote(), timeout=60) == "resolved-x1"
+    finally:
+        ray_tpu.shutdown()
+        plugin_mod.unregister_plugin("stamp")
+        plugin_mod._builtin_loaded = False
+
+
+def test_build_env_context_orders_by_priority(tmp_path):
+    from ray_tpu.runtime_envs import (RuntimeEnvPlugin, register_plugin,
+                                      unregister_plugin)
+    from ray_tpu.runtime_env import build_env_context
+
+    order = []
+
+    class A(RuntimeEnvPlugin):
+        name = "zz_late"
+        priority = 50
+
+        def create(self, core, value, ctx, cache_dir):
+            order.append("late")
+
+    class B(RuntimeEnvPlugin):
+        name = "aa_early"
+        priority = 1
+
+        def create(self, core, value, ctx, cache_dir):
+            order.append("early")
+
+    register_plugin(A())
+    register_plugin(B())
+    try:
+        build_env_context(None, {"zz_late": 1, "aa_early": 1}, str(tmp_path))
+        assert order == ["early", "late"]
+    finally:
+        unregister_plugin("zz_late")
+        unregister_plugin("aa_early")
+
+
+def test_uri_cache_refcount_and_eviction():
+    """Pinned URIs survive byte pressure; unpinned evict LRU-first via the
+    delete callback."""
+    from ray_tpu.runtime_envs import UriCache
+
+    deleted = []
+    cache = UriCache(max_bytes=100, delete_fn=lambda u: deleted.append(u) or 10)
+    cache.add("kv://pkg/a", 60)
+    cache.hold("kv://pkg/a")
+    cache.add("kv://pkg/b", 30)   # total 90: under budget
+    assert deleted == []
+    cache.add("kv://pkg/c", 30)   # total 120: must evict; only b unpinned
+    assert deleted == ["kv://pkg/b"]
+    assert not cache.contains("kv://pkg/b")
+    assert cache.contains("kv://pkg/a")  # pinned survived
+    # Releasing the pin exposes 'a' to the next pressure round.
+    cache.release("kv://pkg/a")
+    cache.add("kv://pkg/d", 40)   # over budget again
+    assert "kv://pkg/a" in deleted or "kv://pkg/c" in deleted
+
+
+def test_pip_check_mode_rejects_missing(ensure_cluster):
+    @ray_tpu.remote(runtime_env={"pip": ["definitely-not-a-real-pkg-xyz"]})
+    def f():
+        return 1
+
+    with pytest.raises(Exception, match="not installed"):
+        ray_tpu.get(f.remote(), timeout=60)
+
+
+def test_pip_venv_materializer_offline_failure(tmp_path, monkeypatch):
+    """install mode builds a venv; on this zero-egress box pip install of a
+    non-cached package must FAIL LOUDLY (not silently fall back)."""
+    from ray_tpu.runtime_envs import pip_env
+
+    with pytest.raises((RuntimeError, Exception)):
+        pip_env.materialize_venv(["definitely-not-a-real-pkg-xyz"],
+                                 str(tmp_path))
+
+
+def test_raylet_env_agent_refcounts(ensure_cluster, tmp_path):
+    """Worker env holds register with the raylet agent; stats reflect the
+    pinned URI."""
+    pkg = tmp_path / "agentpkg"
+    pkg.mkdir()
+    (pkg / "agent_probe_mod.py").write_text("X = 7\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(pkg)]})
+    def use():
+        import agent_probe_mod
+
+        return agent_probe_mod.X
+
+    assert ray_tpu.get(use.remote(), timeout=60) == 7
+    import time as _t
+
+    from ray_tpu.core.worker import global_worker
+
+    core = global_worker()
+    deadline = _t.monotonic() + 10
+    stats = {}
+    while _t.monotonic() < deadline:
+        stats = core.io.run(core.raylet.call("env_stats"))
+        if stats.get("uris", 0) >= 1:
+            break
+        _t.sleep(0.1)
+    assert stats.get("uris", 0) >= 1, stats
+    assert stats.get("pinned", 0) >= 1, stats

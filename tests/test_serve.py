@@ -258,3 +258,41 @@ def test_http_adapter_misconfig_surfaces(cluster):
         urllib.request.urlopen(bad, timeout=60)
     assert ei.value.code == 400
     assert "array" in json.loads(ei.value.read())["error"]
+
+
+def test_push_config_propagation_no_polling(cluster, monkeypatch):
+    """Push-based propagation (LongPollHost analog): with the time-based
+    refresh fallback effectively disabled (1 h), a redeploy must still
+    reach an existing handle — via the controller's pubsub push — fast."""
+    import time
+
+    from ray_tpu.config import cfg
+    from ray_tpu.serve.config_watcher import ConfigWatcher
+
+    monkeypatch.setattr(cfg(), "serve_handle_refresh_s", 3600.0)
+
+    serve.run(Greeter.options(name="pushy").bind("v1"))
+    h = serve.get_deployment_handle("pushy")
+    assert h.remote("x").result() == "v1 x"  # starts the watcher, routes v1
+    watcher = ConfigWatcher.get()
+    assert watcher.healthy
+    v_before = watcher.version("pushy")
+
+    serve.run(Greeter.options(name="pushy").bind("v2"))
+    # The push must land almost immediately after deploy returns (the
+    # publish fires before the controller replies; no polling is armed).
+    t0 = time.monotonic()
+    deadline = t0 + 2.0
+    while time.monotonic() < deadline:
+        v = watcher.version("pushy")
+        if v is not None and v != v_before:
+            break
+        time.sleep(0.002)
+    push_latency = time.monotonic() - t0
+    assert watcher.version("pushy") != v_before, "push never arrived"
+    # Sub-100ms typical; the bound is looser to absorb CI scheduler noise
+    # (the 3600 s poll interval above is what proves this was a PUSH).
+    assert push_latency < 0.5, f"push took {push_latency*1000:.0f} ms"
+    # And the SAME handle object routes to the new config without any
+    # periodic refresh having been possible.
+    assert h.remote("x").result() == "v2 x"
