@@ -248,9 +248,16 @@ class CloudAPIProvider(NodeProvider):
         return getattr(node, "node_id", None)
 
 
+def _gce_queued(**kwargs):
+    from ray_tpu.autoscaler.gce import GceTpuQueuedProvider
+
+    return GceTpuQueuedProvider(**kwargs)
+
+
 PROVIDERS = {
     "local": LocalNodeProvider,
-    "gce_tpu": GCETpuProvider,
+    "gce_tpu": GCETpuProvider,          # gcloud-argv shaped (dry-run-able)
+    "gce_tpu_api": _gce_queued,         # Cloud TPU v2 REST queuedResources
     "cloud_api": CloudAPIProvider,
 }
 

@@ -1,0 +1,285 @@
+"""Multi-agent RL: MultiAgentEnv protocol + per-policy module mapping.
+
+Reference analog: rllib/env/multi_agent_env.py (MultiAgentEnv: dict-keyed
+obs/action/reward spaces per agent) and rllib/core/rl_module/
+multi_rl_module.py (MultiRLModule: policy_id -> module, with
+policy_mapping_fn routing agents onto policies — shared when several
+agents map to one policy id, independent otherwise).
+
+TPU-first shape: every policy's PPO update is the SAME jit-compiled
+update the single-agent path uses (rl/ppo.py make_update_fn); the
+multi-agent layer only routes per-agent transition streams into
+per-policy batches, so N policies cost N compiled updates — no Python in
+the math. Environments are vectorized over n_envs like rl/env.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl import ppo as ppo_mod
+
+
+class MultiAgentEnv:
+    """Vectorized multi-agent env protocol (MultiAgentEnv analog).
+
+    agent_ids: static tuple of agent names (every agent acts every step —
+    the reference's "all agents stepped" simple case).
+    reset() -> {agent: (n_envs, obs_dim)}
+    step({agent: (n_envs,)}) -> (obs dict, reward dict, done (n_envs,))
+    Auto-resets done envs; current_obs() returns post-reset observations.
+    """
+
+    agent_ids: Tuple[str, ...] = ()
+
+    def obs_dim(self, agent: str) -> int:
+        raise NotImplementedError
+
+    def n_actions(self, agent: str) -> int:
+        raise NotImplementedError
+
+    def reset(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        raise NotImplementedError
+
+    def current_obs(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+
+class CooperativeReach(MultiAgentEnv):
+    """2-agent cooperative gridworld (the learning-quality test task).
+
+    Each agent walks a G-cell line toward its own goal (opposite ends);
+    the TEAM is rewarded only jointly: distance-shaped penalty each step
+    and +1 with episode end when BOTH stand on their goals — so a selfish
+    agent that parks on its goal while its partner wanders still bleeds
+    reward, and the optimum requires coordinated arrival. Observations
+    include BOTH positions (fully observable cooperation)."""
+
+    agent_ids = ("a0", "a1")
+
+    def __init__(self, n_envs: int, grid: int = 5, max_steps: int = 32,
+                 seed: int = 0):
+        self.n = n_envs
+        self.grid = grid
+        self.max_steps = max_steps
+        self.rng = np.random.default_rng(seed)
+        self.goals = {"a0": grid - 1, "a1": 0}
+        self.pos = np.zeros((n_envs, 2), dtype=np.int64)
+        self.steps = np.zeros(n_envs, dtype=np.int64)
+        self.reset()
+
+    def obs_dim(self, agent: str) -> int:
+        return 2 * self.grid
+
+    def n_actions(self, agent: str) -> int:
+        return 3  # left, stay, right
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        eye = np.eye(self.grid, dtype=np.float32)
+        own = {a: eye[self.pos[:, i]] for i, a in enumerate(self.agent_ids)}
+        return {
+            "a0": np.concatenate([own["a0"], own["a1"]], axis=1),
+            "a1": np.concatenate([own["a1"], own["a0"]], axis=1),
+        }
+
+    def reset(self) -> Dict[str, np.ndarray]:
+        self.pos = self.rng.integers(0, self.grid, (self.n, 2))
+        self.steps[:] = 0
+        return self._obs()
+
+    def _reset_done(self, done: np.ndarray):
+        k = int(done.sum())
+        if k:
+            self.pos[done] = self.rng.integers(0, self.grid, (k, 2))
+            self.steps[done] = 0
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        for i, a in enumerate(self.agent_ids):
+            move = np.asarray(actions[a]) - 1   # 0/1/2 -> -1/0/+1
+            self.pos[:, i] = np.clip(self.pos[:, i] + move, 0,
+                                     self.grid - 1)
+        self.steps += 1
+        d0 = np.abs(self.pos[:, 0] - self.goals["a0"])
+        d1 = np.abs(self.pos[:, 1] - self.goals["a1"])
+        both = (d0 == 0) & (d1 == 0)
+        team_reward = np.where(
+            both, 1.0, -0.05 * (d0 + d1) / self.grid).astype(np.float32)
+        done = both | (self.steps >= self.max_steps)
+        obs_terminal = self._obs()
+        self._reset_done(done)
+        rewards = {a: team_reward.copy() for a in self.agent_ids}
+        return obs_terminal, rewards, done
+
+    def current_obs(self) -> Dict[str, np.ndarray]:
+        return self._obs()
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiAgentConfig:
+    """policies: policy_id -> PPOConfig (obs_dim/n_actions per policy);
+    policy_mapping_fn: agent_id -> policy_id (shared policy = many agents
+    to one id)."""
+
+    policies: Dict[str, ppo_mod.PPOConfig]
+    policy_mapping_fn: Callable[[str], str]
+    rollout_length: int = 32
+    n_envs: int = 16
+
+    @staticmethod
+    def from_env(env: MultiAgentEnv, *, shared: bool = False,
+                 rollout_length: int = 32, n_envs: int = 16,
+                 **ppo_overrides) -> "MultiAgentConfig":
+        """Independent policy per agent (default) or one shared policy —
+        requires homogeneous spaces when shared."""
+        if shared:
+            a0 = env.agent_ids[0]
+            assert all(env.obs_dim(a) == env.obs_dim(a0)
+                       and env.n_actions(a) == env.n_actions(a0)
+                       for a in env.agent_ids), \
+                "shared policy needs homogeneous agent spaces"
+            policies = {"shared": ppo_mod.PPOConfig(
+                obs_dim=env.obs_dim(a0), n_actions=env.n_actions(a0),
+                **ppo_overrides)}
+            return MultiAgentConfig(policies, lambda a: "shared",
+                                    rollout_length, n_envs)
+        policies = {f"p_{a}": ppo_mod.PPOConfig(
+            obs_dim=env.obs_dim(a), n_actions=env.n_actions(a),
+            **ppo_overrides) for a in env.agent_ids}
+        return MultiAgentConfig(policies, lambda a: f"p_{a}",
+                                rollout_length, n_envs)
+
+
+class MultiAgentPPO:
+    """Per-policy PPO over a MultiAgentEnv (MultiRLModule analog)."""
+
+    def __init__(self, env: MultiAgentEnv, config: MultiAgentConfig,
+                 seed: int = 0):
+        import jax
+        import optax
+
+        self.env = env
+        self.config = config
+        self.mapping = {a: config.policy_mapping_fn(a)
+                        for a in env.agent_ids}
+        unknown = set(self.mapping.values()) - set(config.policies)
+        assert not unknown, f"mapping targets unknown policies: {unknown}"
+        self.policies: Dict[str, dict] = {}
+        keys = jax.random.split(jax.random.key(seed),
+                                len(config.policies) + 1)
+        self.key = keys[-1]
+        for k, (pid, pcfg) in zip(keys, config.policies.items()):
+            optimizer = optax.adam(pcfg.lr)
+            params = ppo_mod.init_policy(pcfg, k)
+            self.policies[pid] = {
+                "config": pcfg,
+                "params": params,
+                "optimizer": optimizer,
+                "opt_state": optimizer.init(params),
+                "update_fn": ppo_mod.make_update_fn(pcfg, optimizer),
+            }
+        self.forward = jax.jit(ppo_mod.policy_forward)
+        self.rng = np.random.default_rng(seed)
+        self.obs = env.reset()
+        self.iteration = 0
+        self.episode_returns: List[float] = []
+        self._running = np.zeros(env.__dict__.get("n", 1), dtype=np.float64)
+
+    # -- rollout -----------------------------------------------------------
+
+    def _act(self, agent: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        import jax.numpy as jnp
+
+        pol = self.policies[self.mapping[agent]]
+        logits, values = self.forward(pol["params"],
+                                      jnp.asarray(self.obs[agent]))
+        logits = np.asarray(logits)
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        cum = probs.cumsum(axis=1)
+        r = self.rng.random((len(probs), 1))
+        # Clamp: float32 cumsum can top out below 1.0, and a draw in that
+        # sliver would otherwise index one past the last action.
+        actions = np.minimum((r > cum).sum(axis=1), probs.shape[1] - 1)
+        logp = np.log(probs[np.arange(len(actions)), actions] + 1e-10)
+        return actions, logp, np.asarray(values)
+
+    def train(self) -> Dict:
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        T = self.config.rollout_length
+        buf = {a: {k: [] for k in ("obs", "actions", "logp", "rewards",
+                                   "dones", "values")}
+               for a in self.env.agent_ids}
+        for _ in range(T):
+            step_actions = {}
+            for a in self.env.agent_ids:
+                actions, logp, values = self._act(a)
+                step_actions[a] = actions
+                buf[a]["obs"].append(self.obs[a])
+                buf[a]["actions"].append(actions)
+                buf[a]["logp"].append(logp)
+                buf[a]["values"].append(values)
+            _obs_t, rewards, done = self.env.step(step_actions)
+            team = np.mean([rewards[a] for a in self.env.agent_ids], axis=0)
+            self._running += team
+            for i in np.where(done)[0]:
+                self.episode_returns.append(float(self._running[i]))
+                self._running[i] = 0.0
+            for a in self.env.agent_ids:
+                buf[a]["rewards"].append(rewards[a])
+                buf[a]["dones"].append(done.astype(np.float32))
+            self.obs = self.env.current_obs()
+
+        # Route agent streams into per-policy batches (GAE per stream).
+        per_policy: Dict[str, List[dict]] = {p: [] for p in self.policies}
+        for a in self.env.agent_ids:
+            pol = self.policies[self.mapping[a]]
+            pcfg = pol["config"]
+            _, last_value = self.forward(pol["params"],
+                                         jnp.asarray(self.obs[a]))
+            adv, ret = ppo_mod.compute_gae(
+                jnp.asarray(np.stack(buf[a]["rewards"])),
+                jnp.asarray(np.stack(buf[a]["values"])),
+                jnp.asarray(np.stack(buf[a]["dones"])),
+                jnp.asarray(last_value), pcfg.gamma, pcfg.gae_lambda)
+            per_policy[self.mapping[a]].append({
+                "obs": np.stack(buf[a]["obs"]).reshape(-1, pcfg.obs_dim),
+                "actions": np.stack(buf[a]["actions"]).reshape(-1)
+                .astype(np.int32),
+                "logp_old": np.stack(buf[a]["logp"]).reshape(-1)
+                .astype(np.float32),
+                "advantages": np.asarray(adv).reshape(-1),
+                "returns": np.asarray(ret).reshape(-1),
+            })
+
+        metrics: Dict[str, float] = {}
+        for pid, chunks in per_policy.items():
+            if not chunks:
+                continue
+            pol = self.policies[pid]
+            batch = {k: jnp.asarray(np.concatenate([c[k] for c in chunks]))
+                     for k in chunks[0]}
+            self.key, sub = jax.random.split(self.key)
+            pol["params"], pol["opt_state"], m = pol["update_fn"](
+                pol["params"], pol["opt_state"], batch, sub)
+            for k, v in m.items():
+                metrics[f"{pid}/{k}"] = float(v)
+
+        self.iteration += 1
+        recent = self.episode_returns[-100:]
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": float(np.mean(recent)) if recent else 0.0,
+            "num_env_steps": T * self.env.n * len(self.env.agent_ids),
+            "time_this_iter_s": time.perf_counter() - t0,
+            **metrics,
+        }

@@ -131,6 +131,56 @@ class ObjectStore:
         self._mm = mmap.mmap(self._fd, size)
         self._view = memoryview(self._mm)
         self._closed = False
+        self._start_prefault(create)
+
+    def _start_prefault(self, create: bool):
+        """Warm the arena from a background thread.
+
+        Two distinct costs otherwise land on the cold put path (together
+        the r3 microbench's 86x put/get asymmetry):
+          * page ALLOCATION — the creator posix_fallocates the whole file
+            (tmpfs allocates + zeroes blocks without writing through the
+            mapping, so it can't race live allocator data). Cheap pure
+            syscalls; on by default (RAY_TPU_STORE_PREFAULT=0 disables —
+            allocation commits the whole arena).
+          * per-process PTE population — an opener can read-touch one
+            byte per page (reads can't corrupt data) so its writes hit
+            mapped pages. OPT-IN via RAY_TPU_STORE_PREFAULT=full: with
+            many workers per host the concurrent walks cost more CPU than
+            the faults they save (pathological on small test boxes).
+        """
+        mode = os.environ.get("RAY_TPU_STORE_PREFAULT", "1")
+        if mode == "0" or (not create and mode != "full"):
+            return
+        import threading
+
+        # The thread gets its OWN dup'd fd: close() recycling the main fd
+        # number mid-walk must never let fallocate hit an unrelated file.
+        fd = os.dup(self._fd)
+        mm, size = self._mm, os.fstat(self._fd).st_size
+
+        def warm():
+            try:
+                chunk = 128 << 20
+                for start in range(0, size, chunk):
+                    if self._closed:
+                        return
+                    end = min(start + chunk, size)
+                    if create:
+                        os.posix_fallocate(fd, start, end - start)
+                    if mode == "full":
+                        # One read per page populates this process's PTEs.
+                        mm[start:end:4096]
+            except (OSError, ValueError, SystemError):
+                pass  # best-effort (e.g. store closed mid-walk)
+            finally:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+
+        threading.Thread(target=warm, name="store_prefault",
+                         daemon=True).start()
 
     # -- lifecycle ---------------------------------------------------------
     def close(self):

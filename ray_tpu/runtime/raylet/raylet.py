@@ -31,6 +31,22 @@ logger = logging.getLogger(__name__)
 DEFAULT_OBJECT_STORE_MEMORY = 2 << 30
 
 
+def _store_dir(session_dir: str) -> str:
+    """Where the shared-memory arena file lives: /dev/shm (tmpfs) when
+    available, like the reference's plasma store. A disk-backed session
+    dir (e.g. /tmp on ext4) turns every fresh-page write into filesystem
+    block allocation + writeback — measured 5-20x slower cold puts (the
+    r3 microbench's 86x put/get asymmetry was exactly this). Override
+    with RAY_TPU_STORE_DIR."""
+    override = os.environ.get("RAY_TPU_STORE_DIR")
+    if override:
+        return override
+    shm = "/dev/shm"
+    if os.path.isdir(shm) and os.access(shm, os.W_OK):
+        return shm
+    return session_dir
+
+
 class WorkerHandle:
     def __init__(self, worker_id: bytes, proc: subprocess.Popen):
         self.worker_id = worker_id
@@ -79,7 +95,7 @@ class Raylet:
         self.server = RpcServer(host, 0)
         self.server.register_all(self)
         self.store_path = os.path.join(
-            session_dir, f"store_{self.node_id.hex()[:12]}.shm")
+            _store_dir(session_dir), f"store_{self.node_id.hex()[:12]}.shm")
         self.object_store_memory = object_store_memory
         self.store: Optional[ObjectStore] = None
         self.gcs: Optional[RpcClient] = None

