@@ -177,6 +177,7 @@ class DashboardHead:
             web.get("/api/timeline", self.timeline),
             web.get("/api/placement_groups", self.placement_groups),
             web.get("/api/cluster_resources", self.cluster_resources),
+            web.get("/api/serve", self.serve_deployments),
             web.get("/api/tasks", self.tasks),
             web.get("/metrics", self.metrics),
             web.post("/api/jobs/", self.job_submit),
@@ -331,6 +332,16 @@ class DashboardHead:
             for k, v in n.get("available", {}).items():
                 avail[k] = avail.get(k, 0.0) + v
         return _json({"total": total, "available": avail})
+
+    async def serve_deployments(self, request):
+        """Serve deployments view: the controller snapshots its state into
+        the GCS KV on every change (reference: dashboard serve module)."""
+        import json as json_mod
+
+        reply = await self.gcs.call("kv_get", key=b"serve:deployments")
+        blob = reply.get("value")
+        return _json({"deployments":
+                      json_mod.loads(blob) if blob else []})
 
     async def metrics(self, request):
         """Aggregate app metrics pushed to the KV by util.metrics plus a few

@@ -68,20 +68,30 @@ def block_from_batch(batch: Union[Batch, "pa.Table", Any]) -> Block:
     if hasattr(batch, "to_dict") and type(batch).__module__.startswith("pandas"):
         return pa.Table.from_pandas(batch, preserve_index=False)
     if isinstance(batch, dict):
-        arrays = {}
+        import json as json_mod
+
+        fields, arrays = [], []
         for k, v in batch.items():
             v = np.asarray(v)
+            meta = None
             if v.ndim > 1:
-                # Tensor columns: fixed-shape lists.
-                arrays[k] = pa.FixedSizeListArray.from_arrays(
+                # Tensor columns: fixed-shape lists; the per-cell shape
+                # rides the field metadata so (n, d1, d2, ...) columns
+                # round-trip SHAPED (not flattened to (n, prod)).
+                arr = pa.FixedSizeListArray.from_arrays(
                     pa.array(v.reshape(-1)), int(np.prod(v.shape[1:])))
+                if v.ndim > 2:
+                    meta = {b"cell_shape":
+                            json_mod.dumps(list(v.shape[1:])).encode()}
             elif (v.dtype == object and len(v)
                   and isinstance(v[0], np.ndarray)):
                 # Array-valued cells (possibly ragged shapes).
-                arrays[k] = _ndarray_cells_to_arrow(v)
+                arr = _ndarray_cells_to_arrow(v)
             else:
-                arrays[k] = pa.array(v)
-        return pa.table(arrays)
+                arr = pa.array(v)
+            fields.append(pa.field(k, arr.type, metadata=meta))
+            arrays.append(arr)
+        return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
     raise TypeError(f"cannot make a block from {type(batch)}")
 
 
@@ -120,13 +130,20 @@ class BlockAccessor:
         return self.block.schema
 
     def to_batch(self) -> Batch:
+        import json as json_mod
+
         out: Batch = {}
         for name in self.block.column_names:
             col = self.block.column(name)
             if pa.types.is_fixed_size_list(col.type):
                 flat = col.combine_chunks().flatten()
                 width = col.type.list_size
-                out[name] = np.asarray(flat).reshape(-1, width)
+                arr = np.asarray(flat).reshape(-1, width)
+                field = self.block.schema.field(name)
+                if field.metadata and b"cell_shape" in field.metadata:
+                    shape = json_mod.loads(field.metadata[b"cell_shape"])
+                    arr = arr.reshape((-1,) + tuple(shape))
+                out[name] = arr
             elif isinstance(col.type, NdarrayType):
                 out[name] = _arrow_to_ndarray_cells(col)
             else:

@@ -259,6 +259,15 @@ class Dataset:
     def write_avro(self, path: str) -> List[str]:
         return self._write(path, "write_avro_block")
 
+    def write_orc(self, path: str) -> List[str]:
+        return self._write(path, "write_orc_block")
+
+    def write_feather(self, path: str) -> List[str]:
+        return self._write(path, "write_feather_block")
+
+    def write_text(self, path: str) -> List[str]:
+        return self._write(path, "write_text_block")
+
     # ---- train ingestion -------------------------------------------------
 
     def streaming_split(self, n: int) -> List["DataIterator"]:
@@ -407,6 +416,35 @@ def read_tfrecords(paths, *, parallelism: int = 8) -> Dataset:
 def read_avro(paths, *, parallelism: int = 8) -> Dataset:
     return Dataset([plan_mod.Read(
         ds_mod.AvroDatasource(paths), parallelism)], parallelism)
+
+
+def read_orc(paths, *, parallelism: int = 8) -> Dataset:
+    return Dataset([plan_mod.Read(ds_mod.ORCDatasource(paths), parallelism)],
+                   parallelism)
+
+
+def read_feather(paths, *, parallelism: int = 8) -> Dataset:
+    """Arrow IPC / Feather v2 (reference: read_api.read_feather)."""
+    return Dataset([plan_mod.Read(
+        ds_mod.FeatherDatasource(paths), parallelism)], parallelism)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = 8) -> Dataset:
+    """Rows of index-filled ndarrays (reference: read_api.range_tensor,
+    the standard data-benchmark source)."""
+    return Dataset([plan_mod.Read(
+        ds_mod.RangeTensorDatasource(n, shape), parallelism)], parallelism)
+
+
+def from_jax(arrays, *, parallelism: int = 8) -> Dataset:
+    """jax.Arrays -> Dataset (device -> host once, then Arrow blocks).
+    TPU-native addition: training evals feed straight from device output."""
+    import numpy as _np
+
+    if not isinstance(arrays, dict):
+        arrays = {"data": arrays}
+    host = {k: _np.asarray(v) for k, v in arrays.items()}
+    return from_numpy(host, parallelism=parallelism)
 
 
 def from_arrow(tables, *, parallelism: int = 8) -> Dataset:

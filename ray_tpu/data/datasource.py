@@ -124,6 +124,47 @@ class JSONDatasource(_FileDatasource):
         return pajson.read_json(path)
 
 
+class ORCDatasource(_FileDatasource):
+    def _read_file(self, path):
+        from pyarrow import orc as paorc
+
+        return paorc.read_table(path)
+
+
+class FeatherDatasource(_FileDatasource):
+    """Arrow IPC / Feather v2 files (reference: read_api.read_feather)."""
+
+    def _read_file(self, path):
+        from pyarrow import feather as pafeather
+
+        return pafeather.read_table(path)
+
+
+class RangeTensorDatasource(Datasource):
+    """range_tensor(n, shape): each row is an ndarray of `shape` filled
+    with its index (reference read_api.range_tensor — the standard data
+    benchmark source)."""
+
+    def __init__(self, n: int, shape):
+        self.n = n
+        self.shape = tuple(shape)
+
+    def read_tasks(self, parallelism, limit):
+        n = self.n if limit is None else min(self.n, limit)
+
+        def make(lo, hi):
+            def read():
+                # Row cells are SHAPED ndarrays (NdarrayType extension
+                # column), matching the reference's tensor-row semantics.
+                return block_from_rows([
+                    {"data": np.full(self.shape, i, dtype=np.int64)}
+                    for i in range(lo, hi)])
+
+            return read
+
+        return [make(lo, hi) for lo, hi in _partition(n, parallelism)]
+
+
 # ---- write path (per-block writers used by Dataset.write_*) --------------
 
 def write_parquet_block(block, path: str, index: int) -> str:
@@ -143,6 +184,41 @@ def write_csv_block(block, path: str, index: int) -> str:
 
     out = os.path.join(path, f"part-{index:05d}.csv")
     pacsv.write_csv(block, out)
+    return out
+
+
+def write_orc_block(block, path: str, index: int) -> str:
+    import os
+
+    import pyarrow.orc as paorc
+
+    out = os.path.join(path, f"part-{index:05d}.orc")
+    paorc.write_table(block, out)
+    return out
+
+
+def write_feather_block(block, path: str, index: int) -> str:
+    import os
+
+    import pyarrow.feather as pafeather
+
+    out = os.path.join(path, f"part-{index:05d}.feather")
+    pafeather.write_feather(block, out)
+    return out
+
+
+def write_text_block(block, path: str, index: int) -> str:
+    """One line per row of the first (string) column."""
+    import os
+
+    from ray_tpu.data.block import BlockAccessor
+
+    out = os.path.join(path, f"part-{index:05d}.txt")
+    batch = BlockAccessor(block).to_batch()
+    col = next(iter(batch.values()))
+    with open(out, "w") as f:
+        for v in col:
+            f.write(str(v) + "\n")
     return out
 
 

@@ -320,6 +320,40 @@ class GcsServer:
             reply["view"] = self._view_deltas(known_version, known_epoch)
         return reply
 
+    async def handle_node_heartbeat2(self, conn, m: bytes):
+        """Typed-schema heartbeat (runtime/wire.py HeartbeatMsg in,
+        ViewDeltaMsg out): the cross-version-evolvable twin of
+        node_heartbeat. New fields on either message are invisible to old
+        peers (unknown field numbers skip on decode); removed ones decode
+        to defaults — protobuf evolution rules without the compiler."""
+        from ray_tpu.runtime import wire
+
+        hb = wire.HeartbeatMsg.decode(m)
+        reply = await self.handle_node_heartbeat(
+            conn, hb.node_id, available=hb.available or None,
+            backlog=hb.backlog,
+            known_version=hb.known_version if hb.known_version >= 0 else None,
+            known_epoch=hb.known_epoch or None)
+        view = reply.pop("view", None)
+        if view is not None:
+            nodes_key = "full" if "full" in view else "deltas"
+            msg = wire.ViewDeltaMsg(
+                version=view["version"], epoch=view.get("epoch") or "",
+                is_full=nodes_key == "full")
+            encoded = [wire.NodeInfoMsg(
+                node_id=n["node_id"], host=n["address"][0],
+                port=int(n["address"][1]), resources=n["resources"],
+                available=n["available"], labels=n["labels"],
+                is_head=n["is_head"], alive=n["alive"],
+                object_store_path=n["object_store_path"])
+                for n in view[nodes_key]]
+            if nodes_key == "full":
+                msg.full = encoded
+            else:
+                msg.deltas = encoded
+            reply["view"] = msg.encode()
+        return reply
+
     async def handle_get_nodes(self, conn, only_alive=True):
         return [n.view() for n in self._nodes.values() if n.alive or not only_alive]
 

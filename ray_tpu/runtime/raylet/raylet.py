@@ -213,14 +213,40 @@ class Raylet:
         # src/ray/common/ray_syncer/). An idle cluster exchanges no node
         # data at all; a full snapshot only flows on first sync or after
         # falling behind the GCS's capped change log.
+        from ray_tpu.runtime import wire
+        from ray_tpu.runtime.rpc import RpcError
+
+        use_typed = True
         while not self._shutdown.is_set():
             try:
-                reply = await self.gcs.call(
-                    "node_heartbeat", node_id=self.node_id,
-                    available=self.available,
-                    backlog=self._backlog(),
-                    known_version=self._view_version,
-                    known_epoch=self._view_epoch)
+                # Typed-schema heartbeat (wire.HeartbeatMsg/ViewDeltaMsg):
+                # structure evolves per-field across versions instead of
+                # all-or-nothing pickled dicts. Falls back to the legacy
+                # handler against an older GCS (the rolling-upgrade case
+                # the schema exists for).
+                if use_typed:
+                    hb = wire.HeartbeatMsg(
+                        node_id=self.node_id,
+                        available=dict(self.available),
+                        known_version=self._view_version,
+                        known_epoch=self._view_epoch or "",
+                        backlog=self._backlog())
+                    try:
+                        reply = await self.gcs.call("node_heartbeat2",
+                                                    m=hb.encode())
+                    except RpcError as e:
+                        if "no handler" not in str(e):
+                            raise
+                        logger.warning("GCS lacks node_heartbeat2; "
+                                       "falling back to legacy heartbeat")
+                        use_typed = False
+                        continue
+                else:
+                    reply = await self.gcs.call(
+                        "node_heartbeat", node_id=self.node_id,
+                        available=self.available, backlog=self._backlog(),
+                        known_version=self._view_version,
+                        known_epoch=self._view_epoch)
                 if reply.get("unknown"):
                     # Restarted GCS lost us (no durable storage): re-register.
                     await self._on_gcs_reconnect(self.gcs)
@@ -228,11 +254,38 @@ class Raylet:
                     self._view_epoch = None
                     self._view_nodes.clear()
                 else:
-                    self._apply_view(reply.get("view"))
+                    view = reply.get("view")
+                    if use_typed:
+                        view = self._decode_view(view)
+                    self._apply_view(view)
             except Exception:
                 pass
             from ray_tpu.config import cfg
             await asyncio.sleep(cfg().heartbeat_interval_s)
+
+    @staticmethod
+    def _decode_view(encoded) -> Optional[dict]:
+        if not encoded:
+            return None
+        from ray_tpu.runtime import wire
+
+        msg = wire.ViewDeltaMsg.decode(encoded)
+
+        def node_dict(n):
+            return {"node_id": n.node_id, "address": (n.host, n.port),
+                    "resources": n.resources, "available": n.available,
+                    "labels": n.labels, "is_head": n.is_head,
+                    "alive": n.alive,
+                    "object_store_path": n.object_store_path}
+
+        view = {"version": msg.version, "epoch": msg.epoch or None}
+        nodes = [node_dict(n) for n in (msg.full if msg.is_full
+                                        else msg.deltas)]
+        if msg.is_full:
+            view["full"] = nodes
+        else:
+            view["deltas"] = nodes
+        return view
 
     def _apply_view(self, view: Optional[dict]):
         if not view:

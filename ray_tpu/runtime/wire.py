@@ -1,0 +1,330 @@
+"""Typed wire schema: versioned message structs over the RPC frame.
+
+Reference analog: src/ray/protobuf/ (21 .proto files) — the property that
+matters is CROSS-VERSION MESSAGE EVOLUTION: a v(N+1) process can add
+fields without breaking v(N) peers, and decoding never depends on both
+sides agreeing on the full field set. The pickle wire gave structure no
+schema; this module adds protobuf's evolution rules without a compiler:
+
+  * messages declare numbered, typed fields (number = wire identity;
+    renames are free, numbers are forever);
+  * encoding is field-tagged TLV — unknown field numbers are SKIPPED on
+    decode (forward compatibility: old readers tolerate new writers);
+  * absent fields decode to their declared defaults (backward
+    compatibility: new readers tolerate old writers);
+  * nested messages, lists, and string-keyed maps compose; ANY is the
+    audited pickle escape hatch for payloads that are genuinely code
+    (task args), not schema.
+
+Frame integration: an encoded message travels as one `bytes` value inside
+the existing authenticated frame (runtime/rpc.py adds transport auth/MAC;
+this layer adds structure). Handlers opt in per message type.
+
+Wire format per field:  [u32 field_no << 3 | wire_type][u32 length][payload]
+Message = concatenation of encoded fields, any order.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+# wire types (3 bits)
+_WT_VARBYTES = 0   # length-delimited scalar payload (int/float/str/bytes/bool)
+_WT_MSG = 1        # nested message
+_WT_LIST = 2       # repeated inner type
+_WT_MAP = 3        # string-keyed map of inner type
+_WT_ANY = 4        # pickled (escape hatch)
+
+_TAG = struct.Struct("<I")
+_LEN = struct.Struct("<I")
+
+
+class FieldType:
+    """Scalar/composite field type descriptors."""
+
+    def __init__(self, kind: str, inner: Any = None):
+        self.kind = kind
+        self.inner = inner
+
+    def __repr__(self):
+        return f"FieldType({self.kind})"
+
+
+INT = FieldType("int")
+FLOAT = FieldType("float")
+BOOL = FieldType("bool")
+STR = FieldType("str")
+BYTES = FieldType("bytes")
+ANY = FieldType("any")
+
+
+def LIST(inner) -> FieldType:  # noqa: N802 (schema DSL)
+    return FieldType("list", inner)
+
+
+def MAP(inner) -> FieldType:  # noqa: N802
+    return FieldType("map", inner)
+
+
+def MSG(msg_cls) -> FieldType:  # noqa: N802
+    return FieldType("msg", msg_cls)
+
+
+class Field:
+    __slots__ = ("number", "type", "default")
+
+    def __init__(self, number: int, ftype: FieldType, default: Any = None):
+        if not 1 <= number < (1 << 29):
+            raise ValueError(f"field number out of range: {number}")
+        self.number = number
+        self.type = ftype
+        self.default = default
+
+
+def _default_for(f: Field):
+    if f.default is not None:
+        return f.default
+    return {"int": 0, "float": 0.0, "bool": False, "str": "",
+            "bytes": b"", "list": None, "map": None, "msg": None,
+            "any": None}[f.type.kind]
+
+
+class MessageMeta(type):
+    def __new__(mcls, name, bases, ns):
+        cls = super().__new__(mcls, name, bases, ns)
+        fields: Dict[str, Field] = {}
+        for base in bases:
+            fields.update(getattr(base, "_fields", {}))
+        numbers = {f.number for f in fields.values()}
+        for key, val in ns.items():
+            if isinstance(val, Field):
+                if val.number in numbers:
+                    raise TypeError(
+                        f"{name}.{key}: duplicate field number {val.number}")
+                numbers.add(val.number)
+                fields[key] = val
+        cls._fields = fields
+        cls._by_number = {f.number: (n, f) for n, f in fields.items()}
+        return cls
+
+
+class Message(metaclass=MessageMeta):
+    """Base class: subclass with `Field` class attributes.
+
+    >>> class Heartbeat(Message):
+    ...     node_id = Field(1, BYTES)
+    ...     available = Field(2, MAP(FLOAT))
+    """
+
+    _fields: Dict[str, Field] = {}
+    _by_number: Dict[int, Tuple[str, Field]] = {}
+
+    def __init__(self, **kwargs):
+        for name, f in self._fields.items():
+            if name in kwargs:
+                setattr(self, name, kwargs.pop(name))
+            else:
+                d = _default_for(f)
+                # Fresh containers per instance.
+                if f.type.kind == "list" and d is None:
+                    d = []
+                elif f.type.kind == "map" and d is None:
+                    d = {}
+                setattr(self, name, d)
+        if kwargs:
+            raise TypeError(
+                f"{type(self).__name__} has no fields {sorted(kwargs)}")
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and all(getattr(self, n) == getattr(other, n)
+                        for n in self._fields))
+
+    def __repr__(self):
+        body = ", ".join(f"{n}={getattr(self, n)!r}" for n in self._fields)
+        return f"{type(self).__name__}({body})"
+
+    # -- encode ------------------------------------------------------------
+
+    def encode(self) -> bytes:
+        out: List[bytes] = []
+        for name, f in self._fields.items():
+            value = getattr(self, name)
+            if value is None:
+                continue
+            out.append(_encode_field(f.number, f.type, value))
+        return b"".join(out)
+
+    @classmethod
+    def decode(cls, data) -> "Message":
+        view = memoryview(data)
+        msg = cls()
+        off = 0
+        end = len(view)
+        while off < end:
+            (tag,) = _TAG.unpack_from(view, off)
+            (length,) = _LEN.unpack_from(view, off + 4)
+            off += 8
+            payload = view[off:off + length]
+            off += length
+            number, wt = tag >> 3, tag & 7
+            entry = cls._by_number.get(number)
+            if entry is None:
+                continue  # unknown field from a newer writer: SKIP
+            name, f = entry
+            try:
+                setattr(msg, name, _decode_value(f.type, wt, payload))
+            except Exception:
+                # Type mismatch across versions: keep the default rather
+                # than failing the whole message.
+                continue
+        return msg
+
+
+def _encode_scalar(ftype: FieldType, value) -> bytes:
+    k = ftype.kind
+    if k == "int":
+        return struct.pack("<q", value)
+    if k == "float":
+        return struct.pack("<d", value)
+    if k == "bool":
+        return b"\x01" if value else b"\x00"
+    if k == "str":
+        return value.encode()
+    if k == "bytes":
+        return bytes(value)
+    raise TypeError(f"not a scalar: {k}")
+
+
+def _decode_scalar(ftype: FieldType, payload: memoryview):
+    k = ftype.kind
+    if k == "int":
+        return struct.unpack("<q", payload)[0]
+    if k == "float":
+        return struct.unpack("<d", payload)[0]
+    if k == "bool":
+        return payload != b"\x00" and bytes(payload) != b"\x00"
+    if k == "str":
+        return str(payload, "utf-8")
+    if k == "bytes":
+        return bytes(payload)
+    raise TypeError(f"not a scalar: {k}")
+
+
+def _wire_type(ftype: FieldType) -> int:
+    return {"int": _WT_VARBYTES, "float": _WT_VARBYTES,
+            "bool": _WT_VARBYTES, "str": _WT_VARBYTES,
+            "bytes": _WT_VARBYTES, "msg": _WT_MSG, "list": _WT_LIST,
+            "map": _WT_MAP, "any": _WT_ANY}[ftype.kind]
+
+
+def _encode_payload(ftype: FieldType, value) -> bytes:
+    k = ftype.kind
+    if k == "msg":
+        return value.encode()
+    if k == "list":
+        parts = []
+        for item in value:
+            p = _encode_payload(ftype.inner, item)
+            parts.append(_LEN.pack(len(p)))
+            parts.append(p)
+        return b"".join(parts)
+    if k == "map":
+        parts = []
+        for key, item in value.items():
+            kb = key.encode()
+            p = _encode_payload(ftype.inner, item)
+            parts.append(_LEN.pack(len(kb)))
+            parts.append(kb)
+            parts.append(_LEN.pack(len(p)))
+            parts.append(p)
+        return b"".join(parts)
+    if k == "any":
+        return pickle.dumps(value, protocol=5)
+    return _encode_scalar(ftype, value)
+
+
+def _encode_field(number: int, ftype: FieldType, value) -> bytes:
+    payload = _encode_payload(ftype, value)
+    return (_TAG.pack((number << 3) | _wire_type(ftype))
+            + _LEN.pack(len(payload)) + payload)
+
+
+def _decode_payload(ftype: FieldType, payload: memoryview):
+    k = ftype.kind
+    if k == "msg":
+        return ftype.inner.decode(payload)
+    if k == "list":
+        out = []
+        off = 0
+        while off < len(payload):
+            (ln,) = _LEN.unpack_from(payload, off)
+            off += 4
+            out.append(_decode_payload(ftype.inner, payload[off:off + ln]))
+            off += ln
+        return out
+    if k == "map":
+        out = {}
+        off = 0
+        while off < len(payload):
+            (kl,) = _LEN.unpack_from(payload, off)
+            off += 4
+            key = str(payload[off:off + kl], "utf-8")
+            off += kl
+            (vl,) = _LEN.unpack_from(payload, off)
+            off += 4
+            out[key] = _decode_payload(ftype.inner, payload[off:off + vl])
+            off += vl
+        return out
+    if k == "any":
+        return pickle.loads(payload)
+    return _decode_scalar(ftype, payload)
+
+
+def _decode_value(ftype: FieldType, wire_type: int, payload: memoryview):
+    if wire_type != _wire_type(ftype):
+        raise TypeError("wire type mismatch")
+    return _decode_payload(ftype, payload)
+
+
+# --------------------------------------------------------------- schemas
+#
+# Core control-plane DTOs (the gcs_service.proto / node_manager.proto
+# analogs). Field numbers are FOREVER: never reuse a number, only add.
+
+class NodeInfoMsg(Message):
+    node_id = Field(1, BYTES)
+    host = Field(2, STR)
+    port = Field(3, INT)
+    resources = Field(4, MAP(FLOAT))
+    available = Field(5, MAP(FLOAT))
+    labels = Field(6, MAP(STR))
+    is_head = Field(7, BOOL)
+    alive = Field(8, BOOL, default=True)
+    object_store_path = Field(9, STR)
+
+
+class HeartbeatMsg(Message):
+    node_id = Field(1, BYTES)
+    available = Field(2, MAP(FLOAT))
+    known_version = Field(3, INT, default=-1)
+    known_epoch = Field(4, STR)
+    backlog = Field(5, ANY)   # per-class demand shapes (advisory)
+
+
+class ViewDeltaMsg(Message):
+    version = Field(1, INT)
+    epoch = Field(2, STR)
+    full = Field(3, LIST(MSG(NodeInfoMsg)))
+    deltas = Field(4, LIST(MSG(NodeInfoMsg)))
+    is_full = Field(5, BOOL)
+
+
+class LeaseRequestMsg(Message):
+    resources = Field(1, MAP(FLOAT))
+    for_actor = Field(2, BOOL)
+    placement_group_id = Field(3, BYTES)
+    bundle_index = Field(4, INT, default=-1)
+    runtime_env_hash = Field(5, BYTES)

@@ -67,6 +67,7 @@ class ServeController:
                 "version": version, "target_payload": target_payload,
                 "init_args": init_args, "init_kwargs": init_kwargs}
         self._publish(name, version, "deployed")
+        self._snapshot_to_kv()
         return True
 
     @staticmethod
@@ -75,6 +76,28 @@ class ServeController:
         from ray_tpu.serve.config_watcher import publish_change
 
         publish_change(name, version, event)
+
+    def _snapshot_to_kv(self):
+        """Dashboard feed: deployment status snapshot in the GCS KV
+        (reference: dashboard serve module reads controller state)."""
+        import json as json_mod
+
+        try:
+            from ray_tpu.core.worker import global_worker
+
+            with self._lock:  # autoscaler thread mutates concurrently
+                snap = [{"name": k, "num_replicas": len(v["replicas"]),
+                         "version": v["version"],
+                         "autoscaling": bool(v["config"].get("autoscaling")),
+                         "max_ongoing_requests":
+                             v["config"].get("max_ongoing_requests", 16)}
+                        for k, v in self.deployments.items()]
+            core = global_worker()
+            core.io.spawn(core.gcs.call(
+                "kv_put", key=b"serve:deployments",
+                value=json_mod.dumps(snap).encode(), overwrite=True))
+        except Exception:
+            pass
 
     # ---- autoscaling (autoscaling_policy.py analog) ----------------------
 
@@ -190,6 +213,7 @@ class ServeController:
                 d["version"] = self.version
                 new_version = self.version
             self._publish(name, new_version, "scaled_up")
+            self._snapshot_to_kv()
         else:
             with self._lock:
                 d = self.deployments.get(name)
@@ -201,6 +225,7 @@ class ServeController:
                 d["version"] = self.version
                 new_version = self.version
             self._publish(name, new_version, "scaled_down")
+            self._snapshot_to_kv()
             for r in victims:
                 try:
                     ray_tpu.kill(r)
@@ -230,6 +255,7 @@ class ServeController:
                 pass
         self.version += 1
         self._publish(name, self.version, "deleted")
+        self._snapshot_to_kv()
         return True
 
     def global_version(self) -> int:

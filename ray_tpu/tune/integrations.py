@@ -1,0 +1,155 @@
+"""External searcher integrations: Optuna / HyperOpt adapters.
+
+Reference analog: python/ray/tune/search/optuna/optuna_search.py:1 and
+search/hyperopt/hyperopt_search.py — thin adapters translating between the
+external library's ask/tell interface and tune's Searcher protocol
+(suggest/on_trial_complete). Both libraries are OPTIONAL: the adapters
+import lazily and raise a clear error naming the native fallback
+(TPESearcher covers the hyperopt/optuna-TPE role without the dependency).
+
+Search-space translation: tune Domains map onto the library's native
+distributions (uniform/loguniform/randint/choice), so library-side
+samplers see the true space, not a flattened one.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from ray_tpu.tune.search import (Categorical, Domain, GridSearch,
+                                 LogUniform, RandInt, Searcher, Uniform)
+
+logger = logging.getLogger(__name__)
+
+
+def _missing(lib: str, pipname: str):
+    return ImportError(
+        f"{lib} is not installed; `pip install {pipname}` to use this "
+        "searcher, or use the dependency-free native TPESearcher "
+        "(ray_tpu.tune.search.TPESearcher) which covers the TPE role")
+
+
+class OptunaSearch(Searcher):
+    """Optuna ask/tell adapter (reference: OptunaSearch).
+
+    Each tune trial is one optuna trial: suggest() calls study.ask() and
+    samples the translated space; on_trial_complete() tells the result.
+    """
+
+    def __init__(self, param_space: Dict, metric: str, mode: str = "max",
+                 *, sampler=None, seed: Optional[int] = None):
+        try:
+            import optuna
+        except ImportError as e:
+            raise _missing("optuna", "optuna") from e
+        assert mode in ("max", "min")
+        self._optuna = optuna
+        self.space = param_space
+        self.metric = metric
+        optuna.logging.set_verbosity(optuna.logging.WARNING)
+        self.study = optuna.create_study(
+            direction="maximize" if mode == "max" else "minimize",
+            sampler=sampler or optuna.samplers.TPESampler(seed=seed))
+        self._trials: Dict[str, object] = {}
+
+    def _sample(self, trial, name: str, dom):
+        if isinstance(dom, GridSearch):
+            return trial.suggest_categorical(name, list(dom.values))
+        if isinstance(dom, LogUniform):
+            return trial.suggest_float(name, dom.low, dom.high, log=True)
+        if isinstance(dom, Uniform):
+            return trial.suggest_float(name, dom.low, dom.high)
+        if isinstance(dom, RandInt):
+            return trial.suggest_int(name, dom.low, dom.high - 1)
+        if isinstance(dom, Categorical):
+            return trial.suggest_categorical(name, list(dom.categories))
+        if isinstance(dom, Domain):
+            raise ValueError(f"unsupported domain {type(dom).__name__}")
+        return dom  # constant
+
+    def suggest(self, trial_id: str) -> Dict:
+        trial = self.study.ask()
+        self._trials[trial_id] = trial
+        return {k: self._sample(trial, k, v) for k, v in self.space.items()}
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict]):
+        trial = self._trials.pop(trial_id, None)
+        if trial is None:
+            return
+        value = (result or {}).get(self.metric)
+        state = self._optuna.trial.TrialState.COMPLETE
+        if value is None:
+            state = self._optuna.trial.TrialState.FAIL
+        self.study.tell(trial, value, state=state)
+
+
+class HyperOptSearch(Searcher):
+    """hyperopt TPE adapter (reference: HyperOptSearch)."""
+
+    def __init__(self, param_space: Dict, metric: str, mode: str = "max",
+                 *, seed: Optional[int] = None):
+        try:
+            import hyperopt
+            from hyperopt import hp
+        except ImportError as e:
+            raise _missing("hyperopt", "hyperopt") from e
+        assert mode in ("max", "min")
+        import numpy as np
+
+        self._hpo = hyperopt
+        self.metric = metric
+        self.mode = mode
+        self.space = {}
+        for k, dom in param_space.items():
+            if isinstance(dom, GridSearch):
+                self.space[k] = hp.choice(k, list(dom.values))
+            elif isinstance(dom, LogUniform):
+                self.space[k] = hp.loguniform(
+                    k, np.log(dom.low), np.log(dom.high))
+            elif isinstance(dom, Uniform):
+                self.space[k] = hp.uniform(k, dom.low, dom.high)
+            elif isinstance(dom, RandInt):
+                self.space[k] = hp.randint(k, dom.low, dom.high)
+            elif isinstance(dom, Categorical):
+                self.space[k] = hp.choice(k, list(dom.categories))
+            elif isinstance(dom, Domain):
+                raise ValueError(f"unsupported domain {type(dom).__name__}")
+            else:
+                self.space[k] = dom
+        self.trials = hyperopt.Trials()
+        self.domain = hyperopt.Domain(lambda c: 0.0, self.space)
+        self.rng = np.random.default_rng(seed)
+        self._tids: Dict[str, int] = {}
+        self._next_tid = 0
+
+    def suggest(self, trial_id: str) -> Dict:
+        import numpy as np
+
+        tid = self._next_tid
+        self._next_tid += 1
+        seed = int(self.rng.integers(2 ** 31 - 1))
+        new = self._hpo.tpe.suggest(
+            [tid], self.domain, self.trials, seed)
+        self.trials.insert_trial_docs(new)
+        self.trials.refresh()
+        self._tids[trial_id] = tid
+        vals = {k: v[0] for k, v in new[0]["misc"]["vals"].items() if v}
+        cfg = self._hpo.space_eval(self.space, vals)
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict]):
+        tid = self._tids.pop(trial_id, None)
+        if tid is None:
+            return
+        value = (result or {}).get(self.metric)
+        for doc in self.trials.trials:
+            if doc["tid"] != tid:
+                continue
+            if value is None:
+                doc["state"] = self._hpo.JOB_STATE_ERROR
+            else:
+                loss = -value if self.mode == "max" else value
+                doc["result"] = {"loss": loss, "status": self._hpo.STATUS_OK}
+                doc["state"] = self._hpo.JOB_STATE_DONE
+        self.trials.refresh()

@@ -1,0 +1,22 @@
+"""Ray-Client-style proxy driver mode.
+
+Reference analog: python/ray/util/client/ (__init__.py:40 RayAPIStub,
+server/proxier.py) + src/ray/protobuf/ray_client.proto:325. A remote
+process connects to ONE endpoint on the head node; the DRIVER runs
+server-side (the proxy hosts a CoreWorker per client session), and the
+client speaks a small typed op set (put/get/wait/task/actor) over the
+authenticated RPC wire. Unlike attach-mode remote drivers
+(core/api.py remote_client), the client never needs reachability to
+raylets/workers — the proxy is the only ingress, which is the whole point
+of Ray Client (firewalled laptops, notebooks).
+
+Usage:
+    server:  started with the head node (client_server_port=...) or
+             ClientProxyServer(...).start()
+    client:  ray_tpu.init(address="client://HOST:PORT")
+"""
+
+from ray_tpu.util.client.client import ClientAPI, connect
+from ray_tpu.util.client.server import ClientProxyServer
+
+__all__ = ["ClientAPI", "ClientProxyServer", "connect"]

@@ -1,0 +1,228 @@
+"""Client side of the proxy-driver mode.
+
+Reference analog: python/ray/util/client/__init__.py:40 (RayAPIStub) and
+api.py — a thin typed facade whose refs/actors are OPAQUE IDS naming
+server-side handles. One authenticated connection to the proxy is the
+only network dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu.util.client.server import _ClientRefMarker
+
+
+class ClientObjectRef:
+    """Opaque handle to a server-side ObjectRef."""
+
+    __slots__ = ("id", "_api", "__weakref__")
+
+    def __init__(self, rid: bytes, api: "ClientAPI"):
+        self.id = rid
+        self._api = api
+
+    def __repr__(self):
+        return f"ClientObjectRef({self.id.hex()[:16]})"
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ClientObjectRef) and other.id == self.id
+
+    def __del__(self):
+        api = self._api
+        if api is not None and not api._closed:
+            api._queue_release(self.id)
+
+    def __reduce__(self):
+        raise TypeError(
+            "ClientObjectRef cannot be pickled directly; pass it as a task "
+            "argument instead")
+
+
+class _ClientActorMethod:
+    def __init__(self, api: "ClientAPI", actor_id: bytes, name: str):
+        self._api = api
+        self._actor_id = actor_id
+        self._name = name
+
+    def remote(self, *args, **kwargs) -> ClientObjectRef:
+        return self._api._actor_call(self._actor_id, self._name, args,
+                                     kwargs)
+
+
+class ClientActorHandle:
+    def __init__(self, api: "ClientAPI", actor_id: bytes):
+        self._api = api
+        self._actor_id = actor_id
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _ClientActorMethod(self._api, self._actor_id, item)
+
+
+class _ClientRemoteFn:
+    def __init__(self, api: "ClientAPI", fn, options: Optional[dict] = None):
+        self._api = api
+        self._fn = fn
+        self._options = options or {}
+        self._fn_id: Optional[bytes] = None
+
+    def options(self, **opts) -> "_ClientRemoteFn":
+        out = _ClientRemoteFn(self._api, self._fn, {**self._options, **opts})
+        out._fn_id = None  # per-options registration happens lazily
+        return out
+
+    def remote(self, *args, **kwargs) -> ClientObjectRef:
+        if self._fn_id is None:
+            reply = self._api._call(
+                "client_register_fn",
+                fn_blob=cloudpickle.dumps(self._fn), options={})
+            self._fn_id = reply["fn_id"]
+        return self._api._task(self._fn_id, args, kwargs,
+                               self._options or None)
+
+
+class _ClientActorClass:
+    def __init__(self, api: "ClientAPI", cls, options: Optional[dict] = None):
+        self._api = api
+        self._cls = cls
+        self._options = options or {}
+
+    def options(self, **opts) -> "_ClientActorClass":
+        return _ClientActorClass(self._api, self._cls,
+                                 {**self._options, **opts})
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        reply = self._api._call(
+            "client_actor_create", cls_blob=cloudpickle.dumps(self._cls),
+            args_blob=self._api._pack_args(args, kwargs),
+            options=self._options)
+        if "error" in reply:
+            raise RuntimeError(reply["error"])
+        return ClientActorHandle(self._api, reply["actor_id"])
+
+
+class ClientAPI:
+    """The `ray_tpu` surface over one proxy connection."""
+
+    def __init__(self, host: str, port: int):
+        from ray_tpu.runtime import rpc as rpc_mod
+        from ray_tpu.runtime.rpc import EventLoopThread, RpcClient
+
+        rpc_mod.load_token_for_address(host, port)
+        self.io = EventLoopThread("ray_tpu_client")
+        self._client = RpcClient(host, port, auto_reconnect=True)
+        self.io.run(self._client.connect(timeout=30))
+        self._closed = False
+        hello = self._call("client_hello")
+        self.client_id = hello["client_id"]
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _call(self, method: str, **kw):
+        reply = self.io.run(self._client.call(method, **kw), timeout=600)
+        return reply
+
+    def _queue_release(self, rid: bytes):
+        """Fire-and-forget server-side handle release."""
+        try:
+            self.io.spawn(self._client.call("client_release", refs=[rid]))
+        except Exception:
+            pass
+
+    def _pack_args(self, args: Tuple, kwargs: Dict) -> bytes:
+        def mark(v):
+            if isinstance(v, ClientObjectRef):
+                return _ClientRefMarker(v.id)
+            return v
+
+        return cloudpickle.dumps(
+            (tuple(mark(a) for a in args),
+             {k: mark(v) for k, v in kwargs.items()}))
+
+    def _task(self, fn_id: bytes, args, kwargs, options) -> ClientObjectRef:
+        reply = self._call("client_task", fn_id=fn_id,
+                           args_blob=self._pack_args(args, kwargs),
+                           options=options)
+        if "error" in reply:
+            raise RuntimeError(reply["error"])
+        return ClientObjectRef(reply["ref"], self)
+
+    def _actor_call(self, actor_id: bytes, method: str, args,
+                    kwargs) -> ClientObjectRef:
+        reply = self._call("client_actor_call", actor_id=actor_id,
+                           method_name=method,
+                           args_blob=self._pack_args(args, kwargs))
+        if "error" in reply:
+            raise RuntimeError(reply["error"])
+        return ClientObjectRef(reply["ref"], self)
+
+    # -- public api --------------------------------------------------------
+
+    def put(self, value: Any) -> ClientObjectRef:
+        from ray_tpu.core import serialization
+
+        segs, _total = serialization.serialize(value)
+        reply = self._call("client_put",
+                           payload=serialization.join_segments(segs))
+        return ClientObjectRef(reply["ref"], self)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        from ray_tpu.core import serialization
+
+        single = isinstance(refs, ClientObjectRef)
+        ref_list = [refs] if single else list(refs)
+        reply = self._call("client_get", refs=[r.id for r in ref_list],
+                           timeout_s=timeout)
+        if "error" in reply:
+            exc = reply.get("exception")
+            raise exc if isinstance(exc, BaseException) else RuntimeError(
+                reply["error"])
+        values = [serialization.deserialize(memoryview(v))
+                  for v in reply["values"]]
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ClientObjectRef], *, num_returns: int = 1,
+             timeout: Optional[float] = None):
+        by_id = {r.id: r for r in refs}
+        reply = self._call("client_wait", refs=[r.id for r in refs],
+                           num_returns=num_returns, timeout_s=timeout)
+        return ([by_id[r] for r in reply["ready"]],
+                [by_id[r] for r in reply["pending"]])
+
+    def remote(self, obj=None, **options):
+        if obj is None:
+            return lambda o: self.remote(o, **options)
+        if isinstance(obj, type):
+            return _ClientActorClass(self, obj, options or None)
+        return _ClientRemoteFn(self, obj, options or None)
+
+    def get_actor(self, name: str) -> ClientActorHandle:
+        reply = self._call("client_get_actor", name=name)
+        if "error" in reply:
+            raise ValueError(reply["error"])
+        return ClientActorHandle(self, reply["actor_id"])
+
+    def kill(self, handle: ClientActorHandle):
+        self._call("client_kill_actor", actor_id=handle._actor_id)
+
+    def disconnect(self):
+        self._closed = True
+        try:
+            self.io.run(self._client.close(), timeout=10)
+        except Exception:
+            pass
+        self.io.stop()
+
+
+def connect(address: str) -> ClientAPI:
+    """connect("host:port") or connect("client://host:port")."""
+    address = address.replace("client://", "")
+    host, port = address.rsplit(":", 1)
+    return ClientAPI(host, int(port))

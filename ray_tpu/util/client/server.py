@@ -1,0 +1,265 @@
+"""Client proxy server: hosts the driver for remote clients.
+
+Reference analog: python/ray/util/client/server/{server.py,proxier.py} —
+one server-side session per client connection, executing ray ops against
+an in-cluster driver and holding the object/actor references the client
+names by id. A dropped client connection tears its session down
+(reference: client disconnect reaps the proxied driver), releasing every
+reference it held.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class _Session:
+    """Per-client-connection state: named handles the client refers to."""
+
+    def __init__(self, client_id: str):
+        self.client_id = client_id
+        self.refs: Dict[bytes, Any] = {}      # ref id -> ObjectRef
+        self.actors: Dict[bytes, Any] = {}    # actor id -> ActorHandle
+        self.fns: Dict[bytes, Any] = {}       # fn id -> RemoteFunction
+
+
+class ClientProxyServer:
+    """RPC server for client sessions; runs inside a cluster-attached
+    process (the head driver, or a dedicated proxy process)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        from ray_tpu.runtime.rpc import RpcServer
+
+        self.server = RpcServer(host, port)
+        self.server.register_all(self, prefix="handle_")
+        self.server.on_disconnect = self._on_disconnect
+        # _sessions is confined to the IO loop (handlers + disconnect
+        # callbacks all run there): no lock needed.
+        self._sessions: Dict[int, _Session] = {}
+
+    def start(self):
+        from ray_tpu.core.worker import global_worker
+
+        core = global_worker()  # must be cluster-attached already
+        core.io.run(self.server.start())
+        return self.server.address
+
+    def stop(self):
+        from ray_tpu.core.worker import global_worker
+
+        try:
+            global_worker().io.run(self.server.close())
+        except Exception:
+            pass
+
+    # -- session plumbing --------------------------------------------------
+
+    def _session(self, conn) -> _Session:
+        key = id(conn)
+        s = self._sessions.get(key)
+        if s is None:
+            s = _Session(uuid.uuid4().hex[:12])
+            self._sessions[key] = s
+            conn.meta["client_session"] = s.client_id
+        return s
+
+    async def _on_disconnect(self, conn):
+        s = self._sessions.pop(id(conn), None)
+        if s is None:
+            return
+        # Dropping the session's handle dicts releases the proxied
+        # driver's references (ObjectRef __del__ -> ref_dropped).
+        logger.info("client session %s disconnected (%d refs, %d actors)",
+                    s.client_id, len(s.refs), len(s.actors))
+        s.refs.clear()
+        s.actors.clear()
+        s.fns.clear()
+
+    @staticmethod
+    def _run(fn, *args, **kwargs):
+        """User-facing ray ops are synchronous; run them off the IO loop."""
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(None, lambda: fn(*args, **kwargs))
+
+    # -- ops ---------------------------------------------------------------
+
+    async def handle_client_hello(self, conn):
+        import ray_tpu
+
+        s = self._session(conn)
+        # Off-loop: every ray op blocks on the core worker's IO loop, and
+        # these handlers RUN on that loop.
+        resources = await self._run(ray_tpu.cluster_resources)
+        return {"ok": True, "client_id": s.client_id,
+                "cluster_resources": resources}
+
+    async def handle_client_put(self, conn, payload: bytes):
+        import ray_tpu
+        from ray_tpu.core import serialization
+
+        s = self._session(conn)
+        value = serialization.deserialize(memoryview(payload))
+        ref = await self._run(ray_tpu.put, value)
+        rid = ref.id.binary() if hasattr(ref, "id") else ref.binary()
+        s.refs[rid] = ref
+        return {"ref": rid}
+
+    async def handle_client_get(self, conn, refs,
+                                timeout_s: Optional[float] = None):
+        import ray_tpu
+        from ray_tpu.core import serialization
+
+        s = self._session(conn)
+        try:
+            targets = [s.refs[r] for r in refs]
+        except KeyError as e:
+            return {"error": f"unknown ref {e}"}
+        try:
+            values = await self._run(ray_tpu.get, targets, timeout=timeout_s)
+        except Exception as e:
+            return {"error": repr(e), "exception": _safe_exc(e)}
+        return {"values": [serialization.join_segments(
+            serialization.serialize(v)[0]) for v in values]}
+
+    async def handle_client_wait(self, conn, refs, num_returns: int,
+                                 timeout_s: Optional[float] = None):
+        import ray_tpu
+
+        s = self._session(conn)
+        try:
+            targets = [s.refs[r] for r in refs]
+        except KeyError as e:
+            return {"error": f"unknown ref {e}"}
+        ready, pending = await self._run(
+            ray_tpu.wait, targets, num_returns=num_returns,
+            timeout=timeout_s)
+        by_obj = {id(s.refs[r]): r for r in refs}
+        return {"ready": [by_obj[id(o)] for o in ready],
+                "pending": [by_obj[id(o)] for o in pending]}
+
+    async def handle_client_register_fn(self, conn, fn_blob: bytes,
+                                        options: dict):
+        import cloudpickle
+
+        import ray_tpu
+
+        s = self._session(conn)
+        fn = cloudpickle.loads(fn_blob)
+        rf = ray_tpu.remote(fn)
+        if options:
+            rf = rf.options(**options)
+        fid = uuid.uuid4().bytes[:8]
+        s.fns[fid] = rf
+        return {"fn_id": fid}
+
+    def _resolve_args(self, s: _Session, args_blob: bytes):
+        import cloudpickle
+
+        args, kwargs = cloudpickle.loads(args_blob)
+
+        def resolve(v):
+            if isinstance(v, _ClientRefMarker):
+                return s.refs[v.ref_id]
+            return v
+
+        return ([resolve(a) for a in args],
+                {k: resolve(v) for k, v in kwargs.items()})
+
+    async def handle_client_task(self, conn, fn_id: bytes, args_blob: bytes,
+                                 options: Optional[dict] = None):
+        s = self._session(conn)
+        rf = s.fns.get(fn_id)
+        if rf is None:
+            return {"error": f"unknown fn {fn_id!r}"}
+        args, kwargs = self._resolve_args(s, args_blob)
+        target = rf.options(**options) if options else rf
+        ref = await self._run(target.remote, *args, **kwargs)
+        rid = ref.id.binary() if hasattr(ref, "id") else ref.binary()
+        s.refs[rid] = ref
+        return {"ref": rid}
+
+    async def handle_client_actor_create(self, conn, cls_blob: bytes,
+                                         args_blob: bytes, options: dict):
+        import cloudpickle
+
+        import ray_tpu
+
+        s = self._session(conn)
+        cls = cloudpickle.loads(cls_blob)
+        ac = ray_tpu.remote(cls)
+        if options:
+            ac = ac.options(**options)
+        args, kwargs = self._resolve_args(s, args_blob)
+        handle = await self._run(ac.remote, *args, **kwargs)
+        aid = handle._actor_id
+        s.actors[aid] = handle
+        return {"actor_id": aid}
+
+    async def handle_client_actor_call(self, conn, actor_id: bytes,
+                                       method_name: str, args_blob: bytes):
+        s = self._session(conn)
+        handle = s.actors.get(actor_id)
+        if handle is None:
+            return {"error": f"unknown actor {actor_id.hex()[:12]}"}
+        args, kwargs = self._resolve_args(s, args_blob)
+        ref = await self._run(
+            getattr(handle, method_name).remote, *args, **kwargs)
+        rid = ref.id.binary() if hasattr(ref, "id") else ref.binary()
+        s.refs[rid] = ref
+        return {"ref": rid}
+
+    async def handle_client_get_actor(self, conn, name: str,
+                                      namespace: Optional[str] = None):
+        import ray_tpu
+
+        s = self._session(conn)
+        try:
+            handle = await self._run(ray_tpu.get_actor, name)
+        except Exception as e:
+            return {"error": repr(e)}
+        s.actors[handle._actor_id] = handle
+        return {"actor_id": handle._actor_id}
+
+    async def handle_client_kill_actor(self, conn, actor_id: bytes):
+        import ray_tpu
+
+        s = self._session(conn)
+        handle = s.actors.pop(actor_id, None)
+        if handle is not None:
+            await self._run(ray_tpu.kill, handle)
+        return {"ok": handle is not None}
+
+    def session_count(self) -> int:
+        return len(self._sessions)
+
+    async def handle_client_release(self, conn, refs):
+        """Client-side ref went out of scope: drop the proxy's handle."""
+        s = self._session(conn)
+        for r in refs:
+            s.refs.pop(r, None)
+        return {"ok": True}
+
+
+def _safe_exc(e: BaseException):
+    import cloudpickle
+
+    try:
+        cloudpickle.dumps(e)
+        return e
+    except Exception:
+        return None
+
+
+class _ClientRefMarker:
+    """Placeholder for a client-held ref inside pickled task args."""
+
+    __slots__ = ("ref_id",)
+
+    def __init__(self, ref_id: bytes):
+        self.ref_id = ref_id

@@ -118,3 +118,55 @@ def test_read_images(cluster, tmp_path):
     assert rows[0]["image"].dtype == np.uint8
     assert rows[1]["image"].shape == (4, 4, 3)
     assert rows[1]["image"][0, 0, 0] == 50
+
+
+def test_orc_round_trip(cluster, tmp_path):
+    import ray_tpu.data as rd
+
+    ds = rd.from_items([{"a": i, "b": float(i) * 0.5} for i in range(50)])
+    files = ds.write_orc(str(tmp_path / "orc"))
+    assert files and all(f.endswith(".orc") for f in files)
+    back = rd.read_orc(str(tmp_path / "orc")).take_all()
+    assert sorted(r["a"] for r in back) == list(range(50))
+
+
+def test_feather_round_trip(cluster, tmp_path):
+    import ray_tpu.data as rd
+
+    ds = rd.from_items([{"x": i} for i in range(30)])
+    files = ds.write_feather(str(tmp_path / "fea"))
+    assert files and all(f.endswith(".feather") for f in files)
+    back = rd.read_feather(str(tmp_path / "fea")).take_all()
+    assert sorted(r["x"] for r in back) == list(range(30))
+
+
+def test_write_text(cluster, tmp_path):
+    import ray_tpu.data as rd
+
+    ds = rd.from_items([{"line": f"row-{i}"} for i in range(10)])
+    files = ds.write_text(str(tmp_path / "txt"))
+    lines = []
+    for f in sorted(files):
+        lines += open(f).read().splitlines()
+    assert sorted(lines) == [f"row-{i}" for i in range(10)]
+
+
+def test_range_tensor(cluster):
+    import ray_tpu.data as rd
+
+    ds = rd.range_tensor(20, shape=(2, 2), parallelism=4)
+    rows = ds.take_all()
+    assert len(rows) == 20
+    got = sorted(int(np.asarray(r["data"])[0, 0]) for r in rows)
+    assert got == list(range(20))
+    assert np.asarray(rows[0]["data"]).shape == (2, 2)
+
+
+def test_from_jax(cluster):
+    import jax.numpy as jnp
+
+    import ray_tpu.data as rd
+
+    ds = rd.from_jax({"v": jnp.arange(16)})
+    rows = ds.take_all()
+    assert sorted(int(r["v"]) for r in rows) == list(range(16))

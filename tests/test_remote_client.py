@@ -57,3 +57,100 @@ def test_remote_client_end_to_end():
         except Exception:
             pass
         cluster.shutdown()
+
+
+# ---- Ray-Client proxy mode (reference: util/client/, ray_client.proto) ---
+
+@pytest.fixture
+def client_proxy():
+    """Head-side cluster + ClientProxyServer; yields (address, proxy)."""
+    ray_tpu.init(num_cpus=2)
+    from ray_tpu.util.client import ClientProxyServer
+
+    proxy = ClientProxyServer(host="127.0.0.1")
+    addr = proxy.start()
+    yield addr, proxy
+    proxy.stop()
+    ray_tpu.shutdown()
+
+
+def test_client_proxy_round_trip(client_proxy):
+    """put/get/task/actor through the SINGLE proxy endpoint — the client
+    never touches GCS/raylet/worker addresses."""
+    from ray_tpu.util.client import connect
+
+    addr = client_proxy[0]
+    api = connect(f"{addr[0]}:{addr[1]}")
+    try:
+        # put/get
+        ref = api.put({"x": 41})
+        assert api.get(ref, timeout=30) == {"x": 41}
+
+        # tasks, including client-ref args
+        @api.remote
+        def add(a, b):
+            return a + b
+
+        r1 = add.remote(1, 2)
+        r2 = add.remote(r1, api.put(10))
+        assert api.get(r2, timeout=60) == 13
+
+        # wait
+        ready, pending = api.wait([r1, r2], num_returns=2, timeout=30)
+        assert len(ready) == 2 and not pending
+
+        # actors
+        @api.remote
+        class Counter:
+            def __init__(self, v):
+                self.v = v
+
+            def inc(self, d=1):
+                self.v += d
+                return self.v
+
+        c = Counter.remote(5)
+        assert api.get(c.inc.remote(), timeout=30) == 6
+        assert api.get(c.inc.remote(3), timeout=30) == 9
+        api.kill(c)
+    finally:
+        api.disconnect()
+
+
+def test_client_proxy_task_error_propagates(client_proxy):
+    from ray_tpu.util.client import connect
+
+    addr = client_proxy[0]
+    api = connect(f"{addr[0]}:{addr[1]}")
+    try:
+        @api.remote
+        def boom():
+            raise ValueError("client-task-fail")
+
+        with pytest.raises(Exception, match="client-task-fail"):
+            api.get(boom.remote(), timeout=60)
+    finally:
+        api.disconnect()
+
+
+def test_client_proxy_session_cleanup(client_proxy):
+    """Disconnecting a client reaps its server-side session (refs and all)."""
+    import time as _t
+
+    from ray_tpu.util.client import connect
+
+    addr, proxy = client_proxy
+    api = connect(f"{addr[0]}:{addr[1]}")
+    api.put(123)
+    assert proxy.session_count() == 1
+    api.disconnect()
+    deadline = _t.monotonic() + 10
+    while _t.monotonic() < deadline and proxy.session_count():
+        _t.sleep(0.05)
+    assert proxy.session_count() == 0, "session leaked after disconnect"
+    # The proxy still serves fresh, independent sessions.
+    api2 = connect(f"{addr[0]}:{addr[1]}")
+    try:
+        assert api2.get(api2.put("ok"), timeout=30) == "ok"
+    finally:
+        api2.disconnect()

@@ -87,3 +87,66 @@ def test_many_actors(cluster):
     assert vals == list(range(200))
     for a in actors:
         ray_tpu.kill(a)
+
+
+# ---- full reference magnitudes (slow; run with -m slow) ------------------
+#
+# The rows above keep CI fast two orders of magnitude down; these are the
+# REFERENCE-scale rows (release/benchmarks/README.md:27-31) on one box,
+# gated behind the slow marker.
+
+@pytest.mark.slow
+def test_reference_scale_queued_tasks(cluster):
+    """1,000,000 trivial tasks queued on one node all complete
+    (release/benchmarks/README.md:30)."""
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    n = 1_000_000
+    refs = [inc.remote(i) for i in range(n)]
+    assert len(refs) == n
+    # Sample-check completions across the whole range, then drain all.
+    out = ray_tpu.get(refs, timeout=5400)
+    assert len(out) == n
+    assert out[0] == 1 and out[n // 2] == n // 2 + 1 and out[-1] == n
+
+
+@pytest.mark.slow
+def test_reference_scale_args_to_single_task(cluster):
+    """10,000 object args resolve into one task
+    (release/benchmarks/README.md:27)."""
+
+    @ray_tpu.remote
+    def total(*parts):
+        return sum(parts)
+
+    parts = [ray_tpu.put(i) for i in range(10_000)]
+    assert ray_tpu.get(total.remote(*parts), timeout=1800) == \
+        sum(range(10_000))
+
+
+@pytest.mark.slow
+def test_reference_scale_returns_from_single_task(cluster):
+    """3,000 returns from one task (release/benchmarks/README.md:28)."""
+
+    @ray_tpu.remote(num_returns=3000)
+    def spread():
+        return tuple(range(3000))
+
+    refs = spread.remote()
+    assert len(refs) == 3000
+    vals = ray_tpu.get(refs, timeout=1800)
+    assert vals == list(range(3000))
+
+
+@pytest.mark.slow
+def test_reference_scale_objects_in_one_get(cluster):
+    """10,000 plasma-resident objects fetched in a single get
+    (release/benchmarks/README.md:29)."""
+    refs = [ray_tpu.put(np.full(16_000, i, dtype=np.int32))
+            for i in range(10_000)]
+    out = ray_tpu.get(refs, timeout=1800)
+    assert len(out) == 10_000
+    assert int(out[7777][0]) == 7777

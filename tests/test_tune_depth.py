@@ -238,3 +238,88 @@ def test_pb2_with_tuner(tmp_path):
     # Explored configs stayed inside the declared bounds.
     for tid, cfg in sched.configs.items():
         assert 0.05 <= cfg["lr"] <= 1.0
+
+
+# ---- external searcher adapters (reference: tune/search/optuna/) ---------
+
+def test_searcher_adapters_raise_helpfully_when_missing():
+    import importlib.util
+
+    from ray_tpu.tune import search as search_mod
+    from ray_tpu.tune.integrations import HyperOptSearch, OptunaSearch
+
+    space = {"lr": search_mod.LogUniform(1e-4, 1e-1)}
+    if importlib.util.find_spec("optuna") is None:
+        with pytest.raises(ImportError, match="TPESearcher"):
+            OptunaSearch(space, metric="score")
+    if importlib.util.find_spec("hyperopt") is None:
+        with pytest.raises(ImportError, match="TPESearcher"):
+            HyperOptSearch(space, metric="score")
+
+
+def test_optuna_adapter_protocol_with_fake(monkeypatch):
+    """Exercise the ask/tell adapter against a minimal fake optuna module:
+    domains translate to the right suggest_* calls and completions tell
+    the study."""
+    import sys
+    import types
+
+    calls = []
+
+    class FakeTrial:
+        def __init__(self, n):
+            self.n = n
+
+        def suggest_float(self, name, low, high, log=False):
+            calls.append(("float", name, low, high, log))
+            return low
+
+        def suggest_int(self, name, low, high):
+            calls.append(("int", name, low, high))
+            return low
+
+        def suggest_categorical(self, name, choices):
+            calls.append(("cat", name, tuple(choices)))
+            return choices[0]
+
+    class FakeStudy:
+        def __init__(self):
+            self.told = []
+            self._n = 0
+
+        def ask(self):
+            self._n += 1
+            return FakeTrial(self._n)
+
+        def tell(self, trial, value, state=None):
+            self.told.append((trial.n, value, state))
+
+    fake = types.ModuleType("optuna")
+    fake.create_study = lambda direction, sampler=None: FakeStudy()
+    fake.samplers = types.SimpleNamespace(
+        TPESampler=lambda seed=None: None)
+    fake.logging = types.SimpleNamespace(
+        set_verbosity=lambda v: None, WARNING=30)
+    fake.trial = types.SimpleNamespace(TrialState=types.SimpleNamespace(
+        COMPLETE="complete", FAIL="fail"))
+    monkeypatch.setitem(sys.modules, "optuna", fake)
+
+    from ray_tpu.tune import search as search_mod
+    from ray_tpu.tune.integrations import OptunaSearch
+
+    s = OptunaSearch({"lr": search_mod.LogUniform(1e-4, 1e-1),
+                      "layers": search_mod.RandInt(1, 5),
+                      "act": search_mod.Categorical(["relu", "tanh"]),
+                      "fixed": 7},
+                     metric="score", mode="max")
+    cfg = s.suggest("t1")
+    assert cfg["lr"] == pytest.approx(1e-4)
+    assert cfg["layers"] == 1 and cfg["act"] == "relu" and cfg["fixed"] == 7
+    assert ("float", "lr", 1e-4, 1e-1, True) in calls
+    assert ("int", "layers", 1, 4) in calls   # high is exclusive in tune
+    s.on_trial_complete("t1", {"score": 0.9})
+    assert s.study.told == [(1, 0.9, "complete")]
+    # Failed trial tells FAIL with no value.
+    s.suggest("t2")
+    s.on_trial_complete("t2", None)
+    assert s.study.told[-1] == (2, None, "fail")

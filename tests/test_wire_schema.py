@@ -1,0 +1,110 @@
+"""Typed wire schema: protobuf-style evolution without the compiler.
+
+Reference analog: src/ray/protobuf/ — the property under test is
+cross-version message evolution (new fields invisible to old readers;
+missing fields default for new readers).
+"""
+
+import pytest
+
+from ray_tpu.runtime import wire
+from ray_tpu.runtime.wire import (ANY, BOOL, BYTES, FLOAT, INT, LIST, MAP,
+                                  MSG, STR, Field, Message)
+
+
+class Inner(Message):
+    name = Field(1, STR)
+    weight = Field(2, FLOAT)
+
+
+class Outer(Message):
+    id = Field(1, BYTES)
+    count = Field(2, INT)
+    ok = Field(3, BOOL)
+    tags = Field(4, MAP(STR))
+    items = Field(5, LIST(MSG(Inner)))
+    blob = Field(6, ANY)
+
+
+def test_round_trip_all_types():
+    m = Outer(id=b"\x01\x02", count=-7, ok=True,
+              tags={"a": "x", "b": "y"},
+              items=[Inner(name="n1", weight=0.5),
+                     Inner(name="n2", weight=1.25)],
+              blob={"free": ["form", 1]})
+    back = Outer.decode(m.encode())
+    assert back == m
+
+
+def test_defaults_and_empty():
+    back = Outer.decode(Outer().encode())
+    assert back.count == 0 and back.ok is False and back.id == b""
+    assert back.tags == {} and back.items == [] and back.blob is None
+
+
+def test_forward_compat_unknown_fields_skipped():
+    """A NEWER writer adds field 9; an old reader must decode everything
+    else and ignore it."""
+
+    class OuterV2(Message):
+        id = Field(1, BYTES)
+        count = Field(2, INT)
+        extra = Field(9, STR)   # new in v2
+
+    data = OuterV2(id=b"x", count=3, extra="future-field").encode()
+    back = Outer.decode(data)
+    assert back.id == b"x" and back.count == 3
+    assert not hasattr(back, "extra")
+
+
+def test_backward_compat_missing_fields_default():
+    """An OLDER writer without field 3+ still decodes; absent fields take
+    declared defaults."""
+
+    class OuterV0(Message):
+        id = Field(1, BYTES)
+
+    back = Outer.decode(OuterV0(id=b"old").encode())
+    assert back.id == b"old"
+    assert back.count == 0 and back.tags == {} and back.items == []
+
+
+def test_type_change_degrades_to_default_not_crash():
+    """A field whose TYPE changed across versions decodes to the default
+    instead of poisoning the whole message."""
+
+    class Changed(Message):
+        id = Field(1, STR)       # was BYTES -> same wire type, decodes
+        count = Field(2, MAP(FLOAT))  # was INT -> wire type mismatch
+
+    data = Outer(id=b"abc", count=5).encode()
+    back = Changed.decode(data)
+    assert back.id == "abc"
+    assert back.count == {}  # mismatched wire type -> default, no raise
+
+
+def test_duplicate_field_numbers_rejected():
+    with pytest.raises(TypeError, match="duplicate field number"):
+        class Bad(Message):
+            a = Field(1, INT)
+            b = Field(1, STR)
+
+
+def test_core_schemas_round_trip():
+    hb = wire.HeartbeatMsg(node_id=b"n1", available={"CPU": 3.0},
+                           known_version=17, known_epoch="e1",
+                           backlog=[{"shape": {"CPU": 1.0}, "count": 2}])
+    back = wire.HeartbeatMsg.decode(hb.encode())
+    assert back == hb
+
+    node = wire.NodeInfoMsg(node_id=b"n1", host="10.0.0.1", port=7001,
+                            resources={"CPU": 8.0, "TPU": 4.0},
+                            available={"CPU": 2.0, "TPU": 4.0},
+                            labels={"tpu-pod-type": "v5e-16"},
+                            is_head=False, alive=True,
+                            object_store_path="/dev/shm/x")
+    delta = wire.ViewDeltaMsg(version=4, epoch="e1", deltas=[node],
+                              is_full=False)
+    back = wire.ViewDeltaMsg.decode(delta.encode())
+    assert back.version == 4 and len(back.deltas) == 1
+    assert back.deltas[0] == node
