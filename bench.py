@@ -571,9 +571,9 @@ def main():
     if on_tpu:
         # ~0.9B params: fits one 16GB v5e chip with bf16 params + adam
         # moments (mu bf16, nu fp32). remat_policy="dots" (save matmul
-        # outputs, recompute elementwise/scores) measured 45.1% MFU vs
-        # 42.9% under full remat in the r3 sweep; batch 6/8 exceed HBM
-        # under this policy.
+        # outputs, recompute elementwise/scores) beat full remat 45.1% vs
+        # 42.9% MFU in an interactive r3 sweep (driver-unverified); batch
+        # 6/8 exceed HBM under this policy.
         config = llama.LlamaConfig(
             vocab_size=32000, d_model=2048, n_layers=14, n_heads=16,
             n_kv_heads=8, d_ff=7168, max_seq=2048, remat_policy="dots")
