@@ -81,3 +81,37 @@ def test_sqlite_store_roundtrip(tmp_path):
     s2 = SqliteStoreClient(str(tmp_path / "gcs.db"))
     assert s2.get("kv", b"ab") == b"2"
     s2.close()
+
+
+def test_pending_placement_group_survives_gcs_restart():
+    """A currently-infeasible (PENDING) placement group persists across a
+    GCS restart and is placed once resources free (the restored retry loop
+    must resume — not wait for an unrelated create/remove)."""
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.address)
+    try:
+        from ray_tpu.core.placement_group import (placement_group,
+                                                  remove_placement_group)
+
+        # Occupy the node so the second group is capacity-feasible but
+        # currently unplaceable.
+        blocker = placement_group([{"CPU": 2.0}], strategy="PACK")
+        assert blocker.wait(30)
+        pending = placement_group([{"CPU": 2.0}], strategy="PACK")
+        assert not pending.wait(1.0)  # stays PENDING
+        assert pending.table().get("state") == "PENDING"
+
+        c.kill_gcs()
+        c.restart_gcs()
+        time.sleep(1.5)
+        # Still pending after restart (record restored).
+        assert pending.table().get("state") == "PENDING"
+
+        # Free the resources: the restored retry loop must place it.
+        remove_placement_group(blocker)
+        assert pending.wait(30), "restored PENDING group never placed"
+        assert pending.table().get("state") == "CREATED"
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
