@@ -1070,6 +1070,21 @@ class CoreWorker:
                 self._cancel_return(lease)
                 lease.busy = True
                 asyncio.ensure_future(self._run_on_lease(key, state, lease, spec))
+        # Transfer idle leases from compatible keys (same resources/pg/env,
+        # different function): workers are function-agnostic — they load any
+        # function from the GCS table — so a warm worker leased for f can run
+        # g without a raylet round-trip. The reference keys leases strictly
+        # per-SchedulingKey (normal_task_submitter.h:52) and pays only a
+        # PopWorker on a miss; here a pool miss forks a ~1s Python process,
+        # so cross-key reuse is this build's warm-dispatch path.
+        while state.queue:
+            stolen = self._steal_idle_lease(key)
+            if stolen is None:
+                break
+            spec = state.queue.pop(0)
+            state.leases.append(stolen)
+            stolen.busy = True
+            asyncio.ensure_future(self._run_on_lease(key, state, stolen, spec))
         # Match outstanding lease requests to unassigned work: request more if
         # short, cancel extras if the queue drained (the raylet would otherwise
         # grant stale speculative leases and starve other scheduling keys).
@@ -1086,6 +1101,55 @@ class CoreWorker:
                 for target in [self.raylet, *self._raylet_clients.values()]:
                     asyncio.ensure_future(
                         target.call("cancel_lease_request", req_id=req_id))
+
+    def _steal_idle_lease(self, key) -> Optional[_LeasedWorker]:
+        """Pop an idle leased worker from a scheduling key that differs only
+        in fn_id (identical resources / placement-group slot / runtime_env —
+        any worker satisfying those can execute this key's tasks too).
+        Fully-drained key states are pruned on the way so the scan stays
+        bounded by LIVE keys, not every function ever submitted."""
+        dead_keys = []
+        found = None
+        for other_key, other in self._keys.items():
+            if other_key == key:
+                continue
+            if not other.leases and not other.queue and not other.inflight_reqs:
+                dead_keys.append(other_key)
+                continue
+            if found is not None or other_key[1:] != key[1:]:
+                continue
+            if other.queue:
+                continue  # its own work would just re-fork; don't starve it
+            for lease in other.leases:
+                if not lease.busy:
+                    self._cancel_return(lease)
+                    other.leases.remove(lease)
+                    found = lease
+                    break
+        for dk in dead_keys:
+            del self._keys[dk]
+        return found
+
+    async def _lease_idle(self, key, state: _KeyState, lease: _LeasedWorker):
+        """A lease just went idle: feed its own queue first, else hand the
+        warm worker to a compatible key with waiting work (the push half of
+        cross-key reuse — without it, work queued while this lease was busy
+        would wait out lease_idle_timeout_s and then fork anyway), else arm
+        the idle-return timer."""
+        lease.busy = False
+        if state.queue:
+            await self._pump(key, state)
+            return
+        for t_key, t_state in self._keys.items():
+            if t_key == key or t_key[1:] != key[1:] or not t_state.queue:
+                continue
+            state.leases.remove(lease)
+            t_state.leases.append(lease)
+            lease.busy = True
+            spec = t_state.queue.pop(0)
+            asyncio.ensure_future(self._run_on_lease(t_key, t_state, lease, spec))
+            return
+        self._schedule_return(key, state, lease)
 
     async def _raylet_for(self, address: Tuple[str, int]) -> RpcClient:
         client = self._raylet_clients.get(address)
@@ -1192,11 +1256,7 @@ class CoreWorker:
         dep_err = await self._resolve_dependencies(spec)
         if dep_err is not None:
             self._complete_error(spec, dep_err)
-            lease.busy = False
-            if state.queue:
-                await self._pump(key, state)
-            else:
-                self._schedule_return(key, state, lease)
+            await self._lease_idle(key, state, lease)
             return
         try:
             reply = await lease.client.call("push_task", spec=spec)
@@ -1221,11 +1281,7 @@ class CoreWorker:
             # surface it on the result futures and free the lease.
             self._complete_error(spec, e if isinstance(e, RayTpuError)
                                  else RayTpuError(f"task push failed: {e!r}"))
-            lease.busy = False
-            if state.queue:
-                await self._pump(key, state)
-            else:
-                self._schedule_return(key, state, lease)
+            await self._lease_idle(key, state, lease)
             return
         lost_oid = self._lost_arg_oid(spec, reply)
         if lost_oid is not None:
@@ -1234,20 +1290,12 @@ class CoreWorker:
             # lease FIRST — the reconstruction may need the very resources
             # this lease holds (holding it while awaiting would deadlock a
             # fully-subscribed cluster) — then recover + resubmit aside.
-            lease.busy = False
-            if state.queue:
-                await self._pump(key, state)
-            else:
-                self._schedule_return(key, state, lease)
+            await self._lease_idle(key, state, lease)
             asyncio.ensure_future(
                 self._recover_and_resubmit(spec, reply, lost_oid))
             return
         self._complete_task(spec, reply)
-        lease.busy = False
-        if state.queue:
-            await self._pump(key, state)
-        else:
-            self._schedule_return(key, state, lease)
+        await self._lease_idle(key, state, lease)
 
     def _lost_arg_oid(self, spec: TaskSpec, reply: dict) -> Optional[bytes]:
         """The oid of a reconstructible lost dependency, or None."""

@@ -73,11 +73,19 @@ def test_serial_actor_keeps_order(cluster):
 
 
 def test_streaming_task_generator(cluster):
+    @ray_tpu.remote
+    def warm():
+        return 1
+
     @ray_tpu.remote(num_returns="streaming")
     def countdown(n):
         for i in range(n):
             time.sleep(0.2)
             yield i
+
+    # Warm the worker pool so the streaming-latency assertion below measures
+    # streaming, not cold worker fork/handshake time (~1s on a loaded 1-core box).
+    ray_tpu.get(warm.remote(), timeout=30)
 
     start = time.monotonic()
     gen = countdown.remote(5)

@@ -201,3 +201,58 @@ def test_live_cluster_mixed_classes():
         assert ray_tpu.get(slow_refs, timeout=60) == list(range(6))
     finally:
         ray_tpu.shutdown()
+
+
+def test_cross_key_lease_reuse_warm_dispatch():
+    """A warm worker leased for one function must serve a different function
+    without a fresh fork — both when idle at submit time (pull/steal) and
+    when it goes idle with the other key's work already queued (push).
+    Forking costs ~1s of Python startup; warm dispatch must be ~ms."""
+    import time as _t
+
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def warm():
+            return 0
+
+        ray_tpu.get(warm.remote(), timeout=30)
+
+        # Pull half: idle warm worker, brand-new function.
+        @ray_tpu.remote
+        def f():
+            import os as _os
+
+            return _os.getpid()
+
+        t0 = _t.monotonic()
+        pid_f = ray_tpu.get(f.remote(), timeout=30)
+        assert _t.monotonic() - t0 < 0.5, "new fn did not reuse warm worker"
+
+        # Push half: queue g while f2 holds the only CPU; on f2's completion
+        # the worker must be handed to g's key, not parked for the idle
+        # timeout and re-forked.
+        @ray_tpu.remote
+        def f2():
+            import os as _os, time as _tt
+
+            _tt.sleep(0.6)
+            return _os.getpid()
+
+        @ray_tpu.remote
+        def g():
+            import os as _os
+
+            return _os.getpid()
+
+        t0 = _t.monotonic()
+        ref_f2 = f2.remote()
+        _t.sleep(0.1)  # ensure f2 occupies the worker first
+        ref_g = g.remote()
+        pid_f2 = ray_tpu.get(ref_f2, timeout=30)
+        pid_g = ray_tpu.get(ref_g, timeout=30)
+        took = _t.monotonic() - t0
+        assert pid_f == pid_f2 == pid_g, "expected one shared warm worker"
+        assert took < 1.4, f"push handoff too slow ({took:.2f}s): forked?"
+    finally:
+        ray_tpu.shutdown()
