@@ -636,11 +636,16 @@ def main():
                 for _i in range(5):
                     st, l = step_fn(st, tokens0)
                 _ = float(l)
-                del st
                 attn_probe[impl] = round((time.perf_counter() - t0) / 5, 4)
                 candidates[impl] = step_fn
             except Exception as exc:
-                attn_probe[impl] = f"failed: {type(exc).__name__}"
+                attn_probe[impl] = (f"failed: {type(exc).__name__}: "
+                                    f"{str(exc)[:120]}")
+            finally:
+                # Free the probe's ~7GB of params+opt state even on the
+                # failure path — r4's first live run OOM'd because a failed
+                # probe's state survived into the headline run's init.
+                st = l = None
             PROBE_LOG.append({"attn_probe": dict(attn_probe)})
         timed = {k: v for k, v in attn_probe.items() if isinstance(v, float)}
         attn_impl = min(timed, key=timed.get) if timed else "reference"

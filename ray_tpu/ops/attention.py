@@ -29,6 +29,13 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# Lane width of the LSE/delta side outputs. Mosaic requires the last two
+# block dims to be (8, 128)-divisible or equal to the array dims, so scalar
+# per-row values are carried in a 128-lane fp32 plane (column 0 is the
+# value; the rest is broadcast) exactly like the reference TPU kernel
+# (jax/experimental/pallas/ops/tpu/flash_attention.py MIN_BLOCK_SIZE).
+LANES = 128
+
 
 def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
     """(batch, seq, kv_heads, hd) -> (batch, seq, kv_heads*n_rep, hd)."""
@@ -74,8 +81,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                       block_q: int):
     """Grid: (batch*heads, num_q_blocks). Blocks:
     q_ref: (block_q, d), k_ref/v_ref: (seq_kv, d) resident, o_ref:
-    (block_q, d), lse_ref: (block_q,) — per-row logsumexp of the SCALED
-    logits, consumed by the backward kernels and by ring-attention merges.
+    (block_q, d), lse_ref: (block_q, LANES) — per-row logsumexp of the
+    SCALED logits broadcast across lanes (column 0 is authoritative),
+    consumed by the backward kernels and by ring-attention merges.
 
     Online softmax over KV blocks; with causal=True, KV blocks entirely above
     the diagonal are skipped (the scheduling win of flash attention).
@@ -117,7 +125,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     m, l, acc = jax.lax.fori_loop(0, max_kb, body, (m, l, acc))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+    if lse_ref is not None:
+        lse_ref[0] = jnp.broadcast_to(m + jnp.log(jnp.maximum(l, 1e-30)),
+                                      (block_q, LANES))
+
+
+def _flash_fwd_kernel_nolse(q_ref, k_ref, v_ref, o_ref, **kw):
+    """Forward without the LSE side output: the serving/prefill path needs
+    only `out`, and the (bh, sq, LANES) fp32 lane plane would be ~128x the
+    useful bytes of pure HBM write traffic on the TTFT hot path."""
+    _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, None, **kw)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -132,8 +149,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]       # (block_q, 1)
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0][:, 0:1]        # (block_q, 1) from the lane plane
+    delta = delta_ref[0][:, 0:1]
     d = q.shape[-1]
 
     q_start = qi * block_q
@@ -187,8 +204,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
         do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(qi * block_q, block_q)][:, None]
-        delta_blk = delta_ref[0, pl.ds(qi * block_q, block_q)][:, None]
+        lse_blk = lse_ref[0, pl.ds(qi * block_q, block_q), :][:, 0:1]
+        delta_blk = delta_ref[0, pl.ds(qi * block_q, block_q), :][:, 0:1]
         s = (q_blk @ k_blk.T) * scale   # (block_q, block_k)
         p = jnp.exp(s - lse_blk)
         k_pos = k_start + jax.lax.broadcasted_iota(
@@ -243,10 +260,12 @@ def _unfold(x, b, h):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
+                emit_lse: bool = True):
     """Run the forward kernel; q/k/v in public (b, s, h, d) layout with
     h == hkv (GQA repeat handled by callers). Returns (out, lse) with lse
-    shaped (b, h, sq) in fp32."""
+    shaped (b, h, sq) in fp32; with emit_lse=False returns (out, None)
+    and the kernel writes no LSE plane (serving hot path)."""
     from jax.experimental import pallas as pl
 
     b, sq, h, d = q.shape
@@ -267,10 +286,18 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret):
         kt = jnp.pad(kt, ((0, 0), (0, skv_p - skv), (0, 0)))
         vt = jnp.pad(vt, ((0, 0), (0, skv_p - skv), (0, 0)))
     grid = (b * h, sq_p // block_q)
-    kernel = functools.partial(
-        _flash_fwd_kernel, block_k=block_k, seq_kv=skv_p, true_kv=skv,
-        causal=causal, scale=scale, block_q=block_q)
-    out, lse = pl.pallas_call(
+    kw = dict(block_k=block_k, seq_kv=skv_p, true_kv=skv, causal=causal,
+              scale=scale, block_q=block_q)
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0))]
+    out_shape = [_sds((b * h, sq_p, d), q.dtype, vma)]
+    if emit_lse:
+        kernel = functools.partial(_flash_fwd_kernel, **kw)
+        out_specs.append(
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)))
+        out_shape.append(_sds((b * h, sq_p, LANES), jnp.float32, vma))
+    else:
+        kernel = functools.partial(_flash_fwd_kernel_nolse, **kw)
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -278,17 +305,14 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
-        ],
-        out_shape=[
-            _sds((b * h, sq_p, d), q.dtype, vma),
-            _sds((b * h, sq_p), jnp.float32, vma),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(qt, kt, vt)
-    return _unfold(out[:, :sq], b, h), lse[:, :sq].reshape(b, h, sq)
+    out = _unfold(res[0][:, :sq], b, h)
+    if not emit_lse:
+        return out, None
+    return out, res[1][:, :sq, 0].reshape(b, h, sq)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -337,6 +361,11 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
         kt = jnp.pad(kt, ((0, 0), (0, skv_p - skv), (0, 0)))
         vt = jnp.pad(vt, ((0, 0), (0, skv_p - skv), (0, 0)))
 
+    # Expand per-row scalars into the 128-lane plane the kernels read
+    # (Mosaic tiling: a 2D (bh, s) array cannot be blocked (1, block_q)).
+    lse_t = jnp.broadcast_to(lse_t[..., None], (b * h, sq_p, LANES))
+    delta = jnp.broadcast_to(delta[..., None], (b * h, sq_p, LANES))
+
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
                           seq_kv=skv_p, true_kv=skv, causal=causal,
@@ -347,8 +376,8 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
             pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=_sds((b * h, sq_p, d), q.dtype, vma),
@@ -365,8 +394,8 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
             pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
             pl.BlockSpec((1, sq_p, d), lambda bh, kb: (bh, 0, 0)),
-            pl.BlockSpec((1, sq_p), lambda bh, kb: (bh, 0)),
-            pl.BlockSpec((1, sq_p), lambda bh, kb: (bh, 0)),
+            pl.BlockSpec((1, sq_p, LANES), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, sq_p, LANES), lambda bh, kb: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
@@ -419,7 +448,8 @@ def flash_attention_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         interpret: Optional[bool] = None) -> jax.Array:
     """Forward-only entry point (serving hot path; no residual outputs)."""
     k, v, scale, interpret = _flash_prep(q, k, v, scale, interpret)
-    out, _ = _flash_call(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
+                         emit_lse=False)
     return out
 
 
