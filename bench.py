@@ -476,7 +476,11 @@ def serve_bench_result(backend: str) -> dict:
     rng = np.random.RandomState(0)
     prompt = rng.randint(1, config.vocab_size, prompt_len).tolist()
 
-    # Warmup: compile the prefill + decode buckets.
+    # Warmup: precompile the full bucket grid (vLLM-TPU-style), then one
+    # real request for the host-side paths. Without the grid warmup the
+    # prefix-cache leg's short-suffix bucket compiled INSIDE the timed
+    # region (13.2 s "TTFT" in the first r4 live run).
+    engine.warmup()
     engine.generate([prompt], SamplingParams(max_tokens=4))
 
     ttfts, decode_times, decoded = [], [], 0

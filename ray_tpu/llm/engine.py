@@ -393,6 +393,54 @@ class LLMEngine:
             req.prefilled = cached_tokens
             self.prefilling.append(req)
 
+    def warmup(self, *, full: bool = False) -> int:
+        """Precompile the bucketed step grid so no user request ever pays an
+        XLA compile mid-stream (vLLM's TPU backend precompiles the same way
+        at startup). Without this, the first request hitting a new
+        (batch, chunk) bucket — e.g. the short suffix after a prefix-cache
+        hit — stalls for a full compile (observed 13 s on a ~2B model vs a
+        105 ms steady-state TTFT).
+
+        Dummy rows carry q_lens=0, so every KV write lands in the scatter
+        drop zone: the KV pool, block tables, and scheduler state are
+        untouched. Warms the device-sampling step (the prefill/decode hot
+        path) for: every prefill chunk bucket at batch 1, every decode batch
+        bucket at Bq=1, and (with full=True) the whole batch x chunk grid.
+        With speculation enabled, also warms the verify step. Returns the
+        number of shapes compiled."""
+        r = self.runner
+        batch_buckets = sorted({r.batch_bucket(n)
+                                for n in range(1, self.max_batch + 1)})
+        chunk_buckets, b = [], 8
+        while b < self.prefill_chunk:
+            chunk_buckets.append(b)
+            b *= 2
+        chunk_buckets.append(r.chunk_bucket(self.prefill_chunk))
+        spec_bq = (r.chunk_bucket(self.spec_ngram + 1)
+                   if self.spec_ngram else None)
+        # Light set: single-sequence prefill chunks + per-batch decode (the
+        # sequential-traffic pattern). Full grid: every batch bucket at every
+        # chunk bucket — required for "no request ever compiles" once
+        # prefills batch, so servers default to it.
+        combos = {(batch_buckets[0], cb) for cb in chunk_buckets}
+        combos |= {(sb, 1) for sb in batch_buckets}
+        if spec_bq:
+            combos |= {(sb, spec_bq) for sb in batch_buckets}
+        if full:
+            combos |= {(sb, cb) for sb in batch_buckets
+                       for cb in chunk_buckets}
+        for S, Bq in sorted(combos):
+            tokens = np.zeros((S, Bq), dtype=np.int32)
+            zeros = np.zeros(S, dtype=np.int32)
+            tables = np.zeros((S, self.max_blocks_per_seq), dtype=np.int32)
+            args = (tokens, zeros, zeros, zeros, tables)
+            samp = (np.zeros(S, np.float32), np.zeros(S, np.int32),
+                    np.ones(S, np.float32), np.zeros(S, np.int32), zeros)
+            r.step_sample(*args, *samp)
+            if spec_bq and Bq == spec_bq:
+                r.step_verify(*args)
+        return len(combos)
+
     def _needs_logits(self, reqs) -> bool:
         """Host sampling (full logits fetch) is only needed for features the
         device sampler lacks (repetition penalty)."""

@@ -46,6 +46,10 @@ class LLMConfig:
     # (greedy-only; tokens proposed from the sequence's own history).
     enable_prefix_caching: bool = True
     speculative_ngram: int = 0
+    # Precompile the (batch, chunk) bucket grid at replica start so no user
+    # request pays an XLA compile mid-stream (vLLM-TPU startup precompile;
+    # a cold bucket costs seconds of TTFT on multi-B-param models).
+    warmup_buckets: bool = True
 
 
 class LLMServer:
@@ -91,6 +95,10 @@ class LLMServer:
             prefill_chunk=llm_config.prefill_chunk,
             enable_prefix_caching=llm_config.enable_prefix_caching,
             speculative_ngram=llm_config.speculative_ngram)
+        if llm_config.warmup_buckets:
+            # Full grid: a server takes concurrent traffic, so batched
+            # prefill shapes (batch>1, chunk>1) WILL be hit.
+            self.engine.warmup(full=True)
         self.tokenizer = llm_config.tokenizer
         self._lock = threading.Lock()
         # request_id -> per-request event queue; the engine loop fans

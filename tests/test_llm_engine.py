@@ -285,3 +285,23 @@ def test_ngram_speculative_accepts_on_repetition(tiny_setup):
     # The cyclic prompt makes n-gram proposals hit: acceptance MUST move
     # (a silently-disabled spec path would leave it at 0).
     assert spec.spec_tokens_accepted > 0, spec.spec_tokens_accepted
+
+
+def test_warmup_precompiles_without_corrupting_state(tiny_setup):
+    """warmup() must compile the bucket grid via q_lens=0 dummy steps that
+    leave the KV pool / block manager untouched: generation after warmup
+    must match the never-warmed engine token for token."""
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.sampling import SamplingParams
+
+    config, params, runner = tiny_setup
+    warmed = LLMEngine(runner, max_batch_size=4, speculative_ngram=3)
+    n_shapes = warmed.warmup()
+    assert n_shapes > 0
+    assert not warmed.block_manager.refcount, "warmup leaked block state"
+    prompt = [1, 5, 9, 2]
+    out = warmed.generate([prompt], SamplingParams(max_tokens=8))[0]
+    expected = naive_greedy_decode(params, config, prompt, 8)
+    assert out.output_token_ids == expected
+    # full grid is a superset of the default set
+    assert warmed.warmup(full=True) >= n_shapes
