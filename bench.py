@@ -655,6 +655,42 @@ def main():
         attn_impl = min(timed, key=timed.get) if timed else "reference"
         config = _dc.replace(config, attention_impl=attn_impl)
         train_step = candidates.get(attn_impl)
+
+        # Batch-size probe: flash attention's O(seq) activation memory can
+        # fit batch 6/8 where the O(s^2) reference OOM'd in r3. Compare
+        # tokens/s (not s/step) against the batch-4 winner and train with
+        # whichever batch feeds the MXU best; OOM probes clean up after
+        # themselves and simply lose the race.
+        if train_step is not None and attn_impl in timed:
+            batch_probe = {batch: round(batch * seq / timed[attn_impl], 1)}
+            best_bsz, best_tok_s = batch, batch_probe[batch]
+            for bsz in (8, 6):
+                st = l = None
+                try:
+                    toks_b = jax.random.randint(
+                        jax.random.key(1), (bsz, seq + 1), 0,
+                        config.vocab_size)
+                    st = init_state(jax.random.key(0))
+                    for _i in range(2):   # compile + settle
+                        st, l = train_step(st, toks_b)
+                        _ = float(l)
+                    t0 = time.perf_counter()
+                    for _i in range(5):
+                        st, l = train_step(st, toks_b)
+                    _ = float(l)
+                    sps = (time.perf_counter() - t0) / 5
+                    tok_s_b = bsz * seq / sps
+                    batch_probe[bsz] = round(tok_s_b, 1)
+                    if tok_s_b > best_tok_s:
+                        best_bsz, best_tok_s = bsz, tok_s_b
+                except Exception as exc:
+                    batch_probe[bsz] = (f"failed: {type(exc).__name__}: "
+                                        f"{str(exc)[:80]}")
+                finally:
+                    st = l = None
+                PROBE_LOG.append({"batch_probe": dict(batch_probe)})
+            batch = best_bsz
+            attn_probe["batch_tokens_per_s"] = batch_probe
     if train_step is None:
         train_step = make_step(config)
 

@@ -403,29 +403,35 @@ class LLMEngine:
 
         Dummy rows carry q_lens=0, so every KV write lands in the scatter
         drop zone: the KV pool, block tables, and scheduler state are
-        untouched. Warms the device-sampling step (the prefill/decode hot
-        path) for: every prefill chunk bucket at batch 1, every decode batch
-        bucket at Bq=1, and (with full=True) the whole batch x chunk grid.
-        With speculation enabled, also warms the verify step. Returns the
-        number of shapes compiled."""
+        untouched. The default (light) set warms the device-sampling step
+        for sequential traffic: every prefill chunk bucket at batch 1,
+        every decode batch bucket at Bq=1, and — with speculation on — the
+        verify step at every reachable proposal-width bucket per batch
+        bucket. full=True warms the whole batch x chunk grid AND the
+        host-logits step (repetition-penalty requests); only then does the
+        no-compile guarantee cover every request shape. Returns the number
+        of shapes compiled."""
         r = self.runner
         batch_buckets = sorted({r.batch_bucket(n)
                                 for n in range(1, self.max_batch + 1)})
-        chunk_buckets, b = [], 8
-        while b < self.prefill_chunk:
-            chunk_buckets.append(b)
-            b *= 2
-        chunk_buckets.append(r.chunk_bucket(self.prefill_chunk))
-        spec_bq = (r.chunk_bucket(self.spec_ngram + 1)
-                   if self.spec_ngram else None)
+        # The runner owns the bucket ladder (one source of truth); warm only
+        # the buckets this engine's prefill_chunk can reach.
+        cap = r.chunk_bucket(self.prefill_chunk)
+        chunk_buckets = [cb for cb in r.chunk_buckets() if cb <= cap]
+        # Spec proposals vary per tick from width 1 up to spec_ngram+1, so
+        # EVERY chunk bucket up to the max proposal's bucket can carry a
+        # verify step.
+        spec_cap = (r.chunk_bucket(self.spec_ngram + 1)
+                    if self.spec_ngram else 0)
         # Light set: single-sequence prefill chunks + per-batch decode (the
         # sequential-traffic pattern). Full grid: every batch bucket at every
         # chunk bucket — required for "no request ever compiles" once
         # prefills batch, so servers default to it.
         combos = {(batch_buckets[0], cb) for cb in chunk_buckets}
         combos |= {(sb, 1) for sb in batch_buckets}
-        if spec_bq:
-            combos |= {(sb, spec_bq) for sb in batch_buckets}
+        if spec_cap:
+            combos |= {(sb, cb) for sb in batch_buckets
+                       for cb in r.chunk_buckets() if cb <= spec_cap}
         if full:
             combos |= {(sb, cb) for sb in batch_buckets
                        for cb in chunk_buckets}
@@ -437,8 +443,13 @@ class LLMEngine:
             samp = (np.zeros(S, np.float32), np.zeros(S, np.int32),
                     np.ones(S, np.float32), np.zeros(S, np.int32), zeros)
             r.step_sample(*args, *samp)
-            if spec_bq and Bq == spec_bq:
+            if spec_cap and 8 <= Bq <= spec_cap:
                 r.step_verify(*args)
+            if full:
+                # Host-logits path (runner.step): taken whenever a request
+                # uses repetition_penalty — warm it too so the "no compile
+                # mid-stream" guarantee covers every sampling feature.
+                r.step(*args)
         return len(combos)
 
     def _needs_logits(self, reqs) -> bool:

@@ -313,10 +313,17 @@ class ModelRunner:
     def batch_bucket(self, n: int) -> int:
         return _bucket(n, self.BATCH_BUCKETS)
 
-    def chunk_bucket(self, n: int) -> int:
+    def chunk_buckets(self) -> list:
+        """The static prefill-chunk bucket ladder: powers of two from 8 up
+        to (and always including) chunk_size. Single source of truth for
+        runtime bucketing AND warmup precompilation — a diverging copy
+        means some runtime bucket never gets warmed."""
         buckets, b = [], 8
         while b < self.chunk_size:
             buckets.append(b)
             b *= 2
         buckets.append(self.chunk_size)
-        return _bucket(n, buckets)
+        return buckets
+
+    def chunk_bucket(self, n: int) -> int:
+        return _bucket(n, self.chunk_buckets())

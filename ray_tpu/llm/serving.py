@@ -46,10 +46,14 @@ class LLMConfig:
     # (greedy-only; tokens proposed from the sequence's own history).
     enable_prefix_caching: bool = True
     speculative_ngram: int = 0
-    # Precompile the (batch, chunk) bucket grid at replica start so no user
-    # request pays an XLA compile mid-stream (vLLM-TPU startup precompile;
-    # a cold bucket costs seconds of TTFT on multi-B-param models).
-    warmup_buckets: bool = True
+    # Precompile step buckets at replica start so user requests don't pay
+    # XLA compiles mid-stream (vLLM-TPU startup precompile; a cold bucket
+    # costs seconds of TTFT on multi-B-param models). "full" = whole
+    # batch x chunk grid incl. the host-logits path (minutes of startup
+    # compiles on big models, zero mid-stream stalls); "light" = the
+    # sequential-traffic set (fast startup, batched-prefill shapes still
+    # compile on first hit); "off" = lazy. True/False alias full/off.
+    warmup_buckets: Any = "full"
 
 
 class LLMServer:
@@ -95,10 +99,12 @@ class LLMServer:
             prefill_chunk=llm_config.prefill_chunk,
             enable_prefix_caching=llm_config.enable_prefix_caching,
             speculative_ngram=llm_config.speculative_ngram)
-        if llm_config.warmup_buckets:
-            # Full grid: a server takes concurrent traffic, so batched
-            # prefill shapes (batch>1, chunk>1) WILL be hit.
-            self.engine.warmup(full=True)
+        wm = llm_config.warmup_buckets
+        wm = {True: "full", False: "off"}.get(wm, wm)
+        if wm not in ("off", "light", "full"):
+            raise ValueError(f"warmup_buckets: {wm!r} not off/light/full")
+        if wm != "off":
+            self.engine.warmup(full=wm == "full")
         self.tokenizer = llm_config.tokenizer
         self._lock = threading.Lock()
         # request_id -> per-request event queue; the engine loop fans
