@@ -1,0 +1,245 @@
+#include "client.hpp"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <random>
+#include <stdexcept>
+
+namespace raytpu {
+
+static const uint8_t PROTOCOL_VERSION = 1;
+static const size_t CHALLENGE = 32;
+static const size_t MAC_SIZE = 16;
+
+static Bytes magic(const char* m3) {
+  Bytes b(m3, m3 + 3);
+  b.push_back(PROTOCOL_VERSION);
+  return b;
+}
+
+Client::Client(const std::string& host, uint16_t port,
+               const std::string& token_hex, double timeout_s) {
+  struct addrinfo hints {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  int rc = ::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                         &res);
+  if (rc != 0)
+    throw std::runtime_error(std::string("resolve failed: ") +
+                             gai_strerror(rc));
+  int fd = -1;
+  for (auto* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) throw std::runtime_error("connect failed to " + host);
+  struct timeval tv;
+  tv.tv_sec = long(timeout_s);
+  tv.tv_usec = long((timeout_s - double(tv.tv_sec)) * 1e6);
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  fd_ = fd;
+  if (!token_hex.empty()) handshake(from_hex(token_hex));
+}
+
+Client::~Client() { close(); }
+
+void Client::close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+void Client::write_all(const uint8_t* p, size_t n) {
+  while (n > 0) {
+    ssize_t w = ::send(fd_, p, n, 0);
+    if (w <= 0) throw std::runtime_error("send failed");
+    p += w;
+    n -= size_t(w);
+  }
+}
+
+void Client::read_all(uint8_t* p, size_t n) {
+  while (n > 0) {
+    ssize_t r = ::recv(fd_, p, n, 0);
+    if (r <= 0) throw std::runtime_error("connection closed");
+    p += r;
+    n -= size_t(r);
+  }
+}
+
+void Client::handshake(const Bytes& token) {
+  // server -> RTA+ver + sc ; client -> cc + HMAC(token,"c"+sc+cc) ;
+  // server -> HMAC(token,"s"+sc+cc) ; both derive HMAC(token,"k"+sc+cc).
+  Bytes first(4 + CHALLENGE);
+  read_all(first.data(), first.size());
+  Bytes am = magic("RTA");
+  if (!std::equal(am.begin(), am.end(), first.begin()))
+    throw std::runtime_error("server did not start wire authentication");
+  Bytes sc(first.begin() + 4, first.end());
+
+  Bytes cc(CHALLENGE);
+  std::random_device rd;
+  for (auto& b : cc) b = uint8_t(rd());
+
+  auto proof_input = [&](char dir) {
+    Bytes m{uint8_t(dir)};
+    m.insert(m.end(), sc.begin(), sc.end());
+    m.insert(m.end(), cc.begin(), cc.end());
+    return m;
+  };
+  Bytes proof = hmac_sha256(token, proof_input('c'));
+  Bytes out = cc;
+  out.insert(out.end(), proof.begin(), proof.end());
+  write_all(out.data(), out.size());
+
+  Bytes server_proof(32);
+  read_all(server_proof.data(), server_proof.size());
+  if (!const_time_eq(server_proof, hmac_sha256(token, proof_input('s'))))
+    throw std::runtime_error("server failed mutual authentication");
+  mac_key_ = hmac_sha256(token, proof_input('k'));
+}
+
+static Bytes frame_tag(const Bytes& key, char dir, uint64_t seq,
+                       const Bytes& body) {
+  Blake2b b(MAC_SIZE, key);
+  uint8_t d = uint8_t(dir);
+  b.update(&d, 1);
+  uint8_t seqb[8];
+  for (int i = 0; i < 8; i++) seqb[i] = uint8_t(seq >> (8 * i));
+  b.update(seqb, 8);
+  b.update(body);
+  return b.digest();
+}
+
+void Client::send_frame(const Bytes& body) {
+  Bytes out = magic("RTX");
+  uint32_t len = uint32_t(body.size());
+  for (int i = 0; i < 4; i++) out.push_back(uint8_t(len >> (8 * i)));
+  out.insert(out.end(), body.begin(), body.end());
+  if (!mac_key_.empty()) {
+    Bytes tag = frame_tag(mac_key_, 'C', send_seq_++, body);
+    out.insert(out.end(), tag.begin(), tag.end());
+  }
+  write_all(out.data(), out.size());
+}
+
+Bytes Client::recv_frame() {
+  Bytes hdr(8);
+  read_all(hdr.data(), hdr.size());
+  Bytes xm = magic("RTX");
+  if (!std::equal(xm.begin(), xm.end(), hdr.begin()))
+    throw std::runtime_error("unexpected reply magic");
+  uint32_t len = 0;
+  for (int i = 3; i >= 0; i--) len = (len << 8) | hdr[4 + i];
+  Bytes body(len);
+  read_all(body.data(), body.size());
+  if (!mac_key_.empty()) {
+    Bytes tag(MAC_SIZE);
+    read_all(tag.data(), tag.size());
+    if (!const_time_eq(tag, frame_tag(mac_key_, 'S', recv_seq_++, body)))
+      throw std::runtime_error("reply MAC verification failed");
+  }
+  return body;
+}
+
+XValue Client::call(const std::string& method, XDict args) {
+  Envelope req{KIND_REQUEST, true, ++next_msg_id_, method,
+               XValue(std::move(args))};
+  send_frame(req.encode());
+  for (;;) {
+    Envelope reply = Envelope::decode(recv_frame());
+    if (reply.kind == KIND_PUSH) continue;  // sync client: skip pushes
+    if (!reply.has_msg_id || reply.msg_id != req.msg_id) continue;
+    if (reply.kind == KIND_ERROR)
+      throw std::runtime_error("remote error in " + method + ": " +
+                               reply.data.repr());
+    if (reply.data.is_error_dict())
+      throw std::runtime_error("error from " + method + ": " +
+                               reply.data.at("error").repr());
+    return reply.data;
+  }
+}
+
+// ---------------------------------------------------------- proxy ops
+
+XValue Client::hello() { return call("xhello", {}); }
+
+Bytes Client::put(XValue value) {
+  XDict args;
+  args.emplace("value", std::move(value));
+  return call("xput", std::move(args)).at("ref").as_bytes();
+}
+
+XValue Client::get(const Bytes& ref, double timeout_s) {
+  XDict args;
+  args.emplace("refs", XValue(XList{XValue(ref)}));
+  args.emplace("timeout_s", XValue(timeout_s));
+  XValue reply = call("xget", std::move(args));
+  return reply.at("values").as_list().at(0);
+}
+
+Bytes Client::submit(const std::string& fn_name, XList args, XDict kwargs) {
+  XDict d;
+  d.emplace("name", XValue(fn_name));
+  d.emplace("args", XValue(std::move(args)));
+  d.emplace("kwargs", XValue(std::move(kwargs)));
+  return call("xcall", std::move(d)).at("ref").as_bytes();
+}
+
+Bytes Client::actor_get(const std::string& name) {
+  XDict d;
+  d.emplace("name", XValue(name));
+  return call("xactor_get", std::move(d)).at("actor_id").as_bytes();
+}
+
+Bytes Client::actor_call(const Bytes& actor_id, const std::string& method,
+                         XList args, XDict kwargs) {
+  XDict d;
+  d.emplace("actor_id", XValue(actor_id));
+  d.emplace("method", XValue(method));
+  d.emplace("args", XValue(std::move(args)));
+  d.emplace("kwargs", XValue(std::move(kwargs)));
+  return call("xactor_call", std::move(d)).at("ref").as_bytes();
+}
+
+void Client::kv_put(const std::string& key, const Bytes& value) {
+  XDict d;
+  d.emplace("key", XValue(key));
+  d.emplace("value", XValue(value));
+  call("xkv_put", std::move(d));
+}
+
+std::optional<Bytes> Client::kv_get(const std::string& key) {
+  XDict d;
+  d.emplace("key", XValue(key));
+  XValue reply = call("xkv_get", std::move(d));
+  const XValue& v = reply.at("value");
+  if (v.is_none()) return std::nullopt;
+  return v.as_bytes();
+}
+
+void Client::release(const Bytes& ref) {
+  XDict d;
+  d.emplace("refs", XValue(XList{XValue(ref)}));
+  call("xrelease", std::move(d));
+}
+
+XValue Client::ref_arg(const Bytes& ref) {
+  XDict d;
+  d.emplace("$ref", XValue(ref));
+  return XValue(std::move(d));
+}
+
+}  // namespace raytpu

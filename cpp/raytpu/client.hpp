@@ -1,0 +1,71 @@
+// Native C++ client for ray_tpu clusters (the N31 language binding).
+//
+// Speaks the cross-language wire dialect end-to-end:
+//   * TCP framing + mutual HMAC-SHA256 handshake + per-frame keyed
+//     BLAKE2b-128 MACs (protocol: ray_tpu/runtime/rpc.py);
+//   * RTX envelopes carrying XValue payloads (ray_tpu/runtime/xlang.py);
+//   * cluster ops against the ClientProxyServer's xlang handlers
+//     (ray_tpu/util/client/server.py): call-by-name tasks, put/get/wait,
+//     named actors, GCS KV.
+//
+// Reference analog: the C++ worker/client of harborn/ray
+// (src/ray/core_worker C++ bindings + python/ray/cross_language.py) —
+// calls into another language go by function NAME with language-neutral
+// values, never pickled closures.
+#pragma once
+
+#include <optional>
+#include <string>
+
+#include "xvalue.hpp"
+
+namespace raytpu {
+
+class Client {
+ public:
+  // token_hex: the cluster session token (hex, from the session dir's
+  // auth_token file or RAY_TPU_AUTH_TOKEN). Empty = unauthenticated wire.
+  Client(const std::string& host, uint16_t port,
+         const std::string& token_hex = "", double timeout_s = 30.0);
+  ~Client();
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // Generic RPC: send one request, wait for its reply. Throws
+  // std::runtime_error on wire/auth errors or KIND_ERROR replies and on
+  // {"error": ...} reply dicts.
+  XValue call(const std::string& method, XDict args);
+
+  // -- convenience ops over the xlang proxy handlers -------------------
+  XValue hello();
+  Bytes put(XValue value);
+  XValue get(const Bytes& ref, double timeout_s = 60.0);
+  // Submit a named/importable Python function; returns the object ref.
+  Bytes submit(const std::string& fn_name, XList args = {},
+               XDict kwargs = {});
+  Bytes actor_get(const std::string& name);
+  Bytes actor_call(const Bytes& actor_id, const std::string& method,
+                   XList args = {}, XDict kwargs = {});
+  void kv_put(const std::string& key, const Bytes& value);
+  std::optional<Bytes> kv_get(const std::string& key);
+  void release(const Bytes& ref);
+
+  // Wrap a ref for use inside submit() args ({"$ref": ref}).
+  static XValue ref_arg(const Bytes& ref);
+
+  void close();
+
+ private:
+  void handshake(const Bytes& token);
+  void send_frame(const Bytes& body);
+  Bytes recv_frame();
+  void write_all(const uint8_t* p, size_t n);
+  void read_all(uint8_t* p, size_t n);
+
+  int fd_ = -1;
+  uint64_t next_msg_id_ = 0;
+  uint64_t send_seq_ = 0, recv_seq_ = 0;
+  Bytes mac_key_;  // empty = MAC off
+};
+
+}  // namespace raytpu

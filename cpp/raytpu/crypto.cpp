@@ -1,0 +1,270 @@
+#include "crypto.hpp"
+
+#include <stdexcept>
+
+namespace raytpu {
+
+// ------------------------------------------------------------- SHA-256
+// FIPS 180-4. Round constants = frac(cbrt(first 64 primes)).
+
+static const uint32_t K256[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static inline uint32_t rotr32(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+Sha256::Sha256() {
+  static const uint32_t H0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                 0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                 0x1f83d9ab, 0x5be0cd19};
+  std::memcpy(h, H0, sizeof(h));
+}
+
+void Sha256::compress(const uint8_t* p) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+           (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr32(w[i - 15], 7) ^ rotr32(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr32(w[i - 2], 17) ^ rotr32(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+  uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = hh + S1 + ch + K256[i] + w[i];
+    uint32_t S0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+void Sha256::update(const uint8_t* data, size_t n) {
+  len += n;
+  while (n > 0) {
+    size_t take = 64 - buflen;
+    if (take > n) take = n;
+    std::memcpy(buf + buflen, data, take);
+    buflen += take;
+    data += take;
+    n -= take;
+    if (buflen == 64) {
+      compress(buf);
+      buflen = 0;
+    }
+  }
+}
+
+Bytes Sha256::digest() {
+  uint64_t bitlen = len * 8;
+  uint8_t pad = 0x80;
+  update(&pad, 1);
+  uint8_t zero = 0;
+  while (buflen != 56) update(&zero, 1);
+  uint8_t lenbuf[8];
+  for (int i = 0; i < 8; i++) lenbuf[i] = uint8_t(bitlen >> (56 - 8 * i));
+  // update() would re-count these; feed the final block directly.
+  std::memcpy(buf + 56, lenbuf, 8);
+  compress(buf);
+  Bytes out(32);
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = uint8_t(h[i] >> 24);
+    out[4 * i + 1] = uint8_t(h[i] >> 16);
+    out[4 * i + 2] = uint8_t(h[i] >> 8);
+    out[4 * i + 3] = uint8_t(h[i]);
+  }
+  return out;
+}
+
+Bytes sha256(const Bytes& data) {
+  Sha256 s;
+  s.update(data);
+  return s.digest();
+}
+
+Bytes hmac_sha256(const Bytes& key, const Bytes& msg) {
+  Bytes k = key;
+  if (k.size() > 64) k = sha256(k);
+  k.resize(64, 0);
+  Bytes ipad(64), opad(64);
+  for (int i = 0; i < 64; i++) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  Sha256 inner;
+  inner.update(ipad);
+  inner.update(msg);
+  Bytes ih = inner.digest();
+  Sha256 outer;
+  outer.update(opad);
+  outer.update(ih);
+  return outer.digest();
+}
+
+// ------------------------------------------------- BLAKE2b (RFC 7693)
+
+static const uint64_t B2B_IV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+
+static const uint8_t B2B_SIGMA[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+static inline uint64_t rotr64(uint64_t x, int n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+static inline uint64_t load64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 7; i >= 0; i--) v = (v << 8) | p[i];
+  return v;
+}
+
+Blake2b::Blake2b(size_t digest_size, const Bytes& key) : outlen(digest_size) {
+  if (digest_size == 0 || digest_size > 64)
+    throw std::invalid_argument("blake2b digest_size must be 1..64");
+  if (key.size() > 64) throw std::invalid_argument("blake2b key too long");
+  for (int i = 0; i < 8; i++) h[i] = B2B_IV[i];
+  // Parameter block word 0: depth=1, fanout=1, key length, digest length.
+  h[0] ^= 0x01010000ULL ^ (uint64_t(key.size()) << 8) ^ uint64_t(digest_size);
+  if (!key.empty()) {
+    uint8_t block[128] = {0};
+    std::memcpy(block, key.data(), key.size());
+    update(block, 128);
+  }
+}
+
+void Blake2b::compress(const uint8_t* block, bool last) {
+  uint64_t m[16], v[16];
+  for (int i = 0; i < 16; i++) m[i] = load64(block + 8 * i);
+  for (int i = 0; i < 8; i++) {
+    v[i] = h[i];
+    v[i + 8] = B2B_IV[i];
+  }
+  v[12] ^= t;       // low word of the byte counter (high word unused <2^64)
+  if (last) v[14] = ~v[14];
+#define B2B_G(a, b, c, d, x, y)              \
+  do {                                       \
+    v[a] = v[a] + v[b] + (x);                \
+    v[d] = rotr64(v[d] ^ v[a], 32);          \
+    v[c] = v[c] + v[d];                      \
+    v[b] = rotr64(v[b] ^ v[c], 24);          \
+    v[a] = v[a] + v[b] + (y);                \
+    v[d] = rotr64(v[d] ^ v[a], 16);          \
+    v[c] = v[c] + v[d];                      \
+    v[b] = rotr64(v[b] ^ v[c], 63);          \
+  } while (0)
+  for (int r = 0; r < 12; r++) {
+    const uint8_t* s = B2B_SIGMA[r];
+    B2B_G(0, 4, 8, 12, m[s[0]], m[s[1]]);
+    B2B_G(1, 5, 9, 13, m[s[2]], m[s[3]]);
+    B2B_G(2, 6, 10, 14, m[s[4]], m[s[5]]);
+    B2B_G(3, 7, 11, 15, m[s[6]], m[s[7]]);
+    B2B_G(0, 5, 10, 15, m[s[8]], m[s[9]]);
+    B2B_G(1, 6, 11, 12, m[s[10]], m[s[11]]);
+    B2B_G(2, 7, 8, 13, m[s[12]], m[s[13]]);
+    B2B_G(3, 4, 9, 14, m[s[14]], m[s[15]]);
+  }
+#undef B2B_G
+  for (int i = 0; i < 8; i++) h[i] ^= v[i] ^ v[i + 8];
+}
+
+void Blake2b::update(const uint8_t* data, size_t n) {
+  while (n > 0) {
+    if (buflen == 128) {
+      // Buffer full AND more input coming: this is a non-final block.
+      t += 128;
+      compress(buf, false);
+      buflen = 0;
+    }
+    size_t take = 128 - buflen;
+    if (take > n) take = n;
+    std::memcpy(buf + buflen, data, take);
+    buflen += take;
+    data += take;
+    n -= take;
+  }
+}
+
+Bytes Blake2b::digest() {
+  // Final block: pad with zeros, counter counts only real bytes.
+  t += buflen;
+  std::memset(buf + buflen, 0, 128 - buflen);
+  compress(buf, true);
+  Bytes out(outlen);
+  for (size_t i = 0; i < outlen; i++)
+    out[i] = uint8_t(h[i / 8] >> (8 * (i % 8)));
+  return out;
+}
+
+Bytes blake2b(const Bytes& data, size_t digest_size, const Bytes& key) {
+  Blake2b b(digest_size, key);
+  b.update(data);
+  return b.digest();
+}
+
+// ------------------------------------------------------------- helpers
+
+Bytes from_hex(const std::string& hex) {
+  if (hex.size() % 2) throw std::invalid_argument("odd hex length");
+  auto nib = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    throw std::invalid_argument("bad hex digit");
+  };
+  Bytes out(hex.size() / 2);
+  for (size_t i = 0; i < out.size(); i++)
+    out[i] = uint8_t((nib(hex[2 * i]) << 4) | nib(hex[2 * i + 1]));
+  return out;
+}
+
+std::string to_hex(const Bytes& b) {
+  static const char* d = "0123456789abcdef";
+  std::string out;
+  out.reserve(b.size() * 2);
+  for (uint8_t c : b) {
+    out.push_back(d[c >> 4]);
+    out.push_back(d[c & 15]);
+  }
+  return out;
+}
+
+bool const_time_eq(const Bytes& a, const Bytes& b) {
+  if (a.size() != b.size()) return false;
+  uint8_t acc = 0;
+  for (size_t i = 0; i < a.size(); i++) acc |= a[i] ^ b[i];
+  return acc == 0;
+}
+
+}  // namespace raytpu

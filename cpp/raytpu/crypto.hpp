@@ -1,0 +1,69 @@
+// Crypto primitives for the ray_tpu cross-language wire.
+//
+// The wire handshake needs HMAC-SHA256 (challenge proofs + MAC-key
+// derivation) and keyed BLAKE2b-128 (per-frame MACs) — see
+// ray_tpu/runtime/rpc.py for the protocol. The toolchain image ships no
+// OpenSSL headers, so both algorithms are implemented here from their
+// public specifications (FIPS 180-4 and RFC 7693). Small, dependency-free,
+// and covered by test vectors cross-checked against Python hashlib in
+// tests/test_xlang_cpp.py.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace raytpu {
+
+using Bytes = std::vector<uint8_t>;
+
+// ------------------------------------------------------------- SHA-256
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buflen = 0;
+
+  Sha256();
+  void update(const uint8_t* data, size_t n);
+  void update(const Bytes& b) { update(b.data(), b.size()); }
+  Bytes digest();  // finalizes; object must not be reused afterwards
+
+ private:
+  void compress(const uint8_t* block);
+};
+
+Bytes sha256(const Bytes& data);
+Bytes hmac_sha256(const Bytes& key, const Bytes& msg);
+
+// ------------------------------------------------- BLAKE2b (RFC 7693)
+
+// Keyed, sequential mode, configurable digest size (wire uses 16).
+struct Blake2b {
+  uint64_t h[8];
+  uint64_t t = 0;       // bytes compressed so far
+  uint8_t buf[128];
+  size_t buflen = 0;
+  size_t outlen;
+
+  explicit Blake2b(size_t digest_size, const Bytes& key = {});
+  void update(const uint8_t* data, size_t n);
+  void update(const Bytes& b) { update(b.data(), b.size()); }
+  Bytes digest();  // finalizes
+
+ private:
+  void compress(const uint8_t* block, bool last);
+};
+
+Bytes blake2b(const Bytes& data, size_t digest_size, const Bytes& key = {});
+
+// ------------------------------------------------------------- helpers
+
+Bytes from_hex(const std::string& hex);
+std::string to_hex(const Bytes& b);
+bool const_time_eq(const Bytes& a, const Bytes& b);
+
+}  // namespace raytpu

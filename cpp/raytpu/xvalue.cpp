@@ -1,0 +1,244 @@
+#include "xvalue.hpp"
+
+#include <cstring>
+#include <sstream>
+
+namespace raytpu {
+
+static void put_u16(Bytes& out, uint16_t v) {
+  out.push_back(uint8_t(v));
+  out.push_back(uint8_t(v >> 8));
+}
+
+static void put_u32(Bytes& out, uint32_t v) {
+  for (int i = 0; i < 4; i++) out.push_back(uint8_t(v >> (8 * i)));
+}
+
+static void put_u64(Bytes& out, uint64_t v) {
+  for (int i = 0; i < 8; i++) out.push_back(uint8_t(v >> (8 * i)));
+}
+
+static uint32_t get_u32(const Bytes& buf, size_t& pos) {
+  if (pos + 4 > buf.size()) throw std::runtime_error("xvalue: truncated u32");
+  uint32_t v = 0;
+  for (int i = 3; i >= 0; i--) v = (v << 8) | buf[pos + i];
+  pos += 4;
+  return v;
+}
+
+static uint64_t get_u64(const Bytes& buf, size_t& pos) {
+  if (pos + 8 > buf.size()) throw std::runtime_error("xvalue: truncated u64");
+  uint64_t v = 0;
+  for (int i = 7; i >= 0; i--) v = (v << 8) | buf[pos + i];
+  pos += 8;
+  return v;
+}
+
+static void need(const Bytes& buf, size_t pos, size_t n) {
+  if (pos + n > buf.size()) throw std::runtime_error("xvalue: truncated");
+}
+
+void XValue::encode(Bytes& out) const {
+  out.push_back(uint8_t(tag_));
+  switch (tag_) {
+    case Tag::None:
+    case Tag::False_:
+    case Tag::True_:
+      break;
+    case Tag::Int:
+      put_u64(out, uint64_t(i_));
+      break;
+    case Tag::Float: {
+      uint64_t bits;
+      std::memcpy(&bits, &f_, 8);
+      put_u64(out, bits);
+      break;
+    }
+    case Tag::Str:
+      put_u32(out, uint32_t(s_.size()));
+      out.insert(out.end(), s_.begin(), s_.end());
+      break;
+    case Tag::Binary:
+      put_u32(out, uint32_t(b_.size()));
+      out.insert(out.end(), b_.begin(), b_.end());
+      break;
+    case Tag::List:
+      put_u32(out, uint32_t(list_->size()));
+      for (const auto& v : *list_) v.encode(out);
+      break;
+    case Tag::Dict:
+      put_u32(out, uint32_t(dict_->size()));
+      for (const auto& [k, v] : *dict_) {
+        put_u32(out, uint32_t(k.size()));
+        out.insert(out.end(), k.begin(), k.end());
+        v.encode(out);
+      }
+      break;
+    case Tag::NdArray: {
+      const XArray& a = *arr_;
+      if (a.dtype.size() > 255) throw std::runtime_error("dtype too long");
+      out.push_back(uint8_t(a.dtype.size()));
+      out.insert(out.end(), a.dtype.begin(), a.dtype.end());
+      out.push_back(uint8_t(a.dims.size()));
+      for (uint64_t d : a.dims) put_u64(out, d);
+      out.insert(out.end(), a.data.begin(), a.data.end());
+      break;
+    }
+  }
+}
+
+XValue XValue::decode(const Bytes& buf, size_t& pos) {
+  need(buf, pos, 1);
+  uint8_t tag = buf[pos++];
+  switch (Tag(tag)) {
+    case Tag::None:
+      return XValue();
+    case Tag::False_:
+      return XValue(false);
+    case Tag::True_:
+      return XValue(true);
+    case Tag::Int:
+      return XValue(int64_t(get_u64(buf, pos)));
+    case Tag::Float: {
+      uint64_t bits = get_u64(buf, pos);
+      double d;
+      std::memcpy(&d, &bits, 8);
+      return XValue(d);
+    }
+    case Tag::Str: {
+      uint32_t n = get_u32(buf, pos);
+      need(buf, pos, n);
+      std::string s(buf.begin() + pos, buf.begin() + pos + n);
+      pos += n;
+      return XValue(std::move(s));
+    }
+    case Tag::Binary: {
+      uint32_t n = get_u32(buf, pos);
+      need(buf, pos, n);
+      Bytes b(buf.begin() + pos, buf.begin() + pos + n);
+      pos += n;
+      return XValue(std::move(b));
+    }
+    case Tag::List: {
+      uint32_t n = get_u32(buf, pos);
+      XList l;
+      l.reserve(n);
+      for (uint32_t i = 0; i < n; i++) l.push_back(decode(buf, pos));
+      return XValue(std::move(l));
+    }
+    case Tag::Dict: {
+      uint32_t n = get_u32(buf, pos);
+      XDict d;
+      for (uint32_t i = 0; i < n; i++) {
+        uint32_t kl = get_u32(buf, pos);
+        need(buf, pos, kl);
+        std::string k(buf.begin() + pos, buf.begin() + pos + kl);
+        pos += kl;
+        d.emplace(std::move(k), decode(buf, pos));
+      }
+      return XValue(std::move(d));
+    }
+    case Tag::NdArray: {
+      need(buf, pos, 1);
+      uint8_t dl = buf[pos++];
+      need(buf, pos, dl);
+      XArray a;
+      a.dtype.assign(buf.begin() + pos, buf.begin() + pos + dl);
+      pos += dl;
+      need(buf, pos, 1);
+      uint8_t ndim = buf[pos++];
+      uint64_t count = 1;
+      for (uint8_t i = 0; i < ndim; i++) {
+        a.dims.push_back(get_u64(buf, pos));
+        count *= a.dims.back();
+      }
+      // itemsize = trailing digits of the dtype str ("<f4" -> 4).
+      size_t isz = 0;
+      for (char c : a.dtype)
+        if (c >= '0' && c <= '9') isz = isz * 10 + size_t(c - '0');
+      if (isz == 0) throw std::runtime_error("bad dtype: " + a.dtype);
+      uint64_t nbytes = count * isz;
+      need(buf, pos, nbytes);
+      a.data.assign(buf.begin() + pos, buf.begin() + pos + nbytes);
+      pos += nbytes;
+      return XValue(std::move(a));
+    }
+  }
+  throw std::runtime_error("xvalue: unknown tag " + std::to_string(tag));
+}
+
+std::string XValue::repr() const {
+  std::ostringstream os;
+  switch (tag_) {
+    case Tag::None: os << "null"; break;
+    case Tag::False_: os << "false"; break;
+    case Tag::True_: os << "true"; break;
+    case Tag::Int: os << i_; break;
+    case Tag::Float: os << f_; break;
+    case Tag::Str: os << '"' << s_ << '"'; break;
+    case Tag::Binary: os << "b:" << to_hex(b_); break;
+    case Tag::List: {
+      os << '[';
+      bool first = true;
+      for (const auto& v : *list_) {
+        if (!first) os << ", ";
+        first = false;
+        os << v.repr();
+      }
+      os << ']';
+      break;
+    }
+    case Tag::Dict: {
+      os << '{';
+      bool first = true;
+      for (const auto& [k, v] : *dict_) {
+        if (!first) os << ", ";
+        first = false;
+        os << '"' << k << "\": " << v.repr();
+      }
+      os << '}';
+      break;
+    }
+    case Tag::NdArray: {
+      os << "ndarray(" << arr_->dtype << ", [";
+      for (size_t i = 0; i < arr_->dims.size(); i++)
+        os << (i ? "," : "") << arr_->dims[i];
+      os << "], " << arr_->data.size() << "B)";
+      break;
+    }
+  }
+  return os.str();
+}
+
+// ------------------------------------------------------------- envelope
+
+Bytes Envelope::encode() const {
+  Bytes out;
+  out.push_back(kind);
+  out.push_back(has_msg_id ? 1 : 0);
+  put_u64(out, has_msg_id ? msg_id : 0);
+  put_u16(out, uint16_t(method.size()));
+  out.insert(out.end(), method.begin(), method.end());
+  data.encode(out);
+  return out;
+}
+
+Envelope Envelope::decode(const Bytes& body) {
+  if (body.size() < 12) throw std::runtime_error("envelope: truncated");
+  Envelope e;
+  e.kind = body[0];
+  e.has_msg_id = body[1] != 0;
+  size_t pos = 2;
+  e.msg_id = get_u64(body, pos);
+  need(body, pos, 2);
+  uint16_t ml = uint16_t(body[pos]) | (uint16_t(body[pos + 1]) << 8);
+  pos += 2;
+  need(body, pos, ml);
+  e.method.assign(body.begin() + pos, body.begin() + pos + ml);
+  pos += ml;
+  e.data = XValue::decode(body, pos);
+  if (pos != body.size()) throw std::runtime_error("envelope: trailing bytes");
+  return e;
+}
+
+}  // namespace raytpu

@@ -1,0 +1,113 @@
+// XValue: the cross-language value vocabulary of the ray_tpu wire.
+//
+// Byte-exact mirror of ray_tpu/runtime/xlang.py (tags, little-endian
+// layout). Dynamically typed variant; ndarrays carry dtype string +
+// dims + raw buffer and map to numpy on the Python side.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "crypto.hpp"  // Bytes
+
+namespace raytpu {
+
+class XValue;
+using XList = std::vector<XValue>;
+using XDict = std::map<std::string, XValue>;
+
+struct XArray {
+  std::string dtype;           // numpy dtype str, e.g. "<f4"
+  std::vector<uint64_t> dims;  // C-order
+  Bytes data;
+};
+
+class XValue {
+ public:
+  enum class Tag : uint8_t {
+    None = 0, False_ = 1, True_ = 2, Int = 3, Float = 4,
+    Str = 5, Binary = 6, List = 7, Dict = 8, NdArray = 9,
+  };
+
+  XValue() : tag_(Tag::None) {}
+  XValue(std::nullptr_t) : tag_(Tag::None) {}
+  XValue(bool b) : tag_(b ? Tag::True_ : Tag::False_) {}
+  XValue(int64_t i) : tag_(Tag::Int), i_(i) {}
+  XValue(int i) : tag_(Tag::Int), i_(i) {}
+  XValue(double d) : tag_(Tag::Float), f_(d) {}
+  XValue(const char* s) : tag_(Tag::Str), s_(s) {}
+  XValue(std::string s) : tag_(Tag::Str), s_(std::move(s)) {}
+  XValue(Bytes b) : tag_(Tag::Binary), b_(std::move(b)) {}
+  XValue(XList l) : tag_(Tag::List), list_(std::make_shared<XList>(std::move(l))) {}
+  XValue(XDict d) : tag_(Tag::Dict), dict_(std::make_shared<XDict>(std::move(d))) {}
+  XValue(XArray a) : tag_(Tag::NdArray), arr_(std::make_shared<XArray>(std::move(a))) {}
+
+  Tag tag() const { return tag_; }
+  bool is_none() const { return tag_ == Tag::None; }
+  bool is_error_dict() const {
+    return tag_ == Tag::Dict && dict_->count("error");
+  }
+
+  bool as_bool() const {
+    check(tag_ == Tag::True_ || tag_ == Tag::False_, "bool");
+    return tag_ == Tag::True_;
+  }
+  int64_t as_int() const { check(tag_ == Tag::Int, "int"); return i_; }
+  double as_float() const {
+    if (tag_ == Tag::Int) return double(i_);
+    check(tag_ == Tag::Float, "float");
+    return f_;
+  }
+  const std::string& as_str() const { check(tag_ == Tag::Str, "str"); return s_; }
+  const Bytes& as_bytes() const { check(tag_ == Tag::Binary, "bytes"); return b_; }
+  const XList& as_list() const { check(tag_ == Tag::List, "list"); return *list_; }
+  const XDict& as_dict() const { check(tag_ == Tag::Dict, "dict"); return *dict_; }
+  const XArray& as_array() const { check(tag_ == Tag::NdArray, "ndarray"); return *arr_; }
+
+  const XValue& at(const std::string& key) const {
+    const auto& d = as_dict();
+    auto it = d.find(key);
+    if (it == d.end()) throw std::out_of_range("no key: " + key);
+    return it->second;
+  }
+
+  void encode(Bytes& out) const;
+  static XValue decode(const Bytes& buf, size_t& pos);
+
+  std::string repr() const;  // stable text rendering (CLI output)
+
+ private:
+  void check(bool ok, const char* want) const {
+    if (!ok) throw std::runtime_error(std::string("xvalue is not ") + want);
+  }
+
+  Tag tag_;
+  int64_t i_ = 0;
+  double f_ = 0;
+  std::string s_;
+  Bytes b_;
+  std::shared_ptr<XList> list_;
+  std::shared_ptr<XDict> dict_;
+  std::shared_ptr<XArray> arr_;
+};
+
+// Envelope (body of one RTX frame).
+struct Envelope {
+  uint8_t kind;
+  bool has_msg_id;
+  uint64_t msg_id;
+  std::string method;
+  XValue data;
+
+  Bytes encode() const;
+  static Envelope decode(const Bytes& body);
+};
+
+constexpr uint8_t KIND_REQUEST = 0, KIND_REPLY = 1, KIND_ERROR = 2,
+                  KIND_PUSH = 3;
+
+}  // namespace raytpu

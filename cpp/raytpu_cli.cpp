@@ -1,0 +1,201 @@
+// raytpu_cli — command-line driver for the C++ client (and its test
+// harness: tests/test_xlang_cpp.py shells out to this binary).
+//
+// Usage:
+//   raytpu_cli selftest
+//       print crypto vectors (sha256/hmac/blake2b of fixed inputs) for
+//       cross-checking against Python hashlib.
+//   raytpu_cli --addr HOST:PORT [--token-hex HEX] CMD...
+//     hello
+//     call NAME [ARG...]          submit + get the result
+//     submit NAME [ARG...]        submit, print ref hex (no wait)
+//     get REFHEX
+//     put ARG                     print ref hex
+//     kvput KEY ARG               value must be b:HEX or s:text
+//     kvget KEY
+//     actorcall NAME METHOD [ARG...]   named actor, submit + get
+//     exec CMD... [-- CMD...]...  several commands on ONE connection
+//                                 (refs are session-scoped; @N names the
+//                                 ref produced by the Nth sub-command)
+//
+// ARG syntax: i:123  f:1.5  s:text  b:hex  true  false  null
+//             ref:REFHEX (object-ref argument; REFHEX may be @N in exec)
+
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <string>
+
+#include "raytpu/client.hpp"
+
+using namespace raytpu;
+
+static XValue parse_arg(const std::string& a) {
+  if (a == "true") return XValue(true);
+  if (a == "false") return XValue(false);
+  if (a == "null") return XValue();
+  if (a.rfind("i:", 0) == 0) return XValue(int64_t(std::stoll(a.substr(2))));
+  if (a.rfind("f:", 0) == 0) return XValue(std::stod(a.substr(2)));
+  if (a.rfind("s:", 0) == 0) return XValue(a.substr(2));
+  if (a.rfind("b:", 0) == 0) return XValue(from_hex(a.substr(2)));
+  if (a.rfind("ref:", 0) == 0) return Client::ref_arg(from_hex(a.substr(4)));
+  throw std::runtime_error("bad arg (want i:/f:/s:/b:/ref:/true/false/null): " + a);
+}
+
+static int selftest() {
+  // Vectors printed for the Python side to compare against hashlib.
+  Bytes abc{'a', 'b', 'c'};
+  Bytes key{'k', 'e', 'y'};
+  std::printf("sha256_abc=%s\n", to_hex(sha256(abc)).c_str());
+  std::printf("sha256_empty=%s\n", to_hex(sha256({})).c_str());
+  std::printf("hmac_key_abc=%s\n", to_hex(hmac_sha256(key, abc)).c_str());
+  std::printf("blake2b16_abc=%s\n", to_hex(blake2b(abc, 16)).c_str());
+  std::printf("blake2b16_key_abc=%s\n",
+              to_hex(blake2b(abc, 16, key)).c_str());
+  Bytes big(300);  // multi-block message
+  for (size_t i = 0; i < big.size(); i++) big[i] = uint8_t(i);
+  std::printf("blake2b16_key_big=%s\n",
+              to_hex(blake2b(big, 16, key)).c_str());
+  std::printf("sha256_big=%s\n", to_hex(sha256(big)).c_str());
+  // XValue roundtrip sanity.
+  XDict d;
+  d.emplace("i", XValue(int64_t(-7)));
+  d.emplace("l", XValue(XList{XValue("x"), XValue(1.5), XValue()}));
+  Bytes enc;
+  XValue(d).encode(enc);
+  size_t pos = 0;
+  XValue back = XValue::decode(enc, pos);
+  std::printf("xvalue_roundtrip=%s\n",
+              (pos == enc.size() && back.repr() == XValue(d).repr()) ? "ok"
+                                                                     : "FAIL");
+  std::printf("xvalue_hex=%s\n", to_hex(enc).c_str());
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  try {
+    std::string addr, token;
+    int i = 1;
+    for (; i < argc; i++) {
+      std::string a = argv[i];
+      if (a == "--addr" && i + 1 < argc)
+        addr = argv[++i];
+      else if (a == "--token-hex" && i + 1 < argc)
+        token = argv[++i];
+      else
+        break;
+    }
+    if (i >= argc) {
+      std::fprintf(stderr, "no command\n");
+      return 2;
+    }
+    std::string cmd = argv[i++];
+    if (cmd == "selftest") return selftest();
+
+    auto colon = addr.rfind(':');
+    if (colon == std::string::npos) {
+      std::fprintf(stderr, "--addr HOST:PORT required\n");
+      return 2;
+    }
+    Client client(addr.substr(0, colon),
+                  uint16_t(std::stoi(addr.substr(colon + 1))), token);
+
+    if (cmd == "exec") {
+      // Sub-commands share this one connection/session. Each produced
+      // ref is remembered; "@N" in ref:/get/ args resolves to the Nth.
+      std::vector<Bytes> made_refs;
+      auto resolve_hex = [&](const std::string& h) {
+        if (!h.empty() && h[0] == '@')
+          return made_refs.at(size_t(std::stoul(h.substr(1))));
+        return from_hex(h);
+      };
+      while (i < argc) {
+        std::string sub = argv[i++];
+        XList args;
+        auto take_args = [&]() {
+          while (i < argc && std::strcmp(argv[i], "--") != 0) {
+            std::string a = argv[i++];
+            if (a.rfind("ref:", 0) == 0)
+              args.push_back(Client::ref_arg(resolve_hex(a.substr(4))));
+            else
+              args.push_back(parse_arg(a));
+          }
+        };
+        if (sub == "put") {
+          take_args();
+          made_refs.push_back(client.put(args.at(0)));
+          std::printf("ref=@%zu\n", made_refs.size() - 1);
+        } else if (sub == "submit") {
+          std::string name = argv[i++];
+          take_args();
+          made_refs.push_back(client.submit(name, std::move(args)));
+          std::printf("ref=@%zu\n", made_refs.size() - 1);
+        } else if (sub == "call") {
+          std::string name = argv[i++];
+          take_args();
+          Bytes ref = client.submit(name, std::move(args));
+          made_refs.push_back(ref);
+          std::printf("%s\n", client.get(ref).repr().c_str());
+        } else if (sub == "get") {
+          std::string h = argv[i++];
+          std::printf("%s\n", client.get(resolve_hex(h)).repr().c_str());
+          take_args();
+        } else {
+          std::fprintf(stderr, "unknown exec sub-command %s\n", sub.c_str());
+          return 2;
+        }
+        if (i < argc && std::strcmp(argv[i], "--") == 0) i++;
+      }
+      return 0;
+    }
+
+    auto rest_args = [&]() {
+      XList args;
+      for (; i < argc; i++) args.push_back(parse_arg(argv[i]));
+      return args;
+    };
+
+    if (cmd == "hello") {
+      std::printf("%s\n", client.hello().repr().c_str());
+    } else if (cmd == "call" || cmd == "submit") {
+      std::string name = argv[i++];
+      Bytes ref = client.submit(name, rest_args());
+      if (cmd == "submit")
+        std::printf("ref=%s\n", to_hex(ref).c_str());
+      else
+        std::printf("%s\n", client.get(ref).repr().c_str());
+    } else if (cmd == "get") {
+      Bytes ref = from_hex(argv[i++]);
+      std::printf("%s\n", client.get(ref).repr().c_str());
+    } else if (cmd == "put") {
+      Bytes ref = client.put(parse_arg(argv[i++]));
+      std::printf("ref=%s\n", to_hex(ref).c_str());
+    } else if (cmd == "kvput") {
+      std::string key = argv[i++];
+      XValue v = parse_arg(argv[i++]);
+      client.kv_put(key, v.tag() == XValue::Tag::Str
+                             ? Bytes(v.as_str().begin(), v.as_str().end())
+                             : v.as_bytes());
+      std::printf("ok\n");
+    } else if (cmd == "kvget") {
+      auto v = client.kv_get(argv[i++]);
+      if (v)
+        std::printf("b:%s\n", to_hex(*v).c_str());
+      else
+        std::printf("null\n");
+    } else if (cmd == "actorcall") {
+      std::string name = argv[i++];
+      std::string method = argv[i++];
+      Bytes aid = client.actor_get(name);
+      Bytes ref = client.actor_call(aid, method, rest_args());
+      std::printf("%s\n", client.get(ref).repr().c_str());
+    } else {
+      std::fprintf(stderr, "unknown command %s\n", cmd.c_str());
+      return 2;
+    }
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

@@ -429,9 +429,11 @@ class LLMEngine:
         # prefills batch, so servers default to it.
         combos = {(batch_buckets[0], cb) for cb in chunk_buckets}
         combos |= {(sb, 1) for sb in batch_buckets}
+        verify_widths = ({cb for cb in r.chunk_buckets() if cb <= spec_cap}
+                         if spec_cap else set())
         if spec_cap:
             combos |= {(sb, cb) for sb in batch_buckets
-                       for cb in r.chunk_buckets() if cb <= spec_cap}
+                       for cb in verify_widths}
         if full:
             combos |= {(sb, cb) for sb in batch_buckets
                        for cb in chunk_buckets}
@@ -443,7 +445,10 @@ class LLMEngine:
             samp = (np.zeros(S, np.float32), np.zeros(S, np.int32),
                     np.ones(S, np.float32), np.zeros(S, np.int32), zeros)
             r.step_sample(*args, *samp)
-            if spec_cap and 8 <= Bq <= spec_cap:
+            if Bq in verify_widths:
+                # Membership in the runner's own ladder (not a hardcoded
+                # lower bound): a chunk_size < 8 config has ladder
+                # [chunk_size], and its verify bucket must warm too.
                 r.step_verify(*args)
             if full:
                 # Host-logits path (runner.step): taken whenever a request

@@ -45,6 +45,11 @@ def _chaos_enabled() -> bool:
 # error instead of undefined unpickling behavior.
 PROTOCOL_VERSION = 1
 _MAGIC = b"RTP" + bytes([PROTOCOL_VERSION])
+# Cross-language dialect: same framing/auth/MAC, body is the xlang binary
+# envelope (runtime/xlang.py) instead of pickle — what non-Python peers
+# (cpp/raytpu_client) speak. A connection switches to xlang replies after
+# its first RTX frame.
+_X_MAGIC = b"RTX" + bytes([PROTOCOL_VERSION])
 _HDR = struct.Struct("<4sI")
 KIND_REQUEST, KIND_REPLY, KIND_ERROR, KIND_PUSH = 0, 1, 2, 3
 MAX_FRAME = 1 << 31
@@ -229,10 +234,36 @@ class AuthError(RpcError):
 
 
 async def _read_frame(reader: asyncio.StreamReader,
-                      mac: Optional[_FrameMac] = None):
+                      mac: Optional[_FrameMac] = None,
+                      conn: Optional["ServerConnection"] = None):
     hdr = await reader.readexactly(_HDR.size)
     magic, length = _HDR.unpack(hdr)
+    if magic == _X_MAGIC and conn is not None:
+        # Cross-language peer (servers only — Python clients never get RTX
+        # replies). MAC still verifies before any decoding.
+        if length > MAX_FRAME:
+            raise RpcError(f"frame too large: {length}")
+        body = await reader.readexactly(length)
+        if mac is not None:
+            tag = await reader.readexactly(_MAC_SIZE)
+            if not mac.verify(body, tag):
+                raise AuthError("frame MAC verification failed")
+        from ray_tpu.runtime import xlang
+
+        conn.xlang = True
+        try:
+            return xlang.decode_envelope(body)
+        except Exception as e:
+            # Foreign implementations are where malformed frames are the
+            # EXPECTED failure mode: drop via the clean protocol path.
+            raise ProtocolMismatch(f"malformed xlang frame: "
+                                   f"{type(e).__name__}: {e}")
     if magic != _MAGIC:
+        if magic[:3] == b"RTX":
+            raise ProtocolMismatch(
+                f"peer speaks xlang wire v{magic[3]}, this process speaks "
+                f"v{PROTOCOL_VERSION}" if magic[3] != PROTOCOL_VERSION
+                else "xlang frames are only accepted by servers")
         if magic[:3] == b"RTA":
             raise ProtocolMismatch(
                 "server requires wire authentication but this process has "
@@ -336,7 +367,8 @@ class RpcServer:
         try:
             while True:
                 try:
-                    kind, msg_id, method, data = await _read_frame(reader, mac)
+                    kind, msg_id, method, data = await _read_frame(
+                        reader, mac, conn=conn)
                 except (asyncio.IncompleteReadError, ConnectionResetError, EOFError):
                     break
                 except AuthError as e:
@@ -420,12 +452,31 @@ class ServerConnection:
         self._mac = mac
         self._lock = asyncio.Lock()
         self.meta: Dict[str, Any] = {}  # handlers stash identity here
+        self.xlang = False  # set by _read_frame on the first RTX frame
 
     async def send(self, payload):
         async with self._lock:
             # Sealing must happen under the lock: the MAC sequence number
             # must match the byte order frames hit the socket in.
-            data = _frame(payload, self._mac)
+            if self.xlang:
+                from ray_tpu.runtime import xlang
+
+                kind, msg_id, method, pdata = payload
+                try:
+                    body = xlang.encode_envelope(
+                        kind, msg_id, method, xlang.sanitize_reply(pdata))
+                except xlang.XEncodeError as e:
+                    # Strict wire: a reply outside the xlang vocabulary
+                    # becomes a structured error, never a repr()-corrupted
+                    # value and never a dead connection.
+                    body = xlang.encode_envelope(
+                        KIND_ERROR, msg_id, method,
+                        f"reply not cross-language representable: {e}")
+                data = _HDR.pack(_X_MAGIC, len(body)) + body
+                if self._mac is not None:
+                    data += self._mac.seal(body)
+            else:
+                data = _frame(payload, self._mac)
             self.writer.write(data)
             await self.writer.drain()
 

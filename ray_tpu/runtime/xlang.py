@@ -1,0 +1,249 @@
+"""Cross-language wire values + envelope (the non-pickle RPC dialect).
+
+Reference analog: src/ray/common/ray_object.h + the msgpack-based
+cross-language serialization used by the Java/C++ workers
+(src/ray/core_worker/transport/ — cross-language args must be
+language-neutral, never pickled). Our Python wire frames carry pickled
+envelopes; a C++ (or any non-Python) peer instead sends frames tagged
+with the `RTX` magic whose body is this self-describing binary encoding.
+Transport auth (mutual HMAC handshake + per-frame MAC, runtime/rpc.py)
+is identical for both dialects — the MAC covers the body bytes before
+either decoder runs.
+
+XValue encoding (one tag byte, little-endian everywhere):
+
+  0x00 None        --
+  0x01 False       --
+  0x02 True        --
+  0x03 int         8B signed
+  0x04 float       8B IEEE-754 double
+  0x05 str         u32 len + utf-8
+  0x06 bytes       u32 len + raw
+  0x07 list        u32 count + XValue*
+  0x08 dict        u32 count + (u32 keylen + utf-8 key + XValue)*
+  0x09 ndarray     u8 dtypelen + ascii dtype ("<f4"...), u8 ndim,
+                   u64*ndim dims, raw C-order buffer
+
+Envelope (body of one RTX frame):
+
+  u8 kind | u8 has_msg_id | u64 msg_id | u16 methodlen + utf-8 method |
+  XValue data (dict for requests; any XValue for replies)
+
+Anything not representable raises XEncodeError — cross-language calls
+are restricted to this vocabulary by design (the pickle escape hatch is
+exactly what a non-Python peer must not need).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Tuple
+
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+T_NONE, T_FALSE, T_TRUE, T_INT, T_FLOAT = 0, 1, 2, 3, 4
+T_STR, T_BYTES, T_LIST, T_DICT, T_NDARRAY = 5, 6, 7, 8, 9
+
+
+class XEncodeError(TypeError):
+    pass
+
+
+class XDecodeError(ValueError):
+    pass
+
+
+def encode_value(v: Any, out: bytearray) -> None:
+    if v is None:
+        out.append(T_NONE)
+    elif v is False:
+        out.append(T_FALSE)
+    elif v is True:
+        out.append(T_TRUE)
+    elif isinstance(v, int):
+        out.append(T_INT)
+        try:
+            out += _I64.pack(v)
+        except struct.error:
+            raise XEncodeError(f"int {v} outside the wire's int64 range")
+    elif isinstance(v, float):
+        out.append(T_FLOAT)
+        out += _F64.pack(v)
+    elif isinstance(v, str):
+        b = v.encode("utf-8")
+        out.append(T_STR)
+        out += _U32.pack(len(b))
+        out += b
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        b = bytes(v)
+        out.append(T_BYTES)
+        out += _U32.pack(len(b))
+        out += b
+    elif isinstance(v, (list, tuple)):
+        out.append(T_LIST)
+        out += _U32.pack(len(v))
+        for item in v:
+            encode_value(item, out)
+    elif isinstance(v, dict):
+        out.append(T_DICT)
+        out += _U32.pack(len(v))
+        for k, item in v.items():
+            if not isinstance(k, str):
+                raise XEncodeError(
+                    f"xlang dict keys must be str, got {type(k).__name__}")
+            kb = k.encode("utf-8")
+            out += _U32.pack(len(kb))
+            out += kb
+            encode_value(item, out)
+    else:
+        import numpy as np
+
+        if isinstance(v, np.ndarray):
+            # ascontiguousarray promotes 0-d to 1-d; keep the true shape.
+            arr = np.ascontiguousarray(v).reshape(v.shape)
+            dt = arr.dtype.str.encode("ascii")  # e.g. b"<f4"
+            out.append(T_NDARRAY)
+            out.append(len(dt))
+            out += dt
+            out.append(arr.ndim)
+            for d in arr.shape:
+                out += _U64.pack(d)
+            out += arr.tobytes()
+        elif isinstance(v, (np.integer,)):
+            encode_value(int(v), out)
+        elif isinstance(v, (np.floating,)):
+            encode_value(float(v), out)
+        elif isinstance(v, (np.bool_,)):
+            encode_value(bool(v), out)
+        else:
+            raise XEncodeError(
+                f"type {type(v).__name__} is not cross-language "
+                "representable (allowed: None/bool/int/float/str/bytes/"
+                "list/dict/ndarray)")
+
+
+def encode(v: Any) -> bytes:
+    out = bytearray()
+    encode_value(v, out)
+    return bytes(out)
+
+
+def _decode(buf: memoryview, pos: int) -> Tuple[Any, int]:
+    tag = buf[pos]
+    pos += 1
+    if tag == T_NONE:
+        return None, pos
+    if tag == T_FALSE:
+        return False, pos
+    if tag == T_TRUE:
+        return True, pos
+    if tag == T_INT:
+        return _I64.unpack_from(buf, pos)[0], pos + 8
+    if tag == T_FLOAT:
+        return _F64.unpack_from(buf, pos)[0], pos + 8
+    if tag == T_STR:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        return bytes(buf[pos:pos + n]).decode("utf-8"), pos + n
+    if tag == T_BYTES:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        return bytes(buf[pos:pos + n]), pos + n
+    if tag == T_LIST:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        items = []
+        for _ in range(n):
+            item, pos = _decode(buf, pos)
+            items.append(item)
+        return items, pos
+    if tag == T_DICT:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        d = {}
+        for _ in range(n):
+            kl = _U32.unpack_from(buf, pos)[0]
+            pos += 4
+            k = bytes(buf[pos:pos + kl]).decode("utf-8")
+            pos += kl
+            d[k], pos = _decode(buf, pos)
+        return d, pos
+    if tag == T_NDARRAY:
+        import numpy as np
+
+        dl = buf[pos]
+        pos += 1
+        dt = np.dtype(bytes(buf[pos:pos + dl]).decode("ascii"))
+        pos += dl
+        ndim = buf[pos]
+        pos += 1
+        shape = []
+        for _ in range(ndim):
+            shape.append(_U64.unpack_from(buf, pos)[0])
+            pos += 8
+        nbytes = dt.itemsize
+        for d in shape:
+            nbytes *= d
+        arr = np.frombuffer(
+            bytes(buf[pos:pos + nbytes]), dtype=dt).reshape(shape)
+        return arr, pos + nbytes
+    raise XDecodeError(f"unknown xvalue tag {tag}")
+
+
+def decode(data) -> Any:
+    v, pos = _decode(memoryview(data), 0)
+    if pos != len(data):
+        raise XDecodeError(f"trailing bytes after xvalue ({len(data)-pos})")
+    return v
+
+
+# ------------------------------------------------------------- envelope
+
+def encode_envelope(kind: int, msg_id, method: str, data: Any) -> bytes:
+    mb = method.encode("utf-8")
+    out = bytearray()
+    out.append(kind)
+    out.append(0 if msg_id is None else 1)
+    out += _U64.pack(msg_id or 0)
+    out += _U16.pack(len(mb))
+    out += mb
+    encode_value(data, out)
+    return bytes(out)
+
+
+def decode_envelope(body) -> Tuple[int, Any, str, Any]:
+    buf = memoryview(body)
+    kind = buf[0]
+    has_id = buf[1]
+    msg_id = _U64.unpack_from(buf, 2)[0]
+    ml = _U16.unpack_from(buf, 10)[0]
+    method = bytes(buf[12:12 + ml]).decode("utf-8")
+    data, pos = _decode(buf, 12 + ml)
+    if pos != len(buf):
+        raise XDecodeError("trailing bytes after envelope")
+    return kind, (msg_id if has_id else None), method, data
+
+
+def sanitize_reply(v: Any) -> Any:
+    """Normalize a handler reply for the xlang wire: exceptions become
+    strings (the error-reply convention), containers recurse, numpy
+    scalars unwrap. Anything else non-representable is left as-is so the
+    subsequent encode raises XEncodeError — the transport then reports a
+    structured error instead of silently repr()-corrupting a value."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [sanitize_reply(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): sanitize_reply(x) for k, x in v.items()}
+    import numpy as np
+
+    if isinstance(v, (np.integer, np.floating, np.bool_)):
+        return v.item()
+    if isinstance(v, BaseException):
+        return f"{type(v).__name__}: {v}"
+    return v

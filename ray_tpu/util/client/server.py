@@ -106,9 +106,7 @@ class ClientProxyServer:
         s = self._session(conn)
         value = serialization.deserialize(memoryview(payload))
         ref = await self._run(ray_tpu.put, value)
-        rid = ref.id.binary() if hasattr(ref, "id") else ref.binary()
-        s.refs[rid] = ref
-        return {"ref": rid}
+        return {"ref": self._track_ref(s, ref)}
 
     async def handle_client_get(self, conn, refs,
                                 timeout_s: Optional[float] = None):
@@ -180,9 +178,7 @@ class ClientProxyServer:
         args, kwargs = self._resolve_args(s, args_blob)
         target = rf.options(**options) if options else rf
         ref = await self._run(target.remote, *args, **kwargs)
-        rid = ref.id.binary() if hasattr(ref, "id") else ref.binary()
-        s.refs[rid] = ref
-        return {"ref": rid}
+        return {"ref": self._track_ref(s, ref)}
 
     async def handle_client_actor_create(self, conn, cls_blob: bytes,
                                          args_blob: bytes, options: dict):
@@ -210,9 +206,7 @@ class ClientProxyServer:
         args, kwargs = self._resolve_args(s, args_blob)
         ref = await self._run(
             getattr(handle, method_name).remote, *args, **kwargs)
-        rid = ref.id.binary() if hasattr(ref, "id") else ref.binary()
-        s.refs[rid] = ref
-        return {"ref": rid}
+        return {"ref": self._track_ref(s, ref)}
 
     async def handle_client_get_actor(self, conn, name: str,
                                       namespace: Optional[str] = None):
@@ -238,6 +232,144 @@ class ClientProxyServer:
     def session_count(self) -> int:
         return len(self._sessions)
 
+    # -- cross-language ops (xlang dialect; see runtime/xlang.py) ----------
+    #
+    # Non-Python peers (cpp/raytpu_client) reach the cluster through these.
+    # Args/results are restricted to the xlang vocabulary; object refs
+    # travel as bytes and may appear inside args as {"$ref": <bytes>}.
+
+    @staticmethod
+    def _track_ref(s: _Session, ref) -> bytes:
+        rid = ref.id.binary() if hasattr(ref, "id") else ref.binary()
+        s.refs[rid] = ref
+        return rid
+
+    @staticmethod
+    def _xresolve_args(s: _Session, args, kwargs):
+        def resolve(v):
+            if isinstance(v, dict):
+                if set(v) == {"$ref"}:
+                    rid = v["$ref"]
+                    if rid not in s.refs:
+                        raise _UnknownRef(rid)
+                    return s.refs[rid]
+                return {k: resolve(x) for k, x in v.items()}
+            if isinstance(v, list):
+                return [resolve(x) for x in v]
+            return v
+
+        return ([resolve(a) for a in (args or [])],
+                {k: resolve(v) for k, v in (kwargs or {}).items()})
+
+    async def handle_xhello(self, conn):
+        import ray_tpu
+
+        s = self._session(conn)
+        resources = await self._run(ray_tpu.cluster_resources)
+        return {"ok": True, "client_id": s.client_id,
+                "cluster_resources": resources}
+
+    async def handle_xcall(self, conn, name: str, args=None, kwargs=None,
+                           options=None):
+        """Invoke a named/importable Python function as a remote task."""
+        import ray_tpu
+        from ray_tpu.util import cross_language
+
+        s = self._session(conn)
+        fn = cross_language.resolve(name)
+        rf = ray_tpu.remote(fn)
+        if options:
+            rf = rf.options(**options)
+        try:
+            a, kw = self._xresolve_args(s, args, kwargs)
+        except _UnknownRef as e:
+            return {"error": str(e)}
+        ref = await self._run(rf.remote, *a, **kw)
+        return {"ref": self._track_ref(s, ref)}
+
+    async def handle_xput(self, conn, value):
+        import ray_tpu
+
+        s = self._session(conn)
+        ref = await self._run(ray_tpu.put, value)
+        return {"ref": self._track_ref(s, ref)}
+
+    async def handle_xget(self, conn, refs, timeout_s=None):
+        import ray_tpu
+
+        s = self._session(conn)
+        try:
+            targets = [s.refs[r] for r in refs]
+        except KeyError as e:
+            return {"error": f"unknown ref {e}"}
+        try:
+            values = await self._run(ray_tpu.get, targets, timeout=timeout_s)
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+        # Representability is enforced once, at the transport encode
+        # (ServerConnection.send turns XEncodeError into a structured
+        # error reply) — no second serialization pass here.
+        return {"values": list(values)}
+
+    async def handle_xwait(self, conn, refs, num_returns: int = 1,
+                           timeout_s=None):
+        import ray_tpu
+
+        s = self._session(conn)
+        try:
+            targets = [s.refs[r] for r in refs]
+        except KeyError as e:
+            return {"error": f"unknown ref {e}"}
+        ready, pending = await self._run(
+            ray_tpu.wait, targets, num_returns=num_returns,
+            timeout=timeout_s)
+        by_obj = {id(s.refs[r]): r for r in refs}
+        return {"ready": [by_obj[id(o)] for o in ready],
+                "pending": [by_obj[id(o)] for o in pending]}
+
+    async def handle_xactor_get(self, conn, name: str):
+        import ray_tpu
+
+        s = self._session(conn)
+        try:
+            handle = await self._run(ray_tpu.get_actor, name)
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+        s.actors[handle._actor_id] = handle
+        return {"actor_id": handle._actor_id}
+
+    async def handle_xactor_call(self, conn, actor_id: bytes, method: str,
+                                 args=None, kwargs=None):
+        s = self._session(conn)
+        handle = s.actors.get(actor_id)
+        if handle is None:
+            return {"error": f"unknown actor {actor_id.hex()[:12]}"}
+        try:
+            a, kw = self._xresolve_args(s, args, kwargs)
+        except _UnknownRef as e:
+            return {"error": str(e)}
+        ref = await self._run(getattr(handle, method).remote, *a, **kw)
+        return {"ref": self._track_ref(s, ref)}
+
+    async def handle_xkv_get(self, conn, key: str):
+        from ray_tpu.core.worker import global_worker
+
+        reply = await global_worker().gcs.call("kv_get", key=key.encode())
+        return {"value": reply.get("value")}
+
+    async def handle_xkv_put(self, conn, key: str, value: bytes):
+        from ray_tpu.core.worker import global_worker
+
+        reply = await global_worker().gcs.call(
+            "kv_put", key=key.encode(), value=value)
+        return {"ok": bool(reply.get("ok"))}
+
+    async def handle_xrelease(self, conn, refs):
+        s = self._session(conn)
+        for r in refs:
+            s.refs.pop(r, None)
+        return {"ok": True}
+
     async def handle_client_release(self, conn, refs):
         """Client-side ref went out of scope: drop the proxy's handle."""
         s = self._session(conn)
@@ -254,6 +386,18 @@ def _safe_exc(e: BaseException):
         return e
     except Exception:
         return None
+
+
+class _UnknownRef(KeyError):
+    """A {"$ref": ...} arg names a ref this session doesn't hold (released
+    via xrelease, or stale after reconnect)."""
+
+    def __init__(self, rid: bytes):
+        super().__init__(rid)
+        self.rid = rid
+
+    def __str__(self):
+        return f"unknown ref {self.rid.hex()[:24]}"
 
 
 class _ClientRefMarker:

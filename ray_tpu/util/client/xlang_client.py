@@ -1,0 +1,134 @@
+"""Synchronous xlang client — the reference implementation of the
+cross-language wire that cpp/raytpu_client implements in C++.
+
+Pickle-free on the wire: frames use the RTX magic and carry xlang binary
+envelopes (runtime/xlang.py). Auth is the same mutual HMAC handshake and
+per-frame blake2b MAC as the Python dialect (runtime/rpc.py) — this
+class re-derives both from primitives (hmac/hashlib) rather than reusing
+rpc.py internals, so it doubles as an executable spec for non-Python
+ports: if this client can talk to the server, a byte-identical C++
+implementation can too.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import socket
+import struct
+from typing import Any, Optional
+
+from ray_tpu.runtime import xlang
+from ray_tpu.runtime.rpc import (KIND_ERROR, KIND_REPLY, KIND_REQUEST,
+                                 PROTOCOL_VERSION)
+
+_HDR = struct.Struct("<4sI")
+_X_MAGIC = b"RTX" + bytes([PROTOCOL_VERSION])
+_AUTH_MAGIC = b"RTA" + bytes([PROTOCOL_VERSION])
+_CHALLENGE = 32
+_MAC_SIZE = 16
+
+
+class XlangError(Exception):
+    pass
+
+
+class XlangClient:
+    def __init__(self, host: str, port: int,
+                 token: Optional[bytes] = None, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.settimeout(timeout)
+        self._msg_id = 0
+        self._send_seq = 0
+        self._recv_seq = 0
+        self._mac_key: Optional[bytes] = None
+        if token is not None:
+            self._handshake(token)
+
+    # -- auth (mirror of rpc.py server handshake, client side) -----------
+
+    def _handshake(self, token: bytes) -> None:
+        import os
+
+        first = self._recv_exact(len(_AUTH_MAGIC) + _CHALLENGE)
+        if first[:4] != _AUTH_MAGIC:
+            raise XlangError("server did not start wire authentication")
+        sc = first[4:]
+        cc = os.urandom(_CHALLENGE)
+        proof = hmac.new(token, b"c" + sc + cc, hashlib.sha256).digest()
+        self.sock.sendall(cc + proof)
+        server_proof = self._recv_exact(32)
+        want = hmac.new(token, b"s" + sc + cc, hashlib.sha256).digest()
+        if not hmac.compare_digest(server_proof, want):
+            raise XlangError("server failed mutual authentication")
+        self._mac_key = hmac.new(token, b"k" + sc + cc,
+                                 hashlib.sha256).digest()
+
+    def _tag(self, direction: bytes, seq: int, body: bytes) -> bytes:
+        m = hashlib.blake2b(key=self._mac_key, digest_size=_MAC_SIZE)
+        m.update(direction)
+        m.update(seq.to_bytes(8, "little"))
+        m.update(body)
+        return m.digest()
+
+    # -- framing ----------------------------------------------------------
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise XlangError("connection closed")
+            buf += chunk
+        return buf
+
+    def _send_frame(self, kind: int, msg_id, method: str,
+                    data: Any) -> None:
+        body = xlang.encode_envelope(kind, msg_id, method, data)
+        out = _HDR.pack(_X_MAGIC, len(body)) + body
+        if self._mac_key is not None:
+            out += self._tag(b"C", self._send_seq, body)
+            self._send_seq += 1
+        self.sock.sendall(out)
+
+    def _recv_frame(self):
+        hdr = self._recv_exact(_HDR.size)
+        magic, length = _HDR.unpack(hdr)
+        if magic != _X_MAGIC:
+            raise XlangError(f"unexpected reply magic {magic!r}")
+        body = self._recv_exact(length)
+        if self._mac_key is not None:
+            tag = self._recv_exact(_MAC_SIZE)
+            want = self._tag(b"S", self._recv_seq, body)
+            self._recv_seq += 1
+            if not hmac.compare_digest(tag, want):
+                raise XlangError("reply MAC verification failed")
+        return xlang.decode_envelope(body)
+
+    # -- calls ------------------------------------------------------------
+
+    def call(self, method: str, **data) -> Any:
+        self._msg_id += 1
+        mid = self._msg_id
+        self._send_frame(KIND_REQUEST, mid, method, data)
+        while True:
+            kind, msg_id, m, reply = self._recv_frame()
+            if kind == KIND_REPLY and msg_id == mid:
+                if isinstance(reply, dict) and reply.get("error"):
+                    raise XlangError(str(reply["error"]))
+                return reply
+            if kind == KIND_ERROR and msg_id == mid:
+                raise XlangError(str(reply))
+            # pushes / stale replies are skipped (sync client)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

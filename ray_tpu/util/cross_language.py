@@ -1,0 +1,70 @@
+"""Cross-language function registry.
+
+Reference analog: python/ray/cross_language.py (java_function /
+cpp_function descriptors) + the function-descriptor resolution the C++
+worker does by name. Non-Python peers cannot ship cloudpickle blobs, so
+they invoke Python functions BY NAME: either a name registered here via
+@cross_language.register, or a fully-qualified "pkg.module:attr" path
+resolved by import. Resolution happens in the proxy process, which is
+inside the cluster's trust domain (callers already passed wire auth).
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.Lock()
+_registry: Dict[str, Callable] = {}
+
+
+def register(name: str, fn: Optional[Callable] = None):
+    """Register `fn` under `name` for cross-language callers.
+
+    Usable as a decorator (``@register("adder")``) or a call
+    (``register("adder", adder)``).
+    """
+    if fn is None:
+        def deco(f):
+            register(name, f)
+            return f
+
+        return deco
+    with _lock:
+        _registry[name] = fn
+    return fn
+
+
+def unregister(name: str) -> None:
+    with _lock:
+        _registry.pop(name, None)
+
+
+def resolve(name: str) -> Callable:
+    """Registered name first; else import "pkg.module:attr" (or the
+    last-dot split of "pkg.module.attr")."""
+    with _lock:
+        fn = _registry.get(name)
+    if fn is not None:
+        return fn
+    if ":" in name:
+        mod_name, attr = name.split(":", 1)
+    elif "." in name:
+        mod_name, attr = name.rsplit(".", 1)
+    else:
+        raise KeyError(
+            f"no cross-language function registered as {name!r} (and it "
+            "is not an importable dotted path)")
+    mod = importlib.import_module(mod_name)
+    obj: Any = mod
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    if not callable(obj):
+        raise TypeError(f"{name!r} resolved to non-callable {obj!r}")
+    return obj
+
+
+def registered_names():
+    with _lock:
+        return sorted(_registry)

@@ -1,0 +1,286 @@
+"""Cross-language wire: xvalue codec, RTX dialect, proxy ops.
+
+Reference analog: the Java/C++ language-worker surface
+(python/ray/cross_language.py, src/ray/core_worker/ cross-language
+serialization) — calls by name with language-neutral values, never
+pickle. The C++ client (cpp/raytpu_client) speaks exactly what
+XlangClient speaks; these tests pin the wire so the C++ side has a
+stable contract (see test_xlang_cpp.py for the compiled client).
+"""
+
+import hashlib
+import math
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime import xlang
+
+
+# ---------------------------------------------------------------- codec
+
+@pytest.mark.parametrize("value", [
+    None, True, False, 0, -1, 2**62, -(2**62), 1.5, -0.0, math.inf,
+    "", "héllo ✓", b"", b"\x00\xff" * 9,
+    [], [1, "two", 3.0, None, [b"x"]],
+    {}, {"a": 1, "b": [True, {"c": None}]},
+])
+def test_xvalue_roundtrip(value):
+    assert xlang.decode(xlang.encode(value)) == value
+
+
+def test_xvalue_ndarray_roundtrip():
+    for arr in [np.arange(12, dtype=np.int32).reshape(3, 4),
+                np.ones((2, 2, 2), dtype=np.float32),
+                np.array([], dtype=np.float64),
+                np.array(7, dtype=np.int64)]:
+        back = xlang.decode(xlang.encode(arr))
+        assert back.dtype == arr.dtype and back.shape == arr.shape
+        np.testing.assert_array_equal(back, arr)
+
+
+def test_xvalue_tuple_decodes_as_list():
+    assert xlang.decode(xlang.encode((1, 2))) == [1, 2]
+
+
+def test_xvalue_rejects_unrepresentable():
+    with pytest.raises(xlang.XEncodeError):
+        xlang.encode(object())
+    with pytest.raises(xlang.XEncodeError):
+        xlang.encode({1: "non-str key"})
+
+
+def test_envelope_roundtrip():
+    body = xlang.encode_envelope(0, 42, "kv_get", {"key": "a"})
+    kind, msg_id, method, data = xlang.decode_envelope(body)
+    assert (kind, msg_id, method, data) == (0, 42, "kv_get", {"key": "a"})
+    body = xlang.encode_envelope(1, None, "m", [1, 2])
+    assert xlang.decode_envelope(body) == (1, None, "m", [1, 2])
+
+
+def test_sanitize_reply_stringifies_exceptions():
+    out = xlang.sanitize_reply({"e": ValueError("boom"), "t": (1, 2)})
+    assert out == {"e": "ValueError: boom", "t": [1, 2]}
+
+
+# ------------------------------------------------- RTX dialect vs RpcServer
+
+def _serve_rpc(token):
+    """Bare RpcServer on its own thread loop with an echo handler."""
+    from ray_tpu.runtime.rpc import EventLoopThread, RpcServer, \
+        set_session_token
+
+    set_session_token(token)
+    io = EventLoopThread(name="xlang-test")
+    server = RpcServer("127.0.0.1", 0)
+
+    async def handle_echo(conn, **data):
+        return {"echo": data}
+
+    async def handle_boom(conn, **data):
+        raise RuntimeError("kapow")
+
+    async def handle_bigint(conn, **data):
+        return {"v": 2**63}  # beyond the wire's int64
+
+    server.register("echo", handle_echo)
+    server.register("boom", handle_boom)
+    server.register("bigint", handle_bigint)
+    io.run(server.start())
+    return io, server
+
+
+@pytest.mark.parametrize("token", [None, hashlib.sha256(b"t").digest()])
+def test_rtx_dialect_request_reply(token):
+    from ray_tpu.runtime.rpc import set_session_token
+    from ray_tpu.util.client.xlang_client import XlangClient, XlangError
+
+    io, server = _serve_rpc(token)
+    try:
+        c = XlangClient("127.0.0.1", server.port, token=token)
+        reply = c.call("echo", a=1, b="two", arr=np.arange(3))
+        assert reply["echo"]["a"] == 1 and reply["echo"]["b"] == "two"
+        np.testing.assert_array_equal(reply["echo"]["arr"], np.arange(3))
+        # Errors arrive as KIND_ERROR with a stringified exception.
+        with pytest.raises(XlangError, match="kapow"):
+            c.call("boom")
+        # Same connection still healthy after an error reply.
+        assert c.call("echo", ok=True)["echo"] == {"ok": True}
+        c.close()
+    finally:
+        io.run(server.close())
+        io.stop()
+        set_session_token(None)
+
+
+def test_rtx_malformed_frame_drops_connection_cleanly():
+    """A truncated/corrupt xlang body must hit the ProtocolMismatch drop
+    path (foreign peers are where malformed frames are EXPECTED), not an
+    unhandled exception in the server's connection task."""
+    import socket
+    import struct
+
+    from ray_tpu.runtime.rpc import PROTOCOL_VERSION, set_session_token
+
+    io, server = _serve_rpc(None)
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+        body = b"\xff\xff\xff"  # bad kind/tag, truncated
+        s.sendall(struct.pack("<4sI", b"RTX" + bytes([PROTOCOL_VERSION]),
+                              len(body)) + body)
+        s.settimeout(5)
+        leftovers = b""
+        try:
+            while True:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                leftovers += chunk
+        except socket.timeout:
+            pass
+        s.close()
+        # Server stays alive and serves the next (well-formed) client.
+        from ray_tpu.util.client.xlang_client import XlangClient
+
+        c = XlangClient("127.0.0.1", server.port, token=None)
+        assert c.call("echo", x=1)["echo"] == {"x": 1}
+        c.close()
+    finally:
+        io.run(server.close())
+        io.stop()
+        set_session_token(None)
+
+
+def test_rtx_unrepresentable_reply_is_structured_error():
+    """Out-of-vocabulary replies (here: an int beyond int64) become a
+    KIND_ERROR naming the problem — never a repr()-corrupted value, never
+    a dead connection."""
+    from ray_tpu.runtime.rpc import set_session_token
+    from ray_tpu.util.client.xlang_client import XlangClient, XlangError
+
+    io, server = _serve_rpc(None)
+    try:
+        c = XlangClient("127.0.0.1", server.port, token=None)
+        with pytest.raises(XlangError, match="not cross-language"):
+            c.call("bigint")
+        # connection survives the error reply
+        assert c.call("echo", ok=1)["echo"] == {"ok": 1}
+        c.close()
+    finally:
+        io.run(server.close())
+        io.stop()
+        set_session_token(None)
+
+
+def test_rtx_auth_rejects_bad_token():
+    from ray_tpu.runtime.rpc import set_session_token
+    from ray_tpu.util.client.xlang_client import XlangClient, XlangError
+
+    token = hashlib.sha256(b"right").digest()
+    io, server = _serve_rpc(token)
+    try:
+        with pytest.raises((XlangError, OSError)):
+            c = XlangClient("127.0.0.1", server.port,
+                            token=hashlib.sha256(b"wrong").digest())
+            c.call("echo", a=1)
+    finally:
+        io.run(server.close())
+        io.stop()
+        set_session_token(None)
+
+
+# ------------------------------------------------------- proxy end-to-end
+
+def _double_plus(x, k=1):
+    return x * 2 + k
+
+
+@pytest.fixture
+def xlang_proxy():
+    ray_tpu.init(num_cpus=2)
+    from ray_tpu.util.client import ClientProxyServer
+
+    proxy = ClientProxyServer(host="127.0.0.1")
+    addr = proxy.start()
+    yield addr
+    proxy.stop()
+    ray_tpu.shutdown()
+
+
+def _xclient(addr):
+    from ray_tpu.runtime.rpc import get_session_token
+    from ray_tpu.util.client.xlang_client import XlangClient
+
+    return XlangClient(addr[0], addr[1], token=get_session_token())
+
+
+def test_xlang_proxy_call_by_name(xlang_proxy):
+    from ray_tpu.util import cross_language
+
+    cross_language.register("double_plus", _double_plus)
+    try:
+        c = _xclient(xlang_proxy)
+        hello = c.call("xhello")
+        assert hello["ok"] is True and hello["client_id"]
+
+        # registered-name call
+        ref = c.call("xcall", name="double_plus", args=[20], kwargs={"k": 2})
+        vals = c.call("xget", refs=[ref["ref"]], timeout_s=60.0)
+        assert vals["values"] == [42]
+
+        # dotted-path call (resolved by import in the proxy)
+        ref2 = c.call("xcall", name="math:sqrt", args=[81.0])
+        assert c.call("xget", refs=[ref2["ref"]],
+                      timeout_s=60.0)["values"] == [9.0]
+        c.close()
+    finally:
+        cross_language.unregister("double_plus")
+
+
+def test_xlang_proxy_put_get_refs_and_kv(xlang_proxy):
+    from ray_tpu.util import cross_language
+
+    cross_language.register("xsum", lambda a, b: a + b)
+    try:
+        c = _xclient(xlang_proxy)
+        arr = np.arange(1000, dtype=np.float32)
+        rid = c.call("xput", value=arr)["ref"]
+        back = c.call("xget", refs=[rid], timeout_s=60.0)["values"][0]
+        np.testing.assert_array_equal(back, arr)
+
+        # $ref marker resolves a client-held ref inside args.
+        r1 = c.call("xput", value=40)["ref"]
+        r2 = c.call("xcall", name="xsum",
+                    args=[{"$ref": r1}, 2])["ref"]
+        assert c.call("xget", refs=[r2], timeout_s=60.0)["values"] == [42]
+
+        # wait
+        w = c.call("xwait", refs=[r2], num_returns=1, timeout_s=30.0)
+        assert w["ready"] == [r2] and w["pending"] == []
+
+        # KV through the proxy
+        assert c.call("xkv_put", key="xl/k1", value=b"v1")["ok"] is True
+        assert c.call("xkv_get", key="xl/k1")["value"] == b"v1"
+        assert c.call("xkv_get", key="xl/missing")["value"] is None
+
+        # release
+        assert c.call("xrelease", refs=[r1, r2])["ok"] is True
+        c.close()
+    finally:
+        cross_language.unregister("xsum")
+
+
+def test_xlang_unrepresentable_result_is_clear_error(xlang_proxy):
+    from ray_tpu.util import cross_language
+    from ray_tpu.util.client.xlang_client import XlangError
+
+    cross_language.register("make_obj", lambda: object())
+    try:
+        c = _xclient(xlang_proxy)
+        ref = c.call("xcall", name="make_obj")["ref"]
+        with pytest.raises(XlangError, match="not cross-language"):
+            c.call("xget", refs=[ref], timeout_s=60.0)
+        c.close()
+    finally:
+        cross_language.unregister("make_obj")

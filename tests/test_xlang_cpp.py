@@ -1,0 +1,143 @@
+"""Compiled C++ client (cpp/raytpu_client) against a live cluster.
+
+Reference analog: the C++ language binding (N31: cpp/ worker + client in
+harborn/ray). The binary authenticates with the session token, speaks
+RTX frames, and drives tasks/objects/actors/KV through the client
+proxy's xlang handlers — no Python on its side of the socket.
+"""
+
+import hashlib
+import hmac as hmac_mod
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+import ray_tpu
+
+REPO = Path(__file__).resolve().parent.parent
+CPP = REPO / "cpp"
+CLI = CPP / "build" / "raytpu_cli"
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None and not CLI.exists(),
+    reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def cli():
+    if not CLI.exists():
+        subprocess.run(["make", "-C", str(CPP)], check=True,
+                       capture_output=True, text=True, timeout=300)
+    return str(CLI)
+
+
+def _run(cli, *args, timeout=120):
+    p = subprocess.run([cli, *args], capture_output=True, text=True,
+                       timeout=timeout)
+    assert p.returncode == 0, f"{args}: rc={p.returncode}\n{p.stderr}"
+    return p.stdout.strip()
+
+
+def test_cpp_crypto_matches_hashlib(cli):
+    """The from-spec SHA-256 / HMAC / keyed BLAKE2b must be bit-identical
+    to CPython's — the handshake depends on it."""
+    out = dict(line.split("=", 1) for line in
+               _run(cli, "selftest").splitlines())
+    big = bytes(range(256)) + bytes(range(44))
+    assert out["sha256_abc"] == hashlib.sha256(b"abc").hexdigest()
+    assert out["sha256_empty"] == hashlib.sha256(b"").hexdigest()
+    assert out["sha256_big"] == hashlib.sha256(big).hexdigest()
+    assert out["hmac_key_abc"] == hmac_mod.new(
+        b"key", b"abc", hashlib.sha256).hexdigest()
+    assert out["blake2b16_abc"] == hashlib.blake2b(
+        b"abc", digest_size=16).hexdigest()
+    assert out["blake2b16_key_abc"] == hashlib.blake2b(
+        b"abc", key=b"key", digest_size=16).hexdigest()
+    assert out["blake2b16_key_big"] == hashlib.blake2b(
+        big, key=b"key", digest_size=16).hexdigest()
+    assert out["xvalue_roundtrip"] == "ok"
+
+
+def test_cpp_xvalue_bytes_match_python(cli):
+    """The CLI's sample dict must decode in Python to the same value."""
+    from ray_tpu.runtime import xlang
+
+    out = dict(line.split("=", 1) for line in
+               _run(cli, "selftest").splitlines())
+    value = xlang.decode(bytes.fromhex(out["xvalue_hex"]))
+    assert value == {"i": -7, "l": ["x", 1.5, None]}
+
+
+# ------------------------------------------------------------ end-to-end
+
+@pytest.fixture(scope="module")
+def cluster_proxy(cli):
+    ray_tpu.init(num_cpus=2)
+    from ray_tpu.runtime.rpc import get_session_token
+    from ray_tpu.util import cross_language
+    from ray_tpu.util.client import ClientProxyServer
+
+    cross_language.register("cpp_add", lambda a, b: a + b)
+    cross_language.register("cpp_concat", lambda s, t: s + t)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    counter = Counter.options(name="cpp_counter").remote()
+
+    proxy = ClientProxyServer(host="127.0.0.1")
+    host, port = proxy.start()
+    token = get_session_token()
+    argv = ["--addr", f"{host}:{port}"]
+    if token:
+        argv += ["--token-hex", token.hex()]
+    yield argv
+    del counter
+    proxy.stop()
+    cross_language.unregister("cpp_add")
+    cross_language.unregister("cpp_concat")
+    ray_tpu.shutdown()
+
+
+def test_cpp_hello_and_call(cli, cluster_proxy):
+    out = _run(cli, *cluster_proxy, "hello")
+    assert '"ok": true' in out
+
+    assert _run(cli, *cluster_proxy, "call", "cpp_add",
+                "i:40", "i:2") == "42"
+    assert _run(cli, *cluster_proxy, "call", "cpp_concat",
+                "s:foo", "s:bar") == '"foobar"'
+    # dotted-path resolution
+    assert _run(cli, *cluster_proxy, "call", "math:sqrt", "f:81") == "9"
+
+
+def test_cpp_put_get_and_ref_args(cli, cluster_proxy):
+    # Refs are session-scoped (one CLI invocation = one session), so
+    # put -> get -> ref-as-arg runs on a single connection via exec.
+    out = _run(cli, *cluster_proxy, "exec",
+               "put", "i:40", "--",
+               "get", "@0", "--",
+               "call", "cpp_add", "ref:@0", "i:2")
+    assert out.splitlines() == ["ref=@0", "40", "42"]
+
+
+def test_cpp_kv(cli, cluster_proxy):
+    _run(cli, *cluster_proxy, "kvput", "cppkey", "s:hello")
+    assert _run(cli, *cluster_proxy, "kvget",
+                "cppkey") == "b:" + b"hello".hex()
+    assert _run(cli, *cluster_proxy, "kvget", "cpp-missing") == "null"
+
+
+def test_cpp_named_actor_call(cli, cluster_proxy):
+    assert _run(cli, *cluster_proxy, "actorcall", "cpp_counter",
+                "add", "i:5") == "5"
+    assert _run(cli, *cluster_proxy, "actorcall", "cpp_counter",
+                "add", "i:7") == "12"
