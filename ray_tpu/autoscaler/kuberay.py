@@ -1,0 +1,408 @@
+"""KubeRay-style node provider: scale by patching a RayCluster custom
+resource, let the operator make pods.
+
+Reference analog: python/ray/autoscaler/_private/kuberay/node_provider.py —
+on Kubernetes the autoscaler NEVER creates machines itself; it edits the
+RayCluster CR (`spec.workerGroupSpecs[*].replicas` and
+`scaleStrategy.workersToDelete`) and the KubeRay operator reconciles pods
+to match. This module implements that contract against any K8s-shaped
+API server:
+
+  * `KubeRayProvider` — NodeProvider whose launch/terminate are CR
+    patches and whose non_terminated is a pod list by label selector.
+    One worker group per InstanceType (TPU slice groups use
+    `numOfHosts` for multi-host atomicity, like KubeRay's TPU support).
+  * `FakeKubeApi` — an in-process API server (HTTP, thread) holding the
+    RayCluster object + pods, with a minimal operator reconcile loop, so
+    the provider is tested against the real wire protocol (GET/PATCH
+    JSON) rather than mocks.
+
+Pod→node identity: the operator injects the pod name into the raylet's
+labels (`kuberay.io/pod`), which is how get_node_id resolves instances —
+mirroring the reference, where pod name IS the instance id.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.autoscaler import InstanceType, NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+class KubeRayProvider(NodeProvider):
+    """Scales a RayCluster CR; the operator owns pod lifecycle."""
+
+    def __init__(self, api_server: str, namespace: str = "default",
+                 cluster_name: str = "raytpu", token: Optional[str] = None,
+                 cluster=None):
+        self.api = api_server.rstrip("/")
+        self.ns = namespace
+        self.name = cluster_name
+        self.token = token
+        self.cluster = cluster  # local test cluster for node identity
+        self._nodes: Dict[str, object] = {}
+        # The operator names pods, not us — launch() returns a SLOT id and
+        # _sync() binds slots to materialized pods of the same group. The
+        # autoscaler keeps accounting in slot ids; the K8s side only ever
+        # sees pod names. Binding is REPLICA-granular: every slot belongs
+        # to a replica-group (rid, one per launch/launch_slice), each rid
+        # maps to exactly one operator replica (the ray.io/replica pod
+        # label), and a rid's slots only ever bind that replica's pods —
+        # so terminating slice A can never name pods of live slice B.
+        self._slot_group: Dict[str, str] = {}
+        self._slot_pod: Dict[str, Optional[str]] = {}
+        self._slot_rid: Dict[str, str] = {}
+        self._rid_replica: Dict[str, str] = {}  # rid -> replica label
+
+    # -- K8s API verbs ----------------------------------------------------
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None):
+        req = urllib.request.Request(
+            self.api + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/merge-patch+json"
+                     if method == "PATCH" else "application/json",
+                     **({"Authorization": f"Bearer {self.token}"}
+                        if self.token else {})})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read() or b"{}")
+
+    @property
+    def _cr_path(self) -> str:
+        return (f"/apis/ray.io/v1/namespaces/{self.ns}"
+                f"/rayclusters/{self.name}")
+
+    def _get_cr(self) -> dict:
+        return self._req("GET", self._cr_path)
+
+    def _patch_cr(self, patch: dict) -> dict:
+        return self._req("PATCH", self._cr_path, patch)
+
+    def _group_for(self, t: InstanceType) -> dict:
+        cr = self._get_cr()
+        for g in cr["spec"].get("workerGroupSpecs", []):
+            if g["groupName"] == t.name:
+                return g
+        # Declare the group on first use (operator tolerates additions).
+        group = {
+            "groupName": t.name,
+            "replicas": 0,
+            "maxReplicas": t.max_workers,
+            "numOfHosts": t.hosts,
+            "template": {"metadata": {"labels": {
+                "ray.io/cluster": self.name,
+                "ray.io/group": t.name,
+            }}, "spec": {"resources": dict(t.resources),
+                         "tpuSlice": t.tpu_slice}},
+        }
+        groups = cr["spec"].get("workerGroupSpecs", []) + [group]
+        self._patch_cr({"spec": {"workerGroupSpecs": groups}})
+        return group
+
+    def _set_group(self, group_name: str, **fields) -> None:
+        cr = self._get_cr()
+        groups = cr["spec"].get("workerGroupSpecs", [])
+        for g in groups:
+            if g["groupName"] == group_name:
+                g.update(fields)
+        self._patch_cr({"spec": {"workerGroupSpecs": groups}})
+
+    # -- NodeProvider surface --------------------------------------------
+
+    def _new_replica_slots(self, instance_type: InstanceType,
+                           hosts: int) -> List[str]:
+        g = self._group_for(instance_type)
+        self._set_group(instance_type.name, replicas=g["replicas"] + 1)
+        rid = uuid.uuid4().hex[:8]
+        slots = []
+        for i in range(hosts):
+            slot = f"{instance_type.name}/{rid}-host{i}"
+            self._slot_group[slot] = instance_type.name
+            self._slot_pod[slot] = None
+            self._slot_rid[slot] = rid
+            slots.append(slot)
+        return slots
+
+    def launch(self, instance_type: InstanceType) -> str:
+        """Scale-up = replicas+1. Returns a slot id; the pod materializes
+        asynchronously (the operator's job) and _sync() binds it."""
+        return self._new_replica_slots(instance_type, 1)[0]
+
+    def launch_slice(self, instance_type: InstanceType) -> List[str]:
+        # One replica of a multi-host group IS the whole slice
+        # (numOfHosts) — atomic by construction, like KubeRay TPU pods;
+        # each host pod of the replica binds to one host slot.
+        return self._new_replica_slots(instance_type, instance_type.hosts)
+
+    def _pods(self) -> List[dict]:
+        sel = f"ray.io/cluster={self.name}"
+        out = self._req("GET", f"/api/v1/namespaces/{self.ns}/pods"
+                               f"?labelSelector={sel}")
+        return out.get("items", [])
+
+    def _sync(self) -> Dict[str, dict]:
+        """Bind unbound slots to unclaimed pods at REPLICA granularity:
+        each replica-group (rid) claims one whole operator replica (the
+        ray.io/replica pod label) and its slots bind only that replica's
+        pods. Drops slots whose bound pod disappeared. Returns
+        pod-name -> pod."""
+        pods = {p["metadata"]["name"]: p for p in self._pods()}
+        for slot, pod in list(self._slot_pod.items()):
+            if pod is not None and pod not in pods:
+                # Pod reaped (our terminate, or operator scale-in): the
+                # slot is gone with it.
+                rid = self._slot_rid.get(slot)
+                self._slot_pod.pop(slot)
+                self._slot_group.pop(slot, None)
+                self._slot_rid.pop(slot, None)
+                self._nodes.pop(slot, None)
+                if rid and all(r != rid for r in self._slot_rid.values()):
+                    self._rid_replica.pop(rid, None)
+        claimed = {p for p in self._slot_pod.values() if p}
+        # replica label -> its pods, per group
+        by_replica: Dict[tuple, List[str]] = {}
+        for name, p in pods.items():
+            lab = p["metadata"]["labels"]
+            key = (lab.get("ray.io/group"), lab.get("ray.io/replica"))
+            by_replica.setdefault(key, []).append(name)
+        taken_replicas = set(self._rid_replica.values())
+        for slot in sorted(s for s, p in self._slot_pod.items() if p is None):
+            group = self._slot_group[slot]
+            rid = self._slot_rid[slot]
+            replica = self._rid_replica.get(rid)
+            if replica is None:
+                # Claim a whole fresh replica: all pods unclaimed, right
+                # group, not already owned by another rid.
+                for (g, r), names in sorted(by_replica.items()):
+                    if g == group and r is not None \
+                            and r not in taken_replicas \
+                            and not any(n in claimed for n in names):
+                        replica = r
+                        self._rid_replica[rid] = r
+                        taken_replicas.add(r)
+                        break
+                if replica is None:
+                    continue  # still materializing
+            for name in sorted(by_replica.get((group, replica), [])):
+                if name not in claimed:
+                    self._slot_pod[slot] = name
+                    claimed.add(name)
+                    break
+        return pods
+
+    def terminate(self, instance_id: str) -> None:
+        """Scale-down is precise on Kubernetes: name the pod in
+        scaleStrategy.workersToDelete AND drop replicas — ONCE per
+        replica, not once per host slot — so the operator can't kill an
+        arbitrary survivor or a sibling slice."""
+        self._sync()
+        group = self._slot_group.pop(instance_id, None)
+        pod_name = self._slot_pod.pop(instance_id, None)
+        rid = self._slot_rid.pop(instance_id, None)
+        self._nodes.pop(instance_id, None)
+        if group is None:
+            return
+        # Replicas drop only when the LAST slot of this replica-group
+        # goes; every slot's bound pod still gets named for deletion.
+        last_of_replica = all(r != rid for r in self._slot_rid.values())
+        if last_of_replica and rid is not None:
+            self._rid_replica.pop(rid, None)
+        cr = self._get_cr()
+        groups = cr["spec"].get("workerGroupSpecs", [])
+        for g in groups:
+            if g["groupName"] == group:
+                if last_of_replica and g["replicas"] > 0:
+                    g["replicas"] -= 1
+                if pod_name is not None:
+                    strat = g.setdefault("scaleStrategy", {})
+                    strat.setdefault("workersToDelete", []).append(pod_name)
+        self._patch_cr({"spec": {"workerGroupSpecs": groups}})
+
+    def pod_of(self, instance_id: str) -> Optional[str]:
+        """The pod currently bound to a slot (None while booting)."""
+        self._sync()
+        return self._slot_pod.get(instance_id)
+
+    def non_terminated(self) -> List[str]:
+        pods = self._sync()
+        out = []
+        for slot, pod in self._slot_pod.items():
+            if pod is None:  # replica granted, pod still materializing
+                out.append(slot)
+            elif pods[pod].get("status", {}).get("phase") in ("Pending",
+                                                              "Running"):
+                out.append(slot)
+        return out
+
+    def get_node_id(self, instance_id: str) -> Optional[bytes]:
+        """In tests the fake operator backs a Running pod with a real local
+        raylet (cluster.add_node), labeled with the pod name."""
+        node = self._nodes.get(instance_id)
+        if node is None and self.cluster is not None:
+            pods = self._sync()
+            pod_name = self._slot_pod.get(instance_id)
+            pod = pods.get(pod_name) if pod_name else None
+            if pod and pod.get("status", {}).get("phase") == "Running":
+                spec = pod.get("spec", {})
+                res = dict(spec.get("resources") or {"CPU": 1})
+                labels = {"kuberay.io/pod": pod_name}
+                if spec.get("tpuSlice"):
+                    labels["tpu-pod-type"] = spec["tpuSlice"]
+                    labels["tpu-slice-name"] = pod_name.rsplit("-", 1)[0]
+                node = self.cluster.add_node(
+                    num_cpus=res.pop("CPU", 1), num_tpus=res.pop("TPU", 0),
+                    resources=res or None, labels=labels)
+                self._nodes[instance_id] = node
+        return getattr(node, "node_id", None)
+
+
+# ------------------------------------------------------------ fake API
+
+class FakeKubeApi:
+    """Minimal K8s API server + KubeRay operator loop, in one thread.
+
+    Speaks real HTTP+JSON (GET CR, PATCH CR with merge semantics, list
+    pods with a labelSelector) so KubeRayProvider is exercised over the
+    actual wire protocol. `reconcile()` plays the operator: creates pods
+    up to `replicas * numOfHosts` per group, honors workersToDelete, and
+    promotes Pending pods to Running after one round (configurable)."""
+
+    def __init__(self, namespace: str = "default",
+                 cluster_name: str = "raytpu", token: Optional[str] = None,
+                 pending_rounds: int = 1):
+        import http.server
+
+        self.ns = namespace
+        self.name = cluster_name
+        self.token = token
+        self.pending_rounds = pending_rounds
+        self.cr = {"apiVersion": "ray.io/v1", "kind": "RayCluster",
+                   "metadata": {"name": cluster_name,
+                                "namespace": namespace},
+                   "spec": {"workerGroupSpecs": []}}
+        self.pods: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        api = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _authed(self):
+                if api.token is None:
+                    return True
+                return (self.headers.get("Authorization")
+                        == f"Bearer {api.token}")
+
+            def do_GET(self):
+                if not self._authed():
+                    return self._send(401, {"reason": "Unauthorized"})
+                with api._lock:
+                    if self.path.startswith("/apis/ray.io/v1/"):
+                        return self._send(200, api.cr)
+                    if "/pods" in self.path:
+                        sel = ""
+                        if "labelSelector=" in self.path:
+                            sel = self.path.split("labelSelector=")[1]
+                        k, _, v = sel.partition("%3D")
+                        if not v:
+                            k, _, v = sel.partition("=")
+                        items = [p for p in api.pods.values()
+                                 if not v or
+                                 p["metadata"]["labels"].get(k) == v]
+                        return self._send(200, {"items": items})
+                return self._send(404, {})
+
+            def do_PATCH(self):
+                if not self._authed():
+                    return self._send(401, {"reason": "Unauthorized"})
+                n = int(self.headers.get("Content-Length", 0))
+                patch = json.loads(self.rfile.read(n) or b"{}")
+                with api._lock:
+                    # merge-patch at the spec level (replace lists, like
+                    # application/merge-patch+json)
+                    for k, v in patch.get("spec", {}).items():
+                        api.cr["spec"][k] = v
+                    return self._send(200, api.cr)
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def reconcile(self) -> None:
+        """One operator round: pods converge toward the CR. Pods are
+        managed per REPLICA (a multi-host group's replica = numOfHosts
+        pods sharing a ray.io/replica label), as the real operator does
+        for TPU worker groups."""
+        with self._lock:
+            for g in self.cr["spec"].get("workerGroupSpecs", []):
+                group = g["groupName"]
+                hosts = g.get("numOfHosts", 1)
+                strat = g.get("scaleStrategy", {})
+                for name in strat.get("workersToDelete", []):
+                    self.pods.pop(name, None)
+                if strat:
+                    g["scaleStrategy"] = {}
+                mine = [p for p in self.pods.values()
+                        if p["metadata"]["labels"].get("ray.io/group")
+                        == group]
+                replicas = {}
+                for p in mine:
+                    r = p["metadata"]["labels"].get("ray.io/replica")
+                    replicas.setdefault(r, []).append(p)
+                want = g["replicas"]
+                # new replicas on free indices, all hosts at once
+                idx = 0
+                while len(replicas) < want:
+                    while str(idx) in replicas:
+                        idx += 1
+                    r = str(idx)
+                    replicas[r] = []
+                    tmpl = g.get("template", {})
+                    for _ in range(hosts):
+                        name = f"{self.name}-{group}-{uuid.uuid4().hex[:6]}"
+                        labels = dict(
+                            tmpl.get("metadata", {}).get("labels", {}))
+                        labels["ray.io/replica"] = r
+                        self.pods[name] = {
+                            "metadata": {"name": name, "labels": labels},
+                            "spec": dict(tmpl.get("spec", {})),
+                            "status": {"phase": "Pending", "_age": 0},
+                        }
+                # excess replicas reaped whole (highest index first)
+                for r in sorted(replicas, reverse=True)[:max(
+                        len(replicas) - want, 0)]:
+                    for p in replicas[r]:
+                        self.pods.pop(p["metadata"]["name"], None)
+            for p in self.pods.values():
+                st = p["status"]
+                if st["phase"] == "Pending":
+                    st["_age"] += 1
+                    if st["_age"] >= self.pending_rounds:
+                        st["phase"] = "Running"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

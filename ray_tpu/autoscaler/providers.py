@@ -254,11 +254,18 @@ def _gce_queued(**kwargs):
     return GceTpuQueuedProvider(**kwargs)
 
 
+def _kuberay(**kwargs):
+    from ray_tpu.autoscaler.kuberay import KubeRayProvider
+
+    return KubeRayProvider(**kwargs)
+
+
 PROVIDERS = {
     "local": LocalNodeProvider,
     "gce_tpu": GCETpuProvider,          # gcloud-argv shaped (dry-run-able)
     "gce_tpu_api": _gce_queued,         # Cloud TPU v2 REST queuedResources
     "cloud_api": CloudAPIProvider,
+    "kuberay": _kuberay,                # RayCluster-CR patching (operator)
 }
 
 

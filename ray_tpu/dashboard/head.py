@@ -185,6 +185,8 @@ class DashboardHead:
             web.get("/api/jobs/{job_id}", self.job_get),
             web.get("/api/jobs/{job_id}/logs", self.job_logs),
             web.post("/api/jobs/{job_id}/stop", self.job_stop),
+            web.static("/static", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "static")),
         ])
         self._runner = web.AppRunner(app)
         await self._runner.setup()

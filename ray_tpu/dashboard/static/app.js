@@ -1,0 +1,462 @@
+/* ray_tpu dashboard SPA (reference analog: python/ray/dashboard/client/).
+   Hash-routed views over the REST surface; no build step, no dependencies.
+   Charts: single-axis SVG line charts, 2px strokes, legend + direct end
+   labels (identity is never color-alone), crosshair + tooltip hover. */
+
+const $ = (id) => document.getElementById(id);
+const esc = (s) => String(s).replace(/[&<>"']/g,
+  (c) => ({"&": "&amp;", "<": "&lt;", ">": "&gt;",
+           '"': "&quot;", "'": "&#39;"}[c]));
+const short = (s) => esc(String(s).slice(0, 12));
+const state = (s) =>
+  `<span class="${/ALIVE|alive|RUNNING|SUCCEEDED|FINISHED|HEALTHY|ok/
+    .test(s) ? "ok" : "bad"}">${esc(s)}</span>`;
+const SERIES = ["#5992e6", "#1da666", "#c0850c", "#ca598c"]; // validated
+
+async function j(url, opts) {
+  const r = await fetch(url, opts);
+  if (!r.ok) throw new Error(`${url}: HTTP ${r.status}`);
+  return r.json();
+}
+
+function rows(head, data, fn) {
+  return `<table><tr>${head.map((h) => `<th>${h}</th>`).join("")}</tr>` +
+    data.map((d) =>
+      `<tr>${fn(d).map((c) => `<td>${c}</td>`).join("")}</tr>`).join("") +
+    "</table>";
+}
+
+function tiles(items) {
+  return `<div class="tile-row">` + items.map(([k, v, cls]) =>
+    `<div class="tile"><div class="v ${cls || ""}">${v}</div>` +
+    `<div class="k">${esc(k)}</div></div>`).join("") + "</div>";
+}
+
+// ---------------------------------------------------------------- charts
+
+/** Single-axis line chart with legend, direct end labels, crosshair
+ * tooltip. series: [{name, color, points:[{t, v}]}], v may be null.
+ * Hover data lives in CHART_DATA keyed by `key` (stable per chart) —
+ * never serialized into the DOM. */
+const CHART_DATA = new Map();
+
+function lineChart(key, series,
+                   {h = 160, ymax = null, fmt = (v) => v} = {}) {
+  const W = 600, H = h, padL = 34, padR = 70, padT = 8, padB = 16;
+  const all = series.flatMap((s) => s.points.filter((p) => p.v != null));
+  if (!all.length) return `<span class="muted">no data yet</span>`;
+  const t0 = Math.min(...all.map((p) => p.t));
+  const t1 = Math.max(...all.map((p) => p.t));
+  const vmax = ymax ?? Math.max(...all.map((p) => p.v), 1e-9) * 1.05;
+  const x = (t) => padL + (W - padL - padR) * (t - t0) / Math.max(t1 - t0, 1e-9);
+  const y = (v) => padT + (H - padT - padB) * (1 - v / vmax);
+  const gridVals = [0, vmax / 2, vmax];
+  const grid = gridVals.map((v) =>
+    `<line class="gridline" x1="${padL}" x2="${W - padR}" y1="${y(v)}" y2="${y(v)}"/>` +
+    `<text x="2" y="${y(v) + 3}">${fmt(v)}</text>`).join("");
+  const polys = series.map((s, i) => {
+    const pts = s.points.filter((p) => p.v != null)
+      .map((p) => `${x(p.t).toFixed(1)},${y(p.v).toFixed(1)}`).join(" ");
+    if (!pts) return "";
+    const last = s.points.filter((p) => p.v != null).at(-1);
+    // direct end label: identity is not carried by color alone
+    return `<polyline class="series" stroke="${s.color}" points="${pts}"/>` +
+      `<text x="${W - padR + 4}" y="${y(last.v) + 3}" fill="${s.color}">` +
+      `${esc(s.name)}</text>`;
+  }).join("");
+  CHART_DATA.set(key, {series, t0, t1, vmax, padL, padR, padT, padB, W, H});
+  const legend = series.length > 1
+    ? `<div class="legend">` + series.map((s) =>
+        `<span><span class="swatch" style="background:${s.color}"></span>` +
+        `${esc(s.name)}</span>`).join("") + "</div>"
+    : "";
+  return `<svg class="chart hoverable" viewBox="0 0 ${W} ${H}" width="100%"` +
+    ` height="${H}" preserveAspectRatio="none" data-chart="${esc(key)}">` +
+    grid + polys + `<g class="hoverlayer"></g></svg>` + legend;
+}
+
+// crosshair + tooltip on chart hover
+document.addEventListener("mousemove", (e) => {
+  const svg = e.target.closest?.("svg.hoverable");
+  const tip = $("tooltip");
+  if (!svg) { tip.hidden = true; document.querySelectorAll(".hoverlayer")
+      .forEach((g) => g.innerHTML = ""); return; }
+  const d = CHART_DATA.get(svg.dataset.chart);
+  if (!d) { tip.hidden = true; return; }
+  const rect = svg.getBoundingClientRect();
+  const fx = (e.clientX - rect.left) / rect.width * d.W;
+  const t = d.t0 + (fx - d.padL) / Math.max(d.W - d.padL - d.padR, 1) *
+    (d.t1 - d.t0);
+  const lines = d.series.map((s) => {
+    let best = null;
+    for (const p of s.points)
+      if (p.v != null && (!best || Math.abs(p.t - t) < Math.abs(best.t - t)))
+        best = p;
+    return best && {name: s.name, color: s.color, ...best};
+  }).filter(Boolean);
+  if (!lines.length) { tip.hidden = true; return; }
+  const xpix = d.padL + (d.W - d.padL - d.padR) *
+    (lines[0].t - d.t0) / Math.max(d.t1 - d.t0, 1e-9);
+  svg.querySelector(".hoverlayer").innerHTML =
+    `<line class="crosshair" x1="${xpix}" x2="${xpix}" y1="${d.padT}"` +
+    ` y2="${d.H - d.padB}"/>`;
+  tip.innerHTML =
+    `<div class="muted">${new Date(lines[0].t).toLocaleTimeString()}</div>` +
+    lines.map((l) => `<div class="row"><span>` +
+      `<span class="swatch" style="background:${l.color};display:inline-block;` +
+      `width:8px;height:8px;border-radius:2px;margin-right:4px"></span>` +
+      `${esc(l.name)}</span><b>${(+l.v).toFixed(3)}</b></div>`).join("");
+  tip.hidden = false;
+  tip.style.left = Math.min(e.clientX + 14, innerWidth - 180) + "px";
+  tip.style.top = (e.clientY + 14) + "px";
+});
+
+// ---------------------------------------------------- data + history
+
+const snapshot = {nodes: [], actors: [], pgs: [], jobs: [], tasks: [],
+                  serve: {deployments: []}, res: {total: {}, available: {}},
+                  metricsText: ""};
+const history = {util: [], metrics: new Map()};  // ring buffers
+const HIST_MAX = 300;
+
+function parsePrometheus(text) {
+  // name{labels} value  -> aggregate by family (sum), keep help text
+  const fams = new Map();
+  let help = {};
+  for (const line of text.split("\n")) {
+    if (line.startsWith("# HELP ")) {
+      const [, name, ...rest] = line.slice(7).split(" ");
+      help[name] = rest.join(" ");
+      continue;
+    }
+    if (!line || line.startsWith("#")) continue;
+    const m = line.match(/^([a-zA-Z_:][\w:]*)(\{.*\})?\s+([-+eE.\d]+|NaN)/);
+    if (!m) continue;
+    const v = parseFloat(m[3]);
+    if (Number.isNaN(v)) continue;
+    const f = fams.get(m[1]) || {sum: 0, n: 0, help: help[m[1]] || ""};
+    f.sum += v;
+    f.n += 1;
+    fams.set(m[1], f);
+  }
+  return fams;
+}
+
+async function poll() {
+  const [nodes, actors, pgs, jobs, res, tasks, serve] = await Promise.all([
+    j("/api/nodes"), j("/api/actors"), j("/api/placement_groups"),
+    j("/api/jobs/"), j("/api/cluster_resources"), j("/api/tasks"),
+    j("/api/serve")]);
+  Object.assign(snapshot, {nodes, actors, pgs, jobs, res, tasks, serve});
+  try {
+    snapshot.metricsText = await (await fetch("/metrics")).text();
+  } catch { snapshot.metricsText = ""; }
+  const now = Date.now();
+  const frac = (k) => {
+    const t = res.total[k] || 0;
+    return t ? (t - (res.available[k] ?? 0)) / t : null;
+  };
+  history.util.push({t: now, cpu: frac("CPU"), tpu: frac("TPU")});
+  if (history.util.length > HIST_MAX) history.util.shift();
+  for (const [name, fam] of parsePrometheus(snapshot.metricsText)) {
+    const buf = history.metrics.get(name) ||
+      {points: [], help: fam.help};
+    buf.help = fam.help || buf.help;
+    buf.points.push({t: now, v: fam.sum});
+    if (buf.points.length > HIST_MAX) buf.points.shift();
+    history.metrics.set(name, buf);
+  }
+  const alive = nodes.filter((n) => n.alive).length;
+  $("summary").textContent =
+    `${alive}/${nodes.length} nodes · ${actors.length} actors · ` +
+    `${jobs.length} jobs · ${new Date().toLocaleTimeString()}`;
+}
+
+// ---------------------------------------------------------------- views
+
+const VIEWS = {
+  overview: {title: "Overview", render: renderOverview},
+  nodes: {title: "Nodes", render: renderNodes},
+  actors: {title: "Actors", render: renderActors},
+  tasks: {title: "Tasks", render: renderTasks},
+  jobs: {title: "Jobs", render: renderJobs},
+  serve: {title: "Serve", render: renderServe},
+  metrics: {title: "Metrics", render: renderMetrics},
+};
+let detail = null;   // {title, body} pinned under the active view
+let searchTerm = "";
+
+function utilChart() {
+  return lineChart("util", [
+    {name: "CPU", color: SERIES[0],
+     points: history.util.map((u) => ({t: u.t, v: u.cpu}))},
+    {name: "TPU", color: SERIES[1],
+     points: history.util.map((u) => ({t: u.t, v: u.tpu}))},
+  ], {ymax: 1, fmt: (v) => `${Math.round(v * 100)}%`});
+}
+
+function renderOverview() {
+  const s = snapshot;
+  const alive = s.nodes.filter((n) => n.alive).length;
+  const running = s.tasks.filter((t) => /RUNNING/.test(t.state)).length;
+  return `
+  <section class="wide"><h2>Cluster</h2>${tiles([
+    ["nodes alive", `${alive}/${s.nodes.length}`,
+     alive === s.nodes.length ? "ok" : "bad"],
+    ["actors", s.actors.length],
+    ["placement groups", s.pgs.length],
+    ["tasks running", running],
+    ["jobs", s.jobs.length],
+    ["serve deployments", (s.serve.deployments || []).length],
+  ])}</section>
+  <section class="wide"><h2>Utilization
+    <span class="right muted">used fraction, last ${
+      Math.round(HIST_MAX * POLL_MS / 1000 / 60)} min</span></h2>
+    ${utilChart()}</section>
+  <section><h2>Cluster resources</h2>${rows(["resource", "used / total", ""],
+    Object.keys(s.res.total), (k) => {
+      const total = s.res.total[k], avail = s.res.available[k] ?? 0;
+      const used = total - avail, pct = total ? (100 * used / total) : 0;
+      const fmt = (v) => k === "memory"
+        ? (v / 2 ** 30).toFixed(1) + " GiB" : +v.toFixed(2);
+      return [esc(k), `${fmt(used)} / ${fmt(total)}`,
+              `<div class="bar"><div style="width:${pct}%"></div></div>`];
+    })}</section>
+  <section><h2>Recent tasks</h2>${rows(["task", "name", "state"],
+    s.tasks.slice(0, 12), (t) => [short(t.task_id), esc(t.name || ""),
+                                  state(t.state)])}</section>`;
+}
+
+function renderNodes() {
+  return `
+  <section class="wide"><h2>Nodes</h2>${rows(
+    ["node", "state", "role", "CPU avail/total", "TPU avail/total", "labels"],
+    snapshot.nodes, (n) => [
+      `<code class="drill" data-kind="nodes" data-id="${esc(n.node_id)}">` +
+        `${short(n.node_id)}</code>`,
+      state(n.alive ? "alive" : "dead"),
+      n.is_head ? "head" : "worker",
+      `${n.available?.CPU ?? "-"} / ${n.resources?.CPU ?? "-"}`,
+      `${n.available?.TPU ?? "-"} / ${n.resources?.TPU ?? "-"}`,
+      esc(Object.entries(n.labels || {}).map(([k, v]) => `${k}=${v}`)
+        .join(" ")),
+    ])}</section>
+  <section class="wide"><h2>Placement groups</h2>${rows(
+    ["pg", "name", "strategy", "state", "bundles"],
+    snapshot.pgs.slice(0, 100), (p) => [
+      short(p.pg_id), esc(p.name || ""), esc(p.strategy), state(p.state),
+      p.bundles?.length ?? ""])}</section>
+  ${detailSection()}`;
+}
+
+function renderActors() {
+  const term = searchTerm.toLowerCase();
+  const match = (a) => !term ||
+    `${a.actor_id} ${a.class_name} ${a.state}`.toLowerCase().includes(term);
+  return `
+  <section class="wide"><h2>Actors
+      <span class="right muted">${snapshot.actors.length} total</span></h2>
+    <div class="searchbox"><input type="text" id="search"
+      placeholder="filter by id / class / state" value="${esc(searchTerm)}">
+    </div>
+    ${rows(["actor", "class", "state", "node", "restarts", "pid"],
+      snapshot.actors.filter(match).slice(0, 200), (a) => [
+        `<code class="drill" data-kind="actors" data-id="${esc(a.actor_id)}">` +
+          `${short(a.actor_id)}</code>`,
+        esc(a.class_name || ""), state(a.state),
+        `<code>${a.node_id ? short(a.node_id) : ""}</code>`,
+        `${a.restarts_used}/${a.max_restarts}`, a.pid ?? ""])}</section>
+  ${detailSection()}`;
+}
+
+function renderTasks() {
+  return `
+  <section class="wide"><h2>Timeline
+    <a class="right muted linklike" href="/api/timeline?format=chrome"
+       download="timeline.json">download chrome trace</a></h2>
+    <div id="timeline">${timelineHtml()}</div></section>
+  <section class="wide"><h2>Recent tasks</h2>${rows(
+    ["task", "name", "state", "actor", "node"],
+    snapshot.tasks.slice(0, 200), (t) => [
+      short(t.task_id), esc(t.name || ""), state(t.state),
+      `<code>${t.actor_id ? short(t.actor_id) : ""}</code>`,
+      `<code>${t.node_id ? short(t.node_id) : ""}</code>`])}</section>`;
+}
+
+let timelineBars = [];
+function timelineHtml() {
+  const bars = timelineBars;
+  if (!bars.length) return `<span class="muted">no task spans yet</span>`;
+  const t0 = Math.min(...bars.map((b) => b.start));
+  const t1 = Math.max(...bars.map((b) => b.end));
+  const span = Math.max(t1 - t0, 1e-6);
+  const lanes = [...new Set(bars.map((b) => b.worker))].sort();
+  return lanes.map((w) => {
+    const r = bars.filter((b) => b.worker === w).slice(-200).map((b) => {
+      const left = 100 * (b.start - t0) / span;
+      const width = Math.max(100 * (b.end - b.start) / span, 0.3);
+      const color = b.ok === false ? "var(--bad)"
+        : b.ok === null ? "var(--dim)" : "var(--s1)";
+      const dur = ((b.end - b.start) * 1000).toFixed(1);
+      return `<div title="${esc(b.name)} · ${dur} ms" style="position:absolute;` +
+        `left:${left}%;width:${width}%;height:10px;background:${color};` +
+        `border-radius:2px"></div>`;
+    }).join("");
+    return `<div style="display:flex;align-items:center;gap:8px;margin:2px 0">` +
+      `<code style="width:110px;flex:none;font-size:11px">${short(w)}</code>` +
+      `<div style="position:relative;height:12px;flex:1">${r}</div></div>`;
+  }).join("") + `<div class="muted" style="font-size:11px;margin-top:4px">` +
+    `${bars.length} spans · ${(t1 - t0).toFixed(1)}s window</div>`;
+}
+
+function renderJobs() {
+  return `
+  <section class="wide"><h2>Submit job</h2>
+    <form class="inline" id="jobform">
+      <input type="text" id="entrypoint"
+        placeholder='entrypoint, e.g. python -c "print(42)"'>
+      <button type="submit">submit</button></form></section>
+  <section class="wide"><h2>Jobs</h2>${rows(
+    ["job", "status", "entrypoint", ""],
+    snapshot.jobs.slice(0, 100), (jb) => [
+      `<code>${esc(jb.submission_id || jb.job_id)}</code>`,
+      state(jb.status || (jb.alive ? "alive" : "finished")),
+      esc(jb.entrypoint || ""),
+      `<a class="logs linklike muted" data-id="${
+        esc(jb.submission_id || jb.job_id)}">logs</a> · ` +
+      `<a class="stopjob linklike muted" data-id="${
+        esc(jb.submission_id || jb.job_id)}">stop</a>`])}</section>
+  ${detailSection()}`;
+}
+
+function renderServe() {
+  const d = snapshot.serve;
+  return `
+  <section class="wide"><h2>Serve deployments</h2>${rows(
+    ["deployment", "replicas", "version", "autoscaling"],
+    d.deployments || [], (x) => [
+      `<code>${esc(x.name)}</code>`, x.num_replicas, esc(x.version ?? ""),
+      x.autoscaling ? "on" : "off"])}</section>
+  ${(d.apps || []).length ? `<section class="wide"><h2>Applications</h2>${
+    rows(["app", "route", "status"], d.apps, (a) =>
+      [esc(a.name), esc(a.route_prefix || ""), state(a.status || "")])
+    }</section>` : ""}`;
+}
+
+function renderMetrics() {
+  const fams = [...history.metrics.entries()]
+    .filter(([, b]) => b.points.length > 1)
+    .sort(([a], [b]) => a.localeCompare(b));
+  if (!fams.length)
+    return `<section class="wide"><h2>Metrics</h2>
+      <span class="muted">no prometheus families scraped yet</span></section>`;
+  const charts = fams.slice(0, 24).map(([name, buf], i) => `
+    <section><h2>${esc(name)}</h2>
+      <div class="muted" style="margin-bottom:4px">${esc(buf.help)}</div>
+      ${lineChart(`m:${name}`,
+                  [{name, color: SERIES[i % SERIES.length],
+                    points: buf.points}],
+                  {h: 110, fmt: (v) => +v.toPrecision(3)})}</section>`);
+  return charts.join("") +
+    (fams.length > 24 ? `<section class="wide"><span class="muted">` +
+      `${fams.length - 24} more families not shown</span></section>` : "");
+}
+
+function detailSection() {
+  if (!detail) return "";
+  return `<section class="wide"><h2>${esc(detail.title)}
+    <a class="right muted linklike" id="closedetail">close</a></h2>
+    <pre class="logs">${esc(detail.body)}</pre></section>`;
+}
+
+// ------------------------------------------------------------- routing
+
+function currentView() {
+  const name = (location.hash || "#overview").slice(1);
+  return VIEWS[name] ? name : "overview";
+}
+
+function renderNav() {
+  const cur = currentView();
+  $("nav").innerHTML = Object.entries(VIEWS).map(([name, v]) =>
+    `<a href="#${name}" class="${name === cur ? "active" : ""}">` +
+    `${v.title}</a>`).join("");
+}
+
+async function render() {
+  renderNav();
+  if (currentView() === "tasks") {
+    try { timelineBars = await j("/api/timeline?limit=2000"); }
+    catch { timelineBars = []; }
+  }
+  const focused = document.activeElement?.id === "search";
+  const pos = focused ? document.activeElement.selectionStart : 0;
+  $("view").innerHTML = VIEWS[currentView()].render();
+  if (focused && $("search")) {
+    $("search").focus();
+    $("search").setSelectionRange(pos, pos);
+  }
+}
+
+async function tick(force = false) {
+  if (!force && !$("autorefresh").checked) return;
+  try {
+    await poll();
+    await render();
+    $("err").textContent = "";
+  } catch (e) {
+    $("err").textContent = " · " + e;
+  }
+}
+
+// ------------------------------------------------------------- events
+
+window.addEventListener("hashchange", () => { detail = null; render(); });
+
+document.addEventListener("input", (e) => {
+  if (e.target.id === "search") { searchTerm = e.target.value; render(); }
+});
+
+document.addEventListener("submit", async (e) => {
+  if (e.target.id !== "jobform") return;
+  e.preventDefault();
+  const entrypoint = $("entrypoint").value.trim();
+  if (!entrypoint) return;
+  try {
+    await j("/api/jobs/", {method: "POST",
+      headers: {"content-type": "application/json"},
+      body: JSON.stringify({entrypoint})});
+    await tick(true);
+  } catch (err) { $("err").textContent = " · " + err; }
+});
+
+document.addEventListener("click", async (e) => {
+  const drill = e.target.closest(".drill");
+  if (drill) {
+    const d = await j(`/api/${drill.dataset.kind}/${drill.dataset.id}`);
+    detail = {title: `${drill.dataset.kind.slice(0, -1)} ` +
+              `${drill.dataset.id.slice(0, 12)}`,
+              body: JSON.stringify(d, null, 2)};
+    render();
+    return;
+  }
+  const logs = e.target.closest(".logs[data-id]");
+  if (logs) {
+    const body = await j(`/api/jobs/${logs.dataset.id}/logs`);
+    detail = {title: `job ${logs.dataset.id} logs (tail)`,
+              body: String(body.logs || "").split("\n").slice(-300).join("\n")};
+    render();
+    return;
+  }
+  const stop = e.target.closest(".stopjob");
+  if (stop) {
+    await fetch(`/api/jobs/${stop.dataset.id}/stop`, {method: "POST"});
+    await tick(true);
+    return;
+  }
+  if (e.target.id === "closedetail") { detail = null; render(); }
+});
+
+const POLL_MS = 2000;
+tick(true);
+setInterval(tick, POLL_MS);

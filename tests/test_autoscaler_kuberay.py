@@ -1,0 +1,160 @@
+"""KubeRay-style provider: scale by patching the RayCluster CR.
+
+Reference analog: python/ray/autoscaler/_private/kuberay/node_provider.py
+— the autoscaler edits `spec.workerGroupSpecs[*].replicas` +
+`scaleStrategy.workersToDelete` and the operator reconciles pods. Tested
+over real HTTP+JSON against the in-process fake API/operator.
+"""
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, InstanceType
+from ray_tpu.autoscaler.kuberay import FakeKubeApi, KubeRayProvider
+
+
+@pytest.fixture
+def kube():
+    api = FakeKubeApi(cluster_name="rt", token="sekret")
+    yield api
+    api.close()
+
+
+def _provider(api, **kw):
+    return KubeRayProvider(api.address, cluster_name="rt", token="sekret",
+                           **kw)
+
+
+def test_launch_is_a_cr_patch_not_a_pod_create(kube):
+    p = _provider(kube)
+    t = InstanceType("cpu-group", {"CPU": 4})
+    p.launch(t)
+    # The provider never made a pod — only the CR changed.
+    assert kube.pods == {}
+    g = kube.cr["spec"]["workerGroupSpecs"][0]
+    assert g["groupName"] == "cpu-group" and g["replicas"] == 1
+    # Operator round materializes the pod (Pending -> Running).
+    kube.reconcile()
+    assert len(p.non_terminated()) == 1
+    kube.reconcile()
+    pods = [pod for pod in kube.pods.values()]
+    assert pods[0]["status"]["phase"] == "Running"
+
+
+def test_terminate_names_the_victim_pod(kube):
+    """Scale-down must be precise: workersToDelete names the pod, so the
+    operator can't reap an arbitrary survivor."""
+    p = _provider(kube)
+    t = InstanceType("cpu-group", {"CPU": 4})
+    p.launch(t)
+    p.launch(t)
+    kube.reconcile()
+    kube.reconcile()
+    a, b = sorted(p.non_terminated())
+    pod_a, pod_b = p.pod_of(a), p.pod_of(b)
+    assert pod_a and pod_b and pod_a != pod_b
+    p.terminate(a)
+    g = kube.cr["spec"]["workerGroupSpecs"][0]
+    assert g["replicas"] == 1
+    assert g["scaleStrategy"]["workersToDelete"] == [pod_a]
+    kube.reconcile()
+    assert p.non_terminated() == [b]     # the survivor slot is untouched
+    assert p.pod_of(b) == pod_b          # ...and keeps its own pod
+
+
+def test_multihost_slice_is_one_replica(kube):
+    """A v5e-16 slice = ONE replica with numOfHosts=4 (atomic, like
+    KubeRay TPU worker groups)."""
+    p = _provider(kube)
+    t = InstanceType.for_pod_type("v5e-16", "v5e-16", cpus_per_host=1)
+    ids = p.launch_slice(t)
+    assert len(ids) == 4
+    g = kube.cr["spec"]["workerGroupSpecs"][0]
+    assert g["replicas"] == 1 and g["numOfHosts"] == 4
+    kube.reconcile()
+    assert len(p.non_terminated()) == 4  # operator made all 4 host pods
+
+
+def test_terminating_one_slice_spares_its_sibling(kube):
+    """Two multi-host slices in ONE group: draining slice A must drop
+    replicas 2 -> 1 (once per replica, not once per host slot) and must
+    name only A's pods — slice B keeps all hosts (intact ICI ring)."""
+    p = _provider(kube)
+    t = InstanceType.for_pod_type("v5e-16", "v5e-16", cpus_per_host=1)
+    slice_a = p.launch_slice(t)
+    slice_b = p.launch_slice(t)
+    kube.reconcile()
+    kube.reconcile()
+    assert len(p.non_terminated()) == 8
+    pods_b = {p.pod_of(s) for s in slice_b}
+    assert None not in pods_b and len(pods_b) == 4
+    # B's pods all share one operator replica; A's share another.
+    replica_of = lambda name: kube.pods[name]["metadata"]["labels"][
+        "ray.io/replica"]
+    assert len({replica_of(n) for n in pods_b}) == 1
+    pods_a = {p.pod_of(s) for s in slice_a}
+    assert {replica_of(n) for n in pods_a} != {replica_of(n) for n in pods_b}
+
+    for s in slice_a:
+        p.terminate(s)
+    g = kube.cr["spec"]["workerGroupSpecs"][0]
+    assert g["replicas"] == 1, "one replica down, not one per host slot"
+    assert set(g["scaleStrategy"]["workersToDelete"]) == pods_a
+    kube.reconcile()
+    survivors = {p.pod_of(s) for s in slice_b}
+    assert survivors == pods_b, "slice B must be untouched"
+    assert len(p.non_terminated()) == 4
+
+
+def test_bad_token_is_rejected(kube):
+    p = KubeRayProvider(kube.address, cluster_name="rt", token="wrong")
+    with pytest.raises(Exception, match="401|Unauthorized"):
+        p.launch(InstanceType("g", {"CPU": 1}))
+
+
+def test_autoscaler_e2e_scales_up_and_down(kube):
+    """Demand -> CR patch -> operator pods -> real raylets join; idle ->
+    precise scale-down. The full loop the reference runs on K8s."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=1)  # head
+        ray_tpu.init(address=cluster.address)
+        p = _provider(kube, cluster=cluster)
+        t = InstanceType("workers", {"CPU": 2}, max_workers=4)
+        scaler = Autoscaler(p, [t], idle_timeout_s=1.0)
+        r = scaler.reconcile(demand=[{"CPU": 2.0}] * 2)
+        assert r["launched"] == 2
+        kube.reconcile()  # operator: pods Pending
+        kube.reconcile()  # operator: pods Running
+        # Booting instances count as capacity: no relaunch.
+        assert scaler.reconcile(demand=[{"CPU": 2.0}] * 2)["launched"] == 0
+        # Pods back real raylets; the cluster sees the new nodes.
+        for iid in p.non_terminated():
+            assert p.get_node_id(iid) is not None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if len([n for n in ray_tpu.nodes() if n["alive"]]) >= 3:
+                break
+            time.sleep(0.25)
+        assert len([n for n in ray_tpu.nodes() if n["alive"]]) >= 3
+        # Idle drain: reconcile loop until the CR shrinks back.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            scaler.reconcile(demand=[])
+            kube.reconcile()
+            if not p.non_terminated():
+                break
+            time.sleep(0.3)
+        assert p.non_terminated() == []
+        g = kube.cr["spec"]["workerGroupSpecs"][0]
+        assert g["replicas"] == 0
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()

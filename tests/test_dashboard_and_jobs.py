@@ -37,8 +37,13 @@ def test_dashboard_api_surface(cluster_with_dashboard):
     assert res["total"]["CPU"] == 2
     with urllib.request.urlopen(url + "/", timeout=30) as r:
         body = r.read()
-    # The UI page itself, plus the tasks API it polls.
-    assert b"ray_tpu dashboard" in body and b"/api/tasks" in body
+    # The SPA shell plus its static module (which polls the tasks API).
+    assert b"ray_tpu dashboard" in body and b"/static/app.js" in body
+    with urllib.request.urlopen(url + "/static/app.js", timeout=30) as r:
+        appjs = r.read()
+    assert b"/api/tasks" in appjs and b"renderMetrics" in appjs
+    with urllib.request.urlopen(url + "/static/app.css", timeout=30) as r:
+        assert b"--panel" in r.read()
     tasks = _get_json(url + "/api/tasks")
     assert isinstance(tasks, list)
 
