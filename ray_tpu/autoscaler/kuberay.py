@@ -60,6 +60,7 @@ class KubeRayProvider(NodeProvider):
         self._slot_pod: Dict[str, Optional[str]] = {}
         self._slot_rid: Dict[str, str] = {}
         self._rid_replica: Dict[str, str] = {}  # rid -> replica label
+        self._last_pods: Dict[str, dict] = {}   # most recent _sync view
 
     # -- K8s API verbs ----------------------------------------------------
 
@@ -154,17 +155,16 @@ class KubeRayProvider(NodeProvider):
         pods. Drops slots whose bound pod disappeared. Returns
         pod-name -> pod."""
         pods = {p["metadata"]["name"]: p for p in self._pods()}
+        self._last_pods = pods
         for slot, pod in list(self._slot_pod.items()):
             if pod is not None and pod not in pods:
-                # Pod reaped (our terminate, or operator scale-in): the
-                # slot is gone with it.
-                rid = self._slot_rid.get(slot)
-                self._slot_pod.pop(slot)
-                self._slot_group.pop(slot, None)
-                self._slot_rid.pop(slot, None)
+                # Pod gone without US terminating the slot (eviction,
+                # node drain, operator restart): spec.replicas still
+                # demands it, so the operator WILL make a replacement —
+                # unbind the slot so it rebinds rather than orphaning
+                # the new pod outside our accounting forever.
+                self._slot_pod[slot] = None
                 self._nodes.pop(slot, None)
-                if rid and all(r != rid for r in self._slot_rid.values()):
-                    self._rid_replica.pop(rid, None)
         claimed = {p for p in self._slot_pod.values() if p}
         # replica label -> its pods, per group
         by_replica: Dict[tuple, List[str]] = {}
@@ -243,19 +243,34 @@ class KubeRayProvider(NodeProvider):
 
     def get_node_id(self, instance_id: str) -> Optional[bytes]:
         """In tests the fake operator backs a Running pod with a real local
-        raylet (cluster.add_node), labeled with the pod name."""
+        raylet (cluster.add_node), labeled with the pod name.
+
+        Reuses the pod map from the most recent _sync (a bound slot's pod
+        is stable) — the autoscaler calls this once per booting instance
+        per tick and must not turn every call into a pod-list GET."""
         node = self._nodes.get(instance_id)
         if node is None and self.cluster is not None:
-            pods = self._sync()
+            pods = self._last_pods if self._slot_pod.get(instance_id) \
+                else self._sync()
             pod_name = self._slot_pod.get(instance_id)
             pod = pods.get(pod_name) if pod_name else None
             if pod and pod.get("status", {}).get("phase") == "Running":
                 spec = pod.get("spec", {})
+                lab = pod["metadata"].get("labels", {})
                 res = dict(spec.get("resources") or {"CPU": 1})
                 labels = {"kuberay.io/pod": pod_name}
                 if spec.get("tpuSlice"):
-                    labels["tpu-pod-type"] = spec["tpuSlice"]
-                    labels["tpu-slice-name"] = pod_name.rsplit("-", 1)[0]
+                    # Slice identity must be PER REPLICA and carry the host
+                    # index, or multi-host gang placement (STRICT_PACK ICI
+                    # contiguity) can never match kuberay nodes.
+                    from ray_tpu.runtime import tpu_topology
+
+                    group = lab.get("ray.io/group", "workers")
+                    replica = lab.get("ray.io/replica", "0")
+                    host = int(lab.get("ray.io/host-index", 0))
+                    labels.update(tpu_topology.slice_labels(
+                        f"{self.name}-{group}-r{replica}",
+                        spec["tpuSlice"], host))
                 node = self.cluster.add_node(
                     num_cpus=res.pop("CPU", 1), num_tpus=res.pop("TPU", 0),
                     resources=res or None, labels=labels)
@@ -373,29 +388,45 @@ class FakeKubeApi:
                     r = p["metadata"]["labels"].get("ray.io/replica")
                     replicas.setdefault(r, []).append(p)
                 want = g["replicas"]
+
+                def make_pod(r, host_idx):
+                    tmpl = g.get("template", {})
+                    name = f"{self.name}-{group}-{uuid.uuid4().hex[:6]}"
+                    labels = dict(tmpl.get("metadata", {}).get("labels", {}))
+                    labels["ray.io/replica"] = r
+                    labels["ray.io/host-index"] = str(host_idx)
+                    self.pods[name] = {
+                        "metadata": {"name": name, "labels": labels},
+                        "spec": dict(tmpl.get("spec", {})),
+                        "status": {"phase": "Pending", "_age": 0},
+                    }
+
+                # heal partial replicas (evicted host pods) first
+                for r, pods_r in replicas.items():
+                    if 0 < len(pods_r) < hosts:
+                        used = {p["metadata"]["labels"]
+                                .get("ray.io/host-index") for p in pods_r}
+                        for i in range(hosts):
+                            if str(i) not in used:
+                                make_pod(r, i)
                 # new replicas on free indices, all hosts at once
                 idx = 0
                 while len(replicas) < want:
                     while str(idx) in replicas:
                         idx += 1
                     r = str(idx)
-                    replicas[r] = []
-                    tmpl = g.get("template", {})
-                    for _ in range(hosts):
-                        name = f"{self.name}-{group}-{uuid.uuid4().hex[:6]}"
-                        labels = dict(
-                            tmpl.get("metadata", {}).get("labels", {}))
-                        labels["ray.io/replica"] = r
-                        self.pods[name] = {
-                            "metadata": {"name": name, "labels": labels},
-                            "spec": dict(tmpl.get("spec", {})),
-                            "status": {"phase": "Pending", "_age": 0},
-                        }
+                    replicas[r] = [None]  # placeholder: now occupied
+                    for i in range(hosts):
+                        make_pod(r, i)
                 # excess replicas reaped whole (highest index first)
                 for r in sorted(replicas, reverse=True)[:max(
                         len(replicas) - want, 0)]:
-                    for p in replicas[r]:
-                        self.pods.pop(p["metadata"]["name"], None)
+                    for name in [n for n, p in self.pods.items()
+                                 if p["metadata"]["labels"].get(
+                                     "ray.io/group") == group
+                                 and p["metadata"]["labels"].get(
+                                     "ray.io/replica") == r]:
+                        self.pods.pop(name, None)
             for p in self.pods.values():
                 st = p["status"]
                 if st["phase"] == "Pending":

@@ -431,30 +431,35 @@ document.addEventListener("submit", async (e) => {
 });
 
 document.addEventListener("click", async (e) => {
-  const drill = e.target.closest(".drill");
-  if (drill) {
-    const d = await j(`/api/${drill.dataset.kind}/${drill.dataset.id}`);
-    detail = {title: `${drill.dataset.kind.slice(0, -1)} ` +
-              `${drill.dataset.id.slice(0, 12)}`,
-              body: JSON.stringify(d, null, 2)};
-    render();
-    return;
+  try {
+    const drill = e.target.closest(".drill");
+    if (drill) {
+      const d = await j(`/api/${drill.dataset.kind}/${drill.dataset.id}`);
+      detail = {title: `${drill.dataset.kind.slice(0, -1)} ` +
+                `${drill.dataset.id.slice(0, 12)}`,
+                body: JSON.stringify(d, null, 2)};
+      render();
+      return;
+    }
+    const logs = e.target.closest(".logs[data-id]");
+    if (logs) {
+      const body = await j(`/api/jobs/${logs.dataset.id}/logs`);
+      detail = {title: `job ${logs.dataset.id} logs (tail)`,
+                body: String(body.logs || "").split("\n").slice(-300)
+                  .join("\n")};
+      render();
+      return;
+    }
+    const stop = e.target.closest(".stopjob");
+    if (stop) {
+      await fetch(`/api/jobs/${stop.dataset.id}/stop`, {method: "POST"});
+      await tick(true);
+      return;
+    }
+    if (e.target.id === "closedetail") { detail = null; render(); }
+  } catch (err) {
+    $("err").textContent = " · " + err;  // e.g. drilling a just-GC'd actor
   }
-  const logs = e.target.closest(".logs[data-id]");
-  if (logs) {
-    const body = await j(`/api/jobs/${logs.dataset.id}/logs`);
-    detail = {title: `job ${logs.dataset.id} logs (tail)`,
-              body: String(body.logs || "").split("\n").slice(-300).join("\n")};
-    render();
-    return;
-  }
-  const stop = e.target.closest(".stopjob");
-  if (stop) {
-    await fetch(`/api/jobs/${stop.dataset.id}/stop`, {method: "POST"});
-    await tick(true);
-    return;
-  }
-  if (e.target.id === "closedetail") { detail = null; render(); }
 });
 
 const POLL_MS = 2000;

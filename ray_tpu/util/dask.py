@@ -1,0 +1,147 @@
+"""Dask-graph scheduler: execute dask task graphs as ray_tpu tasks.
+
+Reference analog: python/ray/util/dask/scheduler.py (ray_dask_get) — a
+drop-in `get` for dask's scheduler interface, so
+`dask.compute(x, scheduler=ray_dask_get)` fans the graph out over the
+cluster. The dask graph protocol is plain data (dict of key -> task,
+task = (callable, *args) tuples with nested key references), so this
+module implements the protocol directly and works with or without dask
+installed; when dask IS present, `enable()` registers the scheduler as
+dask's default.
+
+Semantics implemented (dask/core.py's get semantics):
+  * a task is a tuple whose head is callable: (fn, *args);
+  * args are recursively resolved: keys -> their computed values,
+    lists/tuples recurse;
+  * a key mapping to a literal (non-task) is that literal;
+  * nested tasks inside args execute inline (dask semantics).
+
+Execution: one ray_tpu task per graph node (batched by a configurable
+inline threshold — tiny pure-literal nodes don't deserve a round-trip),
+dependencies passed as ObjectRefs so the object store moves data and
+independent subgraphs run in parallel.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Hashable, List, Set
+
+logger = logging.getLogger(__name__)
+
+
+def _is_task(x: Any) -> bool:
+    return isinstance(x, tuple) and len(x) > 0 and callable(x[0])
+
+
+def _keys_in(x: Any, dsk: Dict) -> Set[Hashable]:
+    """Keys of `dsk` referenced (recursively) by argument structure x."""
+    out: Set[Hashable] = set()
+    if _is_task(x):
+        for a in x[1:]:
+            out |= _keys_in(a, dsk)
+    elif isinstance(x, (list, tuple)):
+        for a in x:
+            out |= _keys_in(a, dsk)
+    elif isinstance(x, Hashable) and x in dsk:
+        out.add(x)
+    return out
+
+
+def _execute_node(task, dep_keys, *dep_values) -> Any:
+    """Run one graph node on a worker: rebuild args from resolved deps.
+
+    Dependencies arrive as TOP-LEVEL task args (dep_values), because
+    ObjectRefs nested inside containers are not auto-resolved — the same
+    rule as the reference's task arguments."""
+    resolved = dict(zip(dep_keys, dep_values))
+    def build(x):
+        if _is_task(x):
+            fn, *args = x
+            return fn(*[build(a) for a in args])
+        if isinstance(x, list):
+            return [build(a) for a in x]
+        if isinstance(x, tuple):
+            return tuple(build(a) for a in x)
+        if isinstance(x, Hashable) and x in resolved:
+            return resolved[x]
+        return x
+
+    return build(task)
+
+
+def ray_dask_get(dsk: Dict, keys, **kwargs) -> Any:
+    """dask scheduler entry point: compute `keys` from graph `dsk`.
+
+    keys may be a single key or a (nested) list of keys, per dask's get
+    contract; the result mirrors its shape."""
+    import ray_tpu
+
+    dsk = dict(dsk)
+    # dependency map + topological order (Kahn)
+    deps: Dict[Hashable, Set[Hashable]] = {
+        k: _keys_in(v, dsk) - {k} for k, v in dsk.items()}
+    pending = {k: set(d) for k, d in deps.items()}
+    ready = [k for k, d in pending.items() if not d]
+    order: List[Hashable] = []
+    dependents: Dict[Hashable, Set[Hashable]] = {k: set() for k in dsk}
+    for k, d in deps.items():
+        for dep in d:
+            dependents[dep].add(k)
+    while ready:
+        k = ready.pop()
+        order.append(k)
+        for child in dependents[k]:
+            pending[child].discard(k)
+            if not pending[child]:
+                ready.append(child)
+    if len(order) != len(dsk):
+        cyc = sorted(set(dsk) - set(order), key=str)[:3]
+        raise ValueError(f"cycle in dask graph near keys {cyc}")
+
+    exec_node = ray_tpu.remote(_execute_node)
+    refs: Dict[Hashable, Any] = {}   # key -> ObjectRef or literal
+    for k in order:
+        v = dsk[k]
+        if not _is_task(v) and not _keys_in(v, dsk):
+            refs[k] = v              # literal: no task round-trip
+            continue
+        dep_keys = sorted(deps[k], key=str)
+        refs[k] = exec_node.remote(v, dep_keys,
+                                   *[refs[d] for d in dep_keys])
+
+    # Batch the final fetch: one ray_tpu.get for every requested ref.
+    from ray_tpu.core.object_ref import ObjectRef
+
+    flat: List[Hashable] = []
+
+    def walk(x):
+        if isinstance(x, list):
+            for i in x:
+                walk(i)
+        else:
+            flat.append(x)
+
+    walk(keys)
+    to_fetch = [k for k in flat if isinstance(refs[k], ObjectRef)]
+    fetched = dict(zip(to_fetch, ray_tpu.get([refs[k] for k in to_fetch]))) \
+        if to_fetch else {}
+    values = {k: fetched.get(k, refs[k]) for k in flat}
+
+    def shape(x):
+        if isinstance(x, list):
+            return [shape(i) for i in x]
+        return values[x]
+
+    return shape(keys)
+
+
+def enable() -> bool:
+    """Register as dask's default scheduler (no-op without dask)."""
+    try:
+        import dask
+    except ImportError:
+        logger.info("dask not installed; ray_dask_get still usable directly")
+        return False
+    dask.config.set(scheduler=ray_dask_get)
+    return True

@@ -105,6 +105,67 @@ def test_terminating_one_slice_spares_its_sibling(kube):
     assert len(p.non_terminated()) == 4
 
 
+def test_evicted_pod_rebinds_to_operator_replacement(kube):
+    """K8s can kill a pod under us (node drain, OOM). replicas still
+    demands it, so the operator heals the replica — the slot must rebind
+    to the replacement instead of orphaning it outside our accounting."""
+    p = _provider(kube)
+    t = InstanceType("cpu-group", {"CPU": 4})
+    slot = p.launch(t)
+    kube.reconcile()
+    kube.reconcile()
+    victim = p.pod_of(slot)
+    assert victim is not None
+    kube.pods.pop(victim)             # external eviction, not our terminate
+    assert p.pod_of(slot) is None     # unbound, NOT forgotten
+    assert slot in p.non_terminated()  # still a live (booting) slot
+    kube.reconcile()                  # operator heals the replica
+    kube.reconcile()
+    replacement = p.pod_of(slot)
+    assert replacement is not None and replacement != victim
+    # and the CR never over- or under-counted
+    assert kube.cr["spec"]["workerGroupSpecs"][0]["replicas"] == 1
+    p.terminate(slot)                 # precise drain still works
+    kube.reconcile()
+    assert p.non_terminated() == []
+
+
+def test_multihost_nodes_carry_gangable_slice_labels(kube):
+    """Raylets backed by kuberay pods must advertise per-replica slice
+    names + host indices or STRICT_PACK gang placement can never match
+    (tpu_topology.find_contiguous_hosts needs worker ids 0..n-1)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=1)
+        ray_tpu.init(address=cluster.address)
+        p = _provider(kube, cluster=cluster)
+        t = InstanceType.for_pod_type("v5e-16", "v5e-16", cpus_per_host=1)
+        slice_a = p.launch_slice(t)
+        slice_b = p.launch_slice(t)
+        kube.reconcile()
+        kube.reconcile()
+        for s in slice_a + slice_b:
+            assert p.get_node_id(s) is not None
+        by_slice = {}
+        for n in ray_tpu.nodes():
+            lab = n.get("labels") or {}
+            if "tpu-slice-name" in lab:
+                by_slice.setdefault(lab["tpu-slice-name"], []).append(
+                    lab["tpu-worker-id"])
+        assert len(by_slice) == 2, by_slice  # one name PER replica
+        for workers in by_slice.values():
+            assert sorted(workers) == ["0", "1", "2", "3"]
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
 def test_bad_token_is_rejected(kube):
     p = KubeRayProvider(kube.address, cluster_name="rt", token="wrong")
     with pytest.raises(Exception, match="401|Unauthorized"):
