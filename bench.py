@@ -515,6 +515,58 @@ def serve_bench_result(backend: str) -> dict:
     p50 = ttfts[len(ttfts) // 2]
     p95 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.95))]
     decode_tok_s = decoded / max(sum(decode_times), 1e-9)
+
+    # Multi-step decode probe: k tokens per dispatch via the on-device
+    # scan (engine decode_multi_step). Reuses the SAME runner, so the only
+    # new compile is the k-step program; on dispatch-latency-bound setups
+    # (this chip arrives over a relay) this is the decode-throughput
+    # lever. The headline decode number reports the better of the two.
+    multi_k = 8
+    multi_tok_s = None
+    try:
+        engine_m = LLMEngine(runner, max_batch_size=8,
+                             prefill_chunk=512 if on_tpu else 16,
+                             pipeline_depth=2, decode_multi_step=multi_k)
+        # Only the k-step scan per batch bucket is cold; warm it.
+        engine_m.warmup()
+        engine_m.generate([prompt], SamplingParams(max_tokens=multi_k + 1))
+        m_decoded, m_time = 0, 0.0
+        for _ in range(n_requests):
+            p = rng.randint(1, config.vocab_size, prompt_len).tolist()
+            t0 = time.perf_counter()
+            first_at = None
+            for i, _tok in enumerate(engine_m.stream(
+                    p, SamplingParams(max_tokens=gen_tokens))):
+                if i == 0:
+                    first_at = time.perf_counter() - t0
+            m_time += time.perf_counter() - t0 - first_at
+            # The first yield lands after a FULL k-token dispatch, so the
+            # post-first_at window covers gen_tokens - k tokens (counting
+            # gen-1 like the single-step leg would inflate this number by
+            # ~k/gen and could crown multi-step on measurement bias).
+            m_decoded += max(gen_tokens - multi_k, 1)
+        multi_tok_s = m_decoded / max(m_time, 1e-9)
+    except Exception as exc:  # best-effort probe; never sinks the leg
+        PROBE_LOG.append({"multi_step_decode": f"{type(exc).__name__}: "
+                                               f"{str(exc)[:160]}"})
+
+    # Throughput under load: all requests in flight at once — continuous
+    # batching aggregates decode across the whole batch (the number that
+    # scales serving cost, vs the latency-oriented sequential runs above).
+    throughput_tok_s = None
+    try:
+        eng_t = engine_m if multi_tok_s else engine
+        prompts = [rng.randint(1, config.vocab_size, prompt_len).tolist()
+                   for _ in range(n_requests)]
+        t0 = time.perf_counter()
+        outs = eng_t.generate(prompts,
+                              SamplingParams(max_tokens=gen_tokens))
+        wall = time.perf_counter() - t0
+        total = sum(len(o.output_token_ids) for o in outs)
+        throughput_tok_s = total / max(wall, 1e-9)
+    except Exception as exc:
+        PROBE_LOG.append({"throughput": f"{type(exc).__name__}: "
+                                        f"{str(exc)[:160]}"})
     return {
         "ttft_p50_ms": round(p50 * 1000, 2),
         "ttft_p95_ms": round(p95 * 1000, 2),
@@ -525,7 +577,15 @@ def serve_bench_result(backend: str) -> dict:
                 engine.block_manager.prefix_tokens_saved),
         },
         "vs_target": round(0.150 / max(p50, 1e-9), 3),  # >1 beats 150ms
-        "decode_tokens_per_sec": round(decode_tok_s, 1),
+        "decode_tokens_per_sec": round(max(decode_tok_s, multi_tok_s or 0),
+                                       1),
+        "decode_single_step": round(decode_tok_s, 1),
+        "decode_multi_step_k": multi_k,
+        "decode_multi_step": (round(multi_tok_s, 1)
+                              if multi_tok_s is not None else None),
+        "throughput_tokens_per_sec": (round(throughput_tok_s, 1)
+                                      if throughput_tok_s is not None
+                                      else None),
         "prompt_len": prompt_len,
         "gen_tokens": gen_tokens,
         "requests": n_requests,

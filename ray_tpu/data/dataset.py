@@ -429,6 +429,158 @@ def read_feather(paths, *, parallelism: int = 8) -> Dataset:
         ds_mod.FeatherDatasource(paths), parallelism)], parallelism)
 
 
+# ---- extended catalog (data/connectors.py) --------------------------------
+
+def read_parquet_bulk(paths, *, parallelism: int = 8) -> Dataset:
+    """One read task per explicitly-listed file, no directory/metadata
+    inference (reference: read_api.read_parquet_bulk — the fast path for
+    huge file lists)."""
+    return Dataset([plan_mod.Read(
+        ds_mod.ParquetBulkDatasource(paths), parallelism)], parallelism)
+
+
+def read_delta(table_path: str, *, version=None,
+               parallelism: int = 8) -> Dataset:
+    """Delta Lake table at its latest (or a pinned) version (reference:
+    read_api.read_delta). Self-contained: replays the JSON transaction
+    log; no deltalake client needed."""
+    from ray_tpu.data import connectors
+
+    return Dataset([plan_mod.Read(
+        connectors.DeltaDatasource(table_path, version), parallelism)],
+        parallelism)
+
+
+def read_audio(paths, *, parallelism: int = 8) -> Dataset:
+    """Audio files -> {"amplitude", "sample_rate", "path"} rows
+    (reference: read_api.read_audio). WAV is native; other codecs need
+    soundfile."""
+    from ray_tpu.data import connectors
+
+    return Dataset([plan_mod.Read(
+        connectors.AudioDatasource(paths), parallelism)], parallelism)
+
+
+def read_videos(paths, *, parallelism: int = 8) -> Dataset:
+    """Video frames, one row each (reference: read_api.read_videos;
+    requires cv2)."""
+    from ray_tpu.data import connectors
+
+    return Dataset([plan_mod.Read(
+        connectors.VideoDatasource(paths), parallelism)], parallelism)
+
+
+def read_mongo(uri: str, database: str, collection: str, *, pipeline=None,
+               parallelism: int = 1) -> Dataset:
+    """MongoDB collection/aggregation (reference: read_api.read_mongo;
+    requires pymongo)."""
+    from ray_tpu.data import connectors
+
+    return Dataset([plan_mod.Read(connectors.MongoDatasource(
+        uri, database, collection, pipeline), parallelism)], parallelism)
+
+
+def read_bigquery(project_id: str, query: str, *,
+                  parallelism: int = 1) -> Dataset:
+    """BigQuery SQL result (reference: read_api.read_bigquery; requires
+    google-cloud-bigquery)."""
+    from ray_tpu.data import connectors
+
+    return Dataset([plan_mod.Read(connectors.BigQueryDatasource(
+        project_id, query), parallelism)], parallelism)
+
+
+def read_clickhouse(dsn: str, query: str, *,
+                    parallelism: int = 1) -> Dataset:
+    """ClickHouse query result (reference: read_api.read_clickhouse;
+    requires clickhouse-connect)."""
+    from ray_tpu.data import connectors
+
+    return Dataset([plan_mod.Read(connectors.ClickHouseDatasource(
+        dsn, query), parallelism)], parallelism)
+
+
+def read_databricks_tables(server_hostname: str, http_path: str,
+                           token: str, query: str, *,
+                           parallelism: int = 1) -> Dataset:
+    """Databricks SQL warehouse query (reference:
+    read_api.read_databricks_tables; requires databricks-sql-connector)."""
+    from ray_tpu.data import connectors
+
+    return Dataset([plan_mod.Read(connectors.DatabricksDatasource(
+        server_hostname, http_path, token, query), parallelism)],
+        parallelism)
+
+
+def read_lance(uri: str, *, columns=None, parallelism: int = 1) -> Dataset:
+    """Lance dataset (reference: read_api.read_lance; requires lance)."""
+    from ray_tpu.data import connectors
+
+    return Dataset([plan_mod.Read(connectors.LanceDatasource(
+        uri, columns), parallelism)], parallelism)
+
+
+def read_iceberg(table_identifier: str, *, catalog_kwargs=None,
+                 parallelism: int = 1) -> Dataset:
+    """Iceberg table scan (reference: read_api.read_iceberg; requires
+    pyiceberg)."""
+    from ray_tpu.data import connectors
+
+    return Dataset([plan_mod.Read(connectors.IcebergDatasource(
+        table_identifier, catalog_kwargs), parallelism)], parallelism)
+
+
+def read_hudi(table_uri: str, *, parallelism: int = 1) -> Dataset:
+    """Hudi table snapshot (reference: read_api.read_hudi; requires
+    hudi)."""
+    from ray_tpu.data import connectors
+
+    return Dataset([plan_mod.Read(connectors.HudiDatasource(table_uri),
+                                  parallelism)], parallelism)
+
+
+def from_dask(ddf) -> Dataset:
+    """Dask collection -> Dataset, partitions computed via the ray_tpu
+    dask scheduler (reference: read_api.from_dask; requires dask)."""
+    from ray_tpu.data import connectors
+
+    import pyarrow as pa
+
+    # One block per dask partition — never pd.concat on the driver (that
+    # would double peak memory and collapse the collection's parallelism
+    # into a single giant block).
+    return from_blocks([pa.Table.from_pandas(p, preserve_index=False)
+                        for p in connectors.dask_partitions(ddf)])
+
+
+def from_modin(df) -> Dataset:
+    """Modin dataframe -> Dataset (reference: read_api.from_modin)."""
+    from ray_tpu.data import connectors
+
+    return from_pandas(connectors.dataframe_from(df, "modin"))
+
+
+def from_mars(df) -> Dataset:
+    """Mars dataframe -> Dataset (reference: read_api.from_mars)."""
+    from ray_tpu.data import connectors
+
+    return from_pandas(connectors.dataframe_from(df, "mars"))
+
+
+def from_daft(df) -> Dataset:
+    """Daft dataframe -> Dataset (reference: read_api.from_daft)."""
+    from ray_tpu.data import connectors
+
+    return from_pandas(connectors.dataframe_from(df, "daft"))
+
+
+def from_spark(df) -> Dataset:
+    """Spark dataframe -> Dataset (reference: read_api.from_spark)."""
+    from ray_tpu.data import connectors
+
+    return from_pandas(connectors.dataframe_from(df, "spark"))
+
+
 def range_tensor(n: int, *, shape=(1,), parallelism: int = 8) -> Dataset:
     """Rows of index-filled ndarrays (reference: read_api.range_tensor,
     the standard data-benchmark source)."""

@@ -110,6 +110,17 @@ class ParquetDatasource(_FileDatasource):
         return pq.read_table(path)
 
 
+class ParquetBulkDatasource(ParquetDatasource):
+    """Explicit file list, NO directory/glob expansion or existence check
+    up front (reference: read_parquet_bulk — the fast path for huge
+    already-resolved file lists)."""
+
+    def __init__(self, paths):
+        if isinstance(paths, str):
+            paths = [paths]
+        self.paths = list(paths)
+
+
 class CSVDatasource(_FileDatasource):
     def _read_file(self, path):
         from pyarrow import csv as pacsv

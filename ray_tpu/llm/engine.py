@@ -228,7 +228,8 @@ class LLMEngine:
                  tokenizer=None, prefill_chunk: Optional[int] = None,
                  pipeline_depth: Optional[int] = None,
                  enable_prefix_caching: bool = True,
-                 speculative_ngram: int = 0):
+                 speculative_ngram: int = 0,
+                 decode_multi_step: int = 1):
         self.runner = model_runner
         self.block_size = model_runner.block_size
         self.block_manager = BlockManager(
@@ -271,6 +272,14 @@ class LLMEngine:
         # batches (exact acceptance needs argmax determinism).
         self.spec_ngram = int(speculative_ngram)
         self.spec_tokens_accepted = 0
+        # Multi-step decode: one dispatch scans k tokens on device (the
+        # vLLM multi-step-scheduling analog, done as a lax.scan). The big
+        # lever when per-execute dispatch latency (remote TPU relays)
+        # rivals per-token compute. A batch uses k = decode_multi_step
+        # only when EVERY member has k tokens of page/length headroom —
+        # otherwise it falls back to the single-step program (both are
+        # precompiled; no mid-stream compiles either way).
+        self.multi_step = max(1, int(decode_multi_step))
 
     # ---- API -------------------------------------------------------------
 
@@ -445,6 +454,11 @@ class LLMEngine:
             samp = (np.zeros(S, np.float32), np.zeros(S, np.int32),
                     np.ones(S, np.float32), np.zeros(S, np.int32), zeros)
             r.step_sample(*args, *samp)
+            if Bq == 1 and self.multi_step > 1:
+                # The k-token scan is a distinct program per batch bucket:
+                # warm it or the first multi-step dispatch compiles
+                # mid-stream (exactly the cliff warmup exists to prevent).
+                r.step_sample_multi(self.multi_step, *args, *samp)
             if Bq in verify_widths:
                 # Membership in the runner's own ladder (not a hardcoded
                 # lower bound): a chunk_size < 8 config has ladder
@@ -574,15 +588,16 @@ class LLMEngine:
         return outputs
 
     def _ensure_pages(self) -> None:
-        """Every running seq needs pages for committed + dispatched + 1
-        tokens; preempt the newest otherwise. Preempted/finished pages that
-        an in-flight step may still write are released only once drained."""
+        """Every running seq needs pages for committed + dispatched + the
+        next dispatch's tokens (multi_step when active); preempt the
+        newest otherwise. Preempted/finished pages that an in-flight step
+        may still write are released only once drained."""
         for req in list(self.running):
             if req not in self.running:
                 continue
             while not self.block_manager.allocate(
-                    req, min(req.num_tokens + req.dispatched + 1,
-                             self._cap_tokens)):
+                    req, min(req.num_tokens + req.dispatched
+                             + self.multi_step, self._cap_tokens)):
                 victim = self.running[-1]
                 self.running.remove(victim)
                 victim.prefilled = 0
@@ -616,6 +631,20 @@ class LLMEngine:
         batch = [r for r in self.running if eligible(r)]
         if not batch:
             return None
+
+        def headroom(r):
+            return min(
+                r.params.max_tokens - len(r.output) - r.dispatched,
+                self._cap_tokens - r.num_tokens - r.dispatched,
+                len(r.blocks) * self.block_size - r.num_tokens
+                - r.dispatched)
+
+        # All-or-nothing k: the scan's block tables and step count are
+        # static, so every member needs full headroom or the batch takes
+        # the (equally precompiled) single-step program.
+        k = self.multi_step if (self.multi_step > 1 and
+                                all(headroom(r) >= self.multi_step
+                                    for r in batch)) else 1
         S = self.runner.batch_bucket(len(batch))
         host_tokens = np.zeros(S, dtype=np.int32)
         gather_idx = np.zeros(S, dtype=np.int32)
@@ -641,41 +670,53 @@ class LLMEngine:
             counters[i] = pos + 1
         if prev is not None and from_prev.any():
             toks = jnp.where(jnp.asarray(from_prev),
-                             prev["tokens"][jnp.asarray(gather_idx)],
+                             prev["last"][jnp.asarray(gather_idx)],
                              jnp.asarray(host_tokens))
         else:
             toks = jnp.asarray(host_tokens)
         temps, top_ks, top_ps, seeds, counters = self._sampling_arrays(
             batch, S, counters)
-        dev_tokens = self.runner.step_sample(
-            toks[:, None], q_positions, kv_lens, q_lens, tables,
-            temps, top_ks, top_ps, seeds, counters,
-            lora_idx=self._lora_idx(batch, S))
+        if k > 1:
+            dev_tokens = self.runner.step_sample_multi(
+                k, toks[:, None], q_positions, kv_lens, q_lens, tables,
+                temps, top_ks, top_ps, seeds, counters,
+                lora_idx=self._lora_idx(batch, S))  # (S, k)
+            last = dev_tokens[:, -1]
+        else:
+            dev_tokens = self.runner.step_sample(
+                toks[:, None], q_positions, kv_lens, q_lens, tables,
+                temps, top_ks, top_ps, seeds, counters,
+                lora_idx=self._lora_idx(batch, S))  # (S,)
+            last = dev_tokens
         try:
             dev_tokens.copy_to_host_async()
         except AttributeError:
             pass
         for req in batch:
-            req.dispatched += 1
-        return {"batch": batch, "tokens": dev_tokens}
+            req.dispatched += k
+        return {"batch": batch, "tokens": dev_tokens, "last": last, "k": k}
 
     def _process_inflight(self, flight: Optional[dict]) -> List[RequestOutput]:
         if flight is None:
             return []
         fetched = np.asarray(flight["tokens"])  # sync point (overlapped)
+        k = flight.get("k", 1)
+        if fetched.ndim == 1:
+            fetched = fetched[:, None]
         outputs: List[RequestOutput] = []
         for i, req in enumerate(flight["batch"]):
-            req.dispatched -= 1
-            if req.finished_reason is not None:
-                continue  # token sampled past the end: discard
+            req.dispatched -= k
             if req not in self.running:
                 continue  # preempted: will recompute from context
-            token = int(fetched[i])
-            req.output.append(token)
-            outputs.append(self._emit(req, [token]))
-            if req.finished_reason:
-                self.running.remove(req)
-                self._defer_release(req)
+            for j in range(k):
+                if req.finished_reason is not None:
+                    break  # tokens sampled past the end: discard
+                token = int(fetched[i, j])
+                req.output.append(token)
+                outputs.append(self._emit(req, [token]))
+                if req.finished_reason:
+                    self.running.remove(req)
+                    self._defer_release(req)
         return outputs
 
     def _defer_release(self, req: _Request):

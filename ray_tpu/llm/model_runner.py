@@ -87,6 +87,7 @@ class ModelRunner:
         self._step_jit = jax.jit(self._step, donate_argnums=(1,))
         self._step_sample_jit = jax.jit(self._step_sample, donate_argnums=(1,))
         self._step_verify_jit = jax.jit(self._step_verify, donate_argnums=(1,))
+        self._multi_jits: Dict[int, object] = {}  # n_steps -> jitted scan
 
     # ---- placement (TP over the mesh, SERVE_RULES) -----------------------
 
@@ -306,6 +307,50 @@ class ModelRunner:
         when to fetch (overlap the transfer with the next dispatch)."""
         lora, idx = self._lora_args(lora_idx, len(tokens))
         toks, self.cache = self._step_sample_jit(
+            self.params, self.cache, tokens, q_positions, kv_lens, q_lens,
+            block_tables, temps, top_ks, top_ps, seeds, counters, lora, idx)
+        return toks
+
+    # ---- multi-step decode ----------------------------------------------
+    #
+    # One dispatch generates n_steps tokens per sequence via lax.scan:
+    # sample -> feed back -> advance positions, entirely on device. The
+    # host sees ONE execute round-trip for n tokens instead of n — the
+    # decode-throughput lever when dispatch latency (remote TPU relays,
+    # slow hosts) rivals per-token compute. Pages for all n tokens must be
+    # preallocated (block tables are static across the scan); the engine
+    # guarantees that before dispatching.
+
+    def _step_sample_multi(self, n_steps: int, params, cache, tokens,
+                           q_positions, kv_lens, q_lens, block_tables,
+                           temps, top_ks, top_ps, seeds, counters,
+                           lora=None, lora_idx=None):
+        def body(carry, step):
+            cache, toks = carry
+            logits, cache = self._step(
+                params, cache, toks, q_positions + step, kv_lens + step,
+                q_lens, block_tables, lora, lora_idx)
+            sampled = self._device_sample(logits, temps, top_ks, top_ps,
+                                          seeds, counters + step)
+            return (cache, sampled[:, None]), sampled
+
+        (cache, _), out = jax.lax.scan(
+            body, (cache, tokens), jnp.arange(n_steps))
+        return out.T, cache    # (S, n_steps)
+
+    def step_sample_multi(self, n_steps: int, tokens, q_positions, kv_lens,
+                          q_lens, block_tables, temps, top_ks, top_ps,
+                          seeds, counters, lora_idx=None):
+        """n_steps decode tokens per sequence in one dispatch. kv_lens /
+        counters are the FIRST step's values (advance on device). Returns
+        device int32 (S, n_steps)."""
+        fn = self._multi_jits.get(n_steps)
+        if fn is None:
+            fn = jax.jit(partial(self._step_sample_multi, n_steps),
+                         donate_argnums=(1,))
+            self._multi_jits[n_steps] = fn
+        lora, idx = self._lora_args(lora_idx, len(tokens))
+        toks, self.cache = fn(
             self.params, self.cache, tokens, q_positions, kv_lens, q_lens,
             block_tables, temps, top_ks, top_ps, seeds, counters, lora, idx)
         return toks

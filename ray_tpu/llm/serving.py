@@ -46,6 +46,10 @@ class LLMConfig:
     # (greedy-only; tokens proposed from the sequence's own history).
     enable_prefix_caching: bool = True
     speculative_ngram: int = 0
+    # Multi-step decode: one dispatch generates k tokens via an on-device
+    # scan (engine.py) — the decode-throughput lever when dispatch latency
+    # rivals per-token compute (remote-attached TPUs).
+    decode_multi_step: int = 1
     # Precompile step buckets at replica start so user requests don't pay
     # XLA compiles mid-stream (vLLM-TPU startup precompile; a cold bucket
     # costs seconds of TTFT on multi-B-param models). "full" = whole
@@ -98,7 +102,8 @@ class LLMServer:
             tokenizer=llm_config.tokenizer,
             prefill_chunk=llm_config.prefill_chunk,
             enable_prefix_caching=llm_config.enable_prefix_caching,
-            speculative_ngram=llm_config.speculative_ngram)
+            speculative_ngram=llm_config.speculative_ngram,
+            decode_multi_step=llm_config.decode_multi_step)
         wm = llm_config.warmup_buckets
         wm = {True: "full", False: "off"}.get(wm, wm)
         if wm not in ("off", "light", "full"):

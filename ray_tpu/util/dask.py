@@ -34,16 +34,29 @@ def _is_task(x: Any) -> bool:
     return isinstance(x, tuple) and len(x) > 0 and callable(x[0])
 
 
+def _hashable(x: Any) -> bool:
+    try:
+        hash(x)
+    except TypeError:
+        return False
+    return True
+
+
 def _keys_in(x: Any, dsk: Dict) -> Set[Hashable]:
-    """Keys of `dsk` referenced (recursively) by argument structure x."""
+    """Keys of `dsk` referenced by argument structure x — dask/core.py's
+    traversal order EXACTLY: task -> recurse args; list -> recurse
+    elements; otherwise a hashable term that is `in dsk` IS a key (this
+    includes non-task tuples: dask dataframe/array partitions use
+    ('name', i) tuple keys, which must never be traversed as
+    containers)."""
     out: Set[Hashable] = set()
     if _is_task(x):
         for a in x[1:]:
             out |= _keys_in(a, dsk)
-    elif isinstance(x, (list, tuple)):
+    elif isinstance(x, list):
         for a in x:
             out |= _keys_in(a, dsk)
-    elif isinstance(x, Hashable) and x in dsk:
+    elif _hashable(x) and x in dsk:
         out.add(x)
     return out
 
@@ -55,15 +68,14 @@ def _execute_node(task, dep_keys, *dep_values) -> Any:
     ObjectRefs nested inside containers are not auto-resolved — the same
     rule as the reference's task arguments."""
     resolved = dict(zip(dep_keys, dep_values))
+
     def build(x):
         if _is_task(x):
             fn, *args = x
             return fn(*[build(a) for a in args])
         if isinstance(x, list):
             return [build(a) for a in x]
-        if isinstance(x, tuple):
-            return tuple(build(a) for a in x)
-        if isinstance(x, Hashable) and x in resolved:
+        if _hashable(x) and x in resolved:
             return resolved[x]
         return x
 
@@ -78,9 +90,11 @@ def ray_dask_get(dsk: Dict, keys, **kwargs) -> Any:
     import ray_tpu
 
     dsk = dict(dsk)
-    # dependency map + topological order (Kahn)
+    # dependency map + topological order (Kahn). Self-references stay in
+    # the dep set so {'a': (f, 'a')} reports as a cycle, not a dispatch
+    # of the raw key.
     deps: Dict[Hashable, Set[Hashable]] = {
-        k: _keys_in(v, dsk) - {k} for k, v in dsk.items()}
+        k: _keys_in(v, dsk) for k, v in dsk.items()}
     pending = {k: set(d) for k, d in deps.items()}
     ready = [k for k, d in pending.items() if not d]
     order: List[Hashable] = []

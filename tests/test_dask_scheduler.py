@@ -67,6 +67,26 @@ def test_cycle_detection(cluster):
     dsk = {"a": (add, "b", 1), "b": (add, "a", 1)}
     with pytest.raises(ValueError, match="cycle"):
         ray_dask_get(dsk, "a")
+    # self-reference is a cycle too, not a dispatch of the raw key
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get({"a": (add, "a", 1)}, "a")
+
+
+def test_tuple_keys_like_dask_collections(cluster):
+    """dask dataframe/array graphs key every partition with ('name', i)
+    tuples; tuple keys must resolve as KEYS (dask/core.py semantics),
+    never be traversed as containers."""
+    dsk = {
+        ("x", 0): (add, 1, 2),          # 3
+        ("x", 1): (add, 10, 20),        # 30
+        ("sum", 0): (add, ("x", 0), ("x", 1)),   # 33
+        "final": (mul, ("sum", 0), 2),  # 66
+    }
+    assert ray_dask_get(dsk, "final") == 66
+    assert ray_dask_get(dsk, [("x", 0), ("x", 1)]) == [3, 30]
+    # a plain tuple that is NOT a key stays a literal inside lists
+    dsk2 = {"t": (lambda pair: pair[0] + pair[1], [(4, 5)][0])}
+    assert ray_dask_get(dsk2, "t") == 9
 
 
 def test_numpy_blocks_flow_through_store(cluster):
