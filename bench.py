@@ -555,7 +555,8 @@ def serve_bench_result(backend: str) -> dict:
     # scales serving cost, vs the latency-oriented sequential runs above).
     throughput_tok_s = None
     try:
-        eng_t = engine_m if multi_tok_s else engine
+        eng_t = (engine_m if multi_tok_s and multi_tok_s > decode_tok_s
+                 else engine)
         prompts = [rng.randint(1, config.vocab_size, prompt_len).tolist()
                    for _ in range(n_requests)]
         t0 = time.perf_counter()

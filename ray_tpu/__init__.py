@@ -9,6 +9,7 @@ See SURVEY.md at the repo root for the structural map to the reference.
 
 from ray_tpu.core.api import (  # noqa: F401
     available_resources,
+    cancel,
     cluster_resources,
     free,
     get,
@@ -30,8 +31,14 @@ from ray_tpu.core.exceptions import (  # noqa: F401
     GetTimeoutError,
     ObjectLostError,
     RayTpuError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
+)
+from ray_tpu.core.placement_group import (  # noqa: F401
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
 )
 from ray_tpu.core.generator import ObjectRefGenerator  # noqa: F401
 from ray_tpu.core.object_ref import ObjectRef  # noqa: F401

@@ -277,6 +277,15 @@ def free(refs):
     worker_mod.global_worker().free(refs)
 
 
+def cancel(ref, *, force: bool = False, recursive: bool = False) -> bool:
+    """Cancel the task producing `ref` (ray.cancel analog). Queued tasks
+    fail immediately; running tasks get a best-effort interrupt
+    (force=True kills the worker process). get() on the ref raises
+    TaskCancelledError. Returns False if the task already finished."""
+    return worker_mod.global_worker().cancel(ref, force=force,
+                                             recursive=recursive)
+
+
 class RuntimeContext:
     @property
     def gcs_address(self) -> Optional[str]:

@@ -44,6 +44,12 @@ class ActorUnavailableError(ActorError):
     pass
 
 
+class TaskCancelledError(RayTpuError):
+    """The task producing this object was cancelled via ray_tpu.cancel()
+    (reference analog: ray.exceptions.TaskCancelledError). Raised by
+    get() on the task's return refs."""
+
+
 class WorkerCrashedError(RayTpuError):
     """The worker executing a task died (e.g. OOM-killed, segfault)."""
 

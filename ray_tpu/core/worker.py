@@ -95,6 +95,11 @@ class CoreWorker:
         self._put_refs: set = set()                   # plasma ids this process created
         self._lineage: Dict[bytes, dict] = {}         # return oid -> lineage record
         self._generators: Dict[bytes, _GeneratorState] = {}  # task_id -> state
+        # Cancellation (ray.cancel analog, task_manager.h MarkTaskCanceled):
+        # cancelled ids suppress every retry/reconstruction path; in-flight
+        # maps a dispatched task to the lease whose worker is running it.
+        self._cancelled_tasks: set = set()
+        self._inflight_tasks: Dict[bytes, "_LeasedWorker"] = {}
         # ---- ownership / distributed refcount (reference_count.h analog) --
         # Owner-side: oid -> {"locations": set[node_id], "borrowers": set[id],
         #   "containers": set[container_oid], "children": [(oid, addr)],
@@ -952,6 +957,74 @@ class CoreWorker:
         self.io.spawn(self._submit_async(spec))
         return refs
 
+    def cancel(self, ref: ObjectRef, force: bool = False,
+               recursive: bool = False) -> bool:
+        """Cancel the task producing `ref` (ray.cancel analog).
+
+        Queued tasks are dequeued and fail immediately with
+        TaskCancelledError. Running tasks get a best-effort interrupt
+        injected into the executing thread (async tasks are cancelled on
+        the loop); `force=True` additionally kills the worker process.
+        Returns True if a cancellation was delivered, False if the task
+        already finished (or is unknown — e.g. an actor method, which the
+        reference also refuses to cancel this way). `recursive` is
+        accepted for signature parity; child-task cancellation is not
+        propagated.
+        """
+        from ray_tpu.core.exceptions import TaskCancelledError
+
+        oid = ref.binary() if hasattr(ref, "binary") else ref.id.binary()
+        with self._mem_lock:
+            rec = self._lineage.get(oid)
+            fut = self.result_futures.get(oid)
+            # Completed tasks pop their result future; the VALUE is the
+            # evidence of completion. Returning False here must leave no
+            # trace, or a no-op cancel would poison later reconstruction.
+            finished = (fut.done() if fut is not None
+                        else (oid in self.memory_store
+                              or oid in self._object_locations))
+        if rec is None or finished:
+            return False
+        spec = rec["spec"]
+        task_id = spec.task_id
+        self._cancelled_tasks.add(task_id)
+
+        async def _do_cancel() -> bool:
+            # 1. still queued? dequeue + fail (never reaches a worker).
+            for state in self._keys.values():
+                for queued in list(state.queue):
+                    if queued.task_id == task_id:
+                        state.queue.remove(queued)
+                        self._complete_error(queued, TaskCancelledError(
+                            f"task {queued.name} was cancelled"))
+                        return True
+            # 2. dispatched: interrupt the executing worker.
+            lease = self._inflight_tasks.get(task_id)
+            if lease is not None and lease.client is not None:
+                try:
+                    reply = await lease.client.call(
+                        "cancel_task", task_id=task_id, force=force)
+                    return bool(reply.get("ok"))
+                except (ConnectionLost, OSError):
+                    return True  # worker died with the cancel: cancelled
+            # 3. neither queued nor on a worker but still pending: it is
+            # awaiting dependency resolution — the post-resolve
+            # _cancelled_tasks check in _run_on_lease will fail it before
+            # it ever reaches a worker.
+            with self._mem_lock:
+                pending = (fut is not None and not fut.done())
+            return pending
+        try:
+            delivered = bool(self.io.run(_do_cancel(), timeout=30))
+        except Exception:
+            logger.exception("cancel of %s failed", spec.name)
+            delivered = False
+        if not delivered:
+            # No cancellation happened: leave no trace (the flag would
+            # otherwise suppress legitimate retries/reconstruction).
+            self._cancelled_tasks.discard(task_id)
+        return delivered
+
     def merge_job_env(self, env: Optional[dict]) -> Optional[dict]:
         """Per-task/actor env overrides the job-level env; env_vars merge
         key-wise (reference runtime_env inheritance semantics)."""
@@ -1004,6 +1077,8 @@ class CoreWorker:
             rec = self._lineage.get(oid)
             if rec is None or rec["attempts"] <= 0:
                 return None
+            if rec["spec"].task_id in self._cancelled_tasks:
+                return None  # cancelled tasks never re-execute
             rec["attempts"] -= 1
             import copy
 
@@ -1253,16 +1328,41 @@ class CoreWorker:
 
     async def _run_on_lease(self, key, state: _KeyState, lease: _LeasedWorker,
                             spec: TaskSpec):
+        from ray_tpu.core.exceptions import TaskCancelledError
+
+        if spec.task_id in self._cancelled_tasks:
+            # Cancelled while queued but popped before the cancel scan saw
+            # it: fail it here instead of dispatching.
+            self._complete_error(
+                spec, TaskCancelledError(f"task {spec.name} was cancelled"))
+            await self._lease_idle(key, state, lease)
+            return
         dep_err = await self._resolve_dependencies(spec)
         if dep_err is not None:
             self._complete_error(spec, dep_err)
             await self._lease_idle(key, state, lease)
             return
+        if spec.task_id in self._cancelled_tasks:
+            # Cancelled while awaiting dependencies (visible in neither
+            # the queue nor _inflight_tasks during that window): fail it
+            # before it reaches a worker.
+            self._complete_error(
+                spec, TaskCancelledError(f"task {spec.name} was cancelled"))
+            await self._lease_idle(key, state, lease)
+            return
+        self._inflight_tasks[spec.task_id] = lease
         try:
             reply = await lease.client.call("push_task", spec=spec)
         except (ConnectionLost, OSError):
+            self._inflight_tasks.pop(spec.task_id, None)
             state.leases.remove(lease)
             await self._return_lease(state, lease, dead=True)
+            if spec.task_id in self._cancelled_tasks:
+                # force-cancel kills the worker mid-push: that death is
+                # the cancellation, never a retryable crash.
+                self._complete_error(spec, TaskCancelledError(
+                    f"task {spec.name} was cancelled (force)"))
+                return
             # Streaming tasks never retry transparently: items already
             # consumed by the caller cannot be un-yielded, so a re-execution
             # would duplicate them (the reference checkpoints the consumed
@@ -1279,10 +1379,12 @@ class CoreWorker:
         except Exception as e:
             # Non-connection failure (e.g. worker couldn't load the function):
             # surface it on the result futures and free the lease.
+            self._inflight_tasks.pop(spec.task_id, None)
             self._complete_error(spec, e if isinstance(e, RayTpuError)
                                  else RayTpuError(f"task push failed: {e!r}"))
             await self._lease_idle(key, state, lease)
             return
+        self._inflight_tasks.pop(spec.task_id, None)
         lost_oid = self._lost_arg_oid(spec, reply)
         if lost_oid is not None:
             # Recursive object recovery (object_recovery_manager.h:38):
@@ -1373,6 +1475,10 @@ class CoreWorker:
 
     def _complete_task(self, spec: TaskSpec, reply: dict):
         metric_defs.TASKS_FINISHED.inc(tags={"outcome": "ok"})
+        # A successfully-completed task is beyond cancellation: drop the
+        # flag so the set stays bounded and future reconstruction of this
+        # task's objects is never suppressed by a raced/no-op cancel.
+        self._cancelled_tasks.discard(spec.task_id)
         if spec.pinned_oids:
             self.unpin_args(spec.pinned_oids)
             spec.pinned_oids = None

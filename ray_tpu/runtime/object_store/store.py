@@ -159,6 +159,12 @@ class ObjectStore:
         fd = os.dup(self._fd)
         mm, size = self._mm, os.fstat(self._fd).st_size
 
+        # MADV_POPULATE_WRITE (Linux 5.14+): one syscall allocates tmpfs
+        # blocks AND populates writable PTEs — the whole first-touch cost
+        # (the dominant term of a cold 1 MiB put: ~0.4 GiB/s faulting vs
+        # ~3 GiB/s on recycled pages) moves off the put path in-kernel.
+        MADV_POPULATE_WRITE = 23
+
         def warm():
             try:
                 chunk = 128 << 20
@@ -166,9 +172,15 @@ class ObjectStore:
                     if self._closed:
                         return
                     end = min(start + chunk, size)
+                    populated = False
                     if create:
-                        os.posix_fallocate(fd, start, end - start)
-                    if mode == "full":
+                        try:
+                            mm.madvise(MADV_POPULATE_WRITE, start,
+                                       end - start)
+                            populated = True
+                        except (OSError, ValueError):
+                            os.posix_fallocate(fd, start, end - start)
+                    if mode == "full" and not populated:
                         # One read per page populates this process's PTEs.
                         mm[start:end:4096]
             except (OSError, ValueError, SystemError):

@@ -54,6 +54,8 @@ class WorkerRuntime:
         self.exec_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="task_exec")
         self.fn_cache: Dict[bytes, Any] = {}
+        self._running_threads: Dict[bytes, int] = {}  # task_id -> thread id
+        self._running_async: Dict[bytes, "asyncio.Task"] = {}
         self.actor_instance = None
         self.actor_spec: Optional[ActorSpec] = None
         self._raylet_client: Optional[RpcClient] = None
@@ -214,10 +216,16 @@ class WorkerRuntime:
 
     def _execute(self, fn, spec: TaskSpec, conn=None) -> dict:
         """Runs on the exec thread; returns the RPC reply."""
+        import threading
+
         from ray_tpu import runtime_env as renv_mod
         from ray_tpu.util import tracing
 
         applied = None
+        # Cancellation registry: cancel_task injects TaskCancelledError
+        # into this thread by id (ray.cancel analog; best-effort — a
+        # blocking C call won't notice until it returns to Python).
+        self._running_threads[spec.task_id] = threading.get_ident()
         try:
             applied = renv_mod.apply_runtime_env(
                 self.core, spec.runtime_env, self.core.session_dir)
@@ -244,11 +252,17 @@ class WorkerRuntime:
             returns = self._package_returns(spec, result)
             return {"status": "ok", "returns": returns, "node_id": self.node_id}
         except Exception as e:
+            from ray_tpu.core.exceptions import TaskCancelledError
+
+            if isinstance(e, TaskCancelledError):
+                logger.info("task %s cancelled", spec.name)
+                return {"status": "error", "error": e}
             tb = traceback.format_exc()
             logger.error("task %s failed:\n%s", spec.name, tb)
             return {"status": "error",
                     "error": TaskError(spec.name, tb, cause=_safe_cause(e))}
         finally:
+            self._running_threads.pop(spec.task_id, None)
             if applied is not None:
                 applied.undo()
             self.core.current_task_name = None
@@ -353,12 +367,60 @@ class WorkerRuntime:
         fn = self._load_function(spec.fn_id)
         loop = asyncio.get_event_loop()
         if self._is_async_callable(fn):
-            reply = await self._tracked(self._execute_async(fn, spec, conn))
+            exec_task = asyncio.ensure_future(
+                self._execute_async(fn, spec, conn))
+            self._running_async[spec.task_id] = exec_task
+            try:
+                reply = await self._tracked(exec_task)
+            except asyncio.CancelledError:
+                from ray_tpu.core.exceptions import TaskCancelledError
+
+                reply = {"status": "error", "error": TaskCancelledError(
+                    f"task {spec.name} was cancelled")}
+            finally:
+                self._running_async.pop(spec.task_id, None)
         else:
             reply = await self._tracked(loop.run_in_executor(
                 self.exec_pool, self._execute, fn, spec, conn))
         await self._drain_borrows()
         return reply
+
+    async def handle_cancel_task(self, conn, task_id: bytes,
+                                 force: bool = False):
+        """Best-effort in-flight cancellation (ray.cancel analog).
+
+        Sync tasks: TaskCancelledError is raised asynchronously in the
+        executing thread (PyThreadState_SetAsyncExc — takes effect at the
+        next Python bytecode; a blocking C call defers it). Async tasks:
+        the asyncio task is cancelled. force=True exits the worker
+        process after replying — the owner maps the resulting connection
+        loss to TaskCancelledError, never a retry."""
+        import ctypes
+
+        from ray_tpu.core.exceptions import TaskCancelledError
+
+        delivered = False
+        atask = self._running_async.get(task_id)
+        if atask is not None and not atask.done():
+            atask.cancel()
+            delivered = True
+        tid = self._running_threads.get(task_id)
+        if not delivered and tid is not None:
+            n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tid), ctypes.py_object(TaskCancelledError))
+            delivered = n == 1
+            if delivered and self._running_threads.get(task_id) != tid:
+                # TOCTOU: the target finished and the reused pool thread
+                # started a DIFFERENT task between lookup and injection —
+                # revoke before the pending exception fires in it.
+                # bare None ctypes-converts to NULL = "clear pending exc"
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(tid), None)
+                delivered = False
+        if force and (delivered or tid is not None or atask is not None):
+            loop = asyncio.get_event_loop()
+            loop.call_later(0.05, os._exit, 1)
+        return {"ok": delivered, "force": force}
 
     # ---- actor lifecycle --------------------------------------------------
 

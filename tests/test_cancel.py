@@ -1,0 +1,148 @@
+"""ray_tpu.cancel: queued, running, async, and force cancellation.
+
+Reference analog: ray.cancel (core_worker task cancellation +
+python/ray/tests/test_cancel.py). Semantics: queued tasks fail fast;
+running tasks get a best-effort interrupt; force kills the worker; a
+cancelled task never retries or reconstructs; get() raises
+TaskCancelledError.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import TaskCancelledError
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=1)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_cancel_queued_task(cluster):
+    """With one CPU, the second task is queued; cancelling it must not
+    wait for the first to finish."""
+    @ray_tpu.remote
+    def busy(t):
+        time.sleep(t)
+        return "done"
+
+    blocker = busy.remote(3.0)
+    queued = busy.remote(0.0)
+    time.sleep(0.3)  # let the blocker occupy the only worker slot
+    t0 = time.time()
+    assert ray_tpu.cancel(queued) is True
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=10)
+    assert time.time() - t0 < 2.0, "queued cancel must not wait"
+    assert ray_tpu.get(blocker, timeout=30) == "done"  # untouched
+
+
+def test_cancel_running_task_interrupts(cluster):
+    @ray_tpu.remote
+    def spin():
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            time.sleep(0.01)  # returns to Python bytecode: interruptible
+        return "never"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # ensure it is RUNNING
+    assert ray_tpu.cancel(ref) is True
+    t0 = time.time()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=20)
+    assert time.time() - t0 < 15, "interrupt should beat the 30s sleep"
+
+
+def test_cancel_finished_task_returns_false(cluster):
+    @ray_tpu.remote
+    def quick():
+        return 42
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=30) == 42
+    assert ray_tpu.cancel(ref) is False
+    assert ray_tpu.get(ref, timeout=5) == 42  # result untouched
+
+
+def test_cancel_force_kills_worker_without_retry(cluster):
+    """force=True kills the worker; the owner maps the death to
+    TaskCancelledError — never WorkerCrashedError, never a retry (the
+    task has max_retries but must not re-run)."""
+    import os
+
+    @ray_tpu.remote(max_retries=3)
+    def hog(marker):
+        # A cancelled-then-retried execution would re-create the marker.
+        with open(marker, "a") as f:
+            f.write(f"{os.getpid()}\n")
+        time.sleep(30)
+        return "never"
+
+    import tempfile
+    marker = tempfile.mktemp()
+    ref = hog.remote(marker)
+    deadline = time.time() + 15
+    while time.time() < deadline and not os.path.exists(marker):
+        time.sleep(0.1)
+    assert os.path.exists(marker), "task never started"
+    assert ray_tpu.cancel(ref, force=True) is True
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    time.sleep(2.0)  # would-be retry window
+    with open(marker) as f:
+        runs = [ln for ln in f.read().splitlines() if ln]
+    assert len(runs) == 1, f"cancelled task re-ran: {runs}"
+    os.unlink(marker)
+
+
+def test_cancel_task_waiting_on_dependency(cluster):
+    """A task blocked on an unfinished dependency is in neither the
+    queue nor a worker; cancel must still take effect (post-resolve
+    check) and the task body must NEVER run."""
+    import os
+    import tempfile
+
+    marker = tempfile.mktemp()
+
+    @ray_tpu.remote
+    def slow_dep():
+        time.sleep(4.0)
+        return 1
+
+    @ray_tpu.remote
+    def child(x, path):
+        with open(path, "w") as f:
+            f.write("ran")
+        return x
+
+    dep = slow_dep.remote()
+    t = child.remote(dep, marker)
+    time.sleep(0.5)  # child now awaits its dependency
+    assert ray_tpu.cancel(t) is True
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(t, timeout=30)
+    assert ray_tpu.get(dep, timeout=30) == 1  # dep unaffected
+    time.sleep(1.0)
+    assert not os.path.exists(marker), "cancelled task body executed"
+
+
+def test_cancel_async_task(cluster):
+    @ray_tpu.remote
+    async def async_spin():
+        import asyncio
+
+        await asyncio.sleep(30)
+        return "never"
+
+    ref = async_spin.remote()
+    time.sleep(1.0)
+    assert ray_tpu.cancel(ref) is True
+    t0 = time.time()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=20)
+    assert time.time() - t0 < 15

@@ -106,3 +106,31 @@ def test_memory_usage_fraction_reads_proc():
 
     frac = node_memory_usage_fraction()
     assert frac is not None and 0.0 < frac < 1.0
+
+
+def test_noop_cancel_does_not_poison_reconstruction():
+    """cancel() on a finished task is a no-op and must leave NO trace:
+    lineage reconstruction of that task's lost object must still work
+    (a suppressed re-execution here would surface as ObjectLostError)."""
+    c = Cluster()
+    c.add_node(num_cpus=1, resources={"head": 1})
+    doomed = c.add_node(num_cpus=1, resources={"other": 1})
+    ray_tpu.init(address=c.address)
+    try:
+        c.wait_for_nodes(2)
+
+        @ray_tpu.remote(num_cpus=0, resources={"other": 1})
+        def produce():
+            return np.arange(300_000, dtype=np.float64)  # plasma-sized
+
+        ref = produce.remote()
+        ray_tpu.wait([ref], num_returns=1, timeout=120)
+        assert ray_tpu.cancel(ref) is False  # finished: documented no-op
+        c.remove_node(doomed, force=True)
+        c.add_node(num_cpus=1, resources={"other": 1})
+        c.wait_for_nodes(2)
+        out = ray_tpu.get(ref, timeout=180)
+        assert out.shape == (300_000,) and out[7] == 7.0
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
