@@ -182,7 +182,7 @@ class ObjectStore:
         # The thread gets its OWN dup'd fd: close() recycling the main fd
         # number mid-walk must never let fallocate hit an unrelated file.
         fd = os.dup(self._fd)
-        mm, size = self._mm, os.fstat(self._fd).st_size
+        mm, size = self._mm, self._size
 
         # MADV_POPULATE_WRITE (Linux 5.14+): one syscall allocates tmpfs
         # blocks AND populates writable PTEs — the whole first-touch cost
@@ -191,7 +191,9 @@ class ObjectStore:
 
         def warm():
             walked = True
-            madvise_ok = True  # latch: one EINVAL means the kernel lacks it
+            madvise_ok = True  # one failure: stop retrying madvise this walk
+            # (_warm stays False then, so per-create populate — which has
+            # its own errno-specific latch — keeps covering puts)
             try:
                 chunk = 128 << 20
                 for start in range(0, size, chunk):
@@ -303,8 +305,16 @@ class ObjectStore:
         end = min((off + length + page - 1) & ~(page - 1), self._size)
         try:
             self._mm.madvise(self._MADV_POPULATE_WRITE, start, end - start)
-        except (OSError, ValueError):
+        except ValueError:
             self._populate_ok = False
+        except OSError as e:
+            # Latch off only for "kernel lacks it" errnos; a transient
+            # ENOMEM/EINTR must not disable the fast path for the
+            # process lifetime (the copy just faults normally this once).
+            import errno
+
+            if e.errno in (errno.EINVAL, errno.ENOSYS):
+                self._populate_ok = False
 
     def ensure_prefault(self) -> None:
         """Start this process's background arena walk if it hasn't run yet

@@ -54,15 +54,17 @@ def run(scale: float = 1.0, num_cpus: int = 4) -> List[Dict]:
         # state is the tracked number).
         from ray_tpu.core.worker import global_worker
 
-        ray_tpu.put(payload)
+        warm_refs = [ray_tpu.put(payload)]
         store = global_worker().store
         deadline = time.monotonic() + 15.0
         while (store is not None and not store.prefaulted
                and store.prefault_inflight  # never-warm hosts: don't stall
                and time.monotonic() < deadline):
             time.sleep(0.1)
-        for _ in range(min(32, m)):
-            ray_tpu.put(payload)
+        warm_refs += [ray_tpu.put(payload) for _ in range(min(32, m))]
+        # Free the warmup objects deterministically so trial occupancy
+        # (3 x m MiB) doesn't depend on GC timing on small stores.
+        del warm_refs
         # Best of 3 trials: on small/shared boxes a single descheduling
         # blip inside one trial halves the apparent bandwidth, so the
         # bandwidth legs report peak steady state (standard for bandwidth
