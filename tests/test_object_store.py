@@ -282,3 +282,108 @@ def test_wait_event_timeout(store):
     woke = store.wait_event(gen, 50)
     assert time.perf_counter() - t0 >= 0.045
     assert not woke
+
+
+# ---- prefault / PTE-populate fast path (put-bandwidth fix) ---------------
+
+
+def _kernel_has_populate_write() -> bool:
+    import mmap
+
+    mm = mmap.mmap(-1, mmap.PAGESIZE)
+    try:
+        mm.madvise(23, 0, mmap.PAGESIZE)  # MADV_POPULATE_WRITE
+        return True
+    except (OSError, ValueError):
+        return False
+    finally:
+        mm.close()
+
+
+def test_creator_prefault_walk_warms(store):
+    """The creator's boot-time walk must finish and flip `prefaulted` so
+    per-create populate degrades to a no-op skip."""
+    import time
+
+    if not _kernel_has_populate_write():
+        pytest.skip("kernel lacks MADV_POPULATE_WRITE (pre-5.14)")
+    deadline = time.time() + 10
+    while store.prefault_inflight and time.time() < deadline:
+        time.sleep(0.05)
+    assert store.prefaulted
+
+
+def test_noncreator_walk_is_lazy(tmp_path):
+    path = str(tmp_path / "lazy.shm")
+    creator = ObjectStore(path, capacity=32 * MB, create=True)
+    try:
+        opener = ObjectStore(path, create=False)
+        try:
+            # No walk at open: small creates never trigger one.
+            small = rand_id()
+            opener.put(small, b"x" * 1024)
+            assert not opener._prefault_started
+            # First large create starts it exactly once.
+            big = rand_id()
+            buf = opener.create(big, 1 << 20)
+            buf[:] = b"y" * (1 << 20)
+            buf.release()
+            opener.seal(big)
+            assert opener._prefault_started
+            got = creator.get(big)
+            assert bytes(got.data[:2]) == b"yy"
+            got.release()
+        finally:
+            opener.close()
+    finally:
+        creator.close()
+
+
+def test_ensure_prefault_idempotent_under_contention(tmp_path):
+    import threading
+
+    path = str(tmp_path / "contend.shm")
+    creator = ObjectStore(path, capacity=16 * MB, create=True)
+    try:
+        opener = ObjectStore(path, create=False)
+        try:
+            # Count _start_prefault invocations directly: deterministic
+            # regardless of how fast individual walks finish, and immune
+            # to walker threads leaked by other tests in this process.
+            calls = []
+            orig = opener._start_prefault
+
+            def counting(create):
+                calls.append(create)
+                orig(create)
+
+            opener._start_prefault = counting
+            threads = [threading.Thread(target=opener.ensure_prefault)
+                       for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(calls) == 1, f"walk started {len(calls)} times"
+        finally:
+            opener.close()
+    finally:
+        creator.close()
+
+
+def test_prefault_disabled_env(tmp_path, monkeypatch):
+    """RAY_TPU_STORE_PREFAULT=0: no walk, no inflight signal (callers that
+    wait on prefault_inflight must not stall), puts still work."""
+    monkeypatch.setenv("RAY_TPU_STORE_PREFAULT", "0")
+    path = str(tmp_path / "noprefault.shm")
+    s = ObjectStore(path, capacity=16 * MB, create=True)
+    try:
+        assert not s.prefault_inflight and not s.prefaulted
+        oid = rand_id()
+        s.put(oid, b"z" * (1 << 20))  # large put: populate still applies
+        assert not s.prefault_inflight
+        buf = s.get(oid)
+        assert len(buf.data) == 1 << 20
+        buf.release()
+    finally:
+        s.close()
