@@ -36,6 +36,16 @@ NEG_INF = -1e30
 # (jax/experimental/pallas/ops/tpu/flash_attention.py MIN_BLOCK_SIZE).
 LANES = 128
 
+# Longest padded sequence for which the backward / forward use the
+# whole-sequence-resident kernels (above it, the O(block)-VMEM tiled
+# kernels take over — see _flash_bwd_rule / _flash_call). The resident
+# kernels skip causal-dead KV blocks entirely (no tile DMA) and are ~18%
+# faster where they fit; residency grows linearly with seq and busts the
+# ~16 MB scoped VMEM near 8k (bwd) / 16k (fwd). Module-level so tests can
+# force the tiled paths at interpret-friendly sizes.
+_BWD_RESIDENT_MAX_ROWS = 4096
+_FWD_RESIDENT_MAX_ROWS = 8192
+
 
 def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
     """(batch, seq, kv_heads, hd) -> (batch, seq, kv_heads*n_rep, hd)."""
@@ -137,10 +147,194 @@ def _flash_fwd_kernel_nolse(q_ref, k_ref, v_ref, o_ref, **kw):
     _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, None, **kw)
 
 
+def _flash_fwd_kernel_tiled(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
+                            l_ref, acc_ref, *, block_k: int,
+                            num_k_blocks: int, true_kv: int, seq_kv: int,
+                            causal: bool, scale: float, block_q: int):
+    """Long-context forward. Grid: (batch*heads, num_q_blocks,
+    num_k_blocks) — the KV walk is a grid dimension so one (block_k, d)
+    tile is VMEM-resident at a time (the whole-sequence-resident kernel
+    above busts the ~16 MB scoped VMEM near seq 16k). Online-softmax
+    state (m, l, acc) lives in f32 scratch persisting across the inner
+    grid steps; outputs are written on the last one."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, dtype=m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+
+    live = ((k_start <= q_start + block_q - 1) if causal
+            else (kb >= 0))  # traced either way for pl.when
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = q @ k_blk.T  # (block_q, block_k)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if true_kv != seq_kv:  # padded tail block: mask padded keys
+            s = jnp.where(k_pos < true_kv, s, NEG_INF)
+        m = m_ref[:, 0:1]
+        l = l_ref[:, 0:1]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = alpha * acc_ref[...] + p @ v_blk
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kb == num_k_blocks - 1)
+    def _write():
+        m = m_ref[:, 0:1]
+        l = l_ref[:, 0:1]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0] = jnp.broadcast_to(
+                m + jnp.log(jnp.maximum(l, 1e-30)), (block_q, LANES))
+
+
+def _flash_fwd_kernel_tiled_nolse(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                                  acc_ref, **kw):
+    _flash_fwd_kernel_tiled(q_ref, k_ref, v_ref, o_ref, None, m_ref, l_ref,
+                            acc_ref, **kw)
+
+
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, acc_ref, *, block_k: int, num_k_blocks: int,
+                         true_kv: int, seq_kv: int, causal: bool,
+                         scale: float, block_q: int):
+    """dQ pass. Grid: (batch*heads, num_q_blocks, num_k_blocks) — the KV
+    walk is a GRID dimension, not an in-kernel loop, so only one
+    (block_k, d) K/V tile is VMEM-resident at a time (Mosaic pipelines the
+    tile DMAs) and VMEM stays O(block) at any sequence length; the old
+    whole-sequence-resident layout blew the ~16 MB scoped VMEM budget at
+    seq 8192. dQ accumulates in an f32 scratch that persists across the
+    innermost grid steps; the out block is written once, on the last step.
+    Recomputes p blockwise from (q, k, lse) — no stored logits. delta_ref
+    carries rowsum(dO*O) - g_lse (the lse cotangent folds in; see
+    _flash_bwd_rule)."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = kb * block_k
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+
+    # Causal: KV blocks entirely above the diagonal contribute nothing —
+    # compute (not the tile DMA) is skipped for them.
+    live = ((k_start <= q_start + block_q - 1) if causal
+            else (kb >= 0))  # traced either way for pl.when
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0:1]    # (block_q, 1) from the lane plane
+        delta = delta_ref[0][:, 0:1]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = (q @ k_blk.T) * scale
+        p = jnp.exp(s - lse)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        if true_kv != seq_kv:
+            p = jnp.where(k_pos < true_kv, p, 0.0)
+        dp = do @ v_blk.T
+        ds = p * (dp - delta)
+        acc_ref[...] += ds @ k_blk
+
+    @pl.when(kb == num_k_blocks - 1)
+    def _write():
+        dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                          block_q: int, num_q_blocks: int, true_kv: int,
+                          mask_kv_tail: bool, causal: bool, scale: float,
+                          block_k: int):
+    """dK/dV pass. Grid: (batch*heads, num_k_blocks, num_q_blocks) — the q
+    walk is a grid dimension (same VMEM-bounding rationale as the dQ pass);
+    dK/dV accumulate in f32 scratch across the inner q steps and are
+    written on the last one. Causal skip mirrors the forward: q blocks
+    strictly above the diagonal are dead. Padded q rows (beyond true seq)
+    contribute nothing even unmasked: their dO and delta are zero-padded,
+    so ds == 0 and p^T @ dO adds 0."""
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    k_start = kb * block_k
+    q_start = qi * block_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros(dk_acc_ref.shape, dk_acc_ref.dtype)
+        dv_acc_ref[...] = jnp.zeros(dv_acc_ref.shape, dv_acc_ref.dtype)
+
+    live = ((q_start + block_q - 1 >= k_start) if causal
+            else (qi >= 0))  # traced either way for pl.when
+
+    @pl.when(live)
+    def _accumulate():
+        k_blk = k_ref[0].astype(jnp.float32)   # (block_k, d)
+        v_blk = v_ref[0].astype(jnp.float32)
+        q_blk = q_ref[0].astype(jnp.float32)   # (block_q, d)
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[0][:, 0:1]
+        delta_blk = delta_ref[0][:, 0:1]
+        s = (q_blk @ k_blk.T) * scale   # (block_q, block_k)
+        p = jnp.exp(s - lse_blk)
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        if mask_kv_tail:  # padded tail keys must not receive dK/dV
+            p = jnp.where(k_pos < true_kv, p, 0.0)
+        dv_acc_ref[...] += p.T @ do_blk
+        dp = do_blk @ v_blk.T
+        ds = p * (dp - delta_blk)
+        dk_acc_ref[...] += ds.T @ q_blk
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _write():
+        dk_ref[0] = (dk_acc_ref[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, block_k: int, seq_kv: int, true_kv: int,
                          causal: bool, scale: float, block_q: int):
-    """dQ pass. Grid: (batch*heads, num_q_blocks); recomputes p blockwise
+    """Whole-sequence-resident dQ pass (grid (batch*heads, num_q_blocks)):
+    K/V live in VMEM for the whole program, and the in-kernel fori SKIPS
+    causal-dead KV blocks entirely (no tile DMA, no compute) — ~18%
+    faster than the tiled variant at seq 2048, but residency grows with
+    seq and busts the ~16 MB VMEM budget near 8k (the tiled kernels
+    take over there; see _flash_bwd_rule). Recomputes p blockwise
     from (q, k, lse) — no stored logits. delta_ref carries
     rowsum(dO*O) - g_lse (the lse cotangent folds in here; see _flash_bwd).
     """
@@ -180,11 +374,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _flash_bwd_dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, block_q: int, seq_q: int,
                           true_kv: int, mask_kv_tail: bool, causal: bool,
                           scale: float, block_k: int):
-    """dK/dV pass. Grid: (batch*heads, num_k_blocks); loops over q blocks at
+    """Whole-sequence-resident dK/dV pass (see the dQ twin above for the
+    residency-vs-seq tradeoff). Loops over q blocks at
     or below the diagonal (causal skip mirrored from the forward). Padded q
     rows (seq_q is the PADDED length) contribute nothing without masking:
     their dO and delta are zero-padded, so ds == 0 and p^T @ dO adds 0."""
@@ -285,30 +480,70 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
     if skv_p != skv:
         kt = jnp.pad(kt, ((0, 0), (0, skv_p - skv), (0, 0)))
         vt = jnp.pad(vt, ((0, 0), (0, skv_p - skv), (0, 0)))
-    grid = (b * h, sq_p // block_q)
-    kw = dict(block_k=block_k, seq_kv=skv_p, true_kv=skv, causal=causal,
-              scale=scale, block_q=block_q)
-    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0))]
-    out_shape = [_sds((b * h, sq_p, d), q.dtype, vma)]
-    if emit_lse:
-        kernel = functools.partial(_flash_fwd_kernel, **kw)
-        out_specs.append(
-            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)))
-        out_shape.append(_sds((b * h, sq_p, LANES), jnp.float32, vma))
+    if skv_p <= _FWD_RESIDENT_MAX_ROWS:
+        grid = (b * h, sq_p // block_q)
+        kw = dict(block_k=block_k, seq_kv=skv_p, true_kv=skv, causal=causal,
+                  scale=scale, block_q=block_q)
+        out_specs = [pl.BlockSpec((1, block_q, d),
+                                  lambda bh, qi: (bh, qi, 0))]
+        out_shape = [_sds((b * h, sq_p, d), q.dtype, vma)]
+        if emit_lse:
+            kernel = functools.partial(_flash_fwd_kernel, **kw)
+            out_specs.append(
+                pl.BlockSpec((1, block_q, LANES),
+                             lambda bh, qi: (bh, qi, 0)))
+            out_shape.append(_sds((b * h, sq_p, LANES), jnp.float32, vma))
+        else:
+            kernel = functools.partial(_flash_fwd_kernel_nolse, **kw)
+        res = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+                pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
+                pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(qt, kt, vt)
     else:
-        kernel = functools.partial(_flash_fwd_kernel_nolse, **kw)
-    res = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
-        ],
-        out_specs=out_specs,
-        out_shape=out_shape,
-        interpret=interpret,
-    )(qt, kt, vt)
+        # Long-context: KV walk as a grid dimension, O(block) VMEM (see
+        # _flash_fwd_kernel_tiled).
+        from jax.experimental.pallas import tpu as pltpu
+
+        num_qb, num_kb = sq_p // block_q, skv_p // block_k
+        kw = dict(block_k=block_k, num_k_blocks=num_kb, true_kv=skv,
+                  seq_kv=skv_p, causal=causal, scale=scale, block_q=block_q)
+        out_specs = [pl.BlockSpec((1, block_q, d),
+                                  lambda bh, qi, kb: (bh, qi, 0))]
+        out_shape = [_sds((b * h, sq_p, d), q.dtype, vma)]
+        if emit_lse:
+            kernel = functools.partial(_flash_fwd_kernel_tiled, **kw)
+            out_specs.append(
+                pl.BlockSpec((1, block_q, LANES),
+                             lambda bh, qi, kb: (bh, qi, 0)))
+            out_shape.append(_sds((b * h, sq_p, LANES), jnp.float32, vma))
+        else:
+            kernel = functools.partial(_flash_fwd_kernel_tiled_nolse, **kw)
+        res = pl.pallas_call(
+            kernel,
+            grid=(b * h, num_qb, num_kb),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda bh, qi, kb: (bh, qi, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda bh, qi, kb: (bh, kb, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda bh, qi, kb: (bh, kb, 0)),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[pltpu.VMEM((block_q, LANES), jnp.float32),
+                            pltpu.VMEM((block_q, LANES), jnp.float32),
+                            pltpu.VMEM((block_q, d), jnp.float32)],
+            interpret=interpret,
+        )(qt, kt, vt)
     out = _unfold(res[0][:, :sq], b, h)
     if not emit_lse:
         return out, None
@@ -324,6 +559,60 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
     out, lse = _flash_call(q, k, v, causal, scale, block_q, block_k,
                            interpret)
     return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_bwd_resident_calls(qt, kt, vt, dot, lse_t, delta, *, b, h, d, sq,
+                              skv, sq_p, skv_p, block_q, block_k, causal,
+                              scale, vma, interpret, q_dtype, k_dtype,
+                              v_dtype):
+    """Backward via the whole-sequence-resident kernels (small-seq fast
+    path; see the implementation-choice comment in _flash_bwd_rule)."""
+    from jax.experimental import pallas as pl
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel_resident, block_k=block_k,
+                          seq_kv=skv_p, true_kv=skv, causal=causal,
+                          scale=scale, block_q=block_q),
+        grid=(b * h, sq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=_sds((b * h, sq_p, d), q_dtype, vma),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse_t, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel_resident, block_q=block_q,
+                          seq_q=sq_p, true_kv=skv,
+                          mask_kv_tail=skv_p != skv,
+                          causal=causal, scale=scale, block_k=block_k),
+        grid=(b * h, skv_p // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq_p, d), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, sq_p, d), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, sq_p, LANES), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, sq_p, LANES), lambda bh, kb: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+        ],
+        out_shape=[
+            _sds((b * h, skv_p, d), k_dtype, vma),
+            _sds((b * h, skv_p, d), v_dtype, vma),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse_t, delta)
+    return (_unfold(dq[:, :sq], b, h), _unfold(dk[:, :skv], b, h),
+            _unfold(dv[:, :skv], b, h))
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
@@ -366,45 +655,74 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
     lse_t = jnp.broadcast_to(lse_t[..., None], (b * h, sq_p, LANES))
     delta = jnp.broadcast_to(delta[..., None], (b * h, sq_p, LANES))
 
+    from jax.experimental.pallas import tpu as pltpu
+
+    # Two implementations of each pass (same math, same numerics):
+    #   * resident — whole-sequence K/V (dQ) / q-side tensors (dK/dV) in
+    #     VMEM, causal-dead blocks skipped entirely. Fastest, but VMEM
+    #     residency grows linearly with seq (dK/dV pass: ~1.8 KB/row ->
+    #     ~15 MB at 8k, past the ~16 MB scoped budget).
+    #   * tiled — the walked axis is a grid dimension, one (block, d)
+    #     tile resident at a time, f32 scratch accumulation: O(block)
+    #     VMEM at ANY seq, ~18% slower at 2048 (dead blocks still DMA).
+    # Pick resident while the bigger pass fits comfortably.
+    resident = max(sq_p, skv_p) <= _BWD_RESIDENT_MAX_ROWS
+    if resident:
+        return _flash_bwd_resident_calls(
+            qt, kt, vt, dot, lse_t, delta, b=b, h=h, d=d, sq=sq, skv=skv,
+            sq_p=sq_p, skv_p=skv_p, block_q=block_q, block_k=block_k,
+            causal=causal, scale=scale, vma=vma, interpret=interpret,
+            q_dtype=q.dtype, k_dtype=k.dtype, v_dtype=v.dtype)
+
+    num_qb, num_kb = sq_p // block_q, skv_p // block_k
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
-                          seq_kv=skv_p, true_kv=skv, causal=causal,
-                          scale=scale, block_q=block_q),
-        grid=(b * h, sq_p // block_q),
+                          num_k_blocks=num_kb, true_kv=skv, seq_kv=skv_p,
+                          causal=causal, scale=scale, block_q=block_q),
+        grid=(b * h, num_qb, num_kb),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, qi, kb: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, qi, kb: (bh, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, kb: (bh, qi, 0)),
         out_shape=_sds((b * h, sq_p, d), q.dtype, vma),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, dot, lse_t, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, seq_q=sq_p,
-                          true_kv=skv, mask_kv_tail=skv_p != skv,
-                          causal=causal, scale=scale, block_k=block_k),
-        grid=(b * h, skv_p // block_k),
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          num_q_blocks=num_qb, true_kv=skv,
+                          mask_kv_tail=skv_p != skv, causal=causal,
+                          scale=scale, block_k=block_k),
+        grid=(b * h, num_kb, num_qb),
         in_specs=[
-            pl.BlockSpec((1, sq_p, d), lambda bh, kb: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
-            pl.BlockSpec((1, sq_p, d), lambda bh, kb: (bh, 0, 0)),
-            pl.BlockSpec((1, sq_p, LANES), lambda bh, kb: (bh, 0, 0)),
-            pl.BlockSpec((1, sq_p, LANES), lambda bh, kb: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, kb, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, kb, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, kb, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, kb, qi: (bh, qi, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0)),
         ],
         out_shape=[
             _sds((b * h, skv_p, d), k.dtype, vma),
             _sds((b * h, skv_p, d), v.dtype, vma),
         ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, dot, lse_t, delta)
 
