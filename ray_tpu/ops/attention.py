@@ -14,8 +14,11 @@ Design (TPU-first):
   * Serving/prefill uses the same forward kernel (no backward needed):
     online softmax over KV blocks, O(seq) memory, causal-block skipping —
     the TTFT hot path the reference outsources to vLLM's CUDA kernels.
-  * GQA (n_kv_heads < n_heads) supported everywhere by logical repeat;
-    grads through the repeat sum over the group automatically.
+  * GQA (n_kv_heads < n_heads): the flash kernels read K/V UNREPEATED —
+    BlockSpec index maps (_kv_row) steer each q-head program at its kv
+    head, and dK/dV group sums are explicit (grouped inner grid in the
+    tiled pass; a post-kernel reshape-sum in the resident pass).
+    mha_reference still uses logical repeat_kv with autodiff summing.
 """
 
 from __future__ import annotations
@@ -272,30 +275,35 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
-                          block_q: int, num_q_blocks: int, true_kv: int,
-                          mask_kv_tail: bool, causal: bool, scale: float,
-                          block_k: int):
-    """dK/dV pass. Grid: (batch*heads, num_k_blocks, num_q_blocks) — the q
-    walk is a grid dimension (same VMEM-bounding rationale as the dQ pass);
-    dK/dV accumulate in f32 scratch across the inner q steps and are
-    written on the last one. Causal skip mirrors the forward: q blocks
-    strictly above the diagonal are dead. Padded q rows (beyond true seq)
-    contribute nothing even unmasked: their dO and delta are zero-padded,
-    so ds == 0 and p^T @ dO adds 0."""
+                          block_q: int, num_q_blocks: int, n_rep: int,
+                          true_kv: int, mask_kv_tail: bool, causal: bool,
+                          scale: float, block_k: int):
+    """dK/dV pass, GQA-native. Grid: (batch*kv_heads, num_k_blocks,
+    n_rep * num_q_blocks) — one program per KV head; the inner grid walks
+    every (group member g, q block qi) pair with (g, qi) = divmod(inner,
+    num_q_blocks), the BlockSpec index maps steering the q-side tiles to
+    q-head row kvh*n_rep + g (same VMEM-bounding rationale as the dQ
+    pass). dK/dV accumulate the whole group's contribution in f32 scratch
+    and are written once, on the last inner step. Causal skip mirrors the
+    forward: q blocks strictly above the diagonal are dead. Padded q rows
+    (beyond true seq) contribute nothing even unmasked: their dO and
+    delta are zero-padded, so ds == 0 and p^T @ dO adds 0."""
     from jax.experimental import pallas as pl
 
     kb = pl.program_id(1)
-    qi = pl.program_id(2)
+    qin = pl.program_id(2)
+    qi = qin % num_q_blocks
     k_start = kb * block_k
     q_start = qi * block_q
+    num_inner = n_rep * num_q_blocks
 
-    @pl.when(qi == 0)
+    @pl.when(qin == 0)
     def _init():
         dk_acc_ref[...] = jnp.zeros(dk_acc_ref.shape, dk_acc_ref.dtype)
         dv_acc_ref[...] = jnp.zeros(dv_acc_ref.shape, dv_acc_ref.dtype)
 
     live = ((q_start + block_q - 1 >= k_start) if causal
-            else (qi >= 0))  # traced either way for pl.when
+            else (qin >= 0))  # traced either way for pl.when
 
     @pl.when(live)
     def _accumulate():
@@ -320,7 +328,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = p * (dp - delta_blk)
         dk_acc_ref[...] += ds.T @ q_blk
 
-    @pl.when(qi == num_q_blocks - 1)
+    @pl.when(qin == num_inner - 1)
     def _write():
         dk_ref[0] = (dk_acc_ref[...] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
@@ -455,16 +463,30 @@ def _unfold(x, b, h):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
+def _kv_row(h: int, hkv: int):
+    """Index-map arithmetic for GQA: q-head grid row -> kv-head row.
+
+    Q is folded to (b*h, s, d) rows bi*h + hi; K/V stay UNREPEATED at
+    (b*hkv, s, d) rows bi*hkv + hi//n_rep. Mapping the kv head in the
+    BlockSpec instead of materializing repeat_kv skips the repeated
+    K/V copies entirely (2x K/V HBM traffic and residuals for the
+    llama GQA configs), which is where long-context bandwidth goes."""
+    n_rep = h // hkv
+    return lambda bh: (bh // h) * hkv + (bh % h) // n_rep
+
+
 def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
                 emit_lse: bool = True):
-    """Run the forward kernel; q/k/v in public (b, s, h, d) layout with
-    h == hkv (GQA repeat handled by callers). Returns (out, lse) with lse
-    shaped (b, h, sq) in fp32; with emit_lse=False returns (out, None)
-    and the kernel writes no LSE plane (serving hot path)."""
+    """Run the forward kernel; q: (b, s, h, d), k/v: (b, s, hkv, d) with
+    hkv dividing h (GQA handled natively via _kv_row index maps — no
+    repeated copies). Returns (out, lse) with lse shaped (b, h, sq) in
+    fp32; with emit_lse=False returns (out, None) and the kernel writes
+    no LSE plane (serving hot path)."""
     from jax.experimental import pallas as pl
 
     b, sq, h, d = q.shape
-    skv = k.shape[1]
+    skv, hkv = k.shape[1], k.shape[2]
+    kvr = _kv_row(h, hkv)
     block_q = min(block_q, sq)
     block_k = min(block_k, skv)
     vma = _vma(q, k, v)
@@ -500,8 +522,10 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-                pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
-                pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
+                pl.BlockSpec((1, skv_p, d),
+                             lambda bh, qi: (kvr(bh), 0, 0)),
+                pl.BlockSpec((1, skv_p, d),
+                             lambda bh, qi: (kvr(bh), 0, 0)),
             ],
             out_specs=out_specs,
             out_shape=out_shape,
@@ -533,9 +557,9 @@ def _flash_call(q, k, v, causal, scale, block_q, block_k, interpret,
                 pl.BlockSpec((1, block_q, d),
                              lambda bh, qi, kb: (bh, qi, 0)),
                 pl.BlockSpec((1, block_k, d),
-                             lambda bh, qi, kb: (bh, kb, 0)),
+                             lambda bh, qi, kb: (kvr(bh), kb, 0)),
                 pl.BlockSpec((1, block_k, d),
-                             lambda bh, qi, kb: (bh, kb, 0)),
+                             lambda bh, qi, kb: (kvr(bh), kb, 0)),
             ],
             out_specs=out_specs,
             out_shape=out_shape,
@@ -561,14 +585,22 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_bwd_resident_calls(qt, kt, vt, dot, lse_t, delta, *, b, h, d, sq,
-                              skv, sq_p, skv_p, block_q, block_k, causal,
-                              scale, vma, interpret, q_dtype, k_dtype,
-                              v_dtype):
+def _flash_bwd_resident_calls(qt, kt, vt, dot, lse_t, delta, *, b, h, hkv,
+                              d, sq, skv, sq_p, skv_p, block_q, block_k,
+                              causal, scale, vma, interpret, q_dtype,
+                              k_dtype, v_dtype):
     """Backward via the whole-sequence-resident kernels (small-seq fast
-    path; see the implementation-choice comment in _flash_bwd_rule)."""
+    path; see the implementation-choice comment in _flash_bwd_rule).
+
+    GQA: K/V are read unrepeated via _kv_row index maps. The dK/dV pass
+    still runs one program per Q head (its per-(bh, kb) scratchless
+    accumulation cannot also sum across heads), so it emits per-q-head
+    partials at (b*h, skv, d) and the group sum happens outside — small
+    seq only, so the extra HBM is bounded."""
     from jax.experimental import pallas as pl
 
+    kvr = _kv_row(h, hkv)
+    n_rep = h // hkv
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel_resident, block_k=block_k,
                           seq_kv=skv_p, true_kv=skv, causal=causal,
@@ -576,8 +608,8 @@ def _flash_bwd_resident_calls(qt, kt, vt, dot, lse_t, delta, *, b, h, d, sq,
         grid=(b * h, sq_p // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (kvr(bh), 0, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, qi: (kvr(bh), 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
@@ -595,8 +627,8 @@ def _flash_bwd_resident_calls(qt, kt, vt, dot, lse_t, delta, *, b, h, d, sq,
         grid=(b * h, skv_p // block_k),
         in_specs=[
             pl.BlockSpec((1, sq_p, d), lambda bh, kb: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (kvr(bh), kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (kvr(bh), kb, 0)),
             pl.BlockSpec((1, sq_p, d), lambda bh, kb: (bh, 0, 0)),
             pl.BlockSpec((1, sq_p, LANES), lambda bh, kb: (bh, 0, 0)),
             pl.BlockSpec((1, sq_p, LANES), lambda bh, kb: (bh, 0, 0)),
@@ -606,13 +638,26 @@ def _flash_bwd_resident_calls(qt, kt, vt, dot, lse_t, delta, *, b, h, d, sq,
             pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0)),
         ],
         out_shape=[
-            _sds((b * h, skv_p, d), k_dtype, vma),
-            _sds((b * h, skv_p, d), v_dtype, vma),
+            # f32 partials ONLY when a group sum follows (n_rep > 1);
+            # plain MHA writes the final dtype directly — no widened HBM
+            # traffic, no extra cast pass.
+            _sds((b * h, skv_p, d),
+                 jnp.float32 if n_rep > 1 else k_dtype, vma),
+            _sds((b * h, skv_p, d),
+                 jnp.float32 if n_rep > 1 else v_dtype, vma),
         ],
         interpret=interpret,
     )(qt, kt, vt, dot, lse_t, delta)
-    return (_unfold(dq[:, :sq], b, h), _unfold(dk[:, :skv], b, h),
-            _unfold(dv[:, :skv], b, h))
+    if n_rep > 1:
+        # Per-q-head partials -> kv-head grads. Head order after _fold is
+        # hi = kvh*n_rep + g, so adjacent rows within a group sum.
+        dk = dk.reshape(b, hkv, n_rep, skv_p, d).sum(axis=2).reshape(
+            b * hkv, skv_p, d).astype(k_dtype)
+        dv = dv.reshape(b, hkv, n_rep, skv_p, d).sum(axis=2).reshape(
+            b * hkv, skv_p, d).astype(v_dtype)
+    return (_unfold(dq[:, :sq], b, h),
+            _unfold(dk[:, :skv], b, hkv),
+            _unfold(dv[:, :skv], b, hkv))
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
@@ -621,7 +666,9 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
     q, k, v, out, lse = res
     g_out, g_lse = cts
     b, sq, h, d = q.shape
-    skv = k.shape[1]
+    skv, hkv = k.shape[1], k.shape[2]
+    kvr = _kv_row(h, hkv)
+    n_rep = h // hkv
     block_q = min(block_q, sq)
     block_k = min(block_k, skv)
     vma = _vma(q, k, v, g_out)
@@ -669,10 +716,11 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
     resident = max(sq_p, skv_p) <= _BWD_RESIDENT_MAX_ROWS
     if resident:
         return _flash_bwd_resident_calls(
-            qt, kt, vt, dot, lse_t, delta, b=b, h=h, d=d, sq=sq, skv=skv,
-            sq_p=sq_p, skv_p=skv_p, block_q=block_q, block_k=block_k,
-            causal=causal, scale=scale, vma=vma, interpret=interpret,
-            q_dtype=q.dtype, k_dtype=k.dtype, v_dtype=v.dtype)
+            qt, kt, vt, dot, lse_t, delta, b=b, h=h, hkv=hkv, d=d, sq=sq,
+            skv=skv, sq_p=sq_p, skv_p=skv_p, block_q=block_q,
+            block_k=block_k, causal=causal, scale=scale, vma=vma,
+            interpret=interpret, q_dtype=q.dtype, k_dtype=k.dtype,
+            v_dtype=v.dtype)
 
     num_qb, num_kb = sq_p // block_q, skv_p // block_k
     dq = pl.pallas_call(
@@ -682,8 +730,10 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
         grid=(b * h, num_qb, num_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, kb: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, kb: (kvr(bh), kb, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, kb: (kvr(bh), kb, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, LANES),
                          lambda bh, qi, kb: (bh, qi, 0)),
@@ -697,48 +747,64 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
         interpret=interpret,
     )(qt, kt, vt, dot, lse_t, delta)
 
+    # dK/dV GQA-native: one program per KV head; the inner grid walks
+    # every (group member, q block) pair — n_rep * num_qb steps — and the
+    # f32 scratch accumulates the whole group's contribution before one
+    # write at (b*hkv) rows. Q-side index maps decompose the inner index
+    # as (g, qi) = divmod(qin, num_qb); q-head row = bkv-derived batch *
+    # h + kv_head * n_rep + g (head order after _fold is kvh*n_rep + g).
+    def _q_row(bkv, qin):
+        return ((bkv // hkv) * h + (bkv % hkv) * n_rep + qin // num_qb)
+
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          num_q_blocks=num_qb, true_kv=skv,
+                          num_q_blocks=num_qb, n_rep=n_rep, true_kv=skv,
                           mask_kv_tail=skv_p != skv, causal=causal,
                           scale=scale, block_k=block_k),
-        grid=(b * h, num_kb, num_qb),
+        grid=(b * hkv, num_kb, n_rep * num_qb),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, kb, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, kb, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda bkv, kb, qin: (_q_row(bkv, qin),
+                                               qin % num_qb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, kb, qin: (bkv, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, kb, qin: (bkv, kb, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda bkv, kb, qin: (_q_row(bkv, qin),
+                                               qin % num_qb, 0)),
             pl.BlockSpec((1, block_q, LANES),
-                         lambda bh, kb, qi: (bh, qi, 0)),
+                         lambda bkv, kb, qin: (_q_row(bkv, qin),
+                                               qin % num_qb, 0)),
             pl.BlockSpec((1, block_q, LANES),
-                         lambda bh, kb, qi: (bh, qi, 0)),
+                         lambda bkv, kb, qin: (_q_row(bkv, qin),
+                                               qin % num_qb, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, kb, qin: (bkv, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, kb, qin: (bkv, kb, 0)),
         ],
         out_shape=[
-            _sds((b * h, skv_p, d), k.dtype, vma),
-            _sds((b * h, skv_p, d), v.dtype, vma),
+            _sds((b * hkv, skv_p, d), k.dtype, vma),
+            _sds((b * hkv, skv_p, d), v.dtype, vma),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, dot, lse_t, delta)
 
-    return (_unfold(dq[:, :sq], b, h), _unfold(dk[:, :skv], b, h),
-            _unfold(dv[:, :skv], b, h))
+    return (_unfold(dq[:, :sq], b, h), _unfold(dk[:, :skv], b, hkv),
+            _unfold(dv[:, :skv], b, hkv))
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def _flash_prep(q, k, v, scale, interpret):
-    """Shared GQA repeat + defaults for the flash entry points."""
+    """Shared defaults for the flash entry points. K/V stay at their
+    native kv-head count — the kernels map kv heads via _kv_row index
+    arithmetic instead of materializing repeat_kv."""
     h, hkv = q.shape[2], k.shape[2]
-    if hkv != h:
-        k = repeat_kv(k, h // hkv)
-        v = repeat_kv(v, h // hkv)
+    if h % hkv != 0:
+        raise ValueError(f"n_heads {h} not divisible by n_kv_heads {hkv}")
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
         from ray_tpu.ops import is_tpu_backend

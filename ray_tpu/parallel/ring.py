@@ -44,10 +44,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     Device i holds tokens [i*seq_local, (i+1)*seq_local).
     """
     b, sq, h, d = q.shape
-    hkv = k.shape[2]
-    if hkv != h:
-        k = repeat_kv(k, h // hkv)
-        v = repeat_kv(v, h // hkv)
+    # K/V circulate the ring UNREPEATED (flash_attention is GQA-native via
+    # _kv_row index maps): n_rep-times less ppermute traffic and HBM
+    # residency per hop for GQA configs.
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     sp = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -121,9 +120,16 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     sp = lax.axis_size(axis_name)
     assert h % sp == 0, f"heads {h} not divisible by sp {sp}"
     hkv = k.shape[2]
-    if hkv != h:
-        k = repeat_kv(k, h // hkv)
-        v = repeat_kv(v, h // hkv)
+    if hkv % sp != 0:
+        # The head-axis all_to_all needs sp to divide the kv-head count.
+        # Repeat K/V only as much as that requires (the local attention
+        # handles any remaining GQA grouping itself); full repeat to h is
+        # the fallback when the minimal factor doesn't divide h evenly.
+        r = sp // math.gcd(hkv, sp)
+        if h % (hkv * r) != 0:
+            r = h // hkv
+        k = repeat_kv(k, r)
+        v = repeat_kv(v, r)
 
     def to_heads(x):
         # (b, sq_local, h, d) -> (b, sq_global, h/sp, d)

@@ -469,3 +469,94 @@ def test_flash_tiled_ragged_tail_and_non_causal(cpu_jax, monkeypatch):
         for gf, gr, name in zip(g_flash, g_ref, "qkv"):
             err = float(jnp.max(jnp.abs(gf - gr)))
             assert err < 2e-2, f"causal={causal} d{name} max err {err}"
+
+
+@pytest.mark.parametrize("force_tiled", [False, True])
+def test_flash_gqa_native_matches_reference(cpu_jax, monkeypatch,
+                                            force_tiled):
+    """GQA (hkv < h) through the flash kernels — K/V are read unrepeated
+    via _kv_row index maps; dK/dV must come back at kv-head count with
+    the group sum applied. Covers both the resident and tiled paths."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops import attention as attn
+
+    if force_tiled:
+        monkeypatch.setattr(attn, "_FWD_RESIDENT_MAX_ROWS", 0)
+        monkeypatch.setattr(attn, "_BWD_RESIDENT_MAX_ROWS", 0)
+    key = jax.random.key(3)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    b, s, h, hkv, d = 2, 150, 4, 2, 128
+    q = jax.random.normal(kq, (b, s, h, d), dtype=jnp.float32)
+    k = jax.random.normal(kk, (b, s, hkv, d), dtype=jnp.float32)
+    v = jax.random.normal(kv, (b, s, hkv, d), dtype=jnp.float32)
+    cot = jax.random.normal(kg, (b, s, h, d), dtype=jnp.float32)
+
+    out = attn.flash_attention(q, k, v, causal=True, block_q=64,
+                               block_k=64, interpret=True)
+    ref = attn.mha_reference(q, k, v, causal=True)
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-2
+
+    def f_flash(q, k, v):
+        return (attn.flash_attention(q, k, v, causal=True, block_q=64,
+                                     block_k=64, interpret=True)
+                * cot).sum()
+
+    def f_ref(q, k, v):
+        return (attn.mha_reference(q, k, v, causal=True) * cot).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    assert g_flash[1].shape == (b, s, hkv, d)  # kv-head count, not h
+    assert g_flash[2].shape == (b, s, hkv, d)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        err = float(jnp.max(jnp.abs(gf - gr)))
+        assert err < 2e-2, f"d{name} max err {err} (tiled={force_tiled})"
+
+
+def test_ring_attention_gqa_unrepeated(jx):
+    """Ring circulates UNREPEATED K/V for GQA (flash is GQA-native):
+    outputs and grads must still match the oracle, and dk/dv keep the
+    kv-head count."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.ops import attention as attn
+    from ray_tpu.parallel import ring
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    b, s, h, hkv, d = 2, 256, 4, 2, 128
+    key = jax.random.key(5)
+    kq, kk, kv, kg = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, hkv, d), jnp.float32)
+    cot = jax.random.normal(kg, (b, s, h, d), jnp.float32)
+    ref = attn.mha_reference(q, k, v, causal=True)
+
+    f = jax.shard_map(
+        lambda q, k, v: ring.ring_attention(q, k, v, axis_name="sp"),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"), check_vma=False)
+    sh = NamedSharding(mesh, P(None, "sp"))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    assert float(jnp.max(jnp.abs(f(qs, ks, vs) - ref))) < 1e-2
+
+    g = jax.grad(lambda q, k, v: (f(q, k, v) * cot).sum(),
+                 argnums=(0, 1, 2))(qs, ks, vs)
+    gr = jax.grad(
+        lambda q, k, v: (attn.mha_reference(q, k, v, causal=True)
+                         * cot).sum(), argnums=(0, 1, 2))(q, k, v)
+    assert g[1].shape == (b, s, hkv, d)
+    for gi, gri, name in zip(g, gr, "qkv"):
+        assert float(jnp.max(jnp.abs(gi - gri))) < 2e-2, name
+
+    # ulysses with hkv % sp != 0 exercises the minimal-repeat fallback
+    u = jax.shard_map(
+        lambda q, k, v: ring.ulysses_attention(q, k, v, axis_name="sp"),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"), check_vma=False)
+    assert float(jnp.max(jnp.abs(u(qs, ks, vs) - ref))) < 1e-2
