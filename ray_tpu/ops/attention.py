@@ -815,7 +815,7 @@ def _flash_prep(q, k, v, scale, interpret):
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 256,
+                    block_q: int = 512, block_k: int = 512,
                     interpret: Optional[bool] = None,
                     return_lse: bool = False):
     """Differentiable Pallas flash attention (fwd + custom_vjp bwd).
@@ -828,7 +828,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 def flash_attention_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         causal: bool = True, scale: Optional[float] = None,
-                        block_q: int = 256, block_k: int = 256,
+                        block_q: int = 512, block_k: int = 512,
                         interpret: Optional[bool] = None) -> jax.Array:
     """Forward-only entry point (serving hot path; no residual outputs)."""
     k, v, scale, interpret = _flash_prep(q, k, v, scale, interpret)
