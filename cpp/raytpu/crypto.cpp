@@ -74,6 +74,9 @@ void Sha256::update(const uint8_t* data, size_t n) {
 }
 
 Bytes Sha256::digest() {
+  if (finalized)
+    throw std::logic_error("Sha256::digest() called twice; state is consumed");
+  finalized = true;
   uint64_t bitlen = len * 8;
   uint8_t pad = 0x80;
   update(&pad, 1);
@@ -217,6 +220,9 @@ void Blake2b::update(const uint8_t* data, size_t n) {
 }
 
 Bytes Blake2b::digest() {
+  if (finalized)
+    throw std::logic_error("Blake2b::digest() called twice; state is consumed");
+  finalized = true;
   // Final block: pad with zeros, counter counts only real bytes.
   t += buflen;
   std::memset(buf + buflen, 0, 128 - buflen);

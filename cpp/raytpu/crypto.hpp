@@ -30,9 +30,10 @@ struct Sha256 {
   Sha256();
   void update(const uint8_t* data, size_t n);
   void update(const Bytes& b) { update(b.data(), b.size()); }
-  Bytes digest();  // finalizes; object must not be reused afterwards
+  Bytes digest();  // finalizes; throws if called twice (state is consumed)
 
  private:
+  bool finalized = false;
   void compress(const uint8_t* block);
 };
 
@@ -52,9 +53,10 @@ struct Blake2b {
   explicit Blake2b(size_t digest_size, const Bytes& key = {});
   void update(const uint8_t* data, size_t n);
   void update(const Bytes& b) { update(b.data(), b.size()); }
-  Bytes digest();  // finalizes
+  Bytes digest();  // finalizes; throws if called twice (state is consumed)
 
  private:
+  bool finalized = false;
   void compress(const uint8_t* block, bool last);
 };
 

@@ -35,7 +35,9 @@ static uint64_t get_u64(const Bytes& buf, size_t& pos) {
 }
 
 static void need(const Bytes& buf, size_t pos, size_t n) {
-  if (pos + n > buf.size()) throw std::runtime_error("xvalue: truncated");
+  // pos + n can wrap for peer-controlled n; compare without the addition.
+  if (pos > buf.size() || n > buf.size() - pos)
+    throw std::runtime_error("xvalue: truncated");
 }
 
 void XValue::encode(Bytes& out) const {
@@ -150,14 +152,19 @@ XValue XValue::decode(const Bytes& buf, size_t& pos) {
       uint64_t count = 1;
       for (uint8_t i = 0; i < ndim; i++) {
         a.dims.push_back(get_u64(buf, pos));
-        count *= a.dims.back();
+        // Peer-controlled dims: reject overflow rather than wrapping to a
+        // small count that would pass the bounds check below.
+        if (__builtin_mul_overflow(count, a.dims.back(), &count))
+          throw std::runtime_error("xvalue: ndarray size overflow");
       }
       // itemsize = trailing digits of the dtype str ("<f4" -> 4).
       size_t isz = 0;
       for (char c : a.dtype)
         if (c >= '0' && c <= '9') isz = isz * 10 + size_t(c - '0');
       if (isz == 0) throw std::runtime_error("bad dtype: " + a.dtype);
-      uint64_t nbytes = count * isz;
+      uint64_t nbytes;
+      if (__builtin_mul_overflow(count, isz, &nbytes))
+        throw std::runtime_error("xvalue: ndarray size overflow");
       need(buf, pos, nbytes);
       a.data.assign(buf.begin() + pos, buf.begin() + pos + nbytes);
       pos += nbytes;

@@ -38,6 +38,41 @@ class TaskSpec:
     # caller drops its refs; reference: task_manager.h holds arg refs).
     pinned_oids: Optional[List[bytes]] = None
 
+    def to_wire(self) -> bytes:
+        """Encode the envelope as a wire.TaskSpecMsg (core_worker.proto:441
+        PushTaskRequest analog): fields evolve per-number across versions
+        instead of all-or-nothing pickled dataclasses."""
+        from ray_tpu.runtime import wire
+
+        return wire.TaskSpecMsg(
+            task_id=self.task_id, fn_id=self.fn_id, name=self.name,
+            args=self.args, kwarg_names=self.kwarg_names,
+            num_returns=self.num_returns, resources=self.resources,
+            max_retries=self.max_retries, actor_id=self.actor_id or b"",
+            method_name=self.method_name or "", seq_no=self.seq_no,
+            scheduling_strategy=self.scheduling_strategy,
+            placement_group_id=self.placement_group_id or b"",
+            placement_group_bundle_index=self.placement_group_bundle_index,
+            runtime_env=self.runtime_env,
+            pinned_oids=self.pinned_oids or []).encode()
+
+    @classmethod
+    def from_wire(cls, data: bytes) -> "TaskSpec":
+        from ray_tpu.runtime import wire
+
+        m = wire.TaskSpecMsg.decode(data)
+        return cls(
+            task_id=m.task_id, fn_id=m.fn_id, name=m.name,
+            args=m.args or [], kwarg_names=m.kwarg_names or [],
+            num_returns=m.num_returns, resources=m.resources,
+            max_retries=m.max_retries, actor_id=m.actor_id or None,
+            method_name=m.method_name or None, seq_no=m.seq_no,
+            scheduling_strategy=m.scheduling_strategy,
+            placement_group_id=m.placement_group_id or None,
+            placement_group_bundle_index=m.placement_group_bundle_index,
+            runtime_env=m.runtime_env,
+            pinned_oids=list(m.pinned_oids) or None)
+
 
 @dataclass
 class ActorSpec:

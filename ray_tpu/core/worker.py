@@ -37,7 +37,7 @@ from ray_tpu.runtime.object_store import ObjectNotFoundError, ObjectStore
 from ray_tpu.runtime.object_store.spill import SpillManager
 from ray_tpu.runtime.object_store.store import StoreFullError
 from ray_tpu.runtime.rpc import (ConnectionLost, EventLoopThread, RpcClient,
-                                 RpcServer)
+                                 RpcError, RpcServer)
 from ray_tpu.utils.ids import ObjectID, TaskID
 
 logger = logging.getLogger(__name__)
@@ -90,6 +90,11 @@ class CoreWorker:
         self._mem_lock = threading.Lock()
         self._registered_fns: set = set()
         self._keys: Dict[Tuple, _KeyState] = {}
+        # Methods whose peers speak the typed wire schema (runtime/wire.py).
+        # Optimistic: flip OFF per method on the first "no handler" from an
+        # older peer and stay on the legacy pickled envelope (the rolling-
+        # upgrade case the schema exists for).
+        self._typed_methods = {"lease_worker", "push_task", "push_actor_task"}
         self._raylet_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._actor_clients: Dict[bytes, "_ActorClient"] = {}
         self._put_refs: set = set()                   # plasma ids this process created
@@ -973,6 +978,12 @@ class CoreWorker:
         """
         from ray_tpu.core.exceptions import TaskCancelledError
 
+        if recursive:
+            # Accepted for signature parity only — don't let callers rely
+            # on child cancellation that never happens.
+            logger.warning(
+                "cancel(recursive=True): child-task cancellation is not "
+                "propagated; only the task producing this ref is cancelled")
         oid = ref.binary() if hasattr(ref, "binary") else ref.id.binary()
         with self._mem_lock:
             rec = self._lineage.get(oid)
@@ -1130,6 +1141,31 @@ class CoreWorker:
         return (spec.fn_id, res, pg, self._env_key(spec.runtime_env))
 
     async def _submit_async(self, spec: TaskSpec):
+        # Resolve dependencies BEFORE the task can enter a queue or occupy a
+        # lease (the reference's DependencyResolver runs before
+        # RequestNewWorkerLease, normal_task_submitter.cc:117): a queued task
+        # is always runnable. Resolving after lease assignment deadlocks the
+        # pool — downstream tasks hold every worker awaiting upstream outputs
+        # while the upstream tasks sit queued with no worker to run on.
+        try:
+            dep_err = await self._resolve_dependencies(spec)
+        except Exception as e:
+            # A failed resolve must surface on the result future, not kill
+            # this (unobserved) coroutine — else get() hangs with no error.
+            dep_err = e if isinstance(e, RayTpuError) else RayTpuError(
+                f"dependency resolution for {spec.name} failed: {e!r}")
+        if dep_err is not None:
+            self._complete_error(spec, dep_err)
+            return
+        if spec.task_id in self._cancelled_tasks:
+            # Cancelled while parked on a pending dependency: fail it here
+            # rather than requesting (possibly forking) a worker just so
+            # _run_on_lease can fail it.
+            from ray_tpu.core.exceptions import TaskCancelledError
+
+            self._complete_error(
+                spec, TaskCancelledError(f"task {spec.name} was cancelled"))
+            return
         key = self._scheduling_key(spec)
         state = self._keys.setdefault(key, _KeyState())
         state.queue.append(spec)
@@ -1234,6 +1270,29 @@ class CoreWorker:
             self._raylet_clients[address] = client
         return client
 
+    async def _lease_call(self, target, resources, req_id, pg_id,
+                          bundle_index, env_key) -> dict:
+        """One lease RPC: typed LeaseRequestMsg/LeaseReplyMsg envelope when
+        the raylet speaks it, legacy pickled kwargs against an older one."""
+        from ray_tpu.runtime import wire
+
+        if "lease_worker" in self._typed_methods:
+            msg = wire.LeaseRequestMsg(
+                resources=resources, for_actor=False,
+                placement_group_id=pg_id or b"", bundle_index=bundle_index,
+                env_key=env_key or "", req_id=req_id or b"")
+            try:
+                encoded = await target.call("lease_worker2", m=msg.encode())
+                return wire.LeaseReplyMsg.decode(encoded).to_reply()
+            except RpcError as e:
+                if "no handler" not in str(e):
+                    raise
+                self._typed_methods.discard("lease_worker")
+        return await target.call(
+            "lease_worker", resources=resources, req_id=req_id,
+            placement_group_id=pg_id, bundle_index=bundle_index,
+            env_key=env_key)
+
     async def _request_lease(self, key, state: _KeyState, req_id: bytes):
         spec_resources = dict(key[1])
         pg_id, bundle_index = key[2]
@@ -1246,10 +1305,9 @@ class CoreWorker:
             target = self.raylet
             try:
                 for _hop in range(4):  # bounded spillback chain
-                    reply = await target.call(
-                        "lease_worker", resources=spec_resources, req_id=req_id,
-                        placement_group_id=pg_id, bundle_index=bundle_index,
-                        env_key=key[3] if len(key) > 3 else None)
+                    reply = await self._lease_call(
+                        target, spec_resources, req_id, pg_id, bundle_index,
+                        key[3] if len(key) > 3 else None)
                     if reply.get("spillback"):
                         target = await self._raylet_for(tuple(reply["spillback"]))
                         continue
@@ -1267,6 +1325,11 @@ class CoreWorker:
         state.inflight_reqs.discard(req_id)
         if not reply.get("ok"):
             if reply.get("canceled"):
+                # The cancel raced new work: the queue may have refilled
+                # while this request was dying, and with it gone nothing
+                # else would re-pump this key — a silent stall.
+                if state.queue:
+                    await self._pump(key, state)
                 return
             if state.queue:
                 self._fail_queued(state, RayTpuError(reply.get("error", "lease refused")))
@@ -1294,10 +1357,11 @@ class CoreWorker:
             self._complete_error(spec, err)
 
     async def _resolve_dependencies(self, spec: TaskSpec) -> Optional[RayTpuError]:
-        """DependencyResolver analog (normal_task_submitter.cc): before pushing,
-        wait for pending ObjectRef args; inline values that live only in this
-        process's memory store (workers can't see it), keep plasma refs as-is.
-        Returns an error to propagate if a dependency failed."""
+        """DependencyResolver analog (normal_task_submitter.cc): before a
+        spec may enter a key queue, wait for pending ObjectRef args; inline
+        values that live only in this process's memory store (workers can't
+        see it), keep plasma refs as-is. Returns an error to propagate if a
+        dependency failed."""
         for i, arg in enumerate(spec.args):
             kind, payload = arg[0], arg[1]
             if kind != "r":
@@ -1337,22 +1401,12 @@ class CoreWorker:
                 spec, TaskCancelledError(f"task {spec.name} was cancelled"))
             await self._lease_idle(key, state, lease)
             return
-        dep_err = await self._resolve_dependencies(spec)
-        if dep_err is not None:
-            self._complete_error(spec, dep_err)
-            await self._lease_idle(key, state, lease)
-            return
-        if spec.task_id in self._cancelled_tasks:
-            # Cancelled while awaiting dependencies (visible in neither
-            # the queue nor _inflight_tasks during that window): fail it
-            # before it reaches a worker.
-            self._complete_error(
-                spec, TaskCancelledError(f"task {spec.name} was cancelled"))
-            await self._lease_idle(key, state, lease)
-            return
+        # Dependencies were resolved BEFORE the spec entered the queue
+        # (_submit_async) — a queued task is always runnable, so nothing may
+        # await here while holding the lease.
         self._inflight_tasks[spec.task_id] = lease
         try:
-            reply = await lease.client.call("push_task", spec=spec)
+            reply = await self._push_call(lease.client, "push_task", spec)
         except (ConnectionLost, OSError):
             self._inflight_tasks.pop(spec.task_id, None)
             state.leases.remove(lease)
@@ -1370,8 +1424,10 @@ class CoreWorker:
             if spec.max_retries > 0 and spec.num_returns != self.STREAMING:
                 spec.max_retries -= 1
                 logger.warning("task %s worker died; retrying", spec.name)
-                state.queue.append(spec)
-                await self._pump(key, state)
+                # Through _submit_async, not the queue directly: the resolve
+                # pass refreshes plasma arg locations that may have died
+                # with the worker's node (near-instant — deps are done).
+                await self._submit_async(spec)
             else:
                 self._complete_error(spec, WorkerCrashedError(
                     f"worker running {spec.name} died"))
@@ -1398,6 +1454,21 @@ class CoreWorker:
             return
         self._complete_task(spec, reply)
         await self._lease_idle(key, state, lease)
+
+    async def _push_call(self, client, method: str, spec: TaskSpec) -> dict:
+        """One task/actor push: typed TaskSpecMsg/TaskReplyMsg envelope when
+        the worker speaks it, legacy pickled spec against an older one."""
+        from ray_tpu.runtime import wire
+
+        if method in self._typed_methods:
+            try:
+                encoded = await client.call(method + "2", m=spec.to_wire())
+                return wire.TaskReplyMsg.decode(encoded).to_reply()
+            except RpcError as e:
+                if "no handler" not in str(e):
+                    raise
+                self._typed_methods.discard(method)
+        return await client.call(method, spec=spec)
 
     def _lost_arg_oid(self, spec: TaskSpec, reply: dict) -> Optional[bytes]:
         """The oid of a reconstructible lost dependency, or None."""
@@ -1719,7 +1790,11 @@ class _ActorClient:
             try:
                 await self._ensure_connected()
                 client = self.client
-                fut = await client.call_send("push_actor_task", spec=spec)
+                if "push_actor_task" in self.core._typed_methods:
+                    fut = await client.call_send("push_actor_task2",
+                                                 m=spec.to_wire())
+                else:
+                    fut = await client.call_send("push_actor_task", spec=spec)
             except ActorDiedError as e:
                 self.core._complete_error(spec, e)
                 self._sem.release()
@@ -1784,11 +1859,15 @@ class _ActorClient:
                     if sent_fut is not None:
                         fut, sent_fut = sent_fut, None
                         reply = await fut
+                        if isinstance(reply, (bytes, bytearray, memoryview)):
+                            from ray_tpu.runtime import wire
+
+                            reply = wire.TaskReplyMsg.decode(reply).to_reply()
                     else:
                         await self._ensure_connected()
                         client = self.client
-                        reply = await client.call("push_actor_task",
-                                                  spec=spec)
+                        reply = await self.core._push_call(
+                            client, "push_actor_task", spec)
                     self.core._complete_task(spec, reply)
                     return
                 except (ConnectionLost, OSError) as e:
@@ -1796,6 +1875,17 @@ class _ActorClient:
                     # re-resolves the address (actor may be restarting).
                     await self._drop_client(client)
                     last_err = e
+                except RpcError as e:
+                    if "no handler" in str(e):
+                        # Older worker predates the typed envelope: flip to
+                        # the legacy pickled spec and re-send. The probe
+                        # must not consume retry budget (streaming methods
+                        # have exactly one attempt).
+                        self.core._typed_methods.discard("push_actor_task")
+                        attempts += 1
+                        last_err = e
+                        continue
+                    raise
                 except ActorDiedError as e:
                     self.core._complete_error(spec, e)
                     return

@@ -632,18 +632,24 @@ class LLMEngine:
         if not batch:
             return None
 
-        def headroom(r):
+        def kv_headroom(r):
+            # Room for KV writes only: pages and the static table width are
+            # hard bounds (an in-flight step writes k entries regardless of
+            # what the harvest keeps). max_tokens is deliberately NOT here —
+            # a nearly-finished member overshoots within its pages and
+            # _process_inflight discards tokens past the end, instead of
+            # dropping the whole batch to single-step for its remaining
+            # lifetime.
             return min(
-                r.params.max_tokens - len(r.output) - r.dispatched,
                 self._cap_tokens - r.num_tokens - r.dispatched,
                 len(r.blocks) * self.block_size - r.num_tokens
                 - r.dispatched)
 
         # All-or-nothing k: the scan's block tables and step count are
-        # static, so every member needs full headroom or the batch takes
+        # static, so every member needs full KV headroom or the batch takes
         # the (equally precompiled) single-step program.
         k = self.multi_step if (self.multi_step > 1 and
-                                all(headroom(r) >= self.multi_step
+                                all(kv_headroom(r) >= self.multi_step
                                     for r in batch)) else 1
         S = self.runner.batch_bucket(len(batch))
         host_tokens = np.zeros(S, dtype=np.int32)

@@ -572,6 +572,21 @@ class Raylet:
 
     # ---- leases (node_manager.cc:1915 HandleRequestWorkerLease) ----------
 
+    async def handle_lease_worker2(self, conn, m: bytes):
+        """Typed-schema lease request (wire.LeaseRequestMsg in,
+        LeaseReplyMsg out — node_manager.proto RequestWorkerLease analog).
+        A newer submitter's extra fields skip on decode here; our reply's
+        fields it doesn't know skip on its side."""
+        from ray_tpu.runtime import wire
+
+        req = wire.LeaseRequestMsg.decode(m)
+        reply = await self.handle_lease_worker(
+            conn, dict(req.resources), for_actor=req.for_actor,
+            placement_group_id=req.placement_group_id or None,
+            bundle_index=req.bundle_index,
+            req_id=req.req_id or None, env_key=req.env_key or None)
+        return wire.LeaseReplyMsg.from_reply(reply).encode()
+
     async def handle_lease_worker(self, conn, resources: Dict[str, float],
                                   for_actor: bool = False,
                                   placement_group_id: Optional[bytes] = None,

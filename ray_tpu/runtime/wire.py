@@ -328,3 +328,120 @@ class LeaseRequestMsg(Message):
     placement_group_id = Field(3, BYTES)
     bundle_index = Field(4, INT, default=-1)
     runtime_env_hash = Field(5, BYTES)
+    env_key = Field(6, STR)
+    req_id = Field(7, BYTES)
+
+
+class LeaseReplyMsg(Message):
+    """RequestWorkerLeaseReply analog (node_manager.proto): grant, refusal,
+    cancellation, or a spillback redirect to another raylet."""
+
+    ok = Field(1, BOOL)
+    error = Field(2, STR)
+    canceled = Field(3, BOOL)
+    spillback_host = Field(4, STR)
+    spillback_port = Field(5, INT, default=-1)
+    spillback_node = Field(6, BYTES)
+    lease_id = Field(7, BYTES)
+    worker_id = Field(8, BYTES)
+    worker_host = Field(9, STR)
+    worker_port = Field(10, INT, default=-1)
+    node_id = Field(11, BYTES)
+
+    @classmethod
+    def from_reply(cls, reply: dict) -> "LeaseReplyMsg":
+        msg = cls(ok=bool(reply.get("ok")),
+                  error=str(reply.get("error") or ""),
+                  canceled=bool(reply.get("canceled")))
+        sb = reply.get("spillback")
+        if sb:
+            msg.spillback_host, msg.spillback_port = str(sb[0]), int(sb[1])
+            msg.spillback_node = reply.get("spillback_node") or b""
+        if reply.get("ok") and reply.get("lease_id"):
+            msg.lease_id = reply["lease_id"]
+            msg.worker_id = reply.get("worker_id") or b""
+            addr = reply.get("worker_address")
+            if addr:
+                msg.worker_host, msg.worker_port = str(addr[0]), int(addr[1])
+            msg.node_id = reply.get("node_id") or b""
+        return msg
+
+    def to_reply(self) -> dict:
+        reply: Dict[str, Any] = {"ok": self.ok}
+        if self.canceled:
+            reply["canceled"] = True
+        if self.error:
+            reply["error"] = self.error
+        if self.spillback_port >= 0:
+            reply["spillback"] = (self.spillback_host, self.spillback_port)
+            if self.spillback_node:
+                reply["spillback_node"] = self.spillback_node
+        if self.ok and self.lease_id:
+            reply["lease_id"] = self.lease_id
+            reply["worker_id"] = self.worker_id
+            if self.worker_port >= 0:
+                reply["worker_address"] = (self.worker_host, self.worker_port)
+            reply["node_id"] = self.node_id
+        return reply
+
+
+class TaskSpecMsg(Message):
+    """TaskSpec envelope (core_worker.proto:441 PushTaskRequest analog).
+
+    The ENVELOPE — ids, routing, options — is schema; `args` and the other
+    payloads that are genuinely code stay ANY (the audited pickle escape
+    hatch), exactly the split the reference draws between TaskSpec protos
+    and its pickled function/arg payloads."""
+
+    task_id = Field(1, BYTES)
+    fn_id = Field(2, BYTES)
+    name = Field(3, STR)
+    args = Field(4, ANY)
+    kwarg_names = Field(5, ANY)
+    num_returns = Field(6, INT, default=1)
+    resources = Field(7, MAP(FLOAT))
+    max_retries = Field(8, INT, default=3)
+    actor_id = Field(9, BYTES)
+    method_name = Field(10, STR)
+    seq_no = Field(11, INT)
+    scheduling_strategy = Field(12, ANY)
+    placement_group_id = Field(13, BYTES)
+    placement_group_bundle_index = Field(14, INT, default=-1)
+    runtime_env = Field(15, ANY)
+    pinned_oids = Field(16, LIST(BYTES))
+
+
+class TaskReplyMsg(Message):
+    """PushTaskReply analog: status + returns; errors are exceptions
+    (ANY), return payloads are serialized values (ANY)."""
+
+    status = Field(1, STR)
+    returns = Field(2, ANY)
+    error = Field(3, ANY)
+    node_id = Field(4, BYTES)
+    streamed = Field(5, INT, default=-1)
+
+    @classmethod
+    def from_reply(cls, reply: dict) -> "TaskReplyMsg":
+        msg = cls(status=reply.get("status") or "")
+        if "returns" in reply:
+            msg.returns = reply["returns"]
+        if "error" in reply:
+            msg.error = reply["error"]
+        if reply.get("node_id"):
+            msg.node_id = reply["node_id"]
+        if "streamed" in reply:
+            msg.streamed = int(reply["streamed"])
+        return msg
+
+    def to_reply(self) -> dict:
+        reply: Dict[str, Any] = {"status": self.status}
+        if self.returns is not None:
+            reply["returns"] = self.returns
+        if self.error is not None:
+            reply["error"] = self.error
+        if self.node_id:
+            reply["node_id"] = self.node_id
+        if self.streamed >= 0:
+            reply["streamed"] = self.streamed
+        return reply

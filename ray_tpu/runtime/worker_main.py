@@ -363,6 +363,24 @@ class WorkerRuntime:
                 *[asyncio.wrap_future(f) for f in futs],
                 return_exceptions=True)
 
+    async def handle_push_task2(self, conn, m: bytes):
+        """Typed-schema task push (wire.TaskSpecMsg in, TaskReplyMsg out):
+        the envelope evolves per-field across versions; args/returns stay
+        pickled payloads. Old workers lack this handler and the submitter
+        falls back to the legacy pickled-spec push."""
+        from ray_tpu.runtime import wire
+
+        reply = await self.handle_push_task(conn, TaskSpec.from_wire(m))
+        return wire.TaskReplyMsg.from_reply(reply).encode()
+
+    async def handle_push_actor_task2(self, conn, m: bytes):
+        """Typed-schema actor call (same envelope: TaskSpecMsg carries
+        actor_id/method_name/seq_no for the ordered actor send path)."""
+        from ray_tpu.runtime import wire
+
+        reply = await self.handle_push_actor_task(conn, TaskSpec.from_wire(m))
+        return wire.TaskReplyMsg.from_reply(reply).encode()
+
     async def handle_push_task(self, conn, spec: TaskSpec):
         fn = self._load_function(spec.fn_id)
         loop = asyncio.get_event_loop()

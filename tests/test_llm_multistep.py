@@ -72,8 +72,8 @@ def test_multistep_seeded_sampling_matches_single_step(tiny_setup):
 
 
 def test_multistep_max_tokens_not_exceeded(tiny_setup):
-    """max_tokens not divisible by k: the tail falls back to single-step
-    and output length is exact."""
+    """max_tokens not divisible by k: the tail overshoots within its pages,
+    harvest discards the extras, and output length is exact."""
     from ray_tpu.llm.sampling import SamplingParams
 
     _, _, make_runner = tiny_setup
@@ -81,6 +81,38 @@ def test_multistep_max_tokens_not_exceeded(tiny_setup):
     multi, _ = _generate(make_runner, [[1, 2, 3]], sp, decode_multi_step=4)
     assert len(multi[0].output_token_ids) == 7
     assert multi[0].finish_reason == "length"
+
+
+def test_multistep_kept_despite_low_headroom_member(tiny_setup):
+    """One nearly-finished request (max_tokens headroom < k) must NOT drop
+    the whole batch to single-step for its remaining lifetime: only the KV
+    bounds (pages, static table width) gate k; max_tokens overshoot is
+    discarded at harvest. Outputs stay exact for both members."""
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.sampling import SamplingParams
+
+    _, _, make_runner = tiny_setup
+    engine = LLMEngine(make_runner(), max_batch_size=4, decode_multi_step=4)
+    seen_k = []
+    orig = engine._dispatch_decode
+
+    def spy(prev):
+        flight = orig(prev)
+        if flight is not None:
+            seen_k.append(flight.get("k", 1))
+        return flight
+
+    engine._dispatch_decode = spy
+    ids = [engine.add_request([1, 2, 3], SamplingParams(max_tokens=2)),
+           engine.add_request([2, 3, 4], SamplingParams(max_tokens=16))]
+    done = {}
+    while engine.has_unfinished():
+        for out in engine.step():
+            if out.finished:
+                done[out.request_id] = out
+    assert len(done[ids[0]].output_token_ids) == 2
+    assert len(done[ids[1]].output_token_ids) == 16
+    assert seen_k and all(k == 4 for k in seen_k), seen_k
 
 
 def test_multistep_eos_truncates_discarded_tokens(tiny_setup):
