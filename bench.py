@@ -554,32 +554,48 @@ def serve_bench_result(backend: str) -> dict:
     # Throughput under load: all requests in flight at once — continuous
     # batching aggregates decode across the whole batch (the number that
     # scales serving cost, vs the latency-oriented sequential runs above).
+    # Swept over concurrency levels for a SATURATION curve: past the
+    # running-batch/page capacity, extra requests queue and the aggregate
+    # should plateau, not fall — that plateau is the chip's serving
+    # capacity. Each level is guarded; the first (base) level feeds the
+    # headline throughput number.
     throughput_tok_s = None
-    try:
-        engine.multi_step = (multi_k if multi_tok_s
-                             and multi_tok_s > decode_tok_s else 1)
-        # Concurrent admission batches the prefills into ONE
-        # (batch, chunk) dispatch — a bucket the LIGHT warmup above
-        # deliberately skips (production servers warmup(full=True); the
-        # full grid would blow the relay's watchdog budget here). One
-        # untimed pass with the same batch shape compiles it; fresh
-        # random prompts in the timed pass keep the prefix cache cold so
-        # only programs are warm, not KV.
-        warm_prompts = [rng.randint(1, config.vocab_size,
-                                    prompt_len).tolist()
-                        for _ in range(n_requests)]
-        engine.generate(warm_prompts, SamplingParams(max_tokens=8))
-        prompts = [rng.randint(1, config.vocab_size, prompt_len).tolist()
-                   for _ in range(n_requests)]
-        t0 = time.perf_counter()
-        outs = engine.generate(prompts,
-                               SamplingParams(max_tokens=gen_tokens))
-        wall = time.perf_counter() - t0
-        total = sum(len(o.output_token_ids) for o in outs)
-        throughput_tok_s = total / max(wall, 1e-9)
-    except Exception as exc:
-        PROBE_LOG.append({"throughput": f"{type(exc).__name__}: "
-                                        f"{str(exc)[:160]}"})
+    saturation = {}
+    levels = ((n_requests, 32, 128) if on_tpu else (n_requests,))
+    engine.multi_step = (multi_k if multi_tok_s
+                         and multi_tok_s > decode_tok_s else 1)
+    for level in levels:
+        try:
+            # Concurrent admission batches the prefills into ONE
+            # (batch, chunk) dispatch — a bucket the LIGHT warmup above
+            # deliberately skips (production servers warmup(full=True);
+            # the full grid would blow the relay's watchdog budget here).
+            # One untimed pass with the same admission shape compiles it;
+            # fresh random prompts in the timed pass keep the prefix
+            # cache cold so only programs are warm, not KV.
+            warm_prompts = [rng.randint(1, config.vocab_size,
+                                        prompt_len).tolist()
+                            for _ in range(level)]
+            engine.generate(warm_prompts, SamplingParams(max_tokens=8))
+            prompts = [rng.randint(1, config.vocab_size,
+                                   prompt_len).tolist()
+                       for _ in range(level)]
+            t0 = time.perf_counter()
+            outs = engine.generate(prompts,
+                                   SamplingParams(max_tokens=gen_tokens))
+            wall = time.perf_counter() - t0
+            total = sum(len(o.output_token_ids) for o in outs)
+            level_tok_s = total / max(wall, 1e-9)
+            saturation[level] = round(level_tok_s, 1)
+            if level == n_requests:
+                # Only the base level may feed the headline number —
+                # promoting a higher-concurrency aggregate would compare
+                # across rounds at different concurrency unmarked.
+                throughput_tok_s = level_tok_s
+        except Exception as exc:
+            saturation[level] = (f"failed: {type(exc).__name__}: "
+                                 f"{str(exc)[:120]}")
+        PROBE_LOG.append({"serve_saturation": dict(saturation)})
     return {
         "ttft_p50_ms": round(p50 * 1000, 2),
         "ttft_p95_ms": round(p95 * 1000, 2),
@@ -599,6 +615,7 @@ def serve_bench_result(backend: str) -> dict:
         "throughput_tokens_per_sec": (round(throughput_tok_s, 1)
                                       if throughput_tok_s is not None
                                       else None),
+        "saturation_curve": saturation,   # concurrency -> aggregate tok/s
         "prompt_len": prompt_len,
         "gen_tokens": gen_tokens,
         "requests": n_requests,
@@ -693,34 +710,68 @@ def main():
 
         return step
 
-    def longctx_probe(base_cfg, make_step, init_state_for):
-        """Train-step throughput at seq 8192, batch 1 (same batch_tokens
-        as the headline run). Flash-only: at 8k the unfused reference
-        attention materializes (1, h, s, s) fp32 scores (~17 GB) — the
-        Pallas fwd+bwd (ops/attention.py) is what makes long context fit
-        at all. 3 timed steps after compile."""
+    def longctx_probe(base_cfg, make_step, init_state_for,
+                      deadline: Optional[float] = None):
+        """Train-step throughput at long sequence, batch 1. Flash-only:
+        past 4k the unfused reference attention materializes (1, h, s, s)
+        fp32 scores — the Pallas fwd+bwd (ops/attention.py) is what makes
+        long context fit at all. Remat policy per seq: "dots" up to 8k;
+        "flash" (save the kernel's out+lse, skip its O(s^2) recompute in
+        backward — models/llama.py) at 16k/32k, where "dots" busts HBM
+        and full remat paid the quadratic kernel twice (42.9% MFU at 32k
+        in r4). 3 timed steps after compile per point; each point is
+        independently guarded so one OOM doesn't kill the leg."""
         import dataclasses as _dc
 
-        cfg = _dc.replace(base_cfg, max_seq=8192, attention_impl="flash")
-        lc_step = make_step(cfg)
-        lc_state = init_state_for(cfg)(jax.random.key(2))
-        lc_tokens = jax.random.randint(jax.random.key(3), (1, 8193), 0,
-                                       cfg.vocab_size)
-        lc_state, l = lc_step(lc_state, lc_tokens)  # compile + warm
-        _ = float(l)
-        t0 = time.perf_counter()
-        n_steps = 3
-        for _i in range(n_steps):
-            lc_state, l = lc_step(lc_state, lc_tokens)
-        lc_loss = float(l)
-        dt = time.perf_counter() - t0
-        tok_s = 8192 * n_steps / dt
-        mfu = tok_s * cfg.flops_per_token(8192) / detect_peak()
-        del lc_state
-        return {"seq": 8192, "batch": 1,
-                "tokens_per_sec": round(tok_s, 1),
-                "mfu": round(mfu, 4), "steps": n_steps,
-                "loss": lc_loss, "attention_impl": "flash"}
+        points = []
+        for lc_seq, policy in ((8192, "dots"), (16384, "flash"),
+                               (32768, "flash")):
+            if deadline is not None and time.monotonic() > deadline:
+                points.append({"seq": lc_seq, "skipped": "budget"})
+                continue
+            try:
+                cfg = _dc.replace(base_cfg, max_seq=lc_seq,
+                                  attention_impl="flash",
+                                  remat_policy=policy)
+                lc_step = make_step(cfg)
+                lc_state = init_state_for(cfg)(jax.random.key(2))
+                lc_tokens = jax.random.randint(
+                    jax.random.key(3), (1, lc_seq + 1), 0, cfg.vocab_size)
+                lc_state, l = lc_step(lc_state, lc_tokens)  # compile+warm
+                _ = float(l)
+                t0 = time.perf_counter()
+                n_steps = 3
+                for _i in range(n_steps):
+                    lc_state, l = lc_step(lc_state, lc_tokens)
+                lc_loss = float(l)
+                dt = time.perf_counter() - t0
+                tok_s = lc_seq * n_steps / dt
+                mfu = tok_s * cfg.flops_per_token(lc_seq) / detect_peak()
+                del lc_state, lc_step
+                points.append({"seq": lc_seq, "batch": 1,
+                               "tokens_per_sec": round(tok_s, 1),
+                               "mfu": round(mfu, 4), "steps": n_steps,
+                               "loss": lc_loss, "remat_policy": policy,
+                               "attention_impl": "flash"})
+            except Exception as exc:
+                points.append({"seq": lc_seq, "remat_policy": policy,
+                               "error": f"{exc!r}"[:300]})
+                # Drop the failed point's state/step NOW: leaving them
+                # bound through gc.collect() would carry the OOM'd
+                # buffers into the next (larger) point.
+                lc_state = lc_step = None  # noqa: F841
+            import gc as _gc
+
+            _gc.collect()
+            # Per-point sidecar flush: a watchdog kill mid-leg still
+            # leaves every completed point in BENCH_probe.json.
+            PROBE_LOG.append({"long_context": points[-1]})
+            try:
+                with open(_SIDECAR + ".partial", "w") as f:
+                    json.dump({"probe_log": PROBE_LOG}, f, default=str)
+            except OSError:
+                pass
+        return points
 
     # Attention impl self-selection: "auto" routes this config (hd=128,
     # seq=2048) through the Pallas flash fwd+bwd on TPU; the XLA-fused
@@ -770,34 +821,55 @@ def main():
         # themselves and simply lose the race.
         if train_step is not None and attn_impl in timed:
             batch_probe = {batch: round(batch * seq / timed[attn_impl], 1)}
+            batch_policy = {batch: config.remat_policy}
             best_bsz, best_tok_s = batch, batch_probe[batch]
-            for bsz in (8, 6):
-                st = l = None
-                try:
-                    toks_b = jax.random.randint(
-                        jax.random.key(1), (bsz, seq + 1), 0,
-                        config.vocab_size)
-                    st = init_state(jax.random.key(0))
-                    for _i in range(2):   # compile + settle
-                        st, l = train_step(st, toks_b)
-                        _ = float(l)
-                    t0 = time.perf_counter()
-                    for _i in range(5):
-                        st, l = train_step(st, toks_b)
-                    _ = float(l)
-                    sps = (time.perf_counter() - t0) / 5
-                    tok_s_b = bsz * seq / sps
-                    batch_probe[bsz] = round(tok_s_b, 1)
-                    if tok_s_b > best_tok_s:
-                        best_bsz, best_tok_s = bsz, tok_s_b
-                except Exception as exc:
-                    batch_probe[bsz] = (f"failed: {type(exc).__name__}: "
-                                        f"{str(exc)[:80]}")
-                finally:
+            best_step, best_policy = train_step, config.remat_policy
+            # The batch-scaling curve (4/8/16 x 2048). "dots" exceeded HBM
+            # at batch>=6 in r3, so the larger batches run the "flash"
+            # remat policy (save the kernel's out+lse only; O(s) memory),
+            # falling back to full remat. Each point is a fresh compile:
+            # the r4 relay 500 at batch 8 came through remote_compile, so
+            # a failed policy is recorded and the next one still tries.
+            for bsz in (8, 16):
+                for policy in ("flash", "full"):
                     st = l = None
+                    try:
+                        step_b = make_step(
+                            _dc.replace(config, remat_policy=policy))
+                        toks_b = jax.random.randint(
+                            jax.random.key(1), (bsz, seq + 1), 0,
+                            config.vocab_size)
+                        st = init_state(jax.random.key(0))
+                        for _i in range(2):   # compile + settle
+                            st, l = step_b(st, toks_b)
+                            _ = float(l)
+                        t0 = time.perf_counter()
+                        for _i in range(5):
+                            st, l = step_b(st, toks_b)
+                        _ = float(l)
+                        sps = (time.perf_counter() - t0) / 5
+                        tok_s_b = bsz * seq / sps
+                        batch_probe[bsz] = round(tok_s_b, 1)
+                        batch_policy[bsz] = policy
+                        if tok_s_b > best_tok_s:
+                            best_bsz, best_tok_s = bsz, tok_s_b
+                            best_step, best_policy = step_b, policy
+                        break
+                    except Exception as exc:
+                        batch_probe[f"{bsz}/{policy}"] = (
+                            f"failed: {type(exc).__name__}: "
+                            f"{str(exc)[:80]}")
+                    finally:
+                        st = l = None
                 PROBE_LOG.append({"batch_probe": dict(batch_probe)})
             batch = best_bsz
+            # The headline must run the policy its winning batch was
+            # probed with — re-running a flash-policy winner under "dots"
+            # would OOM at batch 8/16.
+            train_step = best_step
+            config = _dc.replace(config, remat_policy=best_policy)
             attn_probe["batch_tokens_per_s"] = batch_probe
+            attn_probe["batch_remat_policy"] = batch_policy
     if train_step is None:
         train_step = make_step(config)
 

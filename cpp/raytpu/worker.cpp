@@ -1,0 +1,88 @@
+#include "worker.hpp"
+
+#include <stdexcept>
+
+namespace raytpu {
+
+void Worker::register_with_cluster() {
+  XList names;
+  for (const auto& kv : fns_) names.push_back(XValue(kv.first));
+  XDict args;
+  args.emplace("name", XValue(name_));
+  args.emplace("functions", XValue(std::move(names)));
+  XValue reply = client_.call("xworker_register", std::move(args));
+  worker_id_ = reply.at("worker_id").as_bytes();
+}
+
+void Worker::unregister() {
+  if (worker_id_.empty()) return;
+  XDict args;
+  args.emplace("worker_id", XValue(worker_id_));
+  client_.call("xworker_unregister", std::move(args));
+  worker_id_.clear();
+}
+
+size_t Worker::serve(size_t max_tasks, bool idle_exit,
+                     double poll_timeout_s) {
+  if (worker_id_.empty())
+    throw std::logic_error("serve() before register_with_cluster()");
+  size_t served = 0;
+  int reregisters = 0;
+  for (;;) {
+    XDict poll;
+    poll.emplace("worker_id", XValue(worker_id_));
+    poll.emplace("timeout_s", XValue(poll_timeout_s));
+    XValue task;
+    try {
+      task = client_.call("xworker_poll", std::move(poll));
+    } catch (const std::exception& e) {
+      // The proxy answers an unknown worker id with an error telling us
+      // to re-register (its session state restarted/reaped). Do so a
+      // bounded number of times instead of dying without unregister().
+      if (std::string(e.what()).find("re-register") != std::string::npos &&
+          reregisters < 3) {
+        reregisters++;
+        register_with_cluster();
+        continue;
+      }
+      throw;
+    }
+    const XDict& t = task.as_dict();
+    if (t.count("idle")) {
+      if (idle_exit) break;
+      continue;
+    }
+    const Bytes& task_id = task.at("task_id").as_bytes();
+    const std::string& fn_name = task.at("fn").as_str();
+    XDict result;
+    result.emplace("worker_id", XValue(worker_id_));
+    result.emplace("task_id", XValue(task_id));
+    try {
+      // Args travel as one encoded XValue list (validated against the
+      // xlang vocabulary at submit time on the Python side).
+      XList fn_args;
+      auto it = t.find("args");
+      if (it != t.end() && it->second.tag() == XValue::Tag::Binary) {
+        size_t pos = 0;
+        fn_args = XValue::decode(it->second.as_bytes(), pos).as_list();
+      } else if (it != t.end() && it->second.tag() == XValue::Tag::List) {
+        fn_args = it->second.as_list();
+      }
+      auto fn = fns_.find(fn_name);
+      if (fn == fns_.end())
+        throw std::runtime_error("no such function: " + fn_name);
+      XValue out = fn->second(fn_args);
+      result.emplace("status", XValue(std::string("ok")));
+      result.emplace("value", std::move(out));
+    } catch (const std::exception& e) {
+      result.emplace("status", XValue(std::string("error")));
+      result.emplace("error", XValue(std::string(e.what())));
+    }
+    client_.call("xworker_result", std::move(result));
+    served++;
+    if (max_tasks && served >= max_tasks) break;
+  }
+  return served;
+}
+
+}  // namespace raytpu

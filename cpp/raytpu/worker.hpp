@@ -1,0 +1,54 @@
+// C++ task HOSTING: a worker that registers named C++ functions with the
+// cluster, pulls tasks over the authenticated RTX wire, executes them
+// natively, and pushes results back. The Python driver submits with
+// cross_language.hosted("name").remote(...) and gets a real ObjectRef.
+//
+// Reference analog: the C++ task executor of harborn/ray
+// (cpp/src/ray/runtime/task/task_executor.cc:1) — tasks address functions
+// by DESCRIPTOR (name), args/results are language-neutral values.
+// Transport is long-poll against the client proxy's xworker_* handlers
+// (ray_tpu/util/client/server.py) rather than a raylet push: same task
+// frames, pull-driven.
+#pragma once
+
+#include <functional>
+#include <map>
+#include <string>
+
+#include "client.hpp"
+
+namespace raytpu {
+
+class Worker {
+ public:
+  using Fn = std::function<XValue(const XList&)>;
+
+  Worker(Client& client, std::string name)
+      : client_(client), name_(std::move(name)) {}
+
+  void register_fn(const std::string& fn_name, Fn fn) {
+    fns_[fn_name] = std::move(fn);
+  }
+
+  // Announce this worker + its function names to the cluster
+  // (xworker_register). Must be called before serve().
+  void register_with_cluster();
+
+  // Pull/execute/reply until `max_tasks` tasks served (0 = unlimited) or,
+  // with idle_exit, until one poll comes back empty. Returns tasks served.
+  size_t serve(size_t max_tasks, bool idle_exit, double poll_timeout_s);
+
+  // Graceful goodbye (xworker_unregister): queued tasks are failed over
+  // to the submitter instead of hanging.
+  void unregister();
+
+  const Bytes& worker_id() const { return worker_id_; }
+
+ private:
+  Client& client_;
+  std::string name_;
+  Bytes worker_id_;
+  std::map<std::string, Fn> fns_;
+};
+
+}  // namespace raytpu

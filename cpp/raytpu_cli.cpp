@@ -17,6 +17,11 @@
 //     exec CMD... [-- CMD...]...  several commands on ONE connection
 //                                 (refs are session-scoped; @N names the
 //                                 ref produced by the Nth sub-command)
+//     worker [--max-tasks N] [--idle-exit] [--poll-timeout S]
+//                                 HOST tasks: register the built-in C++
+//                                 functions (cxx.add/mul/upper/sum/fail),
+//                                 pull tasks, execute natively, reply.
+//                                 Exits after N tasks / one idle poll.
 //
 // ARG syntax: i:123  f:1.5  s:text  b:hex  true  false  null
 //             ref:REFHEX (object-ref argument; REFHEX may be @N in exec)
@@ -27,6 +32,7 @@
 #include <string>
 
 #include "raytpu/client.hpp"
+#include "raytpu/worker.hpp"
 
 using namespace raytpu;
 
@@ -183,6 +189,49 @@ int main(int argc, char** argv) {
         std::printf("b:%s\n", to_hex(*v).c_str());
       else
         std::printf("null\n");
+    } else if (cmd == "worker") {
+      size_t max_tasks = 0;
+      bool idle_exit = false;
+      double poll_timeout = 10.0;
+      for (; i < argc; i++) {
+        std::string a = argv[i];
+        if (a == "--max-tasks" && i + 1 < argc)
+          max_tasks = size_t(std::stoul(argv[++i]));
+        else if (a == "--idle-exit")
+          idle_exit = true;
+        else if (a == "--poll-timeout" && i + 1 < argc)
+          poll_timeout = std::stod(argv[++i]);
+        else
+          throw std::runtime_error("unknown worker flag: " + a);
+      }
+      Worker worker(client, "cpp-worker");
+      worker.register_fn("cxx.add", [](const XList& a) {
+        return XValue(a.at(0).as_int() + a.at(1).as_int());
+      });
+      worker.register_fn("cxx.mul", [](const XList& a) {
+        return XValue(a.at(0).as_float() * a.at(1).as_float());
+      });
+      worker.register_fn("cxx.upper", [](const XList& a) {
+        std::string s = a.at(0).as_str();
+        for (auto& c : s) c = char(std::toupper(uint8_t(c)));
+        return XValue(s);
+      });
+      worker.register_fn("cxx.sum", [](const XList& a) {
+        double total = 0;
+        for (const auto& v : a.at(0).as_list()) total += v.as_float();
+        return XValue(total);
+      });
+      worker.register_fn("cxx.fail", [](const XList&) -> XValue {
+        throw std::runtime_error("deliberate failure from C++");
+      });
+      worker.register_with_cluster();
+      std::printf("registered\n");
+      std::fflush(stdout);
+      size_t served = worker.serve(max_tasks, idle_exit, poll_timeout);
+      // Graceful exit announces departure; queued tasks fail over rather
+      // than hang the submitter.
+      worker.unregister();
+      std::printf("served=%zu\n", served);
     } else if (cmd == "actorcall") {
       std::string name = argv[i++];
       std::string method = argv[i++];

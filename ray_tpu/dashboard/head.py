@@ -17,6 +17,7 @@ import subprocess
 import sys
 import time
 import uuid
+from collections import deque
 from typing import Dict, Optional
 
 from aiohttp import web
@@ -160,11 +161,58 @@ class DashboardHead:
         self.gcs: Optional[RpcClient] = None
         self.jobs: Optional[JobManager] = None
         self._runner = None
+        self._log_client: Optional[RpcClient] = None
+        # (node_id, file) -> ring of recent lines. The log monitor ships
+        # every node's worker log lines over GCS pubsub; the head buffers
+        # the tail so the SPA can show per-worker logs without touching
+        # worker filesystems (reference: dashboard log view over the
+        # log_monitor channel, python/ray/dashboard/modules/log/).
+        self._log_buffers: Dict[tuple, deque] = {}
+        self._log_buffer_lines = 1000
+        self._log_buffer_streams = 256
+
+    async def _subscribe_logs(self):
+        from ray_tpu.runtime.log_monitor import LOG_CHANNEL
+
+        async def on_push(method, data):
+            if method != "pubsub" or data.get("channel") != LOG_CHANNEL:
+                return
+            msg = data["message"]
+            key = (msg["node_id"], msg["file"])
+            buf = self._log_buffers.get(key)
+            if buf is None:
+                # Bound TOTAL streams, not just lines-per-stream: worker
+                # churn would otherwise pin 1000 lines per worker EVER
+                # seen. LRU by last write (dict insertion order; we
+                # re-insert on update below).
+                while len(self._log_buffers) >= self._log_buffer_streams:
+                    self._log_buffers.pop(
+                        next(iter(self._log_buffers)), None)
+                buf = deque(maxlen=self._log_buffer_lines)
+            else:
+                del self._log_buffers[key]  # re-insert = move to MRU end
+            buf.extend(msg["lines"])
+            self._log_buffers[key] = buf
+
+        async def _resubscribe(client):
+            await client._call_once("subscribe", 30,
+                                    dict(channels=[LOG_CHANNEL]))
+
+        gcs_host, gcs_port = self.gcs_address.rsplit(":", 1)
+        self._log_client = RpcClient(gcs_host, int(gcs_port),
+                                     on_push=on_push, auto_reconnect=True,
+                                     on_reconnect=_resubscribe)
+        await self._log_client.connect(timeout=30)
+        await self._log_client.call("subscribe", channels=[LOG_CHANNEL])
 
     async def start(self):
         gcs_host, gcs_port = self.gcs_address.rsplit(":", 1)
         self.gcs = RpcClient(gcs_host, int(gcs_port))
         await self.gcs.connect(timeout=30)
+        try:
+            await self._subscribe_logs()
+        except Exception:
+            logger.warning("worker-log streaming unavailable", exc_info=True)
         self.jobs = JobManager(self.gcs, self.gcs_address, self.session_dir)
         app = web.Application()
         app.add_routes([
@@ -185,6 +233,8 @@ class DashboardHead:
             web.get("/api/jobs/{job_id}", self.job_get),
             web.get("/api/jobs/{job_id}/logs", self.job_logs),
             web.post("/api/jobs/{job_id}/stop", self.job_stop),
+            web.get("/api/logs", self.logs_index),
+            web.get("/api/logs/{node_id}/{fname}", self.logs_tail),
             web.static("/static", os.path.join(
                 os.path.dirname(os.path.abspath(__file__)), "static")),
         ])
@@ -199,6 +249,8 @@ class DashboardHead:
     async def close(self):
         if self._runner is not None:
             await self._runner.cleanup()
+        if self._log_client is not None:
+            await self._log_client.close()
         if self.gcs is not None:
             await self.gcs.close()
 
@@ -392,6 +444,31 @@ class DashboardHead:
 
     async def job_logs(self, request):
         return _json({"logs": self.jobs.logs(request.match_info["job_id"])})
+
+    async def logs_index(self, request):
+        """Per-node worker log files the head has buffered (from the log
+        monitor's pubsub stream), with line counts."""
+        nodes: Dict[str, list] = {}
+        for (node_id, fname), buf in sorted(self._log_buffers.items()):
+            nodes.setdefault(node_id, []).append(
+                {"file": fname, "lines": len(buf)})
+        return _json({"nodes": nodes})
+
+    async def logs_tail(self, request):
+        node_id = request.match_info["node_id"]
+        fname = request.match_info["fname"]
+        try:
+            tail = int(request.query.get("tail", "200"))
+        except ValueError:
+            tail = 200
+        buf = self._log_buffers.get((node_id, fname))
+        if buf is None:
+            return _json({"error": "no such log stream"}, status=404)
+        lines = list(buf)
+        if tail > 0:
+            lines = lines[-tail:]
+        return _json({"node_id": node_id, "file": fname, "lines": lines,
+                      "buffered": len(buf)})
 
     async def job_stop(self, request):
         ok = await self.jobs.stop(request.match_info["job_id"])

@@ -181,8 +181,12 @@ const VIEWS = {
   tasks: {title: "Tasks", render: renderTasks},
   jobs: {title: "Jobs", render: renderJobs},
   serve: {title: "Serve", render: renderServe},
+  logs: {title: "Logs", render: renderLogs},
   metrics: {title: "Metrics", render: renderMetrics},
 };
+let logsIndex = {nodes: {}};  // /api/logs: node -> [{file, lines}]
+let logSel = null;            // {node, file} picked in the Logs view
+let logTail = null;           // /api/logs/<node>/<file> payload
 let detail = null;   // {title, body} pinned under the active view
 let searchTerm = "";
 
@@ -343,6 +347,28 @@ function renderServe() {
     }</section>` : ""}`;
 }
 
+function renderLogs() {
+  const nodes = logsIndex.nodes || {};
+  const list = Object.entries(nodes).map(([node, files]) =>
+    `<h3 class="muted">node ${esc(node.slice(0, 12))}</h3>` +
+    files.map((f) => {
+      const active = logSel && logSel.node === node &&
+        logSel.file === f.file;
+      return `<a href="#logs" class="logfile ${active ? "active" : ""}"` +
+        ` data-node="${esc(node)}" data-file="${esc(f.file)}">` +
+        `${esc(f.file)} <span class="muted">(${f.lines})</span></a>`;
+    }).join("<br>")).join("");
+  const tail = logTail
+    ? `<h2>${esc(logTail.file)}<span class="right muted">last ` +
+      `${logTail.lines.length} of ${logTail.buffered} buffered lines` +
+      `</span></h2><pre class="logs">${esc(logTail.lines.join("\n"))}</pre>`
+    : `<p class="muted">select a worker log stream</p>`;
+  return `
+  <section><h2>Worker log streams</h2>${list ||
+    '<p class="muted">no log lines received yet</p>'}</section>
+  <section class="wide">${tail}</section>`;
+}
+
 function renderMetrics() {
   const fams = [...history.metrics.entries()]
     .filter(([, b]) => b.points.length > 1)
@@ -388,6 +414,15 @@ async function render() {
   if (currentView() === "tasks") {
     try { timelineBars = await j("/api/timeline?limit=2000"); }
     catch { timelineBars = []; }
+  }
+  if (currentView() === "logs") {
+    try { logsIndex = await j("/api/logs"); } catch { logsIndex = {nodes: {}}; }
+    if (logSel) {
+      try {
+        logTail = await j(`/api/logs/${logSel.node}/` +
+                          `${encodeURIComponent(logSel.file)}?tail=500`);
+      } catch { logTail = null; }
+    }
   }
   const focused = document.activeElement?.id === "search";
   const pos = focused ? document.activeElement.selectionStart : 0;
@@ -448,6 +483,12 @@ document.addEventListener("click", async (e) => {
                 body: String(body.logs || "").split("\n").slice(-300)
                   .join("\n")};
       render();
+      return;
+    }
+    const logfile = e.target.closest(".logfile");
+    if (logfile) {
+      logSel = {node: logfile.dataset.node, file: logfile.dataset.file};
+      await render();
       return;
     }
     const stop = e.target.closest(".stopjob");

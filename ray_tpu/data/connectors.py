@@ -191,21 +191,67 @@ class MongoDatasource(Datasource):
         self.uri, self.db, self.coll = uri, database, collection
         self.pipeline = pipeline or []
 
-    def read_tasks(self, parallelism, limit):
-        def read_all():
-            client = self.pymongo.MongoClient(self.uri)
-            docs = list(client[self.db][self.coll].aggregate(self.pipeline)
-                        if self.pipeline else
-                        client[self.db][self.coll].find())
-            keys: List[str] = []
-            for d in docs:  # union across docs: schemaless collections
-                d.pop("_id", None)
-                for k in d:
-                    if k not in keys:
-                        keys.append(k)
-            return {k: [d.get(k) for d in docs] for k in keys}
+    @staticmethod
+    def _docs_to_block(docs: List[dict]):
+        keys: List[str] = []
+        for d in docs:  # union across docs: schemaless collections
+            d.pop("_id", None)
+            for k in d:
+                if k not in keys:
+                    keys.append(k)
+        return {k: [d.get(k) for d in docs] for k in keys}
 
-        return [read_all]
+    def read_tasks(self, parallelism, limit):
+        """Honors `parallelism` by splitting on `_id` ranges: N quantile
+        boundary ids are sampled at plan time (sort + skip probes), then
+        one find() per [lo, hi) range runs as its own task. Aggregation
+        pipelines cannot be range-split and read in one task (the
+        reference's MongoDatasource splits only find-style reads too —
+        python/ray/data/_internal/datasource/mongo_datasource.py)."""
+        uri, db, coll_name = self.uri, self.db, self.coll
+        pymongo = self.pymongo
+
+        if self.pipeline or parallelism <= 1:
+            pipeline = self.pipeline
+
+            def read_all():
+                client = pymongo.MongoClient(uri)
+                coll = client[db][coll_name]
+                docs = list(coll.aggregate(pipeline) if pipeline
+                            else coll.find())
+                return self._docs_to_block(docs)
+
+            return [read_all]
+
+        client = pymongo.MongoClient(uri)
+        coll = client[db][coll_name]
+        count = coll.count_documents({})
+        n = max(1, min(parallelism, count or 1))
+        # Quantile boundaries: the _id at every count/n-th position.
+        bounds = []
+        for k in range(1, n):
+            probe = list(coll.find({}, {"_id": 1}).sort("_id", 1)
+                         .skip(k * count // n).limit(1))
+            if probe:
+                bounds.append(probe[0]["_id"])
+        bounds = sorted(set(bounds))  # duplicates collapse on skewed ids
+
+        def make_task(lo, hi):
+            def read_range():
+                cl = pymongo.MongoClient(uri)
+                flt: dict = {}
+                if lo is not None:
+                    flt.setdefault("_id", {})["$gte"] = lo
+                if hi is not None:
+                    flt.setdefault("_id", {})["$lt"] = hi
+                docs = list(cl[db][coll_name].find(flt))
+                return self._docs_to_block(docs)
+
+            return read_range
+
+        edges = [None, *bounds, None]
+        return [make_task(edges[i], edges[i + 1])
+                for i in range(len(edges) - 1)]
 
 
 class BigQueryDatasource(Datasource):
@@ -216,11 +262,56 @@ class BigQueryDatasource(Datasource):
         self.project_id, self.query = project_id, query
 
     def read_tasks(self, parallelism, limit):
-        def read_all():
-            client = self.bq.Client(project=self.project_id)
-            return client.query(self.query).to_arrow()
+        """Honors `parallelism` via the BigQuery Storage API: the query
+        runs once into its destination table, a read session is opened
+        with max_stream_count=parallelism, and each granted stream becomes
+        one read task (the reference requests streams the same way —
+        python/ray/data/_internal/datasource/bigquery_datasource.py:71).
+        Without the storage client (or for parallelism 1) the whole result
+        is fetched in one task."""
+        project_id, query = self.project_id, self.query
+        bq = self.bq
+        try:
+            from google.cloud import bigquery_storage  # type: ignore
+        except ImportError:
+            bigquery_storage = None
 
-        return [read_all]
+        if parallelism <= 1 or bigquery_storage is None:
+            def read_all():
+                client = bq.Client(project=project_id)
+                return client.query(query).to_arrow()
+
+            return [read_all]
+
+        client = bq.Client(project=project_id)
+        dest = client.query(query).result().destination  # materialized
+        session = bigquery_storage.BigQueryReadClient().create_read_session(
+            parent=f"projects/{project_id}",
+            read_session={
+                "table": (f"projects/{dest.project}/datasets/"
+                          f"{dest.dataset_id}/tables/{dest.table_id}"),
+                "data_format": "ARROW",
+            },
+            max_stream_count=parallelism)
+
+        def make_task(stream_name):
+            def read_stream():
+                import pyarrow as pa
+
+                reader = (bigquery_storage.BigQueryReadClient()
+                          .read_rows(stream_name))
+                batches = [page.to_arrow() for page in reader.rows().pages]
+                return pa.Table.from_batches(batches) if batches else None
+
+            return read_stream
+
+        tasks = [make_task(s.name) for s in session.streams]
+        if not tasks:  # empty result set still yields one (empty) task
+            def read_empty():
+                return bq.Client(project=project_id).query(query).to_arrow()
+
+            return [read_empty]
+        return tasks
 
 
 class ClickHouseDatasource(Datasource):
@@ -231,11 +322,42 @@ class ClickHouseDatasource(Datasource):
         self.dsn, self.query = dsn, query
 
     def read_tasks(self, parallelism, limit):
-        def read_all():
-            client = self.cc.get_client(dsn=self.dsn)
-            return client.query_arrow(self.query)
+        """Honors `parallelism` with count + LIMIT/OFFSET splits over the
+        query as a subselect (the reference's ClickHouse datasource builds
+        the same per-task offset windows). Rows must have a stable order
+        for exact partitioning; ClickHouse only guarantees that with an
+        ORDER BY in the query — matching the reference's documented
+        requirement."""
+        dsn, query = self.dsn, self.query
+        cc = self.cc
 
-        return [read_all]
+        if parallelism <= 1:
+            def read_all():
+                return cc.get_client(dsn=dsn).query_arrow(query)
+
+            return [read_all]
+
+        client = cc.get_client(dsn=dsn)
+        count = client.query(
+            f"SELECT count() FROM ({query})").result_rows[0][0]
+        n = max(1, min(parallelism, count or 1))
+
+        def make_task(offset, length):
+            def read_window():
+                cl = cc.get_client(dsn=dsn)
+                return cl.query_arrow(
+                    f"SELECT * FROM ({query}) "
+                    f"LIMIT {length} OFFSET {offset}")
+
+            return read_window
+
+        tasks = []
+        for k in range(n):
+            lo = k * count // n
+            hi = (k + 1) * count // n
+            if hi > lo:
+                tasks.append(make_task(lo, hi - lo))
+        return tasks or [make_task(0, 0)]
 
 
 class DatabricksDatasource(Datasource):

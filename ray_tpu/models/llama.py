@@ -265,13 +265,22 @@ def forward(params: Dict, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
 
     layer_fn = partial(_layer, config)
     if config.remat:
-        if config.remat_policy not in ("full", "dots"):
+        if config.remat_policy not in ("full", "dots", "flash"):
             raise ValueError(
-                f"remat_policy must be 'full' or 'dots', "
+                f"remat_policy must be 'full', 'dots' or 'flash', "
                 f"got {config.remat_policy!r}")
-        policy = (jax.checkpoint_policies.nothing_saveable
-                  if config.remat_policy == "full" else
-                  jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        # "flash": save ONLY the flash-attention kernel outputs (out +
+        # lse, tagged in ops/attention.py) — O(s) extra memory per layer,
+        # and the backward skips re-running the O(s^2) forward kernel
+        # (its other residuals, q/k/v, are cheap dot recomputes from the
+        # saved layer input). The long-context policy: "dots" busts HBM
+        # past ~8k, full remat pays the quadratic kernel twice.
+        policy = {
+            "full": jax.checkpoint_policies.nothing_saveable,
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "flash": jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"),
+        }[config.remat_policy]
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
     def scan_body(x, layer_params):

@@ -580,8 +580,20 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
     out, lse = _flash_call(q, k, v, causal, scale, block_q, block_k,
                            interpret)
+    # Name the kernel's outputs so a checkpoint policy can SAVE them
+    # (save_only_these_names): the flash backward needs exactly (q, k, v,
+    # out, lse), and q/k/v are cheap dot recomputes from the saved layer
+    # input — with out+lse saved, the rematerialized backward DCEs the
+    # whole O(s^2) forward kernel instead of re-running it. That is the
+    # "flash" remat policy (models/llama.py), the long-context middle
+    # ground between "dots" (too much memory past 8k) and full remat
+    # (recomputes the quadratic kernel).
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return (out, lse), (q, k, v, out, lse)
 
 

@@ -7,6 +7,7 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (  # noqa: F401
+    BOHBSearcher,
     Searcher,
     TPESearcher,
     choice,

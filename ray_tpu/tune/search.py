@@ -189,11 +189,36 @@ class TPESearcher(Searcher):
                 lo, hi = ((v.log_low, v.log_high) if log_scale
                           else (v.low, v.high))
                 span = max(hi - lo, 1e-12)
-                bw = max(span / max(math.sqrt(len(gx) or 1), 1.0), 1e-6)
+                # Scott's rule on the GOOD set (what BOHB's KDE does):
+                # bandwidth tracks the spread of the good observations, so
+                # a concentrated good set means tight candidates. Floor at
+                # 1% of span (degenerate/singleton sets), cap at the old
+                # diffuse span/sqrt(n) so sparse sets stay exploratory.
+                if len(gx) >= 2:
+                    mean = sum(gx) / len(gx)
+                    std = math.sqrt(sum((g - mean) ** 2 for g in gx)
+                                    / (len(gx) - 1))
+                    bw = std * len(gx) ** -0.2
+                else:
+                    bw = span / 2.0
+                bw = min(max(bw, span * 0.01, 1e-6),
+                         span / max(math.sqrt(len(gx) or 1), 1.0))
                 best, best_ratio = None, -math.inf
                 for _ in range(self.n_candidates):
                     base = self.rng.choice(gx) if gx else self.rng.uniform(lo, hi)
-                    x = min(max(self.rng.gauss(base, bw), lo), hi)
+                    x = self.rng.gauss(base, bw)
+                    # Reflect at the bounds instead of clamping: a clamp
+                    # piles an atom of candidate density on the boundary,
+                    # and one noisy-good boundary observation then locks
+                    # the whole search onto it.
+                    for _r in range(8):
+                        if x < lo:
+                            x = 2 * lo - x
+                        elif x > hi:
+                            x = 2 * hi - x
+                        else:
+                            break
+                    x = min(max(x, lo), hi)
                     ratio = (self._kde_logpdf(x, gx, bw)
                              - self._kde_logpdf(x, bx, bw))
                     if ratio > best_ratio:
@@ -209,6 +234,64 @@ class TPESearcher(Searcher):
             else:
                 cfg[k] = v
         return cfg
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB (Bayesian Optimization + HyperBand, Falkner et al. 2018):
+    HyperBand's multi-fidelity budgets with a TPE model in place of random
+    sampling. Reference analog: tune/search/bohb/bohb_search.py (TuneBOHB
+    via the ConfigSpace sampler) — native here, no dependency.
+
+    Observations pool PER BUDGET (trials a HyperBand scheduler stops at a
+    rung complete with that rung's budget in their last result); the model
+    draws from the highest budget that has accumulated
+    `min_points_in_model` observations, so high-fidelity evidence
+    dominates as it appears. With probability `random_fraction` (and until
+    any pool is large enough) configs stay random — BOHB's exploration
+    floor, which also guarantees every region keeps nonzero density."""
+
+    def __init__(self, param_space: Dict, metric: str, mode: str = "max", *,
+                 budget_key: str = "training_iteration",
+                 min_points_in_model: Optional[int] = None,
+                 random_fraction: float = 1 / 3, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: int = 0):
+        super().__init__(param_space, metric, mode, n_initial=0,
+                         gamma=gamma, n_candidates=n_candidates, seed=seed)
+        self.budget_key = budget_key
+        self.min_points = (min_points_in_model
+                           if min_points_in_model is not None
+                           else len(param_space) + 2)
+        self.random_fraction = random_fraction
+        self._pools: Dict[float, List] = {}
+
+    def suggest(self, trial_id: str) -> Dict:
+        pool = self._model_pool()
+        if pool is None or self.rng.random() < self.random_fraction:
+            cfg = self._random_config()
+        else:
+            # TPE internals read self._scores; point them at the chosen
+            # budget's pool for this draw.
+            self._scores = pool
+            cfg = self._tpe_config()
+        self._configs[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict]):
+        cfg = self._configs.pop(trial_id, None)
+        if cfg is None or not result:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        score = float(value) if self.mode == "max" else -float(value)
+        budget = float(result.get(self.budget_key) or 1.0)
+        self._pools.setdefault(budget, []).append((score, cfg))
+
+    def _model_pool(self) -> Optional[List]:
+        for budget in sorted(self._pools, reverse=True):
+            if len(self._pools[budget]) >= self.min_points:
+                return self._pools[budget]
+        return None
 
 
 def generate_variants(param_space: Dict, num_samples: int, seed: int = 0

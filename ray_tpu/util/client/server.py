@@ -25,6 +25,7 @@ class _Session:
         self.refs: Dict[bytes, Any] = {}      # ref id -> ObjectRef
         self.actors: Dict[bytes, Any] = {}    # actor id -> ActorHandle
         self.fns: Dict[bytes, Any] = {}       # fn id -> RemoteFunction
+        self.hosted_workers: set = set()      # hosted worker ids (xlang)
 
 
 class ClientProxyServer:
@@ -55,6 +56,9 @@ class ClientProxyServer:
             global_worker().io.run(self.server.close())
         except Exception:
             pass
+        pool = getattr(self, "_poll_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
 
     # -- session plumbing --------------------------------------------------
 
@@ -78,6 +82,14 @@ class ClientProxyServer:
         s.refs.clear()
         s.actors.clear()
         s.fns.clear()
+        if s.hosted_workers:
+            # A dead hosted worker must fail its queued/in-flight tasks,
+            # not leave driver get()s hanging.
+            from ray_tpu.util import cross_language
+
+            for worker_id in s.hosted_workers:
+                cross_language.hosted_unregister(worker_id)
+            s.hosted_workers.clear()
 
     @staticmethod
     def _run(fn, *args, **kwargs):
@@ -375,6 +387,67 @@ class ClientProxyServer:
         s = self._session(conn)
         for r in refs:
             s.refs.pop(r, None)
+        return {"ok": True}
+
+    # -- hosted (foreign-executing) workers --------------------------------
+    #
+    # The reverse of xcall: a C++ (or other non-Python) process registers
+    # functions it EXECUTES, long-polls for tasks, and pushes results.
+    # Python drivers submit via cross_language.hosted("name").remote(...).
+    # Reference analog: cpp/src/ray/runtime/task/task_executor.cc.
+
+    async def handle_xworker_register(self, conn, name: str, functions):
+        from ray_tpu.util import cross_language
+
+        s = self._session(conn)
+        worker_id = cross_language.hosted_register(name, list(functions))
+        s.hosted_workers.add(worker_id)
+        return {"worker_id": worker_id}
+
+    async def handle_xworker_poll(self, conn, worker_id: bytes,
+                                  timeout_s: float = 10.0):
+        import asyncio
+
+        from ray_tpu.util import cross_language
+
+        loop = asyncio.get_event_loop()
+        if not hasattr(self, "_poll_pool"):
+            # Dedicated pool: long-polls parked on the DEFAULT executor
+            # would occupy its handful of threads (cpu_count+4 — five on
+            # the 1-core box) and starve every other handler's _run().
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._poll_pool = ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="xworker-poll")
+        try:
+            task = await loop.run_in_executor(
+                self._poll_pool, cross_language.hosted_poll, worker_id,
+                float(timeout_s))
+        except KeyError as e:
+            return {"error": str(e)}
+        if task is None:
+            return {"idle": True}
+        return {"task_id": task["task_id"], "fn": task["fn"],
+                "args": task["args"]}
+
+    async def handle_xworker_result(self, conn, worker_id: bytes,
+                                    task_id: bytes, status: str,
+                                    value=None, error: str = ""):
+        from ray_tpu.util import cross_language
+
+        try:
+            cross_language.hosted_result(worker_id, task_id, status,
+                                         value=value, error=error)
+        except KeyError as e:
+            return {"error": str(e)}
+        return {"ok": True}
+
+    async def handle_xworker_unregister(self, conn, worker_id: bytes):
+        from ray_tpu.util import cross_language
+
+        cross_language.hosted_unregister(worker_id)
+        s = self._session(conn)
+        s.hosted_workers.discard(worker_id)
         return {"ok": True}
 
 

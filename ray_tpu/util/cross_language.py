@@ -68,3 +68,161 @@ def resolve(name: str) -> Callable:
 def registered_names():
     with _lock:
         return sorted(_registry)
+
+
+# --------------------------------------------------------- hosted workers
+#
+# The REVERSE direction: a non-Python worker (cpp/raytpu_cli `worker`)
+# registers named functions it can EXECUTE, then pulls tasks and pushes
+# results over the RTX wire. Python drivers call those functions with
+# `hosted("name").remote(...)` and get a real ObjectRef back.
+#
+# Reference analog: the C++ task executor
+# (cpp/src/ray/runtime/task/task_executor.cc:1) — tasks target function
+# DESCRIPTORS (names), args/results are language-neutral values. Transport
+# here is long-poll over the authenticated client-proxy wire rather than a
+# raylet push: same task frames, pull-driven (the proxy cannot dial out
+# through the worker's NAT side of the socket).
+
+_hosted_lock = threading.Lock()
+_hosted_workers: Dict[bytes, "_HostedWorker"] = {}
+_hosted_pending: Dict[bytes, dict] = {}  # task_id -> {"oid": ..., "worker"}
+
+
+class _HostedWorker:
+    def __init__(self, name: str, functions):
+        import os
+        import queue as queue_mod
+
+        self.worker_id = os.urandom(8)
+        self.name = name
+        self.functions = frozenset(functions)
+        self.tasks: "queue_mod.Queue[dict]" = queue_mod.Queue()
+
+
+def hosted_register(name: str, functions) -> bytes:
+    """Register a worker that EXECUTES the named functions (called by the
+    proxy on xworker_register). Returns the worker id used for polling."""
+    hw = _HostedWorker(name, functions)
+    with _hosted_lock:
+        _hosted_workers[hw.worker_id] = hw
+    return hw.worker_id
+
+
+def hosted_unregister(worker_id: bytes) -> None:
+    with _hosted_lock:
+        hw = _hosted_workers.pop(worker_id, None)
+        if hw is None:
+            return
+        # Fail every task this worker will never answer: still queued, or
+        # already polled and in flight when it died.
+        orphans = set()
+        while not hw.tasks.empty():
+            try:
+                orphans.add(hw.tasks.get_nowait()["task_id"])
+            except Exception:
+                break
+        orphans |= {tid for tid, rec in _hosted_pending.items()
+                    if rec["worker"] == worker_id}
+    for tid in orphans:
+        hosted_result(worker_id, tid, "error",
+                      error=f"hosted worker {hw.name!r} disconnected",
+                      _allow_unknown_worker=True)
+
+
+def hosted_names() -> list:
+    """All function names currently executable by some hosted worker."""
+    with _hosted_lock:
+        out = set()
+        for hw in _hosted_workers.values():
+            out |= hw.functions
+        return sorted(out)
+
+
+def hosted_poll(worker_id: bytes, timeout_s: float = 10.0) -> Optional[dict]:
+    """Blocking pull of the next task for `worker_id` (run by the proxy in
+    an executor thread). None = idle within the timeout."""
+    import queue as queue_mod
+
+    with _hosted_lock:
+        hw = _hosted_workers.get(worker_id)
+    if hw is None:
+        raise KeyError("unknown hosted worker (re-register)")
+    try:
+        return hw.tasks.get(timeout=min(timeout_s, 30.0))
+    except queue_mod.Empty:
+        return None
+
+
+def hosted_result(worker_id: bytes, task_id: bytes, status: str,
+                  value=None, error: str = "",
+                  _allow_unknown_worker: bool = False) -> None:
+    """Complete a hosted task: land the value (or error) on the driver's
+    ObjectRef exactly the way a Python task reply would."""
+    from ray_tpu.core import worker as worker_mod
+    from ray_tpu.core.exceptions import RayTpuError
+
+    with _hosted_lock:
+        if not _allow_unknown_worker and worker_id not in _hosted_workers:
+            raise KeyError("unknown hosted worker")
+        rec = _hosted_pending.pop(task_id, None)
+    if rec is None:
+        return  # duplicate/late reply
+    w = worker_mod.global_worker()
+    result = (value if status == "ok"
+              else RayTpuError(f"hosted task failed: {error}"))
+    with w._mem_lock:
+        w.memory_store[rec["oid"]] = result
+        fut = w.result_futures.pop(rec["oid"], None)
+    if fut is not None and not fut.done():
+        fut.set_result(True)
+
+
+class HostedFunction:
+    """Handle to a function EXECUTED by a hosted (non-Python) worker."""
+
+    def __init__(self, fn_name: str):
+        self.fn_name = fn_name
+
+    def remote(self, *args):
+        import os
+
+        from ray_tpu.core import worker as worker_mod
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.runtime import xlang
+        from ray_tpu.utils.ids import ObjectID
+
+        # Args must speak the xlang vocabulary — reject pickled Python
+        # closures at SUBMIT time, not in the foreign worker.
+        payload = xlang.encode(list(args))
+        w = worker_mod.global_worker()
+        oid = ObjectID.generate().binary()
+        task_id = os.urandom(8)
+        from concurrent.futures import Future as SyncFuture
+
+        fut = SyncFuture()
+        with w._mem_lock:
+            w.result_futures[oid] = fut
+        # Worker lookup, pending insert AND queue put under ONE lock hold:
+        # a disconnect reap between them would scan _hosted_pending before
+        # the task exists and drain the queue before the put — the task
+        # would then hang forever on a dead worker.
+        with _hosted_lock:
+            hw = next((h for h in _hosted_workers.values()
+                       if self.fn_name in h.functions), None)
+            if hw is None:
+                with w._mem_lock:
+                    w.result_futures.pop(oid, None)
+                avail = sorted({n for h in _hosted_workers.values()
+                                for n in h.functions})  # lock already held
+                raise KeyError(
+                    f"no hosted worker executes {self.fn_name!r} "
+                    f"(available: {avail})")
+            _hosted_pending[task_id] = {"oid": oid, "worker": hw.worker_id}
+            hw.tasks.put({"task_id": task_id, "fn": self.fn_name,
+                          "args": payload})
+        return ObjectRef(oid)
+
+
+def hosted(fn_name: str) -> HostedFunction:
+    return HostedFunction(fn_name)

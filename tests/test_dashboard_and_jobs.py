@@ -186,3 +186,41 @@ def test_dashboard_drilldown_and_timeline(cluster_with_dashboard):
     evs = [e for e in chrome["traceEvents"]
            if e["name"].endswith("work")]
     assert evs and all(e["ph"] == "X" and e["dur"] > 0 for e in evs)
+
+
+def test_dashboard_worker_log_viewer(cluster_with_dashboard):
+    """The head buffers the log monitor's pubsub stream and serves
+    per-node/per-worker tails (reference: the dashboard log view,
+    python/ray/dashboard/modules/log/)."""
+    import time
+
+    url = cluster_with_dashboard
+
+    @ray_tpu.remote
+    def noisy():
+        print("dashboard-log-viewer-marker")
+        return 1
+
+    assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+    # Log line travels worker file -> log monitor -> GCS pubsub -> head.
+    deadline = time.time() + 30
+    stream = None
+    while time.time() < deadline and stream is None:
+        index = _get_json(url + "/api/logs")
+        for node_id, files in index["nodes"].items():
+            for f in files:
+                tail = _get_json(
+                    f"{url}/api/logs/{node_id}/{f['file']}?tail=100")
+                if any("dashboard-log-viewer-marker" in line
+                       for line in tail["lines"]):
+                    stream = (node_id, f["file"])
+                    break
+            if stream:
+                break
+        if stream is None:
+            time.sleep(0.5)
+    assert stream is not None, "marker line never reached the dashboard"
+    # The SPA ships the Logs view wired to these endpoints.
+    with urllib.request.urlopen(url + "/static/app.js", timeout=30) as r:
+        appjs = r.read()
+    assert b"/api/logs" in appjs and b"renderLogs" in appjs

@@ -141,3 +141,104 @@ def test_cpp_named_actor_call(cli, cluster_proxy):
                 "add", "i:5") == "5"
     assert _run(cli, *cluster_proxy, "actorcall", "cpp_counter",
                 "add", "i:7") == "12"
+
+
+# ------------------------------------------------------- C++ task HOSTING
+
+def _spawn_worker(cli, cluster_proxy, *flags):
+    import subprocess
+    import time
+
+    from ray_tpu.util import cross_language
+
+    proc = subprocess.Popen([cli, *cluster_proxy, "worker", *flags],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    deadline = time.time() + 30
+    while "cxx.add" not in cross_language.hosted_names():
+        if time.time() > deadline:
+            proc.kill()
+            raise AssertionError("C++ worker never registered: "
+                                 + str(proc.communicate()))
+        time.sleep(0.05)
+    return proc
+
+
+def test_cpp_task_hosting(cli, cluster_proxy):
+    """N31 task hosting: Python submits by name, C++ EXECUTES natively,
+    Python gets the result on a real ObjectRef (task_executor.cc analog)."""
+    from ray_tpu.util import cross_language
+
+    proc = _spawn_worker(cli, cluster_proxy,
+                         "--max-tasks", "4", "--poll-timeout", "5")
+    try:
+        refs = [cross_language.hosted("cxx.add").remote(40, 2),
+                cross_language.hosted("cxx.mul").remote(6.0, 7.0),
+                cross_language.hosted("cxx.upper").remote("tpu"),
+                cross_language.hosted("cxx.sum").remote([1.5, 2.5, 3.0])]
+        assert ray_tpu.get(refs[0], timeout=60) == 42
+        assert ray_tpu.get(refs[1], timeout=60) == 42.0
+        assert ray_tpu.get(refs[2], timeout=60) == "TPU"
+        assert ray_tpu.get(refs[3], timeout=60) == 7.0
+        out, err = proc.communicate(timeout=60)
+        assert proc.returncode == 0, err
+        assert "served=4" in out
+    finally:
+        proc.kill()
+
+
+def test_cpp_task_hosting_error_and_failover(cli, cluster_proxy):
+    """A C++ exception propagates to the Python get(); tasks still queued
+    when the worker leaves fail over loudly instead of hanging."""
+    import time
+
+    import pytest as _pytest
+
+    from ray_tpu.core.exceptions import RayTpuError
+    from ray_tpu.util import cross_language
+
+    proc = _spawn_worker(cli, cluster_proxy,
+                         "--max-tasks", "1", "--poll-timeout", "5")
+    try:
+        ref_fail = cross_language.hosted("cxx.fail").remote()
+        with _pytest.raises(RayTpuError, match="deliberate failure"):
+            ray_tpu.get(ref_fail, timeout=60)
+        proc.communicate(timeout=60)  # served its 1 task, unregistered
+        deadline = time.time() + 30
+        while "cxx.add" in cross_language.hosted_names():
+            assert time.time() < deadline
+            time.sleep(0.05)
+        with _pytest.raises(KeyError, match="no hosted worker"):
+            cross_language.hosted("cxx.add").remote(1, 2)
+    finally:
+        proc.kill()
+
+
+def test_cpp_worker_death_fails_inflight(cli, cluster_proxy):
+    """SIGKILL the worker with a task queued behind its last serve: the
+    proxy's disconnect reap fails the orphan instead of leaving the
+    driver's get() hanging forever."""
+    import pytest as _pytest
+
+    from ray_tpu.core.exceptions import RayTpuError
+    from ray_tpu.util import cross_language
+
+    # No --max-tasks: the worker would serve forever; we kill it.
+    proc = _spawn_worker(cli, cluster_proxy, "--poll-timeout", "30")
+    ref = None
+    try:
+        assert ray_tpu.get(
+            cross_language.hosted("cxx.add").remote(1, 2), timeout=60) == 3
+        proc.kill()
+        proc.wait(timeout=30)
+        # Submit BEFORE the proxy notices the death: the task queues to the
+        # dead worker and must be failed by the disconnect reap.
+        ref = cross_language.hosted("cxx.add").remote(3, 4)
+    except KeyError:
+        # The reap already won the race: submission itself refused. Fine.
+        return
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    with _pytest.raises(RayTpuError, match="disconnected"):
+        ray_tpu.get(ref, timeout=60)
