@@ -260,12 +260,154 @@ def _kuberay(**kwargs):
     return KubeRayProvider(**kwargs)
 
 
+class AwsNodeProvider(NodeProvider):
+    """EC2 provider via aws-CLI argv (dry-run-able like GCETpuProvider).
+
+    Reference analog: autoscaler/_private/aws/node_provider.py — the same
+    contract (tagged instances are cluster membership; launch =
+    run-instances with cluster/name tags, terminate by instance id),
+    expressed as recorded CLI commands instead of boto3 calls so tests
+    assert the exact API interaction without credentials or egress."""
+
+    def __init__(self, region: str, cluster_name: str = "ray-tpu", *,
+                 ami: str = "resolve:ssm:/aws/service/ami-amazon-linux-"
+                            "latest/al2023-ami-kernel-default-x86_64",
+                 subnet_id: str = "", key_name: str = "",
+                 user_data: str = "",
+                 runner: Optional[CommandRunner] = None):
+        self.region = region
+        self.cluster_name = cluster_name
+        self.ami = ami
+        self.subnet_id = subnet_id
+        self.key_name = key_name
+        self.user_data = user_data
+        self.runner = runner or CommandRunner(dry_run=True)
+        self._live: Dict[str, InstanceType] = {}
+
+    @staticmethod
+    def _ec2_type(instance_type: InstanceType) -> str:
+        # Resource shape -> instance family (the reference reads it from
+        # the cluster YAML; default maps CPU count to m5 sizes).
+        cpus = instance_type.resources.get("CPU", 1)
+        return ("m5.large" if cpus <= 2 else
+                "m5.xlarge" if cpus <= 4 else
+                "m5.2xlarge" if cpus <= 8 else "m5.4xlarge")
+
+    def launch(self, instance_type: InstanceType) -> str:
+        tags = (f"ResourceType=instance,Tags=["
+                f"{{Key=ray-tpu-cluster,Value={self.cluster_name}}},"
+                f"{{Key=ray-tpu-node-type,Value={instance_type.name}}}]")
+        cmd = ["aws", "ec2", "run-instances", "--region", self.region,
+               "--image-id", self.ami,
+               "--instance-type", self._ec2_type(instance_type),
+               "--count", "1", "--tag-specifications", tags]
+        if self.subnet_id:
+            cmd += ["--subnet-id", self.subnet_id]
+        if self.key_name:
+            cmd += ["--key-name", self.key_name]
+        if self.user_data:
+            cmd += ["--user-data", self.user_data]
+        out = self.runner.run(cmd, timeout=600)
+        # EC2 ids are SERVER-assigned (unlike GCE/Azure names): parse the
+        # real id from the run-instances reply, else terminate() would
+        # name an id AWS never issued and leak the VM. Dry-run returns no
+        # output; a placeholder id keeps the recorded lifecycle coherent.
+        iid = None
+        if out:
+            import json as json_mod
+
+            try:
+                iid = json_mod.loads(out)["Instances"][0]["InstanceId"]
+            except (ValueError, KeyError, IndexError) as e:
+                raise RuntimeError(
+                    f"could not parse InstanceId from run-instances "
+                    f"output: {e!r}") from e
+        if iid is None:
+            iid = f"i-dryrun-{uuid.uuid4().hex[:12]}"
+        self._live[iid] = instance_type
+        return iid
+
+    def terminate(self, instance_id: str) -> None:
+        if instance_id not in self._live:
+            return
+        del self._live[instance_id]
+        self.runner.run(["aws", "ec2", "terminate-instances", "--region",
+                         self.region, "--instance-ids", instance_id],
+                        timeout=600)
+
+    def non_terminated(self) -> List[str]:
+        return list(self._live)
+
+    def get_node_id(self, instance_id: str) -> Optional[bytes]:
+        return None  # a booted VM's raylet registers itself with the GCS
+
+
+class AzureNodeProvider(NodeProvider):
+    """Azure VM provider via az-CLI argv (dry-run-able).
+
+    Reference analog: autoscaler/_private/_azure/node_provider.py — VMs
+    tagged with the cluster name in one resource group; create/delete by
+    name."""
+
+    def __init__(self, resource_group: str, location: str,
+                 cluster_name: str = "ray-tpu", *,
+                 image: str = "Ubuntu2204", vm_size: str = "",
+                 custom_data: str = "",
+                 runner: Optional[CommandRunner] = None):
+        self.resource_group = resource_group
+        self.location = location
+        self.cluster_name = cluster_name
+        self.image = image
+        self.vm_size = vm_size
+        self.custom_data = custom_data
+        self.runner = runner or CommandRunner(dry_run=True)
+        self._live: Dict[str, InstanceType] = {}
+
+    @staticmethod
+    def _az_size(instance_type: InstanceType) -> str:
+        cpus = instance_type.resources.get("CPU", 1)
+        return ("Standard_D2s_v5" if cpus <= 2 else
+                "Standard_D4s_v5" if cpus <= 4 else
+                "Standard_D8s_v5" if cpus <= 8 else "Standard_D16s_v5")
+
+    def launch(self, instance_type: InstanceType) -> str:
+        name = f"ray-tpu-{uuid.uuid4().hex[:8]}"
+        cmd = ["az", "vm", "create", "--name", name,
+               "--resource-group", self.resource_group,
+               "--location", self.location,
+               "--image", self.image,
+               "--size", self.vm_size or self._az_size(instance_type),
+               "--tags", f"ray-tpu-cluster={self.cluster_name}",
+               f"ray-tpu-node-type={instance_type.name}"]
+        if self.custom_data:
+            cmd += ["--custom-data", self.custom_data]
+        self.runner.run(cmd, timeout=1800)
+        self._live[name] = instance_type
+        return name
+
+    def terminate(self, instance_id: str) -> None:
+        if instance_id not in self._live:
+            return
+        del self._live[instance_id]
+        self.runner.run(["az", "vm", "delete", "--name", instance_id,
+                         "--resource-group", self.resource_group,
+                         "--yes"], timeout=1800)
+
+    def non_terminated(self) -> List[str]:
+        return list(self._live)
+
+    def get_node_id(self, instance_id: str) -> Optional[bytes]:
+        return None
+
+
 PROVIDERS = {
     "local": LocalNodeProvider,
     "gce_tpu": GCETpuProvider,          # gcloud-argv shaped (dry-run-able)
     "gce_tpu_api": _gce_queued,         # Cloud TPU v2 REST queuedResources
     "cloud_api": CloudAPIProvider,
     "kuberay": _kuberay,                # RayCluster-CR patching (operator)
+    "aws": AwsNodeProvider,             # aws-CLI argv (dry-run-able)
+    "azure": AzureNodeProvider,         # az-CLI argv (dry-run-able)
 }
 
 

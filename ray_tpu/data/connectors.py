@@ -284,7 +284,9 @@ class BigQueryDatasource(Datasource):
             return [read_all]
 
         client = bq.Client(project=project_id)
-        dest = client.query(query).result().destination  # materialized
+        job = client.query(query)
+        job.result()                     # wait for materialization
+        dest = job.destination           # QueryJob attr, not RowIterator's
         session = bigquery_storage.BigQueryReadClient().create_read_session(
             parent=f"projects/{project_id}",
             read_session={

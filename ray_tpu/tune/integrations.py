@@ -153,3 +153,127 @@ class HyperOptSearch(Searcher):
                 doc["result"] = {"loss": loss, "status": self._hpo.STATUS_OK}
                 doc["state"] = self._hpo.JOB_STATE_DONE
         self.trials.refresh()
+
+
+class AxSearch(Searcher):
+    """Ax (Adaptive Experimentation) adapter (reference:
+    tune/search/ax/ax_search.py). Bayesian optimization through
+    AxClient's attach/complete trial interface; the translated space
+    keeps true ranges + log scaling."""
+
+    def __init__(self, param_space: Dict, metric: str, mode: str = "max",
+                 *, seed: Optional[int] = None):
+        try:
+            from ax.service.ax_client import AxClient
+            from ax.service.utils.instantiation import ObjectiveProperties
+        except ImportError as e:
+            raise _missing("ax-platform", "ax-platform") from e
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.space = param_space
+        params = []
+        for name, dom in param_space.items():
+            if isinstance(dom, (Uniform, LogUniform)):
+                params.append({"name": name, "type": "range",
+                               "bounds": [dom.low, dom.high],
+                               "log_scale": isinstance(dom, LogUniform)})
+            elif isinstance(dom, RandInt):
+                params.append({"name": name, "type": "range",
+                               "bounds": [dom.low, dom.high - 1],
+                               "value_type": "int"})
+            elif isinstance(dom, (Categorical, GridSearch)):
+                values = (dom.categories if isinstance(dom, Categorical)
+                          else dom.values)
+                params.append({"name": name, "type": "choice",
+                               "values": list(values)})
+            elif isinstance(dom, Domain):
+                raise ValueError(
+                    f"unsupported domain {type(dom).__name__}")
+            else:
+                params.append({"name": name, "type": "fixed",
+                               "value": dom})
+        self.client = AxClient(random_seed=seed, verbose_logging=False)
+        self.client.create_experiment(
+            name="ray_tpu_tune", parameters=params,
+            objectives={metric: ObjectiveProperties(
+                minimize=mode == "min")})
+        self._trials: Dict[str, int] = {}
+
+    def suggest(self, trial_id: str) -> Dict:
+        cfg, ax_idx = self.client.get_next_trial()
+        self._trials[trial_id] = ax_idx
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict]):
+        ax_idx = self._trials.pop(trial_id, None)
+        if ax_idx is None:
+            return
+        value = (result or {}).get(self.metric)
+        if value is None:
+            self.client.log_trial_failure(ax_idx)
+        else:
+            self.client.complete_trial(
+                ax_idx, raw_data={self.metric: float(value)})
+
+
+class HEBOSearch(Searcher):
+    """HEBO adapter (reference: tune/search/hebo/hebo_search.py).
+    Heteroscedastic-BO through HEBO's suggest/observe dataframe
+    interface."""
+
+    def __init__(self, param_space: Dict, metric: str, mode: str = "max",
+                 *, seed: Optional[int] = None):
+        try:
+            from hebo.design_space.design_space import DesignSpace
+            from hebo.optimizers.hebo import HEBO
+        except ImportError as e:
+            raise _missing("HEBO", "HEBO") from e
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self._constants: Dict[str, object] = {}
+        specs = []
+        for name, dom in param_space.items():
+            if isinstance(dom, LogUniform):
+                specs.append({"name": name, "type": "pow",
+                              "lb": dom.low, "ub": dom.high})
+            elif isinstance(dom, Uniform):
+                specs.append({"name": name, "type": "num",
+                              "lb": dom.low, "ub": dom.high})
+            elif isinstance(dom, RandInt):
+                specs.append({"name": name, "type": "int",
+                              "lb": dom.low, "ub": dom.high - 1})
+            elif isinstance(dom, (Categorical, GridSearch)):
+                values = (dom.categories if isinstance(dom, Categorical)
+                          else dom.values)
+                specs.append({"name": name, "type": "cat",
+                              "categories": list(values)})
+            elif isinstance(dom, Domain):
+                raise ValueError(
+                    f"unsupported domain {type(dom).__name__}")
+            else:
+                # Constants pass through to every config (like the other
+                # adapters), not into HEBO's design space.
+                self._constants[name] = dom
+        self.opt = HEBO(DesignSpace().parse_specs(specs),
+                        rand_sample=4, scramble_seed=seed)
+        self._pending: Dict[str, object] = {}
+
+    def suggest(self, trial_id: str) -> Dict:
+        rec = self.opt.suggest(n_suggestions=1)
+        self._pending[trial_id] = rec
+        cfg = {k: rec[k].iloc[0] for k in rec.columns}
+        cfg.update(self._constants)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict]):
+        import numpy as np
+
+        rec = self._pending.pop(trial_id, None)
+        if rec is None:
+            return
+        value = (result or {}).get(self.metric)
+        if value is None:
+            return  # HEBO has no failure notion; drop the observation
+        y = -float(value) if self.mode == "max" else float(value)
+        self.opt.observe(rec, np.array([[y]]))

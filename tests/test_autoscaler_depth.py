@@ -114,3 +114,63 @@ def test_monitor_reattaches_from_storage(tmp_path, monkeypatch):
     assert store2.load() == []                    # deletion persisted
     assert result["launched"] == 0
     store2.close()
+
+
+def test_aws_provider_dry_run():
+    """AWS EC2 provider: recorded run/terminate commands carry cluster +
+    node-type tags (reference: autoscaler/_private/aws/node_provider.py)."""
+    from ray_tpu.autoscaler.providers import AwsNodeProvider
+
+    runner = CommandRunner(dry_run=True)
+    provider = AwsNodeProvider("us-west-2", "myclust",
+                               subnet_id="subnet-1", runner=runner)
+    t = InstanceType(name="cpu4", resources={"CPU": 4.0})
+    iid = provider.launch(t)
+    assert iid.startswith("i-")
+    assert provider.non_terminated() == [iid]
+    launch_cmd = runner.history[0]
+    assert "aws ec2 run-instances" in launch_cmd
+    assert "--region us-west-2" in launch_cmd
+    assert "m5.xlarge" in launch_cmd          # CPU=4 -> m5.xlarge
+    assert "Key=ray-tpu-cluster,Value=myclust" in launch_cmd
+    assert "Key=ray-tpu-node-type,Value=cpu4" in launch_cmd
+    assert "--subnet-id subnet-1" in launch_cmd
+    provider.terminate(iid)
+    assert provider.non_terminated() == []
+    assert f"aws ec2 terminate-instances --region us-west-2 " \
+           f"--instance-ids {iid}" in runner.history[1]
+    provider.terminate(iid)                   # idempotent: no new command
+    assert len(runner.history) == 2
+
+
+def test_azure_provider_dry_run():
+    """Azure VM provider: recorded create/delete with cluster tags
+    (reference: autoscaler/_private/_azure/node_provider.py)."""
+    from ray_tpu.autoscaler.providers import AzureNodeProvider
+
+    runner = CommandRunner(dry_run=True)
+    provider = AzureNodeProvider("rg1", "westus2", "myclust",
+                                 runner=runner)
+    t = InstanceType(name="cpu8", resources={"CPU": 8.0})
+    name = provider.launch(t)
+    assert name.startswith("ray-tpu-")
+    launch_cmd = runner.history[0]
+    assert "az vm create" in launch_cmd
+    assert "--resource-group rg1" in launch_cmd
+    assert "Standard_D8s_v5" in launch_cmd    # CPU=8 -> D8s
+    assert "ray-tpu-cluster=myclust" in launch_cmd
+    provider.terminate(name)
+    assert f"az vm delete --name {name} --resource-group rg1 --yes" \
+        in runner.history[1]
+    assert provider.non_terminated() == []
+
+
+def test_aws_azure_in_provider_registry():
+    from ray_tpu.autoscaler.providers import (AwsNodeProvider,
+                                              AzureNodeProvider,
+                                              get_provider)
+
+    p = get_provider("aws", region="us-east-1")
+    assert isinstance(p, AwsNodeProvider)
+    p2 = get_provider("azure", resource_group="rg", location="eastus")
+    assert isinstance(p2, AzureNodeProvider)

@@ -344,13 +344,16 @@ def test_read_bigquery_parallelism_one_task_per_stream(monkeypatch):
             return types.SimpleNamespace(rows=lambda: rows)
 
     class _FakeQueryJob:
+        # Faithful to google-cloud-bigquery: `destination` lives on the
+        # QueryJob; result() returns a RowIterator WITHOUT it.
+        destination = types.SimpleNamespace(project="p", dataset_id="d",
+                                            table_id="t")
+
         def to_arrow(self):
             return full
 
         def result(self):
-            dest = types.SimpleNamespace(project="p", dataset_id="d",
-                                         table_id="t")
-            return types.SimpleNamespace(destination=dest)
+            return iter(())
 
     fake_bq = types.SimpleNamespace(
         Client=lambda project: types.SimpleNamespace(

@@ -1,0 +1,147 @@
+"""Ray-on-Spark cluster bootstrap (util/spark.py).
+
+Reference analog: python/ray/util/spark/cluster_init.py
+setup_ray_cluster / shutdown_ray_cluster. Driven by an in-process fake
+SparkSession (the FakeKubeApi pattern): the fake implements exactly the
+Spark surface the bootstrap uses — parallelize(...).barrier()
+.mapPartitions(...).collect() plus job groups — and runs each barrier
+partition in a thread, so REAL raylet worker nodes boot, register with a
+REAL GCS, and execute REAL tasks. No pyspark required.
+"""
+
+import threading
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import spark as spark_mod
+
+
+class _FakeBarrierRDD:
+    def __init__(self, sc, items, n_partitions):
+        self.sc = sc
+        self.items = list(items)
+        self.n = n_partitions
+
+    def barrier(self):
+        self.sc.barrier_calls += 1
+        return self
+
+    def mapPartitions(self, fn):  # noqa: N802 (Spark API surface)
+        self._fn = fn
+        return self
+
+    def collect(self):
+        # Barrier semantics: every partition runs CONCURRENTLY (real
+        # barrier mode gang-schedules); collect blocks until all finish.
+        results = [None] * self.n
+        errors = []
+
+        def run(i):
+            try:
+                results[i] = list(self._fn(iter([i])))
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                   for i in range(self.n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return [r for part in results if part for r in part]
+
+
+class _FakeSparkContext:
+    def __init__(self, default_parallelism=2):
+        self.defaultParallelism = default_parallelism
+        self.job_groups = []
+        self.cancelled_groups = []
+        self.barrier_calls = 0
+
+    def setJobGroup(self, group, desc):  # noqa: N802
+        self.job_groups.append((group, desc))
+
+    def cancelJobGroup(self, group):  # noqa: N802
+        self.cancelled_groups.append(group)
+
+    def parallelize(self, items, n_partitions):
+        return _FakeBarrierRDD(self, items, n_partitions)
+
+
+class _FakeSparkSession:
+    def __init__(self, default_parallelism=2):
+        self.sparkContext = _FakeSparkContext(default_parallelism)
+
+
+@pytest.fixture
+def no_cluster():
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    yield
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+
+
+def test_setup_ray_cluster_on_spark(no_cluster):
+    spark = _FakeSparkSession()
+    address, handle = spark_mod.setup_ray_cluster(
+        spark=spark, max_worker_nodes=2, num_cpus_worker_node=1,
+        timeout_s=120)
+    try:
+        assert spark.sparkContext.barrier_calls == 1  # gang-scheduled
+        ray_tpu.init(address=address)
+        nodes = [n for n in ray_tpu.nodes() if n["alive"]]
+        heads = [n for n in nodes if n["is_head"]]
+        workers = [n for n in nodes if not n["is_head"]]
+        assert len(workers) == 2
+        # 0-CPU head: no work schedules onto the Spark driver host.
+        assert heads and heads[0]["resources"].get("CPU", 0) == 0
+
+        @ray_tpu.remote
+        def where():
+            import os
+
+            return os.getpid()
+
+        pids = ray_tpu.get([where.remote() for _ in range(4)], timeout=120)
+        assert len(set(pids)) >= 1  # executed on spark-hosted workers
+        ray_tpu.shutdown()
+    finally:
+        handle.shutdown()
+    # Teardown: job group cancelled, head dead, workers self-reap (their
+    # babysit loop sees the GCS gone), and the barrier thread exits.
+    assert spark.sparkContext.cancelled_groups == [handle._job_group]
+    assert not handle._job_thread.is_alive() or (
+        handle._job_thread.join(timeout=30) or
+        not handle._job_thread.is_alive())
+
+
+def test_max_num_worker_nodes_sentinel(no_cluster):
+    spark = _FakeSparkSession(default_parallelism=1)
+    address, handle = spark_mod.setup_ray_cluster(
+        spark=spark, max_worker_nodes=spark_mod.MAX_NUM_WORKER_NODES,
+        timeout_s=120)
+    try:
+        assert handle.num_workers == 1  # sized to defaultParallelism
+    finally:
+        handle.shutdown()
+
+
+def test_double_setup_refused(no_cluster):
+    spark = _FakeSparkSession()
+    address, handle = spark_mod.setup_ray_cluster(
+        spark=spark, max_worker_nodes=1, timeout_s=120)
+    try:
+        with pytest.raises(RuntimeError, match="already running"):
+            spark_mod.setup_ray_cluster(spark=spark, max_worker_nodes=1)
+    finally:
+        spark_mod.shutdown_ray_cluster()
+    with pytest.raises(RuntimeError, match="no ray_tpu cluster"):
+        spark_mod.shutdown_ray_cluster()

@@ -402,3 +402,138 @@ def test_bohb_with_tuner_and_hyperband():
     grid = tuner.fit()
     assert len(grid) == 6
     assert grid.get_best_result().metrics["score"] <= 0.0
+
+
+def test_ax_hebo_adapters_raise_helpfully_when_missing():
+    import importlib.util
+
+    from ray_tpu.tune import search as search_mod
+    from ray_tpu.tune.integrations import AxSearch, HEBOSearch
+
+    space = {"lr": search_mod.LogUniform(1e-4, 1e-1)}
+    if importlib.util.find_spec("ax") is None:
+        with pytest.raises(ImportError, match="TPESearcher"):
+            AxSearch(space, metric="score")
+    if importlib.util.find_spec("hebo") is None:
+        with pytest.raises(ImportError, match="TPESearcher"):
+            HEBOSearch(space, metric="score")
+
+
+def test_ax_adapter_protocol_with_fake(monkeypatch):
+    """Ax adapter against a minimal fake AxClient: domains translate to
+    range/choice/fixed parameter specs; completions report raw_data."""
+    import sys
+    import types
+
+    created = {}
+
+    class FakeAxClient:
+        def __init__(self, random_seed=None, verbose_logging=False):
+            self._n = 0
+            self.completed = []
+            self.failed = []
+
+        def create_experiment(self, name, parameters, objectives):
+            created["parameters"] = parameters
+            created["objectives"] = objectives
+
+        def get_next_trial(self):
+            self._n += 1
+            cfg = {}
+            for p in created["parameters"]:
+                if p["type"] == "range":
+                    cfg[p["name"]] = p["bounds"][0]
+                elif p["type"] == "choice":
+                    cfg[p["name"]] = p["values"][0]
+                else:
+                    cfg[p["name"]] = p["value"]
+            return cfg, self._n
+
+        def complete_trial(self, idx, raw_data):
+            self.completed.append((idx, raw_data))
+
+        def log_trial_failure(self, idx):
+            self.failed.append(idx)
+
+    mod_client = types.ModuleType("ax.service.ax_client")
+    mod_client.AxClient = FakeAxClient
+    mod_inst = types.ModuleType("ax.service.utils.instantiation")
+    mod_inst.ObjectiveProperties = (
+        lambda minimize: {"minimize": minimize})
+    for name, mod in (("ax", types.ModuleType("ax")),
+                      ("ax.service", types.ModuleType("ax.service")),
+                      ("ax.service.ax_client", mod_client),
+                      ("ax.service.utils",
+                       types.ModuleType("ax.service.utils")),
+                      ("ax.service.utils.instantiation", mod_inst)):
+        monkeypatch.setitem(sys.modules, name, mod)
+
+    from ray_tpu.tune import search as search_mod
+    from ray_tpu.tune.integrations import AxSearch
+
+    s = AxSearch({"lr": search_mod.LogUniform(1e-4, 1e-1),
+                  "layers": search_mod.RandInt(1, 5),
+                  "act": search_mod.Categorical(["relu", "tanh"])},
+                 metric="score", mode="max")
+    by_name = {p["name"]: p for p in created["parameters"]}
+    assert by_name["lr"]["log_scale"] is True
+    assert by_name["layers"]["bounds"] == [1, 4]  # tune high is exclusive
+    assert created["objectives"]["score"]["minimize"] is False
+    cfg = s.suggest("t1")
+    assert cfg["act"] == "relu"
+    s.on_trial_complete("t1", {"score": 0.5})
+    assert s.client.completed == [(1, {"score": 0.5})]
+    s.suggest("t2")
+    s.on_trial_complete("t2", None)  # errored trial -> failure, not tell
+    assert s.client.failed == [2]
+
+
+def test_hebo_adapter_protocol_with_fake(monkeypatch):
+    """HEBO adapter against a fake suggest/observe optimizer: mode=max
+    negates y (HEBO minimizes)."""
+    import sys
+    import types
+
+    import numpy as np
+    import pandas as pd
+
+    observed = []
+
+    class FakeHEBO:
+        def __init__(self, space, rand_sample=None, scramble_seed=None):
+            self.space = space
+
+        def suggest(self, n_suggestions=1):
+            return pd.DataFrame({"x": [0.25]})
+
+        def observe(self, rec, y):
+            observed.append((rec, y))
+
+    class FakeDesignSpace:
+        def parse_specs(self, specs):
+            self.specs = specs
+            return self
+
+    mod_ds = types.ModuleType("hebo.design_space.design_space")
+    mod_ds.DesignSpace = FakeDesignSpace
+    mod_opt = types.ModuleType("hebo.optimizers.hebo")
+    mod_opt.HEBO = FakeHEBO
+    for name, mod in (("hebo", types.ModuleType("hebo")),
+                      ("hebo.design_space",
+                       types.ModuleType("hebo.design_space")),
+                      ("hebo.design_space.design_space", mod_ds),
+                      ("hebo.optimizers",
+                       types.ModuleType("hebo.optimizers")),
+                      ("hebo.optimizers.hebo", mod_opt)):
+        monkeypatch.setitem(sys.modules, name, mod)
+
+    from ray_tpu.tune import search as search_mod
+    from ray_tpu.tune.integrations import HEBOSearch
+
+    s = HEBOSearch({"x": search_mod.Uniform(0.0, 1.0)},
+                   metric="score", mode="max")
+    cfg = s.suggest("t1")
+    assert cfg == {"x": 0.25}
+    s.on_trial_complete("t1", {"score": 0.8})
+    assert len(observed) == 1
+    np.testing.assert_allclose(observed[0][1], [[-0.8]])  # max -> negate
