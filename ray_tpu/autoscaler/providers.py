@@ -260,7 +260,33 @@ def _kuberay(**kwargs):
     return KubeRayProvider(**kwargs)
 
 
-class AwsNodeProvider(NodeProvider):
+class _CliNodeProvider(NodeProvider):
+    """Shared skeleton for CLI-argv cloud providers (AWS/Azure): launch
+    builds the create command and registers the instance; terminate /
+    listing / liveness are identical — a booted VM's raylet registers
+    itself with the GCS, so get_node_id is always None here."""
+
+    def __init__(self, runner: Optional[CommandRunner] = None):
+        self.runner = runner or CommandRunner(dry_run=True)
+        self._live: Dict[str, InstanceType] = {}
+
+    def _terminate_cmd(self, instance_id: str) -> List[str]:
+        raise NotImplementedError
+
+    def terminate(self, instance_id: str) -> None:
+        if instance_id not in self._live:
+            return
+        del self._live[instance_id]
+        self.runner.run(self._terminate_cmd(instance_id), timeout=1800)
+
+    def non_terminated(self) -> List[str]:
+        return list(self._live)
+
+    def get_node_id(self, instance_id: str) -> Optional[bytes]:
+        return None
+
+
+class AwsNodeProvider(_CliNodeProvider):
     """EC2 provider via aws-CLI argv (dry-run-able like GCETpuProvider).
 
     Reference analog: autoscaler/_private/aws/node_provider.py — the same
@@ -275,14 +301,13 @@ class AwsNodeProvider(NodeProvider):
                  subnet_id: str = "", key_name: str = "",
                  user_data: str = "",
                  runner: Optional[CommandRunner] = None):
+        super().__init__(runner)
         self.region = region
         self.cluster_name = cluster_name
         self.ami = ami
         self.subnet_id = subnet_id
         self.key_name = key_name
         self.user_data = user_data
-        self.runner = runner or CommandRunner(dry_run=True)
-        self._live: Dict[str, InstanceType] = {}
 
     @staticmethod
     def _ec2_type(instance_type: InstanceType) -> str:
@@ -297,7 +322,11 @@ class AwsNodeProvider(NodeProvider):
         tags = (f"ResourceType=instance,Tags=["
                 f"{{Key=ray-tpu-cluster,Value={self.cluster_name}}},"
                 f"{{Key=ray-tpu-node-type,Value={instance_type.name}}}]")
+        # --output json: the id parse below must not depend on the
+        # operator's aws-CLI output config (text/table/yaml would leak
+        # the booted VM as unparseable-but-created).
         cmd = ["aws", "ec2", "run-instances", "--region", self.region,
+               "--output", "json",
                "--image-id", self.ami,
                "--instance-type", self._ec2_type(instance_type),
                "--count", "1", "--tag-specifications", tags]
@@ -327,22 +356,12 @@ class AwsNodeProvider(NodeProvider):
         self._live[iid] = instance_type
         return iid
 
-    def terminate(self, instance_id: str) -> None:
-        if instance_id not in self._live:
-            return
-        del self._live[instance_id]
-        self.runner.run(["aws", "ec2", "terminate-instances", "--region",
-                         self.region, "--instance-ids", instance_id],
-                        timeout=600)
-
-    def non_terminated(self) -> List[str]:
-        return list(self._live)
-
-    def get_node_id(self, instance_id: str) -> Optional[bytes]:
-        return None  # a booted VM's raylet registers itself with the GCS
+    def _terminate_cmd(self, instance_id: str) -> List[str]:
+        return ["aws", "ec2", "terminate-instances", "--region",
+                self.region, "--instance-ids", instance_id]
 
 
-class AzureNodeProvider(NodeProvider):
+class AzureNodeProvider(_CliNodeProvider):
     """Azure VM provider via az-CLI argv (dry-run-able).
 
     Reference analog: autoscaler/_private/_azure/node_provider.py — VMs
@@ -354,14 +373,13 @@ class AzureNodeProvider(NodeProvider):
                  image: str = "Ubuntu2204", vm_size: str = "",
                  custom_data: str = "",
                  runner: Optional[CommandRunner] = None):
+        super().__init__(runner)
         self.resource_group = resource_group
         self.location = location
         self.cluster_name = cluster_name
         self.image = image
         self.vm_size = vm_size
         self.custom_data = custom_data
-        self.runner = runner or CommandRunner(dry_run=True)
-        self._live: Dict[str, InstanceType] = {}
 
     @staticmethod
     def _az_size(instance_type: InstanceType) -> str:
@@ -385,19 +403,9 @@ class AzureNodeProvider(NodeProvider):
         self._live[name] = instance_type
         return name
 
-    def terminate(self, instance_id: str) -> None:
-        if instance_id not in self._live:
-            return
-        del self._live[instance_id]
-        self.runner.run(["az", "vm", "delete", "--name", instance_id,
-                         "--resource-group", self.resource_group,
-                         "--yes"], timeout=1800)
-
-    def non_terminated(self) -> List[str]:
-        return list(self._live)
-
-    def get_node_id(self, instance_id: str) -> Optional[bytes]:
-        return None
+    def _terminate_cmd(self, instance_id: str) -> List[str]:
+        return ["az", "vm", "delete", "--name", instance_id,
+                "--resource-group", self.resource_group, "--yes"]
 
 
 PROVIDERS = {

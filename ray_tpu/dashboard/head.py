@@ -227,6 +227,7 @@ class DashboardHead:
             web.get("/api/cluster_resources", self.cluster_resources),
             web.get("/api/serve", self.serve_deployments),
             web.get("/api/tasks", self.tasks),
+            web.get("/api/tasks/{task_id}", self.task_detail),
             web.get("/metrics", self.metrics),
             web.post("/api/jobs/", self.job_submit),
             web.get("/api/jobs/", self.job_list),
@@ -271,6 +272,12 @@ class DashboardHead:
         return _json(await self.gcs.call(
             "list_tasks", state=request.query.get("state"),
             name=request.query.get("name"), limit=limit))
+
+    async def task_detail(self, request):
+        """Task drill-through: full state-transition history of one task
+        (reference: the dashboard's task page)."""
+        return _json(await self.gcs.call(
+            "get_task", task_id_hex=request.match_info["task_id"]))
 
     async def version(self, request):
         import ray_tpu

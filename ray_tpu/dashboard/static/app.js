@@ -282,7 +282,9 @@ function renderTasks() {
   <section class="wide"><h2>Recent tasks</h2>${rows(
     ["task", "name", "state", "actor", "node"],
     snapshot.tasks.slice(0, 200), (t) => [
-      short(t.task_id), esc(t.name || ""), state(t.state),
+      `<a class="drill linklike" data-kind="tasks" ` +
+      `data-id="${esc(String(t.task_id))}">${short(t.task_id)}</a>`,
+      esc(t.name || ""), state(t.state),
       `<code>${t.actor_id ? short(t.actor_id) : ""}</code>`,
       `<code>${t.node_id ? short(t.node_id) : ""}</code>`])}</section>`;
 }

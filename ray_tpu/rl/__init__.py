@@ -9,7 +9,11 @@ from ray_tpu.rl.connectors import (  # noqa: F401
 from ray_tpu.rl.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rl.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rl.dreamerv3 import DreamerV3, DreamerV3Config  # noqa: F401
-from ray_tpu.rl.env import VectorCartPole, make_env  # noqa: F401
+from ray_tpu.rl.env import (  # noqa: F401
+    VectorCartPole,
+    VectorPendulum,
+    make_env,
+)
 from ray_tpu.rl.impala import IMPALA, ImpalaConfig  # noqa: F401
 from ray_tpu.rl.ppo import PPOConfig  # noqa: F401
 from ray_tpu.rl.replay_buffer import (  # noqa: F401
@@ -25,4 +29,5 @@ from ray_tpu.rl.offline import (  # noqa: F401
     read_episodes,
 )
 from ray_tpu.rl.sac import SAC, SACConfig  # noqa: F401
+from ray_tpu.rl.td3 import TD3, TD3Config  # noqa: F401
 from ray_tpu.rl.tune_integration import as_trainable, register_algorithm  # noqa: F401

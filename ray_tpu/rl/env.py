@@ -71,7 +71,67 @@ class VectorCartPole:
         return self.state.copy()
 
 
-ENVS = {"CartPole-v1": VectorCartPole}
+class VectorPendulum:
+    """Classic Pendulum-v1 dynamics, vectorized, numpy only: CONTINUOUS
+    torque in [-max_torque, max_torque], obs (cos th, sin th, th_dot),
+    fixed 200-step episodes (no early termination) — the standard smoke
+    test for continuous-control algorithms (TD3/DDPG/continuous SAC)."""
+
+    obs_dim = 3
+    action_dim = 1
+    max_torque = 2.0
+    max_steps = 200
+    # Every done is a TIME-LIMIT truncation, not a terminal state:
+    # off-policy learners must keep bootstrapping through it (Pardo 2018
+    # time-limit handling; the original TD3 code zeroes done at limits).
+    all_dones_are_truncations = True
+
+    def __init__(self, n_envs: int, seed: int = 0):
+        self.n = n_envs
+        self.rng = np.random.default_rng(seed)
+        self.th = np.zeros(n_envs, dtype=np.float32)
+        self.th_dot = np.zeros(n_envs, dtype=np.float32)
+        self.steps = np.zeros(n_envs, dtype=np.int64)
+        self.reset()
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self.th), np.sin(self.th), self.th_dot],
+                        axis=1).astype(np.float32)
+
+    def reset(self) -> np.ndarray:
+        self.th = self.rng.uniform(-np.pi, np.pi, self.n).astype(np.float32)
+        self.th_dot = self.rng.uniform(-1, 1, self.n).astype(np.float32)
+        self.steps[:] = 0
+        return self._obs()
+
+    def step(self, actions: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        g, m, length, dt = 10.0, 1.0, 1.0, 0.05
+        u = np.clip(np.asarray(actions, dtype=np.float32).reshape(self.n),
+                    -self.max_torque, self.max_torque)
+        th_norm = ((self.th + np.pi) % (2 * np.pi)) - np.pi
+        reward = -(th_norm ** 2 + 0.1 * self.th_dot ** 2
+                   + 0.001 * u ** 2).astype(np.float32)
+        th_dot = self.th_dot + (3 * g / (2 * length) * np.sin(self.th)
+                                + 3.0 / (m * length ** 2) * u) * dt
+        th_dot = np.clip(th_dot, -8.0, 8.0)
+        self.th = (self.th + th_dot * dt).astype(np.float32)
+        self.th_dot = th_dot.astype(np.float32)
+        self.steps += 1
+        done = self.steps >= self.max_steps
+        obs = self._obs()  # TRUE next state
+        if done.any():
+            k = int(done.sum())
+            self.th[done] = self.rng.uniform(-np.pi, np.pi, k)
+            self.th_dot[done] = self.rng.uniform(-1, 1, k)
+            self.steps[done] = 0
+        return obs, reward, done
+
+    def current_obs(self) -> np.ndarray:
+        return self._obs()
+
+
+ENVS = {"CartPole-v1": VectorCartPole, "Pendulum-v1": VectorPendulum}
 
 
 def make_env(name: str, n_envs: int, seed: int = 0):

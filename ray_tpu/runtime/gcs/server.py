@@ -655,6 +655,23 @@ class GcsServer:
                 break
         return out
 
+    async def handle_get_task(self, conn, task_id_hex: str):
+        """Per-task drill-through: the FULL transition history of one task
+        (every recorded state event, oldest first), matched by hex id or
+        unambiguous prefix — the dashboard task page's data source."""
+        def _hex(tid):
+            return tid.hex() if isinstance(tid, bytes) else str(tid)
+
+        store = getattr(self, "_task_events", None) or []
+        events = [ev for ev in store
+                  if _hex(ev["task_id"]).startswith(task_id_hex)]
+        ids = {_hex(ev["task_id"]) for ev in events}
+        if len(ids) > 1:
+            return {"error": f"ambiguous task id prefix {task_id_hex!r} "
+                             f"({len(ids)} matches)"}
+        return {"found": bool(events),
+                "events": sorted(events, key=lambda e: e["time"])}
+
     async def handle_task_timeline(self, conn, limit: int = 2000):
         """Full state-transition log (not just latest-per-task): the
         dashboard timeline pairs RUNNING->FINISHED/FAILED per task into

@@ -224,3 +224,35 @@ def test_dashboard_worker_log_viewer(cluster_with_dashboard):
     with urllib.request.urlopen(url + "/static/app.js", timeout=30) as r:
         appjs = r.read()
     assert b"/api/logs" in appjs and b"renderLogs" in appjs
+
+
+def test_dashboard_task_drill_through(cluster_with_dashboard):
+    """Per-task drill-through: /api/tasks/{id} returns the task's full
+    state-transition history (reference: the dashboard's task page)."""
+    import time
+
+    url = cluster_with_dashboard
+
+    @ray_tpu.remote
+    def probe_task():
+        return 7
+
+    assert ray_tpu.get(probe_task.remote(), timeout=60) == 7
+    deadline = time.time() + 30
+    task_id = None
+    while time.time() < deadline and task_id is None:
+        tasks = _get_json(url + "/api/tasks?name=probe_task")
+        for t in tasks:
+            if t["state"] == "FINISHED":
+                task_id = t["task_id"]
+        if task_id is None:
+            time.sleep(0.3)
+    assert task_id, "probe task never reported FINISHED"
+    detail = _get_json(f"{url}/api/tasks/{task_id}")
+    assert detail["found"]
+    states = [e["state"] for e in detail["events"]]
+    assert "FINISHED" in states
+    times = [e["time"] for e in detail["events"]]
+    assert times == sorted(times)  # chronological
+    # Unknown id: found=False, no crash.
+    assert _get_json(url + "/api/tasks/ffffffffffff")["found"] is False

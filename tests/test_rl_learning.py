@@ -207,3 +207,30 @@ def test_multi_agent_shared_policy_learning():
     for _ in range(35):
         last = algo.train()
     assert last["episode_return_mean"] > max(first, -1.0) + 0.3
+
+
+def test_td3_improves_pendulum(cluster):
+    """TD3 (continuous control) lifts Pendulum return far above the
+    random-policy baseline (~-1400) within a bounded budget
+    (rllib/algorithms/td3 analog; Fujimoto 2018 fixes are all on the
+    jitted update path)."""
+    from ray_tpu.rl import TD3, TD3Config
+
+    algo = TD3(TD3Config(num_env_runners=2, envs_per_runner=4,
+                         rollout_length=64))
+    try:
+        history = []
+        for _ in range(40):
+            r = algo.train()
+            if r["episode_return_mean"]:
+                history.append(r["episode_return_mean"])
+        early = float(np.mean(history[:3]))
+        late = _mean_tail(history)
+        # `early` is measured after ~768 warm-start updates and can
+        # already be above random on a fast seed — anchor the improvement
+        # bar at the random-policy level (~-1400) so fast early learning
+        # can't fail the relative check.
+        assert late > min(early, -1100) + 300, (early, late, history)
+        assert late > -950, (late, history)  # random policy: ~-1400
+    finally:
+        algo.stop()
