@@ -29,5 +29,9 @@ from ray_tpu.rl.offline import (  # noqa: F401
     read_episodes,
 )
 from ray_tpu.rl.sac import SAC, SACConfig  # noqa: F401
+from ray_tpu.rl.sac_continuous import (  # noqa: F401
+    SACContinuous,
+    SACContinuousConfig,
+)
 from ray_tpu.rl.td3 import TD3, TD3Config  # noqa: F401
 from ray_tpu.rl.tune_integration import as_trainable, register_algorithm  # noqa: F401
