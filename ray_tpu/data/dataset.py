@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import itertools
 from builtins import range as _range
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -104,6 +104,32 @@ class Dataset:
                 leftover = acc.slice(start, n)
         if leftover is not None and leftover.num_rows and not drop_last:
             yield self._format(leftover, batch_format)
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device: str = "cpu",
+                           drop_last: bool = False) -> Iterator[Dict]:
+        """numpy batches converted to torch tensors
+        (Dataset.iter_torch_batches analog). Non-numeric columns pass
+        through unconverted."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                try:
+                    t = torch.as_tensor(v, device=device)
+                except (TypeError, RuntimeError):
+                    out[k] = v  # object/string columns stay numpy
+                    continue
+                if dtypes is not None:
+                    want = (dtypes.get(k) if isinstance(dtypes, dict)
+                            else dtypes)
+                    if want is not None:
+                        t = t.to(want)
+                out[k] = t
+            yield out
 
     def iter_rows(self) -> Iterator[Dict]:
         for block in self.iter_blocks():
@@ -226,6 +252,80 @@ class Dataset:
                 count += b.num_rows
         return total / max(count, 1)
 
+    def std(self, on: str, ddof: int = 1):
+        """One-pass stddev, SHIFTED by the first value seen: the naive
+        sum/sumsq formula catastrophically cancels when |mean| >> spread
+        (Dataset.std analog)."""
+        import math
+
+        shift = None
+        total, sq, count = 0.0, 0.0, 0
+        for b in self.iter_blocks():
+            if b.num_rows:
+                col = BlockAccessor(b).to_batch()[on].astype("float64")
+                if shift is None:
+                    shift = float(col[0])
+                col = col - shift
+                total += float(col.sum())
+                sq += float((col * col).sum())
+                count += b.num_rows
+        if count <= ddof:
+            return 0.0
+        var = (sq - total * total / count) / (count - ddof)
+        return math.sqrt(max(var, 0.0))
+
+    def unique(self, on: str) -> List[Any]:
+        """Distinct values of one column, first-seen order — unsorted,
+        so None/mixed-type columns don't raise (Dataset.unique analog)."""
+        seen: Dict[Any, None] = {}
+        for b in self.iter_blocks():
+            if b.num_rows:
+                for v in BlockAccessor(b).to_batch()[on].tolist():
+                    seen.setdefault(v)
+        return list(seen)
+
+    def aggregate(self, **named_aggs: Tuple[str, str]):
+        """Multi-aggregate in one pass: aggregate(total=("v", "sum"),
+        hi=("v", "max")) -> {"total": ..., "hi": ...}
+        (Dataset.aggregate(AggregateFn...) analog, column/op pairs)."""
+        ops = {"sum", "min", "max", "mean", "count"}
+        for name, (col, op) in named_aggs.items():
+            if op not in ops:
+                raise ValueError(f"{name}: unknown aggregate {op!r} "
+                                 f"(one of {sorted(ops)})")
+        # Pre-seed identities so an EMPTY dataset still returns every
+        # requested key (count 0, sum 0.0, min/max/mean None).
+        acc: Dict[str, Any] = {
+            name: (0 if op == "count" else 0.0 if op in ("sum", "mean")
+                   else None)
+            for name, (_c, op) in named_aggs.items()}
+        counts: Dict[str, int] = {}
+        for b in self.iter_blocks():
+            if not b.num_rows:
+                continue
+            batch = BlockAccessor(b).to_batch()
+            for name, (col, op) in named_aggs.items():
+                if op == "count":
+                    acc[name] += b.num_rows
+                    continue
+                v = batch[col]
+                if op in ("sum", "mean"):
+                    acc[name] += float(v.sum())
+                    counts[name] = counts.get(name, 0) + b.num_rows
+                elif op == "min":
+                    val = float(v.min())
+                    acc[name] = (val if acc[name] is None
+                                 else min(acc[name], val))
+                elif op == "max":
+                    val = float(v.max())
+                    acc[name] = (val if acc[name] is None
+                                 else max(acc[name], val))
+        for name, (col, op) in named_aggs.items():
+            if op == "mean":
+                n = counts.get(name, 0)
+                acc[name] = acc[name] / n if n else None
+        return acc
+
     # ---- writes (datasource write path) ----------------------------------
 
     def _write(self, path: str, writer_name: str) -> List[str]:
@@ -288,6 +388,47 @@ class Dataset:
         for i, b in enumerate(blocks):
             shards[i % n].append(b)
         return [MaterializedDataset(s, self._parallelism) for s in shards]
+
+    def split_at_indices(self, indices: List[int]
+                         ) -> List["MaterializedDataset"]:
+        """Split at ROW indices (Dataset.split_at_indices analog):
+        [3, 7] -> rows [0,3), [3,7), [7,end)."""
+        if sorted(indices) != list(indices) or any(i < 0 for i in indices):
+            raise ValueError("indices must be non-negative and sorted")
+        bounds = [0, *indices, None]
+        rows_seen = 0
+        blocks = list(self.iter_blocks())
+        shards: List[List[Block]] = [[] for _ in _range(len(bounds) - 1)]
+        for b in blocks:
+            lo = rows_seen
+            hi = rows_seen + b.num_rows
+            for k in _range(len(bounds) - 1):
+                s_lo = bounds[k]
+                s_hi = bounds[k + 1]
+                cut_lo = max(lo, s_lo)
+                cut_hi = hi if s_hi is None else min(hi, s_hi)
+                if cut_hi > cut_lo:
+                    shards[k].append(b.slice(cut_lo - lo, cut_hi - cut_lo))
+            rows_seen = hi
+        return [MaterializedDataset(s, self._parallelism) for s in shards]
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> Tuple["MaterializedDataset",
+                                    "MaterializedDataset"]:
+        """(train, test) row split (Dataset.train_test_split analog)."""
+        if not 0.0 < test_size < 1.0:
+            raise ValueError("test_size must be in (0, 1)")
+        ds: "Dataset" = self
+        if shuffle:
+            ds = ds.random_shuffle(seed=seed)
+        blocks = list(ds.iter_blocks())
+        total = sum(b.num_rows for b in blocks)
+        cut = total - int(total * test_size)
+        mat = MaterializedDataset(blocks, self._parallelism)
+        train, test = mat.split_at_indices([cut])
+        return train, test
 
 
 class MaterializedDataset(Dataset):

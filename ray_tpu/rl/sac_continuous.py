@@ -71,10 +71,14 @@ def sample_action(params, obs, key, max_action: float):
     std = jnp.exp(log_std)
     u = mean + std * jax.random.normal(key, mean.shape)
     a = jnp.tanh(u)
-    # Exact tanh correction: log(1 - tanh(u)^2) = 2(log2 - u - softplus(-2u))
+    # Exact change of variables for a = max_action * tanh(u):
+    # log(1 - tanh(u)^2) = 2(log2 - u - softplus(-2u)), plus the
+    # log(max_action) Jacobian of the scale per action dim (omitting it
+    # biases the learned temperature's entropy target).
     logp = (-0.5 * (((u - mean) / std) ** 2 + 2 * log_std
                     + jnp.log(2 * jnp.pi))
-            - 2 * (jnp.log(2.0) - u - jax.nn.softplus(-2 * u))).sum(-1)
+            - 2 * (jnp.log(2.0) - u - jax.nn.softplus(-2 * u))
+            - jnp.log(max_action)).sum(-1)
     return max_action * a, logp
 
 

@@ -88,3 +88,66 @@ def test_zip_union_columns(cluster):
 
     s = rd.range(1000, parallelism=2).random_sample(0.1, seed=0)
     assert 40 < s.count() < 200
+
+
+def test_std_unique_aggregate(cluster):
+    import numpy as np
+
+    ds = rd.from_items([{"v": float(i), "k": i % 3} for i in range(10)])
+    vals = np.arange(10, dtype=np.float64)
+    assert ds.std("v") == pytest.approx(float(vals.std(ddof=1)))
+    # unique: first-seen order, tolerant of unorderable values (None).
+    assert rd.from_items([{"k": x} for x in [3, 1, 3, 2, 1]]) \
+        .unique("k") == [3, 1, 2]
+    agg = ds.aggregate(total=("v", "sum"), hi=("v", "max"),
+                       lo=("v", "min"), avg=("v", "mean"),
+                       n=("v", "count"))
+    assert agg == {"total": 45.0, "hi": 9.0, "lo": 0.0,
+                   "avg": 4.5, "n": 10}
+    with pytest.raises(ValueError, match="unknown aggregate"):
+        ds.aggregate(x=("v", "median"))
+    # Empty dataset: every requested key present with its identity.
+    empty = ds.filter(lambda r: False)
+    assert empty.aggregate(n=("v", "count"), s=("v", "sum"),
+                           hi=("v", "max"), avg=("v", "mean")) == \
+        {"n": 0, "s": 0.0, "hi": None, "avg": None}
+    # std: shifted accumulation survives |mean| >> spread.
+    big = rd.from_items([{"v": 1e9 + float(i)} for i in range(10)])
+    assert big.std("v") == pytest.approx(
+        float(np.arange(10, dtype=np.float64).std(ddof=1)), rel=1e-6)
+
+
+def test_split_at_indices_and_train_test_split(cluster):
+    ds = rd.range(10, parallelism=3).materialize()
+    parts = ds.split_at_indices([3, 7])
+    got = [[r["id"] for r in p.take_all()] for p in parts]
+    assert got == [[0, 1, 2], [3, 4, 5, 6], [7, 8, 9]]
+    # Empty edge shards are allowed.
+    parts2 = ds.split_at_indices([0, 10])
+    assert [sum(1 for _ in p.take_all()) for p in parts2] == [0, 10, 0]
+
+    train, test = ds.train_test_split(0.3)
+    assert [r["id"] for r in train.take_all()] == list(range(7))
+    assert [r["id"] for r in test.take_all()] == [7, 8, 9]
+    train_s, test_s = (rd.range(20, parallelism=4).materialize()
+                       .train_test_split(0.25, shuffle=True, seed=5))
+    all_ids = sorted(r["id"] for r in train_s.take_all()) + \
+        sorted(r["id"] for r in test_s.take_all())
+    assert sorted(all_ids) == list(range(20))
+    assert sum(1 for _ in test_s.take_all()) == 5
+
+
+def test_iter_torch_batches_and_to_pandas(cluster):
+    import numpy as np
+    import torch
+
+    ds = rd.range(10, parallelism=2)
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    assert [len(b["id"]) for b in batches] == [4, 4, 2]
+    assert all(isinstance(b["id"], torch.Tensor) for b in batches)
+    typed = next(iter(ds.iter_torch_batches(
+        batch_size=4, dtypes={"id": torch.float32})))
+    assert typed["id"].dtype == torch.float32
+
+    df = rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]).to_pandas()
+    assert list(df["a"]) == [1, 2] and list(df["b"]) == ["x", "y"]
