@@ -13,3 +13,4 @@ from ray_tpu.autoscaler.providers import (  # noqa: F401
     LocalNodeProvider,
     get_provider,
 )
+from ray_tpu.autoscaler.sdk import request_resources  # noqa: F401

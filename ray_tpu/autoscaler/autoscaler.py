@@ -167,6 +167,13 @@ class Autoscaler:
         for pg in _gcs_call("list_placement_groups"):
             if pg["state"] in ("PENDING", "RESCHEDULING"):
                 demand.extend(pg["bundles"])
+        # Explicit floor from request_resources(): held even with no
+        # queued work (reference: autoscaler/sdk.py request_resources).
+        try:
+            demand.extend(dict(b)
+                          for b in _gcs_call("get_requested_resources"))
+        except Exception:
+            pass  # pre-upgrade GCS without the handler
         return demand
 
     # -- reconcile ---------------------------------------------------------
@@ -286,15 +293,39 @@ class Autoscaler:
                 plan_free.append(dict(t.resources))
         return plan
 
+    def _demand_reserve(self, demand, nodes) -> set:
+        """Instance ids PROTECTED from idle termination: demand bundles
+        packed first-fit onto registered instances' capacities. Demand
+        must not freeze scale-down wholesale — a persistent
+        request_resources floor would otherwise pin every node at peak
+        size forever; only the nodes the demand actually needs stay."""
+        node_by_id = {n["node_id"]: n for n in nodes}
+        remaining: Dict[str, Dict[str, float]] = {}
+        for iid, inst in self.instances.items():
+            node = (node_by_id.get(inst.node_id.hex())
+                    if inst.node_id else None)
+            if node is not None:
+                remaining[iid] = dict(node["resources"])
+        reserved: set = set()
+        for bundle in demand:
+            # Prefer packing onto already-reserved instances.
+            for iid in sorted(remaining, key=lambda i: i not in reserved):
+                cap = remaining[iid]
+                if all(cap.get(k, 0.0) >= v for k, v in bundle.items()):
+                    for k, v in bundle.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    reserved.add(iid)
+                    break
+        return reserved
+
     def _terminate_idle(self, nodes, demand) -> int:
         """Terminate instances whose node has been fully idle past
-        idle_timeout_s (never below min_workers; head node is never touched).
+        idle_timeout_s (never below min_workers; head node is never touched;
+        nodes the current demand needs are protected via _demand_reserve).
         Never-registered instances are reaped by reconcile() after
         boot_grace_s, independent of demand."""
         terminated = 0
-        if demand:
-            self._idle_since.clear()
-            return 0
+        protected = self._demand_reserve(demand, nodes) if demand else set()
         now = time.time()
         node_by_id = {n["node_id"]: n for n in nodes}
 
@@ -318,6 +349,8 @@ class Autoscaler:
             groups.setdefault(inst.slice_id or iid, []).append(iid)
         for key, iids in list(groups.items()):
             if len(self.instances) - len(iids) < self.min_workers:
+                continue
+            if any(iid in protected for iid in iids):
                 continue
             if all(idle_expired(iid, self.instances[iid]) for iid in iids):
                 for iid in iids:

@@ -1,0 +1,27 @@
+"""Programmatic autoscaler requests.
+
+Reference analog: python/ray/autoscaler/sdk.py `request_resources` — set
+an explicit demand FLOOR the autoscaler holds even when no work is
+queued (pre-scaling ahead of a known burst). Each call replaces the
+previous request; `request_resources()` with no arguments clears it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def request_resources(num_cpus: Optional[int] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None
+                      ) -> int:
+    """Ask the autoscaler to scale to accommodate `bundles` (and/or
+    `num_cpus` 1-CPU bundles). Returns the number of requested bundles
+    now in force. The request persists until replaced."""
+    from ray_tpu.state.api import _gcs_call
+
+    req: List[Dict[str, float]] = []
+    if num_cpus:
+        req.extend({"CPU": 1.0} for _ in range(int(num_cpus)))
+    if bundles:
+        req.extend(dict(b) for b in bundles)
+    return _gcs_call("request_resources", bundles=req)["count"]

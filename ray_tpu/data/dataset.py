@@ -276,13 +276,18 @@ class Dataset:
 
     def unique(self, on: str) -> List[Any]:
         """Distinct values of one column, first-seen order — unsorted,
-        so None/mixed-type columns don't raise (Dataset.unique analog)."""
-        seen: Dict[Any, None] = {}
+        so None/mixed-type columns don't raise (Dataset.unique analog).
+        Tensor cells (unhashable lists) dedupe by their tuple form."""
+        def hashable(v):
+            return (tuple(hashable(x) for x in v)
+                    if isinstance(v, list) else v)
+
+        seen: Dict[Any, Any] = {}
         for b in self.iter_blocks():
             if b.num_rows:
                 for v in BlockAccessor(b).to_batch()[on].tolist():
-                    seen.setdefault(v)
-        return list(seen)
+                    seen.setdefault(hashable(v), v)
+        return list(seen.values())
 
     def aggregate(self, **named_aggs: Tuple[str, str]):
         """Multi-aggregate in one pass: aggregate(total=("v", "sum"),

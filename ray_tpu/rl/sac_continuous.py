@@ -3,10 +3,10 @@ twin soft critics, learned temperature — one jit-compiled update.
 
 Reference analog: rllib/algorithms/sac/ — the PRIMARY SAC form there
 (Haarnoja 2018); the discrete variant lives in sac.py. The tanh squash
-uses the exact change-of-variables correction
-log pi(a) = log N(u) - sum log(1 - tanh(u)^2), target entropy defaults
-to -action_dim, and the critic target bootstraps through time-limit
-truncations the same way td3.py does (Pardo 2018).
+uses the exact change-of-variables correction for a = c * tanh(u):
+log pi(a) = log N(u) - sum [log(1 - tanh(u)^2) + log c], target entropy
+defaults to -action_dim, and the critic target bootstraps through
+time-limit truncations the same way td3.py does (Pardo 2018).
 """
 
 from __future__ import annotations

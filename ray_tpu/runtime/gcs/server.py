@@ -193,6 +193,9 @@ class GcsServer:
 
         for key, value in self._store.load_all("kv"):
             self._kv[key] = value
+        for key, blob in self._store.load_all("autoscaler"):
+            if key == b"requested_resources":
+                self._requested_resources = pickle.loads(blob)
         for _, blob in self._store.load_all("jobs"):
             job = pickle.loads(blob)
             self._jobs[job["job_id"]] = job
@@ -363,6 +366,26 @@ class GcsServer:
         node_stats fan-out to every raylet."""
         return [{"node_id": n.node_id, "backlog": n.backlog}
                 for n in self._nodes.values() if n.alive and n.backlog]
+
+    async def handle_request_resources(self, conn, bundles):
+        """Explicit demand floor (autoscaler/sdk request_resources analog):
+        the autoscaler scales to hold these bundles EVEN WITHOUT queued
+        work. Each call REPLACES the previous request (the reference
+        semantics); an empty list clears it. Persisted: the floor must
+        survive a GCS restart or the pre-scaled nodes idle out right
+        before the burst the operator scaled for."""
+        import pickle
+
+        self._requested_resources = [dict(b) for b in (bundles or [])]
+        try:
+            self._store.put("autoscaler", b"requested_resources",
+                            pickle.dumps(self._requested_resources))
+        except Exception:
+            logger.exception("persisting requested_resources failed")
+        return {"ok": True, "count": len(self._requested_resources)}
+
+    async def handle_get_requested_resources(self, conn):
+        return list(getattr(self, "_requested_resources", []))
 
     async def handle_drain_node(self, conn, node_id):
         await self._mark_node_dead(node_id, "drained")

@@ -174,3 +174,67 @@ def test_aws_azure_in_provider_registry():
     assert isinstance(p, AwsNodeProvider)
     p2 = get_provider("azure", resource_group="rg", location="eastus")
     assert isinstance(p2, AzureNodeProvider)
+
+
+def test_request_resources_sets_demand_floor():
+    """autoscaler/sdk request_resources analog: an explicit request scales
+    the cluster with NO queued work; replacing it with an empty request
+    clears the floor."""
+    import ray_tpu
+    from ray_tpu.autoscaler import (Autoscaler, FakeMultiNodeProvider,
+                                    request_resources)
+    from ray_tpu.cluster_utils import Cluster
+
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=1)
+        ray_tpu.init(address=cluster.address)
+        provider = FakeMultiNodeProvider(cluster)
+        scaler = Autoscaler(
+            provider, [InstanceType("cpu2", {"CPU": 2.0})],
+            max_workers=4, idle_timeout_s=3600)
+        # Idle cluster, no tasks: nothing to do.
+        assert scaler.reconcile()["launched"] == 0
+        # The floor alone drives a launch.
+        assert request_resources(bundles=[{"CPU": 2.0}]) == 1
+        assert scaler.reconcile()["launched"] >= 1
+        # Replacing with an empty request clears it; no relaunch after
+        # the booted instance registers.
+        assert request_resources() == 0
+        from ray_tpu.state.api import _gcs_call
+
+        assert _gcs_call("get_requested_resources") == []
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+def test_demand_reserve_protects_only_needed_instances():
+    """A persistent request_resources floor must NOT freeze scale-down
+    wholesale: only instances the demand packs onto are protected, the
+    surplus stays eligible for idle termination."""
+    from ray_tpu.autoscaler.autoscaler import Autoscaler, Instance
+
+    scaler = Autoscaler.__new__(Autoscaler)
+    scaler.instances = {
+        f"i{k}": Instance(f"i{k}", "cpu2", node_id=bytes([k]) * 14)
+        for k in range(3)}
+    nodes = [{"node_id": (bytes([k]) * 14).hex(),
+              "resources": {"CPU": 2.0}, "available": {"CPU": 2.0}}
+             for k in range(3)]
+    # One 2-CPU bundle packs onto ONE instance; two stay unprotected.
+    reserved = scaler._demand_reserve([{"CPU": 2.0}], nodes)
+    assert len(reserved) == 1
+    # Two 1-CPU bundles pack onto the SAME instance (first-fit).
+    reserved = scaler._demand_reserve([{"CPU": 1.0}, {"CPU": 1.0}], nodes)
+    assert len(reserved) == 1
+    # Demand beyond total capacity protects everything it can.
+    reserved = scaler._demand_reserve([{"CPU": 2.0}] * 5, nodes)
+    assert len(reserved) == 3
