@@ -830,7 +830,15 @@ def main():
             # falling back to full remat. Each point is a fresh compile:
             # the r4 relay 500 at batch 8 came through remote_compile, so
             # a failed policy is recorded and the next one still tries.
+            probe_t0 = time.monotonic()
             for bsz in (8, 16):
+                # The curve runs BEFORE the headline: cap its budget so a
+                # slow relay can't starve the headline out of the
+                # watchdog window (each point is a fresh ~1-3 min
+                # compile; the headline number must always land).
+                if time.monotonic() - probe_t0 > 900:
+                    batch_probe[bsz] = "skipped: probe budget"
+                    continue
                 for policy in ("flash", "full"):
                     st = l = None
                     try:

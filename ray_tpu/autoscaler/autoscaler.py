@@ -151,11 +151,14 @@ class Autoscaler:
 
     # -- demand ------------------------------------------------------------
 
-    def get_demand(self) -> List[Dict[str, float]]:
+    def get_demand(self, floor: Optional[List[Dict[str, float]]] = None,
+                   nodes: Optional[List[dict]] = None
+                   ) -> List[Dict[str, float]]:
         """Unmet resource demand: per-scheduling-class lease backlog
         (real shapes, including cluster-wide-infeasible parked classes),
         aggregated by the GCS from raylet heartbeats — one RPC, not a
-        node_stats fan-out — + pending PGs."""
+        node_stats fan-out — + pending PGs. `floor`/`nodes` can be passed
+        by reconcile() so one tick issues each GCS RPC once."""
         from ray_tpu.state.api import _gcs_call
 
         demand: List[Dict[str, float]] = []
@@ -167,14 +170,50 @@ class Autoscaler:
         for pg in _gcs_call("list_placement_groups"):
             if pg["state"] in ("PENDING", "RESCHEDULING"):
                 demand.extend(pg["bundles"])
-        # Explicit floor from request_resources(): held even with no
-        # queued work (reference: autoscaler/sdk.py request_resources).
-        try:
-            demand.extend(dict(b)
-                          for b in _gcs_call("get_requested_resources"))
-        except Exception:
-            pass  # pre-upgrade GCS without the handler
+        # Explicit floor from request_resources(): reference semantics are
+        # about cluster SIZE — a floor bundle is satisfied by any node
+        # large enough regardless of utilization, so only the remainder
+        # the current nodes cannot hold BY CAPACITY becomes launch demand
+        # (packing against `available` would grow a busy cluster past the
+        # floor forever).
+        if floor is None:
+            floor = self._floor_bundles()
+        if floor:
+            if nodes is None:
+                from ray_tpu.state.api import list_nodes
+
+                nodes = [n for n in list_nodes() if n["alive"]]
+            caps = [dict(n["resources"]) for n in nodes]
+            for bundle in floor:
+                for cap in caps:
+                    if all(cap.get(k, 0.0) >= v for k, v in bundle.items()):
+                        for k, v in bundle.items():
+                            cap[k] = cap.get(k, 0.0) - v
+                        break
+                else:
+                    demand.append(dict(bundle))
         return demand
+
+    def _floor_bundles(self) -> List[Dict[str, float]]:
+        """request_resources floor, with a last-known cache: a TRANSIENT
+        GCS error must not drop operator-requested capacity for a tick
+        (the next _terminate_idle would reap the floor-held nodes); only
+        a GCS that does not know the method (pre-upgrade) clears it."""
+        from ray_tpu.state.api import _gcs_call
+
+        try:
+            floor = [dict(b) for b in _gcs_call("get_requested_resources")]
+            self._floor_cache = floor
+        except Exception as e:
+            if "no handler" in str(e):
+                self._floor_cache = []
+            else:
+                logger.warning(
+                    "get_requested_resources failed (%r); holding "
+                    "last-known floor (%d bundles)", e,
+                    len(getattr(self, "_floor_cache", [])))
+            floor = list(getattr(self, "_floor_cache", []))
+        return floor
 
     # -- reconcile ---------------------------------------------------------
 
@@ -183,9 +222,17 @@ class Autoscaler:
         """One reconciliation round; returns {"launched": n, "terminated": m}."""
         from ray_tpu.state.api import list_nodes
 
-        if demand is None:
-            demand = self.get_demand()
         nodes = [n for n in list_nodes() if n["alive"]]
+        # One floor fetch + one node listing per tick, shared by demand
+        # accounting and idle termination (two reads could also disagree
+        # mid-tick, e.g. a floor cleared between them).
+        floor = self._floor_bundles()
+        if demand is None:
+            try:
+                demand = self.get_demand(floor=floor, nodes=nodes)
+            except TypeError:
+                # Tests/subclasses stub get_demand with a 0-arg callable.
+                demand = self.get_demand()
         alive_ids = {n["node_id"] for n in nodes}
         free = [dict(n["available"]) for n in nodes]
 
@@ -253,7 +300,7 @@ class Autoscaler:
                                                slice_id=slice_id)
             launched += len(iids)
 
-        terminated = self._terminate_idle(nodes, demand)
+        terminated = self._terminate_idle(nodes, demand, floor=floor)
         return {"launched": launched, "terminated": terminated,
                 "unmet_demand": len(unmet)}
 
@@ -293,39 +340,69 @@ class Autoscaler:
                 plan_free.append(dict(t.resources))
         return plan
 
-    def _demand_reserve(self, demand, nodes) -> set:
+    def _demand_reserve(self, demand, nodes,
+                        capacity_key: str = "available") -> set:
         """Instance ids PROTECTED from idle termination: demand bundles
         packed first-fit onto registered instances' capacities. Demand
         must not freeze scale-down wholesale — a persistent
         request_resources floor would otherwise pin every node at peak
-        size forever; only the nodes the demand actually needs stay."""
+        size forever; only the nodes the demand actually needs stay.
+
+        capacity_key: "available" for backlog demand (queued work needs
+        FREE capacity — packing against totals would let a busy node
+        absorb the reservation and leave the idle node the work actually
+        needs unprotected); "resources" for the request_resources floor
+        (size semantics: any node large enough holds a floor bundle)."""
         node_by_id = {n["node_id"]: n for n in nodes}
         remaining: Dict[str, Dict[str, float]] = {}
+        instance_node_ids = set()
         for iid, inst in self.instances.items():
             node = (node_by_id.get(inst.node_id.hex())
                     if inst.node_id else None)
             if node is not None:
-                remaining[iid] = dict(node["resources"])
+                instance_node_ids.add(node["node_id"])
+                remaining[iid] = dict(node[capacity_key])
+        # NON-instance nodes (the head, operator-managed nodes) absorb
+        # bundles too — they satisfy demand in get_demand's accounting,
+        # and a bundle they hold must not pin a terminable worker here.
+        for n in nodes:
+            if n["node_id"] not in instance_node_ids:
+                remaining[f"node:{n['node_id']}"] = dict(n[capacity_key])
         reserved: set = set()
         for bundle in demand:
-            # Prefer packing onto already-reserved instances.
-            for iid in sorted(remaining, key=lambda i: i not in reserved):
+            # Prefer already-reserved, then non-instance nodes (reserving
+            # them is free — they are never idle-terminated anyway).
+            for iid in sorted(
+                    remaining,
+                    key=lambda i: (i not in reserved,
+                                   not i.startswith("node:"))):
                 cap = remaining[iid]
                 if all(cap.get(k, 0.0) >= v for k, v in bundle.items()):
                     for k, v in bundle.items():
                         cap[k] = cap.get(k, 0.0) - v
                     reserved.add(iid)
                     break
-        return reserved
+        return reserved & set(self.instances)
 
-    def _terminate_idle(self, nodes, demand) -> int:
+    def _terminate_idle(self, nodes, demand,
+                        floor: Optional[List[Dict[str, float]]] = None
+                        ) -> int:
         """Terminate instances whose node has been fully idle past
         idle_timeout_s (never below min_workers; head node is never touched;
         nodes the current demand needs are protected via _demand_reserve).
         Never-registered instances are reaped by reconcile() after
         boot_grace_s, independent of demand."""
         terminated = 0
-        protected = self._demand_reserve(demand, nodes) if demand else set()
+        protected = (self._demand_reserve(demand, nodes, "available")
+                     if demand else set())
+        if floor is None:
+            floor = self._floor_bundles()
+        if floor:
+            # The SATISFIED floor never appears in demand (get_demand
+            # emits only the unmet remainder), but its holders must not
+            # idle out — that would flap: terminate -> floor unmet ->
+            # relaunch, every idle_timeout.
+            protected |= self._demand_reserve(floor, nodes, "resources")
         now = time.time()
         node_by_id = {n["node_id"]: n for n in nodes}
 
@@ -351,6 +428,12 @@ class Autoscaler:
             if len(self.instances) - len(iids) < self.min_workers:
                 continue
             if any(iid in protected for iid in iids):
+                # Reset protected nodes' idle clocks: otherwise a node
+                # held by a floor for an hour is terminated with ZERO
+                # grace the instant protection lapses (its pre-protection
+                # timestamp is already past the timeout).
+                for iid in iids:
+                    self._idle_since.pop(iid, None)
                 continue
             if all(idle_expired(iid, self.instances[iid]) for iid in iids):
                 for iid in iids:

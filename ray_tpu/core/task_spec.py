@@ -46,32 +46,43 @@ class TaskSpec:
 
         return wire.TaskSpecMsg(
             task_id=self.task_id, fn_id=self.fn_id, name=self.name,
-            args=self.args, kwarg_names=self.kwarg_names,
+            payload=(self.args, self.kwarg_names,
+                     self.scheduling_strategy, self.runtime_env,
+                     self.pinned_oids),
             num_returns=self.num_returns, resources=self.resources,
             max_retries=self.max_retries, actor_id=self.actor_id or b"",
             method_name=self.method_name or "", seq_no=self.seq_no,
-            scheduling_strategy=self.scheduling_strategy,
             placement_group_id=self.placement_group_id or b"",
             placement_group_bundle_index=self.placement_group_bundle_index,
-            runtime_env=self.runtime_env,
-            pinned_oids=self.pinned_oids or []).encode()
+            ).encode()
 
     @classmethod
     def from_wire(cls, data: bytes) -> "TaskSpec":
         from ray_tpu.runtime import wire
 
         m = wire.TaskSpecMsg.decode(data)
+        p = m.payload
+        if isinstance(p, tuple) and len(p) == 5:
+            args, kwarg_names, strategy, runtime_env, pinned = p
+        else:
+            # First-cut writer: field 4 carried the args list alone and
+            # the rest rode the retired 5/12/15/16 fields.
+            args = p or []
+            kwarg_names = m.kwarg_names_v1 or []
+            strategy = m.scheduling_strategy_v1
+            runtime_env = m.runtime_env_v1
+            pinned = list(m.pinned_oids_v1) or None
         return cls(
             task_id=m.task_id, fn_id=m.fn_id, name=m.name,
-            args=m.args or [], kwarg_names=m.kwarg_names or [],
+            args=args or [], kwarg_names=kwarg_names or [],
             num_returns=m.num_returns, resources=m.resources,
             max_retries=m.max_retries, actor_id=m.actor_id or None,
             method_name=m.method_name or None, seq_no=m.seq_no,
-            scheduling_strategy=m.scheduling_strategy,
+            scheduling_strategy=strategy,
             placement_group_id=m.placement_group_id or None,
             placement_group_bundle_index=m.placement_group_bundle_index,
-            runtime_env=m.runtime_env,
-            pinned_oids=list(m.pinned_oids) or None)
+            runtime_env=runtime_env,
+            pinned_oids=list(pinned) if pinned else None)
 
 
 @dataclass

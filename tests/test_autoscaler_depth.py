@@ -238,3 +238,27 @@ def test_demand_reserve_protects_only_needed_instances():
     # Demand beyond total capacity protects everything it can.
     reserved = scaler._demand_reserve([{"CPU": 2.0}] * 5, nodes)
     assert len(reserved) == 3
+
+
+def test_demand_reserve_backlog_packs_against_available():
+    """Backlog demand needs FREE capacity: a fully-busy node must not
+    absorb the reservation and leave the idle node the queued work
+    actually needs unprotected (while the request_resources floor packs
+    by SIZE, ignoring utilization)."""
+    from ray_tpu.autoscaler.autoscaler import Autoscaler, Instance
+
+    scaler = Autoscaler.__new__(Autoscaler)
+    scaler.instances = {
+        "busy": Instance("busy", "cpu2", node_id=b"\x01" * 14),
+        "idle": Instance("idle", "cpu2", node_id=b"\x02" * 14)}
+    nodes = [
+        {"node_id": (b"\x01" * 14).hex(), "resources": {"CPU": 2.0},
+         "available": {"CPU": 0.0}},                      # fully busy
+        {"node_id": (b"\x02" * 14).hex(), "resources": {"CPU": 2.0},
+         "available": {"CPU": 2.0}}]                      # idle
+    # Queued bundle: only the IDLE node can host it.
+    assert scaler._demand_reserve([{"CPU": 2.0}], nodes,
+                                  "available") == {"idle"}
+    # Floor bundle: size semantics — the busy node satisfies it.
+    assert scaler._demand_reserve([{"CPU": 2.0}], nodes,
+                                  "resources") == {"busy"}

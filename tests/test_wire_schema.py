@@ -212,10 +212,51 @@ def test_old_submitter_new_worker_backfills_defaults():
         priority = Field(40, INT, default=5)
 
     old = wire.TaskSpecMsg(task_id=b"t" * 14, fn_id=b"f" * 20, name="w",
-                           args=[("v", b"x")], kwarg_names=[None])
+                           payload=([("v", b"x")], [None], None, None,
+                                    None))
     new = SpecV2.decode(old.encode())
     assert new.task_id == b"t" * 14
+    assert new.payload[0] == [("v", b"x")]
     assert new.priority == 5  # backfilled default
+
+
+def test_first_cut_task_writer_decodes_losslessly():
+    """The first-cut TaskSpecMsg wrote args alone in field 4 and the
+    other opaque pieces in fields 5/12/15/16 (now write-retired). A
+    current reader must recover ALL of them — field 4 is value-versioned
+    (bare list = first cut, 5-tuple = current), not silently empty."""
+    from ray_tpu.core.task_spec import TaskSpec
+
+    class TaskSpecMsgV1(Message):  # the retired writer's exact schema
+        task_id = Field(1, BYTES)
+        fn_id = Field(2, BYTES)
+        name = Field(3, STR)
+        args = Field(4, ANY)
+        kwarg_names = Field(5, ANY)
+        num_returns = Field(6, INT, default=1)
+        resources = Field(7, MAP(FLOAT))
+        max_retries = Field(8, INT, default=3)
+        actor_id = Field(9, BYTES)
+        method_name = Field(10, STR)
+        seq_no = Field(11, INT)
+        scheduling_strategy = Field(12, ANY)
+        placement_group_id = Field(13, BYTES)
+        placement_group_bundle_index = Field(14, INT, default=-1)
+        runtime_env = Field(15, ANY)
+        pinned_oids = Field(16, LIST(BYTES))
+
+    v1 = TaskSpecMsgV1(
+        task_id=b"t" * 20, fn_id=b"f" * 20, name="w",
+        args=[("v", b"x"), ("r", b"o" * 20)], kwarg_names=[None, "k"],
+        num_returns=2, resources={"CPU": 1.0},
+        actor_id=b"a" * 20, method_name="m", seq_no=3,
+        runtime_env={"env_vars": {"A": "1"}}, pinned_oids=[b"o" * 20])
+    spec = TaskSpec.from_wire(v1.encode())
+    assert spec.args == [("v", b"x"), ("r", b"o" * 20)]
+    assert spec.kwarg_names == [None, "k"]
+    assert spec.runtime_env == {"env_vars": {"A": "1"}}
+    assert spec.pinned_oids == [b"o" * 20]
+    assert spec.method_name == "m" and spec.num_returns == 2
 
 
 def test_typed_push_falls_back_on_old_peer():
