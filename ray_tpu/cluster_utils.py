@@ -18,13 +18,37 @@ from typing import Dict, List, Optional
 from ray_tpu.runtime import node as node_mod
 
 
+def _child_pids(pid: int) -> List[int]:
+    """Direct children of `pid` (via /proc), best-effort."""
+    out: List[int] = []
+    try:
+        for entry in os.listdir("/proc"):
+            if not entry.isdigit():
+                continue
+            try:
+                with open(f"/proc/{entry}/status") as f:
+                    for line in f:
+                        if line.startswith("PPid:"):
+                            if int(line.split()[1]) == pid:
+                                out.append(int(entry))
+                            break
+            except OSError:
+                continue
+    except OSError:
+        pass
+    return out
+
+
 class ClusterNode:
-    def __init__(self, proc: subprocess.Popen, info: dict, resources: Dict[str, float]):
+    def __init__(self, proc: subprocess.Popen, info: dict,
+                 resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None):
         self.proc = proc
         self.node_id = bytes.fromhex(info["node_id"])
         self.address = tuple(info["address"])
         self.store_path = info["store_path"]
         self.resources = resources
+        self.labels: Dict[str, str] = dict(labels or {})
 
 
 class Cluster:
@@ -77,7 +101,7 @@ class Cluster:
             self.session_dir, self.gcs_address, res, labels or {},
             object_store_memory, is_head=is_head, worker_env=worker_env,
             name=f"raylet{len(self.nodes)}")
-        node = ClusterNode(proc, info, res)
+        node = ClusterNode(proc, info, res, labels)
         self.nodes.append(node)
         return node
 
@@ -85,12 +109,25 @@ class Cluster:
         """Kill a node (raylet + its workers) to simulate node failure."""
         try:
             if force:
-                # Kill the whole process group (raylet spawned workers with
-                # start_new_session, so kill those separately via raylet).
+                # Host death kills EVERYTHING on the node. Workers run in
+                # their own sessions (start_new_session), so SIGKILLing the
+                # raylet alone would orphan them as still-serving zombies no
+                # real failure mode produces — collect its children first
+                # and kill their sessions too.
+                children = _child_pids(node.proc.pid)
                 node.proc.kill()
+                node.proc.wait(timeout=10)
+                for pid in children:
+                    try:
+                        os.killpg(pid, signal.SIGKILL)
+                    except Exception:
+                        try:
+                            os.kill(pid, signal.SIGKILL)
+                        except Exception:
+                            pass
             else:
                 node.proc.terminate()
-            node.proc.wait(timeout=10)
+                node.proc.wait(timeout=10)
         except Exception:
             pass
         self.nodes.remove(node)

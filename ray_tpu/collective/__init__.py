@@ -1,4 +1,5 @@
 from ray_tpu.collective.collective import (  # noqa: F401
+    abort_collective_group,
     allgather,
     allreduce,
     alltoall,
@@ -13,3 +14,4 @@ from ray_tpu.collective.collective import (  # noqa: F401
     send,
 )
 from ray_tpu.collective.communicator import Communicator  # noqa: F401
+from ray_tpu.core.exceptions import CollectiveAbortError  # noqa: F401

@@ -55,9 +55,38 @@ def init_collective_group(world_size: int, rank: int, backend: str = "tcp",
     return comm
 
 
+def abort_collective_group(group_name: str = "default",
+                           reason: str = "aborted") -> None:
+    """Abort a group's in-flight and future ops everywhere.
+
+    Writes the group's KV abort flag — every member rank's watchdog picks it
+    up within one `collective_watchdog_interval_s` and raises
+    CollectiveAbortError out of any blocked op. Callable from ANY process
+    that can reach the GCS (e.g. the Train controller during gang restart),
+    not just group members. If this process holds the group, it is also
+    aborted locally (immediate, no watchdog latency).
+    """
+    from ray_tpu.collective.communicator import abort_key
+
+    comm = _groups.get(group_name)
+    if comm is not None:
+        comm.abort(reason)  # immediate locally; TCP also writes the KV
+        try:
+            kv_put, _ = _gcs_kv()
+            kv_put(abort_key(group_name), reason or "aborted")
+        except Exception:
+            pass  # no GCS reachable: the local abort still happened
+        return
+    kv_put, _ = _gcs_kv()
+    kv_put(abort_key(group_name), reason or "aborted")
+
+
 def destroy_collective_group(group_name: str = "default"):
     comm = _groups.pop(group_name, None)
     if comm is not None:
+        # Unblock any thread still inside a collective before tearing down
+        # sockets, so it exits with CollectiveAbortError instead of a
+        # confusing ConnectionError from a closed fd.
         comm.close()
 
 

@@ -16,11 +16,30 @@ The TPU-native design splits collectives into two planes:
 from __future__ import annotations
 
 import abc
-from typing import List, Sequence
+import contextlib
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ray_tpu.core.exceptions import CollectiveAbortError
+
+logger = logging.getLogger(__name__)
+
 REDUCE_OPS = ("sum", "prod", "min", "max", "mean")
+
+
+def abort_key(group_name: str) -> str:
+    """GCS KV key of a group's abort flag (non-empty value = abort reason).
+    Settable by ANY process — the Train controller uses it to unblock a
+    worker group's in-flight collectives during a gang restart."""
+    return f"collective:{group_name}:abort"
+
+
+def heartbeat_key(group_name: str, rank: int) -> str:
+    return f"collective:{group_name}:hb:{rank}"
 
 
 class Communicator(abc.ABC):
@@ -31,6 +50,51 @@ class Communicator(abc.ABC):
         self.rank = rank
         self.world_size = world_size
         self.group_name = group_name
+        # Abort plumbing: the flag is checked at op entry and, for blocking
+        # backends, from inside receive loops (poll-timeout ticks), so a
+        # set flag surfaces CollectiveAbortError within one watchdog
+        # interval instead of the socket timeout.
+        self._abort_event = threading.Event()
+        self._abort_reason = ""
+        self._watchdog: Optional["CollectiveWatchdog"] = None
+        self._active_ops = 0
+        self._op_lock = threading.Lock()
+
+    # ---- abort -----------------------------------------------------------
+
+    def abort(self, reason: str = "aborted") -> None:
+        """Set the group's abort flag locally: every blocking op in flight
+        (and every future op) raises CollectiveAbortError. Subclasses with
+        a KV rendezvous also propagate to peers (see TCPCommunicator)."""
+        if not self._abort_event.is_set():
+            self._abort_reason = reason or "aborted"
+            self._abort_event.set()
+
+    @property
+    def aborted(self) -> bool:
+        return self._abort_event.is_set()
+
+    def check_abort(self) -> None:
+        if self._abort_event.is_set():
+            raise CollectiveAbortError(self.group_name, self._abort_reason)
+
+    @property
+    def op_active(self) -> bool:
+        return self._active_ops > 0
+
+    @contextlib.contextmanager
+    def _op(self):
+        """Blocking-op guard: the watchdog only applies peer-liveness
+        staleness checks while an op is actually in flight (an idle group
+        whose peers exited cleanly must not abort retroactively)."""
+        self.check_abort()
+        with self._op_lock:
+            self._active_ops += 1
+        try:
+            yield
+        finally:
+            with self._op_lock:
+                self._active_ops -= 1
 
     @abc.abstractmethod
     def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
@@ -77,6 +141,115 @@ class Communicator(abc.ABC):
 
     def close(self) -> None:
         pass
+
+
+class CollectiveWatchdog:
+    """Peer-liveness + abort propagation for out-of-graph collectives.
+
+    One thread per communicator. Each tick (cfg().collective_watchdog_
+    interval_s) it:
+
+      * bumps this rank's heartbeat counter in the rendezvous KV,
+      * reads the group's abort key — any non-empty value aborts the local
+        communicator (this is how a remote `abort_collective_group` or the
+        Train controller's gang-restart reaches a rank blocked in recv),
+      * while a blocking op is in flight, checks every peer's heartbeat
+        counter: a counter unchanged for `collective_peer_miss_threshold`
+        consecutive ticks means the peer's process is gone (its watchdog
+        died with it) — the group aborts in seconds instead of hanging to
+        the 120 s socket timeout.
+
+    KV failures are tolerated (the GCS may be briefly down); the watchdog
+    just retries next tick.
+    """
+
+    def __init__(self, comm: Communicator,
+                 kv_put: Callable[[str, str], None],
+                 kv_get: Callable[[str], Optional[str]],
+                 interval_s: Optional[float] = None,
+                 miss_threshold: Optional[int] = None):
+        from ray_tpu.config import cfg
+
+        self.comm = comm
+        self._kv_put = kv_put
+        self._kv_get = kv_get
+        self.interval_s = (interval_s if interval_s is not None
+                           else cfg().collective_watchdog_interval_s)
+        self.miss_threshold = (miss_threshold if miss_threshold is not None
+                               else cfg().collective_peer_miss_threshold)
+        self._beats = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # peer rank -> (last seen counter value, consecutive stale ticks)
+        self._peer_state: dict = {}
+
+    def start(self) -> "CollectiveWatchdog":
+        # First beat synchronously: peers must be able to see us from the
+        # moment the group exists, or a slow-to-start watchdog thread would
+        # read as a dead peer.
+        self._beat()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"collective-watchdog-{self.comm.group_name}-"
+                 f"{self.comm.rank}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: float = 5.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _beat(self):
+        self._beats += 1
+        try:
+            self._kv_put(heartbeat_key(self.comm.group_name, self.comm.rank),
+                         str(self._beats))
+        except Exception:
+            pass
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            if self.comm.aborted:
+                return
+            self._beat()
+            try:
+                self._check_abort_key()
+                if self.comm.op_active:
+                    self._check_peers()
+            except Exception:
+                logger.debug("collective watchdog tick failed", exc_info=True)
+            if self.comm.aborted:
+                return
+
+    def _check_abort_key(self):
+        try:
+            value = self._kv_get(abort_key(self.comm.group_name))
+        except Exception:
+            return
+        if value:
+            self.comm.abort(value)
+
+    def _check_peers(self):
+        for peer in range(self.comm.world_size):
+            if peer == self.comm.rank:
+                continue
+            try:
+                value = self._kv_get(heartbeat_key(self.comm.group_name, peer))
+            except Exception:
+                return
+            if value is None:
+                continue  # peer not through rendezvous yet
+            last, stale = self._peer_state.get(peer, (None, 0))
+            stale = stale + 1 if value == last else 0
+            self._peer_state[peer] = (value, stale)
+            if stale >= self.miss_threshold:
+                self.comm.abort(
+                    f"peer rank {peer} lost (no watchdog heartbeat for "
+                    f"{stale} x {self.interval_s:g}s)")
+                return
 
 
 def reduce_arrays(arrays: Sequence[np.ndarray], op: str) -> np.ndarray:

@@ -19,33 +19,77 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from ray_tpu.collective.communicator import Communicator, reduce_arrays
+from ray_tpu.collective.communicator import (
+    Communicator, CollectiveWatchdog, abort_key, reduce_arrays)
 
 _HDR = struct.Struct("<Q")
 
 
-def _send_msg(sock: socket.socket, obj) -> None:
+def _send_msg(sock: socket.socket, obj, check: Optional[Callable] = None,
+              deadline: Optional[float] = None) -> None:
     data = pickle.dumps(obj, protocol=5)
-    sock.sendall(_HDR.pack(len(data)) + data)
+    payload = memoryview(_HDR.pack(len(data)) + data)
+    if check is None and deadline is None:
+        sock.sendall(payload)
+        return
+    # Poll-timeout sockets: a partial send to a slow peer must not surface
+    # as a spurious socket.timeout — retry each tick, observing abort flag
+    # and per-op deadline just like _recv_msg.
+    while payload:
+        try:
+            sent = sock.send(payload)
+        except socket.timeout:
+            if check is not None:
+                check()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("collective op deadline exceeded")
+            continue
+        except OSError:
+            if check is not None:
+                check()
+            raise
+        payload = payload[sent:]
 
 
-def _recv_msg(sock: socket.socket):
-    buf = b""
-    while len(buf) < _HDR.size:
-        chunk = sock.recv(_HDR.size - len(buf))
-        if not chunk:
-            raise ConnectionError("collective peer disconnected")
-        buf += chunk
-    (length,) = _HDR.unpack(buf)
-    parts = []
-    got = 0
-    while got < length:
-        chunk = sock.recv(min(1 << 20, length - got))
-        if not chunk:
-            raise ConnectionError("collective peer disconnected")
-        parts.append(chunk)
-        got += len(chunk)
-    return pickle.loads(b"".join(parts))
+def _recv_msg(sock: socket.socket, check: Optional[Callable] = None,
+              deadline: Optional[float] = None):
+    """Length-prefixed pickle read. With `check`/`deadline` set (and the
+    socket on a short poll timeout), each timeout tick runs `check()` —
+    which raises CollectiveAbortError once the group's abort flag is set —
+    and enforces the per-op deadline, so a blocked receive unblocks within
+    one poll tick of an abort instead of the full socket timeout."""
+
+    def _read(n: int) -> bytes:
+        parts: List[bytes] = []
+        got = 0
+        while got < n:
+            try:
+                chunk = sock.recv(min(1 << 20, n - got))
+            except socket.timeout:
+                if check is None and deadline is None:
+                    raise  # legacy blocking behavior (rendezvous paths)
+                if check is not None:
+                    check()
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "collective op deadline exceeded")
+                continue
+            except OSError:
+                # close() sets the abort flag then closes sockets; the
+                # abort is the real story, not the EBADF it causes.
+                if check is not None:
+                    check()
+                raise
+            if not chunk:
+                if check is not None:
+                    check()
+                raise ConnectionError("collective peer disconnected")
+            parts.append(chunk)
+            got += len(chunk)
+        return b"".join(parts)
+
+    (length,) = _HDR.unpack(_read(_HDR.size))
+    return pickle.loads(_read(length))
 
 
 class TCPCommunicator(Communicator):
@@ -60,12 +104,18 @@ class TCPCommunicator(Communicator):
                  kv_get: Callable[[str], Optional[str]],
                  timeout: float = 120.0):
         super().__init__(rank, world_size, group_name)
+        from ray_tpu.config import cfg
+
         self._timeout = timeout
         self._kv_put = kv_put
         self._kv_get = kv_get
+        # Poll granularity for blocking receives: abort flags and deadlines
+        # are observed once per tick, so it tracks the watchdog interval.
+        self._poll_s = max(0.05, min(cfg().collective_watchdog_interval_s,
+                                     1.0))
         # Direct p2p plane: every rank listens; connections form lazily.
         self._p2p_listener = socket.create_server(("127.0.0.1", 0))
-        self._p2p_listener.settimeout(timeout)
+        self._p2p_listener.settimeout(self._poll_s)
         kv_put(f"collective:{group_name}:p2p:{rank}",
                f"127.0.0.1:{self._p2p_listener.getsockname()[1]}")
         self._p2p_out: dict = {}   # dst rank -> socket
@@ -75,6 +125,10 @@ class TCPCommunicator(Communicator):
             self._peers = []
             return
         if rank == 0:
+            # Clear any stale abort flag from a previous same-named group
+            # BEFORE publishing the root address (peers only proceed once
+            # the address appears, so they can't observe the stale value).
+            kv_put(abort_key(group_name), "")
             self._listener = socket.create_server(("127.0.0.1", 0))
             port = self._listener.getsockname()[1]
             kv_put(key, f"127.0.0.1:{port}")
@@ -90,6 +144,7 @@ class TCPCommunicator(Communicator):
                 sock, _ = self._listener.accept()
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 peer_rank = _recv_msg(sock)
+                sock.settimeout(self._poll_s)
                 self._peers[peer_rank] = sock
                 connected += 1
         else:
@@ -105,6 +160,28 @@ class TCPCommunicator(Communicator):
             self._root = socket.create_connection((host, int(port)), timeout=timeout)
             self._root.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             _send_msg(self._root, rank)
+            self._root.settimeout(self._poll_s)
+        # Liveness/abort watchdog: a dead peer or a KV-set abort flag
+        # surfaces CollectiveAbortError in seconds, not the socket timeout.
+        self._watchdog = CollectiveWatchdog(self, kv_put, kv_get).start()
+
+    # ---- abort -----------------------------------------------------------
+
+    def abort(self, reason: str = "aborted", propagate: bool = True) -> None:
+        """Abort the group: local flag + (by default) the group's KV abort
+        key, so every OTHER rank's watchdog aborts within one interval."""
+        first = not self.aborted
+        super().abort(reason)
+        if first and propagate and self.world_size > 1:
+            try:
+                self._kv_put(abort_key(self.group_name), reason or "aborted")
+            except Exception:
+                pass
+
+    def _op_deadline(self) -> float:
+        from ray_tpu.config import cfg
+
+        return time.monotonic() + cfg().collective_op_timeout_s
 
     # ---- root-coordinated collectives ------------------------------------
 
@@ -112,20 +189,28 @@ class TCPCommunicator(Communicator):
         """Root: gather payloads from all ranks, run `compute(payloads)->
         per-rank replies`, scatter. Non-root: send payload, await reply."""
         if self.world_size == 1:
+            self.check_abort()
             return compute([payload])[0]
-        if self.rank == 0:
-            payloads: List = [None] * self.world_size
-            payloads[0] = payload
-            for r in range(1, self.world_size):
-                op, data = _recv_msg(self._peers[r])
-                assert op == opcode, f"collective mismatch: {op} vs {opcode}"
-                payloads[r] = data
-            replies = compute(payloads)
-            for r in range(1, self.world_size):
-                _send_msg(self._peers[r], replies[r])
-            return replies[0]
-        _send_msg(self._root, (opcode, payload))
-        return _recv_msg(self._root)
+        deadline = self._op_deadline()
+        with self._op():
+            if self.rank == 0:
+                payloads: List = [None] * self.world_size
+                payloads[0] = payload
+                for r in range(1, self.world_size):
+                    op, data = _recv_msg(self._peers[r],
+                                         check=self.check_abort,
+                                         deadline=deadline)
+                    assert op == opcode, f"collective mismatch: {op} vs {opcode}"
+                    payloads[r] = data
+                replies = compute(payloads)
+                for r in range(1, self.world_size):
+                    _send_msg(self._peers[r], replies[r],
+                              check=self.check_abort, deadline=deadline)
+                return replies[0]
+            _send_msg(self._root, (opcode, payload),
+                      check=self.check_abort, deadline=deadline)
+            return _recv_msg(self._root, check=self.check_abort,
+                             deadline=deadline)
 
     def allreduce(self, array: np.ndarray, op: str = "sum") -> np.ndarray:
         def compute(payloads):
@@ -162,12 +247,14 @@ class TCPCommunicator(Communicator):
     # ---- p2p (direct pairwise connections) -------------------------------
 
     def send(self, array: np.ndarray, dst_rank: int) -> None:
+        self.check_abort()
         sock = self._p2p_out.get(dst_rank)
         if sock is None:
             key = f"collective:{self.group_name}:p2p:{dst_rank}"
             deadline = time.monotonic() + self._timeout
             addr = None
             while addr is None:
+                self.check_abort()
                 addr = self._kv_get(key)
                 if addr is None:
                     if time.monotonic() > deadline:
@@ -177,18 +264,39 @@ class TCPCommunicator(Communicator):
             sock = socket.create_connection((host, int(port)), timeout=self._timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             _send_msg(sock, self.rank)  # identify ourselves
+            sock.settimeout(self._poll_s)
             self._p2p_out[dst_rank] = sock
-        _send_msg(sock, np.asarray(array))
+        _send_msg(sock, np.asarray(array), check=self.check_abort,
+                  deadline=self._op_deadline())
 
     def recv(self, shape, dtype, src_rank: int) -> np.ndarray:
-        while src_rank not in self._p2p_in:
-            sock, _ = self._p2p_listener.accept()
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            peer = _recv_msg(sock)
-            self._p2p_in[peer] = sock
-        return _recv_msg(self._p2p_in[src_rank])
+        deadline = self._op_deadline()
+        with self._op():
+            while src_rank not in self._p2p_in:
+                try:
+                    sock, _ = self._p2p_listener.accept()
+                except socket.timeout:
+                    self.check_abort()
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"p2p recv from rank {src_rank}: deadline exceeded")
+                    continue
+                except OSError:
+                    self.check_abort()
+                    raise
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.settimeout(self._poll_s)
+                peer = _recv_msg(sock, check=self.check_abort, deadline=deadline)
+                self._p2p_in[peer] = sock
+            return _recv_msg(self._p2p_in[src_rank], check=self.check_abort,
+                             deadline=deadline)
 
     def close(self) -> None:
+        # Local-only abort: unblocks any thread of THIS rank still inside a
+        # collective, without poisoning peers that are shutting down cleanly.
+        self.abort("collective group closed", propagate=False)
+        if self._watchdog is not None:
+            self._watchdog.stop()
         try:
             for sock in list(self._p2p_out.values()) + list(self._p2p_in.values()):
                 sock.close()

@@ -21,7 +21,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from ray_tpu.collective.communicator import Communicator
+from ray_tpu.collective.communicator import Communicator, CollectiveWatchdog
 
 _initialized = False
 
@@ -77,6 +77,11 @@ class JaxDistributedCommunicator(Communicator):
         self.jax = jax
         self.devices = jax.devices()  # global, across processes
         self.local_devices = jax.local_devices()
+        # jax.distributed blocking collectives can't be interrupted mid-op,
+        # but the watchdog still fails the NEXT op fast (check_abort at op
+        # entry) and propagates remote aborts / dead-peer detection.
+        if world_size > 1:
+            self._watchdog = CollectiveWatchdog(self, kv_put, kv_get).start()
 
     # Helper: stage a host array onto the process-sharded global mesh, apply
     # an in-graph collective, fetch the (replicated) result.
@@ -97,6 +102,7 @@ class JaxDistributedCommunicator(Communicator):
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
         from jax import lax
 
+        self.check_abort()
         mesh = self._process_mesh()
         x = jnp.asarray(array)[None, ...]  # leading axis = proc shard
         sharding = NamedSharding(mesh, P("proc"))
@@ -124,6 +130,7 @@ class JaxDistributedCommunicator(Communicator):
         from jax.sharding import NamedSharding, PartitionSpec as P
         from jax import lax
 
+        self.check_abort()
         mesh = self._process_mesh()
         x = jnp.asarray(array)[None, ...]
         sharding = NamedSharding(mesh, P("proc"))
@@ -161,3 +168,7 @@ class JaxDistributedCommunicator(Communicator):
 
     def barrier(self) -> None:
         self.allreduce(np.zeros(1, dtype=np.float32), "sum")
+
+    def close(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.stop()

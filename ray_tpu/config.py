@@ -97,10 +97,28 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                         "task state events retained by the GCS"),
     "task_events_flush_interval_s": (float, 1.0,
                                      "worker-side task event batch period"),
+    # -- collectives -------------------------------------------------------
+    "collective_watchdog_interval_s": (float, 1.0,
+                                       "peer-liveness/abort poll period of "
+                                       "the collective watchdog during "
+                                       "blocking ops"),
+    "collective_peer_miss_threshold": (int, 3,
+                                       "consecutive stale watchdog "
+                                       "heartbeats before a collective peer "
+                                       "is declared lost and the group "
+                                       "aborts"),
+    "collective_op_timeout_s": (float, 120.0,
+                                "per-op deadline for blocking out-of-graph "
+                                "collective ops"),
     # -- train -------------------------------------------------------------
     "train_poll_interval_s": (float, 0.2, "controller worker poll period"),
     "train_elastic_check_interval_s": (float, 10.0,
                                        "elastic scaling evaluation period"),
+    "train_restart_resource_wait_s": (float, 30.0,
+                                      "max wait for cluster capacity to fit "
+                                      "the worker group before a failure "
+                                      "restart attempt (gang restarts race "
+                                      "the autoscaler replacing a slice)"),
 }
 
 

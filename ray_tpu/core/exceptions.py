@@ -44,6 +44,50 @@ class ActorUnavailableError(ActorError):
     pass
 
 
+# Marker embedded in GCS death reasons for nodes/actors lost to a slice
+# failure domain; `actor_death_error` keys off it so the caller-side error
+# type survives the string-shaped death_reason plumbing.
+TPU_SLICE_LOST_MARKER = "TpuSliceLost"
+
+
+class TpuSliceLostError(ActorDiedError):
+    """An ICI slice failure domain was lost: one host of a multi-host TPU
+    slice died, so the GCS fate-shared its siblings and everything pinned
+    to the slice (actors, tasks, in-flight collectives) fails immediately
+    rather than running against a broken ICI domain.
+
+    Subclasses ActorDiedError so existing actor-failure handling keeps
+    working; Train's controller additionally treats it as a gang-restart
+    signal (train/elastic.py is_gang_failure)."""
+
+    def __init__(self, actor_id_hex: str, reason: str = ""):
+        super().__init__(actor_id_hex, reason)
+
+
+def actor_death_error(actor_id_hex: str, reason: str) -> ActorDiedError:
+    """Typed error for an actor death reason reported by the GCS: deaths
+    caused by a lost slice surface as TpuSliceLostError (fast gang-restart
+    signal), everything else as plain ActorDiedError."""
+    if TPU_SLICE_LOST_MARKER in (reason or ""):
+        return TpuSliceLostError(actor_id_hex, reason)
+    return ActorDiedError(actor_id_hex, reason)
+
+
+class CollectiveAbortError(RayTpuError):
+    """A blocking collective op was aborted — the group's abort flag was
+    set (locally, via the GCS KV, or by the peer-liveness watchdog after a
+    rank stopped heartbeating) — instead of hanging to the socket timeout."""
+
+    def __init__(self, group_name: str, reason: str = ""):
+        self.group_name = group_name
+        self.reason = reason
+        super().__init__(
+            f"collective group {group_name!r} aborted: {reason}")
+
+    def __reduce__(self):
+        return (type(self), (self.group_name, self.reason))
+
+
 class TaskCancelledError(RayTpuError):
     """The task producing this object was cancelled via ray_tpu.cancel()
     (reference analog: ray.exceptions.TaskCancelledError). Raised by

@@ -29,7 +29,7 @@ from ray_tpu.core import serialization
 from ray_tpu.runtime import metric_defs
 from ray_tpu.core.exceptions import (
     ActorDiedError, GetTimeoutError, ObjectLostError, RayTpuError, TaskError,
-    WorkerCrashedError)
+    WorkerCrashedError, actor_death_error)
 from ray_tpu.core.generator import ObjectRefGenerator, _GeneratorState
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import ActorSpec, TaskSpec
@@ -1826,8 +1826,11 @@ class _ActorClient:
                     self.client = client
                     return
                 if state == "DEAD":
-                    raise ActorDiedError(self.actor_id.hex(),
-                                         info.get("death_reason", ""))
+                    # Slice-lost deaths surface as TpuSliceLostError so
+                    # callers (e.g. Train's controller) can gang-restart
+                    # instead of treating it as a lone-actor failure.
+                    raise actor_death_error(self.actor_id.hex(),
+                                            info.get("death_reason", ""))
                 if time.monotonic() > deadline:
                     raise ActorDiedError(self.actor_id.hex(),
                                          f"stuck in state {state}")
@@ -1889,6 +1892,18 @@ class _ActorClient:
                 except ActorDiedError as e:
                     self.core._complete_error(spec, e)
                     return
+            # Retry budget exhausted on connection loss. Ask the GCS whether
+            # the actor is in fact dead — its death_reason carries failure-
+            # domain typing (TpuSliceLost) that a bare socket error loses.
+            try:
+                info = await self.core.gcs.call("get_actor",
+                                                actor_id=self.actor_id)
+                if info.get("found") and info.get("state") == "DEAD":
+                    self.core._complete_error(spec, actor_death_error(
+                        self.actor_id.hex(), info.get("death_reason", "")))
+                    return
+            except Exception:
+                pass
             self.core._complete_error(spec, ActorDiedError(
                 self.actor_id.hex(), f"connection lost: {last_err!r}"))
         except Exception as e:

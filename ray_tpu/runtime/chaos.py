@@ -39,7 +39,8 @@ class ChaosRule:
 
     def __init__(self, pattern: str, mode: str, prob: float,
                  param: float = 0.0, max_hits: Optional[int] = None):
-        assert mode in (FAIL, TIMEOUT, DELAY), mode
+        if mode not in (FAIL, TIMEOUT, DELAY):
+            raise ValueError(f"unknown chaos mode {mode!r}")
         self.pattern = pattern
         self.mode = mode
         self.prob = prob
@@ -70,18 +71,48 @@ class RpcChaos:
         return bool(self._rules)
 
     def configure(self, spec: str):
-        """Parse and append rules from a spec string (see module doc)."""
+        """Parse and append rules from a spec string (see module doc).
+
+        Each rule is validated independently; a malformed fragment raises
+        ValueError naming the offending fragment (an RAY_TPU_CHAOS typo must
+        fail the run loudly, not silently change which faults get injected).
+        Rules parsed before the bad fragment are NOT added — the spec is
+        applied all-or-nothing."""
+        rules = []
         for part in spec.split(","):
             part = part.strip()
             if not part:
                 continue
-            pattern, rhs = part.split("=", 1)
-            fields = rhs.split(":")
-            mode = fields[0]
-            prob = float(fields[1]) if len(fields) > 1 else 1.0
-            param = float(fields[2]) if len(fields) > 2 else (
-                1.0 if mode == TIMEOUT else 0.05)
-            max_hits = int(fields[3]) if len(fields) > 3 else None
+            try:
+                pattern, rhs = part.split("=", 1)
+                if not pattern:
+                    raise ValueError("empty method pattern")
+                fields = rhs.split(":")
+                mode = fields[0]
+                if mode not in (FAIL, TIMEOUT, DELAY):
+                    raise ValueError(
+                        f"unknown mode {mode!r} (expected one of "
+                        f"{FAIL!r}, {TIMEOUT!r}, {DELAY!r})")
+                prob = float(fields[1]) if len(fields) > 1 else 1.0
+                if not 0.0 <= prob <= 1.0:
+                    raise ValueError(f"probability {prob!r} not in [0, 1]")
+                param = float(fields[2]) if len(fields) > 2 else (
+                    1.0 if mode == TIMEOUT else 0.05)
+                if param < 0:
+                    raise ValueError(f"negative param {param!r}")
+                max_hits = int(fields[3]) if len(fields) > 3 else None
+                if max_hits is not None and max_hits < 0:
+                    raise ValueError(f"negative max_hits {max_hits!r}")
+                if len(fields) > 4:
+                    raise ValueError(
+                        f"too many ':' fields ({len(fields)}, max 4)")
+            except ValueError as e:
+                raise ValueError(
+                    f"bad RAY_TPU_CHAOS rule {part!r}: {e} "
+                    f"(expected 'method_glob=mode:prob[:param[:max_hits]]')"
+                ) from e
+            rules.append((pattern, mode, prob, param, max_hits))
+        for pattern, mode, prob, param, max_hits in rules:
             self.add_rule(pattern, mode, prob, param, max_hits)
 
     def add_rule(self, pattern: str, mode: str, prob: float = 1.0,

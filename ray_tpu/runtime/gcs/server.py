@@ -433,7 +433,8 @@ class GcsServer:
             self._persist_job(job)
         return {"ok": True}
 
-    async def _mark_node_dead(self, node_id: bytes, reason: str):
+    async def _mark_node_dead(self, node_id: bytes, reason: str,
+                              _slice_cascade: bool = True):
         rec = self._nodes.get(node_id)
         if rec is None or not rec.alive:
             return
@@ -442,6 +443,18 @@ class GcsServer:
         self._bump_view(rec)
         logger.warning("node %s marked dead: %s", node_id.hex()[:12], reason)
         await self.publish("node", {"event": "removed", "node": rec.view(), "reason": reason})
+        # Slice fate-sharing: a multi-host ICI slice is ONE failure domain.
+        # Losing any host breaks the slice's collectives, so every sibling
+        # is marked dead in the SAME tick (not after its own heartbeat
+        # timeout) and actors on the slice die with the slice-lost marker.
+        from ray_tpu.core.exceptions import TPU_SLICE_LOST_MARKER
+
+        slice_name = rec.labels.get("tpu-slice-name")
+        if slice_name and TPU_SLICE_LOST_MARKER not in reason:
+            reason = (f"{TPU_SLICE_LOST_MARKER}: slice {slice_name!r} "
+                      f"lost ({reason})")
+        if _slice_cascade and slice_name:
+            await self._fate_share_slice(slice_name, node_id, reason)
         # Fail/restart actors that lived on that node.
         for actor in list(self._actors.values()):
             if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION):
@@ -449,6 +462,47 @@ class GcsServer:
                     self._handle_actor_failure(actor.spec.actor_id, f"node died: {reason}"))
         if self._pg_manager is not None:
             await self._pg_manager.on_node_dead(node_id)
+
+    async def _fate_share_slice(self, slice_name: str, origin: bytes,
+                                reason: str):
+        """Mark every sibling host of a lost slice dead NOW, notify their
+        raylets (they kill local workers and shut down — nothing may keep
+        running against a broken ICI domain), and publish a typed
+        `slice_lost` event. Also recorded in the KV so pollers (tests,
+        dashboards) can observe slice loss without a subscription."""
+        from ray_tpu.runtime import wire
+
+        siblings = [n for n in self._nodes.values()
+                    if n.alive and n.node_id != origin
+                    and n.labels.get("tpu-slice-name") == slice_name]
+        members = [origin] + [n.node_id for n in siblings]
+        msg = wire.SliceLostMsg(slice_name=slice_name, nodes=members,
+                                origin_node=origin, reason=reason)
+        encoded = msg.encode()
+        for sib in siblings:
+            if sib.client is not None:
+                self._spawn_bg(self._notify_slice_lost(sib, encoded))
+            await self._mark_node_dead(sib.node_id, reason,
+                                       _slice_cascade=False)
+        logger.warning("slice %r lost (%d host(s) fate-shared): %s",
+                       slice_name, len(siblings), reason)
+        key = f"slice_lost:{slice_name}".encode()
+        self._kv[key] = reason.encode()
+        try:
+            self._store.put("kv", key, self._kv[key])
+        except Exception:
+            logger.exception("slice_lost kv persist failed")
+        await self.publish("slice_lost", {
+            "slice_name": slice_name, "reason": reason, "m": encoded})
+
+    async def _notify_slice_lost(self, rec: "NodeRecord", encoded: bytes):
+        try:
+            await rec.client.call("slice_lost", m=encoded, timeout=5)
+        except Exception as e:
+            # Best effort: the sibling may already be unreachable (it is
+            # marked dead regardless).
+            logger.debug("slice_lost notify to %s failed: %r",
+                         rec.node_id.hex()[:12], e)
 
     async def _health_check_loop(self):
         # gcs_health_check_manager analog: periodic liveness by heartbeat age.

@@ -371,6 +371,27 @@ class Raylet:
         self._shutdown.set()
         return {"ok": True}
 
+    async def handle_slice_lost(self, conn, m: bytes):
+        """Fate-share with the ICI slice (typed wire.SliceLostMsg): a
+        sibling host of this node's slice died, so this node's workers are
+        running against a broken ICI domain. Kill them all immediately —
+        their leases/tasks fail now instead of hanging on dead collectives
+        — then shut the raylet down (the GCS already marked us dead; a
+        production deployment replaces the whole slice as one unit)."""
+        from ray_tpu.runtime import wire
+
+        msg = wire.SliceLostMsg.decode(m)
+        logger.warning(
+            "slice %r lost (%s): fate-sharing — killing %d worker(s) and "
+            "shutting down", msg.slice_name, msg.reason, len(self._workers))
+        for w in list(self._workers.values()):
+            try:
+                w.proc.kill()
+            except Exception:
+                pass
+        self._shutdown.set()
+        return {"ok": True}
+
     # ---- worker pool (worker_pool.h) -------------------------------------
 
     def _park_idle(self, w: WorkerHandle):

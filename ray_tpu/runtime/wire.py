@@ -544,6 +544,19 @@ class TaskSpecMsg(Message):
     pinned_oids_v1 = Field(16, LIST(BYTES))  # decode-only (retired writer)
 
 
+class SliceLostMsg(Message):
+    """Slice failure-domain event (no reference proto: the reference has no
+    slice concept — see ROADMAP "TPU chips/ICI slices"). Published by the
+    GCS on the `slice_lost` channel and pushed to sibling raylets when any
+    host of a multi-host TPU slice dies: the slice is ONE failure domain,
+    so siblings fate-share in the same health tick."""
+
+    slice_name = Field(1, STR)
+    nodes = Field(2, LIST(BYTES))      # every node id of the lost slice
+    origin_node = Field(3, BYTES)      # the host whose death triggered it
+    reason = Field(4, STR)
+
+
 class TaskReplyMsg(Message):
     """PushTaskReply analog: status + returns; errors are exceptions
     (ANY), return payloads are serialized values (ANY)."""

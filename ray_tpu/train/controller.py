@@ -79,15 +79,19 @@ class TrainController:
     def _run_attempts(self, poll_interval: float, world: int) -> Result:
         import dataclasses as _dc
 
-        from ray_tpu.train.elastic import FailureDecision
+        from ray_tpu.train.elastic import FailureDecision, is_gang_failure
         from ray_tpu.train.worker_group import WorkerGroup
 
         attempt = 0
         while True:
             attempt += 1
+            # Per-attempt group name: a fresh collective namespace every
+            # restart, so abort flags from a lost attempt can't poison the
+            # next one.
             scaling = _dc.replace(self.scaling, num_workers=world)
             group = WorkerGroup(scaling, f"{self.run_name}-a{attempt}",
                                 self.storage_path)
+            error = None
             try:
                 group.start(self.backend, group_name=f"{self.run_name}-a{attempt}")
                 latest = self.ckpt_manager.latest_checkpoint
@@ -98,6 +102,11 @@ class TrainController:
             except RayTpuError as e:
                 error = repr(e)
             finally:
+                if is_gang_failure(error):
+                    # Slice loss / collective abort: surviving ranks may be
+                    # wedged inside blocking collectives — unblock them
+                    # before tearing the group down.
+                    group.abort_collectives(error)
                 group.shutdown()
             if error is None:
                 self._final_result = Result(
@@ -118,8 +127,20 @@ class TrainController:
                     self.scaling, world, self._available_resources())
                 if decision.kind == "resize" and decision.num_workers >= 1:
                     world = decision.num_workers
-                logger.warning("train run %s failed (%s); restarting with "
-                               "%d workers", self.run_name, error, world)
+                if is_gang_failure(error):
+                    latest = self.ckpt_manager.latest_checkpoint
+                    logger.warning(
+                        "train run %s: gang restart after slice/collective "
+                        "failure (%s); %d workers resuming from %s",
+                        self.run_name, error, world,
+                        latest.path if latest else "scratch")
+                else:
+                    logger.warning("train run %s failed (%s); restarting with "
+                                   "%d workers", self.run_name, error, world)
+                # A restart typically races recovery (replacement slice
+                # joining, raylets re-registering): don't burn the retry
+                # budget on instantly-infeasible placement groups.
+                self._wait_for_capacity(world)
                 continue
             self._final_result = Result(
                 metrics=self.latest_metrics,
@@ -127,6 +148,27 @@ class TrainController:
                 best_checkpoints=None, path=self.storage_path,
                 metrics_dataframe=self.metrics_history, error=error)
             return self._final_result
+
+    def _wait_for_capacity(self, world: int) -> None:
+        """Bounded wait until the cluster can fit `world` workers again.
+        Proceeds on timeout — placement then fails loudly and consumes a
+        retry, which is the right signal when capacity never returns."""
+        from ray_tpu.config import cfg
+
+        per = self.scaling.worker_resources()
+        if not per:
+            return
+        deadline = time.monotonic() + cfg().train_restart_resource_wait_s
+        while time.monotonic() < deadline:
+            avail = self._available_resources()
+            if all(avail.get(res, 0.0) >= need * world
+                   for res, need in per.items()):
+                return
+            time.sleep(0.5)
+        logger.warning("train run %s: capacity for %d workers did not return "
+                       "within %.0fs; attempting placement anyway",
+                       self.run_name, world,
+                       cfg().train_restart_resource_wait_s)
 
     def _poll_until_done(self, group, poll_interval: float,
                          world: int) -> Optional[str]:

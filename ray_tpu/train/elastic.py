@@ -81,6 +81,25 @@ class ElasticScalingPolicy(ScalingPolicy):
         return ScalingDecision("noop")
 
 
+# Error-string markers of gang failures: the whole worker group is broken
+# as a unit (a TPU slice died, or a collective aborted under it) — restart
+# everything from the latest checkpoint rather than probing individual
+# workers. Workers report exceptions as strings, so markers are textual.
+GANG_FAILURE_MARKERS = (
+    "TpuSliceLost",
+    "TpuSliceLostError",
+    "CollectiveAbortError",
+)
+
+
+def is_gang_failure(error: Optional[str]) -> bool:
+    """True when `error` (a worker/controller error string) indicates a
+    slice loss or collective abort — i.e. the group must be gang-restarted."""
+    if not error:
+        return False
+    return any(marker in error for marker in GANG_FAILURE_MARKERS)
+
+
 class FailureDecision:
     RETRY = "retry"
     FAIL = "fail"

@@ -97,15 +97,21 @@ class WorkerGroup:
         self.storage_path = storage_path
         self.pg = None
         self.workers: List = []
+        self.group_name: Optional[str] = None
 
     def start(self, backend_name, group_name: str):
+        self.group_name = group_name
         res = self.scaling.worker_resources()
         bundles = [dict(res) for _ in range(self.scaling.num_workers)]
         self.pg = placement_group(bundles, strategy=self.scaling.placement_strategy,
                                   name=f"train-{self.run_name}")
         if not self.pg.wait(120):
             raise RuntimeError("placement group for train workers not ready")
-        WorkerActor = ray_tpu.remote(TrainWorker)
+        # max_task_retries lets a poll interrupted by connection loss
+        # re-resolve through the GCS, where a slice-lost death surfaces as
+        # a typed TpuSliceLostError (gang-failure signal) instead of a
+        # generic "connection lost".
+        WorkerActor = ray_tpu.remote(max_task_retries=2)(TrainWorker)
         self.workers = [
             WorkerActor.options(
                 num_cpus=res.get("CPU", 0), num_tpus=res.get("TPU", 0),
@@ -128,6 +134,24 @@ class WorkerGroup:
 
     def poll(self) -> List[Dict]:
         return ray_tpu.get([w.poll.remote() for w in self.workers], timeout=120)
+
+    def abort_collectives(self, reason: str = "gang restart"):
+        """Unblock any worker still inside a blocking collective op.
+
+        Driver-side: writes the group's KV abort flag via
+        `abort_collective_group`; every surviving rank's watchdog observes it
+        within one `collective_watchdog_interval_s` and raises
+        CollectiveAbortError out of the blocked op, so the subsequent
+        `shutdown()` doesn't wait on actors wedged in 120 s socket reads.
+        """
+        if not self.group_name:
+            return
+        try:
+            from ray_tpu.collective import abort_collective_group
+
+            abort_collective_group(self.group_name, reason)
+        except Exception:
+            pass  # GCS may already be unreachable; kill path still works
 
     def shutdown(self):
         for w in self.workers:

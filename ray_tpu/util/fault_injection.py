@@ -10,18 +10,23 @@ killer must outlive the nodes it kills.
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
 from typing import Callable, List, Optional
 
+logger = logging.getLogger(__name__)
+
 
 class NodeKiller:
     """Periodically kills a random non-head worker node in the cluster.
 
-    `respawn=True` adds a replacement node (same resources) after each kill,
-    keeping cluster capacity roughly constant while churning node ids —
-    the elastic-recovery scenario."""
+    `respawn=True` adds a replacement node (same resources and labels) after
+    each kill, keeping cluster capacity roughly constant while churning node
+    ids — the elastic-recovery scenario. Respawn errors are counted in
+    `respawn_failures` (the cluster may legitimately be shutting down under
+    us) and the killer keeps running."""
 
     def __init__(self, cluster, interval_s: float = 1.0, *,
                  respawn: bool = True, seed: int = 0,
@@ -33,6 +38,7 @@ class NodeKiller:
         self.max_kills = max_kills
         self.node_filter = node_filter or (lambda node: True)
         self.kills: List[str] = []
+        self.respawn_failures = 0
         self._rng = random.Random(seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -42,30 +48,50 @@ class NodeKiller:
         self._thread.start()
         return self
 
+    def _pick_victim(self):
+        victims = [n for n in self.cluster.nodes
+                   if n.proc.poll() is None and self.node_filter(n)]
+        return self._rng.choice(victims) if victims else None
+
+    def _kill_one(self, node) -> bool:
+        """Kill `node` and optionally respawn a replacement. Returns True if
+        the kill happened."""
+        node_id = node.node_id.hex()[:12]
+        resources = dict(node.resources)
+        labels = dict(getattr(node, "labels", {}) or {})
+        try:
+            self.cluster.remove_node(node, force=True)
+        except Exception:
+            logger.warning("NodeKiller: failed to kill node %s",
+                           node_id, exc_info=True)
+            return False
+        self.kills.append(node_id)
+        logger.info("NodeKiller: killed node %s (kill #%d)",
+                    node_id, len(self.kills))
+        if self.respawn:
+            try:
+                num_cpus = resources.pop("CPU", 1.0)
+                num_tpus = resources.pop("TPU", 0.0)
+                self.cluster.add_node(num_cpus=num_cpus,
+                                      num_tpus=num_tpus,
+                                      resources=resources or None,
+                                      labels=labels or None)
+            except Exception:
+                self.respawn_failures += 1
+                logger.warning(
+                    "NodeKiller: failed to respawn replacement for node %s "
+                    "(%d respawn failure(s) so far)",
+                    node_id, self.respawn_failures, exc_info=True)
+        return True
+
     def _run(self):
         while not self._stop.wait(self.interval_s):
             if self.max_kills is not None and len(self.kills) >= self.max_kills:
                 return
-            victims = [n for n in self.cluster.nodes
-                       if n.proc.poll() is None and self.node_filter(n)]
-            if not victims:
+            node = self._pick_victim()
+            if node is None:
                 continue
-            node = self._rng.choice(victims)
-            resources = dict(node.resources)
-            try:
-                self.cluster.remove_node(node, force=True)
-            except Exception:
-                continue
-            self.kills.append(node.node_id.hex()[:12])
-            if self.respawn:
-                try:
-                    num_cpus = resources.pop("CPU", 1.0)
-                    num_tpus = resources.pop("TPU", 0.0)
-                    self.cluster.add_node(num_cpus=num_cpus,
-                                          num_tpus=num_tpus,
-                                          resources=resources or None)
-                except Exception:
-                    pass
+            self._kill_one(node)
 
     def stop(self):
         self._stop.set()
@@ -73,9 +99,71 @@ class NodeKiller:
             self._thread.join(timeout=10)
 
 
+class SliceKiller(NodeKiller):
+    """Kills ONE host of a multi-host TPU slice (by `tpu-slice-name` label).
+
+    The point of killing a single host: the GCS must fate-share the
+    surviving siblings (a slice is one ICI failure domain), and anything
+    blocked in a collective over that slice must abort fast. Targets only
+    nodes whose slice has >= `min_slice_hosts` live members, so single-host
+    slices (which trivially fate-share) are skipped.
+
+    `slice_name=None` picks a random qualifying slice per kill. With
+    `respawn=True` the replacement host carries the SAME slice label — the
+    "repaired slice rejoins" scenario (note the GCS will have already marked
+    the old siblings dead; respawn restores capacity, not the old slice).
+    Use `strike()` for a one-shot kill without starting the interval thread.
+    """
+
+    def __init__(self, cluster, interval_s: float = 1.0, *,
+                 slice_name: Optional[str] = None,
+                 min_slice_hosts: int = 2,
+                 respawn: bool = False, seed: int = 0,
+                 max_kills: Optional[int] = None):
+        self.slice_name = slice_name
+        self.min_slice_hosts = min_slice_hosts
+        super().__init__(cluster, interval_s, respawn=respawn, seed=seed,
+                         max_kills=max_kills, node_filter=self._in_target_slice)
+
+    def _live_slice_sizes(self):
+        sizes: dict = {}
+        for n in self.cluster.nodes:
+            name = (getattr(n, "labels", {}) or {}).get("tpu-slice-name")
+            if name and n.proc.poll() is None:
+                sizes[name] = sizes.get(name, 0) + 1
+        return sizes
+
+    def _in_target_slice(self, node) -> bool:
+        name = (getattr(node, "labels", {}) or {}).get("tpu-slice-name")
+        if name is None:
+            return False
+        if self.slice_name is not None and name != self.slice_name:
+            return False
+        return self._live_slice_sizes().get(name, 0) >= self.min_slice_hosts
+
+    def strike(self) -> Optional[str]:
+        """Kill one qualifying slice host NOW (no thread). Returns the short
+        node id of the victim, or None if no slice qualifies."""
+        node = self._pick_victim()
+        if node is None:
+            logger.warning("SliceKiller: no multi-host slice to strike")
+            return None
+        node_id = node.node_id.hex()[:12]
+        slice_name = (getattr(node, "labels", {}) or {}).get("tpu-slice-name")
+        if self._kill_one(node):
+            logger.info("SliceKiller: struck host %s of slice %r",
+                        node_id, slice_name)
+            return node_id
+        return None
+
+
 class GcsKiller:
     """Kills and restarts the GCS on an interval (GCS fault-tolerance
-    churn; the reference exercises this via NotifyGCSRestart paths)."""
+    churn; the reference exercises this via NotifyGCSRestart paths).
+
+    Transient restart errors (port still in TIME_WAIT, slow exit) are
+    counted in `respawn_failures` and logged; the killer keeps looping —
+    a chaos run must not silently stop churning halfway through."""
 
     def __init__(self, cluster, interval_s: float = 2.0,
                  downtime_s: float = 0.5, max_kills: Optional[int] = None):
@@ -84,6 +172,7 @@ class GcsKiller:
         self.downtime_s = downtime_s
         self.max_kills = max_kills
         self.kills = 0
+        self.respawn_failures = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -99,10 +188,18 @@ class GcsKiller:
             try:
                 self.cluster.kill_gcs()
                 time.sleep(self.downtime_s)
+            except Exception:
+                logger.warning("GcsKiller: failed to kill GCS", exc_info=True)
+                continue
+            try:
                 self.cluster.restart_gcs()
                 self.kills += 1
             except Exception:
-                return
+                self.respawn_failures += 1
+                logger.warning(
+                    "GcsKiller: GCS restart failed (%d failure(s) so far); "
+                    "retrying next tick", self.respawn_failures,
+                    exc_info=True)
 
     def stop(self):
         self._stop.set()

@@ -36,6 +36,41 @@ def test_rule_parsing_and_draws():
     assert not r.matches("x")
 
 
+def test_configure_validates_good_specs():
+    c = RpcChaos()
+    c.configure("lease_worker=fail:0.2,pull_object=delay:0.3:0.1,"
+                "kv_*=timeout:1:2:3, ,")  # empty fragments are fine
+    assert [(r.pattern, r.mode) for r in c._rules] == [
+        ("lease_worker", "fail"), ("pull_object", "delay"), ("kv_*", "timeout")]
+
+
+@pytest.mark.parametrize("bad", [
+    "lease_worker",                 # no '='
+    "=fail:0.5",                    # empty pattern
+    "lease_worker=explode:0.5",     # unknown mode
+    "lease_worker=fail:1.5",        # prob out of range
+    "lease_worker=fail:nope",       # non-numeric prob
+    "lease_worker=delay:0.5:-1",    # negative param
+    "lease_worker=fail:0.5:1:-2",   # negative max_hits
+    "lease_worker=fail:0.5:1:2:9",  # too many fields
+])
+def test_configure_rejects_bad_specs(bad):
+    c = RpcChaos()
+    with pytest.raises(ValueError) as exc:
+        c.configure(f"kv_get=delay:1.0,{bad}")
+    # The offending fragment is named in the message...
+    assert bad in str(exc.value)
+    # ...and the spec applied all-or-nothing: the valid leading rule is NOT
+    # half-installed.
+    assert not c._rules
+
+
+def test_add_rule_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        ChaosRule("x", "explode", 1.0)
+
+
+@pytest.mark.chaos
 def test_tasks_survive_injected_rpc_failures():
     """20% of worker-lease RPCs fail at the client edge; tasks still
     complete via the submitter's retry/spillback machinery."""
@@ -55,6 +90,7 @@ def test_tasks_survive_injected_rpc_failures():
         ray_tpu.shutdown()
 
 
+@pytest.mark.chaos
 def test_injected_server_delay_slows_but_not_breaks():
     ray_tpu.init(num_cpus=2)
     try:
@@ -71,6 +107,7 @@ def test_injected_server_delay_slows_but_not_breaks():
 
 
 @pytest.mark.slow
+@pytest.mark.chaos
 def test_node_killer_churn():
     """Tasks keep completing while a NodeKiller cycles worker nodes."""
     from ray_tpu.cluster_utils import Cluster

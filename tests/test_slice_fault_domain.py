@@ -1,0 +1,374 @@
+"""TPU-slice failure domains: fate-sharing, fast collective abort, gang
+recovery.
+
+A multi-host ICI slice is ONE failure unit: losing any host breaks the
+slice's collectives for every sibling. The runtime must (1) mark all
+siblings dead in the same GCS tick the first host dies, (2) surface
+CollectiveAbortError out of blocked collective ops within the watchdog
+budget instead of the 120 s socket timeout, and (3) gang-restart Train
+worker groups from the latest checkpoint.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.exceptions import CollectiveAbortError, TpuSliceLostError
+from ray_tpu.runtime.tpu_topology import slice_labels
+
+
+# ---------------------------------------------------------------------------
+# (a) GCS fate-sharing: one dead host kills the whole slice, typed errors.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_slice_host_death_fate_shares_siblings():
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.state.api import list_nodes
+    from ray_tpu.util.fault_injection import SliceKiller
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=2)  # head, no slice label
+        for i in range(2):
+            cluster.add_node(num_cpus=1, resources={"slicehost": 1},
+                             labels=slice_labels("trillium-0", "v5e-16", i))
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(3)
+
+        @ray_tpu.remote(max_task_retries=2)
+        class Probe:
+            def ping(self):
+                return "pong"
+
+        probe = Probe.options(resources={"slicehost": 1}).remote()
+        assert ray_tpu.get(probe.ping.remote(), timeout=60) == "pong"
+
+        killer = SliceKiller(cluster, slice_name="trillium-0")
+        assert killer.strike() is not None
+        struck_at = time.monotonic()
+
+        # Every slice host must be reported dead well under the 30 s
+        # heartbeat timeout: the raylet's GCS connection drop triggers the
+        # cascade in the same tick, not a per-sibling heartbeat expiry.
+        deadline = struck_at + 10
+        while time.monotonic() < deadline:
+            by_slice = [n for n in list_nodes()
+                        if n["labels"].get("tpu-slice-name") == "trillium-0"]
+            if by_slice and all(not n["alive"] for n in by_slice):
+                break
+            time.sleep(0.1)
+        detect_s = time.monotonic() - struck_at
+        assert by_slice and all(not n["alive"] for n in by_slice), \
+            f"slice siblings still alive after {detect_s:.1f}s: {by_slice}"
+        assert detect_s < 10, detect_s
+        # The head (not part of the slice) is untouched.
+        heads = [n for n in list_nodes() if n["is_head"]]
+        assert heads and all(n["alive"] for n in heads)
+
+        # The actor pinned to the slice fails with the TYPED error carrying
+        # the slice name, so callers can distinguish gang loss from a lone
+        # actor crash.
+        with pytest.raises(TpuSliceLostError) as exc:
+            ray_tpu.get(probe.ping.remote(), timeout=60)
+        assert "trillium-0" in str(exc.value)
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (b) Collective abort: a blocked allreduce unblocks within the watchdog
+#     budget — no cluster needed, two in-process communicators.
+# ---------------------------------------------------------------------------
+
+def _mem_kv():
+    kv, lock = {}, threading.Lock()
+
+    def put(key, value):
+        with lock:
+            kv[key] = value
+
+    def get(key):
+        with lock:
+            return kv.get(key)
+
+    return put, get
+
+
+def _make_pair(group_name, put, get):
+    from ray_tpu.collective.cpu_group import TCPCommunicator
+
+    comms = [None, None]
+    errs = []
+
+    def build(rank):
+        try:
+            comms[rank] = TCPCommunicator(rank, 2, group_name, put, get,
+                                          timeout=30)
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=build, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs and all(comms), errs
+    return comms
+
+
+@pytest.fixture
+def fast_watchdog():
+    from ray_tpu import config as config_mod
+
+    config_mod.reset_for_testing()
+    config_mod.cfg().apply_overrides({
+        "collective_watchdog_interval_s": 0.1,
+        "collective_peer_miss_threshold": 3,
+        "collective_op_timeout_s": 60.0,
+    })
+    yield config_mod.cfg()
+    config_mod.reset_for_testing()
+
+
+def test_inflight_allreduce_aborts_on_dead_peer(fast_watchdog):
+    comms = _make_pair("wd-peer-loss", *_mem_kv())
+    try:
+        # Healthy path first: both ranks participate.
+        out = [None, None]
+
+        def ar(rank):
+            out[rank] = comms[rank].allreduce(np.array([rank + 1.0]))
+
+        threads = [threading.Thread(target=ar, args=(r,)) for r in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert out[0] == out[1] == np.array([3.0])
+
+        # "Host death": rank 1's watchdog stops beating (a dead process
+        # writes no heartbeats) and rank 1 never joins the next op. Rank 0
+        # blocks waiting for its contribution — the watchdog must abort in
+        # ~miss_threshold * interval, not the 120 s socket timeout.
+        comms[1]._watchdog.stop()
+        start = time.monotonic()
+        with pytest.raises(CollectiveAbortError) as exc:
+            comms[0].allreduce(np.ones(4))
+        elapsed = time.monotonic() - start
+        assert elapsed < 10, f"abort took {elapsed:.1f}s"
+        assert "peer rank 1" in str(exc.value)
+        assert exc.value.group_name == "wd-peer-loss"
+    finally:
+        for c in comms:
+            if c is not None:
+                c.close()
+
+
+def test_kv_abort_flag_unblocks_and_propagates(fast_watchdog):
+    from ray_tpu.collective.communicator import abort_key
+
+    put, get = _mem_kv()
+    comms = _make_pair("wd-kv-abort", put, get)
+    try:
+        state = {}
+
+        def blocked_ar():
+            start = time.monotonic()
+            try:
+                comms[0].allreduce(np.ones(2))
+            except CollectiveAbortError as e:
+                state["error"] = e
+                state["elapsed"] = time.monotonic() - start
+
+        t = threading.Thread(target=blocked_ar)
+        t.start()
+        time.sleep(0.3)  # let rank 0 block waiting on rank 1
+        # Out-of-band abort (what the Train controller's gang restart and
+        # abort_collective_group do): write the group's KV abort flag.
+        put(abort_key("wd-kv-abort"), "controller: gang restart")
+        t.join(15)
+        assert not t.is_alive()
+        assert "controller: gang restart" in str(state["error"])
+        assert state["elapsed"] < 10
+        # Local abort also propagated nothing extra needed on rank 1: its
+        # watchdog reads the same flag and poisons future ops.
+        with pytest.raises(CollectiveAbortError):
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                comms[1].check_abort()
+                time.sleep(0.05)
+            raise AssertionError("rank 1 never observed the KV abort flag")
+    finally:
+        for c in comms:
+            if c is not None:
+                c.close()
+
+
+def test_fresh_group_clears_stale_abort_flag(fast_watchdog):
+    """A restarted (same-named) group must not be poisoned by the previous
+    attempt's abort flag: rank 0 clears it before publishing the root
+    address."""
+    from ray_tpu.collective.communicator import abort_key
+
+    put, get = _mem_kv()
+    put(abort_key("wd-restart"), "leftover from dead attempt")
+    comms = _make_pair("wd-restart", put, get)
+    try:
+        assert get(abort_key("wd-restart")) == ""
+        out = [None, None]
+
+        def ar(rank):
+            out[rank] = comms[rank].allreduce(np.array([1.0]))
+
+        threads = [threading.Thread(target=ar, args=(r,)) for r in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert out[0] == out[1] == np.array([2.0])
+    finally:
+        for c in comms:
+            if c is not None:
+                c.close()
+
+
+def test_destroy_collective_group_aborts_inflight(fast_watchdog):
+    """destroy/close while a thread is blocked inside an op unblocks it with
+    CollectiveAbortError (not a 120 s hang or a raw socket error)."""
+    comms = _make_pair("wd-destroy", *_mem_kv())
+    state = {}
+
+    def blocked_ar():
+        try:
+            comms[0].allreduce(np.ones(2))
+        except CollectiveAbortError as e:
+            state["error"] = e
+        except Exception as e:  # pragma: no cover
+            state["unexpected"] = e
+
+    t = threading.Thread(target=blocked_ar)
+    t.start()
+    time.sleep(0.3)
+    comms[0].close()
+    t.join(15)
+    comms[1].close()
+    assert not t.is_alive()
+    assert "unexpected" not in state, state
+    assert isinstance(state.get("error"), CollectiveAbortError)
+
+
+# ---------------------------------------------------------------------------
+# (c) End to end: elastic Train run survives a mid-run SliceKiller strike.
+# ---------------------------------------------------------------------------
+
+def _slice_train_fn(config):
+    import json
+    import os
+    import tempfile
+    import time as _time
+
+    import numpy as _np
+
+    from ray_tpu import train as t
+    from ray_tpu.train.backend import allreduce_gradients
+
+    ctx = t.get_context()
+    start = 0
+    ckpt = t.get_checkpoint()
+    if ckpt is not None:
+        with open(os.path.join(ckpt.path, "state.json")) as f:
+            start = json.load(f)["step"] + 1
+    for step in range(start, 8):
+        # Out-of-graph gradient sync over the group's collective backend —
+        # this is what wedges (then aborts) when the slice dies mid-step.
+        grad = allreduce_gradients(_np.ones(4) * (ctx.get_world_rank() + 1))
+        assert grad.shape == (4,)
+        _time.sleep(0.25)
+        metrics = {"step": step, "world": ctx.get_world_size()}
+        if ctx.get_world_rank() == 0:
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"step": step}, f)
+            t.report(metrics, checkpoint=t.Checkpoint(d))
+        else:
+            t.report(metrics)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_elastic_train_survives_slice_strike(tmp_path):
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train.config import (CheckpointConfig, FailureConfig,
+                                      RunConfig, ScalingConfig)
+    from ray_tpu.train.controller import TrainController
+    from ray_tpu.util.fault_injection import SliceKiller
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=2)  # head
+        for i in range(2):
+            cluster.add_node(num_cpus=1, resources={"slicehost": 1},
+                             labels=slice_labels("trillium-0", "v5e-16", i))
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(3)
+
+        controller = TrainController(
+            _slice_train_fn, train_loop_config={},
+            scaling_config=ScalingConfig(
+                num_workers=2,
+                resources_per_worker={"CPU": 1.0, "slicehost": 1.0}),
+            run_config=RunConfig(
+                name="slice-strike", storage_path=str(tmp_path),
+                checkpoint_config=CheckpointConfig(num_to_keep=2),
+                failure_config=FailureConfig(max_failures=3)),
+            backend="collective")
+
+        box = {}
+
+        def run():
+            try:
+                box["result"] = controller.run(poll_interval=0.2)
+            except BaseException as e:  # pragma: no cover
+                box["crash"] = e
+
+        runner = threading.Thread(target=run, daemon=True)
+        runner.start()
+
+        # Let training make real progress (at least one checkpoint) before
+        # the strike, so recovery provably resumes rather than restarts.
+        deadline = time.monotonic() + 90
+        while (time.monotonic() < deadline
+               and controller.ckpt_manager.latest_checkpoint is None):
+            time.sleep(0.2)
+        assert controller.ckpt_manager.latest_checkpoint is not None, \
+            "no checkpoint before strike"
+
+        killer = SliceKiller(cluster, slice_name="trillium-0")
+        assert killer.strike() is not None
+        # Autoscaler analog: a repaired slice joins with fresh hosts; the
+        # gang restart places the new worker group there.
+        for i in range(2):
+            cluster.add_node(num_cpus=1, resources={"slicehost": 1},
+                             labels=slice_labels("trillium-1", "v5e-16", i))
+
+        runner.join(240)
+        assert not runner.is_alive(), "train run did not finish after strike"
+        assert "crash" not in box, box.get("crash")
+        result = box["result"]
+        assert result.error is None, result.error
+        assert result.metrics["step"] == 7
+        assert result.metrics["world"] == 2
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
