@@ -15,12 +15,32 @@ from __future__ import annotations
 
 import pickle
 import struct
-from typing import Any, List, Optional, Tuple
+import sys
+from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
+import numpy as _np
 
 # Buffers >= this go out-of-band (below it, copying beats the bookkeeping).
 OUT_OF_BAND_THRESHOLD = 16 * 1024
+
+# Per-process serialization counters. `pickle` counts SLOW-path value
+# serializations (a cloudpickle.dumps of the object graph — the thing the
+# compiled-graph steady state must never do to an activation); the fast_*
+# counters count header-only encodes whose payload bytes move as raw views.
+# Tests snapshot these to prove zero host pickling on pipeline hot paths.
+counters: Dict[str, int] = {
+    "pickle": 0, "fast_ndarray": 0, "fast_bytes": 0, "fast_device": 0,
+    "fast_close": 0, "deserialize_pickle": 0, "deserialize_fast": 0,
+}
+
+
+def counter_snapshot() -> Dict[str, int]:
+    return dict(counters)
+
+
+def counter_delta(since: Dict[str, int]) -> Dict[str, int]:
+    return {k: counters[k] - since.get(k, 0) for k in counters}
 
 
 def _align8(n: int) -> int:
@@ -48,6 +68,46 @@ def serialize_with_refs(value: Any) -> Tuple[List, int, List]:
 _FLAG_FAST = 0x8000_0000
 _FAST_NDARRAY = 1
 _FAST_BYTES = 2
+_FAST_DEVICE = 3  # jax.Array: dlpack host view out, device_put back in
+_FAST_CLOSE = 4   # dag.channel CLOSE sentinel: protocol frame, no payload
+
+
+def _device_array_view(value: Any):
+    """If `value` is a jax array we can move as raw bytes, return
+    (numpy_host_view, dtype_name); else None.
+
+    dlpack gives a zero-copy host view on the CPU backend (on TPU the
+    fallback `np.asarray` is the one unavoidable D2H copy at the transfer
+    seam) — either way the payload crosses processes as raw bytes, never
+    through pickle. Sharded / multi-device arrays fall back to the pickle
+    path, which understands jax's own reducers.
+    """
+    # sys.modules holds jax from the first `import jax` STATEMENT, before
+    # its module body finishes — another thread serializing during that
+    # window sees a partial module with no `Array` attribute. No jax array
+    # can exist in the process until the import completes, so a missing
+    # attribute safely means "not a jax array".
+    jax = sys.modules.get("jax")
+    jax_array_t = getattr(jax, "Array", None)
+    if jax_array_t is None or not isinstance(value, jax_array_t):
+        return None
+    import numpy as np
+    try:
+        if not value.is_fully_addressable or len(value.sharding.device_set) != 1:
+            return None
+        # jax dispatch is async (even on CPU): the buffer behind the dlpack
+        # view may still be being written by XLA when the channel memcpy
+        # runs. Synchronize first — this is the same fence device_get takes.
+        value.block_until_ready()
+        try:
+            host = np.from_dlpack(value)
+        except Exception:
+            host = np.asarray(value)  # e.g. bfloat16: numpy lacks the dtype
+        if not host.flags.c_contiguous:
+            host = np.ascontiguousarray(host)
+        return host, str(value.dtype)
+    except Exception:
+        return None  # deleted/donated buffers etc.: let pickle raise cleanly
 
 
 def _try_fast_serialize(value: Any) -> Optional[Tuple[List, int]]:
@@ -66,6 +126,7 @@ def _try_fast_serialize(value: Any) -> Optional[Tuple[List, int]]:
             raw = memoryview(value).cast("B")
         except (ValueError, TypeError):
             return None  # exotic dtype: pickle path handles it
+        counters["fast_ndarray"] += 1
     elif type(value) is bytes:
         # bytes ONLY: bytearray must round-trip as bytearray (mutable),
         # which the pickle path preserves.
@@ -73,8 +134,31 @@ def _try_fast_serialize(value: Any) -> Optional[Tuple[List, int]]:
             return None
         meta = pickle.dumps((_FAST_BYTES, None, None), protocol=5)
         raw = memoryview(value)
+        counters["fast_bytes"] += 1
     else:
-        return None
+        # The channel CLOSE sentinel is protocol, not payload — it rides a
+        # zero-byte fast frame so even teardown stays pickle-free (the
+        # steady-state counters must not blame CLOSE on the data path).
+        # Lazy module check mirrors _device_array_view: if dag.channel was
+        # never imported here, value cannot be its sentinel.
+        ch_mod = sys.modules.get("ray_tpu.dag.channel")
+        if ch_mod is not None and isinstance(value,
+                                             getattr(ch_mod, "_CloseToken",
+                                                     ())):
+            meta = pickle.dumps((_FAST_CLOSE, None, None), protocol=5)
+            raw = memoryview(b"")
+            counters["fast_close"] += 1
+        else:
+            dev = _device_array_view(value)
+            if dev is None:
+                return None
+            # No size floor: even a scalar loss must never force
+            # device_get + pickle on the pipeline hot path.
+            host, dtype_name = dev
+            meta = pickle.dumps((_FAST_DEVICE, dtype_name, host.shape),
+                                protocol=5)
+            raw = memoryview(host).cast("B")
+            counters["fast_device"] += 1
     header = struct.pack("<IQ", _FLAG_FAST | 1, len(meta)) + struct.pack(
         "<Q", raw.nbytes)
     segments: List = [header, meta]
@@ -106,6 +190,7 @@ def serialize(value: Any) -> Tuple[List, int]:
             return False  # out-of-band
         return True  # in-band
 
+    counters["pickle"] += 1
     pickled = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
     raw_views = [b.raw() for b in buffers]
     header = struct.pack("<IQ", len(raw_views), len(pickled)) + b"".join(
@@ -134,23 +219,50 @@ def join_segments(segments: List) -> bytes:
     return b"".join(bytes(s) if isinstance(s, memoryview) else s for s in segments)
 
 
-class PinnedBuffer:
-    """A PEP-688 buffer that pins `pin` (e.g. a StoreBuffer read reference)
-    for as long as any consumer (numpy array, bytes view) is alive.
+class PinnedBuffer(_np.ndarray):
+    """An ndarray view over a store buffer that pins `pin` (e.g. a
+    StoreBuffer read reference) for as long as any derived array is alive.
 
-    Zero-copy deserialization hands these to pickle: reconstructed arrays keep
-    the PinnedBuffer as their base, so the store refcount is held until the
-    arrays are garbage collected — eviction can never reuse live bytes.
+    Lifetime subtleties this class exists to get right:
+
+    - numpy view/frombuffer chains COLLAPSE their base to the root plain
+      ndarray — a subclass instance (and any attribute on it) is dropped
+      from the chain, so the pin must NOT live on the subclass object.
+    - jax's zero-copy `device_put` aliases the bytes of a plain ndarray and
+      retains that exact object, but does not retain ndarray *subclasses*.
+
+    So the pin is anchored with `weakref.finalize` to the inner plain uint8
+    array (`.root`): every numpy view built over this buffer keeps `root`
+    as its base, and `root` is also what jax retains after
+    `np.frombuffer(pinned, ...)`. The store read reference is released only
+    when the last derived array (host or device) is garbage collected —
+    eviction can never recycle live bytes. An ndarray subclass (not a
+    PEP-688 `__buffer__` class) because buffer-protocol consumers must work
+    on every Python we support.
     """
 
-    __slots__ = ("_view", "_pin")
+    _pin: Any = None
+    root: Any = None
 
-    def __init__(self, view: memoryview, pin: Any):
-        self._view = view
+    def __new__(cls, view: memoryview, pin: Any):
+        import numpy as np
+        import weakref
+
+        root = np.frombuffer(view, dtype=np.uint8)
+        if pin is not None:
+            # The registry entry holds `pin` until `root` is collected;
+            # the callback itself is a no-op — dropping the reference is
+            # the release (StoreBuffer.__del__ decrements the store ref).
+            weakref.finalize(root, _drop_pin, pin)
+        self = root.view(cls)
         self._pin = pin
+        self.root = root
+        return self
 
-    def __buffer__(self, flags):
-        return memoryview(self._view)
+
+def _drop_pin(pin: Any) -> None:
+    """Finalizer target: exists only so weakref.finalize keeps `pin` alive
+    exactly as long as the pinned root array."""
 
 
 def deserialize(payload, pin: Any = None) -> Any:
@@ -163,7 +275,9 @@ def deserialize(payload, pin: Any = None) -> Any:
     view = payload if isinstance(payload, memoryview) else memoryview(payload)
     n_buffers, pickle_len = struct.unpack_from("<IQ", view, 0)
     if n_buffers & _FLAG_FAST:
+        counters["deserialize_fast"] += 1
         return _fast_deserialize(view, pickle_len, pin)
+    counters["deserialize_pickle"] += 1
     lens = struct.unpack_from(f"<{n_buffers}Q", view, 12) if n_buffers else ()
     off = 12 + 8 * n_buffers
     pickled = view[off:off + pickle_len]
@@ -190,6 +304,50 @@ def _fast_deserialize(view: memoryview, meta_len: int, pin: Any):
         # bytes are immutable python objects: one copy at get (same as the
         # pickled path, which also copies in-band bytes).
         return bytes(chunk)
+    if kind == _FAST_DEVICE:
+        return _device_from_raw(chunk, dtype_str, shape, pin)
+    if kind == _FAST_CLOSE:
+        from ray_tpu.dag.channel import CLOSE
+
+        return CLOSE
     src = PinnedBuffer(chunk, pin) if pin is not None else chunk
     arr = np.frombuffer(src, dtype=np.dtype(dtype_str)).reshape(shape)
     return arr
+
+
+def _resolve_dtype(dtype_name: str):
+    import numpy as np
+    try:
+        return np.dtype(dtype_name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 and friends register here, not in numpy
+        return np.dtype(getattr(ml_dtypes, dtype_name))
+
+
+def _device_from_raw(chunk: memoryview, dtype_name: str, shape, pin: Any,
+                     device=None):
+    """Rebuild a jax array from raw bytes: one synchronous host memcpy out
+    of the store view, then device_put of the private copy.
+
+    The copy is deliberate, not a missed optimization. Aliasing the store
+    bytes (device_put zero-copies page-aligned hosts on the CPU backend)
+    ties the ring slot's lifetime to when XLA drops the host reference —
+    which happens inside a jax-internal reference cycle, i.e. at an
+    arbitrary future gc, not at array death. A bounded channel ring whose
+    slots free at gc time stalls its writer; a copy costs ~0.1 ms/MiB and
+    makes the slot reusable the moment this returns. On TPU the equivalent
+    copy is the H2D DMA at the transfer seam, fenced before the read
+    reference is dropped. No pin needs to outlive this call.
+
+    jnp.asarray (not device_put) for the default placement: it ingests the
+    host copy synchronously on the calling thread, where device_put's
+    async-transfer handoff can burn a scheduling quantum per array on
+    small hosts."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    host = np.frombuffer(chunk, dtype=_resolve_dtype(dtype_name)).reshape(shape)
+    if device is not None:
+        return jax.device_put(np.array(host), device)
+    return jnp.asarray(np.array(host))

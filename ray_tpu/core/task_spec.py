@@ -37,6 +37,11 @@ class TaskSpec:
     # completes (args must survive the submit->execute window even if the
     # caller drops its refs; reference: task_manager.h holds arg refs).
     pinned_oids: Optional[List[bytes]] = None
+    # Trace propagation: the caller's trace id and the span it submitted
+    # from (util.tracing). The executing worker adopts these so its
+    # execute span parents under the driver's submit span.
+    trace_id: Optional[bytes] = None
+    parent_span_id: Optional[bytes] = None
 
     def to_wire(self) -> bytes:
         """Encode the envelope as a wire.TaskSpecMsg (core_worker.proto:441
@@ -54,6 +59,8 @@ class TaskSpec:
             method_name=self.method_name or "", seq_no=self.seq_no,
             placement_group_id=self.placement_group_id or b"",
             placement_group_bundle_index=self.placement_group_bundle_index,
+            trace_id=self.trace_id or b"",
+            parent_span_id=self.parent_span_id or b"",
             ).encode()
 
     @classmethod
@@ -82,7 +89,9 @@ class TaskSpec:
             placement_group_id=m.placement_group_id or None,
             placement_group_bundle_index=m.placement_group_bundle_index,
             runtime_env=runtime_env,
-            pinned_oids=list(pinned) if pinned else None)
+            pinned_oids=list(pinned) if pinned else None,
+            trace_id=m.trace_id or None,
+            parent_span_id=m.parent_span_id or None)
 
 
 @dataclass

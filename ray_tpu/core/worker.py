@@ -38,6 +38,7 @@ from ray_tpu.runtime.object_store.spill import SpillManager
 from ray_tpu.runtime.object_store.store import StoreFullError
 from ray_tpu.runtime.rpc import (ConnectionLost, EventLoopThread, RpcClient,
                                  RpcError, RpcServer)
+from ray_tpu.util import tracing
 from ray_tpu.utils.ids import ObjectID, TaskID
 
 logger = logging.getLogger(__name__)
@@ -233,7 +234,9 @@ class CoreWorker:
         if fut is not None:
             try:
                 fut.result(timeout)
-            except TimeoutError:
+            # On 3.10 concurrent.futures.TimeoutError is NOT the builtin
+            # TimeoutError (they merge in 3.11) — catch both.
+            except (TimeoutError, concurrent.futures.TimeoutError):
                 raise GetTimeoutError(f"get() timed out waiting for {ref}")
             with self._mem_lock:
                 if oid in self.memory_store:
@@ -941,7 +944,11 @@ class CoreWorker:
             max_retries=max_retries, scheduling_strategy=scheduling_strategy,
             placement_group_id=placement_group_id,
             placement_group_bundle_index=bundle_index,
-            runtime_env=runtime_env, pinned_oids=pins)
+            runtime_env=runtime_env, pinned_oids=pins,
+            # Propagate the caller's trace context (if any): the executing
+            # worker adopts it so its execute span parents under ours.
+            trace_id=tracing.current_trace_id(),
+            parent_span_id=tracing.current_span_id())
         self.pin_args(pins)
         self._record_task_event(spec, "SUBMITTED")
         if num_returns == self.STREAMING:
@@ -1639,7 +1646,9 @@ class CoreWorker:
         spec = TaskSpec(task_id=task_id, fn_id=b"", name=name, args=ser_args,
                         kwarg_names=names, num_returns=num_returns,
                         max_retries=max_task_retries, actor_id=actor_id,
-                        method_name=method_name, pinned_oids=pins)
+                        method_name=method_name, pinned_oids=pins,
+                        trace_id=tracing.current_trace_id(),
+                        parent_span_id=tracing.current_span_id())
         self.pin_args(pins)
         self._record_task_event(spec, "SUBMITTED")
         client = self._actor_clients.get(actor_id)

@@ -1,5 +1,6 @@
 """Compiled graphs: a lazily-bound DAG API over actors/tasks that can be
-lowered onto persistent actor loops connected by shared-memory channels.
+lowered onto persistent actor loops connected by device-resident channels
+and executed from static per-actor READ/COMPUTE/WRITE schedules.
 
 Reference analog: python/ray/dag/ + python/ray/experimental/channel/.
 """
@@ -7,11 +8,15 @@ Reference analog: python/ray/dag/ + python/ray/experimental/channel/.
 from ray_tpu.dag.channel import ChannelClosed, ShmChannel  # noqa: F401
 from ray_tpu.dag.collective import allreduce  # noqa: F401
 from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef  # noqa: F401
+from ray_tpu.dag.device_channel import (CollectiveChannel,  # noqa: F401
+                                        DeviceChannel)
 from ray_tpu.dag.node import (ClassMethodNode, DAGNode, FunctionNode,  # noqa: F401
                               InputNode, MultiOutputNode)
+from ray_tpu.dag.schedule import COMPUTE, READ, WRITE, ScheduleOp  # noqa: F401
 
 __all__ = [
     "DAGNode", "InputNode", "MultiOutputNode", "ClassMethodNode",
     "FunctionNode", "CompiledDAG", "CompiledDAGRef", "ShmChannel",
-    "ChannelClosed", "allreduce",
+    "DeviceChannel", "CollectiveChannel", "ChannelClosed", "allreduce",
+    "ScheduleOp", "READ", "COMPUTE", "WRITE",
 ]

@@ -178,18 +178,32 @@ class ShmChannel:
             raise TimeoutError(f"channel read timed out (version {self._rv})")
         value = serialization.deserialize(buf.data, pin=buf)
         self._rv += 1
-        # Free old versions; keep the most recent buffer alive so zero-copy
-        # views handed to the caller on the previous read stay valid until
-        # they have moved on one iteration.
+        # Free consumed versions. A delete can legitimately fail while a
+        # zero-copy view handed to the caller still pins the buffer (store
+        # refcount > 0) — keep the oid queued and retry on later reads: the
+        # slot frees the moment the consumer's last array dies, and until
+        # then the writer's contains() backpressure correctly treats the
+        # ring slot as occupied.
         self._retired.append(oid)
-        while len(self._retired) > 1:
-            store.delete(self._retired.popleft())
+        while self._retired and store.delete(self._retired[0]):
+            self._retired.popleft()
         if isinstance(value, _CloseToken):
             raise ChannelClosed()
         return value
 
     def drain(self) -> None:
-        """Reader-side cleanup after the loop exits."""
+        """Reader-side cleanup after the loop exits. Pinned buffers (live
+        zero-copy consumers) survive — their finalizers release the store
+        refs, at which point the versions become deletable; everything
+        unpinned is freed here."""
         store = _store()
-        while self._retired:
-            store.delete(self._retired.popleft())
+        import gc
+
+        remaining = [oid for oid in self._retired if not store.delete(oid)]
+        if remaining:
+            # Drop collectable pins (reference cycles through jax arrays)
+            # before the final attempt, then leave true survivors to their
+            # finalizers.
+            gc.collect()
+            remaining = [oid for oid in remaining if not store.delete(oid)]
+        self._retired = deque(remaining)

@@ -22,6 +22,11 @@ _coll_counter = itertools.count()
 class _AllReduce:
     def bind(self, nodes: Sequence[DAGNode], op: str = "sum") -> List[CollectiveOutputNode]:
         nodes = list(nodes)
+        if op not in ("sum", "mean"):
+            # Fail at bind time: a bad op inside the compiled loop would
+            # only surface as a wedged pipeline after the first execute().
+            raise ValueError(f"unsupported allreduce op {op!r} "
+                             "(supported: 'sum', 'mean')")
         if len(nodes) < 2:
             raise ValueError("allreduce needs at least 2 participant nodes")
         actors = set()

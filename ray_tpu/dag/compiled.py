@@ -14,7 +14,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import worker as worker_mod
 from ray_tpu.dag import executor
+from ray_tpu.dag import schedule as sched_mod
 from ray_tpu.dag.channel import ChannelClosed, ShmChannel
+from ray_tpu.dag.device_channel import DeviceChannel
 from ray_tpu.dag.node import (ClassMethodNode, CollectiveOutputNode, DAGNode,
                               FunctionNode, InputAttributeNode, InputNode,
                               MultiOutputNode)
@@ -50,6 +52,9 @@ class CompiledDAG:
         self._core = worker_mod.global_worker()
         self._input_channels: List[ShmChannel] = []
         self._output_channels: List[ShmChannel] = []
+        # Static per-actor READ/COMPUTE/WRITE schedules, keyed by actor id —
+        # the exact slot sequence each loop replays (see dag/schedule.py).
+        self.actor_schedules: Dict[bytes, List[sched_mod.ScheduleOp]] = {}
         self._loop_refs = []
         self._exec_count = 0
         self._fetch_count = 0
@@ -102,7 +107,9 @@ class CompiledDAG:
                 if src_aid != consumer_aid:
                     key = (x.node_id, consumer_aid)
                     if key not in edge_channels:
-                        ch = ShmChannel(capacity=self.buffer_size)
+                        # Device-resident data edge: jax activations cross as
+                        # raw dlpack bytes and land on the consumer's device.
+                        ch = DeviceChannel(capacity=self.buffer_size)
                         edge_channels[key] = ch
                         op_by_node[x.node_id]["writes"].append(ch)
                         consumer_op["reads"].append((x.node_id, ch))
@@ -162,7 +169,7 @@ class CompiledDAG:
         for t in outputs:
             if owner(t) is None:
                 raise ValueError("DAG output must be an actor-method node")
-            ch = ShmChannel(capacity=self.buffer_size)
+            ch = DeviceChannel(capacity=self.buffer_size)
             op_by_node[t.node_id]["writes"].append(ch)
             self._output_channels.append(ch)
 
@@ -171,6 +178,12 @@ class CompiledDAG:
             if plan["input_channel"] is None and not any(
                     op["reads"] for op in plan["ops"]):
                 self._need_input(plan)
+
+        # compile each actor's static READ/COMPUTE/WRITE schedule — the loop
+        # replays this slot list verbatim every iteration (dag/executor.py)
+        for aid, plan in plans.items():
+            plan["schedule"] = sched_mod.compile_plan_schedule(plan)
+            self.actor_schedules[aid] = plan["schedule"]
 
         # launch loops
         handles = {owner(n): n.actor for n in nodes
@@ -186,6 +199,14 @@ class CompiledDAG:
             ch = ShmChannel(capacity=self.buffer_size)
             plan["input_channel"] = ch
             self._input_channels.append(ch)
+
+    def schedule_report(self) -> str:
+        """Human-readable dump of every actor's static schedule."""
+        parts = []
+        for aid, sched in self.actor_schedules.items():
+            parts.append(f"actor {aid.hex()[:8]}:")
+            parts.append(sched_mod.describe(sched))
+        return "\n".join(parts)
 
     # -- execution ----------------------------------------------------------
     def execute(self, *args, **kwargs) -> CompiledDAGRef:

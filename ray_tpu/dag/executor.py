@@ -2,12 +2,14 @@
 
 Reference analog: the generated actor loop of
 python/ray/dag/compiled_dag_node.py (ExecutableTask:451, _execute_until:2436)
-with the static READ -> COMPUTE -> WRITE schedule of dag_node_operation.py:17-34:
-each op reads exactly its own input channels just before computing and writes
-its outputs immediately after, so a graph that revisits an actor through
-another actor (a -> b -> a) streams instead of deadlocking. The worker runtime
-dispatches method name `__ray_dag_loop__` here (runtime/worker_main.py), so
-user classes need no special support.
+driven by the static READ -> COMPUTE -> WRITE schedule of
+dag_node_operation.py:17-34. The loop executes the actor's compiled
+`plan["schedule"]` (a list of schedule.ScheduleOp) verbatim each iteration —
+not ad-hoc per-call dispatch — so an op reads exactly its own input channels
+just before computing and writes its outputs immediately after, and a graph
+that revisits an actor through another actor (a -> b -> a) streams instead of
+deadlocking. The worker runtime dispatches method name `__ray_dag_loop__`
+here (runtime/worker_main.py), so user classes need no special support.
 """
 
 from __future__ import annotations
@@ -15,6 +17,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Dict
 
+from ray_tpu.dag import schedule as sched_mod
 from ray_tpu.dag.channel import ChannelClosed, ShmChannel
 
 logger = logging.getLogger(__name__)
@@ -51,8 +54,41 @@ def _fill(x, values: Dict[int, Any], inp):
     return x
 
 
+def _compute(actor_instance, op: dict, values: Dict[int, Any], inp) -> None:
+    """Run one COMPUTE slot, storing the result under the op's node id."""
+    from ray_tpu.collective import collective as cc
+
+    if op["kind"] == "method":
+        method = getattr(actor_instance, op["method"])
+        args = _fill(op["args"], values, inp)
+        kwargs = _fill(op["kwargs"], values, inp)
+        values[op["node_id"]] = method(*args, **kwargs)
+    elif op["kind"] == "collective":
+        import sys
+
+        import numpy as np
+
+        src_val = values[op["src"]]
+        local = np.asarray(src_val)
+        reduced = cc.allreduce(local, group_name=op["group"])
+        if op["reduce_op"] == "mean":
+            world = cc.get_collective_group_size(op["group"])
+            reduced = reduced / world
+        # getattr, not attribute access: sys.modules holds jax mid-import
+        # with no Array attribute yet (see serialization._device_array_view).
+        jax = sys.modules.get("jax")
+        if getattr(jax, "Array", None) is not None \
+                and isinstance(src_val, jax.Array):
+            # Device-in, device-out: downstream ops and channel writes stay
+            # on the no-pickle fast path.
+            reduced = jax.device_put(reduced)
+        values[op["node_id"]] = reduced
+    else:
+        raise ValueError(f"unknown op kind {op['kind']!r}")
+
+
 def run_loop(actor_instance, plan: dict) -> dict:
-    """Blocking loop over the static schedule:
+    """Blocking loop executing the actor's static schedule:
 
     plan = {
       "collective_groups": [(group_name, world_size, rank)],
@@ -62,7 +98,14 @@ def run_loop(actor_instance, plan: dict) -> dict:
                "src", "group", "reduce_op",         # collective ops
                "reads": [(producer_node_id, ShmChannel)],  # per-op READ
                "writes": [ShmChannel]}],                   # per-op WRITE
+      "schedule": [schedule.ScheduleOp],    # the static per-iteration plan
     }
+
+    Every iteration replays plan["schedule"] slot by slot (compiled once by
+    schedule.compile_plan_schedule; recomputed here only for plans from
+    older drivers). Channel reads block, so the schedule order IS the
+    overlap plan: upstream compute proceeds while this actor waits on a
+    READ slot.
     """
     from ray_tpu.collective import collective as cc
 
@@ -75,6 +118,9 @@ def run_loop(actor_instance, plan: dict) -> dict:
 
     input_channel: ShmChannel = plan.get("input_channel")
     ops = plan["ops"]
+    schedule = plan.get("schedule")
+    if schedule is None:
+        schedule = sched_mod.compile_plan_schedule(plan)
     all_writes = [ch for op in ops for ch in op.get("writes", [])]
     all_reads = [ch for op in ops for _, ch in op.get("reads", [])]
     iterations = 0
@@ -83,29 +129,23 @@ def run_loop(actor_instance, plan: dict) -> dict:
             values: Dict[int, Any] = {}
             inp = None
             try:
-                if input_channel is not None:
-                    inp = input_channel.read()
-                for op in ops:
-                    for producer_id, ch in op.get("reads", []):
-                        values[producer_id] = ch.read()
-                    if op["kind"] == "method":
-                        method = getattr(actor_instance, op["method"])
-                        args = _fill(op["args"], values, inp)
-                        kwargs = _fill(op["kwargs"], values, inp)
-                        values[op["node_id"]] = method(*args, **kwargs)
-                    elif op["kind"] == "collective":
-                        import numpy as np
-
-                        local = np.asarray(values[op["src"]])
-                        reduced = cc.allreduce(local, group_name=op["group"])
-                        if op["reduce_op"] == "mean":
-                            world = cc.get_collective_group_size(op["group"])
-                            reduced = reduced / world
-                        values[op["node_id"]] = reduced
+                for slot in schedule:
+                    if slot.type == sched_mod.READ:
+                        if slot.op_index == sched_mod.INPUT_OP:
+                            inp = input_channel.read()
+                        else:
+                            for producer_id, ch in ops[slot.op_index]["reads"]:
+                                values[producer_id] = ch.read()
+                    elif slot.type == sched_mod.COMPUTE:
+                        _compute(actor_instance, ops[slot.op_index], values,
+                                 inp)
+                    elif slot.type == sched_mod.WRITE:
+                        op = ops[slot.op_index]
+                        for ch in op["writes"]:
+                            ch.write(values[op["node_id"]])
                     else:
-                        raise ValueError(f"unknown op kind {op['kind']!r}")
-                    for ch in op.get("writes", []):
-                        ch.write(values[op["node_id"]])
+                        raise ValueError(
+                            f"unknown schedule op type {slot.type!r}")
             except ChannelClosed:
                 break
             iterations += 1

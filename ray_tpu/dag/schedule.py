@@ -1,0 +1,80 @@
+"""Static per-actor READ/COMPUTE/WRITE schedules for compiled graphs.
+
+Reference analog: python/ray/dag/dag_node_operation.py
+(_DAGNodeOperationType:17 READ/COMPUTE/WRITE, _DAGOperationGraphNode,
+_build_dag_node_operation_graph). The reference topologically sorts a
+tri-partite operation graph so NCCL sends, receives, and compute overlap by
+plan; we lower each actor's plan to the same explicit op sequence, executed
+verbatim by `dag/executor.run_loop` every iteration. The schedule is data
+(inspectable by tests and `CompiledDAG.actor_schedules`), not emergent from
+per-call dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+READ = "READ"
+COMPUTE = "COMPUTE"
+WRITE = "WRITE"
+
+# op_index for schedule entries that do not map to a plan op (the DAG input
+# read at the top of every iteration).
+INPUT_OP = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleOp:
+    """One slot in an actor's static per-iteration schedule.
+
+    type:     READ | COMPUTE | WRITE
+    op_index: index into the actor plan's ``ops`` list (INPUT_OP for the
+              iteration-input read, which precedes every op).
+    node_id:  DAG node id the slot belongs to (-1 for the input read).
+    detail:   human-readable label — method name, channel role — for
+              schedule dumps and docs; never interpreted by the executor.
+    """
+
+    type: str
+    op_index: int
+    node_id: int
+    detail: str = ""
+
+    def __str__(self) -> str:
+        tag = self.detail or (f"node {self.node_id}" if self.node_id >= 0
+                              else "input")
+        return f"{self.type}({tag})"
+
+
+def compile_plan_schedule(plan: Dict[str, Any]) -> List[ScheduleOp]:
+    """Lower a compiled-DAG actor plan (see compiled.py:_build) into the
+    explicit op sequence its loop runs each iteration.
+
+    The per-actor order is the plan's topological op order; blocking channel
+    reads realize every cross-actor edge, so the concatenation of per-actor
+    schedules is deadlock-free exactly when the global DAG is acyclic —
+    which _build's topological lowering guarantees.
+    """
+    sched: List[ScheduleOp] = []
+    if plan.get("input_channel") is not None:
+        sched.append(ScheduleOp(READ, INPUT_OP, -1, detail="input"))
+    for i, op in enumerate(plan["ops"]):
+        node_id = op["node_id"]
+        if op.get("reads"):
+            srcs = ",".join(str(producer) for producer, _ch in op["reads"])
+            sched.append(ScheduleOp(READ, i, node_id, detail=f"from {srcs}"))
+        if op.get("kind") == "collective":
+            label = f"allreduce[{op.get('reduce_op', '')}]"
+        else:
+            label = op.get("method") or op.get("func_name") or "compute"
+        sched.append(ScheduleOp(COMPUTE, i, node_id, detail=label))
+        if op.get("writes"):
+            sched.append(ScheduleOp(WRITE, i, node_id,
+                                    detail=f"x{len(op['writes'])}"))
+    return sched
+
+
+def describe(schedule: List[ScheduleOp]) -> str:
+    """One line per slot — what `--inspect`-style tooling and docs print."""
+    return "\n".join(f"{i:3d}  {op}" for i, op in enumerate(schedule))

@@ -7,8 +7,9 @@ dag_node_operation.py:17-34) plus vLLM's internal PP placement
 axis (see parallel/mesh.py): stages are separate programs — on separate
 devices in one process (LocalPipeline: the dryrun/test path and the
 single-host multi-chip path) or separate actors (ActorPipeline: the
-multi-host path, activations riding the object plane the way compiled-graph
-channels do).
+multi-host path, activations handed off through compiled-graph
+DeviceChannels from a static per-actor READ/COMPUTE/WRITE schedule — no
+host pickling in the steady state).
 
 Memory model: full activation recomputation — backward re-runs the stage
 forward from the saved stage INPUT (cheap to store), so live memory per
@@ -395,12 +396,80 @@ class LocalPipeline:
 
 # ---------------------------------------------------------- actor pipeline
 
+def build_stage_plans(n_stages: int, interleave: int, n_microbatches: int):
+    """Compile the static per-actor channel plans for one ActorPipeline
+    configuration: the device-channel analog of CompiledDAG._build.
+
+    Returns (plans, driver_channels). plans[d] is actor d's plan — its
+    submission_order subsequence as ops wired to DeviceChannels, plus a
+    trailing optimizer "apply" op (and, on the actor hosting the last
+    chunk, a "loss_out" op that reports the step's mean loss), lowered to
+    a static READ/COMPUTE/WRITE schedule (dag/schedule.py) that
+    run_pipeline_loop replays once per train step. driver_channels holds
+    the driver's ends: "in" (token microbatches -> chunk 0), "tgt"
+    (targets -> last chunk), "loss" (mean step loss <- last chunk).
+
+    Channel capacities admit a full step of in-flight traffic plus the
+    next step's lead-in, so the only blocking reads are true data
+    dependencies — the schedule order, not ring backpressure, is the
+    overlap plan. FIFO channels need no microbatch tags: every schedule
+    (plain 1F1B and Megatron interleaved) produces and consumes each
+    boundary's microbatches in ascending order.
+    """
+    from ray_tpu.dag import schedule as dag_schedule
+    from ray_tpu.dag.device_channel import DeviceChannel
+
+    p, v, m = n_stages, max(1, interleave), n_microbatches
+    n_virtual = p * v
+    last = n_virtual - 1
+    cap = 2 * m + 2
+    in_ch = DeviceChannel(capacity=cap)
+    tgt_ch = DeviceChannel(capacity=cap)
+    loss_ch = DeviceChannel(capacity=4)
+    act_ch = {s: DeviceChannel(capacity=cap) for s in range(n_virtual - 1)}
+    grad_ch = {s: DeviceChannel(capacity=cap) for s in range(n_virtual - 1)}
+
+    per_actor_ops: List[List[dict]] = [[] for _ in range(p)]
+    for op in submission_order(p, v, m):
+        s, mb_i = op.stage, op.microbatch
+        entry = {"kind": op.kind, "chunk": s, "mb": mb_i, "reads": [],
+                 "writes": [], "method": f"{op.kind}[c{s},m{mb_i}]"}
+        if op.kind == "fwd":
+            entry["reads"].append(("in", in_ch) if s == 0
+                                  else ("act", act_ch[s - 1]))
+            if s != last:
+                entry["writes"].append(act_ch[s])
+        else:
+            entry["reads"].append(("tgt", tgt_ch) if s == last
+                                  else ("grad", grad_ch[s]))
+            if s > 0:
+                entry["writes"].append(grad_ch[s - 1])
+        per_actor_ops[s % p].append(entry)
+
+    plans = []
+    for d in range(p):
+        ops = per_actor_ops[d]
+        ops.append({"kind": "apply", "chunk": -1, "mb": -1, "reads": [],
+                    "writes": [], "method": "apply_updates"})
+        if last % p == d:
+            ops.append({"kind": "loss_out", "chunk": -1, "mb": -1,
+                        "reads": [], "writes": [loss_ch],
+                        "method": "loss_out"})
+        for i, o in enumerate(ops):
+            o["node_id"] = i
+        plan = {"ops": ops, "n_microbatches": m}
+        plan["schedule"] = dag_schedule.compile_plan_schedule(plan)
+        plans.append(plan)
+    return plans, {"in": in_ch, "tgt": tgt_ch, "loss": loss_ch}
+
+
 class PipelineStageActor:
     """Pipeline chunks hosted in an actor (multi-host PP). One actor per
     DEVICE/host; with interleaving it hosts several VIRTUAL stages
-    (chunks). Activations and gradients travel through the object plane —
-    plasma-backed actor calls, the same data path compiled-graph channels
-    ride."""
+    (chunks). Two transports: the channel loop (run_pipeline_loop —
+    device-resident hand-off, no host pickling of activations) and
+    per-op actor RPC (forward/backward — the baseline path, activations
+    riding the object plane as pickled host arrays)."""
 
     def __init__(self, chunk_ids, n_virtual: int, config_bytes: bytes,
                  chunk_params_bytes: bytes, opt_name: str = "adamw",
@@ -464,26 +533,145 @@ class PipelineStageActor:
         return cloudpickle.dumps(
             [jax.device_get(self.params[c]) for c in self.chunk_ids])
 
+    # -- channel transport --------------------------------------------------
+
+    def _pipeline_compute(self, op: dict, inp: Dict[str, Any],
+                          losses: List[float], n_microbatches: int):
+        kind = op["kind"]
+        if kind == "fwd":
+            c, mb = op["chunk"], op["mb"]
+            x = inp["in"] if "in" in inp else inp["act"]
+            self._saved[(c, mb)] = x
+            if self._fwd[c] is None:
+                return None  # last chunk: loss + grads come from its bwd
+            return self._fwd[c](self.params[c], x)
+        if kind == "bwd":
+            c, mb = op["chunk"], op["mb"]
+            x = self._saved.pop((c, mb))
+            if "tgt" in inp:
+                loss, (dp, dx) = self._bwd[c](self.params[c], x, inp["tgt"])
+                losses.append(float(loss))
+            else:
+                dp, dx = self._bwd[c](self.params[c], x, inp["grad"])
+            self._accumulate(c, dp)
+            return dx
+        if kind == "apply":
+            self.apply_updates(n_microbatches)
+            return None
+        if kind == "loss_out":
+            # A jax scalar, not a float: the loss rides the device fast
+            # path like every other steady-state value.
+            return jnp.asarray(sum(losses) / max(1, len(losses)),
+                               dtype=jnp.float32)
+        raise ValueError(f"unknown pipeline op kind {kind!r}")
+
+    def run_pipeline_loop(self, plan: dict) -> dict:
+        """Persistent channel-driven stage loop — the ActorPipeline analog
+        of dag/executor.run_loop. Replays the plan's static
+        READ/COMPUTE/WRITE schedule once per train step until the driver
+        closes the step-input channels, then cascades CLOSE downstream and
+        returns {"steps", "steady_serialization"} — the latter is this
+        process's serialization-counter delta over the post-warmup steps,
+        which tests assert contains ZERO pickles."""
+        from ray_tpu.core import serialization
+        from ray_tpu.dag import schedule as dag_schedule
+        from ray_tpu.dag.channel import ChannelClosed
+
+        ops = plan["ops"]
+        schedule = plan["schedule"]
+        m = plan["n_microbatches"]
+        read_chs = [ch for op in ops for _, ch in op["reads"]]
+        write_chs = [ch for op in ops for ch in op["writes"]]
+        steps = 0
+        steady_base = None
+        try:
+            while True:
+                losses: List[float] = []
+                pending: Dict[int, Dict[str, Any]] = {}
+                outputs: Dict[int, Any] = {}
+                try:
+                    for slot in schedule:
+                        op = ops[slot.op_index]
+                        if slot.type == dag_schedule.READ:
+                            pending[slot.op_index] = {
+                                role: ch.read() for role, ch in op["reads"]}
+                        elif slot.type == dag_schedule.COMPUTE:
+                            outputs[slot.op_index] = self._pipeline_compute(
+                                op, pending.pop(slot.op_index, {}), losses, m)
+                        else:  # WRITE
+                            val = outputs.pop(slot.op_index)
+                            for ch in op["writes"]:
+                                ch.write(val)
+                except ChannelClosed:
+                    break
+                steps += 1
+                if steps == 1:
+                    # Step 1 is warmup (jit compilation, channel opens);
+                    # the zero-pickle invariant is asserted on the delta
+                    # accumulated from here on.
+                    steady_base = serialization.counter_snapshot()
+        finally:
+            # Mirror dag/executor.run_loop: tombstone our reads (unwedges
+            # blocked upstream writers), CLOSE our writes (downstream
+            # loops exit at their next read), then free retained buffers.
+            for ch in read_chs:
+                try:
+                    ch.close_read()
+                except BaseException:
+                    pass
+            for ch in write_chs:
+                try:
+                    ch.close_write(timeout=10)
+                except BaseException:
+                    pass
+            for ch in read_chs:
+                try:
+                    ch.drain()
+                except BaseException:
+                    pass
+        return {"steps": steps,
+                "steady_serialization":
+                    serialization.counter_delta(steady_base)
+                    if steady_base is not None else None}
+
 
 class ActorPipeline:
-    """Driver-side coordinator for actor-hosted stages: submits ops in a
-    dependency-valid global order with pipelined actor calls (stages run
-    concurrently thanks to the pipelined actor transport). `interleave=v`
-    gives each actor v round-robin chunks and submits per-actor ops in the
-    Megatron interleaved order (megatron_interleaved_schedule), so each
-    actor's execution queue realizes the small-bubble schedule."""
+    """Driver-side coordinator for actor-hosted stages.
+
+    Default transport "channel": stages run persistent loops
+    (run_pipeline_loop) over their static READ/COMPUTE/WRITE schedules,
+    activations and gradients hand off stage-to-stage through
+    DeviceChannels (raw device bytes, zero host pickling), and the driver
+    only feeds token/target microbatches and reads back the step loss.
+    `interleave=v` gives each actor v round-robin chunks in the Megatron
+    interleaved order (megatron_interleaved_schedule), so each loop's
+    schedule realizes the small-bubble plan.
+
+    transport="rpc" keeps the per-op actor-call path (one task per
+    fwd/bwd, activations pickled over the object plane) — the baseline
+    the microbenchmark compares against.
+    """
 
     def __init__(self, config, params, n_stages: int, *, lr: float = 1e-3,
                  resources_per_stage: Optional[dict] = None,
-                 interleave: int = 1):
+                 interleave: int = 1, transport: str = "channel"):
         import cloudpickle
 
         import ray_tpu
 
+        if transport not in ("channel", "rpc"):
+            raise ValueError(f"unknown pipeline transport {transport!r}")
         self.config = config
         self.n_stages = n_stages
         self.interleave = max(1, interleave)
         self.n_virtual = n_stages * self.interleave
+        self.transport = transport
+        # Channel-loop state (channel transport only).
+        self._loop_refs: List[Any] = []
+        self._driver_ch: Optional[Dict[str, Any]] = None
+        self._loop_m: Optional[int] = None
+        self.stage_schedules: Dict[int, List[Any]] = {}
+        self.last_loop_stats: Optional[List[dict]] = None
         chunks = split_params(params, self.n_virtual)
         Stage = ray_tpu.remote(PipelineStageActor)
         opts = resources_per_stage or {"num_cpus": 0}
@@ -495,7 +683,111 @@ class ActorPipeline:
                 ids, self.n_virtual, cfg_b,
                 cloudpickle.dumps([chunks[c] for c in ids]), "adamw", lr))
 
+    # -- channel transport --------------------------------------------------
+
+    def _ensure_loops(self, n_microbatches: int) -> None:
+        """(Re)launch the stage loops if none are running or the microbatch
+        count changed (the static schedules are compiled per m)."""
+        if self._loop_refs and self._loop_m == n_microbatches:
+            return
+        self._stop_loops()
+        plans, chans = build_stage_plans(self.n_stages, self.interleave,
+                                         n_microbatches)
+        self.stage_schedules = {d: plans[d]["schedule"]
+                                for d in range(self.n_stages)}
+        self._driver_ch = chans
+        self._loop_m = n_microbatches
+        self._loop_refs = [self.actors[d].run_pipeline_loop.remote(plans[d])
+                           for d in range(self.n_stages)]
+
+    def _stop_loops(self) -> None:
+        """Close the step-input channels; the loops finish in-flight work,
+        cascade CLOSE downstream, and return their stats (retained in
+        .last_loop_stats). A loop that died with an error raises it here."""
+        import ray_tpu
+        from ray_tpu.dag.channel import ChannelClosed
+
+        if not self._loop_refs:
+            return
+        refs, self._loop_refs = self._loop_refs, []
+        chs, self._driver_ch = self._driver_ch, None
+        self._loop_m = None
+        for k in ("in", "tgt"):
+            try:
+                chs[k].close_write(timeout=10)
+            except BaseException:
+                pass
+        try:
+            while True:
+                chs["loss"].read(timeout=10)
+        except (ChannelClosed, TimeoutError):
+            pass
+        try:
+            chs["loss"].drain()
+        except BaseException:
+            pass
+        self.last_loop_stats = ray_tpu.get(refs, timeout=120)
+
+    def _raise_loop_error(self):
+        """The loss channel closed mid-step: a stage loop died. Unwind the
+        channels and surface the real task error."""
+        import ray_tpu
+
+        refs, self._loop_refs = self._loop_refs, []
+        chs, self._driver_ch = self._driver_ch, None
+        self._loop_m = None
+        if chs is not None:
+            try:
+                chs["loss"].close_read()
+            except BaseException:
+                pass
+            for k in ("in", "tgt"):
+                try:
+                    chs[k].close_write(timeout=5)
+                except BaseException:
+                    pass
+        for ref in refs:
+            try:
+                ray_tpu.get(ref, timeout=30)
+            except BaseException as e:  # noqa: BLE001 — surface task error
+                raise e
+        raise RuntimeError("pipeline stage loop exited unexpectedly")
+
+    def shutdown(self) -> None:
+        """Stop the stage loops (channel transport). Idempotent; the actors
+        survive and a later train_step relaunches the loops."""
+        self._stop_loops()
+
     def train_step(self, tokens, n_microbatches: int) -> Dict[str, float]:
+        if self.transport == "rpc":
+            return self._train_step_rpc(tokens, n_microbatches)
+        import numpy as np
+
+        from ray_tpu.dag.channel import ChannelClosed
+
+        B = tokens.shape[0]
+        assert B % n_microbatches == 0
+        mb = B // n_microbatches
+        inputs = np.asarray(tokens[:, :-1])
+        targets = np.asarray(tokens[:, 1:])
+        self._ensure_loops(n_microbatches)
+        try:
+            # jnp arrays so even the driver's feeds ride the device fast
+            # path — the whole steady state is pickle-free.
+            for i in range(n_microbatches):
+                self._driver_ch["in"].write(
+                    jnp.asarray(inputs[i * mb:(i + 1) * mb]), timeout=600)
+            for i in range(n_microbatches):
+                self._driver_ch["tgt"].write(
+                    jnp.asarray(targets[i * mb:(i + 1) * mb]), timeout=600)
+            loss = self._driver_ch["loss"].read(timeout=600)
+        except ChannelClosed:
+            self._raise_loop_error()
+        return {"loss": float(loss)}
+
+    # -- rpc transport (baseline) -------------------------------------------
+
+    def _train_step_rpc(self, tokens, n_microbatches: int) -> Dict[str, float]:
         import numpy as np
 
         import ray_tpu
@@ -542,6 +834,9 @@ class ActorPipeline:
 
         import ray_tpu
 
+        # Channel loops occupy the actors' execution threads: stop them so
+        # the get_params_bytes calls below can run.
+        self._stop_loops()
         blobs = ray_tpu.get([a.get_params_bytes.remote()
                              for a in self.actors], timeout=600)
         # Each actor returns ITS chunks (ids d, d+p, ...): reassemble in

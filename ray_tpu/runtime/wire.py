@@ -542,6 +542,12 @@ class TaskSpecMsg(Message):
     placement_group_bundle_index = Field(14, INT, default=-1)
     runtime_env_v1 = Field(15, ANY)          # decode-only (retired writer)
     pinned_oids_v1 = Field(16, LIST(BYTES))  # decode-only (retired writer)
+    # Distributed-trace propagation (tracing_helper.py _inject_tracing
+    # analog): the caller's trace id + submit-span id travel as typed
+    # envelope fields so the executing worker stitches its execute span
+    # under the driver's, across processes. Empty = caller not tracing.
+    trace_id = Field(17, BYTES)
+    parent_span_id = Field(18, BYTES)
 
 
 class SliceLostMsg(Message):

@@ -235,8 +235,12 @@ class WorkerRuntime:
             # sees SUBMITTED/FINISHED), giving the dashboard timeline its
             # per-worker execution bars (task_event_buffer.h analog).
             self.core._record_task_event(spec, "RUNNING")
-            with tracing.span(spec.name, "task:execute",
-                              task_id=spec.task_id.hex()[:12]):
+            # Adopt the submitter's trace context (TaskSpec wire fields
+            # 17/18) so this execute span — and any nested submits the
+            # task body makes — stitch under the driver's span by id.
+            with tracing.trace_context(spec.trace_id, spec.parent_span_id), \
+                    tracing.span(spec.name, "task:execute",
+                                 task_id=spec.task_id.hex()[:12]):
                 result = fn(*args, **kwargs)
                 if inspect.iscoroutine(result):
                     # Sync-invoked coroutine (async def run through the

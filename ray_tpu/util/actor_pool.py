@@ -2,11 +2,19 @@
 
 Reference analog: python/ray/util/actor_pool.py (same public surface:
 map / map_unordered / submit / get_next / get_next_unordered / push / pop_idle).
+
+Bookkeeping model: each submit is numbered by a monotone sequence. A call is
+either *in flight* (`_inflight`: ref -> (seq, actor-or-None)) or *backlogged*
+(`_backlog`) waiting for a free actor. Finished-but-unretrieved results keep
+their entry in `_inflight` with the actor slot already recycled (None), so
+ordered retrieval never blocks actor reuse.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Iterator, List, Optional, TypeVar
+from collections import deque
+from typing import (Any, Callable, Deque, Dict, Iterable, Iterator, List,
+                    Optional, Tuple, TypeVar)
 
 import ray_tpu
 
@@ -15,14 +23,29 @@ V = TypeVar("V")
 
 class ActorPool:
     def __init__(self, actors: List[Any]):
-        self._idle_actors: List[Any] = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: List[tuple] = []
+        self._free_actors: Deque[Any] = deque(actors)
+        # ref -> (submit seq, actor). actor becomes None once recycled
+        # (task finished, result not yet retrieved).
+        self._inflight: Dict[Any, Tuple[int, Any]] = {}
+        self._result_refs: Dict[int, Any] = {}   # submit seq -> ref
+        self._submit_seq = 0
+        self._return_seq = 0
+        self._backlog: Deque[tuple] = deque()
 
-    def map(self, fn: Callable[[Any, V], Any], values: Iterable[V]) -> Iterator[Any]:
+    # -- submission ---------------------------------------------------------
+    def submit(self, fn: Callable[[Any, V], Any], value: V):
+        """fn(actor, value) must return an ObjectRef (call a .remote method)."""
+        if not self._free_actors:
+            self._backlog.append((fn, value))
+            return
+        actor = self._free_actors.popleft()
+        ref = fn(actor, value)
+        self._inflight[ref] = (self._submit_seq, actor)
+        self._result_refs[self._submit_seq] = ref
+        self._submit_seq += 1
+
+    def map(self, fn: Callable[[Any, V], Any],
+            values: Iterable[V]) -> Iterator[Any]:
         """Ordered map over values; yields results as they become ready in order."""
         for v in values:
             self.submit(fn, v)
@@ -36,88 +59,82 @@ class ActorPool:
         while self.has_next():
             yield self.get_next_unordered()
 
-    def submit(self, fn: Callable[[Any, V], Any], value: V):
-        """fn(actor, value) must return an ObjectRef (call a .remote method)."""
-        if self._idle_actors:
-            actor = self._idle_actors.pop()
-            future = fn(actor, value)
-            self._future_to_actor[future] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
-        else:
-            self._pending_submits.append((fn, value))
-
+    # -- retrieval ----------------------------------------------------------
     def has_next(self) -> bool:
-        return bool(self._index_to_future) or bool(self._pending_submits)
-
-    def _return_actor(self, actor):
-        self._idle_actors.append(actor)
-        if self._pending_submits:
-            self.submit(*self._pending_submits.pop(0))
+        return bool(self._result_refs) or bool(self._backlog)
 
     def get_next(self, timeout: Optional[float] = None) -> Any:
         """Next result in submission order. A timeout leaves the pool state
         untouched; a task error is raised only after its actor is recycled."""
         if not self.has_next():
             raise StopIteration("no more results")
-        idx = self._next_return_index
-        # The future for idx may not exist yet if its submit is still pending.
-        while idx not in self._index_to_future:
-            self._drain_one(timeout)
-        future = self._index_to_future[idx]
-        ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
+        seq = self._return_seq
+        # The ref for seq may not exist yet while its submit sits in the
+        # backlog; free an actor at a time until it gets dispatched.
+        while seq not in self._result_refs:
+            self._recycle_one(timeout)
+        ref = self._result_refs[seq]
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("timed out waiting for result")
-        del self._index_to_future[idx]
-        self._next_return_index += 1
-        _, actor = self._future_to_actor.pop(future)
+        del self._result_refs[seq]
+        self._return_seq += 1
+        _, actor = self._inflight.pop(ref)
         if actor is not None:
-            self._return_actor(actor)
-        return ray_tpu.get(future)
+            self._release(actor)
+        return ray_tpu.get(ref)
 
     def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
         """Next result in completion order."""
         if not self.has_next():
             raise StopIteration("no more results")
-        while not self._future_to_actor:
-            self._drain_one(timeout)
+        while not self._inflight:
+            self._recycle_one(timeout)
         ready, _ = ray_tpu.wait(
-            list(self._future_to_actor), num_returns=1, timeout=timeout)
+            list(self._inflight), num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("timed out waiting for result")
-        future = ready[0]
-        idx, actor = self._future_to_actor.pop(future)
-        del self._index_to_future[idx]
+        ref = ready[0]
+        seq, actor = self._inflight.pop(ref)
+        del self._result_refs[seq]
         if actor is not None:
-            self._return_actor(actor)
-        return ray_tpu.get(future)
+            self._release(actor)
+        return ray_tpu.get(ref)
 
-    def _drain_one(self, timeout: Optional[float]):
-        """Wait for any still-running task to finish and recycle its actor,
-        keeping its result future around for ordered retrieval."""
-        running = [f for f, (_, a) in self._future_to_actor.items()
+    # -- actor lifecycle ----------------------------------------------------
+    def _release(self, actor):
+        """Return an actor to the free set, immediately dispatching the
+        oldest backlogged submit onto it if one is waiting."""
+        self._free_actors.append(actor)
+        if self._backlog:
+            self.submit(*self._backlog.popleft())
+
+    def _recycle_one(self, timeout: Optional[float]):
+        """Block until any still-running call finishes and free its actor,
+        keeping the result ref around for ordered retrieval."""
+        running = [ref for ref, (_, a) in self._inflight.items()
                    if a is not None]
         if not running:
             raise RuntimeError("pool has pending submits but no running tasks")
         ready, _ = ray_tpu.wait(running, num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("timed out waiting for an actor to free up")
-        future = ready[0]
-        idx, actor = self._future_to_actor[future]
-        self._future_to_actor[future] = (idx, None)
-        self._return_actor(actor)
+        ref = ready[0]
+        seq, actor = self._inflight[ref]
+        self._inflight[ref] = (seq, None)
+        self._release(actor)
 
     def push(self, actor: Any):
         """Add a new idle actor to the pool."""
-        busy = {a for _, a in self._future_to_actor.values()}
-        if actor in self._idle_actors or actor in busy:
+        busy = {a for _, a in self._inflight.values()}
+        if actor in self._free_actors or actor in busy:
             raise ValueError("actor already in pool")
-        self._return_actor(actor)
+        self._release(actor)
 
     def pop_idle(self) -> Optional[Any]:
-        if self._idle_actors:
-            return self._idle_actors.pop()
+        if self._free_actors:
+            return self._free_actors.pop()
         return None
 
     def has_free(self) -> bool:
-        return bool(self._idle_actors) and not self._pending_submits
+        return bool(self._free_actors) and not self._backlog

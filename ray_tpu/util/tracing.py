@@ -25,6 +25,41 @@ _enabled = os.environ.get("RAY_TPU_TRACING", "1") != "0"
 
 _otel_tracer = None
 
+# -- cross-process trace context ---------------------------------------------
+# W3C-traceparent-shaped propagation (tracing_helper.py:_inject_tracing
+# analog, minus the otel hard dependency): every span mints an 8-byte span
+# id and joins the thread's current trace (minting a 16-byte trace id at
+# the root). submit_task copies the caller's (trace_id, span_id) into the
+# TaskSpec wire envelope (TaskSpecMsg fields 17/18); the executing worker
+# adopts them via trace_context() so the execute span — and any spans the
+# task body opens, including nested submits — carry the same trace id and
+# parent-link back to the driver-side submit span. Stitching is by id, not
+# wall clock, so it survives process boundaries and clock skew.
+_ctx = threading.local()
+
+
+def current_trace_id() -> Optional[bytes]:
+    return getattr(_ctx, "trace_id", None)
+
+
+def current_span_id() -> Optional[bytes]:
+    return getattr(_ctx, "span_id", None)
+
+
+@contextmanager
+def trace_context(trace_id: Optional[bytes],
+                  parent_span_id: Optional[bytes]):
+    """Adopt a propagated (trace_id, parent_span_id) pair — the executor
+    side of the TaskSpec trace fields. Spans opened inside parent to the
+    propagated span id; the previous thread context is restored on exit."""
+    prev = (getattr(_ctx, "trace_id", None), getattr(_ctx, "span_id", None))
+    _ctx.trace_id = trace_id
+    _ctx.span_id = parent_span_id
+    try:
+        yield
+    finally:
+        _ctx.trace_id, _ctx.span_id = prev
+
 
 def _get_otel():
     """Lazy optional OpenTelemetry tracer (absent in the base image)."""
@@ -57,17 +92,26 @@ def span(name: str, kind: str, **attrs):
     ctx = otel.start_as_current_span(name) if otel else None
     if ctx is not None:
         ctx.__enter__()
+    trace_id = getattr(_ctx, "trace_id", None) or os.urandom(16)
+    parent = getattr(_ctx, "span_id", None)
+    span_id = os.urandom(8)
+    prev = (getattr(_ctx, "trace_id", None), getattr(_ctx, "span_id", None))
+    _ctx.trace_id, _ctx.span_id = trace_id, span_id
     start = time.time()
     try:
         yield
     finally:
+        _ctx.trace_id, _ctx.span_id = prev
         end = time.time()
+        ids = {"trace_id": trace_id.hex(), "span_id": span_id.hex()}
+        if parent is not None:
+            ids["parent_span_id"] = parent.hex()
         with _lock:
             _spans.append({"name": name, "cat": kind, "ts": start * 1e6,
                            "dur": (end - start) * 1e6, "ph": "X",
                            "pid": os.getpid(),
                            "tid": threading.get_ident() % 100000,
-                           "args": attrs})
+                           "args": {**ids, **attrs}})
         if ctx is not None:
             ctx.__exit__(None, None, None)
 

@@ -135,6 +135,7 @@ def test_lru_eviction():
         mgr.load_adapter(init_adapter(config, "big", rank=8))
 
 
+@pytest.mark.slow  # >60s measured: full-tier only
 def test_lora_through_serve_and_router():
     ray_tpu.init(num_cpus=4)
     try:

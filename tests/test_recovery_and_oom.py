@@ -15,6 +15,7 @@ import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
 
+@pytest.mark.slow  # >60s measured: full-tier only
 def test_lineage_reconstruction_after_node_loss():
     c = Cluster()
     c.add_node(num_cpus=1, resources={"head": 1})
@@ -41,6 +42,7 @@ def test_lineage_reconstruction_after_node_loss():
         c.shutdown()
 
 
+@pytest.mark.slow  # >60s measured: full-tier only
 def test_recursive_reconstruction_of_lost_dependency():
     """Kill the node holding BOTH a task's result and its argument: get()
     re-executes the consumer, whose lost arg is itself reconstructed
@@ -108,6 +110,7 @@ def test_memory_usage_fraction_reads_proc():
     assert frac is not None and 0.0 < frac < 1.0
 
 
+@pytest.mark.slow  # >60s measured: full-tier only
 def test_noop_cancel_does_not_poison_reconstruction():
     """cancel() on a finished task is a no-op and must leave NO trace:
     lineage reconstruction of that task's lost object must still work

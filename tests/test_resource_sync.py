@@ -172,6 +172,7 @@ def test_worker_pool_keyed_by_runtime_env():
         ray_tpu.shutdown()
 
 
+@pytest.mark.slow  # >60s measured: full-tier only
 def test_proactive_spill_keeps_store_below_watermark():
     """The raylet spills LRU objects in the background once the store
     crosses the high watermark, so a worker's put never has to block on

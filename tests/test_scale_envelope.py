@@ -21,6 +21,7 @@ def cluster():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow  # >60s measured: full-tier only
 def test_many_queued_tasks(cluster):
     """100k trivial tasks queued at once all complete (reference row:
     1M+ queued on one node)."""
@@ -70,6 +71,7 @@ def test_many_plasma_objects_in_one_get(cluster):
     assert int(out[512][0]) == 512
 
 
+@pytest.mark.slow  # >60s measured: full-tier only
 def test_many_actors(cluster):
     """200 concurrent actors created and called (reference row: 40k+
     cluster-wide)."""

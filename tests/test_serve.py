@@ -98,6 +98,7 @@ def test_missing_deployment_404(cluster):
     assert exc_info.value.code == 404
 
 
+@pytest.mark.slow  # >60s measured: full-tier only
 def test_llm_deployment_completions(cluster):
     import jax.numpy as jnp
 
