@@ -2,6 +2,7 @@ from ray_tpu.collective.collective import (  # noqa: F401
     abort_collective_group,
     allgather,
     allreduce,
+    allreduce_async,
     alltoall,
     barrier,
     broadcast,
@@ -13,5 +14,5 @@ from ray_tpu.collective.collective import (  # noqa: F401
     reducescatter,
     send,
 )
-from ray_tpu.collective.communicator import Communicator  # noqa: F401
+from ray_tpu.collective.communicator import Communicator, Work  # noqa: F401
 from ray_tpu.core.exceptions import CollectiveAbortError  # noqa: F401

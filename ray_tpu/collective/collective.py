@@ -7,7 +7,12 @@ through the GCS KV (the reference stores the NCCL unique id in a named actor;
 a KV entry is the same pattern one level lower).
 
 Backends:
-  * "tcp"  — TCPCommunicator (CPU/gloo analog; tests and control plane)
+  * "tcp"  — TCPCommunicator (CPU/gloo analog; tests and control plane).
+             Data plane is chunked ring algorithms over per-rank p2p links
+             with zero-pickle raw-buffer frames (cfg().collective_topology
+             selects "ring"/"hub"; see docs/collectives.md). Async handles
+             (`allreduce_async(...) -> Work`) complete in FIFO order on a
+             per-group op thread.
   * "jax"  — multi-host jax.distributed bootstrap; collectives then run
              in-graph over ICI (see jax_backend.initialize_jax_distributed)
 """
@@ -106,6 +111,13 @@ def get_collective_group_size(group_name: str = "default") -> int:
 
 def allreduce(array: np.ndarray, group_name: str = "default", op: str = "sum"):
     return get_group(group_name).allreduce(array, op)
+
+
+def allreduce_async(array: np.ndarray, group_name: str = "default",
+                    op: str = "sum"):
+    """Launch an allreduce and return a Work handle; `.wait()` for the
+    result. Handles on one group complete in submission (FIFO) order."""
+    return get_group(group_name).allreduce_async(array, op)
 
 
 def allgather(array: np.ndarray, group_name: str = "default") -> List[np.ndarray]:

@@ -42,6 +42,48 @@ def heartbeat_key(group_name: str, rank: int) -> str:
     return f"collective:{group_name}:hb:{rank}"
 
 
+class Work:
+    """Handle for an asynchronously launched collective op.
+
+    Reference analog: torch.distributed's Work / NCCL's stream events. Ops
+    submitted through a communicator's `*_async` methods complete in FIFO
+    submission order (one op thread per group drains them); `op_id` is the
+    per-group sequence number, identical across ranks when every rank
+    submits the same op stream — the invariant bucketed gradient reduction
+    relies on. Errors (including CollectiveAbortError from a watchdog
+    abort) surface at `wait()`, never silently."""
+
+    __slots__ = ("op_id", "group_name", "_done", "_result", "_error")
+
+    def __init__(self, op_id: int, group_name: str):
+        self.op_id = op_id
+        self.group_name = group_name
+        self._done = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def _finish(self, result=None, error: Optional[BaseException] = None):
+        self._result = result
+        self._error = error
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until the op completes; return its result or re-raise its
+        error. The executing op observes the group's abort flag and per-op
+        deadline itself, so an aborted group completes this (exceptionally)
+        within one watchdog tick."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"collective op {self.op_id} on group "
+                f"{self.group_name!r} not done after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
 class Communicator(abc.ABC):
     """A process group: `world_size` ranks that communicate collectively."""
 
@@ -123,6 +165,32 @@ class Communicator(abc.ABC):
     @abc.abstractmethod
     def barrier(self) -> None:
         ...
+
+    # ---- async handles ---------------------------------------------------
+    # Default: run synchronously and hand back a completed Work, so every
+    # backend supports the async API; backends with a real op thread (the
+    # TCP ring transport) override these to actually overlap.
+
+    def _completed_work(self, fn) -> Work:
+        work = Work(0, self.group_name)
+        try:
+            work._finish(result=fn())
+        except BaseException as e:
+            work._finish(error=e)
+        return work
+
+    def allreduce_async(self, array: np.ndarray, op: str = "sum") -> Work:
+        return self._completed_work(lambda: self.allreduce(array, op))
+
+    def allgather_async(self, array: np.ndarray) -> Work:
+        return self._completed_work(lambda: self.allgather(array))
+
+    def reducescatter_async(self, arrays: Sequence[np.ndarray],
+                            op: str = "sum") -> Work:
+        return self._completed_work(lambda: self.reducescatter(arrays, op))
+
+    def broadcast_async(self, array: np.ndarray, src_rank: int = 0) -> Work:
+        return self._completed_work(lambda: self.broadcast(array, src_rank))
 
     def alltoall(self, arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
         """Default all-to-all via send/recv pairs (override for better)."""

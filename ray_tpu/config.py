@@ -110,6 +110,21 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
     "collective_op_timeout_s": (float, 120.0,
                                 "per-op deadline for blocking out-of-graph "
                                 "collective ops"),
+    "collective_topology": (str, "ring",
+                            "out-of-graph collective data plane: 'ring' "
+                            "(chunked ring algorithms over p2p links, "
+                            "zero-pickle raw frames) or 'hub' (legacy "
+                            "rank-0 star, pickled payloads)"),
+    "collective_chunk_bytes": (int, 1 << 20,
+                               "chunk size for ring collective transfers; "
+                               "large tensors pipeline across hops in "
+                               "chunks of this size and per-op scratch "
+                               "memory stays bounded at one chunk"),
+    "ddp_bucket_bytes": (int, 4 << 20,
+                         "gradient-coalescing bucket size for "
+                         "allreduce_gradients; each per-dtype bucket "
+                         "launches its ring allreduce as it fills so "
+                         "reduction overlaps the remaining flatten work"),
     # -- train -------------------------------------------------------------
     "train_poll_interval_s": (float, 0.2, "controller worker poll period"),
     "train_elastic_check_interval_s": (float, 10.0,

@@ -64,6 +64,30 @@ DATA_BACKPRESSURE = Counter(
     "dataset producer throttle ENGAGEMENTS (idle->throttled transitions) "
     "under object-store pressure")
 
+# -- collectives -----------------------------------------------------------
+# Per-(op, algo) traffic and latency of the out-of-graph collective plane.
+# `algo` distinguishes the chunked ring data plane from the legacy rank-0
+# hub; components bind() a tag set once and bump the bound handles so the
+# per-chunk accounting stays off the hot path.
+
+COLLECTIVE_OPS = Counter(
+    "ray_tpu_collective_ops_total",
+    "out-of-graph collective operations completed",
+    tag_keys=("op", "algo"))
+COLLECTIVE_BYTES_SENT = Counter(
+    "ray_tpu_collective_bytes_sent_total",
+    "bytes sent on collective data-plane links",
+    tag_keys=("op", "algo"))
+COLLECTIVE_BYTES_RECV = Counter(
+    "ray_tpu_collective_bytes_recv_total",
+    "bytes received on collective data-plane links",
+    tag_keys=("op", "algo"))
+COLLECTIVE_OP_LATENCY = Histogram(
+    "ray_tpu_collective_op_seconds",
+    "end-to-end latency of out-of-graph collective ops",
+    boundaries=[0.001, 0.01, 0.05, 0.25, 1.0, 5.0, 30.0],
+    tag_keys=("op", "algo"))
+
 # -- serve / llm -----------------------------------------------------------
 
 SERVE_REQUESTS = Counter(

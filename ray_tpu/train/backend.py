@@ -82,25 +82,76 @@ def make_backend(name_or_backend) -> Backend:
     return BACKENDS[name_or_backend or "none"]()
 
 
-def allreduce_gradients(grads, group_name: Optional[str] = None):
-    """DDP helper: mean-allreduce a pytree of host/jax arrays over the
-    worker group's collective backend (reference: the NCCL allreduce inside
-    DDP's backward). Use inside train loops running the CollectiveBackend."""
+def reduce_gradients(comm, grads, bucket_bytes: Optional[int] = None):
+    """Bucketed overlapped mean-allreduce of a gradient pytree over `comm`.
+
+    Reference analog: torch DDP's gradient-bucketing Reducer. Leaves are
+    grouped by dtype (never concatenated across dtypes — a mixed f32/f64
+    tree reduces each dtype natively instead of silently upcasting the
+    whole buffer) and coalesced into flat buckets of ~`bucket_bytes`
+    (cfg().ddp_bucket_bytes default). Each bucket's allreduce is launched
+    asynchronously THE MOMENT the bucket fills, so the wire reduction of
+    early buckets overlaps the flatten/copy work of later ones, and the
+    per-group FIFO op thread pipelines the buckets back to back. Handles
+    are then waited in launch order and leaves scattered back in their
+    original tree positions and dtypes.
+    """
     import jax
     import numpy as np
 
+    from ray_tpu.config import cfg
+
+    if bucket_bytes is None:
+        bucket_bytes = cfg().ddp_bucket_bytes
+    bucket_bytes = max(1, int(bucket_bytes))
+
+    leaves, treedef = jax.tree.flatten(grads)
+    arrs = [np.asarray(l) for l in leaves]
+    out: list = [None] * len(leaves)
+
+    # dtype -> list of (leaf index, flat view) accumulating the open bucket
+    open_buckets: Dict[str, list] = {}
+    open_bytes: Dict[str, int] = {}
+    launched: list = []  # (Work, dtype, [(leaf idx, shape, size), ...])
+
+    def _flush(dt: str):
+        entries = open_buckets.pop(dt, None)
+        open_bytes.pop(dt, None)
+        if not entries:
+            return
+        flat = np.concatenate([v for _, v in entries]) if len(entries) > 1 \
+            else np.ascontiguousarray(entries[0][1])
+        meta = [(i, arrs[i].shape, arrs[i].size) for i, _ in entries]
+        launched.append((comm.allreduce_async(flat, op="mean"), dt, meta))
+
+    for i, a in enumerate(arrs):
+        dt = a.dtype.str
+        open_buckets.setdefault(dt, []).append((i, a.ravel()))
+        open_bytes[dt] = open_bytes.get(dt, 0) + a.nbytes
+        if open_bytes[dt] >= bucket_bytes:
+            _flush(dt)
+    for dt in list(open_buckets):
+        _flush(dt)
+
+    for work, dt, meta in launched:
+        reduced = np.asarray(work.wait())
+        if reduced.dtype.str != dt:  # integer mean comes back float64
+            reduced = reduced.astype(np.dtype(dt))
+        offset = 0
+        for i, shape, size in meta:
+            out[i] = reduced[offset:offset + size].reshape(shape)
+            offset += size
+    return jax.tree.unflatten(treedef, out)
+
+
+def allreduce_gradients(grads, group_name: Optional[str] = None,
+                        bucket_bytes: Optional[int] = None):
+    """DDP helper: mean-allreduce a pytree of host/jax arrays over the
+    worker group's collective backend (reference: the NCCL allreduce inside
+    DDP's backward). Use inside train loops running the CollectiveBackend.
+    Gradients are coalesced into per-dtype buckets whose ring allreduces
+    launch as each bucket fills (see reduce_gradients)."""
     from ray_tpu.collective.collective import get_group
 
     comm = get_group(group_name or _active_group or "default")
-    leaves, treedef = jax.tree.flatten(grads)
-    flat = np.concatenate([np.asarray(l).ravel() for l in leaves]) \
-        if leaves else np.zeros(0)
-    reduced = comm.allreduce(flat, op="mean")
-    out = []
-    offset = 0
-    for leaf in leaves:
-        size = int(np.prod(np.asarray(leaf).shape)) if hasattr(leaf, "shape") else 1
-        out.append(reduced[offset:offset + size].reshape(np.asarray(leaf).shape)
-                   .astype(np.asarray(leaf).dtype))
-        offset += size
-    return jax.tree.unflatten(treedef, out)
+    return reduce_gradients(comm, grads, bucket_bytes=bucket_bytes)

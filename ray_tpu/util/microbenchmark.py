@@ -147,6 +147,9 @@ def run(scale: float = 1.0, num_cpus: int = 4) -> List[Dict]:
         # DeviceChannels (raw bytes through the shm ring, no pickle) and
         # once as a plain actor call (task submission + object store).
         results.extend(_bench_channel_vs_rpc(scale))
+
+        # -- out-of-graph collectives: ring vs hub -----------------------
+        results.extend(_bench_collectives(scale))
     finally:
         if owns_cluster:
             ray_tpu.shutdown()
@@ -296,6 +299,124 @@ def _bench_pipeline_step(scale: float) -> List[Dict]:
         out.append({"benchmark": f"pipeline_step_{transport}",
                     "value": round(_rate(n, dt), 2), "unit": "steps/s",
                     "n": n})
+    return out
+
+
+def _bench_collectives(scale: float) -> List[Dict]:
+    """Out-of-graph collective data plane: chunked zero-pickle ring vs the
+    legacy rank-0 hub, 4 thread-hosted TCPCommunicators over an in-memory
+    KV (pure transport, no cluster in the loop). Two pairs of legs:
+
+      * allreduce_{ring,hub}_16mib — one 16 MiB float32 allreduce at 4
+        ranks; MiB/s of reduced payload (best of 3: the ring-vs-hub RATIO
+        is the tracked number and one descheduling blip inside a trial on
+        a small box would corrupt it).
+      * ddp_grads_{bucketed,flat} — allreduce_gradients steady state on a
+        32-leaf ~8 MiB gradient pytree: per-dtype 4 MiB buckets launched
+        async as they fill (overlapped) vs the old concatenate-everything
+        single blocking reduction.
+    """
+    import threading
+
+    import numpy as np
+
+    from ray_tpu.collective.cpu_group import TCPCommunicator
+    from ray_tpu.train.backend import reduce_gradients
+
+    out: List[Dict] = []
+    kv, kv_lock = {}, threading.Lock()
+
+    def kv_put(key, value):
+        with kv_lock:
+            kv[key] = value
+
+    def kv_get(key):
+        with kv_lock:
+            return kv.get(key)
+
+    world = 4
+
+    def make_group(name, **kwargs):
+        comms = [None] * world
+
+        def build(r):
+            comms[r] = TCPCommunicator(r, world, name, kv_put, kv_get,
+                                       timeout=60, **kwargs)
+
+        ts = [threading.Thread(target=build, args=(r,)) for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert all(comms), comms
+        return comms
+
+    def par(comms, fn):
+        errs = []
+
+        def run_rank(c):
+            try:
+                fn(c)
+            except BaseException as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=run_rank, args=(c,)) for c in comms]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(300)
+        if errs:
+            raise errs[0]
+
+    mib = 16
+    payload = np.ones((mib << 20) // 4, dtype=np.float32)
+    for algo in ("hub", "ring"):
+        comms = make_group(f"bench-allreduce-{algo}", topology=algo)
+        try:
+            par(comms, lambda c: c.allreduce(np.ones(64, np.float32), "sum"))
+            best = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                par(comms, lambda c: c.allreduce(payload, "sum"))
+                best = max(best, mib / (time.perf_counter() - t0))
+            out.append({"benchmark": f"allreduce_{algo}_16mib",
+                        "value": round(best, 1), "unit": "MiB/s",
+                        "n": mib, "trials": 3})
+        finally:
+            for c in comms:
+                c.close()
+
+    # DDP gradient sync: same tree, flat (the old np.concatenate-everything
+    # path) vs bucketed-overlapped (the shipped reduce_gradients).
+    grads = {f"layer{i}": np.ones(1 << 16, np.float32) for i in range(32)}
+
+    def flat_reduce(comm):
+        flat = np.concatenate([v.ravel() for v in grads.values()])
+        reduced = comm.allreduce(flat, op="mean")
+        offset, res = 0, {}
+        for k, v in grads.items():
+            res[k] = reduced[offset:offset + v.size].reshape(v.shape)
+            offset += v.size
+        return res
+
+    comms = make_group("bench-ddp")
+    try:
+        steps = max(2, int(4 * scale))
+        for name, step_fn in (("ddp_grads_flat", flat_reduce),
+                              ("ddp_grads_bucketed",
+                               lambda c: reduce_gradients(c, grads))):
+            par(comms, step_fn)  # warmup: links + first-op ramp
+            best = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    par(comms, step_fn)
+                best = max(best, steps / (time.perf_counter() - t0))
+            out.append({"benchmark": name, "value": round(best, 2),
+                        "unit": "steps/s", "n": steps, "trials": 3})
+    finally:
+        for c in comms:
+            c.close()
     return out
 
 

@@ -240,6 +240,99 @@ def test_fresh_group_clears_stale_abort_flag(fast_watchdog):
                 c.close()
 
 
+def test_rank_death_mid_chunked_ring_unblocks_all_peers(fast_watchdog):
+    """A rank dying MID-CHUNK inside a ring allreduce must surface
+    CollectiveAbortError on every live rank within ~1 watchdog interval:
+    the rank adjacent to the failure sees the link EOF, aborts with KV
+    propagation, and the non-adjacent rank's watchdog (or its own recv
+    tick) picks the flag up — nobody waits out the socket timeout."""
+    from ray_tpu import config as config_mod
+    from ray_tpu.collective.cpu_group import TCPCommunicator
+
+    config_mod.cfg().apply_overrides({"collective_chunk_bytes": 2048})
+    comms = [None, None, None]
+    errs = []
+
+    def build(rank):
+        try:
+            comms[rank] = TCPCommunicator(rank, 3, "wd-midchunk", *_kv,
+                                          timeout=30)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    _kv = _mem_kv()
+    threads = [threading.Thread(target=build, args=(r,)) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs and all(comms), errs
+
+    orig_recv = TCPCommunicator._recv_chunk_into
+    state = {"chunks": 0}
+
+    def wedged(self, sock, dst, deadline):
+        # Deterministic wedge: rank 2 stalls after its first chunk, so
+        # every rank is provably mid-op (mid-chunk-stream) at kill time.
+        if self.rank == 2:
+            state["chunks"] += 1
+            if state["chunks"] == 2:
+                time.sleep(4.0)
+        return orig_recv(self, sock, dst, deadline)
+
+    results = {}
+
+    def run_rank(rank):
+        start = time.monotonic()
+        try:
+            comms[rank].allreduce(np.ones(1 << 16, np.float32), "sum")
+            results[rank] = ("ok", time.monotonic() - start)
+        except CollectiveAbortError:
+            results[rank] = ("abort", time.monotonic() - start)
+        except Exception as e:  # pragma: no cover
+            results[rank] = ("unexpected", e)
+
+    try:
+        # Warm the neighbor links so the kill hits the data plane, not
+        # connection setup.
+        warm = [threading.Thread(
+            target=lambda r=r: comms[r].allreduce(np.zeros(4), "sum"))
+            for r in range(3)]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join(30)
+
+        TCPCommunicator._recv_chunk_into = wedged
+        threads = [threading.Thread(target=run_rank, args=(r,))
+                   for r in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # all ranks mid-ring; rank 2 wedged in its sleep
+        # "Process death": rank 2 stops heartbeating and its sockets close.
+        comms[2]._watchdog.stop()
+        comms[2].abort("rank 2 died", propagate=False)  # local flag only
+        for s in (list(comms[2]._p2p_out.values())
+                  + list(comms[2]._p2p_in.values())):
+            try:
+                s.close()
+            except Exception:
+                pass
+        for t in threads:
+            t.join(20)
+        assert all(not t.is_alive() for t in threads)
+        for rank in (0, 1):
+            kind, info = results[rank]
+            assert kind == "abort", (rank, kind, info)
+            # 0.5 s pre-kill block + link EOF detection + 1 watchdog tick.
+            assert info < 5.0, f"rank {rank} unblocked after {info:.1f}s"
+    finally:
+        TCPCommunicator._recv_chunk_into = orig_recv
+        for c in comms:
+            if c is not None:
+                c.close()
+
+
 def test_destroy_collective_group_aborts_inflight(fast_watchdog):
     """destroy/close while a thread is blocked inside an op unblocks it with
     CollectiveAbortError (not a 120 s hang or a raw socket error)."""

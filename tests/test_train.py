@@ -104,6 +104,128 @@ def test_gradient_sync_is_mean(cluster, tmp_path):
     assert result.metrics["synced0"] == 1.5
 
 
+def _bucketed_vs_flat_pytrees(world):
+    """Fixed mixed-dtype gradient pytrees with integer-valued entries, so
+    floating-point sums are exact and bucketed-vs-flat comparisons can be
+    bit-for-bit."""
+    trees = []
+    for r in range(world):
+        trees.append({
+            "layer1": {"w": (np.arange(600, dtype=np.float32)
+                             .reshape(20, 30) * (r + 1)),
+                       "b": np.arange(30, dtype=np.float32) * (r + 2)},
+            "layer2": {"w": (np.arange(256, dtype=np.float64)
+                             .reshape(16, 16) * (r + 1))},
+            "steps": np.arange(8, dtype=np.int32) * (r + 1),
+            "scale": np.float32(2.0 * (r + 1)),
+        })
+    return trees
+
+
+def _reduce_over_thread_group(trees, bucket_bytes):
+    """Run reduce_gradients concurrently over a threaded TCP ring group."""
+    import threading
+
+    from ray_tpu.collective.cpu_group import TCPCommunicator
+    from ray_tpu.train.backend import reduce_gradients
+
+    kv, klock = {}, threading.Lock()
+
+    def put(k, v):
+        with klock:
+            kv[k] = v
+
+    def get(k):
+        with klock:
+            return kv.get(k)
+
+    world = len(trees)
+    comms = [None] * world
+    errs = []
+
+    def build(r):
+        try:
+            comms[r] = TCPCommunicator(r, world, f"ddp-bkt-{bucket_bytes}",
+                                       put, get, timeout=30)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=build, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not errs and all(comms), errs
+
+    out = [None] * world
+
+    def run(r):
+        try:
+            out[r] = ("ok", reduce_gradients(comms[r], trees[r],
+                                             bucket_bytes=bucket_bytes))
+        except BaseException as e:  # pragma: no cover
+            out[r] = ("err", e)
+
+    try:
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        for o in out:
+            assert o is not None and o[0] == "ok", o
+        return [o[1] for o in out]
+    finally:
+        for c in comms:
+            if c is not None:
+                c.close()
+
+
+def test_bucketed_grads_bit_compatible_and_dtype_preserving():
+    """Acceptance: bucketed allreduce_gradients matches the flat (single
+    whole-tree reduction) path bit-for-bit per dtype on a fixed pytree, a
+    tiny bucket_bytes forcing many buckets and a huge one forcing a single
+    bucket per dtype. Mixed dtypes must come back in their ORIGINAL dtypes
+    (the old np.concatenate path silently upcast f32+f64+i32 to f64)."""
+    import jax
+
+    from ray_tpu import config as config_mod
+
+    config_mod.reset_for_testing()
+    config_mod.cfg().apply_overrides({
+        "collective_watchdog_interval_s": 0.1,
+        "collective_op_timeout_s": 60.0,
+        "collective_chunk_bytes": 1024,
+    })
+    try:
+        world = 2
+        trees = _bucketed_vs_flat_pytrees(world)
+        # Exact expectation: mean over ranks of integer-valued arrays.
+        expected = jax.tree.map(
+            lambda *leaves: np.stack([np.asarray(l) for l in leaves])
+            .mean(axis=0), *trees)
+
+        many = _reduce_over_thread_group(trees, bucket_bytes=1024)
+        single = _reduce_over_thread_group(trees, bucket_bytes=1 << 30)
+        for reduced in (*many, *single):
+            flat_r, _ = jax.tree.flatten(reduced)
+            flat_o, _ = jax.tree.flatten(trees[0])
+            flat_e, _ = jax.tree.flatten(expected)
+            for got, orig, exp in zip(flat_r, flat_o, flat_e):
+                orig = np.asarray(orig)
+                assert got.dtype == orig.dtype, (got.dtype, orig.dtype)
+                # Bit-for-bit vs the exact mean, cast to the native dtype
+                # exactly as the flat path does.
+                np.testing.assert_array_equal(
+                    got, np.asarray(exp).astype(orig.dtype))
+        # Bucket layouts agree with each other bit-for-bit too.
+        for a, b in zip(jax.tree.flatten(many[0])[0],
+                        jax.tree.flatten(single[0])[0]):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        config_mod.reset_for_testing()
+
+
 def _failing_once_fn(config):
     from ray_tpu import train as rtrain
 
