@@ -301,6 +301,17 @@ class Autoscaler:
             launched += len(iids)
 
         terminated = self._terminate_idle(nodes, demand, floor=floor)
+        if launched or terminated:
+            from ray_tpu.runtime import events as events_mod
+
+            events_mod.emit(
+                events_mod.AUTOSCALER_SCALE,
+                f"scale decision: +{launched} instance(s) launched, "
+                f"-{terminated} terminated ({len(unmet)} unmet bundle(s))",
+                source="autoscaler",
+                labels={"launched": str(launched),
+                        "terminated": str(terminated),
+                        "unmet": str(len(unmet))})
         return {"launched": launched, "terminated": terminated,
                 "unmet_demand": len(unmet)}
 

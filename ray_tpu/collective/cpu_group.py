@@ -419,6 +419,21 @@ class TCPCommunicator(Communicator):
                 self._kv_put(abort_key(self.group_name), reason or "aborted")
             except Exception:
                 pass
+            # First local observation of a real abort (close() passes
+            # propagate=False): record it on the cluster event bus so the
+            # group-wide unwind is attributable after the fact.
+            try:
+                from ray_tpu.runtime import events as events_mod
+
+                events_mod.emit(
+                    events_mod.COLLECTIVE_ABORT,
+                    f"collective group {self.group_name!r} aborted at rank "
+                    f"{self.rank}: {reason}",
+                    severity=events_mod.ERROR, source="collective",
+                    labels={"group": self.group_name,
+                            "rank": str(self.rank)})
+            except Exception:
+                pass
 
     def _op_deadline(self) -> float:
         from ray_tpu.config import cfg

@@ -97,6 +97,9 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                         "task state events retained by the GCS"),
     "task_events_flush_interval_s": (float, 1.0,
                                      "worker-side task event batch period"),
+    "cluster_events_max": (int, 10_000,
+                           "structured cluster events retained by the GCS "
+                           "event ring (see runtime/events.py)"),
     # -- collectives -------------------------------------------------------
     "collective_watchdog_interval_s": (float, 1.0,
                                        "peer-liveness/abort poll period of "

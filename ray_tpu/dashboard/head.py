@@ -228,6 +228,7 @@ class DashboardHead:
             web.get("/api/serve", self.serve_deployments),
             web.get("/api/tasks", self.tasks),
             web.get("/api/tasks/{task_id}", self.task_detail),
+            web.get("/api/events", self.events),
             web.get("/metrics", self.metrics),
             web.post("/api/jobs/", self.job_submit),
             web.get("/api/jobs/", self.job_list),
@@ -404,22 +405,48 @@ class DashboardHead:
         return _json({"deployments":
                       json_mod.loads(blob) if blob else []})
 
+    async def events(self, request):
+        """Typed cluster events (runtime/events.py), newest first; filters
+        mirror the `scripts events` CLI: ?type=, ?severity=, ?source=,
+        ?limit=."""
+        try:
+            limit = int(request.query.get("limit", "100"))
+        except ValueError:
+            return _json({"error": "limit must be an integer"}, status=400)
+        events = await self.gcs.call(
+            "list_events", event_type=request.query.get("type"),
+            severity=request.query.get("severity"),
+            source=request.query.get("source"), limit=limit)
+        return _json({"events": events})
+
     async def metrics(self, request):
         """Aggregate app metrics pushed to the KV by util.metrics plus a few
-        built-in cluster gauges, in Prometheus text format."""
+        built-in cluster gauges, in Prometheus text format. Only snapshots
+        from ALIVE nodes count: `metrics:<node>:<pid>` keys from dead
+        processes would otherwise inflate counters forever (the GCS also
+        purges them on node death; this filter covers keys raced in after
+        the purge)."""
         from ray_tpu.util.metrics import prometheus_text
 
+        nodes = await self.gcs.call("get_nodes", only_alive=False)
+        alive_hex = {n["node_id"].hex() for n in nodes
+                     if n.get("alive", True)}
         snapshots = []
         keys = (await self.gcs.call("kv_keys", prefix=b"metrics:"))["keys"]
         for k in keys:
+            parts = k.decode(errors="replace").split(":")
+            # Keep keys whose node isn't in the node table (e.g. a driver
+            # that flushed before node assignment records "unknown").
+            if len(parts) >= 2 and parts[1] not in alive_hex \
+                    and any(n["node_id"].hex() == parts[1] for n in nodes):
+                continue
             reply = await self.gcs.call("kv_get", key=k)
             if reply.get("value"):
                 try:
                     snapshots.extend(json.loads(reply["value"]))
                 except Exception:
                     continue
-        nodes = await self.gcs.call("get_nodes")
-        alive = sum(1 for n in nodes if n.get("alive", True))
+        alive = len(alive_hex)
         builtin = [
             {"name": "ray_tpu_cluster_nodes", "type": "gauge",
              "description": "alive nodes", "values": {"[]": float(alive)}},

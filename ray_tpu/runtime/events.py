@@ -1,0 +1,113 @@
+"""Structured cluster event bus: typed records for life-or-death decisions.
+
+Reference analog: src/ray/gcs/gcs_server's event aggregation and
+python/ray/_private/event/event_logger.py (Ray exports typed
+RAY_EVENT records per component; the dashboard's "Events" tab reads
+them back). Here the bus is deliberately small: an event is a plain
+JSON-able dict (it rides the pickle RPC plane and the dashboard JSON
+API unchanged), the GCS keeps a bounded ring of them behind
+`report_events`/`list_events` RPCs, and emission is ALWAYS
+best-effort — losing an event must never take down the component that
+noticed the problem.
+
+Emitters in-tree:
+  * GCS        — NODE_DEAD (heartbeat timeout / drain), SLICE_LOST
+                 (fate-sharing, records the whole failure domain)
+  * raylet     — OOM_KILL (memory monitor victim selection)
+  * collective — COLLECTIVE_ABORT (first local observation of a group
+                 abort, before the KV flag fans out)
+  * autoscaler — AUTOSCALER_SCALE (launch/terminate decisions)
+  * train      — TRAIN_GANG_RESTART (gang failure -> restart from
+                 latest checkpoint)
+
+Read back via `state.list_cluster_events()`, the dashboard
+`/api/events` route, or `python -m ray_tpu.scripts events`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+# Severities (a deliberate subset of syslog: INFO = normal but notable
+# control decisions, WARNING = degraded/retrying, ERROR = something died).
+INFO = "INFO"
+WARNING = "WARNING"
+ERROR = "ERROR"
+SEVERITIES = (INFO, WARNING, ERROR)
+
+# Event types. Closed set so dashboards/tests can switch on them; add new
+# types here rather than inventing strings at the call site.
+NODE_DEAD = "NODE_DEAD"
+SLICE_LOST = "SLICE_LOST"
+OOM_KILL = "OOM_KILL"
+COLLECTIVE_ABORT = "COLLECTIVE_ABORT"
+AUTOSCALER_SCALE = "AUTOSCALER_SCALE"
+TRAIN_GANG_RESTART = "TRAIN_GANG_RESTART"
+EVENT_TYPES = (NODE_DEAD, SLICE_LOST, OOM_KILL, COLLECTIVE_ABORT,
+               AUTOSCALER_SCALE, TRAIN_GANG_RESTART)
+
+
+def make_event(event_type: str, message: str, *,
+               severity: str = INFO, source: str = "",
+               node_id=None, slice_name: Optional[str] = None,
+               actor_id=None,
+               labels: Optional[Dict[str, str]] = None) -> dict:
+    """Build a typed event record.
+
+    `node_id`/`actor_id` accept raw bytes ids or hex strings; they are
+    stored as hex so the record stays JSON-able end to end.
+    """
+    if event_type not in EVENT_TYPES:
+        raise ValueError(f"unknown event type {event_type!r} "
+                         f"(known: {EVENT_TYPES})")
+    if severity not in SEVERITIES:
+        raise ValueError(f"unknown severity {severity!r} "
+                         f"(known: {SEVERITIES})")
+    return {
+        "time": time.time(),
+        "severity": severity,
+        "type": event_type,
+        "source": source,
+        "message": str(message),
+        "node_id": _hex(node_id),
+        "slice_name": slice_name,
+        "actor_id": _hex(actor_id),
+        "labels": dict(labels) if labels else {},
+    }
+
+
+def _hex(id_or_none) -> Optional[str]:
+    if id_or_none is None:
+        return None
+    if isinstance(id_or_none, (bytes, bytearray)):
+        return bytes(id_or_none).hex()
+    return str(id_or_none)
+
+
+def emit(event_type: str, message: str, **kwargs) -> Optional[dict]:
+    """Build an event and ship it to the GCS ring, best-effort.
+
+    Usable from any process holding an initialized core worker (driver,
+    task/actor workers — which covers the autoscaler, Train controller,
+    and collective ranks). The send is fire-and-forget on the core IO
+    loop (same path as the metrics flush), so it is thread-safe and
+    adds no latency to the failure path that called it. Processes
+    WITHOUT a core worker (GCS, raylet) append to the ring / call the
+    RPC directly instead of going through here.
+
+    Never raises: observability must not add failure modes.
+    """
+    try:
+        ev = make_event(event_type, message, **kwargs)
+    except Exception:
+        return None
+    try:
+        from ray_tpu.core import worker as worker_mod
+        if not worker_mod.is_initialized():
+            return ev
+        core = worker_mod.global_worker()
+        core.io.spawn(core.gcs.call("report_events", events=[ev]))
+    except Exception:
+        pass
+    return ev

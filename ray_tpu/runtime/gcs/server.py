@@ -442,6 +442,22 @@ class GcsServer:
         self._persist_node(rec)
         self._bump_view(rec)
         logger.warning("node %s marked dead: %s", node_id.hex()[:12], reason)
+        from ray_tpu.runtime import events as events_mod
+
+        self._record_event(events_mod.make_event(
+            events_mod.NODE_DEAD, f"node {node_id.hex()[:12]} dead: {reason}",
+            severity=events_mod.ERROR, source="gcs", node_id=node_id,
+            slice_name=rec.labels.get("tpu-slice-name")))
+        # A dead node never flushes metrics again — drop its
+        # `metrics:<node>:<pid>` KV snapshots so the dashboard /metrics
+        # aggregation stops counting ghost processes forever.
+        stale_prefix = f"metrics:{node_id.hex()}:".encode()
+        for key in [k for k in self._kv if k.startswith(stale_prefix)]:
+            self._kv.pop(key, None)
+            try:
+                self._store.delete("kv", key)
+            except Exception:
+                pass
         await self.publish("node", {"event": "removed", "node": rec.view(), "reason": reason})
         # Slice fate-sharing: a multi-host ICI slice is ONE failure domain.
         # Losing any host breaks the slice's collectives, so every sibling
@@ -486,6 +502,16 @@ class GcsServer:
                                        _slice_cascade=False)
         logger.warning("slice %r lost (%d host(s) fate-shared): %s",
                        slice_name, len(siblings), reason)
+        from ray_tpu.runtime import events as events_mod
+
+        self._record_event(events_mod.make_event(
+            events_mod.SLICE_LOST,
+            f"slice {slice_name!r} lost ({len(members)} host(s) "
+            f"fate-shared): {reason}",
+            severity=events_mod.ERROR, source="gcs", node_id=origin,
+            slice_name=slice_name,
+            labels={"hosts": str(len(members)),
+                    "members": ",".join(m.hex()[:12] for m in members)}))
         key = f"slice_lost:{slice_name}".encode()
         self._kv[key] = reason.encode()
         try:
@@ -717,6 +743,46 @@ class GcsServer:
                 self._task_latest = {k: v for k, v in
                                      self._task_latest.items() if k in alive}
         return {"ok": True}
+
+    # ---- cluster event bus (runtime/events.py) ---------------------------
+
+    def _record_event(self, ev: dict):
+        """Append one typed cluster event to the bounded ring (see
+        runtime/events.py for the record shape and the emitter list)."""
+        from collections import deque
+
+        from ray_tpu.config import cfg
+
+        store = getattr(self, "_cluster_events", None)
+        if store is None:
+            store = self._cluster_events = deque(
+                maxlen=cfg().cluster_events_max)
+        store.append(ev)
+
+    async def handle_report_events(self, conn, events):
+        """Batched typed cluster events from any component (best-effort
+        emitters: raylets, collective ranks, autoscaler, Train)."""
+        for ev in events:
+            if isinstance(ev, dict):
+                self._record_event(dict(ev))
+        return {"ok": True}
+
+    async def handle_list_events(self, conn, event_type=None, severity=None,
+                                 source=None, limit: int = 100):
+        """Newest-first filtered view of the cluster event ring."""
+        store = getattr(self, "_cluster_events", None) or ()
+        out = []
+        for ev in reversed(store):
+            if event_type is not None and ev.get("type") != event_type:
+                continue
+            if severity is not None and ev.get("severity") != severity:
+                continue
+            if source is not None and ev.get("source") != source:
+                continue
+            out.append(ev)
+            if len(out) >= limit:
+                break
+        return out
 
     async def handle_list_tasks(self, conn, state=None, name=None,
                                 limit: int = 1000):

@@ -21,6 +21,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu.runtime import events as events_mod
 from ray_tpu.runtime import metric_defs, scheduling
 from ray_tpu.runtime.object_store import ObjectStore
 from ray_tpu.runtime.rpc import RpcClient, RpcServer
@@ -29,6 +30,9 @@ from ray_tpu.utils.ids import NodeID, WorkerID
 logger = logging.getLogger(__name__)
 
 DEFAULT_OBJECT_STORE_MEMORY = 2 << 30
+
+# Hot gauge: set on every dispatch tick — bind once, skip per-set tag work.
+_PENDING_LEASES = metric_defs.PENDING_LEASES.bind()
 
 
 def _store_dir(session_dir: str) -> str:
@@ -329,8 +333,26 @@ class Raylet:
                     time.monotonic() - victim.busy_since)
                 metric_defs.OOM_KILLS.inc()
                 victim.proc.kill()
+                self._emit_event(
+                    events_mod.OOM_KILL,
+                    f"memory over {monitor.threshold:.0%}: killed worker "
+                    f"{victim.worker_id.hex()[:12]} to relieve pressure",
+                    severity=events_mod.ERROR)
             except Exception:
                 logger.exception("memory monitor tick failed")
+
+    def _emit_event(self, event_type: str, message: str, **kwargs):
+        """Ship one typed cluster event to the GCS ring, fire-and-forget.
+        The raylet has no core worker, so it bypasses events.emit and uses
+        its own auto-reconnecting GCS client; must be called on the loop."""
+        try:
+            ev = events_mod.make_event(event_type, message, source="raylet",
+                                       node_id=self.node_id, **kwargs)
+            fut = asyncio.ensure_future(
+                self.gcs.call("report_events", events=[ev], timeout=5))
+            fut.add_done_callback(lambda f: f.exception())  # best-effort
+        except Exception:
+            logger.debug("event emit failed", exc_info=True)
 
     async def run_forever(self):
         await self._shutdown.wait()
@@ -744,7 +766,7 @@ class Raylet:
                     self._queues.pop(key, None)
                 elif granted_here:
                     self._queues.move_to_end(key)
-        metric_defs.PENDING_LEASES.set(self._pending_count())
+        _PENDING_LEASES.set(self._pending_count())
 
     async def _resolve_spillback_class(self, key: tuple, q: "collections.deque"):
         """A class that can never run locally: route every member to the
@@ -772,7 +794,7 @@ class Raylet:
                     old.extend(live)
                 else:
                     self._infeasible[key] = live
-                metric_defs.PENDING_LEASES.set(self._pending_count())
+                _PENDING_LEASES.set(self._pending_count())
             return
         for req in live:
             if not req.fut.done():
@@ -1102,3 +1124,31 @@ class Raylet:
                  "resources": v["resources"], "available": v["available"]}
                 for k, v in self._bundles.items()],
         }
+
+    async def handle_dump_spans(self, conn):
+        """Cluster trace aggregation fan-in: this raylet's own span ring
+        plus every ready local worker's (each worker runtime answers the
+        same `dump_spans` RPC). Per-worker failures are dropped — a dying
+        worker must not block the cluster timeline. Spans stitch across
+        processes by the trace/span ids in their `args`, not by clock."""
+        from ray_tpu.util import tracing
+
+        node = self.node_id.hex()[:12]
+        procs = [{"label": f"raylet:{node}", "spans": tracing.get_spans()}]
+
+        async def fetch(w):
+            client = RpcClient(*w.address)
+            await client.connect(timeout=5)
+            try:
+                spans = await client.call("dump_spans", timeout=10)
+                return {"label": f"worker:{node}:{w.worker_id.hex()[:8]}",
+                        "spans": spans}
+            finally:
+                await client.close()
+
+        results = await asyncio.gather(
+            *(fetch(w) for w in list(self._workers.values())
+              if w.address is not None),
+            return_exceptions=True)
+        procs.extend(r for r in results if isinstance(r, dict))
+        return {"processes": procs}

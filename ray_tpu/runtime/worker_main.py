@@ -534,6 +534,15 @@ class WorkerRuntime:
     async def handle_ping(self, conn):
         return {"ok": True}
 
+    async def handle_dump_spans(self, conn):
+        """Cluster trace aggregation: hand this process's span ring to the
+        raylet fan-in (`scripts timeline --cluster`). Served on the IO loop
+        — the ring is a lock-guarded deque, so a busy task never blocks
+        the dump."""
+        from ray_tpu.util import tracing
+
+        return tracing.get_spans()
+
     async def handle_exit(self, conn):
         asyncio.get_event_loop().call_later(0.05, sys.exit, 0)
         return {"ok": True}

@@ -163,9 +163,35 @@ def cmd_job(args):
 def cmd_timeline(args):
     from ray_tpu.util import tracing
 
+    if args.cluster:
+        if not args.address:
+            sys.exit("--cluster requires --address")
+        from ray_tpu.state import api
+
+        _connect(args.address)
+        groups = api.dump_cluster_spans()
+        events = tracing.merge_spans(groups)
+        with open(args.output, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        nspans = sum(len(spans) for _, spans in groups)
+        print(f"wrote {nspans} spans from {len(groups)} process(es) to "
+              f"{args.output} (open in chrome://tracing)")
+        return
     tracing.dump_chrome_trace(args.output)
     print(f"wrote {len(tracing.get_spans())} spans to {args.output} "
           "(open in chrome://tracing)")
+
+
+def cmd_events(args):
+    """Typed cluster events, newest first (`ray list cluster-events`
+    analog; see ray_tpu/runtime/events.py for the record shape)."""
+    from ray_tpu.state import api
+
+    _connect(args.address)
+    events = api.list_cluster_events(event_type=args.type,
+                                     severity=args.severity,
+                                     source=args.source, limit=args.limit)
+    print(json.dumps(events, indent=2, default=str))
 
 
 def cmd_microbenchmark(args):
@@ -204,7 +230,25 @@ def main(argv=None):
 
     p = sub.add_parser("timeline")
     p.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    p.add_argument("--cluster", action="store_true",
+                   help="merge span rings from every process in the cluster "
+                        "(requires --address)")
+    p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("events",
+                       help="typed cluster events (node death, slice loss, "
+                            "OOM kills, collective aborts, scale decisions, "
+                            "gang restarts)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--type", default=None,
+                   help="filter by event type (e.g. SLICE_LOST)")
+    p.add_argument("--severity", default=None,
+                   help="filter by severity (INFO/WARNING/ERROR)")
+    p.add_argument("--source", default=None,
+                   help="filter by source component (gcs/raylet/...)")
+    p.add_argument("--limit", type=int, default=100)
+    p.set_defaults(fn=cmd_events)
 
     p = sub.add_parser("status")
     p.add_argument("--address", required=True)

@@ -1,0 +1,17 @@
+"""Cluster state introspection (`ray_tpu.state.*`).
+
+Reference analog: python/ray/util/state/__init__.py re-exporting the list_*
+API surface."""
+
+from ray_tpu.state.api import (  # noqa: F401
+    dump_cluster_spans,
+    list_actors,
+    list_cluster_events,
+    list_jobs,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    node_stats,
+    summary,
+)

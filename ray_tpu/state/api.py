@@ -117,6 +117,50 @@ def node_stats() -> List[dict]:
     return stats
 
 
+def list_cluster_events(event_type: Optional[str] = None,
+                        severity: Optional[str] = None,
+                        source: Optional[str] = None,
+                        limit: int = 100) -> List[dict]:
+    """Typed cluster events from the GCS ring (runtime/events.py), newest
+    first. Filters are exact matches on the record's type/severity/source
+    fields (e.g. event_type="SLICE_LOST", severity="ERROR")."""
+    return _gcs_call("list_events", event_type=event_type, severity=severity,
+                     source=source, limit=limit)
+
+
+def dump_cluster_spans() -> List[tuple]:
+    """Pull every per-process span ring in the cluster.
+
+    Returns [(label, spans), ...]: this process's own ring plus, per alive
+    node, the raylet's ring and each of its workers' (the raylet fans out
+    to its local workers over the same `dump_spans` RPC). Unreachable
+    nodes are skipped — a partial timeline beats none. Feed the result to
+    `tracing.merge_spans` for one chrome trace."""
+    import os
+
+    from ray_tpu.runtime.rpc import RpcClient
+    from ray_tpu.util import tracing
+
+    core = worker_mod.global_worker()
+    groups = [(f"driver:{os.getpid()}", tracing.get_spans())]
+    for n in _gcs_call("get_nodes"):
+        async def fetch(addr=tuple(n["address"])):
+            client = RpcClient(*addr)
+            await client.connect(timeout=5)
+            try:
+                return await client.call("dump_spans", timeout=15)
+            finally:
+                await client.close()
+
+        try:
+            reply = core.io.run(fetch(), timeout=20)
+        except Exception:
+            continue
+        for proc in reply.get("processes", ()):
+            groups.append((proc["label"], proc["spans"]))
+    return groups
+
+
 def summary() -> Dict:
     nodes = list_nodes()
     actors = list_actors()

@@ -15,7 +15,13 @@ from ray_tpu.train.config import (  # noqa: F401
     ScalingConfig,
 )
 from ray_tpu.train.result import Result  # noqa: F401
-from ray_tpu.train.session import get_checkpoint, get_context, report  # noqa: F401
+from ray_tpu.train.session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    report,
+    step_phase,
+)
+from ray_tpu.train.telemetry import TrainTelemetry  # noqa: F401
 from ray_tpu.train.trainer import (  # noqa: F401
     CollectiveTrainer,
     DataParallelTrainer,

@@ -150,8 +150,13 @@ def allreduce_gradients(grads, group_name: Optional[str] = None,
     worker group's collective backend (reference: the NCCL allreduce inside
     DDP's backward). Use inside train loops running the CollectiveBackend.
     Gradients are coalesced into per-dtype buckets whose ring allreduces
-    launch as each bucket fills (see reduce_gradients)."""
+    launch as each bucket fills (see reduce_gradients). Inside a train
+    worker the whole sync is booked to the step's "collective" phase
+    (train/telemetry.py straggler attribution); outside one, the phase
+    wrapper is a no-op."""
     from ray_tpu.collective.collective import get_group
+    from ray_tpu.train.session import step_phase
 
     comm = get_group(group_name or _active_group or "default")
-    return reduce_gradients(comm, grads, bucket_bytes=bucket_bytes)
+    with step_phase("collective"):
+        return reduce_gradients(comm, grads, bucket_bytes=bucket_bytes)

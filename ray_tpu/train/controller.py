@@ -68,6 +68,10 @@ class TrainController:
             self.scaling, self._available_resources())
         self.callbacks.fire("on_run_start", self.run_name, self.storage_path)
         self._final_result = None
+        from ray_tpu.train.telemetry import TrainTelemetry
+
+        self.telemetry = TrainTelemetry(run_name=self.run_name)
+        self._run_started = time.monotonic()
         try:
             return self._run_attempts(poll_interval, world)
         finally:
@@ -113,7 +117,8 @@ class TrainController:
                     metrics=self.latest_metrics,
                     checkpoint=self.ckpt_manager.latest_checkpoint,
                     best_checkpoints=None, path=self.storage_path,
-                    metrics_dataframe=self.metrics_history, error=None)
+                    metrics_dataframe=self.metrics_history, error=None,
+                    telemetry=self._finalize_telemetry(attempt))
                 return self._final_result
             if error == _RESIZE:
                 # Controlled elastic restart: resume from the latest
@@ -134,6 +139,18 @@ class TrainController:
                         "failure (%s); %d workers resuming from %s",
                         self.run_name, error, world,
                         latest.path if latest else "scratch")
+                    self.telemetry.gang_restarts += 1
+                    from ray_tpu.runtime import events as events_mod
+
+                    events_mod.emit(
+                        events_mod.TRAIN_GANG_RESTART,
+                        f"train run {self.run_name!r}: gang restart after "
+                        f"attempt {attempt} ({error}); {world} worker(s) "
+                        f"resuming from "
+                        f"{latest.path if latest else 'scratch'}",
+                        severity=events_mod.WARNING, source="train",
+                        labels={"run": self.run_name,
+                                "attempt": str(attempt)})
                 else:
                     logger.warning("train run %s failed (%s); restarting with "
                                    "%d workers", self.run_name, error, world)
@@ -146,8 +163,14 @@ class TrainController:
                 metrics=self.latest_metrics,
                 checkpoint=self.ckpt_manager.latest_checkpoint,
                 best_checkpoints=None, path=self.storage_path,
-                metrics_dataframe=self.metrics_history, error=error)
+                metrics_dataframe=self.metrics_history, error=error,
+                telemetry=self._finalize_telemetry(attempt))
             return self._final_result
+
+    def _finalize_telemetry(self, attempts: int):
+        self.telemetry.attempts = attempts
+        self.telemetry.wall_time_s = time.monotonic() - self._run_started
+        return self.telemetry
 
     def _wait_for_capacity(self, world: int) -> None:
         """Bounded wait until the cluster can fit `world` workers again.
@@ -194,6 +217,8 @@ class TrainController:
                 for item in poll["results"]:
                     if "error" in item:
                         return item["error"]
+                    if item.get("telemetry"):
+                        self.telemetry.record_step(item["telemetry"])
                     if item["rank"] == 0:
                         metrics = item["metrics"]
                         self.latest_metrics = metrics

@@ -19,3 +19,6 @@ class Result:
     path: str
     metrics_dataframe: Optional[List[Dict]] = None
     error: Optional[str] = None
+    # Per-run step breakdown / goodput / straggler attribution
+    # (train/telemetry.py TrainTelemetry); populated by TrainController.
+    telemetry: Optional[Any] = None

@@ -11,6 +11,8 @@ import os
 import queue
 import tempfile
 import threading
+import time
+from contextlib import contextmanager
 from typing import Any, Dict, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
@@ -58,6 +60,40 @@ class TrainSession:
         self.results: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
+        # Step telemetry (train/telemetry.py): named phase seconds since
+        # the last report(), closed into one step record per report.
+        self.step_index = 0
+        self._step_started = time.monotonic()
+        self._step_started_wall = time.time()
+        self._phase_acc: Dict[str, float] = {}
+        self._phase_lock = threading.Lock()
+
+    def _close_step(self) -> Dict[str, Any]:
+        """Close the current step: wall time since the last report split
+        into the named phases accumulated by `step_phase`, with the
+        unattributed residual booked as compute."""
+        from ray_tpu.util import tracing
+
+        now = time.monotonic()
+        now_wall = time.time()
+        total = max(0.0, now - self._step_started)
+        with self._phase_lock:
+            phases, self._phase_acc = self._phase_acc, {}
+        known = sum(phases.values())
+        rec = {"step": self.step_index, "rank": self.world_rank,
+               "total_s": total,
+               "data_s": phases.pop("data", 0.0),
+               "collective_s": phases.pop("collective", 0.0),
+               "checkpoint_s": phases.pop("checkpoint", 0.0),
+               "compute_s": max(0.0, total - known),
+               "other_s": sum(phases.values())}
+        tracing.record_span("train:step", "train:step",
+                            self._step_started_wall, now_wall,
+                            rank=self.world_rank, step=self.step_index)
+        self.step_index += 1
+        self._step_started = now
+        self._step_started_wall = now_wall
+        return rec
 
 
 def init_session(**kwargs) -> TrainSession:
@@ -76,15 +112,44 @@ def get_context() -> TrainContext:
     return TrainContext(get_session())
 
 
+@contextmanager
+def step_phase(name: str):
+    """Attribute the wrapped block of the current train step to a named
+    phase ("data" / "collective" / "checkpoint"; other names land in the
+    step record's `other_s`). Opens a `train:<name>` span so the phase
+    also shows up in `scripts timeline --cluster`. No-op outside a train
+    worker, so library code (e.g. `allreduce_gradients`) can wrap
+    unconditionally."""
+    s = _session
+    if s is None:
+        yield
+        return
+    from ray_tpu.util import tracing
+
+    t0 = time.perf_counter()
+    try:
+        with tracing.span(f"train:{name}", "train:phase",
+                          rank=s.world_rank, step=s.step_index):
+            yield
+    finally:
+        dt = time.perf_counter() - t0
+        with s._phase_lock:
+            s._phase_acc[name] = s._phase_acc.get(name, 0.0) + dt
+
+
 def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
-    """Report metrics (and optionally a checkpoint dir) to the controller."""
+    """Report metrics (and optionally a checkpoint dir) to the controller.
+    Also closes the current telemetry step: wall time since the previous
+    report, broken down by the phases `step_phase` accumulated."""
     s = get_session()
     ckpt_path = None
     if checkpoint is not None:
-        ckpt_path = checkpoint.as_directory()
+        with step_phase("checkpoint"):
+            ckpt_path = checkpoint.as_directory()
         s.latest_checkpoint = checkpoint
+    telemetry = s._close_step()
     s.results.put({"metrics": dict(metrics), "checkpoint_path": ckpt_path,
-                   "rank": s.world_rank})
+                   "rank": s.world_rank, "telemetry": telemetry})
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

@@ -1,0 +1,96 @@
+"""Per-run train telemetry: step phase breakdown, goodput, stragglers.
+
+Reference analog: ray.train's v2 metrics surface plus the per-rank timing
+attribution argued for by multi-tenant collective scheduling work (GADGET,
+arxiv 2202.01158): aggregate throughput hides WHO is slow — a straggling
+rank shows up in every OTHER rank's collective wait, so attribution needs
+per-rank, per-phase seconds.
+
+The flow: each worker's session accumulates named phase seconds
+(`train.step_phase("data")`, the collective phase auto-wrapped by
+`allreduce_gradients`) and closes a step record at every
+`session.report()`. Records ride the existing results queue to the
+controller, which folds them into one `TrainTelemetry` attached to
+`Result.telemetry`:
+
+  * goodput   — productive step seconds (rank 0) over run wall seconds,
+                INCLUDING time lost to gang restarts and capacity waits
+                (the denominator a TPU bill actually charges for).
+  * stragglers — per-rank compute/collective seconds. In a synchronous
+                ring, ranks finishing compute early burn the difference
+                inside the collective — so the straggler is the rank with
+                max compute and min collective wait.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+# Canonical phase keys of a step record (session._close_step): `total_s` is
+# wall time since the previous report; `compute_s` is the unattributed
+# residual after the named phases.
+PHASE_KEYS = ("total_s", "data_s", "collective_s", "checkpoint_s",
+              "compute_s", "other_s")
+
+
+@dataclasses.dataclass
+class TrainTelemetry:
+    run_name: str
+    steps: List[dict] = dataclasses.field(default_factory=list)
+    per_rank: Dict[int, Dict[str, float]] = dataclasses.field(
+        default_factory=dict)
+    attempts: int = 1
+    gang_restarts: int = 0
+    wall_time_s: float = 0.0
+    productive_time_s: float = 0.0
+
+    def record_step(self, rec: dict) -> None:
+        """Fold one per-rank step record (from `session.report()`) in.
+        Rank 0's records define the per-step breakdown series and the
+        productive-time numerator; every rank feeds the straggler table."""
+        rank = int(rec.get("rank", 0))
+        acc = self.per_rank.setdefault(
+            rank, {**{k: 0.0 for k in PHASE_KEYS}, "steps": 0})
+        for k in PHASE_KEYS:
+            acc[k] += float(rec.get(k, 0.0))
+        acc["steps"] += 1
+        if rank == 0:
+            self.steps.append(dict(rec))
+            self.productive_time_s += float(rec.get("total_s", 0.0))
+
+    @property
+    def goodput(self) -> float:
+        """Productive step time / run wall time, in [0, 1]. Wall time spans
+        the whole `TrainController.run()` — worker placement, gang
+        restarts, checkpoint restores, and capacity waits all dilute it."""
+        if self.wall_time_s <= 0.0:
+            return 0.0
+        return min(1.0, self.productive_time_s / self.wall_time_s)
+
+    def straggler_report(self) -> List[dict]:
+        """Per-rank phase attribution, rank order. `straggler` marks the
+        rank with the most compute seconds (the one the ring waits on)."""
+        out = []
+        for rank in sorted(self.per_rank):
+            acc = self.per_rank[rank]
+            out.append({"rank": rank, "steps": acc["steps"],
+                        "compute_s": acc["compute_s"],
+                        "collective_s": acc["collective_s"],
+                        "data_s": acc["data_s"],
+                        "checkpoint_s": acc["checkpoint_s"]})
+        if out:
+            slowest = max(out, key=lambda r: r["compute_s"])
+            for r in out:
+                r["straggler"] = r["rank"] == slowest["rank"]
+        return out
+
+    def to_dict(self) -> dict:
+        return {"run_name": self.run_name, "steps": list(self.steps),
+                "per_rank": {r: dict(a) for r, a in self.per_rank.items()},
+                "attempts": self.attempts,
+                "gang_restarts": self.gang_restarts,
+                "wall_time_s": self.wall_time_s,
+                "productive_time_s": self.productive_time_s,
+                "goodput": self.goodput,
+                "stragglers": self.straggler_report()}

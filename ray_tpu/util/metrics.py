@@ -62,7 +62,13 @@ class Metric:
 
     def _merged(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
         merged = dict(self._default_tags)
-        merged.update(tags or {})
+        if tags:
+            for k in tags:
+                if k not in self._tag_keys:
+                    raise ValueError(
+                        f"unknown tag key {k!r} for metric {self._name!r} "
+                        f"(declared: {self._tag_keys})")
+            merged.update(tags)
         return merged
 
     def _observe(self, value: float, tags: Optional[Dict[str, str]]):
@@ -113,10 +119,29 @@ class Gauge(Metric):
     TYPE = "gauge"
 
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
-        key = _tag_key(self._merged(tags))
+        self._set_key(_tag_key(self._merged(tags)), value)
+
+    def _set_key(self, key: str, value: float):
         with self._lock:
             self._values[key] = float(value)
         _maybe_flush()
+
+    def bind(self, tags: Optional[Dict[str, str]] = None) -> "BoundGauge":
+        """Counter.bind/Histogram.bind symmetry: precompute the tag key so
+        hot gauges (PENDING_LEASES on every dispatch tick) skip the
+        per-set merge/json encode."""
+        return BoundGauge(self, _tag_key(self._merged(tags)))
+
+
+class BoundGauge:
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: Gauge, key: str):
+        self._metric = metric
+        self._key = key
+
+    def set(self, value: float):
+        self._metric._set_key(self._key, value)
 
 
 class Histogram(Metric):
@@ -235,8 +260,17 @@ def prometheus_text(snapshots: List[Dict]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _escape_label_value(value) -> str:
+    """Prometheus text exposition escaping for label values: backslash,
+    double-quote, and newline must be escaped (in that order — escaping
+    the backslash last would corrupt the other two)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: Dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"

@@ -116,6 +116,28 @@ def span(name: str, kind: str, **attrs):
             ctx.__exit__(None, None, None)
 
 
+def record_span(name: str, kind: str, start: float, end: float, **attrs):
+    """Append a span retroactively from measured wall-clock bounds.
+
+    For code that times phases itself (e.g. Train closes a step record at
+    `session.report()` — the step's extent is only known after the fact).
+    The span joins the thread's current trace context exactly like
+    `span()` would."""
+    if not _enabled:
+        return
+    trace_id = getattr(_ctx, "trace_id", None) or os.urandom(16)
+    parent = getattr(_ctx, "span_id", None)
+    ids = {"trace_id": trace_id.hex(), "span_id": os.urandom(8).hex()}
+    if parent is not None:
+        ids["parent_span_id"] = parent.hex()
+    with _lock:
+        _spans.append({"name": name, "cat": kind, "ts": start * 1e6,
+                       "dur": max(0.0, end - start) * 1e6, "ph": "X",
+                       "pid": os.getpid(),
+                       "tid": threading.get_ident() % 100000,
+                       "args": {**ids, **attrs}})
+
+
 def get_spans() -> list:
     with _lock:
         return list(_spans)
@@ -126,3 +148,28 @@ def dump_chrome_trace(path: str):
     (the `ray timeline` CLI analog)."""
     with open(path, "w") as f:
         json.dump({"traceEvents": get_spans()}, f)
+
+
+def merge_spans(groups) -> list:
+    """Merge per-process span rings into one chrome traceEvents list.
+
+    `groups` is an iterable of (label, spans) — one entry per process, as
+    returned by the cluster `dump_spans` fan-out. os.getpid() collides
+    across hosts, so every (label, original pid) pair is remapped to a
+    unique lane and announced with a process_name metadata event; the
+    trace/span ids in each span's `args` are untouched — they are what
+    stitches submit -> execute -> nested submit across lanes."""
+    events, lanes = [], {}
+    for label, spans in groups:
+        for s in spans:
+            key = (label, s.get("pid"))
+            lane = lanes.get(key)
+            if lane is None:
+                lane = lanes[key] = len(lanes) + 1
+                events.append({"ph": "M", "name": "process_name",
+                               "pid": lane,
+                               "args": {"name": f"{label} (pid {s.get('pid')})"}})
+            ev = dict(s)
+            ev["pid"] = lane
+            events.append(ev)
+    return events

@@ -61,6 +61,29 @@ def test_dashboard_metrics_endpoint(cluster_with_dashboard):
     assert "dash_test_counter" in text and "3.0" in text
 
 
+def test_dashboard_events_endpoint(cluster_with_dashboard):
+    import time
+
+    from ray_tpu.runtime import events as events_mod
+
+    events_mod.emit(events_mod.AUTOSCALER_SCALE, "dash event probe",
+                    source="autoscaler")
+    deadline = time.monotonic() + 15
+    events = []
+    while time.monotonic() < deadline:
+        events = _get_json(cluster_with_dashboard
+                           + "/api/events?type=AUTOSCALER_SCALE")["events"]
+        if events:
+            break
+        time.sleep(0.2)
+    assert events and events[0]["message"] == "dash event probe"
+    assert events[0]["severity"] == "INFO"
+    # Filters that match nothing return an empty list, not an error.
+    empty = _get_json(cluster_with_dashboard
+                      + "/api/events?type=OOM_KILL&limit=5")["events"]
+    assert empty == []
+
+
 def test_job_submit_roundtrip(cluster_with_dashboard, tmp_path):
     script = tmp_path / "jobscript.py"
     script.write_text(

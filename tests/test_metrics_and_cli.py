@@ -106,6 +106,59 @@ def test_cli_drain_node(capsys):
         cluster.shutdown()
 
 
+def test_prometheus_label_value_escaping():
+    """Label values containing backslash, quote, or newline must come out
+    escaped per the Prometheus text exposition spec — never as a raw
+    newline inside the braces (which truncates the sample line)."""
+    assert metrics_mod._escape_label_value('a"b') == 'a\\"b'
+    assert metrics_mod._escape_label_value("a\\b") == "a\\\\b"
+    assert metrics_mod._escape_label_value("a\nb") == "a\\nb"
+    # Backslash escapes first: a pre-escaped quote must not double-mangle.
+    assert metrics_mod._escape_label_value('\\"') == '\\\\\\"'
+
+    c = metrics_mod.Counter("esc_test_total", "escaping probe",
+                            tag_keys=("path",))
+    c.inc(1, tags={"path": 'tmp\\dir "x"\nnext'})
+    text = metrics_mod.prometheus_text([c.snapshot()])
+    line = next(l for l in text.splitlines()
+                if l.startswith("esc_test_total{"))
+    assert 'path="tmp\\\\dir \\"x\\"\\nnext"' in line
+    assert "\n" not in line  # the newline rode through escaped, not raw
+
+
+def test_gauge_bind_hot_path():
+    g = metrics_mod.Gauge("bind_test_gauge", "bind probe",
+                          tag_keys=("lane",))
+    bound = g.bind({"lane": "a"})
+    bound.set(3.0)
+    bound.set(7.0)  # last write wins, same pre-resolved key
+    g.set(1.0, tags={"lane": "b"})  # unbound path still works alongside
+    values = g.snapshot()["values"]
+    assert values[metrics_mod._tag_key({"lane": "a"})] == 7.0
+    assert values[metrics_mod._tag_key({"lane": "b"})] == 1.0
+    # Undeclared tag keys are a programming error, bound or not.
+    with pytest.raises(ValueError):
+        g.bind({"nope": "x"})
+    with pytest.raises(ValueError):
+        g.set(1.0, tags={"nope": "x"})
+
+
+def test_metric_registry_lint():
+    """Every native metric: unique ray_tpu_-prefixed name, non-empty
+    description, and only declared tag keys ever recorded."""
+    names = [m.info["name"] for m in metric_defs.ALL_METRICS]
+    assert len(names) == len(set(names)), "duplicate metric names"
+    for m in metric_defs.ALL_METRICS:
+        info = m.info
+        assert info["name"].startswith("ray_tpu_"), info["name"]
+        assert info["description"].strip(), f"{info['name']} undescribed"
+        declared = set(info["tag_keys"])
+        for key in m.snapshot()["values"]:
+            used = {k for k, _ in json.loads(key)} if key != "[]" else set()
+            assert used <= declared, \
+                f"{info['name']} recorded undeclared tags {used - declared}"
+
+
 def test_microbenchmark_runs():
     """`ray_tpu microbenchmark` (ray_perf.py analog) produces every core
     metric with positive rates."""

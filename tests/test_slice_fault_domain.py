@@ -459,6 +459,19 @@ def test_elastic_train_survives_slice_strike(tmp_path):
         assert result.error is None, result.error
         assert result.metrics["step"] == 7
         assert result.metrics["world"] == 2
+
+        # Observability plane: the gang restart left a typed cluster event
+        # and is counted (with its wall-clock cost) in Result.telemetry.
+        from ray_tpu.state import list_cluster_events
+        restarts = list_cluster_events(event_type="TRAIN_GANG_RESTART")
+        assert restarts, "no TRAIN_GANG_RESTART event after slice strike"
+        assert restarts[0]["severity"] == "WARNING"
+        assert restarts[0]["source"] == "train"
+        assert restarts[0]["labels"]["run"] == "slice-strike"
+        tel = result.telemetry
+        assert tel is not None and tel.gang_restarts >= 1
+        assert tel.attempts >= 2
+        assert 0 < tel.goodput <= 1.0
     finally:
         try:
             ray_tpu.shutdown()
