@@ -53,11 +53,16 @@ class Work:
     relies on. Errors (including CollectiveAbortError from a watchdog
     abort) surface at `wait()`, never silently."""
 
-    __slots__ = ("op_id", "group_name", "_done", "_result", "_error")
+    __slots__ = ("op_id", "group_name", "rank", "world_size", "_done",
+                 "_result", "_error")
 
-    def __init__(self, op_id: int, group_name: str):
+    def __init__(self, op_id: int, group_name: str,
+                 rank: Optional[int] = None,
+                 world_size: Optional[int] = None):
         self.op_id = op_id
         self.group_name = group_name
+        self.rank = rank
+        self.world_size = world_size
         self._done = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -75,10 +80,19 @@ class Work:
         error. The executing op observes the group's abort flag and per-op
         deadline itself, so an aborted group completes this (exceptionally)
         within one watchdog tick."""
-        if not self._done.wait(timeout):
-            raise TimeoutError(
-                f"collective op {self.op_id} on group "
-                f"{self.group_name!r} not done after {timeout}s")
+        if not self._done.is_set():
+            # The caller is about to park: make the hang diagnosable
+            # (stack dumps / wait-graph name the group + op id).
+            from ray_tpu.core import blocked as blocked_mod
+
+            with blocked_mod.blocked_on(
+                    blocked_mod.COLLECTIVE_OP, group=self.group_name,
+                    op_id=self.op_id, rank=self.rank,
+                    world_size=self.world_size):
+                if not self._done.wait(timeout):
+                    raise TimeoutError(
+                        f"collective op {self.op_id} on group "
+                        f"{self.group_name!r} not done after {timeout}s")
         if self._error is not None:
             raise self._error
         return self._result
@@ -101,6 +115,10 @@ class Communicator(abc.ABC):
         self._watchdog: Optional["CollectiveWatchdog"] = None
         self._active_ops = 0
         self._op_lock = threading.Lock()
+        # Sequence number of the op the op thread is currently executing
+        # (backends with a real op thread keep it current); blocked-on
+        # records use it to name which op a stuck rank is inside.
+        self._current_op_id = 0
 
     # ---- abort -----------------------------------------------------------
 
@@ -132,8 +150,14 @@ class Communicator(abc.ABC):
         self.check_abort()
         with self._op_lock:
             self._active_ops += 1
+        from ray_tpu.core import blocked as blocked_mod
+
         try:
-            yield
+            with blocked_mod.blocked_on(
+                    blocked_mod.COLLECTIVE_OP, group=self.group_name,
+                    op_id=self._current_op_id, rank=self.rank,
+                    world_size=self.world_size):
+                yield
         finally:
             with self._op_lock:
                 self._active_ops -= 1

@@ -462,7 +462,8 @@ class TCPCommunicator(Communicator):
         self.check_abort()  # closed/aborted groups reject new ops eagerly
         with self._submit_lock:
             self._op_seq += 1
-            work = Work(self._op_seq, self.group_name)
+            work = Work(self._op_seq, self.group_name,
+                        rank=self.rank, world_size=self.world_size)
             if self._op_queue is not None:
                 self._op_queue.put((work, fn))
                 return work
@@ -479,6 +480,7 @@ class TCPCommunicator(Communicator):
             if item is None:
                 return
             work, fn = item
+            self._current_op_id = work.op_id  # blocked-on attribution
             try:
                 work._finish(result=fn())
             except BaseException as e:  # noqa: BLE001 - delivered at wait()

@@ -100,6 +100,17 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
     "cluster_events_max": (int, 10_000,
                            "structured cluster events retained by the GCS "
                            "event ring (see runtime/events.py)"),
+    "stall_detector_interval_s": (float, 2.0,
+                                  "GCS wait-graph detector tick period "
+                                  "(cycle -> DEADLOCK_DETECTED, old edge "
+                                  "-> TASK_STALLED)"),
+    "stall_threshold_s": (float, 30.0,
+                          "a wait-graph edge blocked longer than this is "
+                          "reported as TASK_STALLED"),
+    "wait_edge_max_age_s": (float, 15.0,
+                            "GCS drops a reporter's wait edges not "
+                            "refreshed within this window (crashed or "
+                            "unblocked worker)"),
     # -- collectives -------------------------------------------------------
     "collective_watchdog_interval_s": (float, 1.0,
                                        "peer-liveness/abort poll period of "

@@ -88,6 +88,10 @@ class CoreWorker:
         self.memory_store: Dict[bytes, Any] = {}      # oid -> deserialized value
         self._object_locations: Dict[bytes, bytes] = {}  # oid -> node_id (plasma results)
         self.result_futures: Dict[bytes, SyncFuture] = {}
+        # Pending return oid -> {task_id, name, actor_id}: lets a blocked
+        # get() name the task/actor it is waiting for (wait-graph edges,
+        # `scripts stack` annotations). Popped with result_futures.
+        self._result_meta: Dict[bytes, dict] = {}
         self._mem_lock = threading.Lock()
         self._registered_fns: set = set()
         self._keys: Dict[Tuple, _KeyState] = {}
@@ -141,6 +145,14 @@ class CoreWorker:
         self.current_task_name: Optional[str] = None
         self.job_id = None
         self.job_runtime_env: Optional[dict] = None   # init(runtime_env=...)
+        # Task-event + wait-edge reporter: started unconditionally so even
+        # a process that never submits a task (e.g. a driver parked in
+        # get()) reports what it is blocked on.
+        with self._mem_lock:
+            self._task_events: list = []
+            self._task_events_flusher_started = True
+        self._had_wait_edges = False
+        self.io.spawn(self._flush_task_events_loop())
 
     @staticmethod
     async def _connect(addr, auto_reconnect: bool = False):
@@ -231,35 +243,61 @@ class CoreWorker:
             if oid in self.memory_store:
                 return self._raise_if_error(self.memory_store[oid])
             fut = self.result_futures.get(oid)
-        if fut is not None:
+        # Everything below may block: register what we are blocked on so
+        # stack dumps and the cluster wait-graph can explain the stall.
+        with self._blocked_get_ctx(oid, ref):
+            if fut is not None:
+                try:
+                    fut.result(timeout)
+                # On 3.10 concurrent.futures.TimeoutError is NOT the builtin
+                # TimeoutError (they merge in 3.11) — catch both.
+                except (TimeoutError, concurrent.futures.TimeoutError):
+                    raise GetTimeoutError(f"get() timed out waiting for {ref}")
+                with self._mem_lock:
+                    if oid in self.memory_store:
+                        return self._raise_if_error(self.memory_store[oid])
+                # fell through: result is in plasma
+            start = time.monotonic()
             try:
-                fut.result(timeout)
-            # On 3.10 concurrent.futures.TimeoutError is NOT the builtin
-            # TimeoutError (they merge in 3.11) — catch both.
-            except (TimeoutError, concurrent.futures.TimeoutError):
-                raise GetTimeoutError(f"get() timed out waiting for {ref}")
-            with self._mem_lock:
-                if oid in self.memory_store:
-                    return self._raise_if_error(self.memory_store[oid])
-            # fell through: result is in plasma
-        start = time.monotonic()
-        try:
-            value = self._get_plasma_value(oid, ref.owner, timeout)
-        except ObjectNotFoundError:
-            # The plasma wait may have consumed the whole budget: the owner
-            # fallback only gets what remains (never doubles the timeout).
-            remaining = (None if timeout is None else
-                         timeout - (time.monotonic() - start))
-            if remaining is not None and remaining <= 0:
-                raise GetTimeoutError(f"get() timed out waiting for {ref}")
-            value = self._fetch_from_owner(ref, remaining)
-        except ObjectLostError:
-            # Lineage reconstruction: re-execute the producing task, then
-            # re-enter the full read path (the new result may be inline).
-            if not self._reconstruct(oid, timeout):
-                raise
-            return self.get_one(ref, timeout)
+                value = self._get_plasma_value(oid, ref.owner, timeout)
+            except ObjectNotFoundError:
+                # The plasma wait may have consumed the whole budget: the
+                # owner fallback only gets what remains (never doubles the
+                # timeout).
+                remaining = (None if timeout is None else
+                             timeout - (time.monotonic() - start))
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(f"get() timed out waiting for {ref}")
+                value = self._fetch_from_owner(ref, remaining)
+            except ObjectLostError:
+                # Lineage reconstruction: re-execute the producing task, then
+                # re-enter the full read path (the new result may be inline).
+                if not self._reconstruct(oid, timeout):
+                    raise
+                return self.get_one(ref, timeout)
         return self._raise_if_error(value)
+
+    def _blocked_get_ctx(self, oid: bytes, ref: ObjectRef, **extra):
+        """blocked_on("object_get") context for a (possibly) blocking read
+        of `ref`, annotated with everything this process knows about the
+        object: its owner and — when we submitted the producing task
+        ourselves — the target task/actor (the wait-graph edge)."""
+        from ray_tpu.core import blocked as blocked_mod
+
+        detail = {"oid": oid.hex()}
+        owner = ref.owner_addr or ref.owner
+        if owner is not None:
+            detail["owner"] = (owner.hex()
+                               if isinstance(owner, (bytes, bytearray))
+                               else f"{owner[0]}:{owner[1]}")
+        meta = self._result_meta.get(oid)
+        if meta:
+            detail["target_task"] = meta.get("task_id")
+            detail["target_name"] = meta.get("name")
+            if meta.get("actor_id"):
+                detail["target_actor"] = meta["actor_id"]
+        detail.update(extra)
+        return blocked_mod.blocked_on(blocked_mod.OBJECT_GET, **detail)
 
 
     def _get_plasma_value(self, oid: bytes, owner: Optional[bytes],
@@ -424,12 +462,15 @@ class CoreWorker:
                 # Some refs can only appear by being sealed into plasma by
                 # another process (no completion signal): re-check coarsely.
                 block = 0.02 if remaining is None else min(0.02, remaining)
-            if futs:
-                concurrent.futures.wait(
-                    futs, timeout=block,
-                    return_when=concurrent.futures.FIRST_COMPLETED)
-            else:
-                time.sleep(block)
+            first = pending[0]
+            with self._blocked_get_ctx(first.binary(), first,
+                                       num_pending=len(pending)):
+                if futs:
+                    concurrent.futures.wait(
+                        futs, timeout=block,
+                        return_when=concurrent.futures.FIRST_COMPLETED)
+                else:
+                    time.sleep(block)
         return ready, pending
 
     # ------------------------------------------------------------- functions
@@ -561,21 +602,75 @@ class CoreWorker:
         if start:
             self.io.spawn(self._flush_task_events_loop())
 
+    def _collect_wait_edges(self) -> list:
+        """Snapshot this process's blocked-on records as wait-graph edges,
+        each with a short captured stack so detector events can show WHERE
+        the waiter is parked, not just what it waits for."""
+        import sys as _sys
+        import traceback as _tb
+
+        from ray_tpu.core import blocked as blocked_mod
+
+        try:
+            edges = blocked_mod.current_edges()
+        except Exception:
+            return []
+        if not edges:
+            return []
+        # The frames snapshot must not outlive this call: the dict contains
+        # our own frame (a cycle only the generational GC would break), and
+        # any frame whose function returns meanwhile stays alive with its
+        # locals — a pinned channel buffer held that way wedges the ring's
+        # writer. clear() breaks the cycle and drops dead frames now.
+        frames = _sys._current_frames()
+        try:
+            for e in edges:
+                f = frames.get(e.get("thread"))
+                if f is not None:
+                    try:
+                        e["stack"] = [ln.rstrip("\n") for ln in
+                                      _tb.format_stack(f, limit=4)]
+                    except Exception:
+                        pass
+                    f = None
+                e.pop("thread", None)
+                if self.node_id is not None:
+                    e["node_id"] = self.node_id.hex()
+                if self.current_actor_id and "waiter_actor" not in e:
+                    e["waiter_actor"] = self.current_actor_id.hex()
+        finally:
+            frames.clear()
+        return edges
+
     async def _flush_task_events_loop(self):
         while True:
             await asyncio.sleep(cfg().task_events_flush_interval_s)
             self._drain_dropped_refs()   # idle-driver drop processing
+            # Piggyback wait-graph edges on the same flush tick/RPC: an
+            # edge list (possibly empty, to clear a previous report) rides
+            # the FIRST report_task_events call of the tick.
+            edges = self._collect_wait_edges()
+            send_edges = (edges if (edges or self._had_wait_edges)
+                          else None)
+            self._had_wait_edges = bool(edges)
+            first = True
             while True:
                 with self._mem_lock:
                     buf = getattr(self, "_task_events", None)
-                    if not buf:
-                        break
-                    batch = buf[:500]
-                    del buf[:500]  # in-place: appends race-free under lock
+                    batch = buf[:500] if buf else []
+                    if batch:
+                        del buf[:500]  # in-place: appends race-free
+                if not batch and not (first and send_edges is not None):
+                    break
                 try:
-                    await self.gcs.call("report_task_events", events=batch)
+                    await self.gcs.call(
+                        "report_task_events", events=batch,
+                        wait_edges=send_edges if first else None,
+                        reporter=self.worker_ident,
+                        node_id=self.node_id)
                 except Exception:
                     break  # GCS down/old: drop quietly, retry next tick
+                first = False
 
     # --------------------------------------------- ownership & refcounting
     #
@@ -960,6 +1055,8 @@ class CoreWorker:
             for i in range(num_returns):
                 oid = ObjectID.for_task_return(TaskID(task_id), i).binary()
                 self.result_futures[oid] = SyncFuture()
+                self._result_meta[oid] = {"task_id": task_id.hex(),
+                                          "name": name}
                 refs.append(ObjectRef(oid, owner=self.node_id,
                                       owner_addr=self.owner_addr))
         for ref in refs:
@@ -1602,6 +1699,7 @@ class CoreWorker:
                         if children:
                             rec["children"].extend(children)
                     fut = self.result_futures.pop(oid, None)
+                    self._result_meta.pop(oid, None)
                     if fut is not None and not fut.done():
                         fut.set_result(True)
             del displaced
@@ -1627,6 +1725,7 @@ class CoreWorker:
                 displaced.append(self.memory_store.pop(oid, None))
                 self.memory_store[oid] = err
                 fut = self.result_futures.pop(oid, None)
+                self._result_meta.pop(oid, None)
                 if fut is not None and not fut.done():
                     fut.set_result(True)
         del displaced
@@ -1663,12 +1762,53 @@ class CoreWorker:
             for i in range(num_returns):
                 oid = ObjectID.for_task_return(TaskID(task_id), i).binary()
                 self.result_futures[oid] = SyncFuture()
+                self._result_meta[oid] = {"task_id": task_id.hex(),
+                                          "name": name,
+                                          "actor_id": actor_id.hex()}
                 refs.append(ObjectRef(oid, owner_addr=self.owner_addr))
         for ref in refs:
             self._new_owned(ref.binary(), inline=True)
             self.register_ref(ref)
         self.io.spawn(client.enqueue(spec))
         return refs
+
+    def object_table(self, limit: int = 1000) -> List[dict]:
+        """Owner-side object table of THIS process: refcounts, locations,
+        pin state, plus spill state and size where cheaply determinable.
+        Serves `state.list_objects()` locally and the `list_objects` worker
+        RPC that `state.summarize_objects()` fans out cluster-wide."""
+        with self._mem_lock:
+            rows = [(oid, dict(local_refs=self._local_refs.get(oid, 0),
+                               borrowers=len(rec["borrowers"]),
+                               containers=len(rec["containers"]),
+                               locations=[loc.hex()
+                                          for loc in rec["locations"]],
+                               pinned=self._arg_pins.get(oid, 0),
+                               in_memory=oid in self.memory_store))
+                    for oid, rec in list(self._owned.items())[:limit]]
+        out = []
+        for oid, row in rows:
+            row["object_id"] = oid.hex()
+            row["owner"] = self.worker_ident
+            spilled = (self.spill is not None
+                       and self.spill.contains(oid))
+            row["spilled"] = spilled
+            size = None
+            if spilled:
+                try:
+                    size = os.path.getsize(self.spill._path(oid))
+                except OSError:
+                    pass
+            elif self.store is not None and self.store.contains(oid):
+                try:
+                    buf = self.store.get(oid, timeout=0)
+                    size = len(buf)
+                    buf.release()
+                except Exception:
+                    pass
+            row["size"] = size
+            out.append(row)
+        return out
 
     def actor_stats(self, actor_id: bytes, timeout: float = 5.0) -> dict:
         """Query an actor worker's execution stats (queued + ongoing actor

@@ -170,10 +170,41 @@ class ShmChannel:
 
     # -- reader side --------------------------------------------------------
     def read(self, timeout: Optional[float] = None) -> Any:
+        from ray_tpu.core import blocked as blocked_mod
+
         store = _store()
         oid = self._oid(self._rv)
+        deadline = None if timeout is None else time.monotonic() + timeout
         try:
-            buf = store.get(oid, timeout=timeout)
+            with blocked_mod.blocked_on(
+                    blocked_mod.CHANNEL_READ,
+                    channel=self.channel_id.hex(), version=self._rv):
+                while True:
+                    if not self._retired:
+                        buf = store.get(
+                            oid, timeout=(None if deadline is None else
+                                          max(deadline - time.monotonic(),
+                                              0)))
+                        break
+                    # A retired-but-undeleted slot may be exactly what the
+                    # writer's backpressure waits on, and the pin that made
+                    # its delete fail (zero-copy consumer, stack-frame
+                    # snapshot) can die while we are parked here — after
+                    # which nobody would retry. Park in short slices and
+                    # retry the deletes so the ring self-heals.
+                    while self._retired and store.delete(self._retired[0]):
+                        self._retired.popleft()
+                    slice_s = 0.05
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise ObjectNotFoundError(oid)
+                        slice_s = min(slice_s, remaining)
+                    try:
+                        buf = store.get(oid, timeout=slice_s)
+                        break
+                    except ObjectNotFoundError:
+                        continue
         except ObjectNotFoundError:
             raise TimeoutError(f"channel read timed out (version {self._rv})")
         value = serialization.deserialize(buf.data, pin=buf)

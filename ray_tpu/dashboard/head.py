@@ -229,6 +229,8 @@ class DashboardHead:
             web.get("/api/tasks", self.tasks),
             web.get("/api/tasks/{task_id}", self.task_detail),
             web.get("/api/events", self.events),
+            web.get("/api/stacks", self.stacks),
+            web.get("/api/wait_graph", self.wait_graph_view),
             web.get("/metrics", self.metrics),
             web.post("/api/jobs/", self.job_submit),
             web.get("/api/jobs/", self.job_list),
@@ -418,6 +420,37 @@ class DashboardHead:
             severity=request.query.get("severity"),
             source=request.query.get("source"), limit=limit)
         return _json({"events": events})
+
+    async def stacks(self, request):
+        """Cluster-wide annotated stack dumps (`scripts stack --cluster`
+        analog): every raylet fans the `dump_stacks` RPC out to its
+        workers; unreachable nodes are skipped. ?format=text renders the
+        deduped text view, default is the structured JSON."""
+        from ray_tpu.runtime.rpc import RpcClient
+        from ray_tpu.utils import debug
+
+        procs = [debug.render_stacks("dashboard")]
+        for n in await self.gcs.call("get_nodes"):
+            try:
+                client = RpcClient(*tuple(n["address"]))
+                await client.connect(timeout=5)
+                try:
+                    reply = await client.call("dump_stacks", timeout=15)
+                finally:
+                    await client.close()
+            except Exception:
+                continue
+            procs.extend(p for p in reply.get("processes", ())
+                         if isinstance(p, dict))
+        if request.query.get("format") == "text":
+            return web.Response(text=debug.format_stacks(procs),
+                                content_type="text/plain")
+        return _json({"processes": procs})
+
+    async def wait_graph_view(self, request):
+        """The GCS-assembled cluster wait-graph + stall/deadlock detector
+        verdict (edges, cycles, stalled_tasks, deadlocks)."""
+        return _json(await self.gcs.call("wait_graph"))
 
     async def metrics(self, request):
         """Aggregate app metrics pushed to the KV by util.metrics plus a few

@@ -19,6 +19,9 @@ Emitters in-tree:
   * autoscaler — AUTOSCALER_SCALE (launch/terminate decisions)
   * train      — TRAIN_GANG_RESTART (gang failure -> restart from
                  latest checkpoint)
+  * GCS        — TASK_STALLED (wait-graph edge blocked past the stall
+                 threshold), DEADLOCK_DETECTED (cycle in the cluster
+                 wait-graph) — emitted by the stall detector tick
 
 Read back via `state.list_cluster_events()`, the dashboard
 `/api/events` route, or `python -m ray_tpu.scripts events`.
@@ -44,8 +47,11 @@ OOM_KILL = "OOM_KILL"
 COLLECTIVE_ABORT = "COLLECTIVE_ABORT"
 AUTOSCALER_SCALE = "AUTOSCALER_SCALE"
 TRAIN_GANG_RESTART = "TRAIN_GANG_RESTART"
+TASK_STALLED = "TASK_STALLED"
+DEADLOCK_DETECTED = "DEADLOCK_DETECTED"
 EVENT_TYPES = (NODE_DEAD, SLICE_LOST, OOM_KILL, COLLECTIVE_ABORT,
-               AUTOSCALER_SCALE, TRAIN_GANG_RESTART)
+               AUTOSCALER_SCALE, TRAIN_GANG_RESTART, TASK_STALLED,
+               DEADLOCK_DETECTED)
 
 
 def make_event(event_type: str, message: str, *,

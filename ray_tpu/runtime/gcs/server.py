@@ -30,6 +30,38 @@ RESTARTING = "RESTARTING"
 DEAD = "DEAD"
 
 
+def _find_cycles(graph: dict) -> list:
+    """Distinct elementary cycles of a small digraph (iterative DFS; the
+    wait-graph has one node per blocked actor/process, so tiny). Each
+    cycle is reported once regardless of entry point."""
+    cycles, seen = [], set()
+    for start in graph:
+        stack = [(start, iter(graph.get(start, ())))]
+        path, onpath = [start], {start}
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if nxt in onpath:
+                    i = path.index(nxt)
+                    cyc = tuple(path[i:])
+                    key = frozenset(cyc)
+                    if key not in seen:
+                        seen.add(key)
+                        cycles.append(list(cyc))
+                    continue
+                if nxt in graph:
+                    stack.append((nxt, iter(graph.get(nxt, ()))))
+                    path.append(nxt)
+                    onpath.add(nxt)
+                    advanced = True
+                    break
+            if not advanced:
+                stack.pop()
+                onpath.discard(path.pop())
+    return cycles
+
+
 class NodeRecord:
     def __init__(self, node_id: bytes, address: Tuple[str, int], resources: Dict[str, float],
                  object_store_path: str, is_head: bool, labels: Dict[str, str]):
@@ -532,12 +564,22 @@ class GcsServer:
 
     async def _health_check_loop(self):
         # gcs_health_check_manager analog: periodic liveness by heartbeat age.
+        from ray_tpu.config import cfg
+
         while not self._shutdown.is_set():
             await asyncio.sleep(1.0)
             now = time.monotonic()
             for rec in list(self._nodes.values()):
                 if rec.alive and now - rec.last_heartbeat > 30.0:
                     await self._mark_node_dead(rec.node_id, "heartbeat timeout")
+            # Wait-graph detector rides the same loop at its own cadence.
+            last = getattr(self, "_last_stall_tick", 0.0)
+            if now - last >= cfg().stall_detector_interval_s:
+                self._last_stall_tick = now
+                try:
+                    self._stall_detector_tick()
+                except Exception:
+                    logger.exception("stall detector tick failed")
 
     # ---- KV (function/class table, runtime metadata) ---------------------
 
@@ -721,13 +763,32 @@ class GcsServer:
             return {"found": False}
         return {"found": True, **rec.view()}
 
-    async def handle_report_task_events(self, conn, events):
+    async def handle_report_task_events(self, conn, events,
+                                        wait_edges=None, reporter=None,
+                                        node_id=None):
         """Batched task state transitions from workers/drivers
-        (GcsTaskManager analog; task_event_buffer.h:224 export path)."""
+        (GcsTaskManager analog; task_event_buffer.h:224 export path).
+
+        `wait_edges` piggybacks the reporter's blocked-on edges on the
+        same flush tick: None = no update, a list (possibly empty, to
+        clear) replaces the reporter's previous edge set in the cluster
+        wait-graph."""
         from collections import deque
 
         from ray_tpu.config import cfg
 
+        if wait_edges is not None and reporter is not None:
+            table = getattr(self, "_wait_edges", None)
+            if table is None:
+                table = self._wait_edges = {}
+            if wait_edges:
+                table[reporter] = {
+                    "edges": list(wait_edges), "time": time.time(),
+                    "node_id": (node_id.hex()
+                                if isinstance(node_id, (bytes, bytearray))
+                                else node_id)}
+            else:
+                table.pop(reporter, None)
         store = getattr(self, "_task_events", None)
         if store is None:
             store = self._task_events = deque(maxlen=cfg().task_events_max)
@@ -743,6 +804,206 @@ class GcsServer:
                 self._task_latest = {k: v for k, v in
                                      self._task_latest.items() if k in alive}
         return {"ok": True}
+
+    # ---- cluster wait-graph + stall/deadlock detector --------------------
+    #
+    # Workers piggyback blocked-on edges (task -> object -> owner task,
+    # collective member -> group, channel reader -> channel) onto their
+    # task-event flush; the GCS assembles them into one graph and a
+    # periodic tick (a) finds actor-level cycles -> DEADLOCK_DETECTED and
+    # (b) flags edges blocked past `stall_threshold_s` -> TASK_STALLED,
+    # with collective edges grouped per group so the event names the
+    # STRAGGLER ranks (members NOT blocked) rather than the whole gang —
+    # the cross-link into the failure-domain plane.
+
+    def _wait_edge_snapshot(self) -> list:
+        """Live wait-graph edges, flattened with reporter attribution.
+        Edges whose reporter stopped refreshing (crashed or unblocked
+        worker) age out after `wait_edge_max_age_s`."""
+        from ray_tpu.config import cfg
+
+        table = getattr(self, "_wait_edges", None)
+        if not table:
+            return []
+        now = time.time()
+        max_age = cfg().wait_edge_max_age_s
+        edges = []
+        for reporter, rec in list(table.items()):
+            if now - rec["time"] > max_age:
+                table.pop(reporter, None)
+                continue
+            for e in rec["edges"]:
+                e2 = dict(e)
+                e2["reporter"] = reporter
+                if rec.get("node_id") and "node_id" not in e2:
+                    e2["node_id"] = rec["node_id"]
+                edges.append(e2)
+        return edges
+
+    def _edge_node_slice(self, edge: dict):
+        """(node hex, slice name) attribution for an edge's reporter."""
+        node_hex = edge.get("node_id")
+        if not node_hex:
+            return None, None
+        try:
+            rec = self._nodes.get(bytes.fromhex(node_hex))
+        except (ValueError, TypeError):
+            rec = None
+        return node_hex, (rec.labels.get("tpu-slice-name")
+                          if rec else None)
+
+    @staticmethod
+    def _edge_stack(edge: dict) -> str:
+        return "\n".join(edge.get("stack", ())[-2:])
+
+    def _stall_detector_tick(self):
+        from ray_tpu.config import cfg
+        from ray_tpu.runtime import events as events_mod
+
+        edges = self._wait_edge_snapshot()
+        sigs = getattr(self, "_stall_sigs", None)
+        if sigs is None:
+            sigs = self._stall_sigs = set()
+        active = set()
+        counts = {"stalled_tasks": 0, "deadlocks": 0}
+        now = time.time()
+        threshold = cfg().stall_threshold_s
+
+        # (a) Cycles: unit = actor when known, else the reporter process.
+        graph: dict = {}
+        cycle_edges: dict = {}
+        for e in edges:
+            if e.get("kind") != "object_get":
+                continue
+            src = e.get("waiter_actor") or e.get("reporter")
+            dst = e.get("target_actor")
+            if src and dst and src != dst:
+                graph.setdefault(src, set()).add(dst)
+                cycle_edges.setdefault((src, dst), e)
+        deadlocks = _find_cycles(graph)
+        self._active_deadlocks = deadlocks
+        counts["deadlocks"] = len(deadlocks)
+        for cyc in deadlocks:
+            sig = ("deadlock", frozenset(cyc))
+            active.add(sig)
+            if sig in sigs:
+                continue
+            sigs.add(sig)
+            hops, labels = [], {}
+            for i, src in enumerate(cyc):
+                dst = cyc[(i + 1) % len(cyc)]
+                e = cycle_edges.get((src, dst), {})
+                hops.append(
+                    f"{src[:12]} waits on object {e.get('oid', '?')} "
+                    f"({e.get('target_name', '?')}) held by {dst[:12]}")
+                stack = self._edge_stack(e)
+                if stack:
+                    labels[f"stack_{src[:12]}"] = stack
+            node_hex, slice_name = self._edge_node_slice(
+                cycle_edges.get((cyc[0], cyc[1 % len(cyc)]), {}))
+            labels["members"] = ",".join(c[:12] for c in cyc)
+            self._record_event(events_mod.make_event(
+                events_mod.DEADLOCK_DETECTED,
+                f"wait-graph cycle across {len(cyc)} waiter(s): "
+                + "; ".join(hops),
+                severity=events_mod.ERROR, source="gcs",
+                node_id=node_hex, slice_name=slice_name,
+                actor_id=cyc[0], labels=labels))
+            logger.error("deadlock detected: %s", "; ".join(hops))
+
+        # (b) Long-stalled edges. Collective edges are grouped per group
+        # so one event attributes the straggler ranks; everything else
+        # stalls individually.
+        coll: dict = {}
+        for e in edges:
+            if e.get("kind") == "collective_op":
+                coll.setdefault(e.get("group"), []).append(e)
+                continue
+            age = now - e.get("since", now)
+            if age < threshold:
+                continue
+            counts["stalled_tasks"] += 1
+            sig = ("stall", e.get("reporter"), e.get("kind"),
+                   e.get("oid") or e.get("channel"))
+            active.add(sig)
+            if sig in sigs:
+                continue
+            sigs.add(sig)
+            node_hex, slice_name = self._edge_node_slice(e)
+            who = (e.get("waiter_name") or e.get("waiter_task")
+                   or e.get("reporter"))
+            what = (f"object {e.get('oid')}" if e.get("oid")
+                    else f"channel {e.get('channel')}")
+            labels = {"kind": e.get("kind", ""), "reporter":
+                      str(e.get("reporter", ""))}
+            if e.get("oid"):
+                labels["oid"] = e["oid"]
+            if e.get("owner"):
+                labels["owner"] = str(e["owner"])
+            stack = self._edge_stack(e)
+            if stack:
+                labels["stack"] = stack
+            self._record_event(events_mod.make_event(
+                events_mod.TASK_STALLED,
+                f"{who} blocked on {what} for {age:.0f}s "
+                f"(threshold {threshold:g}s)",
+                severity=events_mod.WARNING, source="gcs",
+                node_id=node_hex, slice_name=slice_name,
+                actor_id=e.get("waiter_actor"), labels=labels))
+            logger.warning("stalled: %s blocked on %s for %.0fs",
+                           who, what, age)
+        for group, ges in coll.items():
+            stalled = [e for e in ges
+                       if now - e.get("since", now) >= threshold]
+            if not stalled:
+                continue
+            counts["stalled_tasks"] += len(stalled)
+            blocked_ranks = sorted({e.get("rank") for e in stalled
+                                    if e.get("rank") is not None})
+            world = next((e.get("world_size") for e in stalled
+                          if e.get("world_size")), None)
+            stragglers = (sorted(set(range(world)) - set(blocked_ranks))
+                          if world else [])
+            sig = ("stall_collective", group, tuple(blocked_ranks))
+            active.add(sig)
+            if sig in sigs:
+                continue
+            sigs.add(sig)
+            age = max(now - e.get("since", now) for e in stalled)
+            e0 = stalled[0]
+            node_hex, slice_name = self._edge_node_slice(e0)
+            msg = (f"collective group {group!r}: rank(s) "
+                   f"{blocked_ranks} blocked in op "
+                   f"#{e0.get('op_id', '?')} for {age:.0f}s")
+            if stragglers:
+                msg += (f"; straggler rank(s) {stragglers} have not "
+                        f"entered the op")
+            labels = {"group": str(group),
+                      "blocked_ranks": ",".join(map(str, blocked_ranks)),
+                      "straggler_ranks": ",".join(map(str, stragglers)),
+                      "op_id": str(e0.get("op_id", ""))}
+            stack = self._edge_stack(e0)
+            if stack:
+                labels["stack"] = stack
+            self._record_event(events_mod.make_event(
+                events_mod.TASK_STALLED, msg,
+                severity=events_mod.WARNING, source="gcs",
+                node_id=node_hex, slice_name=slice_name,
+                labels=labels))
+            logger.warning("%s", msg)
+        # Retire resolved conditions so a recurrence re-alerts.
+        sigs.intersection_update(active)
+        self._stall_counts = counts
+
+    async def handle_wait_graph(self, conn):
+        """The assembled cluster wait-graph plus the detector's current
+        verdict counts (`state.wait_graph()` / dashboard data source)."""
+        return {
+            "edges": self._wait_edge_snapshot(),
+            "cycles": list(getattr(self, "_active_deadlocks", [])),
+            **getattr(self, "_stall_counts",
+                      {"stalled_tasks": 0, "deadlocks": 0}),
+        }
 
     # ---- cluster event bus (runtime/events.py) ---------------------------
 

@@ -47,6 +47,18 @@ OOM_KILLS = Counter(
     "ray_tpu_oom_kills_total", "workers killed by the memory monitor")
 PENDING_LEASES = Gauge(
     "ray_tpu_pending_leases", "queued lease requests on this raylet")
+OBJECT_STORE_USED = Gauge(
+    "ray_tpu_object_store_used_bytes",
+    "bytes occupied in this node's shared object-store arena",
+    tag_keys=("node",))
+OBJECT_STORE_CAPACITY = Gauge(
+    "ray_tpu_object_store_capacity_bytes",
+    "total size of this node's shared object-store arena",
+    tag_keys=("node",))
+OBJECT_STORE_SPILLED = Gauge(
+    "ray_tpu_object_store_spilled_bytes",
+    "bytes currently resident in this node's spill directory",
+    tag_keys=("node",))
 
 # -- object plane ----------------------------------------------------------
 

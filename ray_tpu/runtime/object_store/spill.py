@@ -42,6 +42,22 @@ class SpillManager:
     def contains(self, oid: bytes) -> bool:
         return os.path.exists(self._path(oid))
 
+    def spilled_bytes(self) -> int:
+        """Bytes currently resident in the node's spill directory (shared
+        by every process on the node; feeds the per-node spill gauge and
+        `node_stats`). Concurrently-deleted files are skipped."""
+        total = 0
+        try:
+            with os.scandir(self.spill_dir) as it:
+                for entry in it:
+                    try:
+                        total += entry.stat().st_size
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return total
+
     def spill_object(self, oid: bytes) -> bool:
         """Copy one sealed object out to disk, then drop it from the store."""
         try:

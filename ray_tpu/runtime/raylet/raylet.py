@@ -153,6 +153,12 @@ class Raylet:
         from ray_tpu.runtime.object_store.spill import SpillManager
         self.spill = SpillManager(
             self.store, os.path.join(self.session_dir, "spill"))
+        # Per-node store-occupancy gauges, refreshed each heartbeat tick.
+        node_tag = {"node": self.node_id.hex()[:12]}
+        self._g_store_used = metric_defs.OBJECT_STORE_USED.bind(node_tag)
+        self._g_store_capacity = \
+            metric_defs.OBJECT_STORE_CAPACITY.bind(node_tag)
+        self._g_spilled = metric_defs.OBJECT_STORE_SPILLED.bind(node_tag)
         await self.server.start()
         self.gcs = RpcClient(*self.gcs_address, auto_reconnect=True,
                              reconnect_timeout=120,
@@ -262,6 +268,14 @@ class Raylet:
                     if use_typed:
                         view = self._decode_view(view)
                     self._apply_view(view)
+            except Exception:
+                pass
+            try:
+                if self.store is not None:
+                    self._g_store_used.set(float(self.store.used))
+                    self._g_store_capacity.set(float(self.store.capacity))
+                if self.spill is not None:
+                    self._g_spilled.set(float(self.spill.spilled_bytes()))
             except Exception:
                 pass
             from ray_tpu.config import cfg
@@ -1119,6 +1133,8 @@ class Raylet:
             "backlog": self._backlog(),
             "object_store_used": self.store.used if self.store else 0,
             "object_store_capacity": self.store.capacity if self.store else 0,
+            "spilled_bytes": (self.spill.spilled_bytes()
+                              if self.spill else 0),
             "bundles": [
                 {"pg_id": k[0], "bundle_index": k[1], "committed": v["committed"],
                  "resources": v["resources"], "available": v["available"]}
@@ -1152,3 +1168,57 @@ class Raylet:
             return_exceptions=True)
         procs.extend(r for r in results if isinstance(r, dict))
         return {"processes": procs}
+
+    async def handle_dump_stacks(self, conn):
+        """Hang diagnosis fan-in: this raylet's own annotated stacks plus
+        every ready local worker's (each worker runtime answers the same
+        `dump_stacks` RPC). Per-worker failures are dropped — a wedged or
+        dying worker must not block the cluster-wide dump."""
+        from ray_tpu.utils import debug
+
+        node = self.node_id.hex()[:12]
+        procs = [debug.render_stacks(f"raylet:{node}")]
+
+        async def fetch(w):
+            client = RpcClient(*w.address)
+            await client.connect(timeout=5)
+            try:
+                proc = await client.call("dump_stacks", timeout=10)
+                proc["label"] = f"{proc.get('label') or 'worker'} " \
+                                f"node:{node}"
+                return proc
+            finally:
+                await client.close()
+
+        results = await asyncio.gather(
+            *(fetch(w) for w in list(self._workers.values())
+              if w.address is not None),
+            return_exceptions=True)
+        procs.extend(r for r in results if isinstance(r, dict))
+        return {"processes": procs}
+
+    async def handle_list_objects(self, conn, limit: int = 1000):
+        """Cluster memory fan-in: every local worker's owner-side object
+        table (the `state.summarize_objects()` building block). Workers
+        that don't answer are skipped."""
+        async def fetch(w):
+            client = RpcClient(*w.address)
+            await client.connect(timeout=5)
+            try:
+                return await client.call("list_objects", limit=limit,
+                                         timeout=10)
+            finally:
+                await client.close()
+
+        results = await asyncio.gather(
+            *(fetch(w) for w in list(self._workers.values())
+              if w.address is not None),
+            return_exceptions=True)
+        rows = []
+        node = self.node_id.hex()[:12]
+        for r in results:
+            if isinstance(r, list):
+                for row in r:
+                    row.setdefault("node", node)
+                rows.extend(r)
+        return {"objects": rows}

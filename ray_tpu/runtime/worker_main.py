@@ -226,6 +226,15 @@ class WorkerRuntime:
         # into this thread by id (ray.cancel analog; best-effort — a
         # blocking C call won't notice until it returns to Python).
         self._running_threads[spec.task_id] = threading.get_ident()
+        from ray_tpu.core import blocked as blocked_mod
+
+        # Thread -> task attribution for stack dumps and wait-graph edges:
+        # anything this thread blocks on is charged to this task/actor.
+        blocked_mod.set_task_context(threading.get_ident(), {
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+        })
         try:
             applied = renv_mod.apply_runtime_env(
                 self.core, spec.runtime_env, self.core.session_dir)
@@ -267,6 +276,7 @@ class WorkerRuntime:
                     "error": TaskError(spec.name, tb, cause=_safe_cause(e))}
         finally:
             self._running_threads.pop(spec.task_id, None)
+            blocked_mod.set_task_context(threading.get_ident(), None)
             if applied is not None:
                 applied.undo()
             self.core.current_task_name = None
@@ -542,6 +552,24 @@ class WorkerRuntime:
         from ray_tpu.util import tracing
 
         return tracing.get_spans()
+
+    async def handle_dump_stacks(self, conn):
+        """Hang diagnosis: every thread's stack annotated with task/actor
+        context and blocked-on records (see utils/debug.render_stacks).
+        Served on the IO loop — works precisely when the exec threads are
+        wedged, which is the whole point."""
+        from ray_tpu.utils import debug
+
+        label = f"worker:{os.environ.get('RAY_TPU_WORKER_ID', os.getpid())}"
+        if self.actor_spec is not None:
+            label += f" actor:{self.actor_spec.actor_id.hex()[:12]}"
+        return debug.render_stacks(label)
+
+    async def handle_list_objects(self, conn, limit: int = 1000):
+        """Owner-side object table of this worker process (fanned in by the
+        raylet for `state.summarize_objects()` / `scripts memory
+        --cluster`)."""
+        return self.core.object_table(limit=limit)
 
     async def handle_exit(self, conn):
         asyncio.get_event_loop().call_later(0.05, sys.exit, 0)

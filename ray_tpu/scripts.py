@@ -83,7 +83,9 @@ def cmd_list(args):
 
 def cmd_memory(args):
     """Per-node object store usage + owned-object summary (the `ray memory`
-    analog: where object bytes live across the cluster)."""
+    analog: where object bytes live across the cluster). With --cluster,
+    fans the owner-scoped object table out to every worker and aggregates
+    by owner/size/spill state."""
     from ray_tpu.state import api
 
     _connect(args.address)
@@ -93,16 +95,49 @@ def cmd_memory(args):
             "node_id": s.get("node_id"),
             "store_bytes_used": s.get("object_store_used"),
             "store_capacity": s.get("object_store_capacity"),
+            "spilled_bytes": s.get("spilled_bytes"),
             "num_workers": s.get("num_workers"),
             "num_pending_leases": s.get("num_pending_leases"),
         })
     try:
-        objs = api.list_objects(limit=args.limit)
-        out["objects"] = objs
-        out["total_objects"] = len(objs)
+        if args.cluster:
+            out["summary"] = api.summarize_objects(limit=args.limit)
+            out["objects"] = api.list_cluster_objects(limit=args.limit)
+        else:
+            out["objects"] = api.list_objects(limit=args.limit)
+        out["total_objects"] = len(out["objects"])
     except Exception as e:  # objects view is best-effort
         out["objects_error"] = repr(e)
     print(json.dumps(out, indent=2, default=str))
+
+
+def cmd_stack(args):
+    """Annotated stack dump (`ray stack` analog): every thread of every
+    process, with what it is blocked on (object get + owner, collective
+    op, channel read) and the task/actor it runs. Without --cluster,
+    dumps only this process."""
+    from ray_tpu.utils import debug
+
+    if args.cluster:
+        if not args.address:
+            sys.exit("--cluster requires --address")
+        from ray_tpu.state import api
+
+        _connect(args.address)
+        procs = api.dump_cluster_stacks()
+    else:
+        procs = [debug.render_stacks("local")]
+    if args.json:
+        print(json.dumps(procs, indent=2, default=str))
+    else:
+        print(debug.format_stacks(procs))
+    if args.wait_graph:
+        if not args.address:
+            sys.exit("--wait-graph requires --address")
+        from ray_tpu.state import api as api_mod
+
+        _connect(args.address)
+        print(json.dumps(api_mod.wait_graph(), indent=2, default=str))
 
 
 def cmd_drain(args):
@@ -263,7 +298,23 @@ def main(argv=None):
     p = sub.add_parser("memory")
     p.add_argument("--address", required=True)
     p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--cluster", action="store_true",
+                   help="fan out to every worker's object table and "
+                        "aggregate by owner/size/spill state")
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("stack",
+                       help="annotated thread stacks: what every process "
+                            "is blocked on (hang diagnosis)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--cluster", action="store_true",
+                   help="dump every process in the cluster "
+                        "(requires --address)")
+    p.add_argument("--json", action="store_true",
+                   help="raw structured dump instead of rendered text")
+    p.add_argument("--wait-graph", action="store_true",
+                   help="also print the GCS wait-graph + detector verdict")
+    p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("drain")
     p.add_argument("node_id", help="hex node id (see `list nodes`)")

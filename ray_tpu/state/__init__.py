@@ -5,13 +5,17 @@ API surface."""
 
 from ray_tpu.state.api import (  # noqa: F401
     dump_cluster_spans,
+    dump_cluster_stacks,
     list_actors,
     list_cluster_events,
+    list_cluster_objects,
     list_jobs,
     list_nodes,
     list_objects,
     list_placement_groups,
     list_tasks,
     node_stats,
+    summarize_objects,
     summary,
+    wait_graph,
 )

@@ -74,21 +74,71 @@ def list_tasks(state: Optional[str] = None, name: Optional[str] = None,
 
 def list_objects(limit: int = 1000) -> List[dict]:
     """Owned objects of THIS process: id, borrower/container counts,
-    locations (reference: `ray list objects` scoped cluster-wide; ours is
-    owner-scoped — each owner knows its own objects' truth)."""
+    locations, spill state (reference: `ray list objects` scoped
+    cluster-wide; this is owner-scoped — each owner knows its own
+    objects' truth. For the cluster-wide view see
+    `summarize_objects()`)."""
+    return worker_mod.global_worker().object_table(limit=limit)
+
+
+def list_cluster_objects(limit: int = 1000) -> List[dict]:
+    """Every owner's object table, cluster-wide: this process's own plus,
+    per alive node, each worker's (the raylet fans out the same
+    `list_objects` RPC its workers answer). Unreachable nodes/workers are
+    skipped — a partial table beats none."""
+    from ray_tpu.runtime.rpc import RpcClient
+
     core = worker_mod.global_worker()
-    out = []
-    with core._mem_lock:
-        for oid, rec in list(core._owned.items())[:limit]:
-            out.append({
-                "object_id": oid.hex(),
-                "local_refs": core._local_refs.get(oid, 0),
-                "borrowers": len(rec["borrowers"]),
-                "containers": len(rec["containers"]),
-                "locations": [loc.hex() for loc in rec["locations"]],
-                "pinned": core._arg_pins.get(oid, 0),
-            })
-    return out
+    rows = list(core.object_table(limit=limit))
+    for n in _gcs_call("get_nodes"):
+        async def fetch(addr=tuple(n["address"])):
+            client = RpcClient(*addr)
+            await client.connect(timeout=5)
+            try:
+                return await client.call("list_objects", limit=limit,
+                                         timeout=15)
+            finally:
+                await client.close()
+
+        try:
+            reply = core.io.run(fetch(), timeout=20)
+        except Exception:
+            continue
+        rows.extend(reply.get("objects", ()))
+    return rows
+
+
+def summarize_objects(limit: int = 1000) -> Dict:
+    """Cluster-wide object summary aggregated by owner: counts, known
+    bytes, spill state (`scripts memory --cluster` backend)."""
+    rows = list_cluster_objects(limit=limit)
+    owners: Dict[str, dict] = {}
+    for row in rows:
+        o = owners.setdefault(row.get("owner") or "?", {
+            "objects": 0, "bytes": 0, "spilled": 0, "spilled_bytes": 0,
+            "pinned": 0, "borrowed": 0, "in_memory": 0})
+        o["objects"] += 1
+        size = row.get("size")
+        if size:
+            o["bytes"] += size
+            if row.get("spilled"):
+                o["spilled_bytes"] += size
+        if row.get("spilled"):
+            o["spilled"] += 1
+        if row.get("pinned"):
+            o["pinned"] += 1
+        if row.get("borrowers"):
+            o["borrowed"] += 1
+        if row.get("in_memory"):
+            o["in_memory"] += 1
+    return {
+        "total_objects": len(rows),
+        "total_bytes": sum(o["bytes"] for o in owners.values()),
+        "total_spilled": sum(o["spilled"] for o in owners.values()),
+        "total_spilled_bytes": sum(o["spilled_bytes"]
+                                   for o in owners.values()),
+        "owners": owners,
+    }
 
 
 def node_stats() -> List[dict]:
@@ -161,10 +211,52 @@ def dump_cluster_spans() -> List[tuple]:
     return groups
 
 
+def wait_graph() -> Dict:
+    """The GCS-assembled cluster wait-graph: who is blocked on what
+    (`edges`), active deadlock cycles (`cycles`), and the detector's
+    current `stalled_tasks`/`deadlocks` counts."""
+    return _gcs_call("wait_graph")
+
+
+def dump_cluster_stacks() -> List[dict]:
+    """Annotated stack dumps from every process in the cluster.
+
+    Returns render_stacks() dicts: this process's own, plus per alive
+    node the raylet's and each of its workers' (the raylet fans out the
+    same `dump_stacks` RPC). Each thread carries its frames, its live
+    blocked-on record (object get with id + owner, collective op with
+    group/op id, channel read), and the task/actor it is executing.
+    Unreachable nodes are skipped. Render with
+    `utils.debug.format_stacks`."""
+    import os
+
+    from ray_tpu.runtime.rpc import RpcClient
+    from ray_tpu.utils import debug
+
+    core = worker_mod.global_worker()
+    procs = [debug.render_stacks(f"driver:{os.getpid()}")]
+    for n in _gcs_call("get_nodes"):
+        async def fetch(addr=tuple(n["address"])):
+            client = RpcClient(*addr)
+            await client.connect(timeout=5)
+            try:
+                return await client.call("dump_stacks", timeout=15)
+            finally:
+                await client.close()
+
+        try:
+            reply = core.io.run(fetch(), timeout=20)
+        except Exception:
+            continue
+        procs.extend(p for p in reply.get("processes", ())
+                     if isinstance(p, dict))
+    return procs
+
+
 def summary() -> Dict:
     nodes = list_nodes()
     actors = list_actors()
-    return {
+    out = {
         "nodes_alive": sum(1 for n in nodes if n["alive"]),
         "nodes_total": len(nodes),
         "actors_alive": sum(1 for a in actors if a["state"] == "ALIVE"),
@@ -174,6 +266,23 @@ def summary() -> Dict:
         "available_resources": _sum_resources(
             [n for n in nodes if n["alive"]], "available"),
     }
+    try:
+        wg = wait_graph()
+        out["stalled_tasks"] = wg.get("stalled_tasks", 0)
+        out["deadlocks"] = wg.get("deadlocks", 0)
+    except Exception:
+        # Older GCS without the wait-graph plane: leave the keys out
+        # rather than fail the whole summary.
+        pass
+    stats = node_stats()
+    if stats:
+        out["object_store_used"] = sum(
+            s.get("object_store_used", 0) for s in stats)
+        out["object_store_capacity"] = sum(
+            s.get("object_store_capacity", 0) for s in stats)
+        out["spilled_bytes"] = sum(
+            s.get("spilled_bytes", 0) for s in stats)
+    return out
 
 
 def _sum_resources(nodes: List[dict], key: str) -> Dict[str, float]:

@@ -193,6 +193,35 @@ class TrainController:
                        self.run_name, world,
                        cfg().train_restart_resource_wait_s)
 
+    def _surface_stall_events(self) -> None:
+        """Surface hang-diagnosis events (TASK_STALLED/DEADLOCK_DETECTED
+        from the GCS wait-graph detector) into the training run's log,
+        once each — a run stuck behind a straggling collective rank shows
+        up here instead of as silence. Best-effort: observability must
+        never fail the control loop."""
+        from ray_tpu.core import worker as worker_mod
+        from ray_tpu.runtime import events as events_mod
+
+        seen = getattr(self, "_seen_stall_events", None)
+        if seen is None:
+            seen = self._seen_stall_events = set()
+        try:
+            core = worker_mod.global_worker()
+            for etype in (events_mod.DEADLOCK_DETECTED,
+                          events_mod.TASK_STALLED):
+                for ev in core.io.run(core.gcs.call(
+                        "list_events", event_type=etype, limit=20),
+                        timeout=5):
+                    key = (ev.get("type"), ev.get("time"))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    logger.warning("train run %s: %s: %s", self.run_name,
+                                   ev.get("type"), ev.get("message"))
+                    self.telemetry.stall_events += 1
+        except Exception:
+            pass
+
     def _poll_until_done(self, group, poll_interval: float,
                          world: int) -> Optional[str]:
         from ray_tpu.config import cfg
@@ -204,6 +233,7 @@ class TrainController:
             if (now - last_elastic_check
                     >= cfg().train_elastic_check_interval_s):
                 last_elastic_check = now
+                self._surface_stall_events()
                 decision = self.scaling_policy.periodic(
                     self.scaling, world, self._available_resources())
                 if (decision.kind == "resize"

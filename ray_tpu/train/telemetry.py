@@ -44,6 +44,9 @@ class TrainTelemetry:
     gang_restarts: int = 0
     wall_time_s: float = 0.0
     productive_time_s: float = 0.0
+    # Hang-diagnosis events (TASK_STALLED / DEADLOCK_DETECTED) the
+    # controller observed during this run.
+    stall_events: int = 0
 
     def record_step(self, rec: dict) -> None:
         """Fold one per-rank step record (from `session.report()`) in.

@@ -1,0 +1,307 @@
+"""Hang diagnosis plane: stack dumps, wait-graphs, stall/deadlock detection.
+
+Acceptance counter-proofs of the hang-diagnosis PR (ISSUE.md):
+
+  * a two-actor mutual-`get` cycle is reported as a DEADLOCK_DETECTED
+    event within one detector interval, and `state.wait_graph()` shows
+    the cycle's edges (object id, waiter, target actor);
+  * `scripts stack --cluster` output names the blocked object ids and
+    their owners;
+  * a chaos-injected collective straggler (one rank delaying entry into
+    an allreduce) produces a TASK_STALLED event naming the straggler
+    rank — the failure-domain cross-link.
+
+Plus the satellite surfaces: `state.summarize_objects()` /
+`scripts memory --cluster`, the stall-count rollup in `state.summary()`,
+and a smoke test that every CLI subcommand parses `--help` cleanly.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import blocked as blocked_mod
+from ray_tpu.utils import debug
+
+
+def _poll(fn, deadline_s=20.0, sleep=0.25):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        out = fn()
+        if out:
+            return out
+        time.sleep(sleep)
+    return fn()
+
+
+# ---------------------------------------------------------------------------
+# blocked-on registry + stack rendering (no cluster)
+# ---------------------------------------------------------------------------
+
+def test_blocked_registry_nesting_and_edges():
+    ident = threading.get_ident()
+    assert ident not in blocked_mod.snapshot()
+    blocked_mod.set_task_context(ident, {"task_id": "t" * 8, "name": "work",
+                                         "actor_id": "a" * 8})
+    try:
+        with blocked_mod.blocked_on(blocked_mod.OBJECT_GET, oid="aa" * 16):
+            with blocked_mod.blocked_on(blocked_mod.COLLECTIVE_OP,
+                                        group="g0", op_id=3):
+                # Innermost blocking reason wins the snapshot.
+                rec = blocked_mod.snapshot()[ident]
+                assert rec["kind"] == blocked_mod.COLLECTIVE_OP
+                assert rec["detail"]["group"] == "g0"
+                # current_edges flattens detail + task context per edge.
+                edges = blocked_mod.current_edges()
+                mine = [e for e in edges if e.get("waiter_task") == "t" * 8]
+                assert {e["kind"] for e in mine} == {
+                    blocked_mod.OBJECT_GET, blocked_mod.COLLECTIVE_OP}
+                get_edge = next(e for e in mine
+                                if e["kind"] == blocked_mod.OBJECT_GET)
+                assert get_edge["oid"] == "aa" * 16
+                assert get_edge["waiter_actor"] == "a" * 8
+                assert get_edge["since"] <= time.time()
+            rec = blocked_mod.snapshot()[ident]
+            assert rec["kind"] == blocked_mod.OBJECT_GET
+        assert ident not in blocked_mod.snapshot()
+    finally:
+        blocked_mod.set_task_context(ident, None)
+    assert blocked_mod.task_context(ident) is None
+
+
+def test_render_and_format_stacks_annotations():
+    entered = threading.Event()
+    release = threading.Event()
+
+    def parked():
+        with blocked_mod.blocked_on(blocked_mod.OBJECT_GET, oid="cd" * 16,
+                                    owner="10.0.0.1:7777",
+                                    target_name="shard_sum"):
+            entered.set()
+            release.wait(30)
+
+    t = threading.Thread(target=parked, name="parked-get", daemon=True)
+    t.start()
+    assert entered.wait(10)
+    try:
+        dump = debug.render_stacks("unit")
+        assert dump["label"] == "unit" and dump["pid"] == os.getpid()
+        rec = next(th for th in dump["threads"]
+                   if th["name"] == "parked-get")
+        assert rec["blocked_on"]["detail"]["oid"] == "cd" * 16
+        assert any("release.wait" in f or "wait" in f for f in rec["frames"])
+        text = debug.format_stacks([dump])
+        # Blocked threads sort first and carry the annotated description:
+        # object id, owner, and producing task all named.
+        assert "unit" in text and "parked-get" in text
+        assert "cd" * 16 in text and "10.0.0.1:7777" in text
+        assert "shard_sum" in text
+    finally:
+        release.set()
+        t.join(10)
+
+
+# ---------------------------------------------------------------------------
+# cluster fixture: fast detector knobs must be in the env BEFORE init so
+# the GCS / worker subprocesses inherit them
+# ---------------------------------------------------------------------------
+
+_KNOBS = {"RAY_TPU_STALL_DETECTOR_INTERVAL_S": "0.5",
+          "RAY_TPU_STALL_THRESHOLD_S": "2.0"}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from ray_tpu import config as config_mod
+
+    old = {k: os.environ.get(k) for k in _KNOBS}
+    os.environ.update(_KNOBS)
+    config_mod.reset_for_testing()
+    ray_tpu.init(num_cpus=6)
+    try:
+        yield ray_tpu.get_runtime_context().gcs_address
+    finally:
+        ray_tpu.shutdown()
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.update({k: v})
+        config_mod.reset_for_testing()
+
+
+@ray_tpu.remote
+class Peer:
+    """Sync actor (max_concurrency=1): `call_other` occupies the single
+    execution thread, so the nested `ping` can never run — the mutual
+    version of this is a true deadlock."""
+
+    def ping(self):
+        return "pong"
+
+    def call_other(self, other):
+        return ray_tpu.get(other.ping.remote(), timeout=90)
+
+
+# ---------------------------------------------------------------------------
+# tentpole acceptance: mutual-get deadlock -> DEADLOCK_DETECTED + wait-graph
+# ---------------------------------------------------------------------------
+
+def test_mutual_get_deadlock_detected(cluster, capsys):
+    from ray_tpu import scripts, state
+
+    a, b = Peer.remote(), Peer.remote()
+    fa = a.call_other.remote(b)
+    fb = b.call_other.remote(a)
+    try:
+        # Detector interval is 0.5s here (default 2s, acceptance bound 5s);
+        # the edge flush rides the 1s task-events cadence — well inside the
+        # poll budget.
+        events = _poll(lambda: state.list_cluster_events(
+            event_type="DEADLOCK_DETECTED"), deadline_s=25.0)
+        assert events, "mutual get() cycle never produced DEADLOCK_DETECTED"
+        ev = events[0]
+        assert ev["severity"] == "ERROR" and ev["source"] == "gcs"
+        assert "cycle" in ev["message"] and "waits on object" in ev["message"]
+
+        wg = state.wait_graph()
+        assert wg["deadlocks"] >= 1 and wg["cycles"]
+        gets = [e for e in wg["edges"] if e["kind"] == "object_get"]
+        assert len(gets) >= 2
+        # Every edge is self-contained: the waiter submitted the producing
+        # task itself, so it names both its own actor and the target's.
+        by_waiter = {e["waiter_actor"]: e["target_actor"] for e in gets
+                     if e.get("waiter_actor") and e.get("target_actor")}
+        cyc = wg["cycles"][0]
+        assert len(cyc) == 2 and by_waiter[cyc[0]] == cyc[1] \
+            and by_waiter[cyc[1]] == cyc[0]
+        assert all(e.get("oid") and e.get("stack") for e in gets)
+
+        # Stall-count rollup satellite: summary() carries the verdict.
+        summ = state.summary()
+        assert summ["deadlocks"] >= 1 and "stalled_tasks" in summ
+
+        # Acceptance: `scripts stack --cluster` names blocked oids + owners.
+        capsys.readouterr()
+        scripts.main(["stack", "--cluster", "--address", cluster])
+        text = capsys.readouterr().out
+        for e in gets:
+            assert e["oid"] in text
+        assert "owner" in text and "blocked on get(object" in text
+        # And the producing actor is attributed on the blocked line.
+        assert "actor" in text
+
+        # The same dump over JSON keeps the structure (dashboard payload).
+        scripts.main(["stack", "--cluster", "--json", "--address", cluster])
+        procs = json.loads(capsys.readouterr().out)
+        assert any(th.get("blocked_on")
+                   for p in procs for th in p["threads"])
+    finally:
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+        for ref in (fa, fb):
+            with pytest.raises(Exception):
+                ray_tpu.get(ref, timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# chaos: collective straggler -> TASK_STALLED naming the missing rank
+# ---------------------------------------------------------------------------
+
+@ray_tpu.remote
+class Rank:
+    def __init__(self, rank, world, group):
+        self.rank, self.world, self.group = rank, world, group
+        self.comm = None
+
+    def setup(self):
+        from ray_tpu import collective
+
+        self.comm = collective.init_collective_group(
+            self.world, self.rank, backend="tcp", group_name=self.group)
+        return True
+
+    def step(self, delay_s):
+        if delay_s:
+            time.sleep(delay_s)  # chaos: straggle before entering the op
+        return float(self.comm.allreduce(np.ones(4), "sum")[0])
+
+
+@pytest.mark.chaos
+def test_collective_straggler_stall_event(cluster):
+    from ray_tpu import state
+
+    group = "hang-diag-straggler"
+    ranks = [Rank.remote(r, 2, group) for r in range(2)]
+    assert ray_tpu.get([r.setup.remote() for r in ranks], timeout=60) \
+        == [True, True]
+    # Rank 0 enters the allreduce immediately; rank 1 straggles for 8s —
+    # past the 2s stall threshold, so the detector must fire mid-op.
+    refs = [ranks[0].step.remote(0.0), ranks[1].step.remote(8.0)]
+
+    def stalled_collective():
+        evs = state.list_cluster_events(event_type="TASK_STALLED")
+        return [e for e in evs
+                if e.get("labels", {}).get("group") == group]
+    events = _poll(stalled_collective, deadline_s=20.0)
+    assert events, "straggling rank never produced TASK_STALLED"
+    ev = events[0]
+    # Failure-domain cross-link: the event names who is blocked and —
+    # more importantly — which rank has NOT entered the op.
+    assert "1" in ev["labels"]["straggler_ranks"]
+    assert "0" in ev["labels"]["blocked_ranks"]
+    assert "straggler" in ev["message"]
+    # The straggler eventually arrives: the op completes and both ranks
+    # agree — a stall event is a diagnosis, not a failure.
+    assert ray_tpu.get(refs, timeout=120) == [2.0, 2.0]
+
+
+# ---------------------------------------------------------------------------
+# satellites: cluster memory summary + gauges + CLI help smoke
+# ---------------------------------------------------------------------------
+
+def test_summarize_objects_and_memory_cli(cluster, capsys):
+    from ray_tpu import scripts, state
+
+    held = [ray_tpu.put(np.ones(2048)) for _ in range(3)]
+    summ = _poll(lambda: (lambda s: s if s["total_objects"] >= 3 else None)(
+        state.summarize_objects()), deadline_s=10.0)
+    assert summ and summ["total_objects"] >= 3 and summ["total_bytes"] > 0
+    assert summ["owners"]
+    owner, agg = next(iter(summ["owners"].items()))
+    assert owner  # worker ident of the owning process
+    assert agg["objects"] >= 1 and "spilled" in agg and "in_memory" in agg
+
+    rows = state.list_cluster_objects(limit=50)
+    assert any(r.get("object_id") for r in rows)
+    assert all("owner" in r for r in rows if r.get("object_id"))
+
+    capsys.readouterr()
+    scripts.main(["memory", "--cluster", "--address", cluster])
+    out = json.loads(capsys.readouterr().out)
+    assert out["summary"]["total_objects"] >= 3
+    assert out["nodes"] and all("spilled_bytes" in n for n in out["nodes"])
+
+    # Arena-occupancy gauges roll up through node_stats into summary().
+    summ2 = state.summary()
+    assert summ2["object_store_capacity"] > 0
+    assert summ2["object_store_used"] >= 0
+    assert "spilled_bytes" in summ2
+    del held
+
+
+_CLI_SUBCOMMANDS = ("start", "job", "timeline", "events", "status", "list",
+                    "memory", "stack", "drain", "stop", "microbenchmark")
+
+
+@pytest.mark.parametrize("cmd", ("",) + _CLI_SUBCOMMANDS)
+def test_scripts_help_smoke(cmd, capsys):
+    from ray_tpu import scripts
+
+    argv = ([cmd] if cmd else []) + ["--help"]
+    with pytest.raises(SystemExit) as exc:
+        scripts.main(argv)
+    assert exc.value.code == 0, f"`{' '.join(argv)}` exited {exc.value.code}"
+    assert "usage" in capsys.readouterr().out.lower()
