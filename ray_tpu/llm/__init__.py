@@ -1,8 +1,37 @@
-from ray_tpu.llm.engine import LLMEngine, RequestOutput  # noqa: F401
+from ray_tpu.llm.engine import (  # noqa: F401
+    LLMEngine,
+    RequestOutput,
+    prefix_digest_chain,
+)
 from ray_tpu.llm.sampling import SamplingParams  # noqa: F401
 from ray_tpu.llm.serving import (  # noqa: F401
     LLMConfig,
     LLMServer,
+    RequestTimeoutError,
+    build_engine,
     build_llm_deployment,
     build_openai_app,
+    build_routed_app,
 )
+
+__all__ = [
+    "LLMEngine", "RequestOutput", "prefix_digest_chain", "SamplingParams",
+    "LLMConfig", "LLMServer", "RequestTimeoutError", "build_engine",
+    "build_llm_deployment", "build_openai_app", "build_routed_app",
+]
+
+
+def __getattr__(name):
+    # Router/disagg classes import lazily: they pull in the collective
+    # transport stack, which most llm users never touch.
+    if name in ("RouterCore", "LLMRouter", "LocalReplica", "ActorReplica",
+                "prefill_with_retry"):
+        import ray_tpu.llm.router as _r
+
+        return getattr(_r, name)
+    if name in ("PrefillServer", "KVStreamServer", "HandoffError",
+                "send_handoff"):
+        import ray_tpu.llm.disagg as _d
+
+        return getattr(_d, name)
+    raise AttributeError(name)

@@ -1,0 +1,294 @@
+"""Disaggregated prefill/decode: KV-page streaming between LLM replicas.
+
+Prefill and decode have opposite resource profiles — prefill is one long
+compute-bound burst, decode is thousands of tiny latency-bound steps — so
+co-scheduling them on one replica makes every chatty session's inter-token
+latency hostage to whatever long prompt shares the batch. This module splits
+the tiers: PrefillServer replicas run chunked prefill ONLY (engine built
+with prefill_only=True), then stream the populated KV pages plus portable
+request state to a decode replica, whose engine adopts the pages into its
+own block table (BlockManager.adopt_blocks + ModelRunner.scatter_pages) and
+enters decode directly.
+
+Wire format (reuses the chunked raw-frame machinery the ring collectives
+run on, collective/cpu_group.py):
+
+    [u64 body len][u8 kind=2][JSON request state + kv dtype/shape]
+    [u64][u8 kind=1][97B _AMETA][raw k-page bytes]   x ceil(bytes/1MiB)
+    [u64][u8 kind=1][97B _AMETA][raw v-page bytes]   x ceil(bytes/1MiB)
+    <- [u64][u8 kind=2][JSON ack]
+
+Control frames are JSON (kind 2), NOT pickle: the handoff hot path moves
+zero pickled bytes end to end (counter-tested like the ring collectives),
+and a decode replica never evals attacker-shaped pickles off a socket. Page
+payloads ride kind-1 array frames straight out of / into the page buffers
+via recv_into — no intermediate copies, dtype-agnostic (bf16 pages travel
+as raw bytes; the logical dtype rides in the JSON meta).
+
+Failure atomicity: one connection carries exactly one request, and the
+receiver adopts only after BOTH arrays arrived whole. A prefill replica
+dying mid-handoff just drops the connection — the decode engine adopts
+nothing, and the router re-runs prefill on another replica (the sender only
+reports success after the receiver's ack).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.collective.cpu_group import (
+    _AMETA, _HDR, _K_ARRAY, _chunks, _frame_views, _read_ameta, _read_hdr,
+    _sock_recv_into, _sock_send)
+from ray_tpu.core import serialization as _ser
+
+# Handoff control frame: JSON body (kinds 0/1 belong to cpu_group's wire).
+_K_JSON = 2
+_CHUNK_BYTES = 1 << 20
+
+
+class HandoffError(RuntimeError):
+    """KV handoff failed before the receiver acked adoption."""
+
+
+def _send_json(sock: socket.socket, obj: dict,
+               deadline: Optional[float] = None) -> None:
+    body = json.dumps(obj).encode()
+    _sock_send(sock, memoryview(_HDR.pack(len(body), _K_JSON) + body),
+               None, deadline)
+
+
+def _recv_frame(sock: socket.socket, deadline: Optional[float] = None):
+    """Receive one logical handoff message: ("json", dict) or a whole raw
+    array reassembled across its chunk frames ("array", flat uint8)."""
+    length, kind = _read_hdr(sock, None, deadline)
+    if kind == _K_JSON:
+        body = bytearray(length)
+        _sock_recv_into(sock, memoryview(body), None, deadline)
+        return "json", json.loads(bytes(body).decode())
+    if kind != _K_ARRAY:
+        raise HandoffError(f"handoff protocol error: unknown frame kind {kind}")
+    dtype, shape, offset, nelems = _read_ameta(sock, None, deadline)
+    out = np.empty(shape, dtype)
+    flat = out.reshape(-1)
+    total, got = flat.size, 0
+    while True:
+        if nelems:
+            _sock_recv_into(
+                sock, memoryview(flat[offset:offset + nelems]).cast("B"),
+                None, deadline)
+            got += nelems
+        _ser.counters["deserialize_fast"] += 1
+        if got >= total:
+            return "array", flat
+        length, kind = _read_hdr(sock, None, deadline)
+        if kind != _K_ARRAY:
+            raise HandoffError("handoff protocol error: truncated array stream")
+        _, _, offset, nelems = _read_ameta(sock, None, deadline)
+
+
+def _send_array(sock: socket.socket, arr: np.ndarray,
+                deadline: Optional[float] = None) -> None:
+    """Stream one array as chunked raw frames; dtype-agnostic (bytes view)."""
+    flat = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+    for off, n in _chunks(0, flat.size, _CHUNK_BYTES):
+        for view in _frame_views(flat[off:off + n], flat.shape, off):
+            _sock_send(sock, view, None, deadline)
+
+
+def send_handoff(address, state: dict, k_pages, v_pages, *,
+                 timeout: float = 60.0) -> dict:
+    """Stream one prefilled request to a decode replica's KVStreamServer.
+
+    Blocks until the receiver acks adoption — only then may the sender
+    release its own pages and report success upstream (an unacked handoff
+    is treated as never having happened; the router re-runs prefill)."""
+    k = np.ascontiguousarray(k_pages)
+    v = np.ascontiguousarray(v_pages)
+    meta = dict(state)
+    meta["kv_dtype"] = str(k.dtype)
+    meta["kv_shape"] = list(k.shape)
+    deadline = time.monotonic() + timeout
+    with socket.create_connection(tuple(address), timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        _send_json(sock, meta, deadline)
+        _send_array(sock, k, deadline)
+        _send_array(sock, v, deadline)
+        kind, ack = _recv_frame(sock, deadline)
+    if kind != "json" or not ack.get("ok"):
+        raise HandoffError(f"decode replica rejected handoff: {ack}")
+    return ack
+
+
+class KVStreamServer:
+    """Decode-side handoff listener: adopts streamed KV pages atomically.
+
+    One daemon thread accepts connections; each connection carries exactly
+    one request. A connection that dies mid-stream is discarded whole —
+    `adopt_fn(state, k_pages, v_pages) -> bool` runs only once both arrays
+    arrived intact, so partial prefill state can never enter the decode
+    engine's block table."""
+
+    def __init__(self, adopt_fn: Callable[[dict, np.ndarray, np.ndarray], bool],
+                 host: str = "127.0.0.1", port: int = 0,
+                 timeout: float = 60.0):
+        self._adopt = adopt_fn
+        self._timeout = timeout
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.address: Tuple[str, int] = self._sock.getsockname()
+        self._closed = False
+        self.handoffs_adopted = 0
+        self.handoffs_rejected = 0
+        self._thread = threading.Thread(
+            target=self._serve, name="kv-handoff-listener", daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket):
+        with conn:
+            conn.settimeout(self._timeout)
+            try:
+                kind, meta = _recv_frame(conn)
+                if kind != "json":
+                    raise HandoffError("handoff must start with a JSON frame")
+                _, kflat = _recv_frame(conn)
+                _, vflat = _recv_frame(conn)
+            except Exception:
+                # Partial stream (sender died / malformed): adopt NOTHING.
+                self.handoffs_rejected += 1
+                return
+            try:
+                dtype = np.dtype(meta.pop("kv_dtype"))
+                shape = tuple(meta.pop("kv_shape"))
+                k = kflat.view(dtype).reshape(shape)
+                v = vflat.view(dtype).reshape(shape)
+                ok = bool(self._adopt(meta, k, v))
+            except Exception as e:
+                self.handoffs_rejected += 1
+                try:
+                    _send_json(conn, {"ok": False, "error": repr(e)})
+                except Exception:
+                    pass
+                return
+            if ok:
+                self.handoffs_adopted += 1
+                from ray_tpu.runtime import metric_defs
+
+                metric_defs.LLM_KV_HANDOFFS.inc()
+            else:
+                self.handoffs_rejected += 1
+            try:
+                _send_json(conn, {"ok": ok, "id": meta.get("id")})
+            except Exception:
+                pass
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PrefillServer:
+    """Replica callable for the prefill tier.
+
+    Runs chunked prefill ONLY (the engine never takes a decode tick), then
+    exports the request — state + populated KV pages — and streams it to
+    the decode replica named by the caller. Requests that finish AT prefill
+    (max_tokens == 1, stop token on the first sample) complete here and
+    return their response inline: there is nothing left to decode."""
+
+    def __init__(self, llm_config):
+        from ray_tpu.llm.serving import build_engine
+
+        self.engine = build_engine(llm_config, prefill_only=True)
+        self.tokenizer = llm_config.tokenizer
+        self._lock = threading.Lock()
+        # EWMA prefill throughput (tokens/s): the router's TTFT estimator
+        # divides queued prefill tokens by this.
+        self._prefill_tps = 0.0
+
+    def _parse(self, request: Dict):
+        from ray_tpu.llm.serving import LLMServer
+
+        return LLMServer._parse(self, request)
+
+    def prefill(self, request: Dict, decode_address) -> Dict:
+        """Prefill one request and hand it to `decode_address` (a decode
+        replica's KVStreamServer). Returns {"handoff": True, "rid": ...} on
+        success; {"handoff": False, "response": ...} when the request
+        finished during prefill."""
+        prompt, params, lora_name = self._parse(request)
+        t0 = time.monotonic()
+        with self._lock:
+            rid = self.engine.add_request(prompt, params,
+                                          lora_name=lora_name)
+            final = None
+            while True:
+                outs = self.engine.step()
+                mine = [o for o in outs if o.request_id == rid]
+                if any(o.finished for o in mine):
+                    final = next(o for o in mine if o.finished)
+                    break
+                if mine:
+                    break  # first token emitted: prefill complete
+                if not self.engine.has_unfinished():
+                    raise RuntimeError(f"request {rid} vanished mid-prefill")
+            if final is not None:
+                return {"handoff": False, "rid": rid,
+                        "response": _completion_response(final)}
+            state = self.engine.export_request(rid)
+            blocks = state.pop("blocks")
+            k, v = self.engine.runner.gather_pages(blocks)
+            self.engine.block_manager.release_blocks(blocks)
+            elapsed = max(time.monotonic() - t0, 1e-6)
+            tps = len(prompt) / elapsed
+            self._prefill_tps = (tps if self._prefill_tps == 0.0
+                                 else 0.8 * self._prefill_tps + 0.2 * tps)
+        # Stream outside the lock: the socket write must not serialize the
+        # next request's prefill compute behind network time.
+        ack = send_handoff(decode_address, state, k, v)
+        return {"handoff": True, "rid": rid, "ack": ack,
+                "prefill_tokens_per_s": round(self._prefill_tps, 1)}
+
+    def engine_stats(self) -> Dict:
+        with self._lock:
+            s = self.engine.stats()
+        s["prefill_tokens_per_s"] = round(self._prefill_tps, 1)
+        s["role"] = "prefill"
+        return s
+
+
+def _completion_response(out) -> Dict:
+    """OpenAI-ish completion body from a finished RequestOutput (shared by
+    LLMServer.completions and the prefill-finishes-everything path)."""
+    return {
+        "id": out.request_id,
+        "object": "text_completion",
+        "choices": [{
+            "text": out.text,
+            "token_ids": out.output_token_ids,
+            "finish_reason": out.finish_reason,
+        }],
+        "usage": {
+            "prompt_tokens": len(out.prompt_token_ids),
+            "completion_tokens": len(out.output_token_ids),
+        },
+    }

@@ -36,6 +36,44 @@ from ray_tpu.llm.sampling import SamplingParams, sample
 _PREFIX_CACHE_SALT = os.urandom(16)
 
 
+def prefix_digest_chain(prompt: Sequence[int], block_size: int, *,
+                        salt: Optional[bytes] = None,
+                        seed: bytes = b"") -> List[bytes]:
+    """Keyed rolling digest per FULL block of `prompt` (position-and-content
+    chain, so identical blocks at different depths never collide).
+
+    blake2b keyed with a random salt, NOT builtin hash(): hash(int)==int is
+    attacker-predictable, letting a multi-tenant client construct a block
+    whose chain value collides with another user's cached block — silent
+    cross-request KV reuse (the vLLM prefix-cache collision vulnerability).
+
+    `salt` defaults to the per-process engine salt (BlockManager's cache
+    addresses); the serving router (llm/router.py) passes its OWN salt and
+    keeps a router-local chain->replica map — per-process salts mean replica
+    digests are deliberately NOT comparable across processes. `seed` mixes
+    extra context into the chain root (the engine seeds with the LoRA slot;
+    the router with the adapter name)."""
+    out: List[bytes] = []
+    h = b"prefix-chain"
+    bs = block_size
+    key = _PREFIX_CACHE_SALT if salt is None else salt
+    n_blocks = len(prompt) // bs
+    if n_blocks == 0:
+        return out
+    # One vectorized tobytes per block (fixed-width little-endian i64),
+    # not per-token int.to_bytes: this runs at every admission on the
+    # prefill scheduling path (and per routed request in the router).
+    flat = np.asarray(prompt[:n_blocks * bs], dtype="<i8")
+    for i in range(n_blocks):
+        m = hashlib.blake2b(key=key, digest_size=16)
+        m.update(h)
+        m.update(seed)
+        m.update(flat[i * bs:(i + 1) * bs].tobytes())
+        h = m.digest()
+        out.append(h)
+    return out
+
+
 @dataclasses.dataclass
 class RequestOutput:
     request_id: str
@@ -157,36 +195,13 @@ class BlockManager:
     # ---- prefix caching --------------------------------------------------
     def prefix_hashes(self, prompt: Sequence[int],
                       lora_slot: int = 0) -> List[bytes]:
-        """Keyed rolling digest per FULL prompt block (position-and-content
-        chain, so identical blocks at different depths never collide). The
-        chain is seeded with the LoRA slot: adapters change wk/wv
-        (llm/lora.py TARGETS), so KV content differs per adapter and
-        cross-adapter sharing would be silently wrong.
-
-        blake2b keyed with a per-process random salt, NOT builtin hash():
-        hash(int)==int is attacker-predictable, letting a multi-tenant
-        client construct a block whose chain value collides with another
-        user's cached block — silent cross-request KV reuse (the vLLM
-        prefix-cache collision vulnerability)."""
-        out: List[bytes] = []
-        h = b"prefix-chain"
-        bs = self.block_size
+        """Digest chain for this manager's cache addresses (module-level
+        prefix_digest_chain under the per-process salt). The chain is seeded
+        with the LoRA slot: adapters change wk/wv (llm/lora.py TARGETS), so
+        KV content differs per adapter and cross-adapter sharing would be
+        silently wrong."""
         slot = int(lora_slot).to_bytes(8, "little", signed=True)
-        n_blocks = len(prompt) // bs
-        if n_blocks == 0:
-            return out
-        # One vectorized tobytes per block (fixed-width little-endian i64),
-        # not per-token int.to_bytes: this runs at every admission on the
-        # prefill scheduling path.
-        flat = np.asarray(prompt[:n_blocks * bs], dtype="<i8")
-        for i in range(n_blocks):
-            m = hashlib.blake2b(key=_PREFIX_CACHE_SALT, digest_size=16)
-            m.update(h)
-            m.update(slot)
-            m.update(flat[i * bs:(i + 1) * bs].tobytes())
-            h = m.digest()
-            out.append(h)
-        return out
+        return prefix_digest_chain(prompt, self.block_size, seed=slot)
 
     def match_prefix(self, req: _Request, hashes: List[bytes]) -> int:
         """Attach the longest cached chain to req; returns tokens skipped.
@@ -221,6 +236,22 @@ class BlockManager:
         self.cached[h] = bid
         self.block_hash[bid] = h
 
+    # ---- disaggregated handoff (llm/disagg.py) ---------------------------
+
+    def adopt_blocks(self, n: int) -> Optional[List[int]]:
+        """Allocate `n` fresh private pages for KV adopted from another
+        replica (prefill->decode handoff). Refcounted like any allocation so
+        the normal release path applies; returns None when the pool cannot
+        fit them (the caller rejects the handoff, nothing partial sticks)."""
+        if self._available() < n:
+            return None
+        out: List[int] = []
+        for _ in range(n):
+            bid = self._take_free_block()
+            self.refcount[bid] = self.refcount.get(bid, 0) + 1
+            out.append(bid)
+        return out
+
 
 class LLMEngine:
     def __init__(self, model_runner, *, max_batch_size: int = 8,
@@ -229,7 +260,8 @@ class LLMEngine:
                  pipeline_depth: Optional[int] = None,
                  enable_prefix_caching: bool = True,
                  speculative_ngram: int = 0,
-                 decode_multi_step: int = 1):
+                 decode_multi_step: int = 1,
+                 prefill_only: bool = False):
         self.runner = model_runner
         self.block_size = model_runner.block_size
         self.block_manager = BlockManager(
@@ -280,6 +312,11 @@ class LLMEngine:
         # otherwise it falls back to the single-step program (both are
         # precompiled; no mid-stream compiles either way).
         self.multi_step = max(1, int(decode_multi_step))
+        # Disaggregated prefill tier (llm/disagg.py): a prefill-only engine
+        # never runs a decode tick — sequences that finish prefill (first
+        # token sampled) park in `running` until export_request hands them
+        # to a decode replica.
+        self.prefill_only = bool(prefill_only)
 
     # ---- API -------------------------------------------------------------
 
@@ -329,7 +366,7 @@ class LLMEngine:
             self._rejected.clear()
         if self.prefilling:
             outputs.extend(self._prefill_step())
-        if self.running or self._flights:
+        if not self.prefill_only and (self.running or self._flights):
             outputs.extend(self._decode_tick())
         return outputs
 
@@ -360,6 +397,134 @@ class LLMEngine:
                     return
             if not self.has_unfinished():
                 return
+
+    def abort_request(self, request_id: str) -> bool:
+        """Drop a request wherever it lives and free its pages — the serving
+        layer calls this when the client disappears (stream consumer gone,
+        wait timeout) so an abandoned request stops burning decode compute
+        and KV pages on a dead stream. Pages an in-flight device step may
+        still write into are release-deferred until those flights drain
+        (the same discipline as preemption). Returns False when the id is
+        unknown (already finished/aborted)."""
+        for i, req in enumerate(self.waiting):
+            if req.id == request_id:
+                del self.waiting[i]
+                req.finished_reason = "abort"
+                self._unpin_lora(req)
+                self._defer_release(req)
+                return True
+        for queue_ in (self.prefilling, self.running):
+            for req in queue_:
+                if req.id == request_id:
+                    queue_.remove(req)
+                    req.finished_reason = "abort"
+                    self._unpin_lora(req)
+                    self._defer_release(req)
+                    return True
+        return False
+
+    def stats(self) -> Dict:
+        """Scheduler/cache load signal for the serving router: queue depths,
+        KV pool occupancy, prefix-cache effectiveness, and the queued
+        prefill backlog the SLO admission estimator divides by prefill
+        throughput. Cheap (no device sync) — safe to poll per request."""
+        bm = self.block_manager
+        backlog = sum(len(r.context) - r.prefilled for r in self.prefilling)
+        backlog += sum(len(r.context) for r in self.waiting)
+        return {
+            "waiting": len(self.waiting),
+            "prefilling": len(self.prefilling),
+            "running": len(self.running),
+            "inflight_steps": len(self._flights),
+            "free_kv_blocks": bm._available(),
+            "total_kv_blocks": self.runner.num_blocks,
+            "block_size": self.block_size,
+            "prefix_hits": bm.prefix_hits,
+            "prefix_tokens_saved": bm.prefix_tokens_saved,
+            "queued_prefill_tokens": backlog,
+        }
+
+    # ---- disaggregated prefill/decode handoff (llm/disagg.py) ------------
+
+    def export_request(self, request_id: str) -> Optional[dict]:
+        """Detach a just-prefilled request for handoff to a decode replica.
+        Returns the portable request state with its (detached) block ids
+        under "blocks"; the caller gathers those pages off the device
+        (ModelRunner.gather_pages), streams them, and THEN releases the
+        blocks via block_manager.release_blocks — shared cached prefix
+        blocks stay addressable for the next prompt sharing them."""
+        for req in self.running:
+            if req.id == request_id:
+                break
+        else:
+            return None
+        if req.dispatched:
+            raise RuntimeError(
+                f"request {request_id} has in-flight decode steps; only a "
+                "prefill-only engine can export (its pages may still be "
+                "written)")
+        self.running.remove(req)
+        self._unpin_lora(req)
+        blocks, req.blocks = req.blocks, []
+        return {
+            "id": req.id,
+            "prompt": list(req.prompt),
+            "output": list(req.output),
+            "seed": req.seed_val,
+            "lora_slot": req.lora_slot,
+            "params": dataclasses.asdict(req.params),
+            "blocks": blocks,
+        }
+
+    def adopt_request(self, state: dict, k_pages, v_pages) -> bool:
+        """Adopt a prefilled request streamed from another replica: fresh
+        private pages, KV scattered in, the sequence enters decode directly.
+        Decode is bit-identical to a colocated run because the device
+        sampler keys on (seed, absolute position counter) — both carried in
+        `state`. Returns False (nothing allocated) when the pool can't fit
+        the pages; the sender keeps ownership and the router retries."""
+        from ray_tpu.llm.sampling import SamplingParams
+
+        params = SamplingParams(**state["params"])
+        req = _Request(state["id"], list(state["prompt"]), params,
+                       int(state.get("lora_slot", 0)))
+        req.output = [int(t) for t in state["output"]]
+        req.seed_val = int(state["seed"])
+        n_pages = int(np.shape(k_pages)[2])
+        if self.block_manager.blocks_needed(len(req.context) + 1) > n_pages:
+            # The exported allocation always covers context + 1 (admission
+            # invariant); anything less is a protocol error, not pressure.
+            raise ValueError(
+                f"handoff for {req.id} carries {n_pages} pages; "
+                f"{self.block_manager.blocks_needed(len(req.context) + 1)} "
+                "needed")
+        if req.lora_slot and self.runner.lora is None:
+            raise ValueError(
+                "handoff carries a LoRA slot but this replica has no LoRA "
+                "manager (disaggregated tiers must preload identical "
+                "adapters)")
+        ids = self.block_manager.adopt_blocks(n_pages)
+        if ids is None:
+            return False
+        if req.lora_pinned:
+            self.runner.lora.pin(req.lora_slot)
+        req.blocks = ids
+        req.prefilled = len(req.context)
+        self.runner.scatter_pages(ids, k_pages, v_pages)
+        if self.block_manager.caching:
+            # Re-register full prompt blocks under THIS replica's digest
+            # chain so disaggregation composes with prefix caching: the next
+            # prompt sharing the system prefix hits locally.
+            req.prefix_hashes = self.block_manager.prefix_hashes(
+                req.prompt, req.lora_slot)
+            full = min(len(req.prompt) // self.block_size, len(ids))
+            while req.registered_blocks < full:
+                j = req.registered_blocks
+                self.block_manager.register_block(
+                    req, j, req.prefix_hashes[j])
+                req.registered_blocks += 1
+        self.running.append(req)
+        return True
 
     # ---- internals -------------------------------------------------------
 

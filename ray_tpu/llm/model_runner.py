@@ -355,6 +355,31 @@ class ModelRunner:
             block_tables, temps, top_ks, top_ps, seeds, counters, lora, idx)
         return toks
 
+    # ---- disaggregated KV handoff (llm/disagg.py) -----------------------
+
+    def gather_pages(self, block_ids: Sequence[int]):
+        """Fetch the KV pages backing `block_ids` as host arrays, each
+        (n_layers, n_kv_heads, n_pages, block_size, head_dim) — the export
+        side of the prefill->decode handoff. One device-side gather per
+        cache side; the host copies are the raw buffers the zero-pickle
+        framing streams."""
+        import numpy as np
+
+        ids = jnp.asarray(list(block_ids), dtype=jnp.int32)
+        k = np.asarray(self.cache["k"][:, :, ids])
+        v = np.asarray(self.cache["v"][:, :, ids])
+        return k, v
+
+    def scatter_pages(self, block_ids: Sequence[int], k_pages, v_pages):
+        """Write adopted KV pages (gather_pages layout) into this runner's
+        pool at `block_ids` — the import side of the handoff."""
+        ids = jnp.asarray(list(block_ids), dtype=jnp.int32)
+        dtype = self.cache["k"].dtype
+        self.cache["k"] = self.cache["k"].at[:, :, ids].set(
+            jnp.asarray(k_pages, dtype=dtype))
+        self.cache["v"] = self.cache["v"].at[:, :, ids].set(
+            jnp.asarray(v_pages, dtype=dtype))
+
     def batch_bucket(self, n: int) -> int:
         return _bucket(n, self.BATCH_BUCKETS)
 

@@ -1,0 +1,407 @@
+"""Token-throughput-aware LLM router: prefix affinity, pow2, SLO admission.
+
+Replaces the blind client-side `DeploymentHandle._pick_replica` (power-of-
+two on the caller's OWN in-flight count) for LLM apps with a router
+deployment that sees what actually matters for token throughput:
+
+  1. **Prefix-cache affinity.** The engine's automatic prefix cache keys
+     blocks by a salted digest chain over prompt blocks
+     (engine.prefix_digest_chain). The router keeps its own chain -> replica
+     map under its OWN salt (replica salts are per-process and deliberately
+     irreproducible): route a prompt to the replica that most recently
+     served its longest matching chain and the replica-side cache hits on
+     the shared prefix — prefill skips those blocks entirely.
+  2. **Session / LoRA affinity.** A session's follow-up turn extends its
+     previous context; its KV pages are still hot on the replica that
+     served the last turn.
+  3. **Power-of-two-choices on real load** — queue depth (waiting +
+     prefilling + running from LLMServer.engine_stats()), router-side
+     in-flight, and KV occupancy — instead of the handle's client-local
+     in-flight count.
+  4. **SLO-aware admission.** Projected TTFT = queued prefill tokens ahead
+     of this request / measured prefill throughput. When it exceeds the
+     deployment's slo_ttft_s, shed NOW with a 429-shaped error instead of
+     letting every queue grow unboundedly (the shed is cheap for the client
+     to retry elsewhere; a timed-out request holds KV pages the whole way).
+
+In disaggregated mode (LLMConfig.disaggregate > 0) the router also drives
+the prefill tier: pick a prefill replica, hand it the decode replica's KV
+handoff address, and collect the completion from the decode replica once
+the pages are adopted (llm/disagg.py). A prefill replica dying mid-handoff
+is retried on the remaining prefill replicas — the handoff wire is atomic,
+so a half-streamed request never enters any decode engine.
+
+RouterCore is deliberately cluster-free (pure routing state + arithmetic)
+so tests and the microbench drive it against in-process engines; LLMRouter
+is the serve deployment wrapping it around real replica handles.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.llm.engine import prefix_digest_chain
+
+# Load-score weight of KV occupancy relative to one queued request: a
+# replica with a full cache is one whose next admission will evict reusable
+# prefixes, so treat it as ~2 requests heavier.
+_KV_PRESSURE_WEIGHT = 2.0
+# An affinity owner more than this many score points heavier than the
+# lightest replica loses the request anyway (cache reuse never justifies
+# piling onto a hotspot).
+_AFFINITY_IMBALANCE = 8.0
+
+
+class LocalReplica:
+    """In-process replica adapter (tests / microbench): same call surface
+    as ActorReplica, with RpcChaos fault injection honored so chaos tests
+    exercise the router's retry path without a cluster."""
+
+    def __init__(self, obj: Any, name: str = ""):
+        self._obj = obj
+        self.name = name or type(obj).__name__
+
+    def call(self, method: str, *args, **kwargs):
+        from ray_tpu.runtime.chaos import chaos
+
+        c = chaos()
+        if c.enabled:
+            import asyncio
+
+            asyncio.run(c.intercept_client(f"{self.name}.{method}"))
+        return getattr(self._obj, method)(*args, **kwargs)
+
+
+class ActorReplica:
+    """Replica actor handle adapter (the real serve path)."""
+
+    def __init__(self, handle: Any, name: str = "", timeout: float = 600.0):
+        self._handle = handle
+        self._timeout = timeout
+        self.name = name
+
+    def call(self, method: str, *args, **kwargs):
+        import ray_tpu
+
+        ref = self._handle.handle_request.remote(method, list(args), kwargs)
+        return ray_tpu.get(ref, timeout=self._timeout)
+
+
+class RouterCore:
+    """Routing state machine: affinity maps, load scores, admission gate.
+
+    Indexes replicas 0..n-1; the owner (LLMRouter or a test) maps indexes
+    to actual replica objects and feeds `pick`/`admit` fresh engine_stats
+    payloads. Thread-safe under one internal lock (decisions are cheap;
+    the expensive work — stats RPCs, token streaming — happens outside)."""
+
+    def __init__(self, n_replicas: int, *, block_size: int = 16,
+                 slo_ttft_s: float = 0.0, prefix_lru: int = 8192,
+                 prefill_tps: Optional[float] = None):
+        if n_replicas < 1:
+            raise ValueError("router needs at least one replica")
+        self.n = n_replicas
+        self.block_size = block_size
+        self.slo_ttft_s = float(slo_ttft_s)
+        self._lock = threading.Lock()
+        # Router-local salt: chains here never meet replica-side chains
+        # (those are salted per process); only internal consistency matters.
+        self._salt = os.urandom(16)
+        # digest -> replica idx, LRU-bounded; last writer wins (that replica
+        # holds the freshest copy of the blocks).
+        self._prefix_owner: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()
+        self._prefix_lru = prefix_lru
+        self._session_owner: Dict[str, int] = {}
+        self._inflight = [0] * n_replicas
+        # Prefill-throughput EWMA feeding the TTFT estimator; a pinned
+        # value (tests) disables the online update.
+        self._prefill_tps = prefill_tps or 0.0
+        self._prefill_tps_pinned = prefill_tps is not None
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self.shed_count = 0
+
+    # ---- affinity --------------------------------------------------------
+
+    def digest_chain(self, prompt: Sequence[int],
+                     lora_name: Optional[str] = None) -> List[bytes]:
+        return prefix_digest_chain(prompt, self.block_size, salt=self._salt,
+                                   seed=(lora_name or "").encode())
+
+    def _remember(self, chain: List[bytes], idx: int):
+        for d in chain:
+            if d in self._prefix_owner:
+                self._prefix_owner.move_to_end(d)
+            self._prefix_owner[d] = idx
+        while len(self._prefix_owner) > self._prefix_lru:
+            self._prefix_owner.popitem(last=False)
+
+    # ---- load ------------------------------------------------------------
+
+    def _load_score(self, idx: int, stats: Sequence[Optional[Dict]]) -> float:
+        s = stats[idx] if idx < len(stats) else None
+        score = float(self._inflight[idx])
+        if s:
+            score += s.get("waiting", 0) + s.get("prefilling", 0) \
+                + s.get("running", 0)
+            total = s.get("total_kv_blocks", 0)
+            if total:
+                score += _KV_PRESSURE_WEIGHT * (
+                    1.0 - s.get("free_kv_blocks", 0) / total)
+        return score
+
+    # ---- decisions -------------------------------------------------------
+
+    def pick(self, prompt: Sequence[int], *,
+             session_id: Optional[str] = None,
+             lora_name: Optional[str] = None,
+             stats: Optional[Sequence[Optional[Dict]]] = None
+             ) -> Tuple[int, Dict]:
+        """Choose a replica. Returns (idx, decision) where decision carries
+        the reason ("session" | "prefix" | "pow2") and matched_blocks."""
+        import random
+
+        stats = stats if stats is not None else [None] * self.n
+        chain = self.digest_chain(prompt, lora_name)
+        with self._lock:
+            scores = [self._load_score(i, stats) for i in range(self.n)]
+            floor = min(scores)
+            idx: Optional[int] = None
+            decision = {"reason": "pow2", "matched_blocks": 0}
+            if session_id is not None:
+                owner = self._session_owner.get(session_id)
+                if owner is not None and owner < self.n \
+                        and scores[owner] - floor <= _AFFINITY_IMBALANCE:
+                    idx = owner
+                    decision = {"reason": "session", "matched_blocks": 0}
+            if idx is None:
+                # Deepest cached chain wins: scan from the longest prefix
+                # down so a replica holding 8 blocks beats one holding 2.
+                for i in range(len(chain) - 1, -1, -1):
+                    owner = self._prefix_owner.get(chain[i])
+                    if owner is None or owner >= self.n:
+                        continue
+                    if scores[owner] - floor > _AFFINITY_IMBALANCE:
+                        break  # owner is a hotspot; fall through to pow2
+                    idx = owner
+                    decision = {"reason": "prefix", "matched_blocks": i + 1}
+                    break
+            if idx is None:
+                if self.n == 1:
+                    idx = 0
+                else:
+                    a, b = random.sample(range(self.n), 2)
+                    idx = a if scores[a] <= scores[b] else b
+                self.affinity_misses += 1
+            else:
+                self.affinity_hits += 1
+            if session_id is not None:
+                self._session_owner[session_id] = idx
+            self._remember(chain, idx)
+            return idx, decision
+
+    def admit(self, idx: int, prompt_len: int,
+              stats: Optional[Sequence[Optional[Dict]]] = None
+              ) -> Tuple[bool, float]:
+        """SLO admission gate: (ok, projected_ttft_s). Projected TTFT is
+        the prefill tokens queued ahead of this request divided by measured
+        prefill throughput; with no SLO configured everything admits."""
+        if self.slo_ttft_s <= 0.0:
+            return True, 0.0
+        with self._lock:
+            tps = self._prefill_tps
+        if tps <= 0.0:
+            return True, 0.0  # no throughput signal yet: never shed blind
+        s = (stats[idx] if stats is not None and idx < len(stats) else None) \
+            or {}
+        queued = s.get("queued_prefill_tokens", 0)
+        projected = (queued + prompt_len) / tps
+        if projected > self.slo_ttft_s:
+            with self._lock:
+                self.shed_count += 1
+            return False, projected
+        return True, projected
+
+    def observe_prefill(self, tokens: int, seconds: float):
+        """Feed the TTFT estimator with a measured prefill."""
+        if self._prefill_tps_pinned or seconds <= 0 or tokens <= 0:
+            return
+        rate = tokens / seconds
+        with self._lock:
+            self._prefill_tps = (rate if self._prefill_tps == 0.0
+                                 else 0.8 * self._prefill_tps + 0.2 * rate)
+
+    def start(self, idx: int):
+        with self._lock:
+            self._inflight[idx] += 1
+
+    def finish(self, idx: int):
+        with self._lock:
+            self._inflight[idx] = max(0, self._inflight[idx] - 1)
+
+
+def prefill_with_retry(prefill_replicas: Sequence[Any], request: Dict,
+                       decode_address) -> Dict:
+    """Run prefill on the first replica that survives it.
+
+    The handoff wire is atomic (llm/disagg.py): a replica that dies
+    mid-stream leaves NOTHING adopted on the decode side, so re-running
+    the whole prefill elsewhere is always correct — just wasted compute."""
+    last: Optional[Exception] = None
+    for replica in prefill_replicas:
+        try:
+            return replica.call("prefill", request, decode_address)
+        except Exception as e:  # ConnectionLost, HandoffError, socket death
+            last = e
+    raise RuntimeError(
+        f"prefill failed on all {len(prefill_replicas)} replicas") from last
+
+
+class LLMRouter:
+    """The serve deployment fronting the LLM fleet (build_routed_app).
+
+    Requests: same body as LLMServer.completions plus optional
+    "session_id". Responses: the completion dict, or a 429-shaped
+    {"error": {"code": 429, ...}} when SLO admission sheds."""
+
+    STATS_TTL_S = 0.25
+
+    def __init__(self, llm_config, engine_deployment: str,
+                 prefill_deployment: Optional[str] = None):
+        self.config = llm_config
+        self.deployment = engine_deployment
+        self._prefill_deployment = prefill_deployment
+        # Replica handles resolve on the FIRST REQUEST, not here: this
+        # __init__ runs while the controller is still blocked deploying the
+        # router itself, so calling back into it (get_replicas) from here
+        # deadlocks until the get times out and kills the deploy.
+        self.replicas: List[Any] = []
+        self.prefill_replicas: List[Any] = []
+        self.core: Optional[RouterCore] = None
+        self._resolve_lock = threading.Lock()
+        self._stats: List[Optional[Dict]] = []
+        self._stats_t = 0.0
+        self._stats_lock = threading.Lock()
+        # decode idx -> KV handoff address, resolved once per replica.
+        self._handoff_addrs: Dict[int, Any] = {}
+
+    # ---- replica state ---------------------------------------------------
+
+    def _ensure_replicas(self) -> None:
+        if self.core is not None:
+            return
+        with self._resolve_lock:
+            if self.core is not None:
+                return
+            from ray_tpu import serve
+
+            handle = serve.get_deployment_handle(self.deployment)
+            self.replicas = [
+                ActorReplica(h, name=f"{self.deployment}#{i}")
+                for i, h in enumerate(handle.replica_handles())]
+            if self._prefill_deployment:
+                ph = serve.get_deployment_handle(self._prefill_deployment)
+                self.prefill_replicas = [
+                    ActorReplica(h, name=f"{self._prefill_deployment}#{i}")
+                    for i, h in enumerate(ph.replica_handles())]
+            self._stats = [None] * len(self.replicas)
+            # core is the publication barrier: assigned LAST, so a racing
+            # reader that sees it non-None sees resolved replicas too.
+            self.core = RouterCore(
+                len(self.replicas), block_size=self.config.block_size,
+                slo_ttft_s=self.config.slo_ttft_s)
+
+    def _fresh_stats(self) -> List[Optional[Dict]]:
+        now = time.monotonic()
+        with self._stats_lock:
+            if now - self._stats_t < self.STATS_TTL_S:
+                return self._stats
+            self._stats_t = now
+        stats: List[Optional[Dict]] = []
+        for r in self.replicas:
+            try:
+                stats.append(r.call("engine_stats"))
+            except Exception:
+                stats.append(None)  # unreachable replica scores as unknown
+        with self._stats_lock:
+            self._stats = stats
+        return stats
+
+    def _handoff_addr(self, idx: int):
+        addr = self._handoff_addrs.get(idx)
+        if addr is None:
+            addr = self.replicas[idx].call("handoff_address")
+            self._handoff_addrs[idx] = addr
+        return addr
+
+    # ---- API -------------------------------------------------------------
+
+    def __call__(self, request: Dict) -> Dict:
+        return self.completions(request)
+
+    def completions(self, request: Dict) -> Dict:
+        from ray_tpu.runtime import events, metric_defs
+
+        self._ensure_replicas()
+        prompt = request.get("prompt", [])
+        token_prompt = (list(prompt.encode()) if isinstance(prompt, str)
+                        else list(prompt))
+        stats = self._fresh_stats()
+        idx, decision = self.core.pick(
+            token_prompt, session_id=request.get("session_id"),
+            lora_name=request.get("lora_name"), stats=stats)
+        metric_defs.LLM_ROUTER_AFFINITY.inc(tags={
+            "outcome": "hit" if decision["reason"] != "pow2" else "miss"})
+        ok, projected = self.core.admit(idx, len(token_prompt), stats)
+        if not ok:
+            metric_defs.LLM_ROUTER_SHED.inc(
+                tags={"deployment": self.deployment})
+            events.emit(events.LLM_REQUEST_SHED,
+                        f"shed: projected TTFT {projected:.2f}s > SLO "
+                        f"{self.core.slo_ttft_s:.2f}s",
+                        severity=events.WARNING, source="llm-router",
+                        labels={"projected_ttft_s": f"{projected:.3f}",
+                                "slo_ttft_s": f"{self.core.slo_ttft_s:.3f}",
+                                "replica": str(idx)})
+            return {"error": {"code": 429, "type": "overloaded",
+                              "message": "projected TTFT "
+                                         f"{projected:.2f}s exceeds SLO; "
+                                         "retry with backoff"}}
+        self.core.start(idx)
+        try:
+            if self.prefill_replicas:
+                return self._disagg_completions(request, idx)
+            t0 = time.monotonic()
+            resp = self.replicas[idx].call("completions", request)
+            self.core.observe_prefill(
+                len(token_prompt), max(time.monotonic() - t0, 1e-6))
+            return resp
+        finally:
+            self.core.finish(idx)
+
+    def _disagg_completions(self, request: Dict, decode_idx: int) -> Dict:
+        t0 = time.monotonic()
+        result = prefill_with_retry(self.prefill_replicas, request,
+                                    self._handoff_addr(decode_idx))
+        if not result.get("handoff"):
+            return result["response"]  # finished at prefill
+        prompt = request.get("prompt", [])
+        n = len(prompt.encode() if isinstance(prompt, str) else prompt)
+        self.core.observe_prefill(n, max(time.monotonic() - t0, 1e-6))
+        return self.replicas[decode_idx].call(
+            "completions_collect", result["rid"])
+
+    def router_stats(self) -> Dict:
+        self._ensure_replicas()
+        return {
+            "replicas": len(self.replicas),
+            "prefill_replicas": len(self.prefill_replicas),
+            "affinity_hits": self.core.affinity_hits,
+            "affinity_misses": self.core.affinity_misses,
+            "shed_count": self.core.shed_count,
+        }

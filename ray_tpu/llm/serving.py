@@ -15,6 +15,7 @@ vLLM (vllm_models.py:117-168).
 from __future__ import annotations
 
 import dataclasses
+import os
 import queue
 import threading
 import time
@@ -22,6 +23,11 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from ray_tpu import serve
+
+
+class RequestTimeoutError(TimeoutError):
+    """No engine output arrived within LLMConfig.stream_timeout_s. The
+    request has been aborted and its KV pages released — retrying is safe."""
 
 
 @dataclasses.dataclass
@@ -58,65 +64,118 @@ class LLMConfig:
     # sequential-traffic set (fast startup, batched-prefill shapes still
     # compile on first hit); "off" = lazy. True/False alias full/off.
     warmup_buckets: Any = "full"
+    # Serving-plane knobs (llm/router.py, llm/disagg.py). routing="affinity"
+    # fronts the replica fleet with the prefix-cache-affinity router
+    # deployment; slo_ttft_s > 0 arms its admission gate (projected TTFT
+    # above the SLO -> shed with a 429-shaped error instead of queueing
+    # unboundedly); disaggregate=N runs N dedicated prefill replicas that
+    # stream populated KV pages to the decode replicas over the zero-pickle
+    # handoff wire (llm/disagg.py).
+    routing: str = "pow2"               # "pow2" | "affinity"
+    slo_ttft_s: float = 0.0
+    disaggregate: int = 0
+    handoff_host: str = "127.0.0.1"
+    # How long completions/streams wait for the next engine output before
+    # aborting the request (the abandoned-request guard).
+    stream_timeout_s: float = 300.0
+
+
+def build_engine(llm_config: LLMConfig, prefill_only: bool = False):
+    """Construct a ready LLMEngine per config. Shared by decode replicas
+    (LLMServer) and the prefill tier (disagg.PrefillServer)."""
+    import jax
+
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.model_runner import ModelRunner
+    from ray_tpu.models import llama
+
+    config = llm_config.model_config or llama.LlamaConfig.tiny()
+    if llm_config.params_checkpoint:
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        params = Checkpoint(llm_config.params_checkpoint).load_pytree()
+    else:
+        params = llama.init_params(config, jax.random.key(llm_config.seed))
+    mesh = None
+    if llm_config.tensor_parallel > 1:
+        from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+
+        mesh = build_mesh(
+            MeshConfig(tp=llm_config.tensor_parallel),
+            devices=jax.devices()[:llm_config.tensor_parallel])
+    lora_manager = None
+    if llm_config.lora_adapters:
+        from ray_tpu.llm.lora import LoRAManager
+
+        lora_manager = LoRAManager(config, n_slots=llm_config.max_loras,
+                                   rank=llm_config.lora_rank)
+        for adapter in llm_config.lora_adapters:
+            lora_manager.load_adapter(adapter)
+    runner = ModelRunner(config, params,
+                         num_blocks=llm_config.num_kv_blocks,
+                         block_size=llm_config.block_size,
+                         chunk_size=llm_config.prefill_chunk,
+                         mesh=mesh, lora_manager=lora_manager)
+    engine = LLMEngine(
+        runner, max_batch_size=llm_config.max_batch_size,
+        tokenizer=llm_config.tokenizer,
+        prefill_chunk=llm_config.prefill_chunk,
+        enable_prefix_caching=llm_config.enable_prefix_caching,
+        speculative_ngram=llm_config.speculative_ngram,
+        decode_multi_step=llm_config.decode_multi_step,
+        prefill_only=prefill_only)
+    wm = llm_config.warmup_buckets
+    wm = {True: "full", False: "off"}.get(wm, wm)
+    if wm not in ("off", "light", "full"):
+        raise ValueError(f"warmup_buckets: {wm!r} not off/light/full")
+    if wm != "off":
+        engine.warmup(full=wm == "full")
+    return engine
 
 
 class LLMServer:
     """The replica callable: owns one engine instance + its step loop."""
 
     def __init__(self, llm_config: LLMConfig):
-        import jax
-
-        from ray_tpu.llm.engine import LLMEngine
-        from ray_tpu.llm.model_runner import ModelRunner
-        from ray_tpu.models import llama
-
-        config = llm_config.model_config or llama.LlamaConfig.tiny()
-        if llm_config.params_checkpoint:
-            from ray_tpu.train.checkpoint import Checkpoint
-
-            params = Checkpoint(llm_config.params_checkpoint).load_pytree()
-        else:
-            params = llama.init_params(config, jax.random.key(llm_config.seed))
-        mesh = None
-        if llm_config.tensor_parallel > 1:
-            from ray_tpu.parallel.mesh import MeshConfig, build_mesh
-
-            mesh = build_mesh(
-                MeshConfig(tp=llm_config.tensor_parallel),
-                devices=jax.devices()[:llm_config.tensor_parallel])
-        lora_manager = None
-        if llm_config.lora_adapters:
-            from ray_tpu.llm.lora import LoRAManager
-
-            lora_manager = LoRAManager(config, n_slots=llm_config.max_loras,
-                                       rank=llm_config.lora_rank)
-            for adapter in llm_config.lora_adapters:
-                lora_manager.load_adapter(adapter)
-        runner = ModelRunner(config, params,
-                             num_blocks=llm_config.num_kv_blocks,
-                             block_size=llm_config.block_size,
-                             chunk_size=llm_config.prefill_chunk,
-                             mesh=mesh, lora_manager=lora_manager)
-        self.engine = LLMEngine(
-            runner, max_batch_size=llm_config.max_batch_size,
-            tokenizer=llm_config.tokenizer,
-            prefill_chunk=llm_config.prefill_chunk,
-            enable_prefix_caching=llm_config.enable_prefix_caching,
-            speculative_ngram=llm_config.speculative_ngram,
-            decode_multi_step=llm_config.decode_multi_step)
-        wm = llm_config.warmup_buckets
-        wm = {True: "full", False: "off"}.get(wm, wm)
-        if wm not in ("off", "light", "full"):
-            raise ValueError(f"warmup_buckets: {wm!r} not off/light/full")
-        if wm != "off":
-            self.engine.warmup(full=wm == "full")
+        self.engine = build_engine(llm_config)
+        self.config = llm_config
         self.tokenizer = llm_config.tokenizer
+        self._timeout_s = llm_config.stream_timeout_s
+        self._replica_tag = f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
         self._lock = threading.Lock()
         # request_id -> per-request event queue; the engine loop fans
         # RequestOutputs out to these (token-at-a-time streaming).
         self._streams: Dict[str, queue.Queue] = {}
+        # Decode-throughput EWMA published via engine_stats()/gauges.
+        self._tokens_per_s = 0.0
+        self._tok_count = 0
+        self._tok_t0 = time.monotonic()
+        self._gauges = self._bind_gauges()
+        # KV handoff listener: in disaggregated mode prefill replicas
+        # stream populated pages here (llm/disagg.py wire).
+        self._handoff = None
+        if llm_config.disaggregate > 0:
+            from ray_tpu.llm.disagg import KVStreamServer
+
+            self._handoff = KVStreamServer(self._adopt_handoff,
+                                           host=llm_config.handoff_host)
         self._loop = threading.Thread(target=self._engine_loop, daemon=True)
         self._loop.start()
+
+    def _bind_gauges(self):
+        from ray_tpu.runtime import metric_defs as md
+
+        tags = {"replica": self._replica_tag}
+        return {
+            "running": md.LLM_RUNNING.bind(tags),
+            "waiting": md.LLM_WAITING.bind(tags),
+            "prefilling": md.LLM_PREFILLING.bind(tags),
+            "free_kv_blocks": md.LLM_KV_FREE_BLOCKS.bind(tags),
+            "total_kv_blocks": md.LLM_KV_TOTAL_BLOCKS.bind(tags),
+            "prefix_hits": md.LLM_PREFIX_HITS.bind(tags),
+            "prefix_tokens_saved": md.LLM_PREFIX_TOKENS_SAVED.bind(tags),
+            "tokens_per_s": md.LLM_TOKENS_PER_S.bind(tags),
+        }
 
     # ---- engine loop -----------------------------------------------------
 
@@ -161,9 +220,22 @@ class LLMServer:
                     q.put(e)
                 continue
             for out in outs:
+                self._tok_count += len(out.new_token_ids)
                 q = self._streams.get(out.request_id)
                 if q is not None:
                     q.put(out)
+            now = time.monotonic()
+            if now - self._tok_t0 >= 1.0:
+                rate = self._tok_count / (now - self._tok_t0)
+                self._tokens_per_s = (rate if self._tokens_per_s == 0.0
+                                      else 0.7 * self._tokens_per_s
+                                      + 0.3 * rate)
+                self._tok_count = 0
+                self._tok_t0 = now
+                try:
+                    self._publish_gauges()
+                except Exception:
+                    pass
             if not busy:
                 time.sleep(0.005)
 
@@ -197,6 +269,68 @@ class LLMServer:
             seed=request.get("seed"))
         return prompt, params, request.get("lora_name")
 
+    def _abort(self, rid: str) -> bool:
+        """Stop decoding for a dead consumer and free its KV pages."""
+        with self._lock:
+            aborted = self.engine.abort_request(rid)
+        self._streams.pop(rid, None)
+        return aborted
+
+    # ---- stats / observability ------------------------------------------
+
+    def engine_stats(self) -> Dict:
+        """Per-replica load signal the router's pow2/admission logic
+        consumes; also pushes the same numbers to the bound gauges."""
+        with self._lock:
+            s = self.engine.stats()
+        s["tokens_per_s"] = round(self._tokens_per_s, 1)
+        s["replica"] = self._replica_tag
+        if self._handoff is not None:
+            s["handoff_address"] = list(self._handoff.address)
+            s["handoffs_adopted"] = self._handoff.handoffs_adopted
+            s["handoffs_rejected"] = self._handoff.handoffs_rejected
+        try:
+            self._publish_gauges(s)
+        except Exception:
+            pass
+        return s
+
+    def _publish_gauges(self, s: Optional[Dict] = None):
+        if s is None:
+            with self._lock:
+                s = self.engine.stats()
+            s["tokens_per_s"] = round(self._tokens_per_s, 1)
+        g = self._gauges
+        g["running"].set(s["running"])
+        g["waiting"].set(s["waiting"])
+        g["prefilling"].set(s["prefilling"])
+        g["free_kv_blocks"].set(s["free_kv_blocks"])
+        g["total_kv_blocks"].set(s["total_kv_blocks"])
+        g["prefix_hits"].set(s["prefix_hits"])
+        g["prefix_tokens_saved"].set(s["prefix_tokens_saved"])
+        g["tokens_per_s"].set(s["tokens_per_s"])
+
+    # ---- KV handoff (disaggregated prefill) ------------------------------
+
+    def handoff_address(self) -> List:
+        if self._handoff is None:
+            raise ValueError("replica built without disaggregate > 0")
+        return list(self._handoff.address)
+
+    def _adopt_handoff(self, state: Dict, k_pages, v_pages) -> bool:
+        # The stream queue must exist BEFORE the request can start decoding
+        # (the engine loop drops outputs with no queue), and the ack goes
+        # back only after adopt_request returns — so by the time the router
+        # calls completions_collect, both are in place.
+        rid = state["id"]
+        q: queue.Queue = queue.Queue()
+        self._streams[rid] = q
+        with self._lock:
+            ok = self.engine.adopt_request(state, k_pages, v_pages)
+        if not ok:
+            self._streams.pop(rid, None)
+        return ok
+
     # ---- LoRA management (multiplex) ------------------------------------
 
     def load_lora_adapter(self, adapter) -> Dict:
@@ -222,29 +356,38 @@ class LLMServer:
         "temperature", "top_k", "top_p", "stop_token_ids"}."""
         prompt, params, lora_name = self._parse(request)
         rid = self._submit(prompt, params, lora_name)
+        return self._collect(rid)
+
+    def completions_collect(self, request_id: str) -> Dict:
+        """Wait out an already-submitted request (the router calls this on
+        the decode replica after a prefill handoff was adopted)."""
+        if request_id not in self._streams:
+            raise KeyError(f"unknown request {request_id!r} "
+                           "(handoff not adopted here?)")
+        return self._collect(request_id)
+
+    def _collect(self, rid: str) -> Dict:
+        from ray_tpu.llm.disagg import _completion_response
+
         q = self._streams[rid]
         try:
             while True:
-                out = q.get(timeout=300)
+                try:
+                    out = q.get(timeout=self._timeout_s)
+                except queue.Empty:
+                    # Consumer still here but the engine went silent, or the
+                    # client's deadline passed: stop burning KV blocks.
+                    self._abort(rid)
+                    raise RequestTimeoutError(
+                        f"request {rid}: no engine output within "
+                        f"{self._timeout_s}s; request aborted") from None
                 if isinstance(out, Exception):
                     raise out
                 if out.finished:
                     break
         finally:
             self._streams.pop(rid, None)
-        return {
-            "id": out.request_id,
-            "object": "text_completion",
-            "choices": [{
-                "text": out.text,
-                "token_ids": out.output_token_ids,
-                "finish_reason": out.finish_reason,
-            }],
-            "usage": {
-                "prompt_tokens": len(out.prompt_token_ids),
-                "completion_tokens": len(out.output_token_ids),
-            },
-        }
+        return _completion_response(out)
 
     def completions_stream(self, request: Dict):
         """Streaming completions: a generator of OpenAI-style chunk events,
@@ -253,15 +396,22 @@ class LLMServer:
         prompt, params, lora_name = self._parse(request)
         rid = self._submit(prompt, params, lora_name)
         q = self._streams[rid]
+        finished = False
         try:
             while True:
-                out = q.get(timeout=300)
+                try:
+                    out = q.get(timeout=self._timeout_s)
+                except queue.Empty:
+                    raise RequestTimeoutError(
+                        f"request {rid}: no engine output within "
+                        f"{self._timeout_s}s; request aborted") from None
                 if isinstance(out, Exception):
                     raise out
                 for t in out.new_token_ids:
                     yield {"id": rid, "object": "text_completion.chunk",
                            "token": int(t), "finished": False}
                 if out.finished:
+                    finished = True
                     yield {"id": rid, "object": "text_completion.chunk",
                            "token": None, "finished": True,
                            "finish_reason": out.finish_reason,
@@ -269,6 +419,12 @@ class LLMServer:
                            "token_ids": out.output_token_ids}
                     return
         finally:
+            # Runs on timeout, engine error, AND consumer disappearance
+            # (GeneratorExit via _StreamingResponse.__del__): an unfinished
+            # request must not keep decoding to max_tokens for a dead
+            # stream — abort it and free its pages.
+            if not finished:
+                self._abort(rid)
             self._streams.pop(rid, None)
 
 
@@ -280,8 +436,38 @@ def build_llm_deployment(llm_config: LLMConfig, name: str = "llm") -> Any:
     return dep.bind(llm_config)
 
 
+def build_routed_app(llm_config: LLMConfig, name: str = "v1-completions",
+                     *, http: bool = True):
+    """Deploys the full serving plane: `{name}-engine` (decode or colocated
+    replicas), optionally `{name}-prefill` (disaggregate > 0), and the
+    `{name}` router deployment fronting them. Returns the router handle."""
+    from ray_tpu.llm.disagg import PrefillServer
+    from ray_tpu.llm.router import LLMRouter
+
+    tiers = [build_llm_deployment(llm_config, f"{name}-engine")]
+    prefill_name = None
+    if llm_config.disaggregate > 0:
+        prefill_name = f"{name}-prefill"
+        tiers.append(serve.deployment(PrefillServer).options(
+            name=prefill_name, num_replicas=llm_config.disaggregate,
+            num_tpus=llm_config.num_tpus_per_replica,
+            max_ongoing_requests=llm_config.max_batch_size,
+        ).bind(llm_config))
+    # Tiers first: the router resolves their replica handles lazily on the
+    # first request, and they must already be deployed by then.
+    serve.run(tiers)
+    router = serve.deployment(LLMRouter).options(
+        name=name, num_replicas=1,
+        max_ongoing_requests=8 * llm_config.max_batch_size,
+    ).bind(llm_config, f"{name}-engine", prefill_name)
+    return serve.run(router, http=http)
+
+
 def build_openai_app(llm_config: LLMConfig, name: str = "v1-completions"):
     """Deploys the engine and the HTTP ingress; POST /{name} serves
-    completions."""
+    completions. With routing="affinity" or disaggregate > 0 the app gets
+    the router front (build_routed_app) instead of a bare replica fleet."""
+    if llm_config.routing == "affinity" or llm_config.disaggregate > 0:
+        return build_routed_app(llm_config, name)
     handle = serve.run(build_llm_deployment(llm_config, name), http=True)
     return handle

@@ -22,6 +22,9 @@ Emitters in-tree:
   * GCS        — TASK_STALLED (wait-graph edge blocked past the stall
                  threshold), DEADLOCK_DETECTED (cycle in the cluster
                  wait-graph) — emitted by the stall detector tick
+  * llm router — LLM_REQUEST_SHED (SLO admission rejected a request;
+                 labels carry the projected TTFT vs the SLO so
+                 `scripts events` explains shedding during incidents)
 
 Read back via `state.list_cluster_events()`, the dashboard
 `/api/events` route, or `python -m ray_tpu.scripts events`.
@@ -49,9 +52,10 @@ AUTOSCALER_SCALE = "AUTOSCALER_SCALE"
 TRAIN_GANG_RESTART = "TRAIN_GANG_RESTART"
 TASK_STALLED = "TASK_STALLED"
 DEADLOCK_DETECTED = "DEADLOCK_DETECTED"
+LLM_REQUEST_SHED = "LLM_REQUEST_SHED"
 EVENT_TYPES = (NODE_DEAD, SLICE_LOST, OOM_KILL, COLLECTIVE_ABORT,
                AUTOSCALER_SCALE, TRAIN_GANG_RESTART, TASK_STALLED,
-               DEADLOCK_DETECTED)
+               DEADLOCK_DETECTED, LLM_REQUEST_SHED)
 
 
 def make_event(event_type: str, message: str, *,

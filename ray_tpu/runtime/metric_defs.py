@@ -108,6 +108,48 @@ SERVE_REQUESTS = Counter(
 LLM_TOKENS_GENERATED = Counter(
     "ray_tpu_llm_tokens_generated_total", "tokens sampled by LLM engines")
 
+# Per-replica engine depth + KV occupancy: the same numbers
+# LLMServer.engine_stats() feeds the router's pow2/admission logic, pushed
+# as gauges so dashboards see what the router sees.
+LLM_RUNNING = Gauge(
+    "ray_tpu_llm_running", "requests in decode on this replica",
+    tag_keys=("replica",))
+LLM_WAITING = Gauge(
+    "ray_tpu_llm_waiting", "requests queued before prefill on this replica",
+    tag_keys=("replica",))
+LLM_PREFILLING = Gauge(
+    "ray_tpu_llm_prefilling", "requests mid-chunked-prefill on this replica",
+    tag_keys=("replica",))
+LLM_KV_FREE_BLOCKS = Gauge(
+    "ray_tpu_llm_kv_free_blocks", "free KV cache pages on this replica",
+    tag_keys=("replica",))
+LLM_KV_TOTAL_BLOCKS = Gauge(
+    "ray_tpu_llm_kv_total_blocks", "total KV cache pages on this replica",
+    tag_keys=("replica",))
+LLM_PREFIX_HITS = Gauge(
+    "ray_tpu_llm_prefix_hits", "prefix-cache block hits (cumulative)",
+    tag_keys=("replica",))
+LLM_PREFIX_TOKENS_SAVED = Gauge(
+    "ray_tpu_llm_prefix_tokens_saved",
+    "prompt tokens skipped via prefix cache (cumulative)",
+    tag_keys=("replica",))
+LLM_TOKENS_PER_S = Gauge(
+    "ray_tpu_llm_tokens_per_s", "decode throughput EWMA on this replica",
+    tag_keys=("replica",))
+
+# Router plane (llm/router.py) + disaggregated KV handoffs (llm/disagg.py).
+LLM_ROUTER_SHED = Counter(
+    "ray_tpu_llm_router_shed_total",
+    "requests shed by SLO admission (projected TTFT over the SLO)",
+    tag_keys=("deployment",))
+LLM_ROUTER_AFFINITY = Counter(
+    "ray_tpu_llm_router_affinity_total",
+    "router picks by prefix/session-affinity outcome",
+    tag_keys=("outcome",))                       # hit | miss
+LLM_KV_HANDOFFS = Counter(
+    "ray_tpu_llm_kv_handoffs_total",
+    "prefill->decode KV page handoffs adopted")
+
 
 ALL_METRICS = [v for v in list(globals().values())
                if isinstance(v, (Counter, Gauge, Histogram))]

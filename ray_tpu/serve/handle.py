@@ -143,6 +143,19 @@ class DeploymentHandle:
         return (time.monotonic() - self._last_refresh
                 > self.REFRESH_INTERVAL_S)
 
+    def replica_handles(self) -> List:
+        """The current replica actor handles (refresh-if-stale). For
+        routers that do their own replica selection (llm/router.py) instead
+        of this handle's blind power-of-two: call
+        `replica.handle_request.remote(method, args, kwargs)` directly."""
+        if self._needs_refresh():
+            try:
+                self._refresh()
+            except Exception:
+                if not self._replicas:
+                    raise
+        return list(self._replicas)
+
     def _pick_replica(self) -> int:
         n = len(self._replicas)
         if n == 0:

@@ -282,6 +282,48 @@ def summary() -> Dict:
             s.get("object_store_capacity", 0) for s in stats)
         out["spilled_bytes"] = sum(
             s.get("spilled_bytes", 0) for s in stats)
+    try:
+        llm = llm_serving_summary()
+        if llm:
+            out["llm_serving"] = llm
+    except Exception:
+        pass  # no metrics plane / no LLM replicas: leave the key out
+    return out
+
+
+def llm_serving_summary() -> Dict:
+    """Fleet-wide LLM serving rollup from each replica's pushed gauges
+    (the same engine_stats() numbers the router consumes)."""
+    import json
+
+    snapshots = []
+    for key in _gcs_call("kv_keys", prefix=b"metrics:")["keys"]:
+        reply = _gcs_call("kv_get", key=key)
+        if reply.get("value"):
+            snapshots.append(json.loads(reply["value"]))
+    return _aggregate_llm_metrics(snapshots)
+
+
+def _aggregate_llm_metrics(snapshots: List[List[dict]]) -> Dict:
+    """Pure rollup over per-process metric snapshots (util/metrics.py
+    snapshot_all() lists): sums every ray_tpu_llm_* gauge series across
+    replicas and counts the distinct replica tags seen."""
+    sums: Dict[str, float] = {}
+    replicas = set()
+    for snap in snapshots:
+        for metric in snap:
+            name = metric.get("name", "")
+            if not name.startswith("ray_tpu_llm_"):
+                continue
+            short = name[len("ray_tpu_llm_"):]
+            for tag_key, value in metric.get("values", {}).items():
+                if "replica" in tag_key:
+                    replicas.add(tag_key)
+                sums[short] = sums.get(short, 0.0) + value
+    if not sums:
+        return {}
+    out = {k: round(v, 1) for k, v in sums.items()}
+    out["replicas_reporting"] = len(replicas)
     return out
 
 

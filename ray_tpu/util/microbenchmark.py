@@ -150,6 +150,9 @@ def run(scale: float = 1.0, num_cpus: int = 4) -> List[Dict]:
 
         # -- out-of-graph collectives: ring vs hub -----------------------
         results.extend(_bench_collectives(scale))
+
+        # -- LLM serving plane: router affinity + disaggregation ---------
+        results.extend(_bench_serve_mixed(scale))
     finally:
         if owns_cluster:
             ray_tpu.shutdown()
@@ -417,6 +420,193 @@ def _bench_collectives(scale: float) -> List[Dict]:
     finally:
         for c in comms:
             c.close()
+    return out
+
+
+def _bench_serve_mixed(scale: float) -> List[Dict]:
+    """LLM serving plane (llm/router.py + llm/disagg.py), in-process — two
+    tiny fp32 engines on CPU, no serve actors in the loop, so the legs
+    isolate routing policy and prefill placement rather than RPC cost.
+
+      * serve_mixed_*_{affinity,random} — a shared-system-prompt workload
+        (6 distinct 33-token prefixes, repeated) routed by RouterCore
+        prefix affinity vs uniform random over 2 replicas: p99 TTFT,
+        aggregate tokens/s, and prefix tokens saved (the hit-rate signal).
+      * serve_{colocated,disagg}_itl_p99_ms — a chatty stream's p99
+        inter-token gap while long prompts continuously arrive: colocated
+        (prefill chunks interleave with the chatty decode on one replica)
+        vs disaggregated (a PrefillServer runs the long prefills and
+        streams KV pages over the handoff wire; decode only decodes).
+    """
+    import random as _random
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.llm.disagg import PrefillServer
+    from ray_tpu.llm.router import RouterCore
+    from ray_tpu.llm.sampling import SamplingParams
+    from ray_tpu.llm.serving import LLMConfig, LLMServer, build_engine
+    from ray_tpu.models import llama
+
+    out: List[Dict] = []
+    config = llama.LlamaConfig.tiny(vocab_size=128, max_seq=256,
+                                    dtype=jnp.float32)
+
+    def cfg(**kw):
+        base = dict(model_config=config, num_kv_blocks=128, block_size=8,
+                    max_batch_size=4, prefill_chunk=8, warmup_buckets="off")
+        base.update(kw)
+        return LLMConfig(**base)
+
+    # ---- router: prefix affinity vs random over 2 replicas -------------
+    sys_prompts = [[(s * 11 + 5 * i + 2) % 128 for i in range(65)]
+                   for s in range(6)]
+    reps = max(2, int(3 * scale))
+    order = [sys_prompts[i % 6] for i in range(6 * reps)]
+
+    def drive(eng, prompt, max_tokens=8):
+        t0 = time.perf_counter()
+        eng.add_request(prompt, SamplingParams(max_tokens=max_tokens))
+        ttft, n = None, 0
+        while eng.has_unfinished():
+            for o in eng.step():
+                if o.new_token_ids and ttft is None:
+                    ttft = time.perf_counter() - t0
+                n += len(o.new_token_ids)
+        return ttft if ttft is not None else time.perf_counter() - t0, n
+
+    for mode in ("affinity", "random"):
+        # Best of 2 trials (fresh engines + router state each): tokens/s on
+        # a small shared box swings ~20% on scheduler noise, while the
+        # prefix-savings number is deterministic per policy.
+        best_tps, best_ttft, saved, total_tokens = 0.0, float("inf"), 0, 0
+        for _ in range(2):
+            engines = [build_engine(cfg()) for _ in range(2)]
+            for e in engines:  # pay first-hit XLA compiles outside timing
+                drive(e, [(3 * i + 1) % 128 for i in range(33)])
+            core = RouterCore(2, block_size=8)
+            rng = _random.Random(0)
+            ttfts: List[float] = []
+            total_tokens = 0
+            t0 = time.perf_counter()
+            for p in order:
+                idx = (core.pick(p)[0] if mode == "affinity"
+                       else rng.randrange(2))
+                ttft, n = drive(engines[idx], p)
+                ttfts.append(ttft)
+                total_tokens += n
+            elapsed = time.perf_counter() - t0
+            best_tps = max(best_tps, total_tokens / elapsed)
+            best_ttft = min(best_ttft, float(np.percentile(ttfts, 99)))
+            saved = sum(e.block_manager.prefix_tokens_saved for e in engines)
+        out.append({"benchmark": f"serve_mixed_ttft_p99_ms_{mode}",
+                    "value": round(best_ttft * 1e3, 2),
+                    "unit": "ms", "n": len(order), "trials": 2})
+        out.append({"benchmark": f"serve_mixed_tokens_per_s_{mode}",
+                    "value": round(best_tps, 1),
+                    "unit": "tokens/s", "n": total_tokens, "trials": 2})
+        out.append({"benchmark": f"serve_mixed_prefix_tokens_saved_{mode}",
+                    "value": saved, "unit": "tokens", "n": len(order)})
+
+    # ---- disaggregation: chatty inter-token latency under long-prompt
+    # pressure. Each long prompt is unique (a shared prefix would let the
+    # prefix cache hide the very prefill cost the leg measures).
+    chatty_tokens = max(40, int(120 * scale))
+    long_seq = [0]
+
+    def next_long():
+        long_seq[0] += 1
+        j = long_seq[0]
+        return [(13 * i + 7 * j + j * j) % 128 for i in range(225)]
+
+    def chatty_gaps(server, submit_long):
+        stop = threading.Event()
+
+        def pressure():
+            while not stop.is_set():
+                try:
+                    submit_long()
+                except Exception:
+                    return
+
+        # Two pressure threads keep a long prefill in flight continuously —
+        # a lone thread leaves idle windows between requests that let the
+        # colocated leg decode unimpeded and corrupt the comparison.
+        ts = [threading.Thread(target=pressure, daemon=True)
+              for _ in range(2)]
+        gen = server.completions_stream(
+            {"prompt": [3, 1, 4, 1, 5], "max_tokens": chatty_tokens})
+        next(gen)                  # chatty decoding before pressure starts
+        for t in ts:
+            t.start()
+        gaps, last = [], time.perf_counter()
+        for chunk in gen:
+            now = time.perf_counter()
+            if chunk.get("token") is not None:
+                gaps.append(now - last)
+                last = now
+        stop.set()
+        for t in ts:
+            t.join(60)
+        return gaps
+
+    # One-shot 225-token prefill chunks: the regime disaggregation targets
+    # is an expensive chunk stalling the decode batch (big models / long
+    # prompts); chunk=8 on the tiny model makes a chunk as cheap as a
+    # decode step and measures nothing.
+    colo = LLMServer(cfg(prefill_chunk=256))
+    decode = LLMServer(cfg(prefill_chunk=256, disaggregate=1))
+    addr = decode.handoff_address()
+
+    # The prefill tier runs on its own hardware in production; on this
+    # shared bench box, running its compute concurrently would bill the
+    # decode leg for the very work disaggregation moves off-replica. So
+    # prefill the long prompts UNTIMED and have the pressure thread replay
+    # the captured handoffs over the real wire — socket receive, page
+    # adoption, and the adopted requests' decode ARE the decode replica's
+    # steady-state costs, and they stay in the timed window.
+    from ray_tpu.llm.disagg import send_handoff
+
+    peng = build_engine(cfg(prefill_chunk=256), prefill_only=True)
+
+    def capture_handoffs(n):
+        pre = []
+        for _ in range(n):
+            rid = peng.add_request(next_long(), SamplingParams(max_tokens=2))
+            while not any(o.request_id == rid for o in peng.step()):
+                pass
+            state = peng.export_request(rid)
+            blocks = state.pop("blocks")
+            k, v = peng.runner.gather_pages(blocks)
+            peng.block_manager.release_blocks(blocks)
+            pre.append((state, k, v))
+        return pre
+
+    def replay_handoff(pre):
+        state, k, v = pre.pop()   # IndexError when drained ends the thread
+        send_handoff(addr, state, k, v)
+        decode.completions_collect(state["id"])
+
+    legs = (("colocated", colo,
+             lambda _pre: colo.completions(
+                 {"prompt": next_long(), "max_tokens": 2}),
+             lambda: None),
+            ("disagg", decode, replay_handoff,
+             lambda: capture_handoffs(80)))
+    # Best of 2 trials per leg: a descheduling blip in the pressure thread
+    # on a small box corrupts the tail the leg exists to compare.
+    for name, server, submit_long, setup in legs:
+        best, n = float("inf"), 0
+        for _ in range(2):
+            pre = setup()
+            gaps = chatty_gaps(server, lambda: submit_long(pre))
+            n = len(gaps)
+            best = min(best, float(np.percentile(gaps, 99)))
+        out.append({"benchmark": f"serve_{name}_itl_p99_ms",
+                    "value": round(best * 1e3, 2),
+                    "unit": "ms", "n": n, "trials": 2})
     return out
 
 

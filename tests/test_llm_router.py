@@ -1,0 +1,329 @@
+"""LLM serving plane: prefix-affinity router + disaggregated prefill/decode.
+
+Covers the router/disagg subsystem (llm/router.py, llm/disagg.py) against
+in-process engines — no cluster: RouterCore is cluster-free by design and
+LocalReplica honors RpcChaos, so affinity, shedding, handoff atomicity, and
+prefill-retry all run at unit-test cost. The one full-stack routed-app test
+lives behind the `slow` marker.
+"""
+
+import queue
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu  # noqa: F401
+
+
+def _tiny(vocab=128, max_seq=64):
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    return llama.LlamaConfig.tiny(vocab_size=vocab, max_seq=max_seq,
+                                  dtype=jnp.float32)
+
+
+def _cfg(config, **kw):
+    from ray_tpu.llm.serving import LLMConfig
+
+    base = dict(model_config=config, num_kv_blocks=64, block_size=8,
+                max_batch_size=4, prefill_chunk=8, warmup_buckets="off",
+                stream_timeout_s=30.0)
+    base.update(kw)
+    return LLMConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def setup(cpu_jax):
+    return _tiny()
+
+
+def _prompt(seed, n=17, vocab=128):
+    return [(seed * 7 + 3 * i + seed) % vocab for i in range(n)]
+
+
+# ---- routing core ----------------------------------------------------------
+
+
+def test_affinity_beats_round_robin_on_hit_rate(setup):
+    """Shared prompts routed with prefix affinity land on the replica that
+    already cached their blocks; round-robin scatters them. Measured by the
+    engines' own prefix_tokens_saved counters."""
+    from ray_tpu.llm.router import RouterCore
+    from ray_tpu.llm.serving import build_engine
+
+    def run(pick):
+        engines = [build_engine(_cfg(setup)) for _ in range(2)]
+        prompts = [_prompt(s) for s in (1, 2, 3)]
+        order = [prompts[i % 3] for i in range(12)]  # p1,p2,p3,p1,...
+        for n, p in enumerate(order):
+            eng = engines[pick(n, p)]
+            from ray_tpu.llm.sampling import SamplingParams
+
+            eng.add_request(p, SamplingParams(max_tokens=2))
+            while eng.has_unfinished():
+                eng.step()
+        return sum(e.block_manager.prefix_tokens_saved for e in engines)
+
+    core = RouterCore(2, block_size=8)
+    decisions = []
+
+    def affinity_pick(n, p):
+        idx, d = core.pick(p)
+        decisions.append(d["reason"])
+        return idx
+
+    saved_affinity = run(affinity_pick)
+    saved_rr = run(lambda n, p: n % 2)
+    # 3 distinct prompts x 4 occurrences: affinity reuses every repeat on
+    # one replica; round-robin alternates so half the repeats land cold.
+    assert saved_affinity > saved_rr
+    assert decisions[:3] == ["pow2", "pow2", "pow2"]  # first sight: no owner
+    assert set(decisions[3:]) == {"prefix"}           # every repeat: affinity
+    assert core.affinity_hits == 9 and core.affinity_misses == 3
+
+
+def test_session_affinity_and_overload_fallback(setup):
+    from ray_tpu.llm.router import RouterCore
+
+    core = RouterCore(2, block_size=8)
+    p = _prompt(5)
+    idx, d = core.pick(p, session_id="sess-1")
+    idx2, d2 = core.pick(_prompt(6), session_id="sess-1")
+    assert idx2 == idx and d2["reason"] == "session"
+    # Owner drowning in queued work: affinity must yield to load.
+    heavy = [{"waiting": 0, "prefilling": 0, "running": 0,
+              "free_kv_blocks": 64, "total_kv_blocks": 64} for _ in range(2)]
+    heavy[idx]["waiting"] = 50
+    idx3, d3 = core.pick(_prompt(7), session_id="sess-1", stats=heavy)
+    assert idx3 != idx and d3["reason"] == "pow2"
+
+
+def test_shed_triggers_and_recovers():
+    """Projected TTFT above the SLO sheds; a drained queue admits again.
+    The shed event rides the typed event bus (buildable without a GCS)."""
+    from ray_tpu.llm.router import RouterCore
+    from ray_tpu.runtime import events
+
+    core = RouterCore(1, block_size=8, slo_ttft_s=0.5, prefill_tps=1000.0)
+    overloaded = [{"queued_prefill_tokens": 5000}]
+    ok, projected = core.admit(0, 100, overloaded)
+    assert not ok and projected > 0.5
+    assert core.shed_count == 1
+    ev = events.emit(events.LLM_REQUEST_SHED,
+                     f"projected {projected:.2f}s > 0.5s",
+                     severity=events.WARNING, source="llm-router",
+                     labels={"projected_ttft_s": f"{projected:.3f}"})
+    assert ev["type"] == "LLM_REQUEST_SHED"
+    drained = [{"queued_prefill_tokens": 0}]
+    ok2, projected2 = core.admit(0, 100, drained)
+    assert ok2 and projected2 <= 0.5
+    # No throughput signal yet -> never shed blind.
+    blind = RouterCore(1, slo_ttft_s=0.5)
+    assert blind.admit(0, 10 ** 6, overloaded) == (True, 0.0)
+
+
+def test_aggregate_llm_metrics_rollup():
+    from ray_tpu.state.api import _aggregate_llm_metrics
+
+    snapshots = [
+        [{"name": "ray_tpu_llm_running", "type": "gauge",
+          "values": {'[["replica", "a"]]': 3.0}},
+         {"name": "ray_tpu_tasks_submitted_total", "type": "counter",
+          "values": {"[]": 99.0}}],
+        [{"name": "ray_tpu_llm_running", "type": "gauge",
+          "values": {'[["replica", "b"]]': 2.0}},
+         {"name": "ray_tpu_llm_tokens_per_s", "type": "gauge",
+          "values": {'[["replica", "b"]]': 40.5}}],
+    ]
+    out = _aggregate_llm_metrics(snapshots)
+    assert out["running"] == 5.0
+    assert out["tokens_per_s"] == 40.5
+    assert out["replicas_reporting"] == 2
+    assert "tasks_submitted_total" not in out
+    assert _aggregate_llm_metrics([]) == {}
+
+
+# ---- disaggregated prefill/decode ------------------------------------------
+
+
+def test_disagg_bit_identical_and_zero_pickle(setup):
+    """The acceptance pin: prefill->KV-stream->decode produces the exact
+    token sequence single-replica serving produces (greedy AND seeded
+    sampling), and the handoff moves pages with zero pickled bytes (same
+    counter style as test_ring_zero_pickle_steady_state)."""
+    from ray_tpu.core import serialization as ser
+    from ray_tpu.llm.disagg import PrefillServer
+    from ray_tpu.llm.serving import LLMServer
+
+    decode = LLMServer(_cfg(setup, disaggregate=1))
+    prefill = PrefillServer(_cfg(setup))
+    single = LLMServer(_cfg(setup))
+    addr = decode.handoff_address()
+    for req in ({"prompt": _prompt(1, 21), "max_tokens": 8},
+                {"prompt": _prompt(2, 21), "max_tokens": 8,
+                 "temperature": 0.8, "top_k": 20, "seed": 1234}):
+        snap = ser.counter_snapshot()
+        res = prefill.prefill(req, addr)
+        assert res["handoff"] and res["ack"]["ok"]
+        out = decode.completions_collect(res["rid"])
+        delta = ser.counter_delta(snap)
+        base = single.completions(req)
+        assert out["choices"][0]["token_ids"] == \
+            base["choices"][0]["token_ids"]
+        assert delta["pickle"] == 0 and delta["deserialize_pickle"] == 0
+        assert delta["fast_ndarray"] > 0 and delta["deserialize_fast"] > 0
+    # No page leaks on either side of the wire.
+    assert prefill.engine.block_manager._available() == 64
+    stats = decode.engine_stats()
+    assert stats["free_kv_blocks"] == stats["total_kv_blocks"]
+    assert stats["handoffs_adopted"] == 2
+
+
+def test_partial_handoff_stream_discarded(setup):
+    """A sender dying mid-stream must leave nothing adopted: the decode
+    engine's block table only ever sees whole handoffs."""
+    from ray_tpu.collective.cpu_group import _AMETA, _HDR, _K_ARRAY
+    from ray_tpu.llm.disagg import _send_json, KVStreamServer
+
+    adopted = []
+    srv = KVStreamServer(lambda *a: adopted.append(a) or True)
+    try:
+        with socket.create_connection(srv.address, timeout=5) as sock:
+            _send_json(sock, {"id": "x", "kv_dtype": "float32",
+                              "kv_shape": [4, 4]})
+            # Array frame header promising 16 elements... then vanish.
+            sock.sendall(_HDR.pack(_AMETA.size + 8, _K_ARRAY))
+            sock.sendall(_AMETA.pack(b"<f4", 1, 16, 1, 1, 1, 1, 1, 1, 1,
+                                     0, 2))
+            sock.sendall(b"\x00" * 8)
+        deadline = time.monotonic() + 5
+        while srv.handoffs_rejected == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.handoffs_rejected == 1
+        assert srv.handoffs_adopted == 0 and not adopted
+    finally:
+        srv.close()
+
+
+def test_prefill_death_mid_handoff_retries_elsewhere(setup):
+    """RpcChaos kills the first prefill replica's call; prefill_with_retry
+    re-runs the whole prefill on the second and the request completes."""
+    from ray_tpu.llm.disagg import PrefillServer
+    from ray_tpu.llm.router import LocalReplica, prefill_with_retry
+    from ray_tpu.llm.serving import LLMServer
+    from ray_tpu.runtime import chaos as chaos_mod
+
+    decode = LLMServer(_cfg(setup, disaggregate=1))
+    replicas = [LocalReplica(PrefillServer(_cfg(setup)), name=f"prefill-{i}")
+                for i in range(2)]
+    req = {"prompt": _prompt(3, 21), "max_tokens": 4}
+    try:
+        chaos_mod.chaos().add_rule("prefill-0.*", "fail", 1.0, max_hits=1)
+        res = prefill_with_retry(replicas, req, decode.handoff_address())
+    finally:
+        chaos_mod.reset()
+    assert res["handoff"]
+    out = decode.completions_collect(res["rid"])
+    assert len(out["choices"][0]["token_ids"]) == 4
+    # Replica 0 never ran; replica 1 did the work.
+    assert replicas[0]._obj.engine.block_manager.prefix_tokens_saved == 0
+
+    # All replicas down -> typed failure, not a hang.
+    try:
+        chaos_mod.chaos().add_rule("prefill-*", "fail", 1.0)
+        with pytest.raises(RuntimeError, match="all 2 replicas"):
+            prefill_with_retry(replicas, req, decode.handoff_address())
+    finally:
+        chaos_mod.reset()
+
+
+# ---- abandoned-request hygiene ---------------------------------------------
+
+
+def test_abort_request_frees_blocks(setup):
+    from ray_tpu.llm.sampling import SamplingParams
+    from ray_tpu.llm.serving import build_engine
+
+    engine = build_engine(_cfg(setup))
+    free0 = engine.block_manager._available()
+    rid = engine.add_request(_prompt(4, 21), SamplingParams(max_tokens=32))
+    for _ in range(4):
+        engine.step()
+    assert engine.block_manager._available() < free0
+    assert engine.abort_request(rid)
+    assert not engine.abort_request(rid)  # idempotent: already gone
+    while engine.has_unfinished():
+        engine.step()
+    assert engine.block_manager._available() == free0
+
+
+def test_stream_consumer_gone_aborts_request(setup):
+    """Closing the stream generator mid-decode must abort the request in
+    the engine instead of decoding to max_tokens for a dead stream."""
+    from ray_tpu.llm.serving import LLMServer
+
+    server = LLMServer(_cfg(setup))
+    gen = server.completions_stream({"prompt": _prompt(5, 21),
+                                     "max_tokens": 500})
+    first = next(gen)
+    assert first["object"] == "text_completion.chunk"
+    gen.close()  # consumer disappears (GeneratorExit in the generator)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with server._lock:
+            busy = server.engine.has_unfinished()
+        if not busy:
+            break
+        time.sleep(0.02)
+    assert not busy, "request kept decoding after its consumer vanished"
+    stats = server.engine_stats()
+    assert stats["free_kv_blocks"] == stats["total_kv_blocks"]
+
+
+def test_queue_timeout_raises_typed_error_and_aborts(setup):
+    from ray_tpu.llm.serving import LLMServer, RequestTimeoutError
+
+    server = LLMServer(_cfg(setup, stream_timeout_s=0.2))
+    # Idle the engine loop so no output ever reaches the stream queue: the
+    # collector must convert queue.Empty into the typed error AND abort.
+    server.engine.has_unfinished = lambda: False
+    with pytest.raises(RequestTimeoutError, match="aborted"):
+        server.completions({"prompt": _prompt(6), "max_tokens": 8})
+    with server._lock:
+        assert not server.engine.waiting and not server.engine.running
+    assert not server._streams
+
+
+# ---- full stack (cluster) --------------------------------------------------
+
+
+@pytest.mark.slow
+def test_routed_app_end_to_end(setup, tmp_path):
+    """build_openai_app with routing="affinity" on a real cluster: requests
+    flow client -> router deployment -> engine replicas and the router's
+    affinity counters move."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm.serving import build_openai_app
+
+    ray_tpu.init()
+    try:
+        handle = build_openai_app(
+            _cfg(setup, routing="affinity", num_replicas=1),
+            name="routed-llm")
+        p = _prompt(7, 21)
+        r1 = handle.completions.remote({"prompt": p, "max_tokens": 4}) \
+            .result(timeout_s=120)
+        r2 = handle.completions.remote({"prompt": p, "max_tokens": 4}) \
+            .result(timeout_s=120)
+        assert r1["choices"][0]["token_ids"] == r2["choices"][0]["token_ids"]
+        rs = handle.router_stats.remote().result(timeout_s=60)
+        assert rs["affinity_hits"] >= 1
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
