@@ -35,6 +35,11 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                                 "re-executions before an object is lost"),
     # -- raylet / GCS ------------------------------------------------------
     "heartbeat_interval_s": (float, 2.0, "raylet resource heartbeat period"),
+    "lease_batch_max": (int, 64,
+                        "lease requests coalesced into one "
+                        "LeaseBatchRequestMsg frame per raylet per pump "
+                        "(the raylet grants the batch in one scheduling "
+                        "pass)"),
     "worker_prestart": (int, 0,
                         "idle workers spawned at raylet start (0 = spawn on "
                         "first lease; capped by the node's CPU count)"),
@@ -97,6 +102,14 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                         "task state events retained by the GCS"),
     "task_events_flush_interval_s": (float, 1.0,
                                      "worker-side task event batch period"),
+    "event_flush_batch_max": (int, 2000,
+                              "task events per TaskEventBatchMsg frame; a "
+                              "fuller buffer ships in multiple frames on "
+                              "the same tick"),
+    "gcs_ring_shards": (int, 16,
+                        "per-node shards of the GCS task-event ring; "
+                        "ingest and index upkeep are O(shard), reads "
+                        "merge across shards"),
     "cluster_events_max": (int, 10_000,
                            "structured cluster events retained by the GCS "
                            "event ring (see runtime/events.py)"),

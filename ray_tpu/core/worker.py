@@ -79,8 +79,13 @@ class CoreWorker:
         self.node_id = node_id
         self.io = EventLoopThread()
         self.gcs = self.io.run(self._connect(gcs_address, auto_reconnect=True))
-        self.raylet = (self.io.run(self._connect(raylet_address))
-                       if raylet_address else None)
+        # Lease-batch plumbing must exist before any raylet client is up:
+        # a lease_grant push can arrive as soon as the socket connects.
+        self._lease_grant_waiters: Dict[bytes, "asyncio.Future"] = {}
+        self._lease_batch_buf: Dict[Any, list] = {}  # raylet client -> queue
+        self.raylet = (self.io.run(self._connect(
+            raylet_address, on_push=self._on_raylet_push))
+            if raylet_address else None)
         self.store = ObjectStore(store_path, create=False) if store_path else None
         self.spill = (SpillManager(self.store, os.path.join(session_dir, "spill"))
                       if self.store is not None else None)
@@ -99,7 +104,10 @@ class CoreWorker:
         # Optimistic: flip OFF per method on the first "no handler" from an
         # older peer and stay on the legacy pickled envelope (the rolling-
         # upgrade case the schema exists for).
-        self._typed_methods = {"lease_worker", "push_task", "push_actor_task"}
+        self._typed_methods = {"lease_worker", "lease_batch",
+                               "cancel_lease_batch", "push_task",
+                               "push_actor_task", "pull_object",
+                               "put_object", "report_task_events"}
         self._raylet_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._actor_clients: Dict[bytes, "_ActorClient"] = {}
         self._put_refs: set = set()                   # plasma ids this process created
@@ -151,12 +159,15 @@ class CoreWorker:
         with self._mem_lock:
             self._task_events: list = []
             self._task_events_flusher_started = True
+            self._task_events_dropped = 0             # lifetime (summary)
+            self._task_events_dropped_unreported = 0  # ships in next frame
         self._had_wait_edges = False
         self.io.spawn(self._flush_task_events_loop())
 
     @staticmethod
-    async def _connect(addr, auto_reconnect: bool = False):
-        client = RpcClient(addr[0], addr[1], auto_reconnect=auto_reconnect)
+    async def _connect(addr, auto_reconnect: bool = False, on_push=None):
+        client = RpcClient(addr[0], addr[1], auto_reconnect=auto_reconnect,
+                           on_push=on_push)
         await client.connect(timeout=60)
         return client
 
@@ -193,7 +204,38 @@ class CoreWorker:
             raise RayTpuError("no attached raylet for remote put")
         chunk_size = cfg().pull_chunk_bytes
 
+        async def _send_raw():
+            # Zero-pickle: each chunk ships as the raw-frame payload (a
+            # memoryview slice straight onto the socket), only the small
+            # ObjPutMsg header is encoded.
+            from ray_tpu.runtime import wire
+
+            total = len(payload)
+            view = memoryview(payload)
+            off = 0
+            while True:
+                end = min(off + chunk_size, total)
+                m, _ = await self.raylet.call_raw(
+                    "put_object_raw",
+                    m=wire.ObjPutMsg(oid=oid, offset=off, total=total,
+                                     seal=(end >= total)).encode(),
+                    payload=view[off:end])
+                ack = wire.AckMsg.decode(m)
+                if not ack.ok:
+                    raise RayTpuError(f"remote put failed: {ack.error}")
+                off = end
+                if off >= total:
+                    return
+
         async def _send():
+            if "put_object" in self._typed_methods:
+                try:
+                    return await _send_raw()
+                except RpcError as e:
+                    if (isinstance(e, ConnectionLost)
+                            or "no handler" not in str(e)):
+                        raise
+                    self._typed_methods.discard("put_object")
             total = len(payload)
             off = 0
             while True:
@@ -382,7 +424,11 @@ class CoreWorker:
         return self._node_addrs.get(node_id)
 
     def _pull_remote(self, oid: bytes, node_id: bytes) -> bytes:
-        """Chunked pull of a sealed object from another node's raylet."""
+        """Chunked pull of a sealed object from another node's raylet:
+        raw-frame fast path (zero-pickle — chunk bytes come off the socket
+        as views over the receive buffer and land in ONE preallocated
+        bytearray, no intermediate pickle buffer ever materializes),
+        legacy pickled chunks against an old raylet."""
         pull_start = time.monotonic()
         addr = self._node_address(node_id)
         if addr is None:
@@ -390,8 +436,44 @@ class CoreWorker:
                 f"object {oid.hex()[:12]} lives on unknown/dead node "
                 f"{node_id.hex()[:12]}", oid=oid)
 
+        async def _pull_raw(client):
+            from ray_tpu.runtime import wire
+
+            buf, off, total = None, 0, 0
+            while True:
+                m, payload = await client.call_raw(
+                    "pull_object_raw",
+                    m=wire.ObjChunkRequestMsg(
+                        oid=oid, offset=off,
+                        length=cfg().pull_chunk_bytes).encode())
+                rep = wire.ObjChunkReplyMsg.decode(m)
+                if not rep.found:
+                    raise ObjectLostError(
+                        f"object {oid.hex()[:12]} not found on node "
+                        f"{node_id.hex()[:12]} (evicted or node restarted)",
+                        oid=oid)
+                if buf is None:
+                    total = rep.total
+                    buf = bytearray(total)
+                n = len(payload)
+                buf[off:off + n] = payload
+                off += n
+                if off >= total:
+                    return buf
+                if n == 0:
+                    raise ObjectLostError(
+                        f"truncated pull of {oid.hex()[:12]}", oid=oid)
+
         async def _pull():
             client = await self._raylet_for(addr)
+            if "pull_object" in self._typed_methods:
+                try:
+                    return await _pull_raw(client)
+                except RpcError as e:
+                    if (isinstance(e, ConnectionLost)
+                            or "no handler" not in str(e)):
+                        raise
+                    self._typed_methods.discard("pull_object")
             chunks, off = [], 0
             while True:
                 reply = await client.call(
@@ -572,6 +654,19 @@ class CoreWorker:
         self._generators[task_id] = state
         return ObjectRefGenerator(task_id, state)
 
+    async def _on_raylet_push(self, method: str, data: dict):
+        """Pushes from raylets: deferred lease-batch resolutions. A
+        `lease_grant` carries the encoded LeaseReplyMsg for a req_id whose
+        batch entry came back pending=True (see handle_lease_batch2)."""
+        if method != "lease_grant":
+            logger.warning("unexpected raylet push %r", method)
+            return
+        fut = self._lease_grant_waiters.pop(data.get("req_id"), None)
+        if fut is not None and not fut.done():
+            from ray_tpu.runtime import wire
+
+            fut.set_result(wire.LeaseReplyMsg.decode(data["m"]).to_reply())
+
     # ------------------------------------------------------- task events
 
     def _record_task_event(self, spec: TaskSpec, state: str,
@@ -593,10 +688,18 @@ class CoreWorker:
                 "time": time.time(),
                 "error": error,
             })
-            # Bounded buffer: observability never OOMs the submitter.
+            # Bounded buffer: observability never OOMs the submitter. Drops
+            # are COUNTED, not silent — the count ships with the next flush
+            # frame, feeds ray_tpu_task_events_dropped_total, and surfaces
+            # in state.summary().
             overflow = len(buf) - cfg().task_events_max
             if overflow > 0:
                 del buf[:overflow]
+                self._task_events_dropped = getattr(
+                    self, "_task_events_dropped", 0) + overflow
+                self._task_events_dropped_unreported = getattr(
+                    self, "_task_events_dropped_unreported", 0) + overflow
+                metric_defs.TASK_EVENTS_DROPPED.inc(overflow)
             start = not self._task_events_flusher_started
             self._task_events_flusher_started = True
         if start:
@@ -646,31 +749,64 @@ class CoreWorker:
         while True:
             await asyncio.sleep(cfg().task_events_flush_interval_s)
             self._drain_dropped_refs()   # idle-driver drop processing
-            # Piggyback wait-graph edges on the same flush tick/RPC: an
+            # Piggyback wait-graph edges on the same flush tick/frame: an
             # edge list (possibly empty, to clear a previous report) rides
-            # the FIRST report_task_events call of the tick.
+            # the FIRST report of the tick.
             edges = self._collect_wait_edges()
             send_edges = (edges if (edges or self._had_wait_edges)
                           else None)
             self._had_wait_edges = bool(edges)
             first = True
             while True:
+                batch_max = cfg().event_flush_batch_max
                 with self._mem_lock:
                     buf = getattr(self, "_task_events", None)
-                    batch = buf[:500] if buf else []
+                    batch = buf[:batch_max] if buf else []
                     if batch:
-                        del buf[:500]  # in-place: appends race-free
-                if not batch and not (first and send_edges is not None):
+                        del buf[:batch_max]  # in-place: appends race-free
+                    dropped = getattr(self,
+                                      "_task_events_dropped_unreported", 0)
+                    self._task_events_dropped_unreported = 0
+                if not batch and not (first and (send_edges is not None
+                                                 or dropped)):
                     break
                 try:
-                    await self.gcs.call(
-                        "report_task_events", events=batch,
-                        wait_edges=send_edges if first else None,
-                        reporter=self.worker_ident,
-                        node_id=self.node_id)
+                    await self._report_task_events(
+                        batch, send_edges if first else None, dropped)
                 except Exception:
-                    break  # GCS down/old: drop quietly, retry next tick
+                    # GCS down: drop the events quietly (status quo) but
+                    # keep the drop COUNT for the next successful frame.
+                    with self._mem_lock:
+                        self._task_events_dropped_unreported += dropped
+                    break
                 first = False
+
+    async def _report_task_events(self, batch, send_edges, dropped):
+        """One flush frame: a typed TaskEventBatchMsg (one encode per tick
+        instead of N dict-pickles) carrying events + wait edges + the drop
+        count; legacy pickled envelope against an old GCS."""
+        from ray_tpu.runtime import wire
+
+        if "report_task_events" in self._typed_methods:
+            msg = wire.TaskEventBatchMsg(
+                events=[wire.TaskEventMsg.from_event(e) for e in batch],
+                reporter=self.worker_ident,
+                node_id=self.node_id or b"",
+                dropped=dropped)
+            if send_edges is not None:
+                msg.has_wait_edges = True
+                msg.wait_edges = send_edges
+            try:
+                await self.gcs.call("report_task_events2", m=msg.encode())
+                return
+            except RpcError as e:
+                if (isinstance(e, ConnectionLost)
+                        or "no handler" not in str(e)):
+                    raise
+                self._typed_methods.discard("report_task_events")
+        await self.gcs.call(
+            "report_task_events", events=batch, wait_edges=send_edges,
+            reporter=self.worker_ident, node_id=self.node_id)
 
     # --------------------------------------------- ownership & refcounting
     #
@@ -1311,11 +1447,11 @@ class CoreWorker:
                 asyncio.ensure_future(self._request_lease(key, state, req_id))
         elif want < len(state.inflight_reqs):
             extra = len(state.inflight_reqs) - want
-            for req_id in list(state.inflight_reqs)[:extra]:
-                # The request may have spilled; cancel everywhere we talk to.
-                for target in [self.raylet, *self._raylet_clients.values()]:
-                    asyncio.ensure_future(
-                        target.call("cancel_lease_request", req_id=req_id))
+            extras = list(state.inflight_reqs)[:extra]
+            # The requests may have spilled; cancel everywhere we talk to,
+            # one batched frame per raylet instead of reqs x raylets calls.
+            for target in [self.raylet, *self._raylet_clients.values()]:
+                asyncio.ensure_future(self._cancel_lease_reqs(target, extras))
 
     def _steal_idle_lease(self, key) -> Optional[_LeasedWorker]:
         """Pop an idle leased worker from a scheduling key that differs only
@@ -1366,20 +1502,48 @@ class CoreWorker:
             return
         self._schedule_return(key, state, lease)
 
+    async def _cancel_lease_reqs(self, target, req_ids):
+        """Cancel a set of lease requests on one raylet: one
+        cancel_lease_batch call, per-id fallback against an old raylet;
+        a dead raylet has nothing left to cancel."""
+        try:
+            if "cancel_lease_batch" in self._typed_methods:
+                try:
+                    await target.call("cancel_lease_batch",
+                                      req_ids=list(req_ids))
+                    return
+                except RpcError as e:
+                    if (isinstance(e, ConnectionLost)
+                            or "no handler" not in str(e)):
+                        raise
+                    self._typed_methods.discard("cancel_lease_batch")
+            for req_id in req_ids:
+                await target.call("cancel_lease_request", req_id=req_id)
+        except Exception:
+            pass
+
     async def _raylet_for(self, address: Tuple[str, int]) -> RpcClient:
         client = self._raylet_clients.get(address)
         if client is None or client._dead:
-            client = RpcClient(*address)
+            client = RpcClient(*address, on_push=self._on_raylet_push)
             await client.connect(timeout=15)
             self._raylet_clients[address] = client
         return client
 
     async def _lease_call(self, target, resources, req_id, pg_id,
                           bundle_index, env_key) -> dict:
-        """One lease RPC: typed LeaseRequestMsg/LeaseReplyMsg envelope when
-        the raylet speaks it, legacy pickled kwargs against an older one."""
+        """One lease RPC: coalesced into a LeaseBatchRequestMsg frame when
+        the raylet speaks lease_batch2 (one scheduling pass grants the
+        whole batch), else a typed LeaseRequestMsg/LeaseReplyMsg envelope,
+        else legacy pickled kwargs against an older raylet."""
         from ray_tpu.runtime import wire
 
+        if "lease_batch" in self._typed_methods:
+            msg = wire.LeaseRequestMsg(
+                resources=resources, for_actor=False,
+                placement_group_id=pg_id or b"", bundle_index=bundle_index,
+                env_key=env_key or "", req_id=req_id or os.urandom(8))
+            return await self._lease_call_batched(target, msg)
         if "lease_worker" in self._typed_methods:
             msg = wire.LeaseRequestMsg(
                 resources=resources, for_actor=False,
@@ -1396,6 +1560,92 @@ class CoreWorker:
             "lease_worker", resources=resources, req_id=req_id,
             placement_group_id=pg_id, bundle_index=bundle_index,
             env_key=env_key)
+
+    async def _lease_call_batched(self, target, msg) -> dict:
+        """Enqueue one lease request on the per-raylet micro-batch buffer
+        and await its resolution. Requests landing on the same event-loop
+        tick coalesce into one LeaseBatchRequestMsg (the buffer flushes on
+        the next tick, or eagerly at lease_batch_max); replies arrive
+        either inline in the LeaseBatchReplyMsg or later via a
+        `lease_grant` push (see raylet.handle_lease_batch2)."""
+        fut = asyncio.get_event_loop().create_future()
+        buf = self._lease_batch_buf.setdefault(target, [])
+        buf.append((msg, fut))
+        if len(buf) >= cfg().lease_batch_max:
+            self._lease_batch_buf.pop(target, None)
+            asyncio.ensure_future(self._send_lease_batch(target, buf))
+        elif len(buf) == 1:
+            asyncio.ensure_future(self._flush_lease_batch(target))
+        try:
+            # The reply for a pending entry rides a push on the raylet
+            # connection; if that connection dies the push never comes, so
+            # poll connection liveness rather than waiting forever.
+            while True:
+                try:
+                    return await asyncio.wait_for(asyncio.shield(fut), 1.0)
+                except asyncio.TimeoutError:
+                    if target._dead or target._closed:
+                        raise ConnectionLost(
+                            "raylet connection lost awaiting lease grant")
+        finally:
+            self._lease_grant_waiters.pop(msg.req_id, None)
+
+    async def _flush_lease_batch(self, target):
+        await asyncio.sleep(0)  # let same-tick requests pile on
+        buf = self._lease_batch_buf.pop(target, None)
+        if buf:
+            await self._send_lease_batch(target, buf)
+
+    async def _send_lease_batch(self, target, buf):
+        from ray_tpu.runtime import wire
+
+        by_id = {msg.req_id: fut for msg, fut in buf}
+        # Register waiters BEFORE the call: a pending entry's lease_grant
+        # push can arrive while we're still decoding the batch reply.
+        self._lease_grant_waiters.update(by_id)
+        try:
+            encoded = await target.call(
+                "lease_batch2",
+                m=wire.LeaseBatchRequestMsg(
+                    entries=[msg for msg, _ in buf]).encode())
+            reply = wire.LeaseBatchReplyMsg.decode(encoded)
+        except Exception as e:
+            for msg, _ in buf:
+                self._lease_grant_waiters.pop(msg.req_id, None)
+            if (isinstance(e, RpcError) and not isinstance(e, ConnectionLost)
+                    and "no handler" in str(e)):
+                # Old raylet: fall back to per-request leasing for this and
+                # every future request.
+                self._typed_methods.discard("lease_batch")
+                for msg, fut in buf:
+                    asyncio.ensure_future(
+                        self._lease_single_fallback(target, msg, fut))
+                return
+            for _, fut in buf:
+                if not fut.done():
+                    fut.set_exception(
+                        e if isinstance(e, Exception) else RpcError(repr(e)))
+            return
+        for entry in reply.entries:
+            fut = by_id.get(entry.req_id)
+            if fut is not None and not fut.done():
+                self._lease_grant_waiters.pop(entry.req_id, None)
+                fut.set_result(entry.to_reply())
+        # Entries in reply.pending resolve later via the lease_grant push
+        # (_on_raylet_push); their waiters stay registered.
+
+    async def _lease_single_fallback(self, target, msg, fut):
+        try:
+            reply = await self._lease_call(
+                target, dict(msg.resources), msg.req_id,
+                msg.placement_group_id or None, msg.bundle_index,
+                msg.env_key or None)
+        except Exception as e:
+            if not fut.done():
+                fut.set_exception(e)
+            return
+        if not fut.done():
+            fut.set_result(reply)
 
     async def _request_lease(self, key, state: _KeyState, req_id: bytes):
         spec_resources = dict(key[1])

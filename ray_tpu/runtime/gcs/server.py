@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core.task_spec import ActorSpec
@@ -593,6 +594,18 @@ class GcsServer:
             logger.exception("kv persist failed")
         return {"ok": True}
 
+    async def handle_report_metrics2(self, conn, m: bytes):
+        """Typed metrics flush (MetricsReportMsg): one schema'd frame per
+        reporter per tick, filed under the same metrics:<node>:<pid> KV key
+        the legacy kv_put path used, so every reader (dashboard /metrics,
+        state.metrics_snapshot) is oblivious to the transport change.
+        Skips the persistence write — metrics snapshots are ephemeral."""
+        from ray_tpu.runtime import wire
+
+        msg = wire.MetricsReportMsg.decode(m)
+        self._kv[f"metrics:{msg.node}:{msg.pid}".encode()] = msg.payload
+        return {"ok": True}
+
     async def handle_kv_get(self, conn, key: bytes):
         return {"value": self._kv.get(key)}
 
@@ -700,59 +713,97 @@ class GcsServer:
         spec = record.spec
         last_err = None
         import os as _os
-        for node in scheduling.rank_nodes_for_actor(self._nodes, spec, self._pg_manager):
-            req_id = _os.urandom(8)
-            try:
-                lease = await node.client.call(
-                    "lease_worker", resources=spec.resources, for_actor=True,
-                    placement_group_id=spec.placement_group_id,
-                    bundle_index=spec.placement_group_bundle_index,
-                    req_id=req_id, timeout=60)
-            except Exception as e:
-                last_err = e
-                # The pending lease (or a grant that raced the timeout) must
-                # not leak worker resources at the raylet.
+        # Failed leases still need their req_ids canceled at the raylet (a
+        # pending lease, or a grant that raced the timeout, must not leak
+        # worker resources) — but a dead node's cancel must not stall the
+        # scheduling loop, so cancels accumulate per node and fire batched
+        # in the background at exit.
+        pending_cancels: Dict[bytes, list] = {}
+
+        def _flush_cancels():
+            for nid, req_ids in pending_cancels.items():
+                node_rec = self._nodes.get(nid)
+                if node_rec is None or not node_rec.alive:
+                    continue
+                asyncio.ensure_future(
+                    self._cancel_leases_at(node_rec, req_ids))
+
+        try:
+            for node in scheduling.rank_nodes_for_actor(self._nodes, spec,
+                                                        self._pg_manager):
+                req_id = _os.urandom(8)
                 try:
-                    await node.client.call("cancel_lease_request", req_id=req_id,
-                                           timeout=10)
-                except Exception:
-                    pass
-                continue
-            if not lease.get("ok"):
-                last_err = RuntimeError(lease.get("error", "lease refused"))
-                continue
-            worker_addr = tuple(lease["worker_address"])
-            logger.debug("pushing create_actor %s to worker %s at %s",
-                         spec.actor_id.hex()[:12], lease["worker_id"].hex()[:12],
-                         worker_addr)
-            worker_client = RpcClient(*worker_addr)
-            try:
-                await worker_client.connect(timeout=15)
-                reply = await worker_client.call("create_actor", spec=spec, timeout=300)
-                if not reply.get("ok"):
-                    raise RuntimeError(reply.get("error", "actor __init__ failed"))
-            except Exception as e:
-                last_err = e
+                    lease = await node.client.call(
+                        "lease_worker", resources=spec.resources,
+                        for_actor=True,
+                        placement_group_id=spec.placement_group_id,
+                        bundle_index=spec.placement_group_bundle_index,
+                        req_id=req_id, timeout=60)
+                except Exception as e:
+                    last_err = e
+                    pending_cancels.setdefault(node.node_id, []).append(req_id)
+                    continue
+                if not lease.get("ok"):
+                    last_err = RuntimeError(lease.get("error", "lease refused"))
+                    continue
+                worker_addr = tuple(lease["worker_address"])
+                logger.debug("pushing create_actor %s to worker %s at %s",
+                             spec.actor_id.hex()[:12],
+                             lease["worker_id"].hex()[:12], worker_addr)
+                worker_client = RpcClient(*worker_addr)
                 try:
-                    await node.client.call("return_worker", lease_id=lease["lease_id"],
-                                           worker_dead=True)
-                except Exception:
-                    pass
-                # __init__ raising is terminal, not a scheduling failure.
-                if isinstance(e, RuntimeError):
-                    raise
-                continue
-            finally:
-                await worker_client.close()
-            record.state = ALIVE
-            record.address = worker_addr
-            record.node_id = node.node_id
-            record.worker_id = lease["worker_id"]
-            self._persist_actor(record)
-            await self.publish("actor", {"event": "alive", "actor": record.view()})
-            return
+                    await worker_client.connect(timeout=15)
+                    reply = await worker_client.call("create_actor", spec=spec,
+                                                     timeout=300)
+                    if not reply.get("ok"):
+                        raise RuntimeError(
+                            reply.get("error", "actor __init__ failed"))
+                except Exception as e:
+                    last_err = e
+                    try:
+                        await node.client.call(
+                            "return_worker", lease_id=lease["lease_id"],
+                            worker_dead=True)
+                    except Exception:
+                        pass
+                    # __init__ raising is terminal, not a scheduling failure.
+                    if isinstance(e, RuntimeError):
+                        raise
+                    continue
+                finally:
+                    await worker_client.close()
+                record.state = ALIVE
+                record.address = worker_addr
+                record.node_id = node.node_id
+                record.worker_id = lease["worker_id"]
+                self._persist_actor(record)
+                await self.publish("actor",
+                                   {"event": "alive", "actor": record.view()})
+                return
+        finally:
+            _flush_cancels()
         raise RuntimeError(f"no feasible node for actor {spec.class_name} "
                            f"(resources={spec.resources}): {last_err!r}")
+
+    async def _cancel_leases_at(self, node: NodeRecord, req_ids: list):
+        """Best-effort batched lease cancel at one raylet: a single
+        cancel_lease_batch frame, per-id fallback against an old raylet; a
+        node that died in the meantime is tolerated silently."""
+        try:
+            await node.client.call("cancel_lease_batch",
+                                   req_ids=list(req_ids), timeout=10)
+            return
+        except Exception as e:
+            from ray_tpu.runtime.rpc import ConnectionLost, RpcError
+            if not (isinstance(e, RpcError)
+                    and not isinstance(e, ConnectionLost)
+                    and "no handler" in str(e)):
+                return  # dead/unreachable node: nothing left to cancel
+        results = await asyncio.gather(
+            *(node.client.call("cancel_lease_request", req_id=rid, timeout=10)
+              for rid in req_ids),
+            return_exceptions=True)
+        del results  # best-effort: failures mean the node is going away
 
     async def handle_get_actor(self, conn, actor_id: Optional[bytes] = None,
                                name: Optional[str] = None, namespace: str = "default"):
@@ -767,16 +818,56 @@ class GcsServer:
                                         wait_edges=None, reporter=None,
                                         node_id=None):
         """Batched task state transitions from workers/drivers
-        (GcsTaskManager analog; task_event_buffer.h:224 export path).
+        (GcsTaskManager analog; task_event_buffer.h:224 export path) —
+        legacy pickled envelope; new workers ship one typed
+        TaskEventBatchMsg frame via report_task_events2 instead.
 
         `wait_edges` piggybacks the reporter's blocked-on edges on the
         same flush tick: None = no update, a list (possibly empty, to
         clear) replaces the reporter's previous edge set in the cluster
         wait-graph."""
-        from collections import deque
+        self._ingest_task_events(events, wait_edges, reporter, node_id, 0)
+        return {"ok": True}
 
-        from ray_tpu.config import cfg
+    async def handle_report_task_events2(self, conn, m: bytes):
+        """Typed twin of handle_report_task_events: the whole flush tick
+        arrives as one TaskEventBatchMsg frame (events + wait edges + the
+        reporter's buffer-overflow drop count) instead of N dict-pickles."""
+        from ray_tpu.runtime import wire
 
+        msg = wire.TaskEventBatchMsg.decode(m)
+        self._ingest_task_events(
+            [e.to_event() for e in msg.events],
+            msg.wait_edges if msg.has_wait_edges else None,
+            msg.reporter or None, msg.node_id or None, msg.dropped)
+        return {"ok": True}
+
+    def _event_shards(self) -> list:
+        """The task-event store, sharded by origin node: each shard is an
+        independent bounded ring + latest-per-task index so ingest and
+        index upkeep touch ONE shard — a 1k-node cluster's GCS tick stays
+        O(shard), not O(cluster). Readers merge across shards."""
+        shards = getattr(self, "_task_event_shards", None)
+        if shards is None:
+            from collections import deque
+
+            from ray_tpu.config import cfg
+
+            n = max(1, cfg().gcs_ring_shards)
+            per = max(1, cfg().task_events_max // n)
+            shards = self._task_event_shards = [
+                {"ring": deque(maxlen=per), "latest": {}} for _ in range(n)]
+            self._task_events_dropped_total = 0
+        return shards
+
+    def _shard_for(self, key) -> dict:
+        shards = self._event_shards()
+        if isinstance(key, str):
+            key = key.encode()
+        return shards[zlib.crc32(key or b"") % len(shards)]
+
+    def _ingest_task_events(self, events, wait_edges, reporter, node_id,
+                            dropped: int):
         if wait_edges is not None and reporter is not None:
             table = getattr(self, "_wait_edges", None)
             if table is None:
@@ -789,21 +880,36 @@ class GcsServer:
                                 else node_id)}
             else:
                 table.pop(reporter, None)
-        store = getattr(self, "_task_events", None)
-        if store is None:
-            store = self._task_events = deque(maxlen=cfg().task_events_max)
-            self._task_latest = {}
+        shard = self._shard_for(node_id or reporter or b"")
+        if dropped:
+            self._task_events_dropped_total = (
+                getattr(self, "_task_events_dropped_total", 0) + dropped)
+        ring, latest = shard["ring"], shard["latest"]
         for ev in events:
-            store.append(ev)
-            cur = self._task_latest.get(ev["task_id"])
+            ring.append(ev)
+            cur = latest.get(ev["task_id"])
             if cur is None or ev["time"] >= cur["time"]:
-                self._task_latest[ev["task_id"]] = ev
-            # Bound the per-task index alongside the event deque.
-            if len(self._task_latest) > store.maxlen:
-                alive = {e["task_id"] for e in store}
-                self._task_latest = {k: v for k, v in
-                                     self._task_latest.items() if k in alive}
-        return {"ok": True}
+                latest[ev["task_id"]] = ev
+            # Bound the per-task index alongside its own ring only.
+            if len(latest) > ring.maxlen:
+                alive = {e["task_id"] for e in ring}
+                stale = [k for k in latest if k not in alive]
+                for k in stale:
+                    del latest[k]
+                shard["latest"] = latest
+
+    async def handle_task_event_stats(self, conn):
+        """Ingest-side health of the task-event plane: shard layout plus
+        the cluster-wide count of events workers trimmed before flush
+        (satellite of ray_tpu_task_events_dropped_total)."""
+        shards = getattr(self, "_task_event_shards", None) or []
+        return {
+            "shards": len(shards),
+            "events_stored": sum(len(s["ring"]) for s in shards),
+            "tasks_indexed": sum(len(s["latest"]) for s in shards),
+            "events_dropped_total":
+                getattr(self, "_task_events_dropped_total", 0),
+        }
 
     # ---- cluster wait-graph + stall/deadlock detector --------------------
     #
@@ -1047,9 +1153,10 @@ class GcsServer:
 
     async def handle_list_tasks(self, conn, state=None, name=None,
                                 limit: int = 1000):
-        latest = getattr(self, "_task_latest", {})
+        shards = getattr(self, "_task_event_shards", None) or []
         out = []
-        for ev in sorted(latest.values(), key=lambda e: -e["time"]):
+        for ev in sorted((ev for s in shards for ev in s["latest"].values()),
+                         key=lambda e: -e["time"]):
             if state is not None and ev["state"] != state:
                 continue
             if name is not None and name not in ev["name"]:
@@ -1066,8 +1173,8 @@ class GcsServer:
         def _hex(tid):
             return tid.hex() if isinstance(tid, bytes) else str(tid)
 
-        store = getattr(self, "_task_events", None) or []
-        events = [ev for ev in store
+        shards = getattr(self, "_task_event_shards", None) or []
+        events = [ev for s in shards for ev in s["ring"]
                   if _hex(ev["task_id"]).startswith(task_id_hex)]
         ids = {_hex(ev["task_id"]) for ev in events}
         if len(ids) > 1:
@@ -1081,8 +1188,9 @@ class GcsServer:
         dashboard timeline pairs RUNNING->FINISHED/FAILED per task into
         per-worker execution bars (GcsTaskManager export / `ray timeline`
         analog)."""
-        store = getattr(self, "_task_events", None) or []
-        events = list(store)[-limit:]
+        shards = getattr(self, "_task_event_shards", None) or []
+        events = sorted((ev for s in shards for ev in s["ring"]),
+                        key=lambda e: e["time"])[-limit:]
         return events
 
     async def handle_list_actors(self, conn):

@@ -33,6 +33,10 @@ RESTORED_BYTES = Counter(
 RECONSTRUCTIONS = Counter(
     "ray_tpu_object_reconstructions_total",
     "lineage re-executions triggered by lost objects")
+TASK_EVENTS_DROPPED = Counter(
+    "ray_tpu_task_events_dropped_total",
+    "task state events trimmed from this worker's buffer before flush "
+    "(buffer overflow; raise task_events_max or lower the flush interval)")
 
 # -- raylet ----------------------------------------------------------------
 

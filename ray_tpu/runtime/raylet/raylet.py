@@ -24,7 +24,7 @@ from typing import Dict, List, Optional, Tuple
 from ray_tpu.runtime import events as events_mod
 from ray_tpu.runtime import metric_defs, scheduling
 from ray_tpu.runtime.object_store import ObjectStore
-from ray_tpu.runtime.rpc import RpcClient, RpcServer
+from ray_tpu.runtime.rpc import RawReply, RpcClient, RpcError, RpcServer
 from ray_tpu.utils.ids import NodeID, WorkerID
 
 logger = logging.getLogger(__name__)
@@ -644,6 +644,80 @@ class Raylet:
             req_id=req.req_id or None, env_key=req.env_key or None)
         return wire.LeaseReplyMsg.from_reply(reply).encode()
 
+    async def handle_lease_batch2(self, conn, m: bytes):
+        """A pump's worth of lease requests granted in ONE scheduling pass
+        (the amortized HandleRequestWorkerLease): N enqueues, one
+        `_dispatch_pending()`, one reply frame. Entries that pass resolves
+        synchronously (queue errors, immediate refusals) come back inline;
+        everything else is listed as `pending` and resolves later via a
+        `lease_grant` push on this connection. Waiting for all entries
+        here would deadlock — a speculative lease queued behind a running
+        task only grants after that task finishes, which needs this reply
+        to have been delivered."""
+        from ray_tpu.runtime import wire
+
+        batch = wire.LeaseBatchRequestMsg.decode(m)
+        reply = wire.LeaseBatchReplyMsg()
+        waiting = []
+        for req in batch.entries:
+            req_id = req.req_id or os.urandom(8)
+            pg_key = None
+            if req.placement_group_id:
+                idx = (req.bundle_index if req.bundle_index >= 0
+                       else self._any_bundle_index(req.placement_group_id))
+                if idx is None:
+                    r = wire.LeaseReplyMsg.from_reply({
+                        "ok": False,
+                        "error": "placement group bundle not on this node"})
+                    r.req_id = req_id
+                    reply.entries.append(r)
+                    continue
+                pg_key = (req.placement_group_id, idx)
+            fut = asyncio.get_event_loop().create_future()
+            pend = PendingLease(dict(req.resources), req.for_actor, pg_key,
+                                fut, req_id, env_key=req.env_key or None)
+            key = self._sched_class(pend.resources, pg_key, pend.env_key)
+            self._queues.setdefault(key, collections.deque()).append(pend)
+            waiting.append((req_id, fut))
+        await self._dispatch_pending()
+        # A few cooperative yields let resolutions the pass scheduled via
+        # ensure_future (errors, spillback verdicts, grants onto already-
+        # warm workers) land inline in this reply instead of as per-entry
+        # pushes. Bounded and non-blocking: sleep(0) only yields the loop,
+        # so a grant stuck behind a real worker spawn can't stall the
+        # reply — it just comes back `pending`.
+        for _ in range(8):
+            if all(f.done() for _, f in waiting):
+                break
+            await asyncio.sleep(0)
+        for req_id, fut in waiting:
+            if fut.done():
+                r = wire.LeaseReplyMsg.from_reply(fut.result())
+                r.req_id = req_id
+                reply.entries.append(r)
+            else:
+                reply.pending.append(req_id)
+                fut.add_done_callback(
+                    lambda f, rid=req_id: asyncio.ensure_future(
+                        self._push_lease_grant(conn, rid, f)))
+        return reply.encode()
+
+    async def _push_lease_grant(self, conn, req_id: bytes, fut):
+        try:
+            result = fut.result()
+        except Exception as e:
+            result = {"ok": False, "error": repr(e)}
+        from ray_tpu.runtime import wire
+
+        r = wire.LeaseReplyMsg.from_reply(result)
+        r.req_id = req_id
+        try:
+            await conn.push("lease_grant",
+                            {"req_id": req_id, "m": r.encode()})
+        except Exception:
+            logger.debug("lease_grant push for %s failed (peer gone)",
+                         req_id.hex())
+
     async def handle_lease_worker(self, conn, resources: Dict[str, float],
                                   for_actor: bool = False,
                                   placement_group_id: Optional[bytes] = None,
@@ -719,6 +793,16 @@ class Raylet:
                 await self._dispatch_pending()
                 return {"ok": True, "reclaimed": True}
         return {"ok": False}
+
+    async def handle_cancel_lease_batch(self, conn, req_ids: List[bytes]):
+        """Batched cancel fan-in: one frame retires a whole pump's worth of
+        extra in-flight lease requests instead of one RPC per req_id."""
+        canceled = 0
+        for rid in req_ids:
+            r = await self.handle_cancel_lease_request(conn, rid)
+            if r.get("ok"):
+                canceled += 1
+        return {"ok": True, "canceled": canceled}
 
     def _any_bundle_index(self, pg_id: bytes) -> Optional[int]:
         for (gid, idx), b in self._bundles.items():
@@ -868,7 +952,8 @@ class Raylet:
             if w is None:
                 w = self._spawn_worker()
             w.env_key = req.env_key
-            await asyncio.wait_for(w.ready.wait(), timeout=120)
+            if not w.ready.is_set():  # warm worker: skip the timer+task
+                await asyncio.wait_for(w.ready.wait(), timeout=120)
             if w.address is None:
                 raise RuntimeError("worker died during startup")
             w.lease_id = os.urandom(8)
@@ -1008,6 +1093,104 @@ class Raylet:
             finally:
                 buf.release()
 
+    async def handle_pull_object_raw(self, conn, m, payload):
+        """Zero-pickle twin of handle_pull_object: ObjChunkRequestMsg in,
+        the chunk rides OUT as the raw-frame payload — the object bytes
+        are copied once out of the arena and hit the socket without ever
+        entering a pickle buffer."""
+        from ray_tpu.runtime import wire
+
+        req = wire.ObjChunkRequestMsg.decode(m)
+        async with self._pull_sem:
+            metric_defs.PULLS_SERVED.inc()
+            try:
+                buf = self.store.get(req.oid, timeout=0)
+            except Exception:
+                rec = self.spill.read_chunk(req.oid, req.offset, req.length)
+                if rec is None:
+                    return RawReply(
+                        wire.ObjChunkReplyMsg(found=False).encode())
+                total, metadata, chunk = rec
+                return RawReply(
+                    wire.ObjChunkReplyMsg(
+                        found=True, total=total,
+                        metadata=bytes(metadata or b"")).encode(),
+                    chunk)
+            try:
+                data = buf.data
+                return RawReply(
+                    wire.ObjChunkReplyMsg(
+                        found=True, total=len(data),
+                        metadata=bytes(buf.metadata)).encode(),
+                    bytes(data[req.offset:req.offset + req.length]))
+            finally:
+                buf.release()
+
+    async def handle_put_object_raw(self, conn, m, payload):
+        """Zero-pickle twin of handle_put_object: the chunk arrives as the
+        raw-frame payload (a memoryview over the receive buffer) and is
+        copied exactly once, into the store arena."""
+        from ray_tpu.runtime import wire
+
+        req = wire.ObjPutMsg.decode(m)
+        r = await self.handle_put_object(
+            conn, req.oid, payload, req.offset, req.total,
+            metadata=req.metadata, seal=req.seal)
+        return RawReply(wire.AckMsg(ok=bool(r.get("ok")),
+                                    error=str(r.get("error") or ""),
+                                    existed=bool(r.get("existed"))).encode())
+
+    async def _pull_from(self, client: RpcClient, oid: bytes):
+        """Whole-object pull from a peer raylet: raw-frame fast path with
+        a legacy pickled fallback for old peers. Returns (buf, metadata)
+        or None if the peer lost the object."""
+        from ray_tpu.config import cfg
+        from ray_tpu.runtime import wire
+
+        chunk_bytes = cfg().pull_chunk_bytes
+        try:
+            buf, off, total, metadata = None, 0, 0, b""
+            while True:
+                mrep, payload = await client.call_raw(
+                    "pull_object_raw",
+                    m=wire.ObjChunkRequestMsg(oid=oid, offset=off,
+                                              length=chunk_bytes).encode())
+                rep = wire.ObjChunkReplyMsg.decode(mrep)
+                if not rep.found:
+                    return None
+                if buf is None:
+                    total, metadata = rep.total, rep.metadata
+                    buf = bytearray(total)
+                n = len(payload)
+                buf[off:off + n] = payload
+                off += n
+                if off >= total:
+                    return buf, metadata
+                if n == 0:
+                    raise RuntimeError("truncated pull")
+        except RpcError as e:
+            if "no handler" not in str(e):
+                raise
+        chunks, off, total, metadata = [], 0, None, b""
+        while True:
+            r = await client.call("pull_object", oid=oid, offset=off,
+                                  length=chunk_bytes)
+            if not r.get("found"):
+                return None
+            total = r["total"]
+            metadata = r.get("metadata", b"")
+            chunks.append(r["chunk"])
+            off += len(r["chunk"])
+            if off >= total:
+                buf = bytearray(total)
+                pos = 0
+                for c in chunks:
+                    buf[pos:pos + len(c)] = c
+                    pos += len(c)
+                return buf, metadata
+            if not r["chunk"]:
+                raise RuntimeError("truncated pull")
+
     async def handle_fetch_and_relay(self, conn, oid: bytes,
                                      source: Tuple[str, int],
                                      targets: List[Tuple[str, int]],
@@ -1016,33 +1199,17 @@ class Raylet:
         fan the remaining `targets` out as subtrees relaying from THIS node —
         O(log n) depth, no single-source bottleneck (PushManager/broadcast
         analog, push_manager.h:30; the 1 GiB x 50-node envelope case)."""
-        from ray_tpu.config import cfg
-
         if not self.store.contains(oid):
             client = RpcClient(*tuple(source))
             try:
                 await client.connect(timeout=15)
-                chunks, off, total, metadata = [], 0, None, b""
-                while True:
-                    r = await client.call("pull_object", oid=oid, offset=off,
-                                          length=cfg().pull_chunk_bytes)
-                    if not r.get("found"):
-                        return {"ok": False,
-                                "error": "source lost the object"}
-                    total = r["total"]
-                    metadata = r.get("metadata", b"")
-                    chunks.append(r["chunk"])
-                    off += len(r["chunk"])
-                    if off >= total:
-                        break
-                    if not r["chunk"]:
-                        return {"ok": False, "error": "truncated pull"}
+                rec = await self._pull_from(client, oid)
+                if rec is None:
+                    return {"ok": False, "error": "source lost the object"}
+                data, metadata = rec
                 try:
-                    view = self.store.create(oid, total, metadata)
-                    pos = 0
-                    for c in chunks:
-                        view[pos:pos + len(c)] = c
-                        pos += len(c)
+                    view = self.store.create(oid, len(data), metadata)
+                    view[:] = data
                     view.release()
                     self.store.seal(oid)
                 except ValueError:

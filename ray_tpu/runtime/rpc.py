@@ -50,9 +50,37 @@ _MAGIC = b"RTP" + bytes([PROTOCOL_VERSION])
 # (cpp/raytpu_client) speak. A connection switches to xlang replies after
 # its first RTX frame.
 _X_MAGIC = b"RTX" + bytes([PROTOCOL_VERSION])
+# Raw dialect: the zero-pickle fast path for schema'd messages. Body is
+# [u8 kind][u64 msg_id][u16 method_len][method utf8][u32 m_len][m][payload]
+# where `m` is a wire.Message encoding (runtime/wire.py) and `payload` is
+# out-of-band bulk bytes (object chunks) that reach the handler as a
+# memoryview over the receive buffer — no pickle.dumps/loads anywhere on
+# the path. Same MAC/auth rules as every other frame. Error replies stay
+# pickled (rare path, carries real exceptions).
+_R_MAGIC = b"RTR" + bytes([PROTOCOL_VERSION])
+_R_PRE = struct.Struct("<BQH")
+_R_MLEN = struct.Struct("<I")
 _HDR = struct.Struct("<4sI")
 KIND_REQUEST, KIND_REPLY, KIND_ERROR, KIND_PUSH = 0, 1, 2, 3
 MAX_FRAME = 1 << 31
+
+
+class Raw:
+    """Raw-frame envelope: schema header bytes + out-of-band payload.
+
+    Requests decoded off an RTR frame arrive at handlers as
+    `handler(conn, m, payload)`; a handler returning a `Raw` (alias
+    `RawReply`) gets its reply emitted as an RTR frame — end to end, the
+    bulk payload is never pickled and never copied into a pickle buffer."""
+
+    __slots__ = ("m", "payload")
+
+    def __init__(self, m: bytes = b"", payload=b""):
+        self.m = m
+        self.payload = payload
+
+
+RawReply = Raw
 
 # ---------------------------------------------------------------- wire auth
 #
@@ -195,17 +223,25 @@ class _FrameMac:
         self.send_seq = 0
         self.recv_seq = 0
 
-    def _tag(self, direction: bytes, seq: int, body: bytes) -> bytes:
+    def _tag(self, direction: bytes, seq: int, *parts) -> bytes:
         import hashlib
 
         m = hashlib.blake2b(key=self.key, digest_size=_MAC_SIZE)
         m.update(direction)
         m.update(seq.to_bytes(8, "little"))
-        m.update(body)
+        for part in parts:
+            m.update(part)
         return m.digest()
 
     def seal(self, body: bytes) -> bytes:
         tag = self._tag(self.send_dir, self.send_seq, body)
+        self.send_seq += 1
+        return tag
+
+    def seal_parts(self, *parts) -> bytes:
+        """Seal a body supplied as segments (raw frames: head + payload)
+        without concatenating — blake2b streams over each part."""
+        tag = self._tag(self.send_dir, self.send_seq, *parts)
         self.send_seq += 1
         return tag
 
@@ -258,7 +294,31 @@ async def _read_frame(reader: asyncio.StreamReader,
             # EXPECTED failure mode: drop via the clean protocol path.
             raise ProtocolMismatch(f"malformed xlang frame: "
                                    f"{type(e).__name__}: {e}")
+    if magic == _R_MAGIC:
+        # Zero-pickle raw frame: header fields are fixed-width structs, the
+        # schema bytes + bulk payload come back as views over the receive
+        # buffer. Nothing here can execute code.
+        if length > MAX_FRAME:
+            raise RpcError(f"frame too large: {length}")
+        body = await reader.readexactly(length)
+        if mac is not None:
+            tag = await reader.readexactly(_MAC_SIZE)
+            if not mac.verify(body, tag):
+                raise AuthError("frame MAC verification failed")
+        kind, msg_id, mlen = _R_PRE.unpack_from(body, 0)
+        off = _R_PRE.size
+        method = str(body[off:off + mlen], "utf-8")
+        off += mlen
+        (m_len,) = _R_MLEN.unpack_from(body, off)
+        off += _R_MLEN.size
+        data = Raw(bytes(body[off:off + m_len]),
+                   memoryview(body)[off + m_len:])
+        return kind, (msg_id if kind != KIND_PUSH else None), method, data
     if magic != _MAGIC:
+        if magic[:3] == b"RTR":
+            raise ProtocolMismatch(
+                f"peer speaks raw wire v{magic[3]}, this process speaks "
+                f"v{PROTOCOL_VERSION}")
         if magic[:3] == b"RTX":
             raise ProtocolMismatch(
                 f"peer speaks xlang wire v{magic[3]}, this process speaks "
@@ -291,6 +351,24 @@ def _frame(obj, mac: Optional[_FrameMac] = None) -> bytes:
     if mac is not None:
         out += mac.seal(body)
     return out
+
+
+def _write_raw(writer, mac: Optional[_FrameMac], kind: int,
+               msg_id: Optional[int], method: str, m, payload) -> None:
+    """Queue one RTR frame on `writer` (caller drains under its send lock).
+
+    The bulk payload is written as its own segment — never concatenated
+    into an intermediate buffer, never pickled; the MAC streams over the
+    segments via seal_parts."""
+    mb = method.encode()
+    head = (_R_PRE.pack(kind, msg_id or 0, len(mb)) + mb
+            + _R_MLEN.pack(len(m)) + bytes(m))
+    writer.write(_HDR.pack(_R_MAGIC, len(head) + len(payload)))
+    writer.write(head)
+    if len(payload):
+        writer.write(payload)
+    if mac is not None:
+        writer.write(mac.seal_parts(head, payload))
 
 
 class RpcServer:
@@ -414,7 +492,10 @@ class RpcServer:
 
                 if await chaos().intercept_server(method):
                     return  # injected drop: caller times out (rpc_chaos.cc)
-            result = await handler(conn, **data)
+            if isinstance(data, Raw):
+                result = await handler(conn, data.m, data.payload)
+            else:
+                result = await handler(conn, **data)
             if msg_id is not None:
                 await conn.send((KIND_REPLY, msg_id, method, result))
         except Exception as e:
@@ -458,6 +539,12 @@ class ServerConnection:
         async with self._lock:
             # Sealing must happen under the lock: the MAC sequence number
             # must match the byte order frames hit the socket in.
+            if isinstance(payload[3], Raw) and not self.xlang:
+                kind, msg_id, method, pdata = payload
+                _write_raw(self.writer, self._mac, kind, msg_id,
+                           method or "", pdata.m, pdata.payload)
+                await self.writer.drain()
+                return
             if self.xlang:
                 from ray_tpu.runtime import xlang
 
@@ -725,6 +812,42 @@ class RpcClient:
         if timeout is not None:
             return await asyncio.wait_for(fut, timeout)
         return await fut
+
+    async def call_raw(self, method: str, m: bytes = b"", payload=b"",
+                       timeout: Optional[float] = None):
+        """Zero-pickle call: ships a schema'd header `m` (wire.Message
+        bytes) plus an out-of-band bulk `payload` as one RTR frame and
+        returns `(m_reply, payload_view)`. Neither direction runs pickle;
+        the reply payload is a memoryview over the receive buffer. A
+        handler error (including "no handler" on an old peer) surfaces as
+        the usual pickled error reply — callers catch RpcError and fall
+        back to the legacy method."""
+        if self._closed or self._dead:
+            raise ConnectionLost(
+                f"connection to {self.host}:{self.port} "
+                + ("closed" if self._closed else "lost"))
+        if _chaos_enabled():
+            from ray_tpu.runtime.chaos import chaos
+
+            await chaos().intercept_client(method)
+        self._next_id += 1
+        msg_id = self._next_id
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[msg_id] = fut
+        try:
+            async with self._lock:
+                _write_raw(self._writer, self._mac, KIND_REQUEST, msg_id,
+                           method, m, payload)
+                await self._writer.drain()
+        except (ConnectionResetError, OSError) as e:
+            self._pending.pop(msg_id, None)
+            self._dead = True
+            raise ConnectionLost(str(e))
+        data = await (asyncio.wait_for(fut, timeout)
+                      if timeout is not None else fut)
+        if isinstance(data, Raw):
+            return data.m, data.payload
+        return data, b""  # peer answered with a pickled reply: tolerate
 
     async def push(self, method: str, **data):
         async with self._lock:

@@ -470,12 +470,20 @@ class LeaseReplyMsg(Message):
     worker_host = Field(9, STR)
     worker_port = Field(10, INT, default=-1)
     node_id = Field(11, BYTES)
+    # Batch extension: which request this reply resolves (echoes the
+    # LeaseRequestMsg.req_id), and whether the entry is still queued at
+    # the raylet — a pending entry's real resolution arrives later as a
+    # `lease_grant` push on the same connection.
+    req_id = Field(12, BYTES)
+    pending = Field(13, BOOL)
 
     @classmethod
     def from_reply(cls, reply: dict) -> "LeaseReplyMsg":
         msg = cls(ok=bool(reply.get("ok")),
                   error=str(reply.get("error") or ""),
-                  canceled=bool(reply.get("canceled")))
+                  canceled=bool(reply.get("canceled")),
+                  pending=bool(reply.get("pending")),
+                  req_id=reply.get("req_id") or b"")
         sb = reply.get("spillback")
         if sb:
             msg.spillback_host, msg.spillback_port = str(sb[0]), int(sb[1])
@@ -493,6 +501,10 @@ class LeaseReplyMsg(Message):
         reply: Dict[str, Any] = {"ok": self.ok}
         if self.canceled:
             reply["canceled"] = True
+        if self.pending:
+            reply["pending"] = True
+        if self.req_id:
+            reply["req_id"] = self.req_id
         if self.error:
             reply["error"] = self.error
         if self.spillback_port >= 0:
@@ -597,3 +609,114 @@ class TaskReplyMsg(Message):
         if self.streamed >= 0:
             reply["streamed"] = self.streamed
         return reply
+
+
+# ------------------------------------------------- control-plane batching
+#
+# One framed message per tick/pump instead of N per-item RPCs. These ride
+# the same TLV rules as everything above: unknown fields skip, absent
+# fields default, numbers are forever.
+
+class LeaseBatchRequestMsg(Message):
+    """A pump's worth of lease requests, granted in ONE scheduling pass.
+
+    The raylet enqueues every entry, runs a single `_dispatch_pending()`,
+    and replies immediately: entries resolved by that pass (grant, error,
+    spillback) come back in `entries`; everything still queued is listed
+    in `pending` and resolves later via a `lease_grant` push carrying a
+    LeaseReplyMsg with the matching req_id. Waiting for all entries in
+    the reply would deadlock — a speculative lease behind a running task
+    only grants after that task finishes, which needs the reply."""
+
+    entries = Field(1, LIST(MSG(LeaseRequestMsg)))
+
+
+class LeaseBatchReplyMsg(Message):
+    entries = Field(1, LIST(MSG(LeaseReplyMsg)))  # resolved now (req_id set)
+    pending = Field(2, LIST(BYTES))               # req_ids still queued
+    error = Field(3, STR)
+
+
+class TaskEventMsg(Message):
+    """One task state transition (gcs.proto TaskEvents analog)."""
+
+    task_id = Field(1, STR)     # hex
+    name = Field(2, STR)
+    state = Field(3, STR)
+    actor_id = Field(4, STR)    # hex, "" = not an actor task
+    worker = Field(5, STR)
+    time = Field(6, FLOAT)
+    error = Field(7, STR)
+
+    @classmethod
+    def from_event(cls, ev: dict) -> "TaskEventMsg":
+        return cls(task_id=ev.get("task_id") or "",
+                   name=ev.get("name") or "",
+                   state=ev.get("state") or "",
+                   actor_id=ev.get("actor_id") or "",
+                   worker=ev.get("worker") or "",
+                   time=float(ev.get("time") or 0.0),
+                   error=str(ev.get("error") or ""))
+
+    def to_event(self) -> dict:
+        return {"task_id": self.task_id, "name": self.name,
+                "state": self.state,
+                "actor_id": self.actor_id or None,
+                "worker": self.worker, "time": self.time,
+                "error": self.error or None}
+
+
+class TaskEventBatchMsg(Message):
+    """One flusher tick: every buffered event + the wait-edge snapshot +
+    the drop count in a single typed frame (replaces N dict-pickles)."""
+
+    events = Field(1, LIST(MSG(TaskEventMsg)))
+    reporter = Field(2, STR)
+    node_id = Field(3, BYTES)
+    # wait_edges semantics match the pickled handler: has_wait_edges=False
+    # means "no update", True with an empty list means "clear".
+    has_wait_edges = Field(4, BOOL)
+    wait_edges = Field(5, ANY)
+    dropped = Field(6, INT)     # events trimmed from the buffer since last tick
+
+
+class MetricsReportMsg(Message):
+    """One metrics flush tick: the node/pid-scoped snapshot as one typed
+    frame (same JSON payload the kv_put path shipped, minus the pickle)."""
+
+    node = Field(1, STR)
+    pid = Field(2, INT)
+    payload = Field(3, BYTES)   # JSON snapshot_all() bytes
+
+
+# --------------------------------------------------- zero-pickle transfer
+#
+# Object pull/push headers for the raw-frame RPC fast path: the chunk
+# bytes ride OUT-OF-BAND as the frame payload (never pickled, received
+# straight off the socket), only this small header is schema-encoded.
+
+class ObjChunkRequestMsg(Message):
+    oid = Field(1, BYTES)
+    offset = Field(2, INT)
+    length = Field(3, INT)
+
+
+class ObjChunkReplyMsg(Message):
+    found = Field(1, BOOL)
+    total = Field(2, INT)
+    metadata = Field(3, BYTES)
+    error = Field(4, STR)
+
+
+class ObjPutMsg(Message):
+    oid = Field(1, BYTES)
+    offset = Field(2, INT)
+    total = Field(3, INT)
+    metadata = Field(4, BYTES)
+    seal = Field(5, BOOL)
+
+
+class AckMsg(Message):
+    ok = Field(1, BOOL)
+    error = Field(2, STR)
+    existed = Field(3, BOOL)

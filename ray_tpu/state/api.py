@@ -283,6 +283,14 @@ def summary() -> Dict:
         out["spilled_bytes"] = sum(
             s.get("spilled_bytes", 0) for s in stats)
     try:
+        tes = _gcs_call("task_event_stats")
+        out["task_events_dropped"] = tes.get("events_dropped_total", 0)
+        out["task_event_shards"] = tes.get("shards", 0)
+    except Exception:
+        # Older GCS without the sharded task-event plane: leave the keys
+        # out rather than fail the whole summary.
+        pass
+    try:
         llm = llm_serving_summary()
         if llm:
             out["llm_serving"] = llm

@@ -7,6 +7,12 @@ raylets (runtime/raylet handle_fetch_and_relay): depth O(log_f n), and no
 node uploads more than f copies — the owner is not a bottleneck. After
 broadcast, tasks on any node read the object zero-copy from their local
 store instead of pulling on demand.
+
+Each relay hop moves the object over the raw-frame object plane
+(raylet._pull_from -> handle_pull_object_raw): chunks ride as framed
+payload bytes straight from the store arena into a preallocated receive
+buffer, so a 1 GiB broadcast never materializes an intermediate pickle of
+the object on any hop (see docs/control_plane.md).
 """
 
 from __future__ import annotations

@@ -216,6 +216,9 @@ def _maybe_flush():
         pass  # metrics must never break the application
 
 
+_typed_report = True
+
+
 def flush():
     from ray_tpu.core import worker as worker_mod
 
@@ -223,12 +226,33 @@ def flush():
         return
     core = worker_mod.global_worker()
     node = core.node_id.hex() if getattr(core, "node_id", None) else "unknown"
-    key = f"metrics:{node}:{os.getpid()}".encode()
     payload = json.dumps(snapshot_all()).encode()
     # Fire-and-forget: inc()/set() run on arbitrary threads INCLUDING the io
     # loop itself (e.g. _complete_task on the actor submit path); blocking on
     # the push here would deadlock the loop against its own flush.
-    core.io.spawn(core.gcs.call("kv_put", key=key, value=payload))
+    core.io.spawn(_push_snapshot(core, node, payload))
+
+
+async def _push_snapshot(core, node: str, payload: bytes):
+    """One typed MetricsReportMsg frame per flush (the GCS files it under
+    the same metrics:<node>:<pid> KV key); pickled kv_put against an old
+    GCS."""
+    global _typed_report
+    if _typed_report:
+        from ray_tpu.runtime import wire
+        from ray_tpu.runtime.rpc import ConnectionLost, RpcError
+
+        msg = wire.MetricsReportMsg(node=node, pid=os.getpid(),
+                                    payload=payload)
+        try:
+            await core.gcs.call("report_metrics2", m=msg.encode())
+            return
+        except RpcError as e:
+            if isinstance(e, ConnectionLost) or "no handler" not in str(e):
+                raise
+            _typed_report = False
+    key = f"metrics:{node}:{os.getpid()}".encode()
+    await core.gcs.call("kv_put", key=key, value=payload)
 
 
 def prometheus_text(snapshots: List[Dict]) -> str:

@@ -152,3 +152,27 @@ def test_reference_scale_objects_in_one_get(cluster):
     out = ray_tpu.get(refs, timeout=1800)
     assert len(out) == 10_000
     assert int(out[7777][0]) == 7777
+
+
+# ---- control-plane scale envelope (batched leases, 1k fake nodes) --------
+
+def test_time_to_first_lease_1k_fake_nodes():
+    """Fast-tier control-plane envelope: with 1000 fake node records live
+    in the GCS (full view synced to the raylet), the first lease of a
+    64-entry LeaseBatchRequestMsg must still grant promptly — the path
+    must be O(shard)/O(batch), not O(cluster). Anything approaching the
+    60s line belongs behind the slow marker, so the bound asserts far
+    below it. Shares the harness with the microbench suite so the test
+    and the recorded MICROBENCH.json legs measure the same thing."""
+    from ray_tpu.util.microbenchmark import run_scale_envelope
+
+    legs = run_scale_envelope(n_requests=64, fake_nodes=1000, trials=1)
+    ttfl = legs["time_to_first_lease_1k_fake_nodes"]["value"]
+    assert ttfl < 60.0, f"time to first lease {ttfl:.3f}s breaches envelope"
+    # Batched leasing must not LOSE to per-item round-trips (generous
+    # slack: this guards against the batch path breaking/falling back,
+    # not against scheduler jitter on a loaded CI box).
+    batched = legs["sched_tasks_per_s"]["value"]
+    per_item = legs["sched_tasks_per_s_per_item"]["value"]
+    assert batched > 0 and per_item > 0
+    assert batched >= 0.5 * per_item, (batched, per_item)

@@ -287,3 +287,120 @@ def test_typed_push_falls_back_on_old_peer():
     assert reply == {"status": "ok", "returns": []}
     assert calls == ["push_task2", "push_task"]
     assert "push_task" not in shim._typed_methods  # remembered: no re-probe
+
+def test_lease_batch_schemas_round_trip():
+    """LeaseBatchRequestMsg / LeaseBatchReplyMsg — the coalesced lease
+    envelope (one frame per pump, spillback/grant verdicts per entry)."""
+    req = wire.LeaseBatchRequestMsg(entries=[
+        wire.LeaseRequestMsg(resources={"CPU": 1.0}, req_id=b"r1" * 4),
+        wire.LeaseRequestMsg(resources={"TPU": 4.0}, req_id=b"r2" * 4,
+                             env_key="env-a", bundle_index=2,
+                             placement_group_id=b"p" * 14)])
+    back = wire.LeaseBatchRequestMsg.decode(req.encode())
+    assert back == req
+    assert back.entries[1].env_key == "env-a"
+
+    inline = wire.LeaseReplyMsg.from_reply(
+        {"ok": True, "lease_id": b"l" * 8, "worker_id": b"w" * 12,
+         "worker_address": ("127.0.0.1", 40001), "node_id": b"n" * 14})
+    inline.req_id = b"r1" * 4
+    rep = wire.LeaseBatchReplyMsg(entries=[inline],
+                                  pending=[b"r2" * 4, b"r3" * 4])
+    back = wire.LeaseBatchReplyMsg.decode(rep.encode())
+    assert back == rep
+    assert back.entries[0].req_id == b"r1" * 4
+    assert back.entries[0].to_reply()["ok"] is True
+    assert back.pending == [b"r2" * 4, b"r3" * 4]
+
+    # The per-entry pending/req_id additions to LeaseReplyMsg survive the
+    # dict round trip used by the worker's waiter table.
+    pend = wire.LeaseReplyMsg.from_reply(
+        {"ok": False, "pending": True, "req_id": b"q" * 8})
+    back = wire.LeaseReplyMsg.decode(pend.encode())
+    assert back.pending is True and back.req_id == b"q" * 8
+    assert back.to_reply()["pending"] is True
+
+
+def test_lease_batch_forward_compat():
+    """A newer submitter's extra batch fields skip cleanly on an old
+    raylet's decoder (field numbers are forever; unknowns skip)."""
+
+    class LeaseBatchRequestMsgV2(wire.LeaseBatchRequestMsg):
+        deadline_ms = Field(9, INT)          # future addition
+        submitter = Field(10, STR)
+
+    data = LeaseBatchRequestMsgV2(
+        entries=[wire.LeaseRequestMsg(resources={"CPU": 1.0},
+                                      req_id=b"a" * 8)],
+        deadline_ms=250, submitter="w-1").encode()
+    back = wire.LeaseBatchRequestMsg.decode(data)
+    assert len(back.entries) == 1
+    assert back.entries[0].resources == {"CPU": 1.0}
+
+    class LeaseBatchReplyMsgV2(wire.LeaseBatchReplyMsg):
+        queue_depth = Field(9, INT)
+
+    data = LeaseBatchReplyMsgV2(pending=[b"b" * 8],
+                                queue_depth=40).encode()
+    back = wire.LeaseBatchReplyMsg.decode(data)
+    assert back.pending == [b"b" * 8] and back.entries == []
+
+
+def test_task_event_batch_round_trip():
+    """TaskEventBatchMsg — one flusher tick as one typed frame: events,
+    piggybacked wait edges, and the buffer-overflow drop count."""
+    ev = {"task_id": "ab" * 10, "name": "work", "state": "RUNNING",
+          "actor_id": None, "worker": "worker:1234", "time": 12.5,
+          "error": None}
+    msg = wire.TaskEventBatchMsg(
+        events=[wire.TaskEventMsg.from_event(ev)], reporter="worker:1234",
+        node_id=b"n" * 14, has_wait_edges=True,
+        wait_edges=[{"kind": "object", "oid": "ff" * 10}], dropped=17)
+    back = wire.TaskEventBatchMsg.decode(msg.encode())
+    assert back == msg
+    assert back.events[0].to_event() == ev
+    assert back.dropped == 17 and back.has_wait_edges is True
+
+    # has_wait_edges=False (no update) is distinct from True + empty
+    # (clear) — the tri-state the pickled handler used None for.
+    no_update = wire.TaskEventBatchMsg(events=[], reporter="w")
+    back = wire.TaskEventBatchMsg.decode(no_update.encode())
+    assert back.has_wait_edges is False and back.wait_edges is None
+
+
+def test_task_event_batch_forward_compat():
+    class TaskEventBatchMsgV2(wire.TaskEventBatchMsg):
+        flush_seq = Field(9, INT)            # future addition
+
+    data = TaskEventBatchMsgV2(
+        events=[wire.TaskEventMsg.from_event(
+            {"task_id": "aa", "name": "n", "state": "FINISHED",
+             "worker": "w", "time": 1.0})],
+        dropped=3, flush_seq=99).encode()
+    back = wire.TaskEventBatchMsg.decode(data)
+    assert back.dropped == 3
+    assert back.events[0].state == "FINISHED"
+    assert not hasattr(back, "flush_seq")
+
+
+def test_object_plane_raw_schemas_round_trip():
+    """ObjChunkRequestMsg/ObjChunkReplyMsg/ObjPutMsg/AckMsg — the typed
+    heads of the zero-pickle object frames (the chunk bytes themselves
+    ride as the raw-frame payload, outside the schema)."""
+    req = wire.ObjChunkRequestMsg(oid=b"o" * 20, offset=4 << 20,
+                                  length=1 << 20)
+    assert wire.ObjChunkRequestMsg.decode(req.encode()) == req
+
+    rep = wire.ObjChunkReplyMsg(found=True, total=64 << 20,
+                                metadata=b"meta")
+    assert wire.ObjChunkReplyMsg.decode(rep.encode()) == rep
+
+    put = wire.ObjPutMsg(oid=b"o" * 20, offset=8, total=128,
+                         metadata=b"m", seal=True)
+    assert wire.ObjPutMsg.decode(put.encode()) == put
+
+    ack = wire.AckMsg(ok=False, error="store full", existed=False)
+    assert wire.AckMsg.decode(ack.encode()) == ack
+
+    rep = wire.MetricsReportMsg(node="ab" * 8, pid=4242, payload=b"[]")
+    assert wire.MetricsReportMsg.decode(rep.encode()) == rep
