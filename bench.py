@@ -57,6 +57,18 @@ def _slim(obj, max_str=200):
     return obj
 
 
+def _actual_backend() -> str:
+    """The backend jax actually resolved in THIS process — recorded in
+    every emitted result so a BENCH_*.json can never claim TPU numbers
+    that silently ran on CPU (or vice versa)."""
+    try:
+        import jax
+
+        return str(jax.default_backend())
+    except Exception:
+        return "unknown"
+
+
 def _emit(result: dict) -> None:
     """Write the fat result (+ probe log) to the sidecar, print a slim line.
 
@@ -64,6 +76,7 @@ def _emit(result: dict) -> None:
     stripped, and as a last resort the detail dict is replaced wholesale
     rather than ever exceeding ~4 KB (r2's slim line parsed; r3's fat one
     did not — this path can no longer regress that way)."""
+    result.setdefault("backend", _actual_backend())
     fat = dict(result)
     fat.setdefault("detail", {})
     fat["detail"] = dict(fat["detail"])

@@ -152,6 +152,28 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                          "allreduce_gradients; each per-dtype bucket "
                          "launches its ring allreduce as it fills so "
                          "reduction overlaps the remaining flatten work"),
+    # -- rlhf --------------------------------------------------------------
+    "rlhf_placement_check_interval": (int, 1,
+                                      "PPO iterations between adaptive "
+                                      "placement evaluations"),
+    "rlhf_rollout_frac_high": (float, 0.60,
+                               "rollout share of iteration wall time above "
+                               "which the adaptive policy disaggregates "
+                               "(generation dominates: give the generator "
+                               "its own gang and KV pool)"),
+    "rlhf_rollout_frac_low": (float, 0.35,
+                              "rollout share below which the adaptive "
+                              "policy re-colocates (updates dominate: "
+                              "reclaim the slice, cheap in-place sync)"),
+    "rlhf_kv_pressure_high": (float, 0.75,
+                              "KV pool occupancy fraction treated as "
+                              "generator memory pressure; at/above this a "
+                              "colocated generator disaggregates even if "
+                              "rollout time alone would not justify it"),
+    "rlhf_placement_min_dwell": (int, 2,
+                                 "iterations a placement mode must persist "
+                                 "before the policy may switch again "
+                                 "(hysteresis against signal flapping)"),
     # -- train -------------------------------------------------------------
     "train_poll_interval_s": (float, 0.2, "controller worker poll period"),
     "train_elastic_check_interval_s": (float, 10.0,

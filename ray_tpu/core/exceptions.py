@@ -73,6 +73,15 @@ def actor_death_error(actor_id_hex: str, reason: str) -> ActorDiedError:
     return ActorDiedError(actor_id_hex, reason)
 
 
+class WeightSyncError(RayTpuError):
+    """A weight hot-swap payload failed validation against the loaded model
+    (pytree structure, leaf shape, or dtype mismatch — or the engine was
+    mid-generation). Raised by `LLMEngine.update_weights` BEFORE any state
+    is touched, so the next prefill never runs against a half-applied tree;
+    the RLHF weight-sync path surfaces it to the trainer instead of the
+    failure appearing deep inside paged attention."""
+
+
 class CollectiveAbortError(RayTpuError):
     """A blocking collective op was aborted — the group's abort flag was
     set (locally, via the GCS KV, or by the peer-liveness watchdog after a

@@ -1,3 +1,4 @@
+from ray_tpu.core.exceptions import WeightSyncError  # noqa: F401
 from ray_tpu.llm.engine import (  # noqa: F401
     LLMEngine,
     RequestOutput,
@@ -15,7 +16,8 @@ from ray_tpu.llm.serving import (  # noqa: F401
 )
 
 __all__ = [
-    "LLMEngine", "RequestOutput", "prefix_digest_chain", "SamplingParams",
+    "LLMEngine", "RequestOutput", "WeightSyncError", "prefix_digest_chain",
+    "SamplingParams",
     "LLMConfig", "LLMServer", "RequestTimeoutError", "build_engine",
     "build_llm_deployment", "build_openai_app", "build_routed_app",
 ]

@@ -236,6 +236,22 @@ class BlockManager:
         self.cached[h] = bid
         self.block_hash[bid] = h
 
+    def invalidate_prefix_cache(self) -> int:
+        """Drop EVERY cached prefix mapping: cached KV was computed under
+        the previous weights, so after a weight hot-swap a prefix hit would
+        silently decode against stale activations. Parked reusable blocks
+        return to the free pool outright; blocks still referenced by live
+        sequences merely lose content-addressability (their normal release
+        now routes to `free` since their hash entry is gone). Returns the
+        number of cache entries dropped."""
+        n = len(self.cached)
+        self.cached.clear()
+        self.block_hash.clear()
+        while self.reusable:
+            bid, _ = self.reusable.popitem(last=False)
+            self.free.append(bid)
+        return n
+
     # ---- disaggregated handoff (llm/disagg.py) ---------------------------
 
     def adopt_blocks(self, n: int) -> Optional[List[int]]:
@@ -317,6 +333,9 @@ class LLMEngine:
         # token sampled) park in `running` until export_request hands them
         # to a decode replica.
         self.prefill_only = bool(prefill_only)
+        # Bumped by update_weights (RLHF weight sync); rollout experiences
+        # record the version they were sampled under.
+        self.weights_version = 0
 
     # ---- API -------------------------------------------------------------
 
@@ -422,6 +441,65 @@ class LLMEngine:
                     self._defer_release(req)
                     return True
         return False
+
+    def update_weights(self, params, *, version: Optional[int] = None,
+                       force: bool = False) -> Dict:
+        """Hot-swap the model weights in place (RLHF weight sync).
+
+        Validates the incoming pytree against the loaded model FIRST —
+        structure, per-leaf shape, per-leaf dtype — and raises a typed
+        `WeightSyncError` on any mismatch, so a malformed sync payload
+        surfaces here instead of as a shape error deep inside the next
+        prefill. On success the params are re-placed through the runner's
+        normal placement path (sharded over the mesh when one exists) and
+        the ENTIRE prefix cache is invalidated: cached KV was computed
+        under the old weights and a post-swap prefix hit would be silently
+        wrong. The jitted step programs close over nothing — params are an
+        argument — so an identical-shaped swap triggers no recompiles.
+
+        Refuses (WeightSyncError) while requests are in flight unless
+        `force=True`: an in-flight sequence would mix logits from two
+        policies mid-generation. Drain or abort first (the RLHF trainer
+        syncs between rollout rounds, when the engine is idle).
+        """
+        import jax
+
+        from ray_tpu.core.exceptions import WeightSyncError
+
+        if self.has_unfinished() and not force:
+            raise WeightSyncError(
+                "engine has unfinished requests; drain rollouts before "
+                "swapping weights (or pass force=True)")
+        old_paths, old_def = jax.tree_util.tree_flatten_with_path(
+            self.runner.params)
+        try:
+            new_leaves, new_def = jax.tree.flatten(params)
+        except Exception as exc:
+            raise WeightSyncError(f"weight payload is not a pytree: {exc}")
+        if new_def != old_def:
+            raise WeightSyncError(
+                f"pytree structure mismatch: engine has {old_def}, "
+                f"payload has {new_def}")
+        for (path, old_leaf), new_leaf in zip(old_paths, new_leaves):
+            name = jax.tree_util.keystr(path)
+            old_shape = tuple(old_leaf.shape)
+            new_shape = tuple(np.shape(new_leaf))
+            if old_shape != new_shape:
+                raise WeightSyncError(
+                    f"shape mismatch at {name}: engine {old_shape}, "
+                    f"payload {new_shape}")
+            old_dt = np.dtype(old_leaf.dtype)
+            new_dt = np.dtype(getattr(new_leaf, "dtype", type(new_leaf)))
+            if old_dt != new_dt:
+                raise WeightSyncError(
+                    f"dtype mismatch at {name}: engine {old_dt}, "
+                    f"payload {new_dt}")
+        self.runner.params = self.runner._place_params(params)
+        invalidated = self.block_manager.invalidate_prefix_cache()
+        self.weights_version = (version if version is not None
+                                else self.weights_version + 1)
+        return {"version": self.weights_version,
+                "invalidated_prefix_entries": invalidated}
 
     def stats(self) -> Dict:
         """Scheduler/cache load signal for the serving router: queue depths,

@@ -249,8 +249,10 @@ def _layer(config: LlamaConfig, x, layer_params, cos, sin):
     return x
 
 
-def forward(params: Dict, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
-    """tokens: (b, s) int32 -> logits (b, s, vocab) float32."""
+def backbone(params: Dict, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
+    """tokens: (b, s) int32 -> final-norm hidden states (b, s, d) in
+    config.dtype — everything `forward` computes except the lm_head
+    projection. Value heads and reward models (rlhf/) hang off this."""
     from ray_tpu.parallel.sharding import constrain
 
     cos, sin = rope_frequencies(config.head_dim, config.max_seq, config.rope_theta)
@@ -288,7 +290,14 @@ def forward(params: Dict, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    x = constrain(x, ("batch", "seq", None))
+    return constrain(x, ("batch", "seq", None))
+
+
+def forward(params: Dict, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
+    """tokens: (b, s) int32 -> logits (b, s, vocab) float32."""
+    from ray_tpu.parallel.sharding import constrain
+
+    x = backbone(params, tokens, config)
     # lm_head: gather the fsdp (embed/contracting) factor, keep vocab on tp.
     lm_head = constrain(params["lm_head"], (None, "vocab"))
     logits = (x @ lm_head.astype(config.dtype)).astype(jnp.float32)

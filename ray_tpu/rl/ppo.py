@@ -82,6 +82,30 @@ def compute_gae(rewards, values, dones, last_value, gamma, lam):
     return advs, advs + values
 
 
+def token_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """log p(token) under `logits`: (..., T, V) float logits and (..., T)
+    int32 token ids -> (..., T). Pure; shared by the RLHF learner (policy,
+    behavior, and reference logprobs all come through here so the three are
+    computed identically)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]
+
+
+def kl_from_logprobs(logp: jax.Array, logp_ref: jax.Array) -> jax.Array:
+    """Per-token sampled KL estimate between the policy that produced the
+    tokens and a reference policy: E_pi[log pi - log ref] sampled at the
+    taken token (the k1 estimator RLHF reward shaping uses). Positive in
+    expectation; per-token so it can be folded into per-token rewards."""
+    return logp - logp_ref
+
+
+def masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
+    """Mean of `x` over positions where `mask` is 1 (variable-length
+    response tokens inside a padded batch)."""
+    mask = mask.astype(x.dtype)
+    return (x * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
 def ppo_loss(params, batch, config: PPOConfig):
     logits, values = policy_forward(params, batch["obs"])
     logp_all = jax.nn.log_softmax(logits)

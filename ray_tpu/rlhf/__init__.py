@@ -1,0 +1,46 @@
+"""RLHF pipeline: serving-engine rollouts + Train learners with adaptive
+colocated/disaggregated placement. See docs/rlhf.md."""
+
+from ray_tpu.core.exceptions import WeightSyncError  # noqa: F401
+from ray_tpu.rlhf.placement import (  # noqa: F401
+    COLOCATED,
+    DISAGGREGATED,
+    PlacementDecision,
+    PlacementPolicy,
+)
+from ray_tpu.rlhf.rollout import (  # noqa: F401
+    Experience,
+    RolloutCoordinator,
+    RolloutReplica,
+    default_reward,
+    rollout_seed,
+    run_rollout_round,
+)
+from ray_tpu.rlhf.trainer import (  # noqa: F401
+    ADAPTIVE,
+    LearnerWorker,
+    RLHFConfig,
+    RLHFTrainer,
+    default_prompt_fn,
+)
+from ray_tpu.rlhf import weight_sync  # noqa: F401
+
+__all__ = [
+    "ADAPTIVE",
+    "COLOCATED",
+    "DISAGGREGATED",
+    "Experience",
+    "LearnerWorker",
+    "PlacementDecision",
+    "PlacementPolicy",
+    "RLHFConfig",
+    "RLHFTrainer",
+    "RolloutCoordinator",
+    "RolloutReplica",
+    "WeightSyncError",
+    "default_prompt_fn",
+    "default_reward",
+    "rollout_seed",
+    "run_rollout_round",
+    "weight_sync",
+]

@@ -1,0 +1,115 @@
+"""Adaptive placement policy: colocate vs disaggregate, decided at runtime.
+
+Per the adaptive-placement RLHF result (PAPERS.md #5), the
+generator/learner placement decision dominates RLHF throughput and the
+right answer changes MID-RUN as response lengths and KV pressure drift.
+The policy reads two live signals, both already produced by this repo's
+planes:
+
+  * the telemetry plane's rollout-vs-update phase breakdown — the RLHF
+    trainer books rollout seconds to the step's `data_s` phase and update
+    seconds to `compute_s` (train/telemetry.py record shape), so
+    rollout_frac = rollout / (rollout + update) is the goodput signal;
+  * the serving engine's `engine_stats()` KV occupancy — a colocated
+    generator sharing a slice with the learner starves for KV blocks
+    long before rollout latency shows it.
+
+Decision rule (hysteresis both in thresholds and in time):
+
+    colocated --[rollout_frac >= high  OR  kv_pressure >= kv_high]-->
+        disaggregated   (generation dominates: dedicated gang + KV pool)
+    disaggregated --[rollout_frac <= low  AND  kv_pressure < kv_high]-->
+        colocated       (updates dominate: reclaim the slice, in-place sync)
+
+A switch is only allowed after `min_dwell` iterations in the current
+mode — flapping would pay gang re-formation on every noise spike.
+Thresholds default from the config table (rlhf_* knobs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+COLOCATED = "colocated"
+DISAGGREGATED = "disaggregated"
+MODES = (COLOCATED, DISAGGREGATED)
+
+
+@dataclasses.dataclass
+class PlacementDecision:
+    mode: str                 # mode to run the NEXT iteration in
+    switch: bool              # True when mode != current mode
+    reason: str               # human-readable signal summary
+    rollout_frac: float
+    kv_pressure: float
+
+
+class PlacementPolicy:
+    def __init__(self, *, rollout_frac_high: Optional[float] = None,
+                 rollout_frac_low: Optional[float] = None,
+                 kv_pressure_high: Optional[float] = None,
+                 min_dwell: Optional[int] = None):
+        from ray_tpu.config import cfg
+
+        c = cfg()
+        self.high = (rollout_frac_high if rollout_frac_high is not None
+                     else c.rlhf_rollout_frac_high)
+        self.low = (rollout_frac_low if rollout_frac_low is not None
+                    else c.rlhf_rollout_frac_low)
+        self.kv_high = (kv_pressure_high if kv_pressure_high is not None
+                        else c.rlhf_kv_pressure_high)
+        self.min_dwell = (min_dwell if min_dwell is not None
+                          else c.rlhf_placement_min_dwell)
+        if not (0.0 <= self.low <= self.high <= 1.0):
+            raise ValueError(
+                f"need 0 <= low <= high <= 1, got low={self.low} "
+                f"high={self.high}")
+        self._dwell = 0  # iterations since the last switch (or start)
+
+    @staticmethod
+    def kv_pressure(engine_stats: Optional[dict]) -> float:
+        """KV pool occupancy in [0, 1] from an `engine.stats()` dict."""
+        if not engine_stats:
+            return 0.0
+        total = float(engine_stats.get("total_kv_blocks", 0) or 0)
+        if total <= 0:
+            return 0.0
+        free = float(engine_stats.get("free_kv_blocks", 0) or 0)
+        return max(0.0, min(1.0, 1.0 - free / total))
+
+    def decide(self, rollout_s: float, update_s: float,
+               engine_stats: Optional[dict],
+               current_mode: str) -> PlacementDecision:
+        """One evaluation tick. Callers invoke this once per
+        `rlhf_placement_check_interval` iterations with the LAST
+        iteration's phase seconds; the dwell counter advances per call."""
+        if current_mode not in MODES:
+            raise ValueError(f"unknown mode {current_mode!r}")
+        busy = rollout_s + update_s
+        frac = rollout_s / busy if busy > 0 else 0.0
+        kv = self.kv_pressure(engine_stats)
+        self._dwell += 1
+
+        target = current_mode
+        reason = f"rollout_frac={frac:.2f} kv_pressure={kv:.2f} (hold)"
+        if current_mode == COLOCATED and (frac >= self.high
+                                          or kv >= self.kv_high):
+            target = DISAGGREGATED
+            reason = (f"rollout_frac={frac:.2f}>={self.high}"
+                      if frac >= self.high
+                      else f"kv_pressure={kv:.2f}>={self.kv_high}")
+        elif current_mode == DISAGGREGATED and (frac <= self.low
+                                                and kv < self.kv_high):
+            target = COLOCATED
+            reason = f"rollout_frac={frac:.2f}<={self.low}"
+
+        if target != current_mode and self._dwell < self.min_dwell:
+            return PlacementDecision(current_mode, False,
+                                     f"dwell {self._dwell}/{self.min_dwell} "
+                                     f"(wanted {target}: {reason})",
+                                     frac, kv)
+        if target != current_mode:
+            self._dwell = 0
+        return PlacementDecision(target, target != current_mode, reason,
+                                 frac, kv)

@@ -25,6 +25,10 @@ Emitters in-tree:
   * llm router — LLM_REQUEST_SHED (SLO admission rejected a request;
                  labels carry the projected TTFT vs the SLO so
                  `scripts events` explains shedding during incidents)
+  * rlhf       — RLHF_PLACEMENT_SWITCH (the adaptive placement policy
+                 moved generator/learner between colocated and
+                 disaggregated; labels carry from/to mode, the switch
+                 epoch, and the goodput reason)
 
 Read back via `state.list_cluster_events()`, the dashboard
 `/api/events` route, or `python -m ray_tpu.scripts events`.
@@ -53,9 +57,10 @@ TRAIN_GANG_RESTART = "TRAIN_GANG_RESTART"
 TASK_STALLED = "TASK_STALLED"
 DEADLOCK_DETECTED = "DEADLOCK_DETECTED"
 LLM_REQUEST_SHED = "LLM_REQUEST_SHED"
+RLHF_PLACEMENT_SWITCH = "RLHF_PLACEMENT_SWITCH"
 EVENT_TYPES = (NODE_DEAD, SLICE_LOST, OOM_KILL, COLLECTIVE_ABORT,
                AUTOSCALER_SCALE, TRAIN_GANG_RESTART, TASK_STALLED,
-               DEADLOCK_DETECTED, LLM_REQUEST_SHED)
+               DEADLOCK_DETECTED, LLM_REQUEST_SHED, RLHF_PLACEMENT_SWITCH)
 
 
 def make_event(event_type: str, message: str, *,

@@ -8,6 +8,7 @@ from ray_tpu.train.callbacks import (  # noqa: F401
     WandbLoggerCallback,
 )
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager  # noqa: F401
+from ray_tpu.train.learner import QueueLearnerLoop  # noqa: F401
 from ray_tpu.train.config import (  # noqa: F401
     CheckpointConfig,
     FailureConfig,

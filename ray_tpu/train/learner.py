@@ -1,0 +1,104 @@
+"""Queue-driven learner loop: decouple experience production from updates.
+
+Reference analog: ray.train's async data-ingest loops and the
+learner-thread pattern of RLlib's async algorithms (IMPALA/APPO): a
+producer (the RLHF rollout plane, a data pipeline, a replay buffer) pushes
+batches into an EXTERNAL queue (`util/queue.py` — any worker in the
+cluster can feed it) and a background loop drains it in FIFO order,
+applying each batch through a caller-supplied callable (which typically
+fans the batch out to a collective worker gang and allreduces gradients).
+
+The loop is deliberately dumb: no retries, no reordering. FIFO application
+is what makes sequence-number ledger proofs possible — the RLHF trainer
+counter-proves "no experience lost or duplicated across a placement
+switch" by comparing the set of seq_nos this loop consumed against the
+set the rollout coordinator issued.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+# Pushed by a producer to end the loop after everything queued ahead of it
+# has been applied (a drain barrier, not an abort).
+STOP = "__learner_stop__"
+
+
+class QueueLearnerLoop:
+    """Drains an experience queue on a background thread, FIFO.
+
+    `apply_fn(batch)` runs on the loop thread for every non-STOP item; an
+    exception stops the loop and is re-raised from `stop()`/`wait_for()`.
+    """
+
+    def __init__(self, queue, apply_fn: Callable[[Any], Any], *,
+                 poll_interval: float = 0.02):
+        self._queue = queue
+        self._apply = apply_fn
+        self._poll = poll_interval
+        self._thread: Optional[threading.Thread] = None
+        self._stop_seen = threading.Event()
+        self._abort = threading.Event()
+        self._lock = threading.Lock()
+        self.updates_applied = 0
+        self.last_error: Optional[BaseException] = None
+
+    def start(self) -> "QueueLearnerLoop":
+        if self._thread is not None:
+            raise RuntimeError("learner loop already started")
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="learner-loop")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._abort.is_set():
+            try:
+                item = self._queue.get_nowait()
+            except Exception:
+                time.sleep(self._poll)
+                continue
+            if isinstance(item, str) and item == STOP:
+                self._stop_seen.set()
+                return
+            try:
+                self._apply(item)
+            except BaseException as exc:  # surfaced via stop()/wait_for()
+                self.last_error = exc
+                self._stop_seen.set()
+                return
+            with self._lock:
+                self.updates_applied += 1
+
+    def wait_for(self, n_updates: int, timeout: float = 120.0) -> int:
+        """Block until at least `n_updates` batches have been applied."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.last_error is not None:
+                raise self.last_error
+            with self._lock:
+                if self.updates_applied >= n_updates:
+                    return self.updates_applied
+            time.sleep(self._poll)
+        raise TimeoutError(
+            f"learner loop applied {self.updates_applied}/{n_updates} "
+            f"updates within {timeout}s")
+
+    def stop(self, drain: bool = True, timeout: float = 60.0):
+        """End the loop. drain=True pushes the STOP sentinel so every batch
+        queued before it is applied first; drain=False aborts immediately
+        (queued batches stay in the queue)."""
+        if self._thread is None:
+            return
+        if drain:
+            self._queue.put(STOP)
+            if not self._stop_seen.wait(timeout):
+                self._abort.set()
+        else:
+            self._abort.set()
+        self._thread.join(timeout)
+        self._thread = None
+        if self.last_error is not None:
+            raise self.last_error

@@ -154,6 +154,9 @@ def run(scale: float = 1.0, num_cpus: int = 4) -> List[Dict]:
         # -- LLM serving plane: router affinity + disaggregation ---------
         results.extend(_bench_serve_mixed(scale))
 
+        # -- RLHF pipeline: colocated vs disaggregated placement ---------
+        results.extend(_bench_rlhf(scale))
+
         # -- control-plane scale envelope: batched vs per-item leases ----
         results.extend(_bench_scale_envelope(scale))
     finally:
@@ -610,6 +613,46 @@ def _bench_serve_mixed(scale: float) -> List[Dict]:
         out.append({"benchmark": f"serve_{name}_itl_p99_ms",
                     "value": round(best * 1e3, 2),
                     "unit": "ms", "n": n, "trials": 2})
+    return out
+
+
+def _bench_rlhf(scale: float) -> List[Dict]:
+    """RLHF pipeline (rlhf/): the full rollout -> PPO update -> weight-sync
+    loop on a tiny fp32 model, once per placement mode.
+
+      * rlhf_colocated_steps_per_s — generator in-process with the driver,
+        weight sync via device-channel hot-swap.
+      * rlhf_disagg_steps_per_s — generator as a dedicated actor, weight
+        sync via object-plane publish + fanout broadcast.
+      * rlhf_weight_sync_ms — mean per-iteration sync latency, one value
+        per mode. The gap between the modes is the sync tax the adaptive
+        placement policy trades against rollout/update goodput.
+    """
+    from ray_tpu.rlhf import RLHFConfig, RLHFTrainer
+
+    out: List[Dict] = []
+    iters = max(2, int(3 * scale))
+    model = dict(vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+                 n_kv_heads=2, d_ff=64, max_seq=128)
+    for mode in ("colocated", "disaggregated"):
+        trainer = RLHFTrainer(RLHFConfig(
+            model_kwargs=model, placement_mode=mode,
+            iterations=iters, prompts_per_iter=2, prompt_len=4,
+            max_new_tokens=4, run_name=f"bench-rlhf-{mode}"))
+        try:
+            t0 = time.perf_counter()
+            result = trainer.run()
+            elapsed = time.perf_counter() - t0
+        finally:
+            trainer.shutdown()
+        tag = "colocated" if mode == "colocated" else "disagg"
+        out.append({"benchmark": f"rlhf_{tag}_steps_per_s",
+                    "value": round(iters / elapsed, 3),
+                    "unit": "steps/s", "n": iters, "trials": 1})
+        sync = result["sync_ms"]
+        out.append({"benchmark": "rlhf_weight_sync_ms",
+                    "value": round(sum(sync) / max(1, len(sync)), 2),
+                    "unit": f"ms ({tag})", "n": len(sync), "trials": 1})
     return out
 
 

@@ -17,6 +17,18 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
 
+# Persistent XLA compilation cache, shared by the driver AND every spawned
+# worker/actor process (env is inherited). The suite compiles the same tiny
+# llama/train programs in dozens of fresh actor processes; on the 1-core CI
+# box those duplicate compiles dominate wall-clock (~40% of a cluster-test's
+# runtime measured). jax keys entries by program + compile options + backend
+# and falls back to compiling on any cache miss/corruption, so this is
+# purely a speedup. Opt out by exporting JAX_COMPILATION_CACHE_DIR=''.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/ray_tpu_test_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+
 # The environment's sitecustomize may have ALREADY imported jax with a TPU
 # plugin (env edits above are then too late for this process): force the
 # in-process config back to CPU and drop any initialized non-CPU backend,
@@ -26,6 +38,11 @@ if "jax" in sys.modules:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # Env edits above came too late for an already-imported jax.
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     try:
         from jax._src import xla_bridge
 

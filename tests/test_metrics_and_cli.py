@@ -159,6 +159,7 @@ def test_metric_registry_lint():
                 f"{info['name']} recorded undeclared tags {used - declared}"
 
 
+@pytest.mark.slow  # >60s measured: full-tier only
 def test_microbenchmark_runs():
     """`ray_tpu microbenchmark` (ray_perf.py analog) produces every core
     metric with positive rates."""

@@ -1,0 +1,117 @@
+"""RLHF chaos: a generator replica's slice dies MID-ROLLOUT.
+
+The pipeline must (1) fail the in-flight generate with the typed slice
+error, (2) re-queue the incomplete seq_nos, (3) re-form the generator
+gang on surviving nodes (fresh weight publish — the gang-restart
+discipline), (4) finish the round with every prompt completed EXACTLY
+once, and (5) leave the SLICE_LOST -> TRAIN_GANG_RESTART event chain in
+`state.list_cluster_events()`."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime.tpu_topology import slice_labels
+
+TINY = dict(vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+            n_kv_heads=2, d_ff=64, max_seq=128)
+
+
+@pytest.mark.chaos
+def test_generator_slice_death_requeues_without_duplicates():
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.rlhf import RLHFConfig, RLHFTrainer
+    from ray_tpu.state import list_cluster_events
+    from ray_tpu.util.fault_injection import SliceKiller
+
+    cluster = Cluster()
+    try:
+        # head: driver, queue, learner gang (pinned via the "learn"
+        # resource so no learner can land on the doomed slice)
+        cluster.add_node(num_cpus=4, resources={"learn": 2})
+        for i in range(2):  # SliceKiller strikes multi-host slices
+            cluster.add_node(num_cpus=2, resources={"gen": 1},
+                             labels=slice_labels("gen-slice", "v5e-16", i))
+        cluster.add_node(num_cpus=2, resources={"genfb": 2})  # survivor
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(4)
+
+        config = RLHFConfig(
+            model_kwargs=TINY, placement_mode="disaggregated",
+            iterations=1, prompts_per_iter=3, prompt_len=4,
+            # Long generations keep the doomed replica's generate RPC in
+            # flight while the killer strikes.
+            max_new_tokens=48, temperature=0.7, seed=7,
+            rollout_get_timeout=120.0,
+            learner_options={"resources": {"learn": 1}},
+            generator_options={"resources": {"gen": 1}},
+            generator_fallback_options={"resources": {"genfb": 1}},
+            run_name="rlhf-chaos")
+        trainer = RLHFTrainer(config)
+        try:
+            trainer._form_learners(None, 0)
+            trainer._form_generators()
+            trainer.coordinator.add_prompts(
+                [[10 + i, 11, 12, 13] for i in range(3)])
+
+            out = {}
+
+            def round_thread():
+                try:
+                    out["exps"] = trainer._rollout_round()
+                except BaseException as exc:  # surfaced by the main thread
+                    out["error"] = exc
+
+            t = threading.Thread(target=round_thread, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 60
+            while (time.monotonic() < deadline
+                   and trainer.coordinator.issued_count == 0):
+                time.sleep(0.05)
+            assert trainer.coordinator.issued_count > 0, \
+                "rollout round never issued work"
+            assert SliceKiller(cluster, slice_name="gen-slice").strike() \
+                is not None
+            t.join(300)
+            assert not t.is_alive(), "rollout round hung after slice death"
+            assert "error" not in out, out.get("error")
+
+            # Exactly once: every prompt produced one experience despite
+            # the mid-flight death; the ledger shows the re-queue and no
+            # duplicate completions slipped through.
+            exps = out["exps"]
+            assert sorted(e.seq_no for e in exps) == [0, 1, 2]
+            ledger = trainer.coordinator.ledger()
+            assert ledger["dup_completions"] == 0
+            assert ledger["requeues"] >= 1
+            assert ledger["pending"] == ledger["issued"] == 0
+            assert trainer.generator_rebuilds >= 1
+            # The re-formed gang landed on the survivor node and carries
+            # freshly published weights the learner gang can still train.
+            trainer._apply_batch(exps)
+            assert trainer.updates_total == 1
+
+            deadline = time.monotonic() + 20
+            got = {}
+            while time.monotonic() < deadline and len(got) < 2:
+                for ev_type in ("SLICE_LOST", "TRAIN_GANG_RESTART"):
+                    if ev_type not in got:
+                        evs = list_cluster_events(event_type=ev_type)
+                        if evs:
+                            got[ev_type] = evs[0]
+                time.sleep(0.2)
+            assert "SLICE_LOST" in got, "no SLICE_LOST event"
+            assert "TRAIN_GANG_RESTART" in got, "no TRAIN_GANG_RESTART event"
+            assert got["TRAIN_GANG_RESTART"]["source"] == "rlhf"
+            assert (got["TRAIN_GANG_RESTART"]["labels"].get("run")
+                    == "rlhf-chaos")
+        finally:
+            trainer.shutdown()
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()

@@ -73,6 +73,7 @@ def test_sse_streaming_through_http_proxy():
     serve.delete("counter")
 
 
+@pytest.mark.slow  # >60s measured: full-tier only
 def test_openai_router_composition():
     """Router deployment -> engine deployment via DeploymentHandle; chat
     completions apply the template; /v1/models lists; unknown model 404s."""

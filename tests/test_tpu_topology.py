@@ -47,6 +47,7 @@ def test_find_contiguous_hosts_rejects_holes():
         (0, b"n3"), (1, b"n4"), (2, b"n5")]
 
 
+@pytest.mark.slow  # >60s measured: full-tier only
 def test_strict_pack_lands_on_one_slice():
     """4-host {TPU:4} bundles on a cluster with one intact 4-host slice, one
     2-host slice, and loose TPU nodes: placed exactly on the intact slice."""
@@ -93,6 +94,7 @@ def test_strict_pack_lands_on_one_slice():
         cluster.shutdown()
 
 
+@pytest.mark.slow  # >60s measured: full-tier only
 def test_strict_pack_rejects_fragmented_slices():
     """Only 2+2 hosts across two slices: a 4-bundle STRICT_PACK group must
     NOT be created (fragmenting would put DCN inside the job's ICI mesh)."""
