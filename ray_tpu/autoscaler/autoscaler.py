@@ -80,6 +80,13 @@ class NodeProvider:
         """Raylet node id for a launched instance, once known (else None)."""
         return None
 
+    def preemption_notices(self) -> List[dict]:
+        """Pending advance-notice preemptions from the cloud's view:
+        [{"instance_id": str, "deadline": unix_ts, "notice_s": float}].
+        The reconciler turns each into a GCS drain + replacement launch.
+        Default: the cloud gives no notice."""
+        return []
+
 
 class FakeMultiNodeProvider(NodeProvider):
     """Launches real raylet subprocesses on this machine (test provider)."""
@@ -148,6 +155,7 @@ class Autoscaler:
         # considered failed and reaped.
         self.boot_grace_s = boot_grace_s
         self._idle_since: Dict[str, float] = {}
+        self._preempt_handled: set = set()
 
     # -- demand ------------------------------------------------------------
 
@@ -215,6 +223,109 @@ class Autoscaler:
             floor = list(getattr(self, "_floor_cache", []))
         return floor
 
+    # -- preemption notices ------------------------------------------------
+
+    def handle_preemption_notice(self, instance_id: str,
+                                 deadline_s: Optional[float] = None,
+                                 reason: str = "spot preemption") -> bool:
+        """React to an advance preemption notice for one instance.
+
+        Two actions, both at NOTICE time (not at the kill): (1) the
+        instance's node enters the GCS DRAINING state with the notice
+        window as its deadline, so the scheduler stops leasing onto it,
+        its raylet migrates primary object copies, and drain-aware
+        consumers (Train/RLHF) checkpoint and re-form proactively;
+        (2) a replacement instance of the same type launches immediately,
+        so replacement capacity races the deadline instead of waiting
+        for the death to create demand. Returns True if the drain was
+        issued. Idempotent per instance."""
+        if instance_id in self._preempt_handled:
+            return False
+        inst = self.instances.get(instance_id)
+        if inst is None:
+            return False
+        self._preempt_handled.add(instance_id)
+        if deadline_s is None:
+            from ray_tpu.config import cfg
+
+            deadline_s = cfg().drain_deadline_default_s
+        if inst.node_id is None:
+            inst.node_id = self.provider.get_node_id(instance_id)
+        drained = False
+        if inst.node_id is not None:
+            from ray_tpu.state.api import _gcs_call
+
+            try:
+                reply = _gcs_call("drain_node", node_id=inst.node_id,
+                                  reason=reason, deadline_s=deadline_s)
+                drained = bool(reply.get("ok"))
+            except Exception as e:
+                logger.warning("drain_node for preempted instance %s "
+                               "failed: %r", instance_id, e)
+        inst.status = "DRAINING"
+        # Replacement launch NOW: every sibling host of a multi-host slice
+        # is preempted with it (the cloud reclaims whole slices) and each
+        # host's notice drains its own node, but the replacement slice
+        # launches ONCE per preempted slice, not once per host notice.
+        launched = 0
+        t = self.instance_types.get(inst.instance_type)
+        if inst.slice_id is not None:
+            replaced = getattr(self, "_preempt_replaced_slices", None)
+            if replaced is None:
+                replaced = self._preempt_replaced_slices = set()
+            if inst.slice_id in replaced:
+                t = None
+            else:
+                replaced.add(inst.slice_id)
+        if (t is not None
+                and len(self.instances) + t.hosts <= self.max_workers):
+            iids = self.provider.launch_slice(t)
+            slice_id = uuid.uuid4().hex[:8] if t.hosts > 1 else None
+            for iid in iids:
+                self.instances[iid] = Instance(iid, t.name, "LAUNCHING",
+                                               launched_at=time.time(),
+                                               slice_id=slice_id)
+            launched = len(iids)
+        logger.warning(
+            "preemption notice for %s (%.1fs): drain %s, +%d replacement "
+            "instance(s)", instance_id, deadline_s,
+            "issued" if drained else "skipped (no node binding)", launched)
+        from ray_tpu.runtime import events as events_mod
+
+        try:
+            events_mod.emit(
+                events_mod.AUTOSCALER_SCALE,
+                f"preemption notice for instance {instance_id} "
+                f"({deadline_s:.1f}s): node drain "
+                f"{'issued' if drained else 'skipped'}, {launched} "
+                f"replacement instance(s) launched",
+                severity=events_mod.WARNING, source="autoscaler",
+                labels={"instance": instance_id,
+                        "deadline_s": f"{deadline_s:.1f}",
+                        "launched": str(launched)})
+        except Exception:
+            pass
+        return drained
+
+    def _poll_preemption_notices(self) -> None:
+        try:
+            notices = self.provider.preemption_notices()
+        except Exception:
+            return
+        for n in notices:
+            iid = n.get("instance_id")
+            if not iid or iid in self._preempt_handled:
+                continue
+            deadline = n.get("deadline")
+            # Remaining window, not the original notice: polling latency
+            # between the cloud stamping the notice and this tick seeing
+            # it has already consumed part of the drain budget.
+            if deadline is not None:
+                notice_s = max(0.0, float(deadline) - time.time())
+            else:
+                notice_s = n.get("notice_s")
+            self.handle_preemption_notice(iid, notice_s)
+
     # -- reconcile ---------------------------------------------------------
 
     def reconcile(self, demand: Optional[List[Dict[str, float]]] = None
@@ -222,6 +333,7 @@ class Autoscaler:
         """One reconciliation round; returns {"launched": n, "terminated": m}."""
         from ray_tpu.state.api import list_nodes
 
+        self._poll_preemption_notices()
         nodes = [n for n in list_nodes() if n["alive"]]
         # One floor fetch + one node listing per tick, shared by demand
         # accounting and idle termination (two reads could also disagree
@@ -234,7 +346,10 @@ class Autoscaler:
                 # Tests/subclasses stub get_demand with a 0-arg callable.
                 demand = self.get_demand()
         alive_ids = {n["node_id"] for n in nodes}
-        free = [dict(n["available"]) for n in nodes]
+        # A DRAINING node is alive but refuses new leases and dies at its
+        # deadline — counting its capacity would suppress the very
+        # replacement launch the drain notice exists to trigger.
+        free = [dict(n["available"]) for n in nodes if not n.get("draining")]
 
         # Resolve instance -> raylet-node bindings and mark registered
         # instances RUNNING. Instances still booting (launched but not yet in
@@ -251,7 +366,19 @@ class Autoscaler:
             registered = (inst.node_id is not None
                           and inst.node_id.hex() in alive_ids)
             if registered:
-                inst.status = "RUNNING"
+                if inst.status != "DRAINING":
+                    inst.status = "RUNNING"
+                continue
+            if inst.status == "DRAINING":
+                # Drain deadline passed and the cloud reclaimed the node:
+                # drop the record (the replacement already launched at
+                # notice time; keeping this would pin max_workers).
+                try:
+                    self.provider.terminate(iid)
+                except Exception:
+                    pass
+                self.instances.pop(iid, None)
+                self._idle_since.pop(iid, None)
                 continue
             if inst.status != "LAUNCHING":
                 # Previously RUNNING but transiently absent from the alive
@@ -421,6 +548,9 @@ class Autoscaler:
             return node_by_id.get(inst.node_id.hex()) if inst.node_id else None
 
         def idle_expired(iid, inst) -> bool:
+            if inst.status == "DRAINING":
+                # Mid-drain: the deadline (not the idle clock) retires it.
+                return False
             node = node_of(inst)
             if node is None:
                 return False  # still booting (boot-grace reaping handles it)

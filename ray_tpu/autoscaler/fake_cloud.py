@@ -10,10 +10,19 @@ chaos control surface (provision delay, fail-next-N launches).
 
 Run: python -m ray_tpu.autoscaler.fake_cloud --port 0 --ready-file PATH
 API:
-  POST   /instances  {"type": str, "count": int}      -> {"ids": [...]}
+  POST   /instances  {"type": str, "count": int, "preemptible"?: bool}
+                                                      -> {"ids": [...]}
   GET    /instances                                   -> {"instances": [...]}
   DELETE /instances/<id>                              -> {}
-  POST   /control    {"provision_delay_s"?, "fail_next"?} -> {}
+  POST   /control    {"provision_delay_s"?, "fail_next"?,
+                      "preempt"?: id, "notice_s"?: float} -> {}
+
+Preemption (the spot/advance-notice shape): POST /control with
+{"preempt": iid, "notice_s": N} stamps `preempt_at = now + N` on a
+RUNNING instance — the listing immediately exposes the pending notice
+(what a real cloud's metadata server would surface), and tick() flips the
+instance to PREEMPTED once the deadline passes. notice_s <= 0 models a
+no-notice preemption (killed on the next tick).
 """
 
 from __future__ import annotations
@@ -35,13 +44,29 @@ class _State:
         self.fail_next = 0
 
     def tick(self):
-        """Lazy transitions: PENDING becomes RUNNING (or FAILED) at ready_at."""
+        """Lazy transitions: PENDING becomes RUNNING (or FAILED) at
+        ready_at; a RUNNING instance with an expired preemption notice
+        becomes PREEMPTED (the cloud kills it at the deadline)."""
         now = time.time()
         for inst in self.instances.values():
             if inst["status"] == "PENDING" and now >= inst["ready_at"]:
                 inst["status"] = "FAILED" if inst["doomed"] else "RUNNING"
+            if (inst["status"] == "RUNNING"
+                    and inst.get("preempt_at") is not None
+                    and now >= inst["preempt_at"]):
+                inst["status"] = "PREEMPTED"
 
-    def create(self, type_name: str, count: int) -> list:
+    def preempt(self, iid: str, notice_s: float) -> bool:
+        inst = self.instances.get(iid)
+        if inst is None or inst["status"] in ("TERMINATED", "FAILED",
+                                              "PREEMPTED"):
+            return False
+        inst["preempt_at"] = time.time() + max(0.0, notice_s)
+        inst["preempt_notice_s"] = notice_s
+        return True
+
+    def create(self, type_name: str, count: int,
+               preemptible: bool = False) -> list:
         ids = []
         slice_id = uuid.uuid4().hex[:8] if count > 1 else None
         for i in range(count):
@@ -55,6 +80,8 @@ class _State:
                 "slice_id": slice_id, "worker_index": i,
                 "ready_at": time.time() + self.provision_delay_s,
                 "doomed": doomed,
+                "preemptible": bool(preemptible),
+                "preempt_at": None, "preempt_notice_s": None,
             }
             ids.append(iid)
         return ids
@@ -92,7 +119,8 @@ def make_server(port: int = 0) -> ThreadingHTTPServer:
             if self.path == "/instances":
                 req = self._body()
                 with state.lock:
-                    ids = state.create(req["type"], int(req.get("count", 1)))
+                    ids = state.create(req["type"], int(req.get("count", 1)),
+                                       bool(req.get("preemptible", False)))
                 self._reply({"ids": ids})
             elif self.path == "/control":
                 req = self._body()
@@ -102,6 +130,12 @@ def make_server(port: int = 0) -> ThreadingHTTPServer:
                             req["provision_delay_s"])
                     if "fail_next" in req:
                         state.fail_next = int(req["fail_next"])
+                    if "preempt" in req:
+                        ok = state.preempt(str(req["preempt"]),
+                                           float(req.get("notice_s", 0.0)))
+                        if not ok:
+                            return self._reply(
+                                {"error": "unknown or dead instance"}, 404)
                 self._reply({})
             else:
                 self._reply({"error": "not found"}, 404)

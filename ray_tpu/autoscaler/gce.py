@@ -233,6 +233,68 @@ class GceTpuQueuedProvider(NodeProvider):
         return node
 
 
+# ---------------------------------------------------------- preemption
+
+class GcePreemptionWatcher:
+    """On-VM watcher for GCE/TPU-VM advance preemption notice.
+
+    GCE surfaces spot/preemptible reclamation through the instance
+    metadata server: `computeMetadata/v1/instance/preempted` flips to
+    "TRUE" ~30 s before the kill (the ACPI G2 shutdown window). This
+    thread polls that endpoint (using the metadata server's
+    wait-for-change long-poll when available) and fires `callback(
+    notice_s)` ONCE at the flip — the node bootstrap wires the callback
+    to the autoscaler's `handle_preemption_notice` / a direct GCS
+    `drain_node`, turning the cloud's notice into a cluster drain.
+
+    `metadata_base` is overridable so tests point it at a local fake
+    instead of http://metadata.google.internal."""
+
+    def __init__(self, callback, *, poll_interval_s: float = 1.0,
+                 notice_s: float = 30.0,
+                 metadata_base: str = "http://metadata.google.internal"):
+        self.callback = callback
+        self.poll_interval_s = poll_interval_s
+        self.notice_s = notice_s
+        self.base = metadata_base.rstrip("/")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False
+
+    def _preempted(self) -> bool:
+        req = urllib.request.Request(
+            f"{self.base}/computeMetadata/v1/instance/preempted",
+            headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.read().strip().upper() == b"TRUE"
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                if self._preempted():
+                    self.fired = True
+                    try:
+                        self.callback(self.notice_s)
+                    except Exception:
+                        logger.exception("preemption callback failed")
+                    return  # one-shot: the VM is going away
+            except Exception:
+                pass  # metadata server hiccup: keep watching
+            self._stop.wait(self.poll_interval_s)
+
+    def start(self) -> "GcePreemptionWatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="gce-preemption-watcher", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
 # --------------------------------------------------------------- fake API
 
 class _FakeState:

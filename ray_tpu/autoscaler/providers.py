@@ -216,8 +216,32 @@ class CloudAPIProvider(NodeProvider):
             self.cluster.remove_node(node, force=False)
 
     def non_terminated(self) -> List[str]:
-        return [iid for iid, inst in self._list().items()
+        listing = self._list()
+        # Materialize cloud-side preemption kills: a PREEMPTED instance's
+        # simulated VM dies hard (force: the raylet gets no goodbye — the
+        # graceful part already happened during the drain window).
+        for iid, inst in listing.items():
+            if inst["status"] == "PREEMPTED" and iid in self._nodes:
+                node = self._nodes.pop(iid)
+                if self.cluster is not None:
+                    try:
+                        self.cluster.remove_node(node, force=True)
+                    except Exception:
+                        pass
+        return [iid for iid, inst in listing.items()
                 if inst["status"] in ("PENDING", "RUNNING")]
+
+    def preemption_notices(self) -> List[dict]:
+        """Advance notices from the cloud listing: RUNNING instances with a
+        pending `preempt_at` (the fake cloud's /control preemption
+        injection; a real API would surface the same via its feed)."""
+        out = []
+        for iid, inst in self._list().items():
+            if inst["status"] == "RUNNING" and inst.get("preempt_at"):
+                out.append({"instance_id": iid,
+                            "deadline": float(inst["preempt_at"]),
+                            "notice_s": inst.get("preempt_notice_s")})
+        return out
 
     def get_node_id(self, instance_id: str) -> Optional[bytes]:
         inst = self._list().get(instance_id)

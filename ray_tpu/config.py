@@ -183,6 +183,21 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                                       "the worker group before a failure "
                                       "restart attempt (gang restarts race "
                                       "the autoscaler replacing a slice)"),
+    "train_drain_check_interval_s": (float, 1.0,
+                                     "how often the Train controller polls "
+                                     "for NODE_DRAINING events overlapping "
+                                     "its worker group (must be well under "
+                                     "the shortest expected drain notice)"),
+    # -- drain / preemption --------------------------------------------------
+    "drain_deadline_default_s": (float, 30.0,
+                                 "drain notice window used when an "
+                                 "autoscaler preemption notice carries no "
+                                 "explicit deadline"),
+    "actor_restart_capacity_wait_s": (float, 30.0,
+                                      "max wait for a feasible node during "
+                                      "an actor restart (a preempted node's "
+                                      "replacement races registration) "
+                                      "before the restart fails"),
 }
 
 

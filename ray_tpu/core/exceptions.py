@@ -36,6 +36,12 @@ class ActorDiedError(ActorError):
         self.reason = reason
         super().__init__(f"actor {actor_id_hex[:12]} died: {reason}")
 
+    @property
+    def cause(self) -> str:
+        """Typed death cause (CAUSE_PREEMPTION when the hosting node was
+        drained/preempted with notice, CAUSE_CRASH otherwise)."""
+        return death_cause(self.reason)
+
     def __reduce__(self):
         return (type(self), (self.actor_id_hex, self.reason))
 
@@ -48,6 +54,44 @@ class ActorUnavailableError(ActorError):
 # failure domain; `actor_death_error` keys off it so the caller-side error
 # type survives the string-shaped death_reason plumbing.
 TPU_SLICE_LOST_MARKER = "TpuSliceLost"
+
+# Marker embedded in GCS death reasons for nodes that died at the end of a
+# drain window (spot/preemptible retirement with advance notice). Callers
+# use it — via `death_cause` — to distinguish a *planned* capacity loss
+# (retry freely, do not consume retry budgets) from a surprise crash.
+NODE_PREEMPTED_MARKER = "NodePreempted"
+
+# Typed death causes derivable from a string-shaped death reason.
+CAUSE_PREEMPTION = "preemption"
+CAUSE_CRASH = "crash"
+
+
+def death_cause(reason: "str | None") -> str:
+    """Classify a death reason string into a typed cause. The markers ride
+    inside the reason (the reason plumbing through GCS pubsub, actor death
+    records, and wire messages is string-shaped — same trick as
+    TPU_SLICE_LOST_MARKER)."""
+    if NODE_PREEMPTED_MARKER in (reason or ""):
+        return CAUSE_PREEMPTION
+    return CAUSE_CRASH
+
+
+class NodeDiedError(RayTpuError):
+    """A node left the cluster. `cause` distinguishes a graceful
+    drain/preemption (CAUSE_PREEMPTION — the death was announced in
+    advance and is infinitely retryable) from a crash (CAUSE_CRASH)."""
+
+    def __init__(self, node_id_hex: str, reason: str = ""):
+        self.node_id_hex = node_id_hex
+        self.reason = reason
+        super().__init__(f"node {node_id_hex[:12]} died: {reason}")
+
+    @property
+    def cause(self) -> str:
+        return death_cause(self.reason)
+
+    def __reduce__(self):
+        return (type(self), (self.node_id_hex, self.reason))
 
 
 class TpuSliceLostError(ActorDiedError):
@@ -116,14 +160,19 @@ class ObjectLostError(RayTpuError):
     owner's submitter reconstruct the exact lost dependency recursively
     (object_recovery_manager.h:38 analog)."""
 
-    def __init__(self, message: str, oid: "bytes | None" = None):
+    def __init__(self, message: str, oid: "bytes | None" = None,
+                 cause: "str | None" = None):
         super().__init__(message)
         self.oid = oid
+        # Explicit cause wins; otherwise derive from the message (drain
+        # paths embed NODE_PREEMPTED_MARKER in it).
+        self.cause = cause or death_cause(message)
 
     def __reduce__(self):
-        # Default Exception pickling drops kwargs; keep oid across the wire
-        # (the recovery path reads it on the submitting side).
-        return (type(self), (self.args[0] if self.args else "", self.oid))
+        # Default Exception pickling drops kwargs; keep oid and cause across
+        # the wire (the recovery path reads them on the submitting side).
+        return (type(self),
+                (self.args[0] if self.args else "", self.oid, self.cause))
 
 
 class RuntimeEnvSetupError(RayTpuError):

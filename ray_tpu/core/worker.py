@@ -136,6 +136,10 @@ class CoreWorker:
         self._owner_clients: Dict[Tuple, RpcClient] = {}
         self._owner_locks: Dict[Tuple, "asyncio.Lock"] = {}
         self._death_sub_client: Optional[RpcClient] = None
+        # node_id -> True/False: was the node's death an ANNOUNCED
+        # drain/preemption? Filled lazily from the GCS node table on the
+        # (rare) death paths that decide whether to consume retry budget.
+        self._node_death_cause: Dict[bytes, bool] = {}
         self.worker_ident = (os.environ.get("RAY_TPU_WORKER_ID")
                              or "drv" + os.urandom(6).hex())
         # Every process (driver AND worker) serves the ownership protocol:
@@ -312,12 +316,98 @@ class CoreWorker:
                     raise GetTimeoutError(f"get() timed out waiting for {ref}")
                 value = self._fetch_from_owner(ref, remaining)
             except ObjectLostError:
+                # Drain relocation first: a draining node migrates its
+                # primary copies to live peers and records the new homes in
+                # the GCS relocation table — a moved object is readable
+                # WITHOUT lineage re-execution.
+                value = self._get_relocated_value(oid, timeout)
+                if value is not _MISSING:
+                    return self._raise_if_error(value)
                 # Lineage reconstruction: re-execute the producing task, then
                 # re-enter the full read path (the new result may be inline).
-                if not self._reconstruct(oid, timeout):
+                # An announced preemption does not consume the
+                # reconstruction budget (the loss was planned, not a bug).
+                preempted = self._node_was_preempted(
+                    self._object_locations.get(oid))
+                if not self._reconstruct(oid, timeout, preempted=preempted):
                     raise
                 return self.get_one(ref, timeout)
         return self._raise_if_error(value)
+
+    def _get_relocated_value(self, oid: bytes, timeout: Optional[float]):
+        """Ask the GCS where a drain migration put `oid`; on a hit, retry
+        the plasma read from the new home. Returns _MISSING when there is
+        no (new) relocation or the read fails anyway."""
+        try:
+            reply = self.io.run(
+                self.gcs.call("locate_object", oid=oid), timeout=10)
+        except Exception:
+            return _MISSING
+        if not reply or not reply.get("found"):
+            return _MISSING
+        node_id = reply["node_id"]
+        if node_id == self._object_locations.get(oid):
+            return _MISSING  # that's where we just failed to read from
+        self._object_locations[oid] = node_id
+        addr = reply.get("address")
+        if addr:
+            self._node_addrs[node_id] = tuple(addr)
+        try:
+            return self._get_plasma_value(oid, node_id, timeout)
+        except (ObjectNotFoundError, ObjectLostError):
+            return _MISSING
+
+    def _preemption_verdict(self, node_id: bytes, nodes) -> bool:
+        """Classify `node_id` against a GCS node-table snapshot; caches
+        only FINAL (dead-node) verdicts — a live, non-draining node may
+        still receive a drain notice later."""
+        from ray_tpu.core.exceptions import CAUSE_PREEMPTION, death_cause
+
+        verdict = False
+        for n in nodes:
+            nid = n["node_id"]
+            if isinstance(nid, str):
+                nid = bytes.fromhex(nid)
+            if nid != node_id:
+                continue
+            verdict = bool(n.get("draining")) or death_cause(
+                n.get("death_reason")) == CAUSE_PREEMPTION
+            if not n.get("alive", True):
+                self._node_death_cause[node_id] = verdict
+            break
+        return verdict
+
+    def _node_was_preempted(self, node_id: Optional[bytes]) -> bool:
+        """True when `node_id` died (or is dying) from an ANNOUNCED
+        drain/preemption — such deaths never consume retry budgets
+        (max_retries / reconstruction_attempts). Lazily resolved from the
+        GCS node table; only called on (rare) death paths. Sync — must not
+        be called from the IO loop (use _node_was_preempted_async there)."""
+        if node_id is None:
+            return False
+        cached = self._node_death_cause.get(node_id)
+        if cached is not None:
+            return cached
+        try:
+            nodes = self.io.run(
+                self.gcs.call("get_nodes", only_alive=False), timeout=10)
+        except Exception:
+            return False
+        return self._preemption_verdict(node_id, nodes)
+
+    async def _node_was_preempted_async(self, node_id: Optional[bytes]) -> bool:
+        """IO-loop twin of _node_was_preempted."""
+        if node_id is None:
+            return False
+        cached = self._node_death_cause.get(node_id)
+        if cached is not None:
+            return cached
+        try:
+            nodes = await self.gcs.call("get_nodes", only_alive=False,
+                                        timeout=10)
+        except Exception:
+            return False
+        return self._preemption_verdict(node_id, nodes)
 
     def _blocked_get_ctx(self, oid: bytes, ref: ObjectRef, **extra):
         """blocked_on("object_get") context for a (possibly) blocking read
@@ -520,7 +610,12 @@ class CoreWorker:
             for ref in pending:
                 oid = ref.binary()
                 with self._mem_lock:
-                    in_mem = oid in self.memory_store
+                    # A completed task pops its result future, so a plasma
+                    # result's only completion evidence is its recorded
+                    # location — without this check wait() never reports a
+                    # remote plasma result ready even though get() works.
+                    in_mem = (oid in self.memory_store
+                              or oid in self._object_locations)
                     fut = self.result_futures.get(oid)
                 if in_mem or (fut is not None and fut.done()) or \
                         (self.store is not None and self.store.contains(oid)):
@@ -1316,11 +1411,14 @@ class CoreWorker:
             while len(self._lineage) > cfg().lineage_max_entries:
                 self._lineage.pop(next(iter(self._lineage)))
 
-    def _reconstruct_start(self, oid: bytes) -> Optional[SyncFuture]:
+    def _reconstruct_start(self, oid: bytes,
+                           preempted: bool = False) -> Optional[SyncFuture]:
         """Kick off re-execution of the task whose lineage produced `oid`;
         returns the result future (None if no lineage/attempts remain).
         If a (re-)execution producing `oid` is already in flight, piggyback
-        on its future instead of double-executing the producer."""
+        on its future instead of double-executing the producer.
+        `preempted=True` (the copy was lost to an announced node
+        retirement) re-executes WITHOUT consuming the attempt budget."""
         with self._mem_lock:
             existing = self.result_futures.get(oid)
             if existing is not None and not existing.done():
@@ -1330,7 +1428,8 @@ class CoreWorker:
                 return None
             if rec["spec"].task_id in self._cancelled_tasks:
                 return None  # cancelled tasks never re-execute
-            rec["attempts"] -= 1
+            if not preempted:
+                rec["attempts"] -= 1
             import copy
 
             spec = copy.deepcopy(rec["spec"])
@@ -1348,11 +1447,12 @@ class CoreWorker:
         self.io.spawn(self._submit_async(spec))
         return out
 
-    def _reconstruct(self, oid: bytes, timeout: Optional[float]) -> bool:
+    def _reconstruct(self, oid: bytes, timeout: Optional[float],
+                     preempted: bool = False) -> bool:
         """Re-execute the task whose lineage produced `oid` (the object's
         primary copy was lost with its node). Returns True if a new attempt
         was submitted and completed."""
-        fut = self._reconstruct_start(oid)
+        fut = self._reconstruct_start(oid, preempted=preempted)
         if fut is None:
             return False
         try:
@@ -1776,7 +1876,12 @@ class CoreWorker:
             # would duplicate them (the reference checkpoints the consumed
             # index; we surface the failure instead).
             if spec.max_retries > 0 and spec.num_returns != self.STREAMING:
-                spec.max_retries -= 1
+                # A death caused by an announced drain/preemption does not
+                # consume the retry budget (the node was retired on
+                # schedule — retrying is the designed recovery, not a
+                # symptom worth rationing).
+                if not await self._node_was_preempted_async(lease.node_id):
+                    spec.max_retries -= 1
                 logger.warning("task %s worker died; retrying", spec.name)
                 # Through _submit_async, not the queue directly: the resolve
                 # pass refreshes plasma arg locations that may have died

@@ -39,7 +39,8 @@ MODES = (COLOCATED, DISAGGREGATED)
 @dataclasses.dataclass
 class PlacementDecision:
     mode: str                 # mode to run the NEXT iteration in
-    switch: bool              # True when mode != current mode
+    switch: bool              # True when the gang must re-form (mode change,
+                              # or a drain notice forcing same-mode re-form)
     reason: str               # human-readable signal summary
     rollout_frac: float
     kv_pressure: float
@@ -66,6 +67,16 @@ class PlacementPolicy:
                 f"need 0 <= low <= high <= 1, got low={self.low} "
                 f"high={self.high}")
         self._dwell = 0  # iterations since the last switch (or start)
+        self._drain_pending: Optional[str] = None
+
+    def note_drain(self, reason: str = "node draining") -> None:
+        """Record an advance-notice drain covering the current gang.
+
+        The next `decide()` call returns a forced re-form of the CURRENT
+        mode, bypassing dwell hysteresis — a drain deadline is a hard
+        external clock, not a noisy signal, so waiting out the dwell
+        window would ride the gang straight into the deadline kill."""
+        self._drain_pending = reason
 
     @staticmethod
     def kv_pressure(engine_stats: Optional[dict]) -> float:
@@ -89,6 +100,11 @@ class PlacementPolicy:
         busy = rollout_s + update_s
         frac = rollout_s / busy if busy > 0 else 0.0
         kv = self.kv_pressure(engine_stats)
+        if self._drain_pending is not None:
+            reason, self._drain_pending = self._drain_pending, None
+            self._dwell = 0
+            return PlacementDecision(current_mode, True,
+                                     f"drain re-form: {reason}", frac, kv)
         self._dwell += 1
 
         target = current_mode

@@ -398,6 +398,7 @@ class RLHFTrainer:
         self.consumed_seq_nos: List[int] = []
         self.sync_ms: List[float] = []
         self.generator_rebuilds = 0
+        self._seen_drain_events: set = set()
 
         self.learners: List = []
         self.generators: List = []
@@ -664,11 +665,62 @@ class RLHFTrainer:
             pass
         return None
 
+    def _drain_notice(self) -> Optional[str]:
+        """Fresh NODE_DRAINING notice covering a node hosting one of this
+        run's learner/generator actors, or None.
+
+        The proactive half of advance-notice preemption for RLHF gangs:
+        the re-form happens on live capacity BEFORE the deadline kill,
+        instead of surfacing later as a collective abort mid-update.
+        Best-effort — drain awareness must never fail the PPO loop."""
+        from ray_tpu.core import worker as worker_mod
+        from ray_tpu.runtime import events as events_mod
+
+        try:
+            core = worker_mod.global_worker()
+            fresh: Dict[str, str] = {}
+            for ev in core.io.run(core.gcs.call(
+                    "list_events", event_type=events_mod.NODE_DRAINING,
+                    limit=20), timeout=5):
+                key = (ev.get("node_id"), ev.get("time"))
+                if key in self._seen_drain_events or not ev.get("node_id"):
+                    continue
+                self._seen_drain_events.add(key)
+                fresh[ev["node_id"]] = ev.get("message", "node draining")
+            if not fresh:
+                return None
+            ours = {h._actor_id
+                    for h in list(self.learners) + list(self.generators)
+                    if hasattr(h, "_actor_id")}
+            homes = set()
+            for a in core.io.run(core.gcs.call("list_actors"), timeout=5):
+                if a.get("actor_id") in ours and a.get("node_id"):
+                    homes.add(a["node_id"].hex())
+            for node_hex, msg in fresh.items():
+                if node_hex in homes:
+                    return msg
+        except Exception:
+            pass
+        return None
+
     def _maybe_switch(self, iteration: int, rollout_s: float,
                       update_s: float) -> None:
         cfg = self.config
         if iteration == cfg.iterations - 1:
             return  # nothing left to run in the new placement
+        notice = self._drain_notice()
+        if notice:
+            if self.policy is not None:
+                # Route through the policy so its dwell/mode state stays
+                # consistent with the forced re-form.
+                self.policy.note_drain(notice)
+                decision = self.policy.decide(
+                    rollout_s, update_s, self._engine_stats(), self.mode)
+                self._switch(decision.mode, decision.reason, iteration)
+            else:
+                self._switch(self.mode, f"drain re-form: {notice}",
+                             iteration)
+            return
         if cfg.force_switch_at is not None:
             if iteration == cfg.force_switch_at:
                 other = (DISAGGREGATED if self.mode == COLOCATED

@@ -12,7 +12,11 @@ noticed the problem.
 
 Emitters in-tree:
   * GCS        — NODE_DEAD (heartbeat timeout / drain), SLICE_LOST
-                 (fate-sharing, records the whole failure domain)
+                 (fate-sharing, records the whole failure domain),
+                 NODE_DRAINING (a drain notice arrived: the node keeps
+                 running until the deadline but takes no new work),
+                 NODE_PREEMPTED (a draining node reached its deadline
+                 and died — the planned-retirement flavor of NODE_DEAD)
   * raylet     — OOM_KILL (memory monitor victim selection)
   * collective — COLLECTIVE_ABORT (first local observation of a group
                  abort, before the KV flag fans out)
@@ -49,6 +53,8 @@ SEVERITIES = (INFO, WARNING, ERROR)
 # Event types. Closed set so dashboards/tests can switch on them; add new
 # types here rather than inventing strings at the call site.
 NODE_DEAD = "NODE_DEAD"
+NODE_DRAINING = "NODE_DRAINING"
+NODE_PREEMPTED = "NODE_PREEMPTED"
 SLICE_LOST = "SLICE_LOST"
 OOM_KILL = "OOM_KILL"
 COLLECTIVE_ABORT = "COLLECTIVE_ABORT"
@@ -58,7 +64,8 @@ TASK_STALLED = "TASK_STALLED"
 DEADLOCK_DETECTED = "DEADLOCK_DETECTED"
 LLM_REQUEST_SHED = "LLM_REQUEST_SHED"
 RLHF_PLACEMENT_SWITCH = "RLHF_PLACEMENT_SWITCH"
-EVENT_TYPES = (NODE_DEAD, SLICE_LOST, OOM_KILL, COLLECTIVE_ABORT,
+EVENT_TYPES = (NODE_DEAD, NODE_DRAINING, NODE_PREEMPTED, SLICE_LOST,
+               OOM_KILL, COLLECTIVE_ABORT,
                AUTOSCALER_SCALE, TRAIN_GANG_RESTART, TASK_STALLED,
                DEADLOCK_DETECTED, LLM_REQUEST_SHED, RLHF_PLACEMENT_SWITCH)
 

@@ -174,7 +174,10 @@ class PlacementGroupManager:
         (or TOTAL resources with by_capacity=True — the can-this-ever-fit
         check). Returns [(bundle_index, node_id)] or None if infeasible.
         """
-        nodes = [n for n in self.gcs._nodes.values() if n.alive]
+        # Draining nodes take no NEW bundles: a gang placed there would be
+        # killed at the drain deadline moments later.
+        nodes = [n for n in self.gcs._nodes.values()
+                 if n.alive and not getattr(n, "draining", False)]
         snapshot = {n.node_id: dict(n.resources if by_capacity
                                     else n.available) for n in nodes}
         totals = {n.node_id: n.resources for n in nodes}

@@ -78,6 +78,18 @@ class NodeRecord:
         self.client: Optional[RpcClient] = None
         # Latest per-scheduling-class lease backlog reported by heartbeat.
         self.backlog: List[dict] = []
+        # Two-phase drain (DrainNode analog, node_manager.proto): the node
+        # is still ALIVE — running work finishes, objects stay readable —
+        # but the scheduler/PGs route around it until drain_deadline
+        # (wall-clock; drain_deadline_mono is the GCS-local enforcement
+        # clock), when it is killed for real.
+        self.draining = False
+        self.drain_reason = ""
+        self.drain_deadline = 0.0          # unix seconds (advisory, wire)
+        self.drain_deadline_mono = 0.0     # monotonic (enforcement)
+        # Why the node died (kept in the view so workers deciding whether a
+        # death consumes retry budget can classify it — death_cause()).
+        self.death_reason = ""
 
     def view(self) -> dict:
         return {
@@ -89,6 +101,10 @@ class NodeRecord:
             "is_head": self.is_head,
             "labels": dict(self.labels),
             "alive": self.alive,
+            "draining": self.draining,
+            "drain_reason": self.drain_reason,
+            "drain_deadline": self.drain_deadline,
+            "death_reason": self.death_reason,
         }
 
 
@@ -202,7 +218,9 @@ class GcsServer:
                 "resources": rec.resources, "available": rec.available,
                 "object_store_path": rec.object_store_path,
                 "is_head": rec.is_head, "labels": rec.labels,
-                "alive": rec.alive}))
+                "alive": rec.alive, "draining": rec.draining,
+                "drain_reason": rec.drain_reason,
+                "drain_deadline": rec.drain_deadline}))
         except Exception:
             logger.exception("node persist failed")
 
@@ -241,6 +259,15 @@ class GcsServer:
             rec = NodeRecord(d["node_id"], tuple(d["address"]), d["resources"],
                              d["object_store_path"], d["is_head"], d["labels"])
             rec.available = d["available"]
+            if d.get("draining"):
+                # Monotonic deadlines don't survive the restart: re-derive
+                # remaining notice from the persisted wall-clock deadline.
+                rec.draining = True
+                rec.drain_reason = d.get("drain_reason", "")
+                rec.drain_deadline = d.get("drain_deadline", 0.0)
+                rec.drain_deadline_mono = (
+                    time.monotonic()
+                    + max(0.0, rec.drain_deadline - time.time()))
             self._nodes[d["node_id"]] = rec
             restored_nodes += 1
             # Seed the view log so delta-synced raylets learn restored
@@ -381,7 +408,9 @@ class GcsServer:
                 port=int(n["address"][1]), resources=n["resources"],
                 available=n["available"], labels=n["labels"],
                 is_head=n["is_head"], alive=n["alive"],
-                object_store_path=n["object_store_path"])
+                object_store_path=n["object_store_path"],
+                draining=bool(n.get("draining")),
+                drain_deadline=float(n.get("drain_deadline") or 0.0))
                 for n in view[nodes_key]]
             if nodes_key == "full":
                 msg.full = encoded
@@ -420,16 +449,120 @@ class GcsServer:
     async def handle_get_requested_resources(self, conn):
         return list(getattr(self, "_requested_resources", []))
 
-    async def handle_drain_node(self, conn, node_id):
-        await self._mark_node_dead(node_id, "drained")
-        return {"ok": True}
+    async def handle_drain_node(self, conn, node_id, reason: str = "drained",
+                                deadline_s: Optional[float] = None):
+        """Two-phase node retirement (DrainNode analog, node_manager.proto).
+
+        With a positive `deadline_s` (advance notice — the spot-preemption
+        shape) the node enters DRAINING: it stays alive, the scheduler and
+        placement groups stop leasing onto it, its raylet migrates primary
+        object copies to live peers, and drain-aware consumers (Train,
+        RLHF) checkpoint and re-form proactively. At the deadline the
+        health loop kills it for real with the preempted marker so
+        whatever didn't make it falls back to the reactive paths without
+        consuming retry budgets.
+
+        `deadline_s` None/<=0 keeps the legacy immediate-kill semantics —
+        this IS the 0-notice reactive path."""
+        if deadline_s is None or deadline_s <= 0:
+            rec = self._nodes.get(node_id)
+            if rec is not None and rec.alive:
+                # Even a 0-notice drain is an ANNOUNCED retirement: flag it
+                # so _mark_node_dead stamps the preemption marker (typed
+                # cause, retry-budget exemption) and records NODE_PREEMPTED.
+                rec.draining = True
+                if not rec.drain_reason:
+                    rec.drain_reason = reason
+            await self._mark_node_dead(node_id, reason)
+            return {"ok": True, "draining": False}
+        rec = self._nodes.get(node_id)
+        if rec is None or not rec.alive:
+            return {"ok": False, "unknown": True}
+        if not rec.draining:
+            rec.draining = True
+            rec.drain_reason = reason
+        # Repeated notices tighten (never extend) the window: the cloud's
+        # second notice is always sooner than the first.
+        new_mono = time.monotonic() + deadline_s
+        if rec.drain_deadline_mono <= 0 or new_mono < rec.drain_deadline_mono:
+            rec.drain_deadline_mono = new_mono
+            rec.drain_deadline = time.time() + deadline_s
+        self._persist_node(rec)
+        self._bump_view(rec)
+        logger.warning("node %s DRAINING (%s): deadline in %.1fs",
+                       node_id.hex()[:12], reason, deadline_s)
+        from ray_tpu.runtime import events as events_mod
+
+        self._record_event(events_mod.make_event(
+            events_mod.NODE_DRAINING,
+            f"node {node_id.hex()[:12]} draining ({reason}): "
+            f"deadline in {deadline_s:.1f}s",
+            severity=events_mod.WARNING, source="gcs", node_id=node_id,
+            slice_name=rec.labels.get("tpu-slice-name"),
+            labels={"deadline_s": f"{deadline_s:.1f}", "reason": reason}))
+        await self.publish("node", {"event": "draining", "node": rec.view(),
+                                    "reason": reason,
+                                    "deadline_s": deadline_s})
+        # Tell the raylet so it stops granting leases and starts migrating
+        # its primary object copies (best-effort: the view delta is the
+        # backup signal).
+        if rec.client is not None:
+            self._spawn_bg(self._notify_drain(rec, reason, deadline_s))
+        return {"ok": True, "draining": True,
+                "deadline": rec.drain_deadline}
+
+    async def _notify_drain(self, rec: "NodeRecord", reason: str,
+                            deadline_s: float):
+        try:
+            await rec.client.call("drain_self", reason=reason,
+                                  deadline_s=deadline_s, timeout=5)
+        except Exception as e:
+            logger.debug("drain_self notify to %s failed: %r",
+                         rec.node_id.hex()[:12], e)
+
+    # ---- object relocation (drain-time primary-copy migration) -----------
+    #
+    # While a node drains, its raylet pushes primary object copies to live
+    # peers and reports the new homes here. Workers that later hit
+    # ObjectLostError for an oid ask `locate_object` BEFORE falling back to
+    # lineage reconstruction, so objects that had time to move survive the
+    # preemption without re-execution.
+
+    async def handle_report_object_locations(self, conn, node_id,
+                                             oids) -> dict:
+        table = getattr(self, "_object_relocations", None)
+        if table is None:
+            table = self._object_relocations = {}
+        for oid in oids:
+            table[bytes(oid)] = node_id
+        return {"ok": True, "count": len(oids)}
+
+    async def handle_locate_object(self, conn, oid: bytes) -> dict:
+        table = getattr(self, "_object_relocations", None)
+        holder = table.get(oid) if table else None
+        if holder is None:
+            return {"found": False}
+        rec = self._nodes.get(holder)
+        if rec is None or not rec.alive:
+            return {"found": False}
+        return {"found": True, "node_id": holder,
+                "address": list(rec.address)}
 
     async def _on_disconnect(self, conn: ServerConnection):
         for subs in self._subscribers.values():
             subs.discard(conn)
         node_id = conn.meta.get("node_id")
         if node_id is not None and node_id in self._nodes and self._nodes[node_id].alive:
-            await self._mark_node_dead(node_id, "raylet disconnected")
+            # A draining node's disconnect IS the announced preemption —
+            # don't overwrite the cause with a generic "disconnected" (the
+            # typed-cause plumbing downstream keys off the reason string).
+            rec = self._nodes[node_id]
+            if rec.draining:
+                reason = (f"node preempted at end of drain "
+                          f"({rec.drain_reason})")
+            else:
+                reason = "raylet disconnected"
+            await self._mark_node_dead(node_id, reason)
         job_id = conn.meta.get("job_id")
         if job_id is not None and job_id in self._jobs:
             self._jobs[job_id]["alive"] = False
@@ -471,7 +604,18 @@ class GcsServer:
         rec = self._nodes.get(node_id)
         if rec is None or not rec.alive:
             return
+        from ray_tpu.core.exceptions import NODE_PREEMPTED_MARKER
+
+        # A drained node's death is a PLANNED retirement: stamp the typed
+        # preemption marker into the reason (it survives the string-shaped
+        # death plumbing to actors/tasks/objects, where `death_cause`
+        # recovers it) and record the paired NODE_PREEMPTED event.
+        was_draining = rec.draining
+        if was_draining and NODE_PREEMPTED_MARKER not in reason:
+            reason = f"{NODE_PREEMPTED_MARKER}: {reason}"
         rec.alive = False
+        rec.draining = False
+        rec.death_reason = reason
         self._persist_node(rec)
         self._bump_view(rec)
         logger.warning("node %s marked dead: %s", node_id.hex()[:12], reason)
@@ -481,6 +625,21 @@ class GcsServer:
             events_mod.NODE_DEAD, f"node {node_id.hex()[:12]} dead: {reason}",
             severity=events_mod.ERROR, source="gcs", node_id=node_id,
             slice_name=rec.labels.get("tpu-slice-name")))
+        if was_draining:
+            self._record_event(events_mod.make_event(
+                events_mod.NODE_PREEMPTED,
+                f"node {node_id.hex()[:12]} preempted at drain deadline "
+                f"({rec.drain_reason})",
+                severity=events_mod.WARNING, source="gcs", node_id=node_id,
+                slice_name=rec.labels.get("tpu-slice-name"),
+                labels={"reason": rec.drain_reason}))
+        # Relocation entries pointing AT the dead node are stale; entries
+        # migrated OFF it (to live peers) stay valid.
+        table = getattr(self, "_object_relocations", None)
+        if table:
+            for oid in [o for o, holder in table.items()
+                        if holder == node_id]:
+                table.pop(oid, None)
         # A dead node never flushes metrics again — drop its
         # `metrics:<node>:<pid>` KV snapshots so the dashboard /metrics
         # aggregation stops counting ghost processes forever.
@@ -573,6 +732,16 @@ class GcsServer:
             for rec in list(self._nodes.values()):
                 if rec.alive and now - rec.last_heartbeat > 30.0:
                     await self._mark_node_dead(rec.node_id, "heartbeat timeout")
+                elif (rec.alive and rec.draining
+                        and rec.drain_deadline_mono > 0
+                        and now >= rec.drain_deadline_mono):
+                    # Drain window expired: the retirement happens NOW even
+                    # if the cloud hasn't actually revoked the VM yet —
+                    # deadline semantics must be deterministic for callers.
+                    await self._mark_node_dead(
+                        rec.node_id,
+                        f"node preempted at end of drain "
+                        f"({rec.drain_reason})")
             # Wait-graph detector rides the same loop at its own cadence.
             last = getattr(self, "_last_stall_tick", 0.0)
             if now - last >= cfg().stall_detector_interval_s:
@@ -1230,13 +1399,29 @@ class GcsServer:
         async with lock:
             if rec.state == DEAD:
                 return
-            if rec.restarts_used < rec.spec.max_restarts:
-                rec.restarts_used += 1
+            # Infinite-retry-on-preemption: a death caused by an ANNOUNCED
+            # node retirement does not consume the restart budget (the
+            # reference framework's drained-node semantics) — only actors
+            # that are restartable at all (max_restarts > 0) qualify.
+            from ray_tpu.core.exceptions import death_cause, CAUSE_PREEMPTION
+
+            preempted = (death_cause(reason) == CAUSE_PREEMPTION
+                         and rec.spec.max_restarts > 0)
+            if preempted or rec.restarts_used < rec.spec.max_restarts:
+                if not preempted:
+                    rec.restarts_used += 1
                 rec.state = RESTARTING
                 rec.address = None
                 await self.publish("actor", {"event": "restarting", "actor": rec.view()})
                 try:
-                    await self._schedule_and_create(rec)
+                    # Only an ANNOUNCED retirement has replacement capacity
+                    # in flight worth waiting for; a plain crash keeps the
+                    # old fail-fast semantics (an actor whose resource no
+                    # longer exists anywhere must die, not stall).
+                    if preempted:
+                        await self._restart_with_capacity_wait(rec)
+                    else:
+                        await self._schedule_and_create(rec)
                 except Exception as e:
                     rec.state = DEAD
                     rec.death_reason = f"restart failed: {e!r}"
@@ -1247,6 +1432,32 @@ class GcsServer:
                 rec.death_reason = reason
                 self._persist_actor(rec)
                 await self.publish("actor", {"event": "dead", "actor": rec.view()})
+
+    async def _restart_with_capacity_wait(self, rec: "ActorRecord"):
+        """Restart a PREEMPTED actor, waiting out a transient capacity gap.
+
+        A restart triggered by an announced node retirement routinely
+        RACES the capacity that replaces the node (the autoscaler
+        launches at preemption notice time, but registration takes
+        seconds) — failing the actor permanently on the first 'no
+        feasible node' would make every graceful drain a coin flip.
+        Only the feasibility error retries; anything else (e.g.
+        __init__ raising) is terminal as before."""
+        from ray_tpu.config import cfg
+
+        deadline = time.monotonic() + cfg().actor_restart_capacity_wait_s
+        while True:
+            try:
+                await self._schedule_and_create(rec)
+                return
+            except RuntimeError as e:
+                if (not str(e).startswith("no feasible node")
+                        or time.monotonic() >= deadline):
+                    raise
+                logger.info(
+                    "actor %s restart waiting for capacity (%s)",
+                    rec.spec.actor_id.hex()[:12], e)
+                await asyncio.sleep(1.0)
 
     # ---- placement groups (delegated, see gcs/placement_groups.py) -------
 

@@ -63,6 +63,11 @@ OBJECT_STORE_SPILLED = Gauge(
     "ray_tpu_object_store_spilled_bytes",
     "bytes currently resident in this node's spill directory",
     tag_keys=("node",))
+NODES_DRAINING = Gauge(
+    "ray_tpu_nodes_draining",
+    "1 while this node is draining toward an announced retirement "
+    "deadline (advance-notice preemption), 0 otherwise",
+    tag_keys=("node",))
 
 # -- object plane ----------------------------------------------------------
 

@@ -83,6 +83,11 @@ class PendingLease:
 
 
 class Raylet:
+    # Class-level default so dispatch-path helpers work on partially
+    # constructed instances (unit tests build bare Raylets) — __init__
+    # shadows it per-instance when a drain starts.
+    _draining = False
+
     def __init__(self, gcs_address: Tuple[str, int], session_dir: str,
                  resources: Dict[str, float], labels: Dict[str, str],
                  object_store_memory: int = DEFAULT_OBJECT_STORE_MEMORY,
@@ -128,6 +133,15 @@ class Raylet:
         self._memory_task = None
         self._spill_task = None
         self._cluster_view: List[dict] = []
+        # Two-phase drain: set by the GCS's `drain_self` RPC (or the view
+        # delta as backup). While draining, running leases finish but new
+        # non-PG lease classes spill to peers, bundle prepares are refused,
+        # and a background task migrates primary object copies off-node.
+        self._draining = False
+        self._drain_reason = ""
+        self._drain_deadline = 0.0
+        self._drain_progress: Dict[str, int] = {}
+        self._drain_migrate_task = None
         # Incremental resource-view sync state (see _heartbeat_loop).
         self._view_version = 0
         self._view_epoch = None  # GCS instance id; mismatch -> full resync
@@ -294,7 +308,9 @@ class Raylet:
                     "resources": n.resources, "available": n.available,
                     "labels": n.labels, "is_head": n.is_head,
                     "alive": n.alive,
-                    "object_store_path": n.object_store_path}
+                    "object_store_path": n.object_store_path,
+                    "draining": n.draining,
+                    "drain_deadline": n.drain_deadline}
 
         view = {"version": msg.version, "epoch": msg.epoch or None}
         nodes = [node_dict(n) for n in (msg.full if msg.is_full
@@ -313,6 +329,13 @@ class Raylet:
         else:
             for n in view.get("deltas", ()):
                 self._view_nodes[n["node_id"]] = n
+        # Backup drain trigger: if the GCS's direct `drain_self` RPC was
+        # lost, our own draining flag still arrives via the view delta.
+        me = self._view_nodes.get(self.node_id)
+        if me is not None and me.get("draining") and not self._draining:
+            self._start_drain("drain (via view sync)",
+                              max(0.0, float(me.get("drain_deadline") or 0.0)
+                                  - time.time()))
         # Dead nodes delivered their final not-alive delta: drop them so
         # the table stays bounded by LIVE nodes under churn.
         for nid in [nid for nid, n in self._view_nodes.items()
@@ -427,6 +450,122 @@ class Raylet:
                 pass
         self._shutdown.set()
         return {"ok": True}
+
+    # ---- graceful drain (advance-notice retirement) ----------------------
+
+    async def handle_drain_self(self, conn, reason: str = "",
+                                deadline_s: float = 0.0):
+        """The GCS announced this node's retirement (spot preemption with
+        notice). Enter drain mode: running leases finish, but new work
+        spills to peers and primary object copies migrate off-node before
+        the deadline kill."""
+        self._start_drain(reason, deadline_s)
+        return {"ok": True, "draining": True,
+                "objects_total": self._drain_progress.get("objects_total")}
+
+    def _start_drain(self, reason: str, deadline_s: float):
+        if self._draining:
+            return
+        self._draining = True
+        self._drain_reason = reason
+        self._drain_deadline = time.time() + max(0.0, deadline_s)
+        logger.warning("raylet %s draining (%s): deadline in %.1fs",
+                       self.node_id.hex()[:12], reason, deadline_s)
+        try:
+            self._g_draining = metric_defs.NODES_DRAINING.bind(
+                {"node": self.node_id.hex()[:12]})
+            self._g_draining.set(1.0)
+        except Exception:
+            pass
+        self._drain_migrate_task = asyncio.ensure_future(
+            self._drain_migrate_objects())
+        # Queued non-PG lease classes re-route now rather than running a
+        # task that dies with the node.
+        for key in [k for k, q in list(self._queues.items())
+                    if q and k[1] is None]:
+            q = self._queues.pop(key)
+            asyncio.ensure_future(self._resolve_spillback_class(key, q))
+
+    def _drain_peers(self) -> List[dict]:
+        return [n for n in self._cluster_view
+                if n.get("alive") and not n.get("draining")
+                and n["node_id"] != self.node_id]
+
+    async def _drain_migrate_objects(self):
+        """Proactively re-replicate this node's primary object copies onto
+        live non-draining peers, then report the new homes to the GCS
+        relocation table — so a `get()` after the deadline finds the moved
+        copy instead of paying ObjectLostError + lineage re-execution.
+        Peers PULL via their existing `fetch_and_relay` chunked path (the
+        same machinery as broadcast); whatever doesn't finish before the
+        kill falls back to the reactive path by design."""
+        if self.store is None:
+            return
+        try:
+            oids = [oid for oid in self.store.list_objects()
+                    if self.store.contains(oid)]
+        except Exception:
+            logger.exception("drain: object enumeration failed")
+            return
+        self._drain_progress = {"objects_total": len(oids),
+                                "objects_migrated": 0, "objects_failed": 0}
+        if not oids:
+            return
+        peers = self._drain_peers()
+        if not peers:
+            # Gossip may lag replacement capacity launched at notice time:
+            # confirm against the GCS before giving up.
+            try:
+                self._cluster_view = await self.gcs.call("get_nodes")
+                peers = self._drain_peers()
+            except Exception:
+                pass
+        if not peers:
+            logger.warning("drain: no live peer to migrate %d object(s) to",
+                           len(oids))
+            self._drain_progress["objects_failed"] = len(oids)
+            return
+        moved: List[bytes] = []
+        by_peer: Dict[bytes, List[bytes]] = {}
+        for i, oid in enumerate(oids):
+            by_peer.setdefault(peers[i % len(peers)]["node_id"], []).append(oid)
+        peer_by_id = {p["node_id"]: p for p in peers}
+        for peer_id, batch in by_peer.items():
+            peer = peer_by_id[peer_id]
+            client = RpcClient(*tuple(peer["address"]))
+            try:
+                await client.connect(timeout=10)
+                for oid in batch:
+                    try:
+                        r = await client.call(
+                            "fetch_and_relay", oid=oid,
+                            source=self.server.address, targets=[],
+                            timeout=60)
+                        if r.get("ok"):
+                            moved.append(oid)
+                            self._drain_progress["objects_migrated"] += 1
+                        else:
+                            self._drain_progress["objects_failed"] += 1
+                    except Exception:
+                        self._drain_progress["objects_failed"] += 1
+                # Report per-peer so partial progress still lands in the
+                # relocation table if the deadline interrupts us.
+                if moved:
+                    await self.gcs.call("report_object_locations",
+                                        node_id=peer_id,
+                                        oids=[o for o in moved
+                                              if o in set(batch)])
+            except Exception:
+                self._drain_progress["objects_failed"] += len(batch)
+                logger.warning("drain: migration to peer %s failed",
+                               peer_id.hex()[:12], exc_info=True)
+            finally:
+                try:
+                    await client.close()
+                except Exception:
+                    pass
+        logger.info("drain: migrated %d/%d object(s) off node",
+                    self._drain_progress["objects_migrated"], len(oids))
 
     # ---- worker pool (worker_pool.h) -------------------------------------
 
@@ -829,6 +968,14 @@ class Raylet:
                 if not q:
                     self._queues.pop(key, None)
                     continue
+                if self._draining and key[1] is None:
+                    # Draining: new non-PG work re-routes to peers instead
+                    # of starting here and dying at the deadline. (PG-bundle
+                    # classes stay — the bundle is committed on this node.)
+                    del self._queues[key]
+                    asyncio.ensure_future(
+                        self._resolve_spillback_class(key, q))
+                    continue
                 granted_here = 0
                 while q:
                     req = q[0]
@@ -905,7 +1052,8 @@ class Raylet:
         cluster_resource_scheduler.cc:149 GetBestSchedulableNode), or None."""
         candidates = [
             n for n in self._cluster_view
-            if n.get("alive") and n["node_id"] != self.node_id
+            if n.get("alive") and not n.get("draining")
+            and n["node_id"] != self.node_id
             and scheduling.fits(n["resources"], resources)]
         if not candidates:
             return None
@@ -1018,6 +1166,11 @@ class Raylet:
         key = (pg_id, bundle_index)
         if key in self._bundles:
             return {"ok": True}  # idempotent retry
+        if self._draining:
+            # A bundle prepared here would be killed at the drain deadline;
+            # refusing makes the PG planner pick a live node (its own plan
+            # already excludes draining nodes — this closes the race).
+            return {"ok": False, "error": "node draining"}
         if not scheduling.fits(self.available, resources):
             return {"ok": False, "error": "insufficient resources at prepare"}
         scheduling.subtract(self.available, resources)
@@ -1302,6 +1455,10 @@ class Raylet:
             "object_store_capacity": self.store.capacity if self.store else 0,
             "spilled_bytes": (self.spill.spilled_bytes()
                               if self.spill else 0),
+            "draining": self._draining,
+            "drain_reason": self._drain_reason,
+            "drain_deadline": self._drain_deadline,
+            "drain_progress": dict(self._drain_progress),
             "bundles": [
                 {"pg_id": k[0], "bundle_index": k[1], "committed": v["committed"],
                  "resources": v["resources"], "available": v["available"]}

@@ -91,9 +91,14 @@ def rank_nodes_for_actor(nodes: Dict[bytes, "NodeRecord"], spec, pg_manager) -> 
     hybrid: feasible nodes sorted by post-placement utilization, ties randomized
     so uniform actors spread.
     """
-    alive = [n for n in nodes.values() if n.alive]
+    # Draining nodes are alive but retiring: never START anything there
+    # (existing work runs to the drain deadline; PG-pinned placement below
+    # still honors an already-committed bundle location).
+    alive = [n for n in nodes.values()
+             if n.alive and not getattr(n, "draining", False)]
     strategy = spec.scheduling_strategy
     if spec.placement_group_id is not None and pg_manager is not None:
+        alive = [n for n in nodes.values() if n.alive]
         node_id = pg_manager.bundle_location(spec.placement_group_id,
                                              spec.placement_group_bundle_index)
         return [n for n in alive if node_id is not None and n.node_id == node_id]

@@ -427,6 +427,11 @@ class NodeInfoMsg(Message):
     is_head = Field(7, BOOL)
     alive = Field(8, BOOL, default=True)
     object_store_path = Field(9, STR)
+    # Two-phase drain: the node is still alive (leases/objects keep
+    # working) but is scheduled for retirement at drain_deadline (unix
+    # seconds; 0.0 = not draining). Old peers skip unknown fields.
+    draining = Field(10, BOOL)
+    drain_deadline = Field(11, FLOAT)
 
 
 class HeartbeatMsg(Message):

@@ -141,15 +141,25 @@ def cmd_stack(args):
 
 
 def cmd_drain(args):
-    """Drain a node: the GCS marks it dead for scheduling; its actors
-    restart elsewhere (DrainRaylet analog, node_manager.proto)."""
+    """Drain a node (DrainNode analog, node_manager.proto).
+
+    With --deadline N the node enters the two-phase DRAINING state: it
+    stays alive for N seconds while the scheduler stops leasing onto it,
+    its raylet migrates primary object copies to peers, and drain-aware
+    consumers checkpoint/re-form; at the deadline the GCS kills it with
+    the preempted marker. --deadline 0 (default) is the legacy immediate
+    drain: marked dead now, reactive recovery everywhere."""
     from ray_tpu.core import worker as worker_mod
 
     _connect(args.address)
     core = worker_mod.global_worker()
     node_id = bytes.fromhex(args.node_id)
-    core.io.run(core.gcs.call("drain_node", node_id=node_id))
-    print(json.dumps({"drained": args.node_id}))
+    reply = core.io.run(core.gcs.call(
+        "drain_node", node_id=node_id, reason=args.reason,
+        deadline_s=args.deadline))
+    print(json.dumps({"drained": args.node_id,
+                      "draining": bool(reply.get("draining")),
+                      "deadline": reply.get("deadline")}))
 
 
 def cmd_stop(args):
@@ -316,9 +326,18 @@ def main(argv=None):
                    help="also print the GCS wait-graph + detector verdict")
     p.set_defaults(fn=cmd_stack)
 
-    p = sub.add_parser("drain")
+    p = sub.add_parser("drain",
+                       help="retire a node: immediately, or gracefully "
+                            "with an advance-notice deadline")
     p.add_argument("node_id", help="hex node id (see `list nodes`)")
     p.add_argument("--address", required=True)
+    p.add_argument("--deadline", type=float, default=0.0,
+                   help="drain notice window in seconds: the node keeps "
+                        "running this long while work and objects migrate "
+                        "off it, then dies as preempted (0 = immediate)")
+    p.add_argument("--reason", default="drained via scripts",
+                   help="human-readable drain cause (lands in events and "
+                        "death reasons)")
     p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser("stop")

@@ -27,6 +27,10 @@ def list_nodes() -> List[dict]:
             "resources": n["resources"],
             "available": n["available"],
             "labels": n["labels"],
+            "draining": bool(n.get("draining", False)),
+            "drain_reason": n.get("drain_reason", ""),
+            "drain_deadline": n.get("drain_deadline", 0.0),
+            "death_reason": n.get("death_reason", ""),
         })
     return out
 
@@ -258,6 +262,8 @@ def summary() -> Dict:
     actors = list_actors()
     out = {
         "nodes_alive": sum(1 for n in nodes if n["alive"]),
+        "nodes_draining": sum(1 for n in nodes
+                              if n["alive"] and n.get("draining")),
         "nodes_total": len(nodes),
         "actors_alive": sum(1 for a in actors if a["state"] == "ALIVE"),
         "actors_total": len(actors),

@@ -53,10 +53,20 @@ class TrainController:
 
     @staticmethod
     def _available_resources() -> Dict[str, float]:
-        import ray_tpu
-
+        # Schedulable capacity only: a DRAINING node still advertises its
+        # resources but refuses new leases and bundles, so counting it
+        # would declare capacity that placement can't actually use (and
+        # a drain re-form would race its own dying node).
         try:
-            return ray_tpu.available_resources()
+            from ray_tpu.state.api import list_nodes
+
+            total: Dict[str, float] = {}
+            for n in list_nodes():
+                if not n["alive"] or n.get("draining"):
+                    continue
+                for k, v in n["available"].items():
+                    total[k] = total.get(k, 0.0) + v
+            return total
         except Exception:
             return {}
 
@@ -111,6 +121,11 @@ class TrainController:
                     # wedged inside blocking collectives — unblock them
                     # before tearing the group down.
                     group.abort_collectives(error)
+                elif error == _RESIZE:
+                    # Controlled re-form (elastic resize / drain notice):
+                    # close backends rank-locally so no rank records a
+                    # COLLECTIVE_ABORT for what is a clean restart.
+                    group.quiesce()
                 group.shutdown()
             if error is None:
                 self._final_result = Result(
@@ -126,6 +141,11 @@ class TrainController:
                 world = self._pending_world
                 logger.info("train run %s resizing to %d workers",
                             self.run_name, world)
+                # A drain-notice re-form races the replacement capacity the
+                # autoscaler launched at notice time: wait for schedulable
+                # (non-draining) room so the new placement group doesn't
+                # fail infeasible and burn a failure-policy retry.
+                self._wait_for_capacity(world)
                 continue
             if self.failure_policy.decide(error) == FailureDecision.RETRY:
                 decision = self.scaling_policy.on_failure(
@@ -222,14 +242,73 @@ class TrainController:
         except Exception:
             pass
 
+    def _drain_hits_group(self, group) -> bool:
+        """True when a NODE_DRAINING notice covers a node hosting one of
+        this run's placement-group bundles.
+
+        This is the proactive half of advance-notice preemption: instead of
+        waiting for the deadline kill to surface as a CollectiveAbortError /
+        TpuSliceLostError (the reactive gang-restart path), the controller
+        sees the notice, tears the group down cleanly, and re-forms it from
+        the latest checkpoint on replacement capacity — the scheduler
+        already refuses draining nodes, so the new bundles land elsewhere.
+        Best-effort: drain awareness must never fail the control loop."""
+        from ray_tpu.core import worker as worker_mod
+        from ray_tpu.runtime import events as events_mod
+
+        seen = getattr(self, "_seen_drain_events", None)
+        if seen is None:
+            seen = self._seen_drain_events = set()
+        try:
+            core = worker_mod.global_worker()
+            draining = set()
+            for ev in core.io.run(core.gcs.call(
+                    "list_events", event_type=events_mod.NODE_DRAINING,
+                    limit=20), timeout=5):
+                if ev.get("node_id"):
+                    draining.add(ev["node_id"])
+                key = (ev.get("node_id"), ev.get("time"))
+                if key not in seen:
+                    seen.add(key)
+                    logger.warning("train run %s: %s", self.run_name,
+                                   ev.get("message"))
+            if not draining or group.pg is None:
+                return False
+            info = group.pg.table()
+            homes = {loc.hex() if isinstance(loc, bytes) else loc
+                     for loc in info.get("locations", []) if loc}
+            hit = sorted(h[:12] for h in homes & draining)
+            if hit:
+                latest = self.ckpt_manager.latest_checkpoint
+                logger.warning(
+                    "train run %s: draining node(s) %s host gang bundles; "
+                    "proactive re-form from %s before the drain deadline",
+                    self.run_name, ", ".join(hit),
+                    latest.path if latest else "scratch")
+                return True
+        except Exception:
+            pass
+        return False
+
     def _poll_until_done(self, group, poll_interval: float,
                          world: int) -> Optional[str]:
         from ray_tpu.config import cfg
 
         last_elastic_check = time.monotonic()
+        last_drain_check = time.monotonic()
         while True:
             polls = group.poll()
             now = time.monotonic()
+            if (now - last_drain_check
+                    >= cfg().train_drain_check_interval_s):
+                last_drain_check = now
+                if self._drain_hits_group(group):
+                    # Re-form even without a checkpoint on record: the
+                    # draining node dies at the deadline regardless, so a
+                    # clean scratch restart on replacement capacity beats
+                    # riding into the collective abort.
+                    self._pending_world = world
+                    return _RESIZE
             if (now - last_elastic_check
                     >= cfg().train_elastic_check_interval_s):
                 last_elastic_check = now

@@ -153,6 +153,26 @@ class WorkerGroup:
         except Exception:
             pass  # GCS may already be unreachable; kill path still works
 
+    def quiesce(self, timeout: float = 10.0):
+        """Controlled-teardown prelude (drain/resize — NOT failure): close
+        each rank's collective backend so training threads blocked inside
+        collectives unblock LOCALLY (close aborts without propagating, so
+        no group-wide abort flag and no COLLECTIVE_ABORT event) before the
+        actors are killed. Without this, killing rank A mid-allreduce
+        makes rank B observe a broken link and record a real abort —
+        turning a clean checkpoint-resume re-form into what looks like a
+        gang failure after the fact."""
+        refs = []
+        for w in self.workers:
+            try:
+                refs.append(w.shutdown_backend.remote())
+            except Exception:
+                pass
+        try:
+            ray_tpu.get(refs, timeout=timeout)
+        except Exception:
+            pass  # a rank may already be dead; kill path still works
+
     def shutdown(self):
         for w in self.workers:
             try:

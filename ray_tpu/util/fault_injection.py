@@ -157,6 +157,121 @@ class SliceKiller(NodeKiller):
         return None
 
 
+class PreemptionKiller(NodeKiller):
+    """Advance-notice preemption: drain notice now, hard kill at deadline.
+
+    Models a spot/preemptible reclaim end to end: `strike()` picks a
+    victim, issues the GCS `drain_node(node_id, reason, deadline_s=
+    notice_s)` two-phase drain (scheduler stops leasing onto it, its
+    raylet migrates primary object copies, Train/RLHF checkpoint and
+    re-form proactively), then a timer thread force-kills the raylet at
+    the deadline — whatever didn't migrate in time falls back to the
+    reactive paths (fate-sharing, lineage reconstruction, gang restart).
+
+    `notice_s <= 0` is the no-notice shape: immediate drain-as-kill (the
+    GCS treats a non-positive deadline as a straight NODE_PREEMPTED
+    death), exercising the purely reactive recovery the graceful plane
+    falls back to. With `respawn=True` a replacement node (same
+    resources/labels) is added AT NOTICE TIME, standing in for the
+    autoscaler's replacement launch so re-forming gangs have somewhere
+    to go before the deadline."""
+
+    def __init__(self, cluster, notice_s: float = 10.0, *,
+                 reason: str = "chaos preemption", respawn: bool = True,
+                 seed: int = 0,
+                 node_filter: Optional[Callable] = None):
+        self.notice_s = notice_s
+        self.reason = reason
+        self.struck: List[str] = []
+        self._timers: List[threading.Timer] = []
+        # Respawn is handled here at NOTICE time (see strike), never by
+        # the inherited deadline-kill path.
+        self._respawn_replacement = respawn
+        super().__init__(cluster, interval_s=3600.0, respawn=False,
+                         seed=seed, node_filter=node_filter)
+
+    def _drain(self, node_id: bytes) -> bool:
+        """Issue the drain RPC from a fresh client (the killer outlives
+        any driver worker, so it cannot borrow one's GCS connection)."""
+        import asyncio
+
+        from ray_tpu.runtime.rpc import RpcClient
+
+        async def call():
+            client = RpcClient(*self.cluster.gcs_address)
+            await client.connect(timeout=5)
+            try:
+                return await client.call(
+                    "drain_node", node_id=node_id, reason=self.reason,
+                    deadline_s=self.notice_s, timeout=10)
+            finally:
+                await client.close()
+
+        try:
+            return bool(asyncio.run(call()).get("ok"))
+        except Exception:
+            logger.warning("PreemptionKiller: drain_node failed",
+                           exc_info=True)
+            return False
+
+    def _respawn_like(self, resources: dict, labels: dict):
+        """Replacement capacity, standing in for the autoscaler's
+        notice-time replacement launch."""
+        if not self._respawn_replacement:
+            return
+        try:
+            res = dict(resources)
+            self.cluster.add_node(num_cpus=res.pop("CPU", 1.0),
+                                  num_tpus=res.pop("TPU", 0.0),
+                                  resources=res or None,
+                                  labels=dict(labels) or None)
+        except Exception:
+            self.respawn_failures += 1
+            logger.warning("PreemptionKiller: replacement respawn failed",
+                           exc_info=True)
+
+    def strike(self) -> Optional[str]:
+        """Preempt one qualifying node NOW: drain notice + replacement
+        capacity immediately, then a timed hard kill `notice_s` later.
+        Returns the victim's short node id (before the kill lands), or
+        None if no node qualifies."""
+        node = self._pick_victim()
+        if node is None:
+            logger.warning("PreemptionKiller: no node to preempt")
+            return None
+        short = node.node_id.hex()[:12]
+        resources = dict(node.resources)
+        labels = dict(getattr(node, "labels", {}) or {})
+        if self.notice_s <= 0:
+            # No-notice preemption: the GCS marks it dead (reactive path),
+            # then the process goes away and the replacement arrives late.
+            self._drain(node.node_id)
+            self._kill_one(node)
+            self._respawn_like(resources, labels)
+            self.struck.append(short)
+            return short
+        self._drain(node.node_id)
+        self._respawn_like(resources, labels)
+        self.struck.append(short)
+        logger.info("PreemptionKiller: drain notice for node %s "
+                    "(kill in %.1fs)", short, self.notice_s)
+        timer = threading.Timer(self.notice_s, self._deadline_kill, (node,))
+        timer.daemon = True
+        timer.start()
+        self._timers.append(timer)
+        return short
+
+    def _deadline_kill(self, node):
+        if node.proc.poll() is not None:
+            return  # already down (GCS deadline enforcement won the race)
+        self._kill_one(node)
+
+    def stop(self):
+        for t in self._timers:
+            t.cancel()
+        super().stop()
+
+
 class GcsKiller:
     """Kills and restarts the GCS on an interval (GCS fault-tolerance
     churn; the reference exercises this via NotifyGCSRestart paths).

@@ -1,0 +1,588 @@
+"""Graceful preemption & drain plane: advance-notice node retirement.
+
+A drain notice (`drain_node(node_id, reason, deadline_s)`) starts a
+two-phase retirement: the node enters DRAINING (alive, but the scheduler
+stops leasing onto it and its raylet migrates primary object copies),
+then dies for real at the deadline with the NodePreempted marker in its
+death reason. Drain-aware consumers act during the window — the Train
+controller checkpoints and re-forms its gang on replacement capacity
+BEFORE the kill (no collective abort, no gang restart), the autoscaler
+launches replacement instances at notice time — and anything that misses
+the window falls back to the reactive paths (fate-sharing, lineage
+reconstruction, gang restart), counter-proven by the zero-notice test.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime.tpu_topology import slice_labels
+
+
+# ---------------------------------------------------------------------------
+# (a) Drain state machine: DRAINING state, lease refusal, object migration,
+#     deadline kill with the typed preemption marker.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_drain_state_machine_object_migration_and_deadline():
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core import worker as worker_mod
+    from ray_tpu.runtime import metric_defs
+    from ray_tpu.state import list_cluster_events
+    from ray_tpu.state.api import list_nodes, node_stats, summary
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=2)  # head
+        victim = cluster.add_node(num_cpus=1, resources={"pin": 1.0})
+        cluster.add_node(num_cpus=1)
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(3)
+
+        # An object whose ONLY copy lives in the victim's plasma store
+        # (large enough to skip the inline-return path).
+        @ray_tpu.remote(resources={"pin": 1})
+        def make():
+            return np.ones(300_000, dtype=np.uint8)
+
+        ref = make.remote()
+        ready, _ = ray_tpu.wait([ref], timeout=30)
+        assert ready, "pinned task did not finish"
+
+        core = worker_mod.global_worker()
+        reply = core.io.run(core.gcs.call(
+            "drain_node", node_id=victim.node_id,
+            reason="test preemption", deadline_s=8.0))
+        assert reply["ok"] and reply["draining"], reply
+
+        # DRAINING is visible everywhere observability looks.
+        nodes = {n["node_id"]: n for n in list_nodes()}
+        me = nodes[victim.node_id.hex()]
+        assert me["alive"] and me["draining"], me
+        assert me["drain_reason"] == "test preemption", me
+        assert summary()["nodes_draining"] == 1
+        assert list_cluster_events(event_type="NODE_DRAINING"), \
+            "no NODE_DRAINING event"
+
+        # The raylet proactively migrates its primary object copies.
+        deadline = time.monotonic() + 6
+        progress = None
+        while time.monotonic() < deadline:
+            stats = {s["node_id"]: s for s in node_stats()}
+            st = stats.get(victim.node_id.hex())
+            if st and st.get("drain_progress", {}).get("objects_migrated"):
+                progress = st["drain_progress"]
+                break
+            time.sleep(0.3)
+        assert progress and progress["objects_migrated"] >= 1, progress
+
+        # The scheduler refuses NEW leases onto a draining node: a fresh
+        # lease class needing the victim's custom resource parks as
+        # infeasible instead of starting work that would die at the
+        # deadline. (A different resource shape than `make` so the probe
+        # can't reuse the driver's cached lease — already-granted leases
+        # legitimately run until the deadline. Probed after migration
+        # progress so drain state has propagated to every raylet's
+        # cluster view — the notice itself is async.)
+        @ray_tpu.remote(resources={"pin": 0.5})
+        def probe():
+            return 1
+
+        leased, _ = ray_tpu.wait([probe.remote()], timeout=2)
+        assert not leased, \
+            "new lease granted on a draining node during the drain window"
+
+        # At the deadline the GCS kills the node for real, preserving the
+        # preemption cause through death.
+        deadline = time.monotonic() + 12
+        while time.monotonic() < deadline:
+            nodes = {n["node_id"]: n for n in list_nodes()}
+            if not nodes[victim.node_id.hex()]["alive"]:
+                break
+            time.sleep(0.3)
+        me = nodes[victim.node_id.hex()]
+        assert not me["alive"], "draining node not killed at deadline"
+        assert "NodePreempted" in me["death_reason"], me
+        assert list_cluster_events(event_type="NODE_PREEMPTED"), \
+            "no NODE_PREEMPTED event"
+        assert summary()["nodes_draining"] == 0
+
+        # The object survived the retirement WITHOUT lineage re-execution:
+        # its migrated copy serves the get.
+        cluster.remove_node(victim, force=True)
+        before = sum(metric_defs.RECONSTRUCTIONS.snapshot()["values"]
+                     .values())
+        val = ray_tpu.get(ref, timeout=30)
+        after = sum(metric_defs.RECONSTRUCTIONS.snapshot()["values"]
+                    .values())
+        assert val.sum() == 300_000
+        assert after == before, \
+            f"object was reconstructed ({before} -> {after}), not migrated"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (b) Typed death cause: the preemption marker survives the string-shaped
+#     death reason and is classified by death_cause().
+# ---------------------------------------------------------------------------
+
+def test_death_cause_classifies_preemption():
+    from ray_tpu.core.exceptions import (
+        CAUSE_CRASH, CAUSE_PREEMPTION, NODE_PREEMPTED_MARKER,
+        NodeDiedError, death_cause)
+
+    assert death_cause(f"{NODE_PREEMPTED_MARKER}: drain deadline") \
+        == CAUSE_PREEMPTION
+    assert death_cause("heartbeat timeout") == CAUSE_CRASH
+    assert death_cause(None) == CAUSE_CRASH
+
+    e = NodeDiedError("ab" * 16, f"{NODE_PREEMPTED_MARKER}: spot reclaim")
+    assert e.cause == CAUSE_PREEMPTION
+    assert NodeDiedError("ab" * 16, "raylet crashed").cause == CAUSE_CRASH
+
+
+# ---------------------------------------------------------------------------
+# (c) Preemption-caused deaths do not consume retry budgets: an actor with
+#     max_restarts=1 survives TWO preemptions (the announced deaths are
+#     exempt), where two ordinary node failures would have exceeded it.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_preemption_death_spares_actor_restart_budget():
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.fault_injection import PreemptionKiller
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=2)  # head
+        cluster.add_node(num_cpus=1, resources={"spot": 1.0})
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote(max_restarts=1, max_task_retries=4)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        a = Counter.options(resources={"spot": 1.0}).remote()
+        assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+
+        killer = PreemptionKiller(
+            cluster, notice_s=0.0, respawn=True,
+            node_filter=lambda n: "spot" in (n.resources or {}))
+        for round_no in (1, 2):
+            assert killer.strike() is not None
+            cluster.wait_for_nodes(2)
+            # Restarted (state reset) on the replacement node: a second
+            # ordinary failure would exhaust max_restarts=1, but announced
+            # preemptions never decrement the budget.
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                try:
+                    assert ray_tpu.get(a.bump.remote(), timeout=60) >= 1
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            else:
+                raise AssertionError(
+                    f"actor not restarted after preemption #{round_no}")
+        killer.stop()
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (d) End to end, graceful path: with advance notice, a Train gang
+#     re-forms from a pre-deadline checkpoint on replacement capacity
+#     with ZERO collective aborts and ZERO reactive gang restarts.
+# ---------------------------------------------------------------------------
+
+def _drain_train_fn(config):
+    import tempfile
+    import time as _time
+
+    import numpy as _np
+
+    from ray_tpu import train as t
+    from ray_tpu.train.backend import allreduce_gradients
+
+    ctx = t.get_context()
+    start = 0
+    ckpt = t.get_checkpoint()
+    if ckpt is not None:
+        with open(os.path.join(ckpt.path, "state.json")) as f:
+            start = json.load(f)["step"] + 1
+    if ctx.get_world_rank() == 0 and config.get("marker_file"):
+        with open(config["marker_file"], "a") as f:
+            f.write(f"{start}\n")
+    for step in range(start, 10):
+        grad = allreduce_gradients(_np.ones(4) * (ctx.get_world_rank() + 1))
+        assert grad.shape == (4,)
+        _time.sleep(0.4)
+        metrics = {"step": step, "world": ctx.get_world_size()}
+        if ctx.get_world_rank() == 0:
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"step": step}, f)
+            t.report(metrics, checkpoint=t.Checkpoint(d))
+        else:
+            t.report(metrics)
+
+
+@pytest.mark.chaos
+def test_preemption_notice_graceful_train_reform(tmp_path):
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train.config import (CheckpointConfig, FailureConfig,
+                                      RunConfig, ScalingConfig)
+    from ray_tpu.train.controller import TrainController
+    from ray_tpu.util.fault_injection import PreemptionKiller
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=2)  # head
+        for _ in range(2):
+            cluster.add_node(num_cpus=1, resources={"slicehost": 1})
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(3)
+
+        marker = str(tmp_path / "resume_starts.txt")
+        controller = TrainController(
+            _drain_train_fn, train_loop_config={"marker_file": marker},
+            scaling_config=ScalingConfig(
+                num_workers=2,
+                resources_per_worker={"CPU": 1.0, "slicehost": 1.0}),
+            run_config=RunConfig(
+                name="drain-notice", storage_path=str(tmp_path),
+                checkpoint_config=CheckpointConfig(num_to_keep=2),
+                failure_config=FailureConfig(max_failures=3)),
+            backend="collective")
+
+        box = {}
+
+        def run():
+            try:
+                box["result"] = controller.run(poll_interval=0.2)
+            except BaseException as e:  # pragma: no cover
+                box["crash"] = e
+
+        runner = threading.Thread(target=run, daemon=True)
+        runner.start()
+
+        # Real progress (at least one checkpoint) before the notice, so
+        # the re-form provably resumes instead of restarting.
+        deadline = time.monotonic() + 90
+        while (time.monotonic() < deadline
+               and controller.ckpt_manager.latest_checkpoint is None):
+            time.sleep(0.2)
+        assert controller.ckpt_manager.latest_checkpoint is not None, \
+            "no checkpoint before the preemption notice"
+
+        # Advance-notice preemption of one gang host: drain notice +
+        # replacement capacity now, hard kill 8 s later.
+        killer = PreemptionKiller(
+            cluster, notice_s=8.0, respawn=True,
+            node_filter=lambda n: "slicehost" in (n.resources or {}))
+        assert killer.strike() is not None
+
+        runner.join(180)
+        assert not runner.is_alive(), "train run did not finish"
+
+        # The run can finish before the 8 s deadline fires; the GCS still
+        # enforces the deadline and kills the victim.  Wait for that kill
+        # BEFORE stopping the killer (stop() cancels its local kill timer).
+        from ray_tpu.state import list_cluster_events
+        deadline = time.monotonic() + 20
+        while (time.monotonic() < deadline
+               and not list_cluster_events(event_type="NODE_PREEMPTED")):
+            time.sleep(0.3)
+        killer.stop()
+
+        assert "crash" not in box, box.get("crash")
+        result = box["result"]
+        assert result.error is None, result.error
+        assert result.metrics["step"] == 9
+        assert result.metrics["world"] == 2
+
+        # The graceful contract: the controller saw the notice and re-formed
+        # BEFORE the deadline — no rank ever hit a collective abort, and the
+        # reactive gang-restart path never fired.
+        assert not list_cluster_events(event_type="COLLECTIVE_ABORT"), \
+            "a rank aborted a collective despite the advance notice"
+        assert not list_cluster_events(event_type="TRAIN_GANG_RESTART"), \
+            "reactive gang restart fired despite the advance notice"
+        assert controller.telemetry.gang_restarts == 0
+        assert list_cluster_events(event_type="NODE_DRAINING")
+        assert list_cluster_events(event_type="NODE_PREEMPTED")
+
+        # The re-formed attempt resumed from a pre-deadline checkpoint:
+        # some attempt started at a step > 0.
+        with open(marker) as f:
+            starts = [int(line) for line in f.read().split()]
+        assert len(starts) >= 2, f"no re-form happened: {starts}"
+        assert max(starts) > 0, f"re-form restarted from scratch: {starts}"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (e) Counter-proof, zero notice: with no drain window the same scenario
+#     still recovers — via the REACTIVE path (fate-sharing + gang restart
+#     from the last checkpoint).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_zero_notice_preemption_reactive_fallback(tmp_path):
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train.config import (CheckpointConfig, FailureConfig,
+                                      RunConfig, ScalingConfig)
+    from ray_tpu.train.controller import TrainController
+    from ray_tpu.util.fault_injection import PreemptionKiller
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=2)  # head
+        for i in range(2):
+            cluster.add_node(num_cpus=1, resources={"slicehost": 1},
+                             labels=slice_labels("trillium-0", "v5e-16", i))
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(3)
+
+        controller = TrainController(
+            _drain_train_fn, train_loop_config={},
+            scaling_config=ScalingConfig(
+                num_workers=2,
+                resources_per_worker={"CPU": 1.0, "slicehost": 1.0}),
+            run_config=RunConfig(
+                name="zero-notice", storage_path=str(tmp_path),
+                checkpoint_config=CheckpointConfig(num_to_keep=2),
+                failure_config=FailureConfig(max_failures=3)),
+            backend="collective")
+
+        box = {}
+
+        def run():
+            try:
+                box["result"] = controller.run(poll_interval=0.2)
+            except BaseException as e:  # pragma: no cover
+                box["crash"] = e
+
+        runner = threading.Thread(target=run, daemon=True)
+        runner.start()
+
+        deadline = time.monotonic() + 90
+        while (time.monotonic() < deadline
+               and controller.ckpt_manager.latest_checkpoint is None):
+            time.sleep(0.2)
+        assert controller.ckpt_manager.latest_checkpoint is not None
+
+        # notice_s=0: the drain IS the kill (straight NODE_PREEMPTED
+        # death); no window for anyone to act gracefully.
+        killer = PreemptionKiller(
+            cluster, notice_s=0.0, respawn=False,
+            node_filter=lambda n: "slicehost" in (n.resources or {}))
+        assert killer.strike() is not None
+        # Replacement capacity arrives AFTER the death, like an autoscaler
+        # reacting to it (fresh slice: the old one fate-shared away).
+        for i in range(2):
+            cluster.add_node(num_cpus=1, resources={"slicehost": 1},
+                             labels=slice_labels("trillium-1", "v5e-16", i))
+
+        runner.join(240)
+        killer.stop()
+        assert not runner.is_alive(), "train run did not finish"
+        assert "crash" not in box, box.get("crash")
+        result = box["result"]
+        assert result.error is None, result.error
+        assert result.metrics["step"] == 9
+
+        # Reactive path fired: the gang restarted after the fact.
+        from ray_tpu.state import list_cluster_events
+        assert list_cluster_events(event_type="TRAIN_GANG_RESTART"), \
+            "no reactive gang restart after zero-notice preemption"
+        assert controller.telemetry.gang_restarts >= 1
+        assert list_cluster_events(event_type="NODE_PREEMPTED")
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (f) RLHF placement: a drain notice forces a same-mode gang re-form on the
+#     next decide(), bypassing dwell hysteresis.
+# ---------------------------------------------------------------------------
+
+def test_placement_policy_drain_forces_reform():
+    from ray_tpu.rlhf.placement import COLOCATED, PlacementPolicy
+
+    policy = PlacementPolicy(rollout_frac_high=0.9, rollout_frac_low=0.1,
+                             kv_pressure_high=0.9, min_dwell=5)
+    # Steady state: no switch.
+    d = policy.decide(1.0, 1.0, None, COLOCATED)
+    assert not d.switch
+
+    policy.note_drain("node abc123 draining")
+    d = policy.decide(1.0, 1.0, None, COLOCATED)
+    assert d.switch and d.mode == COLOCATED
+    assert "drain re-form" in d.reason and "abc123" in d.reason
+
+    # One-shot: the pending drain is consumed, dwell restarts.
+    d = policy.decide(1.0, 1.0, None, COLOCATED)
+    assert not d.switch
+
+
+# ---------------------------------------------------------------------------
+# (g) Autoscaler: a provider preemption notice drains the instance's node
+#     and launches replacement capacity at NOTICE time; the DRAINING record
+#     is dropped once the cloud reclaims the node.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_autoscaler_preemption_notice_drains_and_replaces():
+    from ray_tpu.autoscaler.autoscaler import (
+        Autoscaler, FakeMultiNodeProvider, InstanceType)
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.state.api import list_nodes
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=1)  # head
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(1)
+
+        class SpotProvider(FakeMultiNodeProvider):
+            def __init__(self, cluster):
+                super().__init__(cluster)
+                self.notices = []
+
+            def preemption_notices(self):
+                return list(self.notices)
+
+        provider = SpotProvider(cluster)
+        autoscaler = Autoscaler(
+            provider, [InstanceType("spot-cpu", {"CPU": 1, "spot": 1})],
+            idle_timeout_s=0.5, max_workers=4)
+        assert autoscaler.reconcile(demand=[{"spot": 1}])["launched"] == 1
+        cluster.wait_for_nodes(2)
+        iid = next(iter(provider.nodes))
+        autoscaler.reconcile(demand=[{"spot": 1}])  # bind node id
+
+        provider.notices.append(
+            {"instance_id": iid, "deadline": time.time() + 30.0})
+        autoscaler.reconcile(demand=[{"spot": 1}])
+        assert autoscaler.instances[iid].status == "DRAINING"
+        node_hex = provider.get_node_id(iid).hex()
+        nmap = {n["node_id"]: n for n in list_nodes()}
+        assert nmap[node_hex]["draining"], nmap[node_hex]
+        # Replacement launched at notice time, not at the death.
+        assert len(provider.nodes) == 2
+
+        # The notice is handled once: another tick with the same notice
+        # still listed must not drain/launch again.
+        autoscaler.reconcile(demand=[{"spot": 1}])
+        assert len(provider.nodes) == 2
+
+        # Idle reaping must not beat the drain deadline to the kill: the
+        # DRAINING instance outlives the (tiny) idle timeout even with no
+        # demand — only its deadline retires it.
+        time.sleep(0.7)
+        autoscaler.reconcile(demand=[])
+        assert iid in autoscaler.instances
+        assert autoscaler.instances[iid].status == "DRAINING"
+
+        # Cloud reclaims the node at its real deadline: the next reconcile
+        # drops the DRAINING record (the replacement already exists).
+        victim = provider.nodes[iid]
+        cluster.remove_node(victim, force=True)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            alive = {n["node_id"] for n in list_nodes() if n["alive"]}
+            if node_hex not in alive:
+                break
+            time.sleep(0.2)
+        autoscaler.reconcile(demand=[])
+        assert iid not in autoscaler.instances
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (h) GCE metadata preemption watcher: polls the instance's `preempted`
+#     metadata key and fires the callback exactly once.
+# ---------------------------------------------------------------------------
+
+def test_gce_preemption_watcher_fires_once():
+    import http.server
+
+    from ray_tpu.autoscaler.gce import GcePreemptionWatcher
+
+    state = {"preempted": False}
+    hits = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            hits.append(self.path)
+            body = (b"TRUE" if state["preempted"]
+                    and "instance/preempted" in self.path else b"FALSE")
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    fired = []
+    watcher = GcePreemptionWatcher(
+        lambda notice_s: fired.append(notice_s),
+        poll_interval_s=0.05, notice_s=12.0,
+        metadata_base=f"http://127.0.0.1:{srv.server_address[1]}")
+    watcher.start()
+    try:
+        time.sleep(0.3)
+        assert not fired  # metadata says FALSE: nothing fires
+        state["preempted"] = True
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not fired:
+            time.sleep(0.05)
+        assert fired == [12.0]
+        time.sleep(0.3)
+        assert fired == [12.0], "watcher fired more than once"
+        assert any("instance/preempted" in p for p in hits)
+    finally:
+        watcher.stop()
+        srv.shutdown()
