@@ -1,0 +1,48 @@
+"""Async sharded checkpoint plane.
+
+Three stages, three modules:
+
+- `snapshot`  — device -> host staging buffers; the only part the train
+                step ever waits for.
+- `manifest`  — path-based (zero-pickle) leaf tables, per-rank shard
+                files, atomic manifest commit.
+- `restore`   — manifest -> global leaves -> re-slice for the CURRENT
+                world size (reshard-on-restore, N -> M workers).
+- `plane`     — the background persister tying them together, plus
+                peer replication and GCS relocation registration.
+
+See docs/checkpointing.md for the lifecycle and on-disk format.
+"""
+
+from ray_tpu.checkpoint.manifest import (
+    CheckpointError,
+    CheckpointNotCommitted,
+    FORMAT,
+    has_manifest,
+    read_manifest,
+    shard_axis_for,
+)
+from ray_tpu.checkpoint.plane import (
+    CheckpointPlane,
+    PendingSave,
+    save_sharded,
+)
+from ray_tpu.checkpoint.restore import restore_shard, restore_tree
+from ray_tpu.checkpoint.snapshot import BufferPool, Snapshot, snapshot_shard
+
+__all__ = [
+    "BufferPool",
+    "CheckpointError",
+    "CheckpointNotCommitted",
+    "CheckpointPlane",
+    "FORMAT",
+    "PendingSave",
+    "Snapshot",
+    "has_manifest",
+    "read_manifest",
+    "restore_shard",
+    "restore_tree",
+    "save_sharded",
+    "shard_axis_for",
+    "snapshot_shard",
+]

@@ -1,0 +1,237 @@
+"""Checkpoint manifest: path-based leaf records + atomic commit.
+
+The manifest is the zero-pickle replacement for the old
+`{name}.treedef.pkl` format (train/checkpoint.py): structure crosses
+process and storage boundaries as a JSON table of key paths — the same
+idiom as `rlhf/weight_sync.describe_weights` — never as a pickled
+treedef. Each record carries the leaf's GLOBAL shape/dtype plus how it
+was sharded across the saving world, so restore can reassemble the
+global array from any number of shard files and re-slice it for a
+*different* world size (reshard-on-restore).
+
+On-disk layout for a checkpoint named `state` saved by an N-rank world:
+
+    state-shard-00000-of-00004.npz    per-rank leaf arrays
+    state-shard-00000-of-00004.json   per-rank leaf table + nbytes
+    ...
+    state.manifest.json               committed LAST, atomically
+
+Commit protocol: every rank's persister writes its shard npz + json
+(tmp file, fsync, rename), then calls `try_commit` — whichever rank
+finds all N shard jsons present writes `state.manifest.json` via the
+same tmp+fsync+rename dance. `os.replace` is atomic on POSIX, so a
+crash at ANY point mid-persist leaves either no manifest (checkpoint
+never existed; the previous one stays latest) or a complete one. The
+manifest content is derived deterministically from the shard tables, so
+concurrent committers racing the rename write identical bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core.exceptions import RayTpuError
+
+FORMAT = "ray-tpu-ckpt-v1"
+
+
+class CheckpointError(RayTpuError):
+    pass
+
+
+class CheckpointNotCommitted(CheckpointError):
+    """No committed manifest at the path: the save never completed (or
+    crashed mid-persist). Callers fall back to the previous checkpoint."""
+
+
+# -- key paths --------------------------------------------------------------
+
+def encode_path(path) -> List[dict]:
+    """JSON-able encoding of a jax key path: dict keys as {"key": k},
+    sequence positions as {"idx": i}, attribute nodes (registered
+    dataclass-style pytrees) as {"attr": name}. Anything else is an
+    error — exotic custom nodes should be captured via a template on
+    restore, but their keys still encode one of these three ways."""
+    out: List[dict] = []
+    for k in path:
+        if hasattr(k, "key"):                       # DictKey / FlattenedIndex
+            key = k.key
+            if not isinstance(key, (str, int)):
+                raise CheckpointError(
+                    f"non-JSON dict key {key!r} in checkpoint tree")
+            out.append({"key": key})
+        elif hasattr(k, "idx"):                     # SequenceKey
+            out.append({"idx": int(k.idx)})
+        elif hasattr(k, "name"):                    # GetAttrKey
+            out.append({"attr": str(k.name)})
+        else:
+            raise CheckpointError(f"unsupported tree key {k!r}")
+    return out
+
+
+def path_str(encoded: Sequence[dict]) -> str:
+    return "/".join(str(next(iter(seg.values()))) for seg in encoded) or "."
+
+
+# -- leaf records -----------------------------------------------------------
+
+def shard_axis_for(shape: Tuple[int, ...], world: int) -> Optional[int]:
+    """Axis 0 when the leading dim splits evenly across the world;
+    None = replicated (stored by shard 0 only). The rule is recomputed
+    at restore time for the NEW world size, so N-way and M-way layouts
+    of the same tree are both derivable from the global shape alone."""
+    if world > 1 and len(shape) >= 1 and shape[0] >= world \
+            and shape[0] % world == 0:
+        return 0
+    return None
+
+
+def leaf_records(tree: Any, world: int) -> Tuple[List[dict], List[Any]]:
+    """Flatten `tree` with paths into (records, leaves). Records carry
+    GLOBAL shape/dtype + shard_axis for `world`; every rank derives the
+    identical table from its (replicated) tree."""
+    import jax
+    import numpy as np
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    records, leaves = [], []
+    for path, leaf in flat:
+        shape = tuple(int(d) for d in getattr(leaf, "shape", ()))
+        dtype = str(np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype)))
+        records.append({"path": encode_path(path),
+                        "global_shape": list(shape),
+                        "dtype": dtype,
+                        "shard_axis": shard_axis_for(shape, world)})
+        leaves.append(leaf)
+    return records, leaves
+
+
+# -- file naming ------------------------------------------------------------
+
+def shard_npz(name: str, rank: int, world: int) -> str:
+    return f"{name}-shard-{rank:05d}-of-{world:05d}.npz"
+
+
+def shard_meta(name: str, rank: int, world: int) -> str:
+    return f"{name}-shard-{rank:05d}-of-{world:05d}.json"
+
+
+def manifest_file(name: str) -> str:
+    return f"{name}.manifest.json"
+
+
+def has_manifest(directory: str, name: str = "state") -> bool:
+    return os.path.exists(os.path.join(directory, manifest_file(name)))
+
+
+# -- durable writes ---------------------------------------------------------
+
+def _fsync_write(path: str, write_fn, fsync: bool = True) -> None:
+    """Write via tmp + (fsync) + atomic rename, so readers only ever see
+    absent-or-complete files."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        write_fn(f)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _fsync_dir(directory: str) -> None:
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass  # not all filesystems support directory fsync
+
+
+def write_shard(directory: str, name: str, rank: int, world: int,
+                records: Sequence[dict], local_leaves: Sequence,
+                fsync: bool = True) -> int:
+    """Persist one rank's shard: npz of its local leaf arrays + the json
+    leaf table. `local_leaves[i]` is None for leaves this rank does not
+    store (replicated leaves on rank > 0). Returns bytes written."""
+    import numpy as np
+
+    os.makedirs(directory, exist_ok=True)
+    entries = {f"leaf_{i}": np.asarray(l)
+               for i, l in enumerate(local_leaves) if l is not None}
+    nbytes = int(sum(a.nbytes for a in entries.values()))
+    _fsync_write(os.path.join(directory, shard_npz(name, rank, world)),
+                 lambda f: np.savez(f, **entries), fsync=fsync)
+    meta = {"format": FORMAT, "name": name, "rank": rank, "world": world,
+            "nbytes": nbytes, "leaves": list(records)}
+    payload = json.dumps(meta).encode()
+    _fsync_write(os.path.join(directory, shard_meta(name, rank, world)),
+                 lambda f: f.write(payload), fsync=fsync)
+    return nbytes
+
+
+def try_commit(directory: str, name: str, world: int, *,
+               step: Optional[int] = None, fsync: bool = True,
+               extra: Optional[Dict[str, Any]] = None) -> Optional[dict]:
+    """Atomically commit the manifest if (and only if) every shard's meta
+    is on disk. Returns the manifest dict when THIS call committed (or
+    found it already committed returns None — exactly one caller reports
+    the commit), None while shards are still missing."""
+    path = os.path.join(directory, manifest_file(name))
+    if os.path.exists(path):
+        return None  # someone else won the commit race
+    tables = []
+    for rank in range(world):
+        p = os.path.join(directory, shard_meta(name, rank, world))
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            tables.append(json.load(f))
+    canonical = tables[0]["leaves"]
+    for t in tables[1:]:
+        if t["leaves"] != canonical:
+            raise CheckpointError(
+                f"shard leaf tables disagree under {directory!r}; "
+                "ranks snapshotted different trees")
+    manifest = {"format": FORMAT, "name": name, "world": world,
+                "step": step,
+                "nbytes": int(sum(t["nbytes"] for t in tables)),
+                "leaves": canonical}
+    if extra:
+        manifest["extra"] = dict(extra)
+    payload = json.dumps(manifest).encode()
+    _fsync_write(path, lambda f: f.write(payload), fsync=fsync)
+    _fsync_dir(directory)
+    return manifest
+
+
+def read_manifest(directory: str, name: str = "state") -> dict:
+    path = os.path.join(directory, manifest_file(name))
+    if not os.path.exists(path):
+        raise CheckpointNotCommitted(
+            f"no committed manifest {manifest_file(name)!r} under "
+            f"{directory!r} (crashed mid-persist, or not a checkpoint)")
+    with open(path) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != FORMAT:
+        raise CheckpointError(
+            f"unknown checkpoint format {manifest.get('format')!r}")
+    return manifest
+
+
+def wait_committed(directory: str, name: str, timeout: float) -> bool:
+    """Poll (cheap stat) until the manifest lands; used by rank 0's
+    persister to learn when the LAST rank's commit made the checkpoint
+    real, without any cross-rank RPC."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while True:
+        if has_manifest(directory, name):
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.02)

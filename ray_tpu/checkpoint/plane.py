@@ -1,0 +1,322 @@
+"""The checkpoint plane: async save = snapshot now, persist in background.
+
+`save_async` stalls the caller for the device->host snapshot ONLY
+(snapshot.py), then hands the captured buffers to a daemon persister
+thread which:
+
+  1. writes this rank's shard npz + leaf table (tmp + fsync + rename),
+  2. tries the atomic manifest commit (manifest.py — whichever rank
+     lands last commits; a crash anywhere leaves the previous
+     checkpoint valid),
+  3. optionally replicates the completed shard to peer hosts through
+     the `util/broadcast.py` fanout tree and registers the replica
+     object in the GCS drain relocation table, so a draining node's
+     shards are already elsewhere when the deadline kill lands,
+  4. books `ray_tpu_ckpt_*` metrics and (committer only) emits the
+     CHECKPOINT_SAVED cluster event.
+
+Rank 0's persister additionally waits (bounded, cheap stat polling) for
+the manifest commit even when another rank lands it, so exactly one
+rank can report "checkpoint real" upstream — that is how the Train
+session feeds the controller's CheckpointManager without any
+cross-rank RPC.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from ray_tpu.checkpoint import manifest as manifest_mod
+from ray_tpu.checkpoint import snapshot as snapshot_mod
+from ray_tpu.checkpoint.manifest import CheckpointError
+
+logger = logging.getLogger(__name__)
+
+
+class PendingSave:
+    """Handle for one rank's in-flight save. `wait()` blocks until the
+    background persist finished (NOT until the global commit — rank 0's
+    handle observes the commit via `committed`)."""
+
+    def __init__(self, directory: str, name: str, rank: int, world: int,
+                 step: Optional[int], snapshot_ms: float, nbytes: int):
+        self.directory = directory
+        self.name = name
+        self.rank = rank
+        self.world = world
+        self.step = step
+        self.snapshot_ms = snapshot_ms
+        self.nbytes = nbytes
+        self.persist_ms = 0.0
+        self.committed = False
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+    @property
+    def ok(self) -> bool:
+        return self.done.is_set() and self.error is None
+
+    def info(self) -> dict:
+        return {"directory": self.directory, "name": self.name,
+                "rank": self.rank, "world": self.world, "step": self.step,
+                "snapshot_ms": self.snapshot_ms,
+                "persist_ms": self.persist_ms, "bytes": self.nbytes,
+                "committed": self.committed, "ok": self.ok}
+
+
+class CheckpointPlane:
+    """Per-process checkpoint pipeline: one staging-buffer pool + one
+    background persister thread, shared by every save this process makes."""
+
+    def __init__(self, *, reuse_buffers: bool = True, source: str = "train"):
+        self.source = source
+        self._pool = snapshot_mod.BufferPool() if reuse_buffers else None
+        self._queue: "queue.Queue" = queue.Queue()
+        self._pending: List[PendingSave] = []
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        # Replica shard objects stay referenced here so the object plane
+        # keeps them alive across the drain window (bounded: old
+        # checkpoints age out of the deque and become collectable).
+        self._replica_refs: "collections.deque" = collections.deque(maxlen=32)
+
+    # -- public API --------------------------------------------------------
+
+    def save_async(self, tree: Any, directory: str, *, name: str = "state",
+                   rank: int = 0, world: int = 1,
+                   step: Optional[int] = None,
+                   wait_commit: Optional[bool] = None,
+                   on_done: Optional[Callable[[dict], None]] = None
+                   ) -> PendingSave:
+        """Snapshot `tree`'s (rank, world) shard into host buffers and
+        return; persistence happens on the background thread. The caller
+        stalls only for the device->host copy."""
+        if self._closed:
+            raise CheckpointError("checkpoint plane is closed")
+        snap = snapshot_mod.snapshot_shard(tree, rank=rank, world=world,
+                                           name=name, pool=self._pool)
+        from ray_tpu.runtime import metric_defs
+
+        metric_defs.CKPT_SNAPSHOT_MS.observe(snap.snapshot_ms)
+        pending = PendingSave(os.path.abspath(directory), name, rank, world,
+                              step, snap.snapshot_ms, snap.nbytes)
+        if wait_commit is None:
+            wait_commit = rank == 0
+        with self._lock:
+            self._pending.append(pending)
+        self._ensure_thread()
+        self._queue.put((snap, pending, wait_commit, on_done))
+        return pending
+
+    def save_sync(self, tree: Any, directory: str, *, name: str = "state",
+                  rank: int = 0, world: int = 1,
+                  step: Optional[int] = None) -> PendingSave:
+        """Snapshot AND persist inline on the calling thread — the
+        synchronous baseline (and the flush-at-exit path). The caller
+        stalls for serialization, fsync, and the commit attempt."""
+        snap = snapshot_mod.snapshot_shard(tree, rank=rank, world=world,
+                                           name=name, pool=self._pool)
+        from ray_tpu.runtime import metric_defs
+
+        metric_defs.CKPT_SNAPSHOT_MS.observe(snap.snapshot_ms)
+        pending = PendingSave(os.path.abspath(directory), name, rank, world,
+                              step, snap.snapshot_ms, snap.nbytes)
+        with self._lock:
+            self._pending.append(pending)
+        self._persist(snap, pending, wait_commit=False, on_done=None)
+        if pending.error is not None:
+            raise pending.error
+        return pending
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until every in-flight persist finished. True iff all
+        completed without error inside the timeout. This is what the
+        drain path calls AFTER quiescing collectives: the train step
+        never waits for persistence, the teardown does."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ok = True
+        while True:
+            with self._lock:
+                pendings = list(self._pending)
+            if not pendings:
+                return ok
+            for p in pendings:
+                remaining = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                if not p.done.wait(remaining):
+                    return False
+                ok = ok and p.error is None
+            # Loop: a save issued while we waited joins the flush.
+
+    def close(self) -> None:
+        self._closed = True
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join(timeout=5)
+
+    # -- persister ---------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="ckpt-persister", daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            snap, pending, wait_commit, on_done = job
+            self._persist(snap, pending, wait_commit, on_done)
+
+    def _persist(self, snap, pending: PendingSave, wait_commit: bool,
+                 on_done: Optional[Callable[[dict], None]]) -> None:
+        from ray_tpu.config import cfg
+        from ray_tpu.runtime import metric_defs
+        from ray_tpu.util import fault_injection
+
+        t0 = time.perf_counter()
+        committed_manifest = None
+        try:
+            fault_injection.failpoint("ckpt.persist")
+            manifest_mod.write_shard(
+                pending.directory, pending.name, pending.rank, pending.world,
+                snap.records, snap.leaves, fsync=cfg().ckpt_fsync)
+            if cfg().ckpt_replicate:
+                self._replicate_shard(pending)
+            fault_injection.failpoint("ckpt.commit")
+            committed_manifest = manifest_mod.try_commit(
+                pending.directory, pending.name, pending.world,
+                step=pending.step, fsync=cfg().ckpt_fsync)
+            if committed_manifest is not None:
+                pending.committed = True
+        except BaseException as e:  # noqa: BLE001 - surfaced on the handle
+            pending.error = e
+            logger.warning("checkpoint persist failed for %s",
+                           pending.directory, exc_info=True)
+        pending.persist_ms = (time.perf_counter() - t0) * 1e3
+        metric_defs.CKPT_PERSIST_MS.observe(pending.persist_ms)
+        if pending.error is None:
+            metric_defs.CKPT_BYTES.inc(pending.nbytes)
+        snap.release()
+        if committed_manifest is not None:
+            self._emit_saved(pending, committed_manifest)
+        if pending.error is None and not pending.committed and wait_commit:
+            # This rank's shard is durable but another rank holds the
+            # last one. Watch for that commit OFF the persister thread —
+            # blocking here would starve queued saves (and, when several
+            # ranks share one process/plane, the very save that commits).
+            threading.Thread(
+                target=self._await_commit, args=(pending, on_done),
+                name="ckpt-commit-wait", daemon=True).start()
+        else:
+            self._finalize(pending, on_done)
+
+    def _await_commit(self, pending: PendingSave,
+                      on_done: Optional[Callable[[dict], None]]) -> None:
+        from ray_tpu.config import cfg
+
+        try:
+            pending.committed = manifest_mod.wait_committed(
+                pending.directory, pending.name, cfg().ckpt_commit_wait_s)
+        except Exception as e:
+            pending.error = e
+        self._finalize(pending, on_done)
+
+    def _finalize(self, pending: PendingSave,
+                  on_done: Optional[Callable[[dict], None]]) -> None:
+        with self._lock:
+            try:
+                self._pending.remove(pending)
+            except ValueError:
+                pass
+        pending.done.set()
+        if on_done is not None:
+            try:
+                on_done(pending.info())
+            except Exception:
+                logger.warning("checkpoint on_done callback failed",
+                               exc_info=True)
+
+    def _emit_saved(self, pending: PendingSave, committed: dict) -> None:
+        """Exactly one rank (the committer) announces the checkpoint."""
+        from ray_tpu.runtime import events
+
+        events.emit(
+            events.CHECKPOINT_SAVED,
+            f"checkpoint {pending.name!r} committed at {pending.directory} "
+            f"(step {pending.step}, {committed.get('nbytes', 0)} bytes, "
+            f"{pending.world} shard(s))",
+            severity=events.INFO, source=self.source,
+            labels={"step": str(pending.step),
+                    "world": str(pending.world),
+                    "bytes": str(committed.get("nbytes", 0)),
+                    "snapshot_ms": f"{pending.snapshot_ms:.3f}",
+                    "persist_ms": f"{pending.persist_ms:.1f}",
+                    "path": pending.directory})
+
+    def _replicate_shard(self, pending: PendingSave) -> int:
+        """Fan the durable shard bytes out to peer object stores and
+        register the replica in the GCS drain relocation table.
+        Best-effort: replication failures never fail the save."""
+        try:
+            import numpy as np
+
+            import ray_tpu
+            from ray_tpu.config import cfg
+            from ray_tpu.core import worker as worker_mod
+            from ray_tpu.util.broadcast import broadcast_object
+
+            if not ray_tpu.is_initialized():
+                return 0
+            path = os.path.join(
+                pending.directory,
+                manifest_mod.shard_npz(pending.name, pending.rank,
+                                       pending.world))
+            data = np.fromfile(path, dtype=np.uint8)
+            ref = ray_tpu.put(data)
+            covered = broadcast_object(
+                ref, timeout=cfg().ckpt_replicate_timeout_s)
+            core = worker_mod.global_worker()
+            core.io.run(core.gcs.call(
+                "register_checkpoint_shards",
+                path=pending.directory, name=pending.name,
+                shard=pending.rank, world=pending.world, step=pending.step,
+                nbytes=int(data.nbytes), oids=[ref.binary()],
+                node_id=core.node_id), timeout=10)
+            self._replica_refs.append(ref)
+            return covered
+        except Exception:
+            logger.warning("checkpoint shard replication failed for %s",
+                           pending.directory, exc_info=True)
+            return 0
+
+
+def save_sharded(tree: Any, directory: str, *, name: str = "state",
+                 rank: int = 0, world: int = 1,
+                 step: Optional[int] = None) -> dict:
+    """One-shot synchronous save of one rank's shard (world=1 = a whole
+    tree in the new manifest format — the `Checkpoint.save_pytree`
+    backend). Commits the manifest when this shard completes the set."""
+    from ray_tpu.config import cfg
+
+    snap = snapshot_mod.snapshot_shard(tree, rank=rank, world=world,
+                                       name=name, pool=None)
+    nbytes = manifest_mod.write_shard(directory, name, rank, world,
+                                      snap.records, snap.leaves,
+                                      fsync=cfg().ckpt_fsync)
+    committed = manifest_mod.try_commit(directory, name, world, step=step,
+                                        fsync=cfg().ckpt_fsync)
+    return {"bytes": nbytes, "committed": committed is not None,
+            "snapshot_ms": snap.snapshot_ms}

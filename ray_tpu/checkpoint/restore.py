@@ -1,0 +1,213 @@
+"""Reshard-on-restore: manifest -> global leaves -> the CURRENT mesh.
+
+Restore never assumes the saving topology: it reads the committed
+manifest, reassembles each global leaf by concatenating its shard
+slices (shard order is the shard index — bit-exact reassembly), and
+hands the result back either whole (`restore_tree`) or re-sliced for a
+NEW (rank, world) (`restore_shard`). An N-worker checkpoint therefore
+restores onto M workers for any M: the new local shapes are derived
+from the global shape alone, by the same rule the writer used.
+
+Structure rebuild is path-based (zero-pickle): nested dicts and
+lists/tuples come back from the encoded key paths directly; trees with
+custom container nodes (optax states, dataclass pytrees) are rebuilt by
+validating the manifest against a caller-provided `template` — the
+"rebuild the same template locally, adopt the leaves" idiom the RLHF
+placement switch already uses.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+from ray_tpu.checkpoint.manifest import (
+    CheckpointError,
+    encode_path,
+    path_str,
+    read_manifest,
+    shard_axis_for,
+    shard_npz,
+)
+
+
+class _ShardReader:
+    """Lazy npz handles for one checkpoint's shard files."""
+
+    def __init__(self, directory: str, name: str, world: int):
+        import numpy as np
+
+        self._np = np
+        self.directory = directory
+        self.name = name
+        self.world = world
+        self._files: dict = {}
+
+    def _file(self, rank: int):
+        f = self._files.get(rank)
+        if f is None:
+            path = os.path.join(self.directory,
+                                shard_npz(self.name, rank, self.world))
+            if not os.path.exists(path):
+                raise CheckpointError(
+                    f"manifest committed but shard file missing: {path}")
+            f = self._files[rank] = self._np.load(path)
+        return f
+
+    def leaf(self, index: int, record: dict):
+        """Reassemble leaf `index` to its GLOBAL value."""
+        key = f"leaf_{index}"
+        if record["shard_axis"] is None:
+            return self._file(0)[key]
+        parts = [self._file(r)[key] for r in range(self.world)]
+        return self._np.concatenate(parts, axis=record["shard_axis"])
+
+    def close(self):
+        for f in self._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+
+
+def _global_leaves(directory: str, name: str) -> tuple:
+    import numpy as np
+
+    manifest = read_manifest(directory, name)
+    reader = _ShardReader(directory, name, int(manifest["world"]))
+    leaves = []
+    try:
+        for i, rec in enumerate(manifest["leaves"]):
+            leaf = reader.leaf(i, rec)
+            if list(leaf.shape) != list(rec["global_shape"]) \
+                    or str(np.dtype(leaf.dtype)) != rec["dtype"]:
+                raise CheckpointError(
+                    f"leaf {path_str(rec['path'])}: reassembled "
+                    f"{leaf.shape}/{leaf.dtype}, manifest says "
+                    f"{rec['global_shape']}/{rec['dtype']}")
+            leaves.append(np.array(leaf))  # own the memory past npz close
+    finally:
+        reader.close()
+    return manifest, leaves
+
+
+def _rebuild_from_paths(records: List[dict], leaves: List) -> Any:
+    """Nested dict/list reconstruction from encoded paths. Containers
+    that need a type registry (tuples come back as lists, namedtuples /
+    custom nodes not at all) require a `template` instead."""
+    if not records:
+        return {}
+    if not records[0]["path"]:
+        if len(records) != 1:
+            raise CheckpointError("multiple leaves at the tree root")
+        return leaves[0]
+
+    def build(items):  # items: [(remaining_path, leaf)]
+        first = [p[0] for p, _ in items]
+        if all("key" in seg for seg in first):
+            out: dict = {}
+            for (seg, *rest), leaf in ((tuple(p), l) for p, l in items):
+                out.setdefault(seg["key"], []).append((list(rest), leaf))
+            return {k: build(v) if v[0][0] else v[0][1]
+                    for k, v in out.items()}
+        if all("idx" in seg for seg in first):
+            slots: dict = {}
+            for p, leaf in items:
+                slots.setdefault(p[0]["idx"], []).append((p[1:], leaf))
+            if sorted(slots) != list(range(len(slots))):
+                raise CheckpointError("non-contiguous sequence indices")
+            return [build(v) if v[0][0] else v[0][1]
+                    for _, v in sorted(slots.items())]
+        raise CheckpointError(
+            "checkpoint tree has attribute/custom container nodes; pass "
+            "`template=` to restore into the original structure")
+
+    return build([(list(r["path"]), l) for r, l in zip(records, leaves)])
+
+
+def _unflatten_template(template: Any, records: List[dict],
+                        leaves: List) -> Any:
+    """Validate the manifest against `template`'s flatten order, then
+    adopt the leaves into the template's structure."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    if len(flat) != len(records):
+        raise CheckpointError(
+            f"template has {len(flat)} leaves, checkpoint {len(records)}")
+    for (path, t_leaf), rec in zip(flat, records):
+        enc = encode_path(path)
+        if enc != rec["path"]:
+            raise CheckpointError(
+                f"template/checkpoint structure mismatch: "
+                f"{path_str(enc)} vs {path_str(rec['path'])}")
+        t_shape = tuple(int(d) for d in getattr(t_leaf, "shape", ()))
+        if list(t_shape) != list(rec["global_shape"]):
+            raise CheckpointError(
+                f"leaf {path_str(enc)}: template shape {t_shape}, "
+                f"checkpoint {tuple(rec['global_shape'])}")
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _maybe_device_put(leaves: List, device_put: bool) -> List:
+    if not device_put:
+        return leaves
+    try:
+        import jax
+
+        return [jax.device_put(l) for l in leaves]
+    except Exception:
+        return leaves
+
+
+def restore_tree(directory: str, *, name: str = "state",
+                 template: Any = None, device_put: bool = False) -> Any:
+    """Read the committed checkpoint under `directory` and return the
+    FULL tree (global leaves), regardless of how many ranks saved it.
+    With `device_put=True`, leaves land on the current default device."""
+    manifest, leaves = _global_leaves(directory, name)
+    leaves = _maybe_device_put(leaves, device_put)
+    if template is not None:
+        return _unflatten_template(template, manifest["leaves"], leaves)
+    return _rebuild_from_paths(manifest["leaves"], leaves)
+
+
+def restore_shard(directory: str, *, rank: int, world: int,
+                  name: str = "state", template: Any = None,
+                  device_put: bool = False) -> Any:
+    """Restore onto the CURRENT topology: reassemble global leaves, then
+    slice each for (`rank`, `world`) by the writer's sharding rule. The
+    saving world size N and the restoring world size M are independent —
+    this is the elastic `_RESIZE` restore path."""
+    manifest, leaves = _global_leaves(directory, name)
+    out = []
+    for rec, leaf in zip(manifest["leaves"], leaves):
+        axis = shard_axis_for(tuple(leaf.shape), world)
+        if axis is None:
+            out.append(leaf)
+        else:
+            per = leaf.shape[axis] // world
+            out.append(leaf[rank * per:(rank + 1) * per].copy())
+    out = _maybe_device_put(out, device_put)
+    if template is not None:
+        return _unflatten_template_sharded(template, manifest["leaves"], out)
+    return _rebuild_from_paths(manifest["leaves"], out)
+
+
+def _unflatten_template_sharded(template: Any, records: List[dict],
+                                leaves: List) -> Any:
+    """Template adoption for per-rank shards: template leaf shapes are
+    the LOCAL shapes, so validate rank-local dims, not global ones."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    if len(flat) != len(records):
+        raise CheckpointError(
+            f"template has {len(flat)} leaves, checkpoint {len(records)}")
+    for (path, _), rec in zip(flat, records):
+        enc = encode_path(path)
+        if enc != rec["path"]:
+            raise CheckpointError(
+                f"template/checkpoint structure mismatch: "
+                f"{path_str(enc)} vs {path_str(rec['path'])}")
+    return jax.tree_util.tree_unflatten(treedef, leaves)

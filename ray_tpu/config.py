@@ -188,6 +188,24 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                                      "for NODE_DRAINING events overlapping "
                                      "its worker group (must be well under "
                                      "the shortest expected drain notice)"),
+    # -- checkpoint plane ----------------------------------------------------
+    "ckpt_fsync": (bool, True,
+                   "fsync shard/manifest files before the atomic rename; "
+                   "disable only in tests where durability is irrelevant"),
+    "ckpt_commit_wait_s": (float, 60.0,
+                           "how long rank 0's persister waits for the last "
+                           "rank's manifest commit before reporting the "
+                           "save as uncommitted"),
+    "ckpt_flush_timeout_s": (float, 30.0,
+                             "max wait for in-flight background persists "
+                             "when a worker group quiesces (drain/resize)"),
+    "ckpt_replicate": (bool, False,
+                       "replicate completed checkpoint shards to peer "
+                       "object stores via the broadcast fanout tree and "
+                       "register them in the GCS relocation table"),
+    "ckpt_replicate_timeout_s": (float, 60.0,
+                                 "per-shard timeout for the replication "
+                                 "fanout"),
     # -- drain / preemption --------------------------------------------------
     "drain_deadline_default_s": (float, 30.0,
                                  "drain notice window used when an "

@@ -108,6 +108,10 @@ class RLHFConfig:
     run_name: str = "rlhf"
     rollout_get_timeout: float = 120.0
     update_wait_timeout: float = 300.0
+    # When set, every placement switch also persists the (policy, opt)
+    # state to this directory via the async checkpoint plane — durability
+    # for the drain-and-reform hand-off without lengthening the switch.
+    state_checkpoint_dir: Optional[str] = None
     max_generator_rebuilds: int = 3
 
 
@@ -138,6 +142,7 @@ class LearnerWorker:
         self.hyper = dict(hyper)
         self.group_name: Optional[str] = None
         self.version = int(start_version)
+        self._ckpt_plane = None  # lazy ray_tpu.checkpoint.CheckpointPlane
 
         kwargs = dict(model_kwargs)
         kwargs.setdefault("dtype", jnp.float32)
@@ -331,6 +336,33 @@ class LearnerWorker:
         leaves = [np.asarray(l) for l in
                   jax.tree_util.tree_leaves((self.policy, self.opt_state))]
         return leaves, self.version
+
+    def state_snapshot(self, directory: Optional[str] = None):
+        """`state_leaves` plus, when `directory` is set, an async durable
+        snapshot of the same state through the checkpoint plane: the
+        hand-off leaves are captured inline, the shard/manifest persist
+        runs in the background while the replacement gang forms — so the
+        drain-and-reform path gets crash durability without lengthening
+        the switch."""
+        leaves, version = self.state_leaves()
+        if directory:
+            from ray_tpu.checkpoint import CheckpointPlane
+
+            if self._ckpt_plane is None:
+                # Fresh buffers per save (no pool reuse): the returned
+                # hand-off leaves and the staging copies are independent.
+                self._ckpt_plane = CheckpointPlane(reuse_buffers=False,
+                                                   source="rlhf")
+            self._ckpt_plane.save_async(
+                (self.policy, self.opt_state), directory,
+                name="rlhf_state", rank=0, world=1, step=version)
+        return leaves, version
+
+    def flush_state_persist(self, timeout: float = 10.0) -> bool:
+        """Wait for in-flight background state persists (teardown path)."""
+        if self._ckpt_plane is None:
+            return True
+        return self._ckpt_plane.flush(timeout)
 
     def lm_leaves(self):
         """LM leaves (meta order) for bit-identity assertions."""
@@ -631,9 +663,19 @@ class RLHFTrainer:
 
         self.coordinator.requeue_all_issued()
         self.loop.stop(drain=True)  # STOP barrier: queued batches apply first
+        # Hand-off leaves come back inline; when state_checkpoint_dir is
+        # set the same state also persists durably in the background (the
+        # switch only ever waits for the snapshot, never the I/O).
         leaves, version = ray_tpu.get(
-            self.learners[0].state_leaves.remote())
+            self.learners[0].state_snapshot.remote(
+                self.config.state_checkpoint_dir))
         self._teardown_generators()
+        if self.config.state_checkpoint_dir:
+            try:
+                ray_tpu.get(self.learners[0].flush_state_persist.remote(),
+                            timeout=30)
+            except Exception:
+                pass  # durability is best-effort; the hand-off leaves rule
         self._teardown_learners()
         from_mode, self.mode = self.mode, to_mode
         self.epoch += 1

@@ -33,6 +33,11 @@ Emitters in-tree:
                  moved generator/learner between colocated and
                  disaggregated; labels carry from/to mode, the switch
                  epoch, and the goodput reason)
+  * checkpoint — CHECKPOINT_SAVED (the manifest commit made a new
+                 checkpoint real; emitted by exactly one rank — the
+                 committer — with step, world, bytes, snapshot_ms and
+                 persist_ms labels so dashboards attribute train-step
+                 stall vs background persist cost)
 
 Read back via `state.list_cluster_events()`, the dashboard
 `/api/events` route, or `python -m ray_tpu.scripts events`.
@@ -64,10 +69,12 @@ TASK_STALLED = "TASK_STALLED"
 DEADLOCK_DETECTED = "DEADLOCK_DETECTED"
 LLM_REQUEST_SHED = "LLM_REQUEST_SHED"
 RLHF_PLACEMENT_SWITCH = "RLHF_PLACEMENT_SWITCH"
+CHECKPOINT_SAVED = "CHECKPOINT_SAVED"
 EVENT_TYPES = (NODE_DEAD, NODE_DRAINING, NODE_PREEMPTED, SLICE_LOST,
                OOM_KILL, COLLECTIVE_ABORT,
                AUTOSCALER_SCALE, TRAIN_GANG_RESTART, TASK_STALLED,
-               DEADLOCK_DETECTED, LLM_REQUEST_SHED, RLHF_PLACEMENT_SWITCH)
+               DEADLOCK_DETECTED, LLM_REQUEST_SHED, RLHF_PLACEMENT_SWITCH,
+               CHECKPOINT_SAVED)
 
 
 def make_event(event_type: str, message: str, *,

@@ -548,6 +548,59 @@ class GcsServer:
         return {"found": True, "node_id": holder,
                 "address": list(rec.address)}
 
+    # ---- checkpoint shard registry (checkpoint/plane.py replication) -----
+    #
+    # A completed checkpoint shard that was broadcast to peer object stores
+    # registers here: the shard row records where the durable file lives,
+    # and each replica oid lands in the drain relocation table homed on a
+    # live PEER of the reporting node (the broadcast placed a copy on every
+    # node) — so when the writer's node drains and dies at its deadline,
+    # `locate_object` already points somewhere that survives it.
+
+    async def handle_register_checkpoint_shards(self, conn, path: str,
+                                                name: str, shard: int,
+                                                world: int, step=None,
+                                                nbytes: int = 0,
+                                                oids=(), node_id=None
+                                                ) -> dict:
+        shards = getattr(self, "_ckpt_shards", None)
+        if shards is None:
+            shards = self._ckpt_shards = {}
+        shards[(path, name, int(shard), int(world))] = {
+            "path": path, "name": name, "shard": int(shard),
+            "world": int(world), "step": step, "nbytes": int(nbytes),
+            "oids": [bytes(o) for o in oids],
+            "node_id": node_id, "time": time.time()}
+        table = getattr(self, "_object_relocations", None)
+        if table is None:
+            table = self._object_relocations = {}
+        peer = None
+        for nid, rec in self._nodes.items():
+            if rec.alive and not rec.draining and nid != node_id:
+                peer = nid
+                break
+        home = peer if peer is not None else node_id
+        relocated = 0
+        if home is not None:
+            for oid in oids:
+                table[bytes(oid)] = home
+                relocated += 1
+        return {"ok": True, "relocated": relocated,
+                "home": home.hex() if isinstance(home, bytes) else home}
+
+    async def handle_list_checkpoint_shards(self, conn,
+                                            path: Optional[str] = None
+                                            ) -> list:
+        shards = getattr(self, "_ckpt_shards", None) or {}
+        rows = [dict(v, oids=[o.hex() for o in v["oids"]],
+                     node_id=(v["node_id"].hex()
+                              if isinstance(v["node_id"], bytes)
+                              else v["node_id"]))
+                for v in shards.values()
+                if path is None or v["path"] == path]
+        rows.sort(key=lambda r: (r["path"], r["name"], r["shard"]))
+        return rows
+
     async def _on_disconnect(self, conn: ServerConnection):
         for subs in self._subscribers.values():
             subs.discard(conn)
@@ -634,12 +687,23 @@ class GcsServer:
                 slice_name=rec.labels.get("tpu-slice-name"),
                 labels={"reason": rec.drain_reason}))
         # Relocation entries pointing AT the dead node are stale; entries
-        # migrated OFF it (to live peers) stay valid.
+        # migrated OFF it (to live peers) stay valid. Checkpoint-shard
+        # replicas are special: the broadcast placed a copy on EVERY node,
+        # so their entries re-home to a surviving peer instead of dropping.
         table = getattr(self, "_object_relocations", None)
         if table:
+            ckpt_oids = {bytes(o) for row in
+                         (getattr(self, "_ckpt_shards", None) or {}).values()
+                         for o in row["oids"]}
+            new_home = next((nid for nid, r in self._nodes.items()
+                             if r.alive and not r.draining
+                             and nid != node_id), None)
             for oid in [o for o, holder in table.items()
                         if holder == node_id]:
-                table.pop(oid, None)
+                if oid in ckpt_oids and new_home is not None:
+                    table[oid] = new_home
+                else:
+                    table.pop(oid, None)
         # A dead node never flushes metrics again — drop its
         # `metrics:<node>:<pid>` KV snapshots so the dashboard /metrics
         # aggregation stops counting ghost processes forever.

@@ -159,6 +159,22 @@ LLM_KV_HANDOFFS = Counter(
     "ray_tpu_llm_kv_handoffs_total",
     "prefill->decode KV page handoffs adopted")
 
+# Checkpoint plane (checkpoint/plane.py): the snapshot histogram is the
+# train-step stall, the persist histogram is the background cost — the
+# 5x-plus gap between them is the async plane's whole point.
+CKPT_SNAPSHOT_MS = Histogram(
+    "ray_tpu_ckpt_snapshot_ms",
+    "device->host snapshot stall per save (the only part a train step "
+    "waits for)",
+    boundaries=[0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000])
+CKPT_PERSIST_MS = Histogram(
+    "ray_tpu_ckpt_persist_ms",
+    "background shard persist + commit duration per save",
+    boundaries=[1, 5, 10, 50, 100, 500, 1000, 5000, 30000])
+CKPT_BYTES = Counter(
+    "ray_tpu_ckpt_bytes_total",
+    "checkpoint bytes persisted by this process (per-rank shard bytes)")
+
 
 ALL_METRICS = [v for v in list(globals().values())
                if isinstance(v, (Counter, Gauge, Histogram))]

@@ -2,8 +2,10 @@
 
 Reference analog: python/ray/train/_checkpoint.py:56 (Checkpoint = filesystem
 + path), train/_internal/checkpoint_manager.py (top-K by score). Pytree
-save/load uses a flat npz + pickled treedef — works for jax arrays on any
-mesh (arrays are fetched to host; sharded restore re-shards via device_put).
+save/load is backed by the checkpoint plane's path-based manifest format
+(ray_tpu/checkpoint/ — zero-pickle, reshard-on-restore); `load_pytree`
+still reads the retired flat-npz + pickled-treedef layout for checkpoints
+written before the manifest format existed.
 """
 
 from __future__ import annotations
@@ -44,21 +46,34 @@ class Checkpoint:
 
     @staticmethod
     def save_pytree(tree: Any, path: str, name: str = "state") -> "Checkpoint":
-        import jax
+        """Synchronously save `tree` in the manifest format (a 1-shard
+        checkpoint — the whole tree in one npz plus a path-based JSON
+        leaf table; no pickled treedef)."""
+        from ray_tpu.checkpoint import save_sharded
 
-        os.makedirs(path, exist_ok=True)
-        leaves, treedef = jax.tree.flatten(tree)
-        host_leaves = [np.asarray(leaf) for leaf in leaves]
-        np.savez(os.path.join(path, f"{name}.npz"),
-                 **{f"leaf_{i}": l for i, l in enumerate(host_leaves)})
-        with open(os.path.join(path, f"{name}.treedef.pkl"), "wb") as f:
-            pickle.dump(treedef, f)
+        save_sharded(tree, path, name=name, rank=0, world=1)
         return Checkpoint(path)
 
-    def load_pytree(self, name: str = "state") -> Any:
+    def load_pytree(self, name: str = "state", template: Any = None) -> Any:
+        """Load a pytree saved under this checkpoint. Reads the manifest
+        format (any shard count — reassembles global leaves); falls back
+        to the legacy `{name}.npz` + `{name}.treedef.pkl` layout for old
+        checkpoints. `template` restores trees with custom container
+        nodes (optax states etc.) into their original structure."""
+        from ray_tpu.checkpoint import has_manifest, restore_tree
+
+        if has_manifest(self.path, name):
+            return restore_tree(self.path, name=name, template=template)
+        legacy = os.path.join(self.path, f"{name}.treedef.pkl")
+        if not os.path.exists(legacy):
+            from ray_tpu.checkpoint import CheckpointNotCommitted
+
+            raise CheckpointNotCommitted(
+                f"no {name!r} checkpoint (manifest or legacy) under "
+                f"{self.path!r}")
         import jax
 
-        with open(os.path.join(self.path, f"{name}.treedef.pkl"), "rb") as f:
+        with open(legacy, "rb") as f:
             treedef = pickle.load(f)
         data = np.load(os.path.join(self.path, f"{name}.npz"))
         leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
@@ -98,9 +113,18 @@ class CheckpointManager:
         reverse = self.score_order == "max"
         ranked = sorted(self._entries, key=lambda e: e[0], reverse=reverse)
         keep = ranked[:self.num_to_keep]
-        for score, path, metrics in self._entries:
-            if (score, path, metrics) not in keep:
-                shutil.rmtree(path, ignore_errors=True)
+        # The most recent checkpoint is never pruned, even when it scores
+        # worst: `latest_checkpoint` feeds the drain / gang-restart resume
+        # paths, which must not point at a deleted directory.
+        latest = self._entries[-1]
+        if latest not in keep:
+            if keep:
+                keep[-1] = latest
+            else:
+                keep = [latest]
+        for entry in self._entries:
+            if entry not in keep:
+                shutil.rmtree(entry[1], ignore_errors=True)
         self._entries = [e for e in self._entries if e in keep]
 
     @property

@@ -124,8 +124,22 @@ class TrainController:
                 elif error == _RESIZE:
                     # Controlled re-form (elastic resize / drain notice):
                     # close backends rank-locally so no rank records a
-                    # COLLECTIVE_ABORT for what is a clean restart.
+                    # COLLECTIVE_ABORT for what is a clean restart. The
+                    # train threads only ever waited for snapshots; the
+                    # teardown (not the steps) absorbs the background
+                    # persists, then one last poll ingests commits that
+                    # landed during the drain so the re-form resumes from
+                    # the newest checkpoint, not the previous one.
                     group.quiesce()
+                    from ray_tpu.config import cfg as _cfg
+
+                    group.flush_checkpoints(_cfg().ckpt_flush_timeout_s)
+                    try:
+                        for poll in group.poll():
+                            for item in poll["results"]:
+                                self._ingest_item(item)
+                    except Exception:
+                        pass
                 group.shutdown()
             if error is None:
                 self._final_result = Result(
@@ -324,25 +338,54 @@ class TrainController:
             # reference reports rank-0 results by default).
             for poll in polls:
                 for item in poll["results"]:
-                    if "error" in item:
-                        return item["error"]
-                    if item.get("telemetry"):
-                        self.telemetry.record_step(item["telemetry"])
-                    if item["rank"] == 0:
-                        metrics = item["metrics"]
-                        self.latest_metrics = metrics
-                        self.metrics_history.append(metrics)
-                        self.callbacks.fire("on_result", metrics,
-                                            len(self.metrics_history))
-                        if item.get("checkpoint_path"):
-                            self.ckpt_manager.register(item["checkpoint_path"],
-                                                       metrics)
-                            self.callbacks.fire(
-                                "on_checkpoint", item["checkpoint_path"],
-                                metrics)
+                    err = self._ingest_item(item)
+                    if err is not None:
+                        return err
             errors = [p["error"] for p in polls if p["error"]]
             if errors:
                 return errors[0]
             if all(p["finished"] for p in polls):
-                return None
+                # Ranks flush background checkpoint persists before
+                # flipping `finished`, so every record is already queued —
+                # but one poll drains at most 16 per rank. Keep draining
+                # until the queues are empty so async-committed
+                # checkpoints registered here feed Result.checkpoint.
+                while True:
+                    leftovers = [item for poll in group.poll()
+                                 for item in poll["results"]]
+                    if not leftovers:
+                        return None
+                    for item in leftovers:
+                        err = self._ingest_item(item)
+                        if err is not None:
+                            return err
             time.sleep(poll_interval)
+
+    def _ingest_item(self, item: Dict) -> Optional[str]:
+        """Fold one worker-queue record into controller state. Returns an
+        error string for error records, else None. `checkpoint_only`
+        records come from the background persister (async manifest
+        commit) — they register the checkpoint without re-recording
+        metrics/telemetry for the step that produced them."""
+        if "error" in item:
+            return item["error"]
+        if item.get("checkpoint_only"):
+            if item["rank"] == 0 and item.get("checkpoint_path"):
+                metrics = item.get("metrics") or dict(self.latest_metrics)
+                self.ckpt_manager.register(item["checkpoint_path"], metrics)
+                self.callbacks.fire("on_checkpoint", item["checkpoint_path"],
+                                    metrics)
+            return None
+        if item.get("telemetry"):
+            self.telemetry.record_step(item["telemetry"])
+        if item["rank"] == 0:
+            metrics = item["metrics"]
+            self.latest_metrics = metrics
+            self.metrics_history.append(metrics)
+            self.callbacks.fire("on_result", metrics,
+                                len(self.metrics_history))
+            if item.get("checkpoint_path"):
+                self.ckpt_manager.register(item["checkpoint_path"], metrics)
+                self.callbacks.fire("on_checkpoint", item["checkpoint_path"],
+                                    metrics)
+        return None

@@ -66,7 +66,12 @@ class TrainSession:
         self._step_started = time.monotonic()
         self._step_started_wall = time.time()
         self._phase_acc: Dict[str, float] = {}
+        # Background-attributed time (checkpoint persist) is booked
+        # separately: it overlaps compute, so folding it into the phase
+        # accumulator would corrupt the step's compute residual.
+        self._bg_acc: Dict[str, float] = {}
         self._phase_lock = threading.Lock()
+        self._ckpt_plane = None  # lazy: ray_tpu.checkpoint.CheckpointPlane
 
     def _close_step(self) -> Dict[str, Any]:
         """Close the current step: wall time since the last report split
@@ -79,12 +84,14 @@ class TrainSession:
         total = max(0.0, now - self._step_started)
         with self._phase_lock:
             phases, self._phase_acc = self._phase_acc, {}
+            bg, self._bg_acc = self._bg_acc, {}
         known = sum(phases.values())
         rec = {"step": self.step_index, "rank": self.world_rank,
                "total_s": total,
                "data_s": phases.pop("data", 0.0),
                "collective_s": phases.pop("collective", 0.0),
                "checkpoint_s": phases.pop("checkpoint", 0.0),
+               "checkpoint_persist_s": bg.get("checkpoint_persist", 0.0),
                "compute_s": max(0.0, total - known),
                "other_s": sum(phases.values())}
         tracing.record_span("train:step", "train:step",
@@ -94,6 +101,27 @@ class TrainSession:
         self._step_started = now
         self._step_started_wall = now_wall
         return rec
+
+    def note_background(self, name: str, seconds: float) -> None:
+        """Book time spent OFF the train thread (background persister) so
+        step records can attribute it without charging the step."""
+        with self._phase_lock:
+            self._bg_acc[name] = self._bg_acc.get(name, 0.0) + seconds
+
+    def ensure_plane(self):
+        """The per-worker CheckpointPlane, created on first async save."""
+        if self._ckpt_plane is None:
+            from ray_tpu.checkpoint import CheckpointPlane
+
+            self._ckpt_plane = CheckpointPlane(source="train")
+        return self._ckpt_plane
+
+    def flush_checkpoints(self, timeout: Optional[float] = None) -> bool:
+        """Wait for in-flight background checkpoint persists. Called by
+        the worker teardown (drain/resize quiesce), never by the step."""
+        if self._ckpt_plane is None:
+            return True
+        return self._ckpt_plane.flush(timeout)
 
 
 def init_session(**kwargs) -> TrainSession:
@@ -137,20 +165,80 @@ def step_phase(name: str):
             s._phase_acc[name] = s._phase_acc.get(name, 0.0) + dt
 
 
-def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
-    """Report metrics (and optionally a checkpoint dir) to the controller.
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None,
+           state: Any = None, state_name: str = "state"):
+    """Report metrics (and optionally a checkpoint) to the controller.
     Also closes the current telemetry step: wall time since the previous
-    report, broken down by the phases `step_phase` accumulated."""
+    report, broken down by the phases `step_phase` accumulated.
+
+    `checkpoint=` is the classic synchronous handoff: the caller already
+    materialized a directory. `state=` is the async plane: the call
+    stalls only for the device->host snapshot of this rank's shard and
+    returns; serialization/commit happen in the background, and rank 0
+    reports the checkpoint upstream once the manifest commits."""
     s = get_session()
     ckpt_path = None
     if checkpoint is not None:
         with step_phase("checkpoint"):
             ckpt_path = checkpoint.as_directory()
         s.latest_checkpoint = checkpoint
+    if state is not None:
+        _save_state_async(s, state, dict(metrics), state_name)
     telemetry = s._close_step()
     s.results.put({"metrics": dict(metrics), "checkpoint_path": ckpt_path,
                    "rank": s.world_rank, "telemetry": telemetry})
 
 
+def _save_state_async(s: TrainSession, state: Any, metrics: Dict[str, Any],
+                      name: str) -> None:
+    """Kick off this rank's shard save; the step pays for the snapshot
+    only (booked as the `checkpoint` phase). When the manifest commits,
+    rank 0's on_done enqueues a checkpoint-only record so the controller
+    registers the directory without waiting on the train thread."""
+    directory = os.path.join(s.storage_path, f"{s.run_name}-ckpt",
+                             f"step_{s.step_index:08d}")
+
+    def on_done(info: Dict[str, Any]) -> None:
+        s.note_background("checkpoint_persist", info["persist_ms"] / 1e3)
+        if info["ok"] and info["committed"]:
+            s.latest_checkpoint = Checkpoint(info["directory"])
+            if s.world_rank == 0:
+                s.results.put({"checkpoint_only": True,
+                               "checkpoint_path": info["directory"],
+                               "metrics": metrics, "rank": 0})
+
+    with step_phase("checkpoint"):
+        s.ensure_plane().save_async(
+            state, directory, name=name, rank=s.world_rank,
+            world=s.world_size, step=s.step_index, on_done=on_done)
+
+
 def get_checkpoint() -> Optional[Checkpoint]:
     return get_session().latest_checkpoint
+
+
+def load_state(template: Any = None, name: str = "state",
+               shard: bool = True):
+    """Restore the latest checkpoint's `report(state=...)` tree for THIS
+    rank's CURRENT (rank, world) — the reshard-on-restore entry point a
+    train fn calls at startup after an elastic resize or drain re-form.
+    The saving world size is irrelevant: global leaves are reassembled
+    from the manifest and re-sliced for the live topology, then
+    `device_put` onto the current default device. Returns None when
+    there is no manifest-format checkpoint yet (fresh run or a legacy
+    directory)."""
+    s = get_session()
+    ckpt = s.latest_checkpoint
+    if ckpt is None:
+        return None
+    from ray_tpu.checkpoint import has_manifest, restore_shard, restore_tree
+
+    directory = ckpt.as_directory()
+    if not has_manifest(directory, name):
+        return None
+    if shard and s.world_size > 1:
+        return restore_shard(directory, rank=s.world_rank,
+                             world=s.world_size, name=name,
+                             template=template, device_put=True)
+    return restore_tree(directory, name=name, template=template,
+                        device_put=True)

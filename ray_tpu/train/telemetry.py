@@ -29,9 +29,12 @@ from typing import Dict, List
 
 # Canonical phase keys of a step record (session._close_step): `total_s` is
 # wall time since the previous report; `compute_s` is the unattributed
-# residual after the named phases.
+# residual after the named phases. `checkpoint_s` is the snapshot STALL the
+# step paid; `checkpoint_persist_s` is background persist time that
+# overlapped compute (booked separately so it never distorts the residual) —
+# their ratio is the async checkpoint plane's win, per step.
 PHASE_KEYS = ("total_s", "data_s", "collective_s", "checkpoint_s",
-              "compute_s", "other_s")
+              "checkpoint_persist_s", "compute_s", "other_s")
 
 
 @dataclasses.dataclass
@@ -81,7 +84,8 @@ class TrainTelemetry:
                         "compute_s": acc["compute_s"],
                         "collective_s": acc["collective_s"],
                         "data_s": acc["data_s"],
-                        "checkpoint_s": acc["checkpoint_s"]})
+                        "checkpoint_s": acc["checkpoint_s"],
+                        "checkpoint_persist_s": acc["checkpoint_persist_s"]})
         if out:
             slowest = max(out, key=lambda r: r["compute_s"])
             for r in out:

@@ -65,6 +65,16 @@ class TrainWorker:
                 self.session.results.put(
                     {"error": traceback.format_exc(), "rank": self.rank})
             finally:
+                # Land in-flight background checkpoint persists before
+                # declaring the rank finished, so a commit (and rank 0's
+                # checkpoint-only record) can't race the controller's
+                # final poll.
+                try:
+                    from ray_tpu.config import cfg
+
+                    self.session.flush_checkpoints(cfg().ckpt_flush_timeout_s)
+                except Exception:
+                    pass
                 self.session.finished.set()
 
         self.thread = threading.Thread(target=run, daemon=True)
@@ -83,6 +93,14 @@ class TrainWorker:
             error = repr(self.session.error)
         return {"results": out, "finished": finished, "error": error,
                 "rank": self.rank}
+
+    def flush_checkpoints(self, timeout: float = 30.0) -> bool:
+        """Block until this rank's background checkpoint persists finish
+        (drain path: called AFTER quiesce — the train step itself never
+        waits for persistence)."""
+        if self.session is None:
+            return True
+        return self.session.flush_checkpoints(timeout)
 
     def shutdown_backend(self):
         if getattr(self, "_backend", None) is not None:
@@ -172,6 +190,20 @@ class WorkerGroup:
             ray_tpu.get(refs, timeout=timeout)
         except Exception:
             pass  # a rank may already be dead; kill path still works
+
+    def flush_checkpoints(self, timeout: float = 30.0) -> bool:
+        """Best-effort wait for every rank's in-flight checkpoint
+        persists (drain/resize teardown, after `quiesce`)."""
+        refs = []
+        for w in self.workers:
+            try:
+                refs.append(w.flush_checkpoints.remote(timeout))
+            except Exception:
+                pass
+        try:
+            return all(ray_tpu.get(refs, timeout=timeout + 10))
+        except Exception:
+            return False
 
     def shutdown(self):
         for w in self.workers:

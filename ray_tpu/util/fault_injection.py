@@ -320,3 +320,62 @@ class GcsKiller:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+
+
+class FailPoints:
+    """Named in-process fail points for deterministic crash injection.
+
+    Library code sprinkles `failpoint("name")` at interesting spots
+    (e.g. "ckpt.persist" before the shard write, "ckpt.commit" between
+    shard write and manifest commit). Tests arm a point with an
+    exception (simulated crash) or a `threading.Event` gate (pause the
+    code there until released). Unarmed points cost one dict lookup on
+    an (almost always) empty dict.
+    """
+
+    def __init__(self):
+        self._points = {}
+        self._lock = threading.Lock()
+        self.hits = {}
+
+    def arm(self, name: str, *, exc: Optional[BaseException] = None,
+            block: Optional[threading.Event] = None, after: int = 0):
+        """Arm `name`. `exc` raises at the site; `block` makes the site
+        wait until the event is set; `after=N` skips the first N hits
+        (crash on the N+1-th pass)."""
+        with self._lock:
+            self._points[name] = {"exc": exc, "block": block,
+                                  "after": int(after)}
+
+    def disarm(self, name: str):
+        with self._lock:
+            self._points.pop(name, None)
+
+    def clear(self):
+        with self._lock:
+            self._points.clear()
+            self.hits.clear()
+
+    def check(self, name: str):
+        if not self._points:          # fast path: nothing armed anywhere
+            return
+        with self._lock:
+            point = self._points.get(name)
+            if point is None:
+                return
+            self.hits[name] = self.hits.get(name, 0) + 1
+            if point["after"] > 0:
+                point["after"] -= 1
+                return
+        if point["block"] is not None:
+            point["block"].wait()
+        if point["exc"] is not None:
+            raise point["exc"]
+
+
+FAIL_POINTS = FailPoints()
+
+
+def failpoint(name: str):
+    """Module-level fail-point check — the one-liner library code calls."""
+    FAIL_POINTS.check(name)

@@ -157,6 +157,9 @@ def run(scale: float = 1.0, num_cpus: int = 4) -> List[Dict]:
         # -- RLHF pipeline: colocated vs disaggregated placement ---------
         results.extend(_bench_rlhf(scale))
 
+        # -- checkpoint plane: sync stall vs async snapshot-only stall ---
+        results.extend(_bench_checkpoint(scale))
+
         # -- control-plane scale envelope: batched vs per-item leases ----
         results.extend(_bench_scale_envelope(scale))
     finally:
@@ -842,6 +845,72 @@ def run_scale_envelope(n_requests: int = 192, fake_nodes: int = 1000,
         return loop.run_until_complete(_run())
     finally:
         loop.close()
+
+
+def _bench_checkpoint(scale: float) -> List[Dict]:
+    """Checkpoint plane (checkpoint/): what a train step actually stalls
+    for, per save of a ~64 MiB fp32 state, best of 3.
+
+      * ckpt_sync_stall_ms — the old way: snapshot + serialize + fsync +
+        commit inline with the step.
+      * ckpt_async_stall_ms — `save_async` return latency: the
+        device->host snapshot only; persistence runs on the background
+        thread (flushed between trials so runs don't overlap).
+      * ckpt_restore_reshard_ms — read a 4-way checkpoint back as one
+        rank of a 2-way world (manifest read + global reassembly +
+        re-slice), the elastic-restore path.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from ray_tpu.checkpoint import CheckpointPlane, restore_shard, save_sharded
+
+    mib = max(8, int(64 * scale))
+    n_arrays = 8
+    per = (mib * (1 << 20)) // (4 * n_arrays)
+    tree = {f"layer_{i}": np.arange(per, dtype=np.float32) + i
+            for i in range(n_arrays)}
+    root = tempfile.mkdtemp(prefix="ckpt-bench-")
+    plane = CheckpointPlane()
+    out: List[Dict] = []
+    try:
+        sync_ms, async_ms = [], []
+        for trial in range(3):
+            d = os.path.join(root, f"sync-{trial}")
+            t0 = time.perf_counter()
+            save_sharded(tree, d, name="state", rank=0, world=1, step=trial)
+            sync_ms.append((time.perf_counter() - t0) * 1e3)
+        for trial in range(3):
+            d = os.path.join(root, f"async-{trial}")
+            t0 = time.perf_counter()
+            plane.save_async(tree, d, name="state", rank=0, world=1,
+                             step=trial)
+            async_ms.append((time.perf_counter() - t0) * 1e3)
+            plane.flush(60)
+        out.append({"benchmark": "ckpt_sync_stall_ms",
+                    "value": round(min(sync_ms), 3),
+                    "unit": f"ms ({mib} MiB)", "n": 1, "trials": 3})
+        out.append({"benchmark": "ckpt_async_stall_ms",
+                    "value": round(min(async_ms), 3),
+                    "unit": f"ms ({mib} MiB)", "n": 1, "trials": 3})
+        d4 = os.path.join(root, "sharded-4way")
+        for r in range(4):
+            save_sharded(tree, d4, name="state", rank=r, world=4)
+        reshard_ms = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            restore_shard(d4, rank=0, world=2, name="state")
+            reshard_ms.append((time.perf_counter() - t0) * 1e3)
+        out.append({"benchmark": "ckpt_restore_reshard_ms",
+                    "value": round(min(reshard_ms), 3),
+                    "unit": f"ms ({mib} MiB, 4->2)", "n": 1, "trials": 3})
+    finally:
+        plane.close()
+        shutil.rmtree(root, ignore_errors=True)
+    return out
 
 
 def _bench_scale_envelope(scale: float) -> List[Dict]:

@@ -1,0 +1,466 @@
+"""Async sharded checkpoint plane: snapshot isolation, atomic commit,
+reshard-on-restore, and the train-stack wiring.
+
+The plane's contract, each half tested here:
+  * the train step stalls only for the device->host snapshot — mutating
+    the live state after `save_async` returns cannot corrupt the
+    checkpoint, and persistence (serialize + fsync + manifest commit)
+    runs on a background thread;
+  * the manifest commit is atomic (tmp+fsync+rename), so a crash
+    injected mid-persist leaves the PREVIOUS checkpoint the valid
+    latest;
+  * restore is topology-independent: an N-rank checkpoint reassembles
+    bit-identically onto M ranks for any M (global leaves re-sliced by
+    the same rule the writer used), with structure carried as path-based
+    JSON — zero pickle anywhere in the format.
+"""
+
+import json
+import os
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.checkpoint import (
+    CheckpointNotCommitted,
+    CheckpointPlane,
+    has_manifest,
+    read_manifest,
+    restore_shard,
+    restore_tree,
+    save_sharded,
+    snapshot_shard,
+)
+from ray_tpu.util import fault_injection
+
+
+def _tree(scale=1.0):
+    """Mixed-shape/dtype state: shardable, non-shardable, scalar leaf."""
+    n = int(12 * scale)
+    return {
+        "params": {"w": np.arange(n * 4, dtype=np.float32).reshape(n, 4),
+                   "b": np.linspace(-1, 1, 3).astype(np.float32)},
+        "opt": [np.arange(n * 4, dtype=np.float32).reshape(n, 4) * 0.5,
+                np.int32(7)],
+        "counts": np.arange(n, dtype=np.int32),
+    }
+
+
+def _flat(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+# ---------------------------------------------------------------------------
+# Manifest format: path-based, zero-pickle, atomic commit.
+# ---------------------------------------------------------------------------
+
+def test_manifest_roundtrip_zero_pickle(tmp_path):
+    d = str(tmp_path / "ck")
+    save_sharded(_tree(), d, name="state", rank=0, world=1, step=3)
+
+    # No pickle anywhere in the on-disk format.
+    files = os.listdir(d)
+    assert not [f for f in files if f.endswith(".pkl")], files
+    manifest = read_manifest(d, "state")
+    assert manifest["step"] == 3 and manifest["world"] == 1
+    # Paths are JSON key paths, not opaque blobs.
+    paths = {"/".join(str(next(iter(seg.values()))) for seg in rec["path"])
+             for rec in manifest["leaves"]}
+    assert "params/w" in paths and "opt/0" in paths
+
+    restored = restore_tree(d)
+    for a, b in zip(_flat(restored), _flat(_tree())):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert np.asarray(a).dtype == np.asarray(b).dtype
+
+
+def test_reshard_n_to_m_bit_identical(tmp_path):
+    """4-rank checkpoint restores bit-identically as 2-way, 3-way, and
+    full-tree — the acceptance criterion (N != M)."""
+    tree = _tree()
+    d = str(tmp_path / "ck4")
+    for r in range(4):
+        save_sharded(tree, d, name="state", rank=r, world=4, step=1)
+    assert has_manifest(d, "state")
+
+    full = restore_tree(d)
+    for a, b in zip(_flat(full), _flat(tree)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    for m in (2, 3):
+        parts = [restore_shard(d, rank=r, world=m, name="state")
+                 for r in range(m)]
+        # Reassemble the M-way restore and compare bit-for-bit. The w
+        # leaf (12, 4) shards at both 4 and 2 but replicates at 3.
+        for leaf_idx, ref in enumerate(_flat(tree)):
+            got = [_flat(p)[leaf_idx] for p in parts]
+            ref = np.asarray(ref)
+            from ray_tpu.checkpoint import shard_axis_for
+
+            if shard_axis_for(ref.shape, m) is not None:
+                reassembled = np.concatenate([np.asarray(g) for g in got])
+            else:
+                reassembled = np.asarray(got[0])
+                for g in got[1:]:
+                    np.testing.assert_array_equal(np.asarray(g), reassembled)
+            np.testing.assert_array_equal(reassembled, ref)
+            assert reassembled.dtype == ref.dtype
+
+
+def test_restore_with_template_handles_custom_nodes(tmp_path):
+    """Trees with container nodes the path rebuild can't synthesize
+    (tuples, optax-style states) restore through a locally-built
+    template — the RLHF adopt-the-leaves idiom."""
+    tree = {"a": (np.ones((8, 2), np.float32), np.zeros(3, np.float32))}
+    d = str(tmp_path / "ck")
+    save_sharded(tree, d)
+    # Templateless: sequence nodes come back as lists (paths can't
+    # distinguish tuple from list) — values still bit-identical.
+    bare = restore_tree(d)
+    assert isinstance(bare["a"], list)
+    np.testing.assert_array_equal(bare["a"][0], tree["a"][0])
+    # With a template, the original container types are adopted.
+    out = restore_tree(d, template={"a": (np.empty((8, 2), np.float32),
+                                          np.empty(3, np.float32))})
+    assert isinstance(out["a"], tuple)
+    np.testing.assert_array_equal(out["a"][0], tree["a"][0])
+    # A template whose structure disagrees is rejected, not misassigned.
+    with pytest.raises(Exception):
+        restore_tree(d, template={"b": (np.empty((8, 2), np.float32),
+                                        np.empty(3, np.float32))})
+
+
+# ---------------------------------------------------------------------------
+# Async plane: snapshot isolation + crash-mid-persist atomicity.
+# ---------------------------------------------------------------------------
+
+def test_async_snapshot_isolation_under_mutation(tmp_path):
+    """save_async returns before anything hits disk; mutating the source
+    arrays afterwards must not leak into the checkpoint (the capture is
+    a copy, not a view)."""
+    tree = _tree()
+    want = [np.array(l) for l in _flat(tree)]
+    d = str(tmp_path / "ck")
+    plane = CheckpointPlane()
+    gate = threading.Event()
+    fault_injection.FAIL_POINTS.arm("ckpt.persist", block=gate)
+    try:
+        pending = plane.save_async(tree, d, rank=0, world=1, step=0)
+        # Persist is blocked at the failpoint: nothing durable yet.
+        assert not has_manifest(d, "state")
+        assert not pending.done.is_set()
+        # The next "optimizer step" scribbles over the live state.
+        tree["params"]["w"] += 1000.0
+        tree["opt"][0] *= -1.0
+        tree["counts"][:] = -1
+    finally:
+        gate.set()
+        fault_injection.FAIL_POINTS.clear()
+    assert pending.wait(30) and pending.ok and pending.committed, \
+        pending.error
+    restored = restore_tree(d)
+    for a, b in zip(_flat(restored), want):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    plane.close()
+
+
+def test_crash_mid_persist_leaves_previous_checkpoint_valid(tmp_path):
+    """Kill the persister between shard write and manifest commit: the
+    new directory has shards but NO manifest (not a checkpoint), and the
+    previous checkpoint still restores."""
+    plane = CheckpointPlane()
+    d1, d2 = str(tmp_path / "step1"), str(tmp_path / "step2")
+    p1 = plane.save_async(_tree(), d1, rank=0, world=1, step=1)
+    assert p1.wait(30) and p1.committed
+
+    fault_injection.FAIL_POINTS.arm(
+        "ckpt.commit", exc=RuntimeError("injected crash before commit"))
+    try:
+        p2 = plane.save_async(_tree(2.0), d2, rank=0, world=1, step=2)
+        assert p2.wait(30)
+    finally:
+        fault_injection.FAIL_POINTS.clear()
+    assert p2.error is not None and not p2.committed
+    assert not has_manifest(d2, "state")
+    with pytest.raises(CheckpointNotCommitted):
+        read_manifest(d2, "state")
+    # The prior checkpoint is untouched and loadable.
+    restored = restore_tree(d1)
+    for a, b in zip(_flat(restored), _flat(_tree())):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    plane.close()
+
+
+def test_buffer_pool_reuse_across_saves(tmp_path):
+    """Steady-state checkpointing reuses the same staging memory."""
+    plane = CheckpointPlane()
+    tree = _tree()
+    for i in range(4):
+        p = plane.save_async(tree, str(tmp_path / f"s{i}"), step=i)
+        assert p.wait(30) and p.ok
+    pool = plane._pool
+    assert pool.acquired > pool.allocated  # second+ saves hit the pool
+    plane.close()
+
+
+def test_snapshot_shard_splits_bytes(tmp_path):
+    """Each rank captures ~1/world of the shardable bytes; replicated
+    leaves are captured by rank 0 only."""
+    tree = _tree()
+    snaps = [snapshot_shard(tree, rank=r, world=4) for r in range(4)]
+    assert snaps[0].nbytes > snaps[1].nbytes  # rank 0 also holds replicated
+    w = np.asarray(tree["params"]["w"])
+    idx = [i for i, rec in enumerate(snaps[0].records)
+           if rec["path"] == [{"key": "params"}, {"key": "w"}]]
+    assert idx and snaps[0].records[idx[0]]["shard_axis"] == 0
+    for r, snap in enumerate(snaps):
+        np.testing.assert_array_equal(snap.leaves[idx[0]], w[r * 3:(r + 1) * 3])
+
+
+# ---------------------------------------------------------------------------
+# Satellites: _prune latest-retention, save_pytree back-compat.
+# ---------------------------------------------------------------------------
+
+def test_prune_never_deletes_latest_checkpoint(tmp_path):
+    """num_to_keep retention must not delete the most recent checkpoint
+    even when it scores worst: `latest_checkpoint` feeds the drain /
+    gang-restart resume paths."""
+    from ray_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "run"), num_to_keep=1,
+                            score_attribute="score", score_order="max")
+    for i, score in enumerate([0.9, 0.1]):  # latest scores WORST
+        src = tmp_path / f"src{i}"
+        src.mkdir()
+        (src / "data.txt").write_text(str(score))
+        mgr.register(str(src), {"score": score})
+    latest = mgr.latest_checkpoint
+    assert latest is not None and os.path.isdir(latest.path)
+    with open(os.path.join(latest.path, "data.txt")) as f:
+        assert f.read() == "0.1"
+    # Top-K still honored for everything except the latest override.
+    kept = [e for e in os.listdir(tmp_path / "run")
+            if e.startswith("checkpoint")]
+    assert len(kept) == 1
+
+
+def test_prune_keeps_best_and_latest(tmp_path):
+    from ray_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "run"), num_to_keep=2,
+                            score_attribute="score", score_order="max")
+    for i, score in enumerate([0.5, 0.9, 0.1]):
+        src = tmp_path / f"src{i}"
+        src.mkdir()
+        (src / "data.txt").write_text(str(score))
+        mgr.register(str(src), {"score": score})
+    assert os.path.isdir(mgr.latest_checkpoint.path)       # 0.1 survives
+    with open(os.path.join(mgr.best_checkpoint.path, "data.txt")) as f:
+        assert f.read() == "0.9"                           # best survives
+    kept = [e for e in os.listdir(tmp_path / "run")
+            if e.startswith("checkpoint")]
+    assert len(kept) == 2                                  # 0.5 pruned
+
+
+def test_save_pytree_new_format_and_legacy_loader(tmp_path):
+    """save_pytree now writes the manifest format (no pickled treedef);
+    load_pytree still reads pre-manifest checkpoints."""
+    import jax
+
+    from ray_tpu.train import Checkpoint
+
+    tree = {"w": np.arange(6, dtype=np.float32), "b": [np.int32(1),
+                                                       np.int32(2)]}
+    d_new = str(tmp_path / "new")
+    ckpt = Checkpoint.save_pytree(tree, d_new)
+    assert not [f for f in os.listdir(d_new) if f.endswith(".pkl")]
+    out = ckpt.load_pytree()
+    np.testing.assert_array_equal(out["w"], tree["w"])
+    assert out["b"][1] == 2
+
+    # Hand-write a legacy flat-npz + pickled-treedef checkpoint.
+    d_old = str(tmp_path / "old")
+    os.makedirs(d_old)
+    leaves, treedef = jax.tree.flatten(tree)
+    np.savez(os.path.join(d_old, "state.npz"),
+             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    with open(os.path.join(d_old, "state.treedef.pkl"), "wb") as f:
+        pickle.dump(treedef, f)
+    legacy = Checkpoint(d_old).load_pytree()
+    np.testing.assert_array_equal(legacy["w"], tree["w"])
+    assert legacy["b"] == [1, 2]
+
+    with pytest.raises(CheckpointNotCommitted):
+        Checkpoint(str(tmp_path / "empty")).load_pytree()
+
+
+# ---------------------------------------------------------------------------
+# Peer replication: a committed shard's bytes fan out through the
+# broadcast tree and the replica object registers in the GCS drain
+# relocation table, homed on a PEER node.
+# ---------------------------------------------------------------------------
+
+def test_replicated_shards_register_in_gcs_relocation_table(tmp_path):
+    from ray_tpu.checkpoint.manifest import shard_npz
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.config import cfg
+    from ray_tpu.core import worker as worker_mod
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=2)  # head — where the driver's plane runs
+        cluster.add_node(num_cpus=1)  # the peer replicas should land on
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes(2)
+        cfg().apply_overrides({"ckpt_replicate": True})
+        plane = CheckpointPlane(source="test")
+        try:
+            d = str(tmp_path / "ck")
+            p = plane.save_async(_tree(1.0), d, name="state",
+                                 rank=0, world=1, step=3)
+            assert p.wait(30) and p.ok and p.committed, p.info()
+
+            core = worker_mod.global_worker()
+            rows = core.io.run(core.gcs.call(
+                "list_checkpoint_shards", path=os.path.abspath(d)))
+            assert len(rows) == 1, rows
+            row = rows[0]
+            assert (row["shard"], row["world"], row["step"]) == (0, 1, 3)
+            npz = os.path.join(d, shard_npz("state", 0, 1))
+            assert row["nbytes"] == os.path.getsize(npz) > 0
+            assert len(row["oids"]) == 1
+
+            # The replica object is homed on a live node that is NOT the
+            # one that wrote the shard — that is what makes it useful
+            # when the writer's node hits its drain deadline.
+            loc = core.io.run(core.gcs.call(
+                "locate_object", oid=bytes.fromhex(row["oids"][0])))
+            assert loc["found"], loc
+            assert loc["node_id"] != core.node_id, loc
+        finally:
+            plane.close()
+            cfg().apply_overrides({"ckpt_replicate": False})
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Train-stack wiring: report(state=...), telemetry attribution, event,
+# metrics, and restore through the controller's checkpoint manager.
+# ---------------------------------------------------------------------------
+
+def _async_ckpt_train_fn(config):
+    import jax.numpy as jnp
+
+    from ray_tpu import train as rtrain
+
+    ctx = rtrain.get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+    n = config["rows"]
+    state = {"w": jnp.arange(n * 2, dtype=jnp.float32).reshape(n, 2),
+             "step": jnp.int32(0)}
+    restored = rtrain.load_state()
+    if restored is not None:
+        state = restored
+    import time as _time
+
+    for step in range(config["steps"]):
+        state = {"w": state["w"] + 1.0, "step": state["step"] + 1}
+        rtrain.report({"loss": 1.0 / (step + 1), "step": step}, state=state)
+        # Give the step enough duration for the PREVIOUS save's background
+        # persist to land inside it (persist time is booked into the step
+        # during which it completes).
+        _time.sleep(0.1)
+
+
+def test_report_state_async_end_to_end(cluster_4cpu, tmp_path):
+    """2-worker run saving sharded async checkpoints at every report:
+    the result's checkpoint restores the final state, telemetry books
+    snapshot stall vs background persist separately, the committer
+    emitted CHECKPOINT_SAVED, and the ckpt metrics moved."""
+    from ray_tpu.runtime import metric_defs
+    from ray_tpu.state import list_cluster_events
+    from ray_tpu.train import (DataParallelTrainer, RunConfig, ScalingConfig)
+
+    steps, rows = 3, 8
+    trainer = DataParallelTrainer(
+        _async_ckpt_train_fn,
+        train_loop_config={"steps": steps, "rows": rows},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="async-ckpt", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+
+    # The registered checkpoint holds the LAST committed state; restore
+    # is full-tree (world-independent) and bit-identical.
+    assert result.checkpoint is not None
+    restored = restore_tree(result.checkpoint.as_directory())
+    expect = np.arange(rows * 2, dtype=np.float32).reshape(rows, 2) + steps
+    np.testing.assert_array_equal(np.asarray(restored["w"]), expect)
+    assert int(restored["step"]) == steps
+    manifest = read_manifest(result.checkpoint.as_directory(), "state")
+    assert manifest["world"] == 2  # genuinely sharded across both ranks
+
+    # Telemetry: the step paid a (tiny) snapshot stall; background
+    # persist time is attributed separately.
+    tel = result.telemetry.to_dict()
+    rank0 = [s for s in tel["steps"] if s.get("checkpoint_s", 0) > 0]
+    assert rank0, tel["steps"]
+    assert any(s.get("checkpoint_persist_s", 0) > 0 for s in tel["steps"])
+    assert "checkpoint_persist_s" in tel["stragglers"][0]
+
+    # The committer announced exactly the committed checkpoints.
+    evs = [e for e in list_cluster_events()
+           if e["type"] == "CHECKPOINT_SAVED"]
+    assert evs, "no CHECKPOINT_SAVED event"
+    assert all(e["labels"].get("bytes", "0") != "0" for e in evs)
+
+    # Metrics moved on the worker processes (snapshot + persist + bytes
+    # are per-process; at minimum the histograms exist and the driver's
+    # registry knows them).
+    names = {m._name for m in metric_defs.ALL_METRICS}
+    assert {"ray_tpu_ckpt_snapshot_ms", "ray_tpu_ckpt_persist_ms",
+            "ray_tpu_ckpt_bytes_total"} <= names
+
+
+def test_resize_restore_at_new_world_size(cluster_4cpu, tmp_path):
+    """The elastic-resume contract end-to-end at the API level: a 2-way
+    async checkpoint restores through `load_state` semantics at world=3
+    and world=1 (restore_shard against the committed manifest)."""
+    import jax.numpy as jnp
+
+    state = {"w": jnp.arange(24, dtype=jnp.float32).reshape(12, 2),
+             "step": jnp.int32(9)}
+    d = str(tmp_path / "ck")
+    plane = CheckpointPlane()
+    pend = [plane.save_async(state, d, rank=r, world=2, step=9)
+            for r in range(2)]
+    assert all(p.wait(30) for p in pend)
+    assert any(p.committed for p in pend)
+    for new_world in (1, 3):
+        got = [restore_shard(d, rank=r, world=new_world)
+               for r in range(new_world)]
+        w = np.asarray(state["w"])
+        if new_world == 1:
+            np.testing.assert_array_equal(got[0]["w"], w)
+        else:
+            np.testing.assert_array_equal(
+                np.concatenate([g["w"] for g in got]), w)
+        assert all(int(g["step"]) == 9 for g in got)
+    plane.close()
+
+
+@pytest.fixture(scope="module")
+def cluster_4cpu():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
