@@ -125,6 +125,20 @@ def send_handoff(address, state: dict, k_pages, v_pages, *,
     return ack
 
 
+def migrate_session(address, state: dict, k_pages, v_pages, *,
+                    timeout: float = 60.0) -> dict:
+    """Replica->replica live session migration: the prefill->decode handoff
+    wire generalized. `state` is an LLMEngine.export_session "kv" export —
+    possibly mid-generation (output tokens + their KV pages ride along) —
+    and the adopter resumes decode exactly where the exporter stopped, with
+    zero re-prefill. Same whole-stream-or-discard atomicity and zero-pickle
+    guarantees as send_handoff: an unacked migration never happened, and
+    the caller falls back to seeded replay from the prompt."""
+    meta = dict(state)
+    meta["migrated"] = True
+    return send_handoff(address, meta, k_pages, v_pages, timeout=timeout)
+
+
 class KVStreamServer:
     """Decode-side handoff listener: adopts streamed KV pages atomically.
 
@@ -235,10 +249,13 @@ class PrefillServer:
         replica's KVStreamServer). Returns {"handoff": True, "rid": ...} on
         success; {"handoff": False, "response": ...} when the request
         finished during prefill."""
-        prompt, params, lora_name = self._parse(request)
+        prompt, params, lora_name, rid = self._parse(request)
         t0 = time.monotonic()
         with self._lock:
-            rid = self.engine.add_request(prompt, params,
+            # A router-assigned request_id rides through so the decode-side
+            # stream keeps the router's name for the request (failover
+            # replays re-derive the same sampling seed from it).
+            rid = self.engine.add_request(prompt, params, request_id=rid,
                                           lora_name=lora_name)
             final = None
             while True:

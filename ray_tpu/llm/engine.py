@@ -336,6 +336,10 @@ class LLMEngine:
         # Bumped by update_weights (RLHF weight sync); rollout experiences
         # record the version they were sampled under.
         self.weights_version = 0
+        # Prefill tokens actually run through the model (cache hits and
+        # adopted KV excluded): the "zero re-prefill" proof for session
+        # migration — an adopted sequence never adds to this.
+        self.prefill_tokens_computed = 0
 
     # ---- API -------------------------------------------------------------
 
@@ -519,10 +523,58 @@ class LLMEngine:
             "block_size": self.block_size,
             "prefix_hits": bm.prefix_hits,
             "prefix_tokens_saved": bm.prefix_tokens_saved,
+            "prefill_tokens_computed": self.prefill_tokens_computed,
             "queued_prefill_tokens": backlog,
         }
 
     # ---- disaggregated prefill/decode handoff (llm/disagg.py) ------------
+
+    def drain_flights(self) -> List[RequestOutput]:
+        """Synchronously harvest every in-flight decode step and release
+        deferred pages. After this, no device step can still write into any
+        sequence's pages and every request's `dispatched` is 0 — the
+        precondition for exporting decode state (session migration). Tokens
+        the drained steps sampled commit normally (some requests may finish
+        here); the caller fans the returned outputs to its streams."""
+        outputs: List[RequestOutput] = []
+        while self._flights:
+            outputs.extend(self._process_inflight(self._flights.popleft()))
+        self._drain_release()
+        return outputs
+
+    def export_session(self, request_id: str):
+        """Detach a live request wherever it lives for replica->replica
+        migration (llm/disagg.py migrate_session). Returns (state, mode):
+
+          * ("kv" mode) the request was decoding — state carries its block
+            ids under "blocks" exactly like export_request; the caller
+            gathers + streams the pages and the adopter resumes decode with
+            zero re-prefill.
+          * ("replay" mode) the request had not finished prefill — its
+            partial KV is discarded whole (never exported torn) and state
+            carries prompt/output/seed only; the importer re-runs from the
+            prompt, and seeded sampling makes the retry token-identical.
+
+        (None, None) when the id is unknown (already finished). Call
+        drain_flights() first: decode export requires dispatched == 0."""
+        for req in self.running:
+            if req.id == request_id:
+                return self.export_request(request_id), "kv"
+        for queue_ in (self.waiting, self.prefilling):
+            for req in list(queue_):
+                if req.id == request_id:
+                    queue_.remove(req)
+                    self._unpin_lora(req)
+                    self._defer_release(req)
+                    return {
+                        "id": req.id,
+                        "prompt": list(req.prompt),
+                        "output": list(req.output),
+                        "seed": req.seed_val,
+                        "lora_slot": req.lora_slot,
+                        "params": dataclasses.asdict(req.params),
+                    }, "replay"
+        return None, None
 
     def export_request(self, request_id: str) -> Optional[dict]:
         """Detach a just-prefilled request for handoff to a decode replica.
@@ -538,9 +590,8 @@ class LLMEngine:
             return None
         if req.dispatched:
             raise RuntimeError(
-                f"request {request_id} has in-flight decode steps; only a "
-                "prefill-only engine can export (its pages may still be "
-                "written)")
+                f"request {request_id} has in-flight decode steps; call "
+                "drain_flights() first (its pages may still be written)")
         self.running.remove(req)
         self._unpin_lora(req)
         blocks, req.blocks = req.blocks, []
@@ -569,26 +620,31 @@ class LLMEngine:
         req.output = [int(t) for t in state["output"]]
         req.seed_val = int(state["seed"])
         n_pages = int(np.shape(k_pages)[2])
-        if self.block_manager.blocks_needed(len(req.context) + 1) > n_pages:
-            # The exported allocation always covers context + 1 (admission
-            # invariant); anything less is a protocol error, not pressure.
+        if self.block_manager.blocks_needed(len(req.context)) > n_pages:
+            # The stream must cover every context token's KV; anything less
+            # is a protocol error (torn export), not pressure.
             raise ValueError(
                 f"handoff for {req.id} carries {n_pages} pages; "
-                f"{self.block_manager.blocks_needed(len(req.context) + 1)} "
+                f"{self.block_manager.blocks_needed(len(req.context))} "
                 "needed")
         if req.lora_slot and self.runner.lora is None:
             raise ValueError(
                 "handoff carries a LoRA slot but this replica has no LoRA "
                 "manager (disaggregated tiers must preload identical "
                 "adapters)")
-        ids = self.block_manager.adopt_blocks(n_pages)
+        # Allocate headroom for the next token too when the stream covered
+        # the context exactly (a migrated sequence whose context fills its
+        # last block): decode resumes without an immediate allocation.
+        total = max(n_pages,
+                    self.block_manager.blocks_needed(len(req.context) + 1))
+        ids = self.block_manager.adopt_blocks(total)
         if ids is None:
             return False
         if req.lora_pinned:
             self.runner.lora.pin(req.lora_slot)
         req.blocks = ids
         req.prefilled = len(req.context)
-        self.runner.scatter_pages(ids, k_pages, v_pages)
+        self.runner.scatter_pages(ids[:n_pages], k_pages, v_pages)
         if self.block_manager.caching:
             # Re-register full prompt blocks under THIS replica's digest
             # chain so disaggregation composes with prefix caching: the next
@@ -739,6 +795,7 @@ class LLMEngine:
                   for r in batch]
         Bq = self.runner.chunk_bucket(max(chunks))
         chunks = [min(c, Bq) for c in chunks]
+        self.prefill_tokens_computed += sum(chunks)
         S = self.runner.batch_bucket(len(batch))
         tokens = np.zeros((S, Bq), dtype=np.int32)
         q_positions = np.zeros(S, dtype=np.int32)

@@ -30,6 +30,35 @@ class RequestTimeoutError(TimeoutError):
     request has been aborted and its KV pages released — retrying is safe."""
 
 
+class ReplicaDrainingError(RuntimeError):
+    """This replica has stopped admitting (node drain / scale-down
+    retirement in progress). The request was NOT submitted; route it to a
+    healthy replica. The message embeds "REPLICA_DRAINING" so routers can
+    classify it across actor RPC boundaries that re-wrap exception types."""
+
+    def __init__(self, tag: str = ""):
+        super().__init__(f"REPLICA_DRAINING {tag}: replica is draining; "
+                         "resubmit on a healthy replica")
+
+
+class SessionMigratedError(RuntimeError):
+    """The replica exported this in-flight request to another replica
+    (drain/scale-down migration) while a consumer was collecting it.
+
+    mode "kv": the adoptive replica already holds the live stream — the
+    consumer re-collects there with completions_collect(request_id), zero
+    re-prefill. mode "replay": re-submit the same request (same
+    request_id) anywhere healthy; seeded sampling reproduces the identical
+    token stream from the prompt. The message embeds
+    "SESSION_MIGRATED <mode> <rid>" so routers can classify the error
+    across actor RPC boundaries that re-wrap exception types."""
+
+    def __init__(self, rid: str, mode: str):
+        super().__init__(f"SESSION_MIGRATED {mode} {rid}")
+        self.rid = rid
+        self.mode = mode
+
+
 @dataclasses.dataclass
 class LLMConfig:
     model_config: Any = None            # llama.LlamaConfig
@@ -78,6 +107,23 @@ class LLMConfig:
     # How long completions/streams wait for the next engine output before
     # aborting the request (the abandoned-request guard).
     stream_timeout_s: float = 300.0
+
+
+def _node_hex() -> Optional[str]:
+    """This process's cluster node id (hex), when a core worker exists —
+    the join key the router uses to map NODE_DRAINING/NODE_DEAD events to
+    replicas. None outside a cluster (in-process tests, microbench)."""
+    try:
+        from ray_tpu.core import worker as worker_mod
+
+        if worker_mod.is_initialized():
+            nid = worker_mod.global_worker().node_id
+            if isinstance(nid, (bytes, bytearray)):
+                return bytes(nid).hex()
+            return str(nid) if nid is not None else None
+    except Exception:
+        pass
+    return None
 
 
 def build_engine(llm_config: LLMConfig, prefill_only: bool = False):
@@ -151,14 +197,18 @@ class LLMServer:
         self._tok_count = 0
         self._tok_t0 = time.monotonic()
         self._gauges = self._bind_gauges()
-        # KV handoff listener: in disaggregated mode prefill replicas
-        # stream populated pages here (llm/disagg.py wire).
-        self._handoff = None
-        if llm_config.disaggregate > 0:
-            from ray_tpu.llm.disagg import KVStreamServer
+        # KV stream listener — always on: prefill replicas stream populated
+        # pages here in disaggregated mode, and draining peers migrate live
+        # sessions here in every mode (llm/disagg.py wire).
+        from ray_tpu.llm.disagg import KVStreamServer
 
-            self._handoff = KVStreamServer(self._adopt_handoff,
-                                           host=llm_config.handoff_host)
+        self._handoff = KVStreamServer(self._adopt_handoff,
+                                       host=llm_config.handoff_host)
+        # Set when this replica is being retired (node drain / scale-down):
+        # new submissions bounce with ReplicaDrainingError and
+        # migrate_sessions moves the live ones out.
+        self._draining = False
+        self._sessions_migrated_out = 0
         self._loop = threading.Thread(target=self._engine_loop, daemon=True)
         self._loop.start()
 
@@ -239,8 +289,15 @@ class LLMServer:
             if not busy:
                 time.sleep(0.005)
 
-    def _submit(self, prompt, params, lora_name=None) -> str:
-        rid = uuid.uuid4().hex[:12]
+    def _submit(self, prompt, params, lora_name=None,
+                request_id: Optional[str] = None) -> str:
+        if self._draining:
+            raise ReplicaDrainingError(self._replica_tag)
+        # Honor a caller-assigned id (the router names requests): the
+        # engine seeds sampling from crc32(request_id) when no explicit
+        # seed is set, so a failover replay under the same id reproduces
+        # the identical token stream on any replica.
+        rid = request_id or uuid.uuid4().hex[:12]
         q: queue.Queue = queue.Queue()
         self._streams[rid] = q
         try:
@@ -267,7 +324,8 @@ class LLMServer:
             max_tokens=int(request.get("max_tokens", 32)),
             stop_token_ids=request.get("stop_token_ids"),
             seed=request.get("seed"))
-        return prompt, params, request.get("lora_name")
+        return prompt, params, request.get("lora_name"), \
+            request.get("request_id")
 
     def _abort(self, rid: str) -> bool:
         """Stop decoding for a dead consumer and free its KV pages."""
@@ -275,6 +333,12 @@ class LLMServer:
             aborted = self.engine.abort_request(rid)
         self._streams.pop(rid, None)
         return aborted
+
+    def abort(self, rid: str) -> bool:
+        """Router-facing abort: after a collect call fails mid-generation
+        the router replays the request elsewhere and aborts the orphan here
+        so it stops burning decode compute and KV pages. Idempotent."""
+        return self._abort(rid)
 
     # ---- stats / observability ------------------------------------------
 
@@ -285,6 +349,9 @@ class LLMServer:
             s = self.engine.stats()
         s["tokens_per_s"] = round(self._tokens_per_s, 1)
         s["replica"] = self._replica_tag
+        s["draining"] = self._draining
+        s["node_id"] = _node_hex()
+        s["sessions_migrated_out"] = self._sessions_migrated_out
         if self._handoff is not None:
             s["handoff_address"] = list(self._handoff.address)
             s["handoffs_adopted"] = self._handoff.handoffs_adopted
@@ -310,12 +377,94 @@ class LLMServer:
         g["prefix_tokens_saved"].set(s["prefix_tokens_saved"])
         g["tokens_per_s"].set(s["tokens_per_s"])
 
-    # ---- KV handoff (disaggregated prefill) ------------------------------
+    # ---- KV handoff + live migration (llm/disagg.py wire) ----------------
 
     def handoff_address(self) -> List:
-        if self._handoff is None:
-            raise ValueError("replica built without disaggregate > 0")
         return list(self._handoff.address)
+
+    def resume_admission(self) -> None:
+        """Cancel a drain: start admitting again. For the reprieve path —
+        the node's drain was withdrawn, or a scale-down decision reversed
+        before the replica was retired. Sessions already migrated out
+        stay migrated (their KV lives on the adoptive replica now)."""
+        self._draining = False
+
+    def migrate_sessions(self, target_address, *,
+                         timeout: float = 60.0) -> Dict:
+        """Drain-plane live migration: stop admitting, then move every live
+        request to `target_address` (another replica's KV stream listener).
+
+        Decoding requests travel with their populated KV pages over the
+        zero-pickle raw-frame wire — whole-stream-or-discard, so a target
+        dying mid-adopt leaves nothing torn and the request falls back to
+        seeded replay from the prompt. Requests still queued or mid-prefill
+        always take the replay path (their partial KV is discarded whole).
+        Requests that finish while the async pipeline drains complete
+        normally — migration never double-delivers. Consumers blocked in
+        completions/_collect get a SessionMigratedError naming the mode so
+        the router re-collects (kv) or re-submits (replay); no client ever
+        observes this replica going away. Returns per-mode rid lists."""
+        from ray_tpu.llm.disagg import migrate_session
+
+        self._draining = True
+        migrated: List[str] = []
+        replayed: List[str] = []
+        finished: List[str] = []
+        exports: List[tuple] = []
+        with self._lock:
+            # Harvest in-flight device steps first: their tokens commit,
+            # some requests finish here (the migration-vs-completion race
+            # resolves to exactly-once delivery), and afterwards no device
+            # write can land in any exported page.
+            for out in self.engine.drain_flights():
+                q = self._streams.get(out.request_id)
+                if q is not None:
+                    q.put(out)
+                if out.finished:
+                    finished.append(out.request_id)
+            live = ([r.id for r in self.engine.running]
+                    + [r.id for r in self.engine.prefilling]
+                    + [r.id for r in self.engine.waiting])
+            for rid in live:
+                state, mode = self.engine.export_session(rid)
+                if state is None:
+                    continue
+                if mode == "kv":
+                    blocks = state.pop("blocks")
+                    k, v = self.engine.runner.gather_pages(blocks)
+                    self.engine.block_manager.release_blocks(blocks)
+                    exports.append((rid, state, k, v))
+                else:
+                    replayed.append(rid)
+        # Stream outside the lock (PrefillServer's discipline: socket time
+        # must never serialize engine work — and the failure path below
+        # must not hold the engine hostage either).
+        send_failed: List[str] = []
+        for rid, state, k, v in exports:
+            try:
+                migrate_session(target_address, state, k, v,
+                                timeout=timeout)
+                migrated.append(rid)
+            except Exception:
+                # Atomic wire: nothing half-adopted — but a timeout with a
+                # LOST ACK can leave the session fully adopted (decoding
+                # with no consumer) on the target while we replay it from
+                # the prompt. Report these rids so the router best-effort
+                # aborts them on the target before the replay starts.
+                send_failed.append(rid)
+                replayed.append(rid)
+        for rid in migrated:
+            q = self._streams.get(rid)
+            if q is not None:
+                q.put(SessionMigratedError(rid, "kv"))
+        for rid in replayed:
+            q = self._streams.get(rid)
+            if q is not None:
+                q.put(SessionMigratedError(rid, "replay"))
+        self._sessions_migrated_out += len(migrated)
+        return {"migrated": migrated, "replayed": replayed,
+                "send_failed": send_failed, "finished": finished,
+                "replica": self._replica_tag}
 
     def _adopt_handoff(self, state: Dict, k_pages, v_pages) -> bool:
         # The stream queue must exist BEFORE the request can start decoding
@@ -354,8 +503,8 @@ class LLMServer:
     def completions(self, request: Dict) -> Dict:
         """OpenAI-ish /v1/completions: {"prompt": str|[int], "max_tokens",
         "temperature", "top_k", "top_p", "stop_token_ids"}."""
-        prompt, params, lora_name = self._parse(request)
-        rid = self._submit(prompt, params, lora_name)
+        prompt, params, lora_name, rid = self._parse(request)
+        rid = self._submit(prompt, params, lora_name, rid)
         return self._collect(rid)
 
     def completions_collect(self, request_id: str) -> Dict:
@@ -393,8 +542,8 @@ class LLMServer:
         """Streaming completions: a generator of OpenAI-style chunk events,
         one per sampled token. Consume through
         handle.options("completions_stream").remote_stream(request)."""
-        prompt, params, lora_name = self._parse(request)
-        rid = self._submit(prompt, params, lora_name)
+        prompt, params, lora_name, rid = self._parse(request)
+        rid = self._submit(prompt, params, lora_name, rid)
         q = self._streams[rid]
         finished = False
         try:

@@ -28,7 +28,17 @@ Emitters in-tree:
                  wait-graph) — emitted by the stall detector tick
   * llm router — LLM_REQUEST_SHED (SLO admission rejected a request;
                  labels carry the projected TTFT vs the SLO so
-                 `scripts events` explains shedding during incidents)
+                 `scripts events` explains shedding during incidents),
+                 LLM_REQUEST_FAILOVER (an in-flight request was replayed
+                 on a surviving replica after its replica died; seeded
+                 sampling makes the retry token-identical),
+                 LLM_SESSION_MIGRATED (a draining replica exported live
+                 sessions — KV pages + request state — to an adoptive
+                 replica over the raw-frame wire; labels carry counts),
+                 LLM_REPLICA_EJECTED (health tracking declared a replica
+                 dead: affinity state pruned, no more picks land on it),
+                 LLM_REPLICAS_SCALED (the serve-side replica policy
+                 changed the LLM fleet size; scale-down drains first)
   * rlhf       — RLHF_PLACEMENT_SWITCH (the adaptive placement policy
                  moved generator/learner between colocated and
                  disaggregated; labels carry from/to mode, the switch
@@ -68,12 +78,18 @@ TRAIN_GANG_RESTART = "TRAIN_GANG_RESTART"
 TASK_STALLED = "TASK_STALLED"
 DEADLOCK_DETECTED = "DEADLOCK_DETECTED"
 LLM_REQUEST_SHED = "LLM_REQUEST_SHED"
+LLM_REQUEST_FAILOVER = "LLM_REQUEST_FAILOVER"
+LLM_SESSION_MIGRATED = "LLM_SESSION_MIGRATED"
+LLM_REPLICA_EJECTED = "LLM_REPLICA_EJECTED"
+LLM_REPLICAS_SCALED = "LLM_REPLICAS_SCALED"
 RLHF_PLACEMENT_SWITCH = "RLHF_PLACEMENT_SWITCH"
 CHECKPOINT_SAVED = "CHECKPOINT_SAVED"
 EVENT_TYPES = (NODE_DEAD, NODE_DRAINING, NODE_PREEMPTED, SLICE_LOST,
                OOM_KILL, COLLECTIVE_ABORT,
                AUTOSCALER_SCALE, TRAIN_GANG_RESTART, TASK_STALLED,
-               DEADLOCK_DETECTED, LLM_REQUEST_SHED, RLHF_PLACEMENT_SWITCH,
+               DEADLOCK_DETECTED, LLM_REQUEST_SHED, LLM_REQUEST_FAILOVER,
+               LLM_SESSION_MIGRATED, LLM_REPLICA_EJECTED,
+               LLM_REPLICAS_SCALED, RLHF_PLACEMENT_SWITCH,
                CHECKPOINT_SAVED)
 
 

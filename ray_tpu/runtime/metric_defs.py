@@ -159,6 +159,23 @@ LLM_KV_HANDOFFS = Counter(
     "ray_tpu_llm_kv_handoffs_total",
     "prefill->decode KV page handoffs adopted")
 
+# Fleet resilience (llm/router.py FleetSupervisor): failover replays,
+# drain-plane session migrations, and the live-replica count the router's
+# health tracker believes in. All roll up into
+# state.summary()["llm_serving"] like every other ray_tpu_llm_* series.
+LLM_FAILOVERS = Counter(
+    "ray_tpu_llm_failovers_total",
+    "in-flight requests replayed on a surviving replica after a failure",
+    tag_keys=("deployment",))
+LLM_SESSIONS_MIGRATED = Counter(
+    "ray_tpu_llm_sessions_migrated_total",
+    "live sessions moved replica->replica (KV pages over the drain plane)",
+    tag_keys=("deployment",))
+LLM_REPLICAS_HEALTHY = Gauge(
+    "ray_tpu_llm_replicas_healthy",
+    "replicas the router currently considers live and routable",
+    tag_keys=("deployment",))
+
 # Checkpoint plane (checkpoint/plane.py): the snapshot histogram is the
 # train-step stall, the persist histogram is the background cost — the
 # 5x-plus gap between them is the async plane's whole point.

@@ -232,6 +232,53 @@ class ServeController:
                 except Exception:
                     pass
 
+    def scale_replicas(self, name: str, desired: int) -> bool:
+        """External scaling entry point (the LLM router's replica policy
+        drives this): set the replica count directly, bypassing the
+        queue-depth autoscaler — which skips deployments without an
+        `autoscaling` config, so the two never fight over one fleet."""
+        if desired < 1:
+            return False
+        if name not in self.deployments:
+            return False
+        self._scale_to(name, int(desired))
+        return True
+
+    def remove_replica(self, name: str, actor_id_hex: str) -> bool:
+        """Retire one SPECIFIC replica by actor id.
+
+        _scale_to's downscale always trims the tail of the replica list;
+        drain-based scale-down needs to kill the replica whose sessions
+        were just migrated out, whichever slot it holds. The caller (LLM
+        router) is responsible for having drained it first — by the time
+        this runs the replica should hold no live sessions."""
+        with self._lock:
+            d = self.deployments.get(name)
+            if d is None:
+                return False
+            victim = None
+            for r in d["replicas"]:
+                aid = getattr(r, "_actor_id", None)
+                aid_hex = (bytes(aid).hex()
+                           if isinstance(aid, (bytes, bytearray))
+                           else str(aid))
+                if aid_hex == actor_id_hex:
+                    victim = r
+                    break
+            if victim is None:
+                return False
+            d["replicas"] = [r for r in d["replicas"] if r is not victim]
+            self.version += 1
+            d["version"] = self.version
+            new_version = self.version
+        self._publish(name, new_version, "scaled_down")
+        self._snapshot_to_kv()
+        try:
+            ray_tpu.kill(victim)
+        except Exception:
+            pass
+        return True
+
     def get_replicas(self, name: str) -> dict:
         d = self.deployments.get(name)
         if d is None:

@@ -230,12 +230,22 @@ class PreemptionKiller(NodeKiller):
             logger.warning("PreemptionKiller: replacement respawn failed",
                            exc_info=True)
 
-    def strike(self) -> Optional[str]:
+    def strike(self, node=None) -> Optional[str]:
         """Preempt one qualifying node NOW: drain notice + replacement
         capacity immediately, then a timed hard kill `notice_s` later.
         Returns the victim's short node id (before the kill lands), or
-        None if no node qualifies."""
-        node = self._pick_victim()
+        None if no node qualifies.
+
+        `node` pins the victim — a Cluster node object or a node-id hex
+        prefix — for scripted chaos scenarios ("drain THIS replica's
+        node, outright-kill THAT one") where the seeded random pick
+        would make the assertion depend on the draw."""
+        if node is not None and isinstance(node, str):
+            node = next((n for n in self.cluster.nodes
+                         if n.node_id.hex().startswith(node)
+                         and n.proc.poll() is None), None)
+        if node is None:
+            node = self._pick_victim()
         if node is None:
             logger.warning("PreemptionKiller: no node to preempt")
             return None
