@@ -1,0 +1,36 @@
+"""Project-invariant analysis layer: graftlint + runtime sanitizers.
+
+The codebase rests on a handful of cross-cutting invariants that used to
+be proven one counter-proof test at a time:
+
+  * zero-pickle on hot wire paths (ring collectives, raw-frame RPC, KV
+    handoffs, device channels, checkpoint manifests);
+  * no blocking calls inside remote-actor ``__init__`` (the router
+    deadlock class: an actor constructor that blocks on the very control
+    plane that is constructing it);
+  * forward-compatible typed frames in ``runtime/wire.py`` (field numbers
+    are forever, every frame round-trips in CI);
+  * every event type documented, every metric's tags declared, every
+    background thread daemonized and named.
+
+``graftlint`` enforces these statically over the whole package — AST
+passes, no imports of the code under analysis — and the sanitizers
+enforce the dynamic halves at test time:
+
+  * :class:`PickleSanitizer` hooks pickle during a scoped window and
+    attributes every (de)serialization to its call site;
+  * :class:`LockOrderSanitizer` wraps ``threading.Lock`` and reports
+    cross-thread lock-order inversions with both acquisition stacks.
+
+CLI: ``python -m ray_tpu.scripts lint [--json]``. Docs:
+``docs/static_analysis.md``.
+"""
+
+from ray_tpu.analysis.graftlint import LintConfig, LintResult, Violation, run
+from ray_tpu.analysis.sanitizers import (LockOrderSanitizer, PickleSanitizer,
+                                         pickle_window)
+
+__all__ = [
+    "LintConfig", "LintResult", "Violation", "run",
+    "PickleSanitizer", "LockOrderSanitizer", "pickle_window",
+]

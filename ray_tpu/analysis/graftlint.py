@@ -1,0 +1,773 @@
+"""graftlint: AST-based checker for the project's cross-cutting invariants.
+
+Reference analog: Ray's scattered CI lint scripts (ci/lint/*,
+check_api_annotations, the banned-words checks) — here consolidated into
+one analysis pass over the package with machine-checkable rules. Every
+pass is pure AST + text: linting never imports the code under analysis,
+so it runs in milliseconds and cannot be confused by import-time side
+effects.
+
+Rules
+-----
+hot-pickle            pickle/cloudpickle calls inside the frozen list of
+                      zero-pickle hot-path modules (ring collectives,
+                      raw-frame RPC, device channels, KV handoff,
+                      checkpoint manifest).
+actor-init-blocking   ray_tpu.get()/wait(), handle resolution
+                      (replica_handles), or collective group ops inside a
+                      @remote / deployment class __init__ — including
+                      self-helper methods reachable from __init__. This is
+                      the router deadlock class: a constructor blocking on
+                      the control plane that is mid-way through
+                      constructing it.
+wire-field-order      *Msg field numbers in runtime/wire.py must be
+                      declared in ascending order with no duplicates
+                      (numbers are wire identity; declaration order is the
+                      reader's mental schema — keep them aligned).
+wire-field-default    Field(default=...) must be an immutable literal; a
+                      mutable default would be shared across instances.
+wire-roundtrip        every *Msg class must have an entry in the
+                      roundtrip-test registry (WIRE_ROUNDTRIP_REGISTRY in
+                      tests/test_wire_schema.py) so CI proves it
+                      encodes/decodes.
+event-docs            every type in runtime/events.py EVENT_TYPES must
+                      have a row in docs/observability.md.
+event-undeclared      emit()/make_event() called with a string literal
+                      that is not a registered event type.
+metric-def            metric_defs.py hygiene: ray_tpu_-prefixed name,
+                      non-empty description, literal tag_keys tuple.
+metric-central        Counter/Gauge/Histogram constructed outside
+                      runtime/metric_defs.py (runtime metrics are defined
+                      once, in the central table).
+metric-tags           a metric observation (.inc/.set/.observe/.bind)
+                      passing literal tag keys not declared by the metric.
+thread-attrs          threading.Thread(...) without daemon=True and
+                      name=...: an unnamed or non-daemon background
+                      thread is undiagnosable in stack dumps and can wedge
+                      interpreter shutdown.
+parse-error           a file under analysis failed to parse.
+
+Suppressions
+------------
+Inline, justified at the call site::
+
+    body = pickle.dumps(obj)  # graftlint: allow[hot-pickle] control frames only
+
+An allow comment applies to its own line and the line directly below it
+(comment-above style). The shipped baseline file
+(ray_tpu/analysis/baseline.txt) carries `rule path:line` entries for
+violations accepted tree-wide; it ships empty — prefer inline allows,
+which sit next to the code they justify.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "hot-pickle": "pickle on a zero-pickle hot-path module",
+    "actor-init-blocking": "blocking call inside a remote-class __init__",
+    "wire-field-order": "*Msg field numbers out of order or duplicated",
+    "wire-field-default": "*Msg field default is not an immutable literal",
+    "wire-roundtrip": "*Msg missing from the roundtrip-test registry",
+    "event-docs": "event type has no docs/observability.md row",
+    "event-undeclared": "emit() with an unregistered event-type literal",
+    "metric-def": "metric definition hygiene (name/description/tag_keys)",
+    "metric-central": "metric constructed outside runtime/metric_defs.py",
+    "metric-tags": "metric observed with undeclared tag keys",
+    "thread-attrs": "threading.Thread without daemon=True and name=",
+    "parse-error": "file failed to parse",
+}
+
+_PICKLE_MODULES = {"pickle", "cloudpickle", "_pickle", "cPickle", "dill"}
+_PICKLE_FUNCS = {"dumps", "loads", "dump", "load", "Pickler", "Unpickler"}
+_RAY_BLOCKING = {"get", "wait"}
+_BLOCKING_ATTRS = {"replica_handles", "init_collective_group",
+                   "create_collective_group"}
+_COLLECTIVE_OPS = {"allreduce", "allgather", "reducescatter", "broadcast",
+                   "barrier", "alltoall", "send", "recv",
+                   "allreduce_gradients"}
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+_METRIC_OBSERVERS = {"inc", "set", "observe", "bind"}
+_ALLOW_RE = re.compile(r"#\s*graftlint:\s*allow\[([a-z\-, ]+)\]")
+
+
+# Default hot-path module set: the wire paths whose steady state must move
+# zero pickled bytes (each has a counter-proof test; the lint keeps new
+# call sites out between test runs). Frozen: extending it is a PR-review
+# decision, not a call-site decision.
+HOT_PATHS: Tuple[str, ...] = (
+    "ray_tpu/runtime/rpc.py",
+    "ray_tpu/collective/cpu_group.py",
+    "ray_tpu/dag/device_channel.py",
+    "ray_tpu/llm/disagg.py",
+    "ray_tpu/checkpoint/manifest.py",
+)
+
+
+@dataclass
+class LintConfig:
+    """Repo-relative layout the passes read. `root` is the repository
+    root (the directory containing the ray_tpu/ package)."""
+
+    root: str
+    package: str = "ray_tpu"
+    hot_paths: Tuple[str, ...] = HOT_PATHS
+    wire_module: str = "ray_tpu/runtime/wire.py"
+    events_module: str = "ray_tpu/runtime/events.py"
+    metric_defs_module: str = "ray_tpu/runtime/metric_defs.py"
+    metrics_module: str = "ray_tpu/util/metrics.py"
+    roundtrip_registry: str = "tests/test_wire_schema.py"
+    registry_name: str = "WIRE_ROUNDTRIP_REGISTRY"
+    docs_observability: str = "docs/observability.md"
+    baseline: str = "ray_tpu/analysis/baseline.txt"
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def key(self) -> str:
+        return f"{self.rule} {self.path}:{self.line}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+@dataclass
+class LintResult:
+    violations: List[Violation] = field(default_factory=list)
+    suppressed: int = 0
+    baselined: int = 0
+    files_scanned: int = 0
+    notes: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict:
+        return {"ok": self.ok,
+                "violations": [v.to_dict() for v in self.violations],
+                "suppressed": self.suppressed, "baselined": self.baselined,
+                "files_scanned": self.files_scanned, "notes": self.notes}
+
+
+class _Module:
+    """One parsed file: tree + allow-comment map + import alias tables."""
+
+    def __init__(self, rel: str, source: str):
+        self.rel = rel
+        self.tree = ast.parse(source)
+        # alias -> full dotted target ("md" -> "ray_tpu.runtime.metric_defs",
+        # "dumps" -> "pickle.dumps"). Collected over the WHOLE tree: the
+        # codebase imports lazily inside functions on purpose.
+        self.imports: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.imports[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+        self.allows: Dict[int, Set[str]] = {}
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _ALLOW_RE.search(text)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                # The comment covers its own line and the next (so it can
+                # sit above a long call).
+                self.allows.setdefault(i, set()).update(rules)
+                self.allows.setdefault(i + 1, set()).update(rules)
+
+    def allowed(self, rule: str, line: int) -> bool:
+        return rule in self.allows.get(line, ())
+
+    def resolves(self, name: str, target: str) -> bool:
+        return self.imports.get(name) == target
+
+
+def _load_modules(cfg: LintConfig) -> Tuple[Dict[str, _Module],
+                                            List[Violation]]:
+    mods: Dict[str, _Module] = {}
+    errors: List[Violation] = []
+    pkg_dir = os.path.join(cfg.root, cfg.package)
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fname)
+            rel = os.path.relpath(full, cfg.root).replace(os.sep, "/")
+            try:
+                with open(full, encoding="utf-8") as f:
+                    mods[rel] = _Module(rel, f.read())
+            except (SyntaxError, UnicodeDecodeError) as e:
+                errors.append(Violation(
+                    "parse-error", rel, getattr(e, "lineno", 0) or 0,
+                    f"failed to parse: {e}"))
+    return mods, errors
+
+
+def _read_text(cfg: LintConfig, rel: str) -> Optional[str]:
+    path = os.path.join(cfg.root, rel)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+# --------------------------------------------------------------- passes
+
+def _pass_hot_pickle(cfg: LintConfig,
+                     mods: Dict[str, _Module]) -> Iterator[Violation]:
+    for rel in cfg.hot_paths:
+        mi = mods.get(rel)
+        if mi is None:
+            continue
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            hit = None
+            if isinstance(f, ast.Attribute) and f.attr in _PICKLE_FUNCS:
+                base = f.value
+                if isinstance(base, ast.Name) and (
+                        base.id in _PICKLE_MODULES
+                        or mi.imports.get(base.id) in _PICKLE_MODULES):
+                    hit = f"{base.id}.{f.attr}"
+                elif (isinstance(base, ast.Attribute)
+                      and base.attr in _PICKLE_MODULES):
+                    hit = f"{base.attr}.{f.attr}"  # e.g. rpc.pickle.dumps
+            elif isinstance(f, ast.Name):
+                full = mi.imports.get(f.id, "")
+                mod, _, fn = full.rpartition(".")
+                if mod in _PICKLE_MODULES and fn in _PICKLE_FUNCS:
+                    hit = full
+            if hit:
+                yield Violation(
+                    "hot-pickle", rel, node.lineno,
+                    f"{hit} on a zero-pickle hot path — move the payload "
+                    f"to raw/typed frames, or justify with an inline "
+                    f"`# graftlint: allow[hot-pickle] <why>`")
+
+
+def _is_remote_class(node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        try:
+            text = ast.unparse(dec)
+        except Exception:  # pragma: no cover - unparse of exotic nodes
+            continue
+        if re.search(r"\b(remote|deployment)\b", text):
+            return True
+    return False
+
+
+def _blocking_call(mi: _Module, call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        base = f.value
+        if f.attr in _RAY_BLOCKING and isinstance(base, ast.Name) and (
+                base.id == "ray_tpu"
+                or mi.resolves(base.id, "ray_tpu")):
+            return f"ray_tpu.{f.attr}()"
+        if f.attr in _BLOCKING_ATTRS:
+            return f".{f.attr}()"
+        if f.attr in _COLLECTIVE_OPS and isinstance(base, ast.Name) and (
+                base.id == "collective"
+                or mi.resolves(base.id, "ray_tpu.collective")):
+            return f"collective.{f.attr}()"
+    elif isinstance(f, ast.Name):
+        full = mi.imports.get(f.id, "")
+        if full in ("ray_tpu.get", "ray_tpu.wait"):
+            return f"{full}()"
+        if f.id in _BLOCKING_ATTRS:
+            return f"{f.id}()"
+    return None
+
+
+def _pass_actor_init(cfg: LintConfig,
+                     mods: Dict[str, _Module]) -> Iterator[Violation]:
+    for rel, mi in mods.items():
+        for node in ast.walk(mi.tree):
+            if not (isinstance(node, ast.ClassDef)
+                    and _is_remote_class(node)):
+                continue
+            methods = {m.name: m for m in node.body
+                       if isinstance(m, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            init = methods.get("__init__")
+            if init is None:
+                continue
+            # __init__ plus every same-class helper reachable from it via
+            # self.<m>() — the deadlock hides one hop down as often as not.
+            reachable, queue = {"__init__"}, [init]
+            while queue:
+                fn = queue.pop()
+                for c in ast.walk(fn):
+                    if (isinstance(c, ast.Call)
+                            and isinstance(c.func, ast.Attribute)
+                            and isinstance(c.func.value, ast.Name)
+                            and c.func.value.id == "self"
+                            and c.func.attr in methods
+                            and c.func.attr not in reachable):
+                        reachable.add(c.func.attr)
+                        queue.append(methods[c.func.attr])
+            for name in sorted(reachable):
+                for c in ast.walk(methods[name]):
+                    if not isinstance(c, ast.Call):
+                        continue
+                    what = _blocking_call(mi, c)
+                    if what:
+                        via = ("" if name == "__init__"
+                               else f" (via self.{name}(), reached from "
+                                    f"__init__)")
+                        yield Violation(
+                            "actor-init-blocking", rel, c.lineno,
+                            f"{what} inside {node.name}.__init__{via}: a "
+                            f"remote constructor must not block on the "
+                            f"control plane that is constructing it — "
+                            f"resolve lazily on first use")
+
+
+def _msg_classes(tree: ast.Module) -> Iterator[ast.ClassDef]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name.endswith("Msg") \
+                and not node.name.startswith("_"):
+            yield node
+
+
+def _msg_fields(cls: ast.ClassDef):
+    """Yield (name, number, default_node, lineno) for Field assignments."""
+    for stmt in cls.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Name)
+                and stmt.value.func.id == "Field"):
+            continue
+        call = stmt.value
+        number = None
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, int):
+            number = call.args[0].value
+        default = next((kw.value for kw in call.keywords
+                        if kw.arg == "default"), None)
+        yield stmt.targets[0].id, number, default, stmt.lineno
+
+
+def _registry_names(cfg: LintConfig) -> Optional[Set[str]]:
+    text = _read_text(cfg, cfg.roundtrip_registry)
+    if text is None:
+        return None
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            target = node.target.id
+        if target == cfg.registry_name \
+                and isinstance(getattr(node, "value", None), ast.Dict):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return None
+
+
+def _pass_wire(cfg: LintConfig, mods: Dict[str, _Module],
+               notes: List[str]) -> Iterator[Violation]:
+    mi = mods.get(cfg.wire_module)
+    if mi is None:
+        return
+    registry = _registry_names(cfg)
+    if registry is None:
+        notes.append(
+            f"wire-roundtrip skipped: no {cfg.registry_name} in "
+            f"{cfg.roundtrip_registry}")
+    for cls in _msg_classes(mi.tree):
+        seen: Dict[int, str] = {}
+        prev = 0
+        for name, number, default, lineno in _msg_fields(cls):
+            if number is None:
+                yield Violation(
+                    "wire-field-order", cfg.wire_module, lineno,
+                    f"{cls.name}.{name}: field number must be an int "
+                    f"literal (numbers are wire identity)")
+                continue
+            if number in seen:
+                yield Violation(
+                    "wire-field-order", cfg.wire_module, lineno,
+                    f"{cls.name}.{name}: duplicate field number {number} "
+                    f"(already used by {seen[number]})")
+            elif number < prev:
+                yield Violation(
+                    "wire-field-order", cfg.wire_module, lineno,
+                    f"{cls.name}.{name}: field number {number} declared "
+                    f"after {prev} — keep declaration order ascending so "
+                    f"the class reads as the wire schema")
+            seen[number] = name
+            prev = max(prev, number)
+            if default is not None and not (
+                    isinstance(default, ast.Constant)
+                    or (isinstance(default, ast.UnaryOp)
+                        and isinstance(default.operand, ast.Constant))):
+                yield Violation(
+                    "wire-field-default", cfg.wire_module, lineno,
+                    f"{cls.name}.{name}: default must be an immutable "
+                    f"literal — a mutable default is shared across every "
+                    f"decoded instance")
+        if registry is not None and cls.name not in registry:
+            yield Violation(
+                "wire-roundtrip", cfg.wire_module, cls.lineno,
+                f"{cls.name} has no entry in {cfg.registry_name} "
+                f"({cfg.roundtrip_registry}) — every wire frame must "
+                f"round-trip in CI before a peer depends on it")
+
+
+def _event_types(mi: _Module) -> Tuple[Dict[str, Tuple[str, int]],
+                                       List[str]]:
+    """(constant name -> (string value, line), ordered type values)."""
+    consts: Dict[str, Tuple[str, int]] = {}
+    ordered: List[str] = []
+    for node in mi.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+            if isinstance(value, ast.Constant) \
+                    and isinstance(value.value, str):
+                consts[target] = (value.value, node.lineno)
+            elif target == "EVENT_TYPES" \
+                    and isinstance(value, (ast.Tuple, ast.List)):
+                for elt in value.elts:
+                    if isinstance(elt, ast.Name) and elt.id in consts:
+                        ordered.append(consts[elt.id][0])
+                    elif isinstance(elt, ast.Constant):
+                        ordered.append(elt.value)
+    return consts, ordered
+
+
+def _pass_events(cfg: LintConfig, mods: Dict[str, _Module],
+                 notes: List[str]) -> Iterator[Violation]:
+    mi = mods.get(cfg.events_module)
+    if mi is None:
+        return
+    consts, types = _event_types(mi)
+    docs = _read_text(cfg, cfg.docs_observability)
+    if docs is None:
+        notes.append(f"event-docs skipped: {cfg.docs_observability} "
+                     f"not found")
+    else:
+        for value in types:
+            if f"`{value}`" not in docs:
+                line = next((ln for v, ln in consts.values() if v == value),
+                            0)
+                yield Violation(
+                    "event-docs", cfg.events_module, line,
+                    f"event type {value} has no row in "
+                    f"{cfg.docs_observability} — document who emits it "
+                    f"and when before shipping it")
+    known = set(types)
+    events_target = cfg.events_module[:-3].replace("/", ".")
+    for rel, m in mods.items():
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            f = node.func
+            is_emit = False
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in ("emit", "make_event") \
+                    and isinstance(f.value, ast.Name) \
+                    and m.imports.get(f.value.id) == events_target:
+                is_emit = True
+            elif isinstance(f, ast.Name) and m.imports.get(f.id) in (
+                    f"{events_target}.emit",
+                    f"{events_target}.make_event"):
+                is_emit = True
+            if is_emit and node.args[0].value not in known:
+                yield Violation(
+                    "event-undeclared", rel, node.lineno,
+                    f"emit({node.args[0].value!r}): not a registered "
+                    f"event type — add it to EVENT_TYPES in "
+                    f"{cfg.events_module} (and its docs row)")
+
+
+def _metric_registry(cfg: LintConfig, mods: Dict[str, _Module]
+                     ) -> Tuple[Dict[str, Set[str]], List[Violation]]:
+    """Parse metric_defs.py: var name -> declared tag keys, plus hygiene
+    violations."""
+    registry: Dict[str, Set[str]] = {}
+    violations: List[Violation] = []
+    mi = mods.get(cfg.metric_defs_module)
+    if mi is None:
+        return registry, violations
+    for node in mi.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id in _METRIC_CLASSES):
+            continue
+        var, call = node.targets[0].id, node.value
+        name_arg = call.args[0] if call.args else None
+        if not (isinstance(name_arg, ast.Constant)
+                and isinstance(name_arg.value, str)
+                and name_arg.value.startswith("ray_tpu_")):
+            violations.append(Violation(
+                "metric-def", cfg.metric_defs_module, node.lineno,
+                f"{var}: metric name must be a ray_tpu_-prefixed string "
+                f"literal"))
+        desc = call.args[1] if len(call.args) > 1 else next(
+            (kw.value for kw in call.keywords if kw.arg == "description"),
+            None)
+        if not (isinstance(desc, ast.Constant)
+                and isinstance(desc.value, str) and desc.value.strip()):
+            violations.append(Violation(
+                "metric-def", cfg.metric_defs_module, node.lineno,
+                f"{var}: metric needs a non-empty description (the table "
+                f"is the documentation)"))
+        tags: Set[str] = set()
+        tag_kw = next((kw.value for kw in call.keywords
+                       if kw.arg == "tag_keys"), None)
+        if tag_kw is not None:
+            if isinstance(tag_kw, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str) for e in tag_kw.elts):
+                tags = {e.value for e in tag_kw.elts}
+            else:
+                violations.append(Violation(
+                    "metric-def", cfg.metric_defs_module, node.lineno,
+                    f"{var}: tag_keys must be a literal tuple of strings "
+                    f"so the declared tag set is statically checkable"))
+        registry[var] = tags
+    return registry, violations
+
+
+def _pass_metrics(cfg: LintConfig,
+                  mods: Dict[str, _Module]) -> Iterator[Violation]:
+    registry, def_violations = _metric_registry(cfg, mods)
+    yield from def_violations
+    defs_target = cfg.metric_defs_module[:-3].replace("/", ".")
+    metrics_target = cfg.metrics_module[:-3].replace("/", ".")
+    for rel, mi in mods.items():
+        if rel in (cfg.metric_defs_module, cfg.metrics_module):
+            continue
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            # Centralization: runtime metrics are defined once, in the
+            # table — a Counter() constructed elsewhere escapes the
+            # registry lint and the docs.
+            constructed = None
+            if isinstance(f, ast.Name) and mi.imports.get(f.id) in {
+                    f"{metrics_target}.{c}" for c in _METRIC_CLASSES}:
+                constructed = f.id
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr in _METRIC_CLASSES \
+                    and isinstance(f.value, ast.Name) \
+                    and mi.imports.get(f.value.id) == metrics_target:
+                constructed = f.attr
+            if constructed:
+                yield Violation(
+                    "metric-central", rel, node.lineno,
+                    f"{constructed}(...) outside "
+                    f"{cfg.metric_defs_module}: define runtime metrics in "
+                    f"the central table (import and bind them here)")
+                continue
+            # Tag discipline at observation sites, statically: only
+            # literal dict tags are checkable; variables are covered by
+            # the runtime ValueError in util/metrics.py.
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _METRIC_OBSERVERS):
+                continue
+            base = f.value
+            metric = None
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and mi.imports.get(base.value.id) == defs_target:
+                metric = base.attr
+            elif isinstance(base, ast.Name) and mi.imports.get(
+                    base.id, "").startswith(defs_target + "."):
+                metric = mi.imports[base.id].rsplit(".", 1)[1]
+            if metric not in registry:
+                continue
+            tags_expr = next((kw.value for kw in node.keywords
+                              if kw.arg == "tags"), None)
+            if tags_expr is None and f.attr == "bind" and node.args:
+                tags_expr = node.args[0]
+            if not isinstance(tags_expr, ast.Dict):
+                continue
+            keys = {k.value for k in tags_expr.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+            undeclared = keys - registry[metric]
+            if undeclared:
+                yield Violation(
+                    "metric-tags", rel, node.lineno,
+                    f"{metric}.{f.attr}: tag keys {sorted(undeclared)} "
+                    f"not declared in its tag_keys "
+                    f"(declared: {sorted(registry[metric])})")
+
+
+def _pass_threads(cfg: LintConfig,
+                  mods: Dict[str, _Module]) -> Iterator[Violation]:
+    for rel, mi in mods.items():
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_thread = (
+                (isinstance(f, ast.Attribute) and f.attr == "Thread"
+                 and isinstance(f.value, ast.Name)
+                 and (f.value.id == "threading"
+                      or mi.resolves(f.value.id, "threading")))
+                or (isinstance(f, ast.Name)
+                    and mi.resolves(f.id, "threading.Thread")))
+            if not is_thread:
+                continue
+            kwargs = {kw.arg for kw in node.keywords if kw.arg}
+            daemon_kw = next((kw.value for kw in node.keywords
+                              if kw.arg == "daemon"), None)
+            missing = []
+            if not (isinstance(daemon_kw, ast.Constant)
+                    and daemon_kw.value is True):
+                missing.append("daemon=True")
+            if "name" not in kwargs:
+                missing.append("name=")
+            if missing:
+                yield Violation(
+                    "thread-attrs", rel, node.lineno,
+                    f"threading.Thread missing {' and '.join(missing)}: "
+                    f"unnamed threads are opaque in `scripts stack` dumps "
+                    f"and non-daemon background threads wedge shutdown")
+
+
+# --------------------------------------------------------------- driver
+
+def _load_baseline(cfg: LintConfig,
+                   path: Optional[str]) -> Set[str]:
+    baseline = path or os.path.join(cfg.root, cfg.baseline)
+    entries: Set[str] = set()
+    if not os.path.exists(baseline):
+        return entries
+    with open(baseline, encoding="utf-8") as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if line:
+                # "rule path:line" (exact) or "rule path" (whole file).
+                entries.add(line)
+    return entries
+
+
+def default_root() -> str:
+    """Repository root: the directory containing the ray_tpu package."""
+    import ray_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        ray_tpu.__file__)))
+
+
+def run(root: Optional[str] = None,
+        rules: Optional[Iterable[str]] = None,
+        baseline_path: Optional[str] = None,
+        config: Optional[LintConfig] = None) -> LintResult:
+    cfg = config or LintConfig(root=root or default_root())
+    wanted = set(rules) if rules else None
+    unknown = (wanted or set()) - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown rules: {sorted(unknown)} "
+                         f"(known: {sorted(RULES)})")
+    result = LintResult()
+    mods, parse_errors = _load_modules(cfg)
+    result.files_scanned = len(mods)
+    raw: List[Violation] = list(parse_errors)
+    raw.extend(_pass_hot_pickle(cfg, mods))
+    raw.extend(_pass_actor_init(cfg, mods))
+    raw.extend(_pass_wire(cfg, mods, result.notes))
+    raw.extend(_pass_events(cfg, mods, result.notes))
+    raw.extend(_pass_metrics(cfg, mods))
+    raw.extend(_pass_threads(cfg, mods))
+    baseline = _load_baseline(cfg, baseline_path)
+    for v in raw:
+        if wanted is not None and v.rule not in wanted:
+            continue
+        mi = mods.get(v.path)
+        if mi is not None and mi.allowed(v.rule, v.line):
+            result.suppressed += 1
+            continue
+        if v.key() in baseline or f"{v.rule} {v.path}" in baseline:
+            result.baselined += 1
+            continue
+        result.violations.append(v)
+    result.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return result
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="project-invariant static analysis over ray_tpu/")
+    parser.add_argument("--root", default=None,
+                        help="repository root (default: the directory "
+                             "containing the installed ray_tpu package)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file overriding the shipped "
+                             "ray_tpu/analysis/baseline.txt")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="RULE",
+                        help="run only this rule id (repeatable)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule:22s} {desc}")
+        return 0
+    try:
+        result = run(root=args.root, rules=args.rule,
+                     baseline_path=args.baseline)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        for v in result.violations:
+            print(v.render())
+        tail = (f"{len(result.violations)} violation(s), "
+                f"{result.suppressed} allowed inline, "
+                f"{result.baselined} baselined, "
+                f"{result.files_scanned} files")
+        for note in result.notes:
+            print(f"note: {note}")
+        print(tail if result.violations else f"clean: {tail}")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

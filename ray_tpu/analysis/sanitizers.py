@@ -1,0 +1,504 @@
+"""Runtime sanitizers: the dynamic halves of the graftlint invariants.
+
+:class:`PickleSanitizer` proves the zero-pickle property at test time the
+way tsan proves data-race freedom: hook the primitive, attribute every
+call to its call site, and let the test assert over a scoped window. It
+subsumes the old per-test plumbing of ``serialization.counter_snapshot``
+/ ``counter_delta`` pairs — one fixture, and every event comes with the
+``file:line`` that pickled, so a failing zero-pickle test names the
+regressing call site instead of printing a bare counter delta.
+
+:class:`LockOrderSanitizer` wraps ``threading.Lock`` for the duration of
+a test, records which locks each thread holds while acquiring others,
+and reports lock-order inversions (cycles in the cross-thread
+acquisition graph) with BOTH acquisition stacks. The router control
+loop, checkpoint persister, and collective tx threads all hold locks
+concurrently; an inversion between them is a deadlock that strikes under
+load, not under test — unless the order graph itself is checked.
+
+Both sanitizers patch process-global primitives, so they are scoped:
+install on ``__enter__``, restore on ``__exit__``, refcounted so nested
+windows (e.g. a test window around an actor that opens its own) compose.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import pickle
+import sys
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.analysis.graftlint import HOT_PATHS, _ALLOW_RE
+from ray_tpu.core import serialization as _ser
+
+_THIS_FILE = os.path.abspath(__file__)
+
+# Lines carrying (or directly under) an inline `# graftlint:
+# allow[hot-pickle]` comment, per absolute source path. The sanitizer
+# honors the SAME waivers as the static lint: a justified control-frame
+# codec on a hot-path module is not a hot event at runtime either.
+_allow_cache: Dict[str, frozenset] = {}
+
+
+def _hot_allowed_lines(abs_path: str) -> frozenset:
+    cached = _allow_cache.get(abs_path)
+    if cached is None:
+        lines = set()
+        try:
+            with open(abs_path, encoding="utf-8") as fh:
+                for i, text in enumerate(fh, start=1):
+                    m = _ALLOW_RE.search(text)
+                    if m and "hot-pickle" in m.group(1):
+                        lines.update((i, i + 1))
+        except OSError:
+            pass
+        cached = frozenset(lines)
+        _allow_cache[abs_path] = cached
+    return cached
+
+
+def _rel_site(filename: str) -> str:
+    """Normalize an absolute frame filename to a repo-relative path when
+    it lives under the ray_tpu package (so hot-path matching and test
+    assertions are location-independent)."""
+    norm = filename.replace(os.sep, "/")
+    idx = norm.rfind("/ray_tpu/")
+    if idx >= 0:
+        return norm[idx + 1:]
+    return norm
+
+
+def _is_hot(site: str) -> bool:
+    return site in HOT_PATHS
+
+
+@dataclass
+class PickleEvent:
+    op: str        # dumps | loads | dump | load
+    site: str      # repo-relative file of the innermost ray_tpu frame
+    line: int
+    function: str
+    hot: bool
+
+    def render(self) -> str:
+        flag = " [HOT PATH]" if self.hot else ""
+        return f"pickle.{self.op} at {self.site}:{self.line} " \
+               f"(in {self.function}){flag}"
+
+    def to_dict(self) -> dict:
+        return {"op": self.op, "site": self.site, "line": self.line,
+                "function": self.function, "hot": self.hot}
+
+
+# ---------------------------------------------------------- pickle hook
+#
+# One process-global patch shared by every open window. pickle.dumps &
+# co. are rebound on the pickle MODULE, so call sites that do
+# `import pickle; pickle.dumps(...)` (the codebase idiom) route through
+# the hook; cloudpickle is hooked the same way when present. The patch
+# is installed only while at least one window is open.
+
+_patch_lock = threading.Lock()
+_active_windows: List["Window"] = []
+_originals: Dict[Tuple[Any, str], Any] = {}
+
+
+def _call_site() -> Tuple[str, str, int, str]:
+    """(abs_path, rel_site, line, function) of the innermost ray_tpu
+    frame below the hook (falling back to the innermost non-pickle frame,
+    e.g. a test function)."""
+    f = sys._getframe(2)
+    first = None
+    while f is not None:
+        fn = f.f_code.co_filename
+        base = os.path.basename(fn)
+        if os.path.abspath(fn) != _THIS_FILE and "pickle" not in base:
+            if first is None:
+                first = f
+            rel = _rel_site(fn)
+            if rel.startswith("ray_tpu/"):
+                return (os.path.abspath(fn), rel, f.f_lineno,
+                        f.f_code.co_name)
+        f = f.f_back
+    if first is not None:
+        fn = first.f_code.co_filename
+        return (os.path.abspath(fn), _rel_site(fn), first.f_lineno,
+                first.f_code.co_name)
+    return "<unknown>", "<unknown>", 0, "<unknown>"
+
+
+def _record(op: str) -> None:
+    abs_path, site, line, func = _call_site()
+    hot = (_is_hot(site)
+           and line not in _hot_allowed_lines(abs_path))
+    event = PickleEvent(op=op, site=site, line=line, function=func,
+                        hot=hot)
+    for w in list(_active_windows):
+        w.events.append(event)
+
+
+def _make_hook(op: str, original):
+    def hook(*args, **kwargs):
+        _record(op)
+        return original(*args, **kwargs)
+
+    hook.__name__ = f"_sanitized_{op}"
+    return hook
+
+
+def _install() -> None:
+    targets: List[Tuple[Any, str]] = [(pickle, n)
+                                      for n in ("dumps", "loads",
+                                                "dump", "load")]
+    cp = sys.modules.get("cloudpickle")
+    if cp is not None:
+        targets.extend((cp, n) for n in ("dumps", "dump"))
+    for mod, name in targets:
+        original = getattr(mod, name)
+        _originals[(mod, name)] = original
+        setattr(mod, name, _make_hook(name, original))
+
+
+def _uninstall() -> None:
+    for (mod, name), original in _originals.items():
+        setattr(mod, name, original)
+    _originals.clear()
+
+
+class Window:
+    """A scoped pickle-observation window.
+
+    Usable as a pytest-fixture product (``pickle_sanitizer.window()``)
+    or standalone inside a remote actor (``with pickle_window() as w``).
+    Events and counter deltas remain readable after ``__exit__``;
+    :meth:`summary` returns a plain-dict form that crosses the actor
+    boundary without dragging the sanitizer along.
+    """
+
+    def __init__(self) -> None:
+        self.events: List[PickleEvent] = []
+        self._since: Dict[str, int] = {}
+        self._counters: Optional[Dict[str, int]] = None
+
+    def __enter__(self) -> "Window":
+        self._since = _ser.counter_snapshot()
+        with _patch_lock:
+            if not _active_windows:
+                _install()
+            _active_windows.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._counters = _ser.counter_delta(self._since)
+        with _patch_lock:
+            if self in _active_windows:
+                _active_windows.remove(self)
+            if not _active_windows:
+                _uninstall()
+
+    @property
+    def counters(self) -> Dict[str, int]:
+        """Serialization-counter delta over the window (live while the
+        window is open, frozen at exit)."""
+        if self._counters is not None:
+            return self._counters
+        return _ser.counter_delta(self._since)
+
+    @property
+    def hot_events(self) -> List[PickleEvent]:
+        return [e for e in self.events if e.hot]
+
+    def assert_zero_pickle(self) -> None:
+        """The steady-state invariant: no slow-path value pickling and no
+        pickle call attributed to a hot-path module inside the window."""
+        c = self.counters
+        problems = []
+        if c.get("pickle", 0):
+            problems.append(
+                f"{c['pickle']} slow-path serialize() pickle(s)")
+        if c.get("deserialize_pickle", 0):
+            problems.append(
+                f"{c['deserialize_pickle']} slow-path deserialize(s)")
+        hot = self.hot_events
+        if hot:
+            sites = "\n  ".join(e.render() for e in hot)
+            problems.append(f"{len(hot)} hot-path pickle call(s):\n  "
+                            f"{sites}")
+        assert not problems, (
+            "zero-pickle window violated: " + "; ".join(problems))
+
+    def summary(self) -> dict:
+        """Plain-dict snapshot, safe to return across an actor boundary."""
+        return {
+            "counters": dict(self.counters),
+            "events": [e.to_dict() for e in self.events],
+            "hot_sites": sorted({f"{e.site}:{e.line}"
+                                 for e in self.events if e.hot}),
+            "pickle_calls": len(self.events),
+        }
+
+
+def pickle_window() -> Window:
+    """Standalone window — importable inside a remote actor method."""
+    return Window()
+
+
+class PickleSanitizer:
+    """Fixture-facing handle: mints windows and keeps them for teardown
+    reporting. One sanitizer per test; windows may nest or repeat."""
+
+    def __init__(self) -> None:
+        self.windows: List[Window] = []
+
+    def window(self) -> Window:
+        w = Window()
+        self.windows.append(w)
+        return w
+
+    def close(self) -> None:
+        # Belt and braces: a test that leaks an open window must not
+        # leave pickle patched for the rest of the session.
+        with _patch_lock:
+            for w in self.windows:
+                if w in _active_windows:
+                    _active_windows.remove(w)
+            if not _active_windows:
+                _uninstall()
+
+
+# ------------------------------------------------------ lock-order hook
+
+def _creation_site() -> str:
+    f = sys._getframe(2)
+    while f is not None:
+        if os.path.abspath(f.f_code.co_filename) != _THIS_FILE:
+            return f"{_rel_site(f.f_code.co_filename)}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+@dataclass
+class _Edge:
+    """First observed held->acquired ordering, with both stacks."""
+
+    src: str            # name (creation site) of the held lock
+    dst: str            # name of the lock being acquired
+    thread: str
+    src_stack: List[str]   # where the held lock was acquired
+    dst_stack: List[str]   # where the new lock is being acquired
+
+
+@dataclass
+class LockInversion:
+    cycle: List[str]                  # lock names forming the cycle
+    edges: List[_Edge] = field(default_factory=list)
+
+    def render(self) -> str:
+        lines = ["lock-order inversion: "
+                 + " -> ".join(self.cycle + [self.cycle[0]])]
+        for e in self.edges:
+            lines.append(
+                f"  thread {e.thread!r} acquired {e.dst} while holding "
+                f"{e.src}:")
+            lines.append(f"    {e.src} acquired at:")
+            lines.extend(f"      {ln}" for ln in e.src_stack)
+            lines.append(f"    {e.dst} acquired at:")
+            lines.extend(f"      {ln}" for ln in e.dst_stack)
+        return "\n".join(lines)
+
+
+_lock_seq = itertools.count(1)
+
+
+class _TrackedLock:
+    """Drop-in for the object returned by ``threading.Lock()``."""
+
+    def __init__(self, sanitizer: "LockOrderSanitizer", site: str):
+        self._lock = sanitizer._real_lock_factory()
+        self._sanitizer = sanitizer
+        # Graph nodes are lock INSTANCES, displayed by creation site.
+        # Keying by site alone would merge distinct locks born on one
+        # line (a, b = Lock(), Lock()) into a single node, turning one
+        # thread's nested acquire into a self-edge "cycle".
+        self.name = f"{site}#{next(_lock_seq)}"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # Record the intent BEFORE blocking: a real deadlock never
+        # returns from acquire, and the whole point is to report the
+        # ordering that caused it.
+        self._sanitizer._on_acquire_attempt(self)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._sanitizer._on_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        self._sanitizer._on_release(self)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<TrackedLock {self.name}>"
+
+
+def _thread_name() -> str:
+    """Current thread's name WITHOUT threading.current_thread(): that
+    call materializes a _DummyThread for a not-yet-registered thread,
+    whose __init__ sets an Event — acquiring a tracked lock and
+    re-entering this hook forever. Reading _active directly is what
+    faulthandler does for the same reason."""
+    ident = threading.get_ident()
+    t = threading._active.get(ident)
+    return t.name if t is not None else f"thread-{ident}"
+
+
+def _stack_lines(skip: int = 2) -> List[str]:
+    frames = traceback.extract_stack()[:-skip]
+    out = []
+    for fr in frames:
+        fn = _rel_site(fr.filename)
+        if os.path.abspath(fr.filename) == _THIS_FILE:
+            continue
+        out.append(f"{fn}:{fr.lineno} in {fr.name}")
+    return out[-8:]  # innermost 8 non-sanitizer frames
+
+
+class LockOrderSanitizer:
+    """Scoped ``threading.Lock`` wrapper that builds the cross-thread
+    lock-order graph and reports cycles.
+
+    Usage (typically via the ``lock_sanitizer`` fixture)::
+
+        with LockOrderSanitizer() as san:
+            ... run the threads under test ...
+        san.assert_no_inversions()
+
+    Locks created while the sanitizer is installed are tracked; each
+    acquisition while another tracked lock is held adds a held->acquired
+    edge tagged with the acquiring thread and both acquisition stacks.
+    A cycle in the edge graph is an ordering that can deadlock under the
+    right interleaving — reported even if this run got lucky.
+    """
+
+    def __init__(self) -> None:
+        self._real_lock_factory = None
+        self._tls = threading.local()
+        # (src_name, dst_name) -> first observed _Edge. Mutated under
+        # _graph_lock: a REAL lock allocated before patching.
+        self._edges: Dict[Tuple[str, str], _Edge] = {}
+        self._graph_lock = threading.Lock()
+        self._installed = False
+
+    # -- patch lifecycle -------------------------------------------------
+
+    def __enter__(self) -> "LockOrderSanitizer":
+        self._real_lock_factory = threading.Lock
+        sanitizer = self
+
+        def _tracked_lock_factory():
+            return _TrackedLock(sanitizer, _creation_site())
+
+        threading.Lock = _tracked_lock_factory
+        self._installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._installed:
+            threading.Lock = self._real_lock_factory
+            self._installed = False
+
+    # -- acquisition tracking --------------------------------------------
+
+    def _held(self) -> List[Tuple[_TrackedLock, List[str]]]:
+        if not hasattr(self._tls, "held"):
+            self._tls.held = []
+        return self._tls.held
+
+    def _on_acquire_attempt(self, lock: _TrackedLock) -> None:
+        stack = _stack_lines(skip=3)
+        thread = _thread_name()
+        for held, held_stack in self._held():
+            if held is lock:
+                continue
+            key = (held.name, lock.name)
+            with self._graph_lock:
+                if key not in self._edges:
+                    self._edges[key] = _Edge(
+                        src=held.name, dst=lock.name, thread=thread,
+                        src_stack=held_stack, dst_stack=stack)
+
+    def _on_acquired(self, lock: _TrackedLock) -> None:
+        self._held().append((lock, _stack_lines(skip=3)))
+
+    def _on_release(self, lock: _TrackedLock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is lock:
+                del held[i]
+                return
+
+    # -- analysis --------------------------------------------------------
+
+    def inversions(self) -> List[LockInversion]:
+        with self._graph_lock:
+            edges = dict(self._edges)
+        graph: Dict[str, List[str]] = {}
+        for (src, dst) in edges:
+            graph.setdefault(src, []).append(dst)
+            graph.setdefault(dst, [])
+        # DFS back-edge detection; each distinct cycle reported once.
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in graph}
+        path: List[str] = []
+        cycles: List[List[str]] = []
+        seen: set = set()
+
+        def visit(n: str) -> None:
+            color[n] = GREY
+            path.append(n)
+            for m in graph[n]:
+                if color[m] == GREY:
+                    cycle = path[path.index(m):]
+                    key = frozenset(cycle)
+                    if key not in seen:
+                        seen.add(key)
+                        cycles.append(list(cycle))
+                elif color[m] == WHITE:
+                    visit(m)
+            path.pop()
+            color[n] = BLACK
+
+        for n in sorted(graph):
+            if color[n] == WHITE:
+                visit(n)
+        out = []
+        for cycle in cycles:
+            inv = LockInversion(cycle=cycle)
+            for i, src in enumerate(cycle):
+                dst = cycle[(i + 1) % len(cycle)]
+                if (src, dst) in edges:
+                    inv.edges.append(edges[(src, dst)])
+            out.append(inv)
+        return out
+
+    def report(self) -> str:
+        invs = self.inversions()
+        if not invs:
+            return "lock-order: no inversions detected"
+        return "\n\n".join(inv.render() for inv in invs)
+
+    def assert_no_inversions(self) -> None:
+        invs = self.inversions()
+        assert not invs, self.report()

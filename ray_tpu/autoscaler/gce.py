@@ -422,7 +422,8 @@ def start_gce_fake(port: int = 0):
     state = _FakeState()
     handler = type("Handler", (_FakeHandler,), {"state": state})
     server = ThreadingHTTPServer(("127.0.0.1", port), handler)
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="gce-fake-http")
     thread.start()
     host, bound = server.server_address
     return server, f"http://{host}:{bound}", state

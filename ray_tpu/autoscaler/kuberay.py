@@ -359,7 +359,7 @@ class FakeKubeApi:
                                                       Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
+                                        daemon=True, name="kuberay-fake-http")
         self._thread.start()
 
     @property

@@ -172,7 +172,7 @@ class KVStreamServer:
             except OSError:
                 return  # closed
             threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
+                             daemon=True, name="kv-handoff-conn").start()
 
     def _handle(self, conn: socket.socket):
         with conn:

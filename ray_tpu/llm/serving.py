@@ -209,7 +209,8 @@ class LLMServer:
         # migrate_sessions moves the live ones out.
         self._draining = False
         self._sessions_migrated_out = 0
-        self._loop = threading.Thread(target=self._engine_loop, daemon=True)
+        self._loop = threading.Thread(target=self._engine_loop, daemon=True,
+                                      name=f"llm-engine-{self._replica_tag}")
         self._loop.start()
 
     def _bind_gauges(self):

@@ -245,6 +245,23 @@ def cmd_microbenchmark(args):
     microbenchmark.main(scale=args.scale, as_json=args.json)
 
 
+def cmd_lint(args):
+    from ray_tpu.analysis import graftlint
+
+    lint_args = []
+    if args.json:
+        lint_args.append("--json")
+    if args.root:
+        lint_args.extend(["--root", args.root])
+    if args.baseline:
+        lint_args.extend(["--baseline", args.baseline])
+    for rule in args.rule or ():
+        lint_args.extend(["--rule", rule])
+    if args.list_rules:
+        lint_args.append("--list-rules")
+    sys.exit(graftlint.main(lint_args))
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -349,6 +366,23 @@ def main(argv=None):
     p.add_argument("--scale", type=float, default=1.0)
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_microbenchmark)
+
+    p = sub.add_parser("lint",
+                       help="graftlint: project-invariant static analysis "
+                            "(zero-pickle hot paths, actor-init blocking, "
+                            "wire schema, registries); exits nonzero on "
+                            "violations")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    p.add_argument("--root", default=None,
+                   help="repository root to lint (default: the tree the "
+                        "installed ray_tpu package lives in)")
+    p.add_argument("--baseline", default=None,
+                   help="override the shipped baseline file")
+    p.add_argument("--rule", action="append", default=None,
+                   help="run only this rule (repeatable)")
+    p.add_argument("--list-rules", action="store_true")
+    p.set_defaults(fn=cmd_lint)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -28,7 +28,8 @@ class ServeController:
         self.version = 0
         self._lock = threading.Lock()
         self._autoscale_thread = threading.Thread(
-            target=self._autoscale_loop, daemon=True)
+            target=self._autoscale_loop, daemon=True,
+            name="serve-autoscale")
         self._autoscale_thread.start()
 
     def deploy(self, name: str, target_payload: bytes, config: dict,

@@ -39,7 +39,8 @@ class LocalDeploymentResponse:
             except BaseException as e:  # delivered to .result()
                 self._q.put((False, e))
 
-        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="serve-local-call")
         self._thread.start()
 
     def result(self, timeout: Optional[float] = 60.0,

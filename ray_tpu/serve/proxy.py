@@ -25,7 +25,8 @@ class HTTPProxyActor:
         self.host = host
         self.port = port
         self._ready = threading.Event()
-        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="serve-http-proxy")
         self._thread.start()
         self._ready.wait(timeout=30)
 

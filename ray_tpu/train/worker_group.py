@@ -77,7 +77,8 @@ class TrainWorker:
                     pass
                 self.session.finished.set()
 
-        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread = threading.Thread(target=run, daemon=True,
+                                       name="train-driver")
         self.thread.start()
         return True
 

@@ -55,7 +55,8 @@ class TrialRunner:
             finally:
                 self.session.finished.set()
 
-        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread = threading.Thread(target=run, daemon=True,
+                                       name=f"tune-trial-{self.trial_id}")
         self.thread.start()
         return True
 

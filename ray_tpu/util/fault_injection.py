@@ -44,7 +44,8 @@ class NodeKiller:
         self._thread: Optional[threading.Thread] = None
 
     def start(self):
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="chaos-node-killer")
         self._thread.start()
         return self
 
@@ -302,7 +303,8 @@ class GcsKiller:
         self._thread: Optional[threading.Thread] = None
 
     def start(self):
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="chaos-gcs-killer")
         self._thread.start()
         return self
 

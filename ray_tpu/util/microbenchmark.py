@@ -358,7 +358,8 @@ def _bench_collectives(scale: float) -> List[Dict]:
             comms[r] = TCPCommunicator(r, world, name, kv_put, kv_get,
                                        timeout=60, **kwargs)
 
-        ts = [threading.Thread(target=build, args=(r,)) for r in range(world)]
+        ts = [threading.Thread(target=build, args=(r,), daemon=True,
+                               name=f"bench-build-{r}") for r in range(world)]
         for t in ts:
             t.start()
         for t in ts:
@@ -375,7 +376,8 @@ def _bench_collectives(scale: float) -> List[Dict]:
             except BaseException as e:  # pragma: no cover
                 errs.append(e)
 
-        ts = [threading.Thread(target=run_rank, args=(c,)) for c in comms]
+        ts = [threading.Thread(target=run_rank, args=(c,), daemon=True,
+                               name=f"bench-rank-{c.rank}") for c in comms]
         for t in ts:
             t.start()
         for t in ts:
@@ -546,8 +548,9 @@ def _bench_serve_mixed(scale: float) -> List[Dict]:
         # Two pressure threads keep a long prefill in flight continuously —
         # a lone thread leaves idle windows between requests that let the
         # colocated leg decode unimpeded and corrupt the comparison.
-        ts = [threading.Thread(target=pressure, daemon=True)
-              for _ in range(2)]
+        ts = [threading.Thread(target=pressure, daemon=True,
+                               name=f"bench-pressure-{i}")
+              for i in range(2)]
         gen = server.completions_stream(
             {"prompt": [3, 1, 4, 1, 5], "max_tokens": chatty_tokens})
         next(gen)                  # chatty decoding before pressure starts
@@ -720,7 +723,8 @@ def _bench_serve_resilience(scale: float) -> List[Dict]:
         req = {"prompt": prompt(trial + 7, ctx_tokens), "max_tokens": 64,
                "request_id": rid}
         th = threading.Thread(target=lambda r=dict(req):
-                              _swallow(src.completions, r), daemon=True)
+                              _swallow(src.completions, r), daemon=True,
+                              name=f"bench-migrate-src-{trial}")
         th.start()
         deadline = time.monotonic() + 30
         while (src.engine_stats()["running"] < 1

@@ -268,7 +268,8 @@ def run_async(dag: DAGNode, **kw):
 
     workflow_id = kw.setdefault(
         "workflow_id", f"workflow-{int(time.time())}-{os.getpid()}")
-    t = threading.Thread(target=lambda: _swallow(run, dag, **kw), daemon=True)
+    t = threading.Thread(target=lambda: _swallow(run, dag, **kw), daemon=True,
+                         name=f"workflow-{workflow_id}")
     t.start()
     return workflow_id
 

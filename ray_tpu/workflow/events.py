@@ -156,7 +156,7 @@ class HTTPEventProvider:
 
         self._srv = ThreadingHTTPServer((host, port), Handler)
         self._thread = threading.Thread(target=self._srv.serve_forever,
-                                        daemon=True)
+                                        daemon=True, name="workflow-events-http")
         self._thread.start()
 
     @property

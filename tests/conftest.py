@@ -64,3 +64,29 @@ def cpu_jax():
     assert jax.default_backend() == "cpu"
     assert len(jax.devices()) == 8
     return jax
+
+
+@pytest.fixture
+def pickle_sanitizer():
+    """Scoped pickle observation: `w = pickle_sanitizer.window()` opens a
+    window (`with w: ...`) during which every pickle.dumps/loads in the
+    process is attributed to its call site; `w.assert_zero_pickle()` is
+    the steady-state proof. Replaces per-test counter_snapshot plumbing."""
+    from ray_tpu.analysis.sanitizers import PickleSanitizer
+
+    san = PickleSanitizer()
+    try:
+        yield san
+    finally:
+        san.close()
+
+
+@pytest.fixture
+def lock_sanitizer():
+    """Wraps threading.Lock for the test; locks created inside the window
+    are tracked and `san.assert_no_inversions()` fails on any cross-thread
+    lock-order cycle, reporting both acquisition stacks."""
+    from ray_tpu.analysis.sanitizers import LockOrderSanitizer
+
+    with LockOrderSanitizer() as san:
+        yield san

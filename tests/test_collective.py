@@ -280,35 +280,33 @@ def test_ring_allgather_broadcast_reducescatter(ring_cfg):
         _close_all(comms)
 
 
-def test_ring_zero_pickle_steady_state(ring_cfg):
+def test_ring_zero_pickle_steady_state(ring_cfg, pickle_sanitizer):
     """Acceptance: after the p2p links warm up, a ring allreduce moves ONLY
-    raw array frames — the serialization pickle counters must not move. The
+    raw array frames — the pickle sanitizer window must stay empty. The
     hub plane (topology="hub") on the same payload pickles every hop,
-    proving the counters would catch a regression."""
-    from ray_tpu.core import serialization as ser
-
+    proving the sanitizer would catch (and attribute) a regression."""
     comms = _thread_group("ring-nopickle", 4, *_mem_kv())
     try:
         payload = np.ones(4096, np.float32)  # 16 KiB -> 32 chunks of 512 B
         _run_ranks(comms, lambda c: c.allreduce(payload, "sum"))  # warm links
-        snap = ser.counter_snapshot()
-        for _ in range(3):  # steady state
-            _run_ranks(comms, lambda c: c.allreduce(payload, "sum"))
-        delta = ser.counter_delta(snap)
-        assert delta.get("pickle", 0) == 0, delta
-        assert delta.get("deserialize_pickle", 0) == 0, delta
-        assert delta.get("fast_ndarray", 0) > 0, delta
-        assert delta.get("deserialize_fast", 0) > 0, delta
+        with pickle_sanitizer.window() as w:
+            for _ in range(3):  # steady state
+                _run_ranks(comms, lambda c: c.allreduce(payload, "sum"))
+        w.assert_zero_pickle()
+        assert w.counters["fast_ndarray"] > 0, w.counters
+        assert w.counters["deserialize_fast"] > 0, w.counters
     finally:
         _close_all(comms)
 
     hub = _thread_group("hub-pickles", 4, *_mem_kv(), topology="hub")
     try:
         _run_ranks(hub, lambda c: c.allreduce(payload, "sum"))
-        snap = ser.counter_snapshot()
-        _run_ranks(hub, lambda c: c.allreduce(payload, "sum"))
-        delta = ser.counter_delta(snap)
-        assert delta.get("pickle", 0) > 0, delta  # the contrast
+        with pickle_sanitizer.window() as w:
+            _run_ranks(hub, lambda c: c.allreduce(payload, "sum"))
+        assert w.counters["pickle"] > 0, w.counters  # the contrast
+        # ... and the sanitizer names the hub's codec as the call site.
+        assert any(e.site == "ray_tpu/collective/cpu_group.py"
+                   for e in w.events), [e.render() for e in w.events]
     finally:
         _close_all(hub)
 

@@ -103,15 +103,19 @@ def test_pinned_buffer_survives_base_chain_collapse():
 @ray_tpu.remote
 class ChannelReader:
     def read_one(self, ch):
-        base = serialization.counter_snapshot()
-        value = ch.read(timeout=60)
-        delta = serialization.counter_delta(base)
+        # The sanitizer window works inside a remote actor: summary() is a
+        # plain dict that crosses the boundary without the sanitizer.
+        from ray_tpu.analysis.sanitizers import pickle_window
+
+        with pickle_window() as w:
+            value = ch.read(timeout=60)
         ch.close_read()
         ch.drain()
-        return float(np.asarray(value).sum()), delta
+        return float(np.asarray(value).sum()), w.summary()
 
 
-def test_device_channel_zero_pickle_both_ends(cluster, cpu_jax):
+def test_device_channel_zero_pickle_both_ends(cluster, cpu_jax,
+                                              pickle_sanitizer):
     import jax.numpy as jnp
 
     from ray_tpu.dag.device_channel import DeviceChannel
@@ -120,17 +124,19 @@ def test_device_channel_zero_pickle_both_ends(cluster, cpu_jax):
     reader = ChannelReader.remote()
     ref = reader.read_one.remote(ch)
     payload = jnp.ones((1 << 16,), dtype=jnp.float32)
-    base = serialization.counter_snapshot()
-    ch.write(payload, timeout=60)
-    write_delta = serialization.counter_delta(base)
-    total, read_delta = ray_tpu.get(ref, timeout=120)
+    with pickle_sanitizer.window() as w:
+        ch.write(payload, timeout=60)
+    total, read_summary = ray_tpu.get(ref, timeout=120)
     assert total == float(1 << 16)
-    # Writer: one fast device encode, no pickle of the payload.
-    assert write_delta["pickle"] == 0
-    assert write_delta["fast_device"] == 1
+    # Writer: one fast device encode, no pickle of the payload, and no
+    # pickle event attributed to the device-channel hot path.
+    w.assert_zero_pickle()
+    assert w.counters["fast_device"] == 1, w.counters
     # Reader: one fast decode, no pickle.
-    assert read_delta["deserialize_pickle"] == 0
-    assert read_delta["deserialize_fast"] == 1
+    rc = read_summary["counters"]
+    assert rc["deserialize_pickle"] == 0, read_summary
+    assert rc["deserialize_fast"] == 1, read_summary
+    assert read_summary["hot_sites"] == [], read_summary
     ray_tpu.kill(reader)
 
 

@@ -293,7 +293,8 @@ def test_summarize_objects_and_memory_cli(cluster, capsys):
 
 
 _CLI_SUBCOMMANDS = ("start", "job", "timeline", "events", "status", "list",
-                    "memory", "stack", "drain", "stop", "microbenchmark")
+                    "memory", "stack", "drain", "stop", "microbenchmark",
+                    "lint")
 
 
 @pytest.mark.parametrize("cmd", ("",) + _CLI_SUBCOMMANDS)

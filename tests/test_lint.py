@@ -1,0 +1,544 @@
+"""graftlint + sanitizers: every analysis pass proven positive AND
+negative against seeded mini-repos, plus the fast-tier gate that keeps
+the real tree clean.
+
+Mini-repos are written under tmp_path with the same layout the linter
+expects of the real repository (ray_tpu/, tests/, docs/) and linted via
+LintConfig(root=tmp_path) — the passes are pure AST, so nothing is
+imported from the seeded files.
+"""
+
+import importlib.util
+import json
+import os
+import pickle
+import textwrap
+import threading
+
+import pytest
+
+from ray_tpu.analysis.graftlint import LintConfig, run
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(root, rel, text):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(text))
+    return path
+
+
+def _line_of(text, needle):
+    for i, line in enumerate(textwrap.dedent(text).splitlines(), start=1):
+        if needle in line:
+            return i
+    raise AssertionError(f"needle {needle!r} not in seeded source")
+
+
+def _lint(root, **kw):
+    return run(config=LintConfig(root=str(root)), **kw)
+
+
+def _only(result, rule):
+    return [v for v in result.violations if v.rule == rule]
+
+
+# ------------------------------------------------------------ hot-pickle
+
+HOT_SRC = """\
+    import pickle
+
+    def encode(obj):
+        return pickle.dumps(obj)
+    """
+
+
+def test_hot_pickle_positive(tmp_path):
+    _write(tmp_path, "ray_tpu/runtime/rpc.py", HOT_SRC)
+    res = _lint(tmp_path)
+    (v,) = _only(res, "hot-pickle")
+    assert v.path == "ray_tpu/runtime/rpc.py"
+    assert v.line == _line_of(HOT_SRC, "pickle.dumps")
+    assert "pickle.dumps" in v.message
+
+
+def test_hot_pickle_negative_outside_hot_path(tmp_path):
+    # Same code in a NON-hot module: pickle is fine there.
+    _write(tmp_path, "ray_tpu/util/cache.py", HOT_SRC)
+    res = _lint(tmp_path)
+    assert not _only(res, "hot-pickle")
+
+
+def test_hot_pickle_inline_allow_suppresses(tmp_path):
+    _write(tmp_path, "ray_tpu/runtime/rpc.py", """\
+        import pickle
+
+        def encode(obj):
+            # graftlint: allow[hot-pickle] control frames only
+            return pickle.dumps(obj)
+        """)
+    res = _lint(tmp_path)
+    assert not _only(res, "hot-pickle")
+    assert res.suppressed == 1
+
+
+def test_hot_pickle_baseline_suppresses(tmp_path):
+    _write(tmp_path, "ray_tpu/runtime/rpc.py", HOT_SRC)
+    line = _line_of(HOT_SRC, "pickle.dumps")
+    baseline = _write(tmp_path, "lint_baseline.txt",
+                      f"hot-pickle ray_tpu/runtime/rpc.py:{line}\n")
+    res = _lint(tmp_path, baseline_path=str(baseline))
+    assert not _only(res, "hot-pickle")
+    assert res.baselined == 1
+
+
+def test_hot_pickle_sees_aliased_import(tmp_path):
+    src = """\
+        import cloudpickle as cp
+
+        def encode(obj):
+            return cp.dumps(obj)
+        """
+    _write(tmp_path, "ray_tpu/llm/disagg.py", src)
+    res = _lint(tmp_path)
+    (v,) = _only(res, "hot-pickle")
+    assert v.line == _line_of(src, "cp.dumps")
+
+
+# --------------------------------------------------- actor-init-blocking
+
+INIT_SRC = """\
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Router:
+        def __init__(self, deployment):
+            self.handles = ray_tpu.get(deployment.replicas.remote())
+
+        def route(self, deployment):
+            return ray_tpu.get(deployment.replicas.remote())
+    """
+
+
+def test_actor_init_blocking_positive(tmp_path):
+    _write(tmp_path, "ray_tpu/llm/router2.py", INIT_SRC)
+    res = _lint(tmp_path)
+    # Only the __init__ call is flagged — route() may block freely.
+    (v,) = _only(res, "actor-init-blocking")
+    assert v.path == "ray_tpu/llm/router2.py"
+    assert v.line == _line_of(INIT_SRC, "self.handles")
+    assert "Router.__init__" in v.message
+
+
+def test_actor_init_blocking_via_self_helper(tmp_path):
+    src = """\
+        import ray_tpu
+
+        @ray_tpu.remote
+        class Router:
+            def __init__(self):
+                self._resolve()
+
+            def _resolve(self):
+                self.h = ray_tpu.get(None)
+        """
+    _write(tmp_path, "ray_tpu/llm/router3.py", src)
+    res = _lint(tmp_path)
+    (v,) = _only(res, "actor-init-blocking")
+    assert v.line == _line_of(src, "ray_tpu.get")
+    assert "via self._resolve()" in v.message
+
+
+def test_actor_init_blocking_negative_plain_class(tmp_path):
+    # No @remote/@deployment decorator: a plain class may block in
+    # __init__ (nothing is constructing it over the control plane).
+    _write(tmp_path, "ray_tpu/llm/router4.py", """\
+        import ray_tpu
+
+        class Plain:
+            def __init__(self, ref):
+                self.v = ray_tpu.get(ref)
+        """)
+    assert not _only(_lint(tmp_path), "actor-init-blocking")
+
+
+# ----------------------------------------------------------- wire schema
+
+WIRE_SRC = """\
+    class FooMsg(Message):
+        b = Field(2, INT)
+        a = Field(1, STR)
+
+    class BarMsg(Message):
+        x = Field(1, INT, default=[])
+        y = Field(1, STR)
+    """
+
+
+def test_wire_field_order_and_default(tmp_path):
+    _write(tmp_path, "ray_tpu/runtime/wire.py", WIRE_SRC)
+    res = _lint(tmp_path)
+    order = _only(res, "wire-field-order")
+    assert {v.line for v in order} == {_line_of(WIRE_SRC, "a = Field(1"),
+                                      _line_of(WIRE_SRC, "y = Field(1")}
+    assert any("declared after" in v.message for v in order)
+    assert any("duplicate field number" in v.message for v in order)
+    (dflt,) = _only(res, "wire-field-default")
+    assert dflt.line == _line_of(WIRE_SRC, "default=[]")
+
+
+def test_wire_roundtrip_registry_gate(tmp_path):
+    _write(tmp_path, "ray_tpu/runtime/wire.py", """\
+        class FooMsg(Message):
+            a = Field(1, STR)
+
+        class BarMsg(Message):
+            b = Field(1, INT)
+        """)
+    _write(tmp_path, "tests/test_wire_schema.py", """\
+        WIRE_ROUNDTRIP_REGISTRY = {
+            "FooMsg": None,
+        }
+        """)
+    res = _lint(tmp_path)
+    (v,) = _only(res, "wire-roundtrip")
+    assert "BarMsg" in v.message and v.path == "ray_tpu/runtime/wire.py"
+
+
+def test_wire_clean_negative(tmp_path):
+    _write(tmp_path, "ray_tpu/runtime/wire.py", """\
+        class FooMsg(Message):
+            a = Field(1, STR)
+            b = Field(2, INT, default=-1)
+        """)
+    _write(tmp_path, "tests/test_wire_schema.py",
+           'WIRE_ROUNDTRIP_REGISTRY = {"FooMsg": None}\n')
+    res = _lint(tmp_path)
+    assert not [v for v in res.violations if v.rule.startswith("wire-")]
+
+
+# ---------------------------------------------------------------- events
+
+EVENTS_SRC = """\
+    EVENT_DOCUMENTED = "thing_happened"
+    EVENT_SECRET = "undocumented_thing"
+
+    EVENT_TYPES = (EVENT_DOCUMENTED, EVENT_SECRET)
+    """
+
+
+def test_event_docs_positive_and_negative(tmp_path):
+    _write(tmp_path, "ray_tpu/runtime/events.py", EVENTS_SRC)
+    _write(tmp_path, "docs/observability.md",
+           "| `thing_happened` | emitted when the thing happens |\n")
+    res = _lint(tmp_path)
+    (v,) = _only(res, "event-docs")
+    assert "undocumented_thing" in v.message
+    assert v.line == _line_of(EVENTS_SRC, "EVENT_SECRET")
+    # Add the row -> clean.
+    _write(tmp_path, "docs/observability.md",
+           "| `thing_happened` | ... |\n| `undocumented_thing` | ... |\n")
+    assert not _only(_lint(tmp_path), "event-docs")
+
+
+def test_event_undeclared_emit(tmp_path):
+    _write(tmp_path, "ray_tpu/runtime/events.py", EVENTS_SRC)
+    _write(tmp_path, "docs/observability.md",
+           "| `thing_happened` |\n| `undocumented_thing` |\n")
+    src = """\
+        from ray_tpu.runtime import events
+
+        def notify(bus):
+            events.emit(bus, severity="info")
+            events.emit("thing_happened")
+            events.emit("never_registered")
+        """
+    _write(tmp_path, "ray_tpu/llm/notify.py", src)
+    res = _lint(tmp_path)
+    (v,) = _only(res, "event-undeclared")
+    assert v.path == "ray_tpu/llm/notify.py"
+    assert v.line == _line_of(src, "never_registered")
+
+
+# --------------------------------------------------------------- metrics
+
+METRIC_DEFS_SRC = """\
+    from ray_tpu.util.metrics import Counter
+
+    BAD = Counter("wrong_prefix_total")
+    GOOD = Counter("ray_tpu_good_total", "a good metric",
+                   tag_keys=("op",))
+    """
+
+
+def test_metric_def_hygiene(tmp_path):
+    _write(tmp_path, "ray_tpu/runtime/metric_defs.py", METRIC_DEFS_SRC)
+    res = _lint(tmp_path)
+    bad = _only(res, "metric-def")
+    assert {v.line for v in bad} == {_line_of(METRIC_DEFS_SRC, "BAD =")}
+    assert any("ray_tpu_-prefixed" in v.message for v in bad)
+    assert any("description" in v.message for v in bad)
+
+
+def test_metric_central_and_tags(tmp_path):
+    _write(tmp_path, "ray_tpu/runtime/metric_defs.py", METRIC_DEFS_SRC)
+    rogue = """\
+        from ray_tpu.util.metrics import Counter
+
+        ROGUE = Counter("ray_tpu_rogue_total", "defined outside the table")
+        """
+    _write(tmp_path, "ray_tpu/llm/rogue.py", rogue)
+    tags = """\
+        from ray_tpu.runtime import metric_defs as md
+
+        def observe():
+            md.GOOD.inc(1, tags={"op": "x"})
+            md.GOOD.inc(1, tags={"algo": "ring"})
+        """
+    _write(tmp_path, "ray_tpu/llm/tags.py", tags)
+    res = _lint(tmp_path)
+    (central,) = _only(res, "metric-central")
+    assert central.path == "ray_tpu/llm/rogue.py"
+    assert central.line == _line_of(rogue, "ROGUE =")
+    (tagv,) = _only(res, "metric-tags")  # only the undeclared key fires
+    assert tagv.line == _line_of(tags, "algo")
+    assert "'algo'" in tagv.message
+
+
+# ---------------------------------------------------------- thread-attrs
+
+def test_thread_attrs(tmp_path):
+    src = """\
+        import threading
+
+        def spawn(fn):
+            threading.Thread(target=fn).start()
+            threading.Thread(target=fn, daemon=True,
+                             name="good-loop").start()
+        """
+    _write(tmp_path, "ray_tpu/llm/threads.py", src)
+    res = _lint(tmp_path)
+    (v,) = _only(res, "thread-attrs")
+    assert v.line == _line_of(src, "threading.Thread(target=fn).start()")
+    assert "daemon=True" in v.message and "name=" in v.message
+
+
+def test_parse_error_is_reported_not_raised(tmp_path):
+    _write(tmp_path, "ray_tpu/broken.py", "def oops(:\n")
+    res = _lint(tmp_path)
+    (v,) = _only(res, "parse-error")
+    assert v.path == "ray_tpu/broken.py"
+
+
+def test_unknown_rule_rejected(tmp_path):
+    (tmp_path / "ray_tpu").mkdir()
+    with pytest.raises(ValueError, match="unknown rules"):
+        _lint(tmp_path, rules=["no-such-rule"])
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_cli_exits_nonzero_with_attribution(tmp_path, capsys):
+    from ray_tpu import scripts
+
+    _write(tmp_path, "ray_tpu/runtime/rpc.py", HOT_SRC)
+    with pytest.raises(SystemExit) as exc:
+        scripts.main(["lint", "--root", str(tmp_path)])
+    assert exc.value.code == 1
+    out = capsys.readouterr().out
+    line = _line_of(HOT_SRC, "pickle.dumps")
+    assert f"ray_tpu/runtime/rpc.py:{line}" in out
+    assert "[hot-pickle]" in out
+
+
+def test_cli_json_output(tmp_path, capsys):
+    from ray_tpu import scripts
+
+    _write(tmp_path, "ray_tpu/runtime/rpc.py", HOT_SRC)
+    with pytest.raises(SystemExit) as exc:
+        scripts.main(["lint", "--root", str(tmp_path), "--json"])
+    assert exc.value.code == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] is False
+    (v,) = report["violations"]
+    assert v["rule"] == "hot-pickle"
+    assert v["path"] == "ray_tpu/runtime/rpc.py"
+    assert v["line"] == _line_of(HOT_SRC, "pickle.dumps")
+
+
+def test_cli_clean_tree_exits_zero(tmp_path, capsys):
+    from ray_tpu import scripts
+
+    _write(tmp_path, "ray_tpu/util/fine.py", "X = 1\n")
+    with pytest.raises(SystemExit) as exc:
+        scripts.main(["lint", "--root", str(tmp_path)])
+    assert exc.value.code == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_tree_is_clean():
+    """The CI gate: the real repository lints clean. A violation here
+    means a new unregistered frame / undocumented event / unnamed thread
+    / hot-path pickle landed without a justification."""
+    res = run(root=REPO_ROOT)
+    assert res.files_scanned > 100  # sanity: the real tree, not a stub
+    assert res.ok, "\n".join(v.render() for v in res.violations)
+
+
+# ------------------------------------------------------ pickle sanitizer
+
+def _load_fake_module(path, name):
+    spec = importlib.util.spec_from_file_location(name, str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pickle_sanitizer_attributes_hot_site(tmp_path, pickle_sanitizer):
+    # A file living under .../ray_tpu/llm/disagg.py is classified by its
+    # repo-relative path — seeding one in tmp_path simulates a hot-path
+    # regression without touching the real module.
+    src = """\
+        import pickle
+
+        def leak(obj):
+            return pickle.dumps(obj)
+        """
+    path = _write(tmp_path, "ray_tpu/llm/disagg.py", src)
+    mod = _load_fake_module(path, "fake_disagg_hot")
+    with pickle_sanitizer.window() as w:
+        mod.leak({"kv": 1})
+    (e,) = w.hot_events
+    assert e.site == "ray_tpu/llm/disagg.py"
+    assert e.line == _line_of(src, "pickle.dumps")
+    assert e.op == "dumps" and e.function == "leak"
+    with pytest.raises(AssertionError, match="hot-path pickle"):
+        w.assert_zero_pickle()
+    assert w.summary()["hot_sites"] == [f"ray_tpu/llm/disagg.py:{e.line}"]
+
+
+def test_pickle_sanitizer_honors_inline_allow(tmp_path, pickle_sanitizer):
+    path = _write(tmp_path, "ray_tpu/llm/disagg.py", """\
+        import pickle
+
+        def ctrl(obj):
+            # graftlint: allow[hot-pickle] control frames only
+            return pickle.dumps(obj)
+        """)
+    mod = _load_fake_module(path, "fake_disagg_allowed")
+    with pickle_sanitizer.window() as w:
+        mod.ctrl({"kv": 1})
+    assert len(w.events) == 1 and not w.hot_events
+    w.assert_zero_pickle()  # justified control-frame codec: not hot
+
+
+def test_pickle_sanitizer_counts_slow_path(pickle_sanitizer):
+    from ray_tpu.core import serialization
+
+    with pickle_sanitizer.window() as w:
+        serialization.serialize({"a": [1, 2, 3]})  # slow path: pickles
+    assert w.counters["pickle"] == 1
+    with pytest.raises(AssertionError, match="slow-path"):
+        w.assert_zero_pickle()
+    # Attribution points at serialization.py, NOT at a hot wire module.
+    assert all(e.site == "ray_tpu/core/serialization.py"
+               for e in w.events)
+    assert not w.hot_events
+
+
+def test_pickle_sanitizer_unpatches_after_last_window(pickle_sanitizer):
+    before = pickle.dumps
+    with pickle_sanitizer.window():
+        assert pickle.dumps is not before  # hook installed
+    assert pickle.dumps is before          # and fully removed
+
+
+# -------------------------------------------------- lock-order sanitizer
+
+def test_lock_order_inversion_reports_both_stacks(lock_sanitizer):
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def take_a_then_b():
+        with a:
+            with b:
+                pass
+
+    def take_b_then_a():
+        with b:
+            with a:
+                pass
+
+    # Run serially: the ORDER graph is cyclic even though this particular
+    # interleaving never deadlocks — exactly the case a sanitizer must
+    # catch (the unlucky interleaving strikes in production, not in CI).
+    for name, fn in (("locker-ab", take_a_then_b),
+                     ("locker-ba", take_b_then_a)):
+        t = threading.Thread(target=fn, name=name, daemon=True)
+        t.start()
+        t.join(30)
+
+    (inv,) = lock_sanitizer.inversions()
+    assert len(inv.cycle) == 2 and len(inv.edges) == 2
+    report = lock_sanitizer.report()
+    assert "lock-order inversion" in report
+    # Both threads named...
+    assert "locker-ab" in report and "locker-ba" in report
+    # ...and BOTH acquisition stacks point into this test.
+    assert report.count("acquired at:") == 4
+    assert "take_a_then_b" in report and "take_b_then_a" in report
+    assert "test_lint.py" in report
+    with pytest.raises(AssertionError, match="lock-order inversion"):
+        lock_sanitizer.assert_no_inversions()
+
+
+def test_lock_order_same_line_locks_are_distinct_nodes(lock_sanitizer):
+    # Two locks born on ONE source line must not merge into a single
+    # graph node: a nested acquire by one thread would then read as a
+    # self-edge "cycle". Graph nodes are lock instances, not sites.
+    a, b = threading.Lock(), threading.Lock()
+
+    def nested():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=nested, name="nested-0", daemon=True)
+    t.start()
+    t.join(30)
+
+    assert lock_sanitizer.inversions() == []
+    lock_sanitizer.assert_no_inversions()
+
+
+def test_lock_order_consistent_ordering_is_clean(lock_sanitizer):
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def ordered():
+        with a:
+            with b:
+                pass
+
+    for i in range(2):
+        t = threading.Thread(target=ordered, name=f"ordered-{i}",
+                             daemon=True)
+        t.start()
+        t.join(30)
+
+    assert lock_sanitizer.inversions() == []
+    lock_sanitizer.assert_no_inversions()
+
+
+def test_lock_sanitizer_restores_threading_lock():
+    from ray_tpu.analysis.sanitizers import LockOrderSanitizer
+
+    orig = threading.Lock
+    with LockOrderSanitizer():
+        assert threading.Lock is not orig
+        lock = threading.Lock()
+        with lock:                      # tracked lock is a working lock
+            assert lock.locked()
+        assert not lock.locked()
+    assert threading.Lock is orig
