@@ -108,6 +108,7 @@ HOT_PATHS: Tuple[str, ...] = (
     "ray_tpu/collective/cpu_group.py",
     "ray_tpu/dag/device_channel.py",
     "ray_tpu/llm/disagg.py",
+    "ray_tpu/llm/prefix_store.py",
     "ray_tpu/checkpoint/manifest.py",
 )
 

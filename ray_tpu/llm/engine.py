@@ -25,7 +25,7 @@ import hashlib
 import os
 import uuid
 from collections import deque
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -142,6 +142,26 @@ class BlockManager:
         self.reusable: "OrderedDict[int, None]" = OrderedDict()  # LRU
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
+        # digest -> (lora_slot, lora_name, root-anchored token prefix
+        # through that block): what the host/cluster prefix tiers
+        # (llm/prefix_store.py) need to re-address and token-verify a block
+        # after it leaves this device pool. The adapter NAME is resolved at
+        # registration time — while the owning request still pins its slot
+        # — because slot numbers are recycled across adapter loads and a
+        # spill-time resolution could attribute old KV to a new adapter.
+        self.digest_meta: Dict[bytes, Tuple[int, Optional[str],
+                                            Tuple[int, ...]]] = {}
+        # Hooks installed by LLMEngine.attach_prefix_store: spill_fn is
+        # called with (block_id, digest) just before a parked cached block
+        # is recycled — the last moment its pages are intact; lora_name_fn
+        # maps a pinned slot to its adapter name ("" = base model).
+        self.spill_fn = None
+        self.lora_name_fn = None
+
+    def _slot_name(self, lora_slot: int) -> Optional[str]:
+        if self.lora_name_fn is not None:
+            return self.lora_name_fn(lora_slot)
+        return "" if lora_slot == 0 else None
 
     def blocks_needed(self, num_tokens: int) -> int:
         return (num_tokens + self.block_size - 1) // self.block_size
@@ -155,10 +175,15 @@ class BlockManager:
     def _take_free_block(self) -> int:
         if self.free:
             return self.free.popleft()
-        # Evict the least-recently-used parked cached block.
+        # Evict the least-recently-used parked cached block — spilling it
+        # to the host prefix tier first (best-effort) while its pages are
+        # still unwritten.
         bid, _ = self.reusable.popitem(last=False)
         h = self.block_hash.pop(bid)
+        if self.spill_fn is not None:
+            self.spill_fn(bid, h)
         self.cached.pop(h, None)
+        self.digest_meta.pop(h, None)
         return bid
 
     def allocate(self, req: _Request, num_tokens: int) -> bool:
@@ -235,6 +260,22 @@ class BlockManager:
             return
         self.cached[h] = bid
         self.block_hash[bid] = h
+        self.digest_meta[h] = (
+            req.lora_slot, self._slot_name(req.lora_slot),
+            tuple(req.prompt[:(index + 1) * self.block_size]))
+
+    def register_adopted_block(self, bid: int, h: bytes, lora_slot: int,
+                               tokens: Sequence[int]) -> bool:
+        """Make a block adopted from the prefix store addressable under
+        digest `h` (the adopter already holds a refcount on `bid`). First
+        writer wins, like register_block."""
+        if not self.caching or h in self.cached or bid in self.block_hash:
+            return False
+        self.cached[h] = bid
+        self.block_hash[bid] = h
+        self.digest_meta[h] = (int(lora_slot), self._slot_name(lora_slot),
+                               tuple(tokens))
+        return True
 
     def invalidate_prefix_cache(self) -> int:
         """Drop EVERY cached prefix mapping: cached KV was computed under
@@ -247,6 +288,7 @@ class BlockManager:
         n = len(self.cached)
         self.cached.clear()
         self.block_hash.clear()
+        self.digest_meta.clear()
         while self.reusable:
             bid, _ = self.reusable.popitem(last=False)
             self.free.append(bid)
@@ -340,6 +382,16 @@ class LLMEngine:
         # adopted KV excluded): the "zero re-prefill" proof for session
         # migration — an adopted sequence never adds to this.
         self.prefill_tokens_computed = 0
+        # Tiered prefix store (llm/prefix_store.py), attached by the
+        # serving layer via attach_prefix_store. Host tier catches device
+        # evictions; cluster store makes spilled prefixes adoptable fleet
+        # wide. Both optional — a bare engine behaves exactly as before.
+        self.host_prefix_tier = None
+        self.cluster_store = None
+        self.host_prefix_hits = 0
+        self.host_prefix_tokens_saved = 0
+        self.cluster_prefix_hits = 0
+        self.cluster_prefix_tokens_saved = 0
 
     # ---- API -------------------------------------------------------------
 
@@ -502,6 +554,15 @@ class LLMEngine:
         invalidated = self.block_manager.invalidate_prefix_cache()
         self.weights_version = (version if version is not None
                                 else self.weights_version + 1)
+        # Spilled KV is as stale as cached KV after a hot-swap: drop the
+        # host tier outright and GC cluster entries below the new version
+        # (adoption also gates on exact version match, so a racing peer's
+        # lookup can never resurrect pre-swap pages either way).
+        if self.host_prefix_tier is not None:
+            invalidated += self.host_prefix_tier.clear()
+        if self.cluster_store is not None:
+            self.cluster_store.purge(
+                below_weights_version=self.weights_version)
         return {"version": self.weights_version,
                 "invalidated_prefix_entries": invalidated}
 
@@ -513,7 +574,7 @@ class LLMEngine:
         bm = self.block_manager
         backlog = sum(len(r.context) - r.prefilled for r in self.prefilling)
         backlog += sum(len(r.context) for r in self.waiting)
-        return {
+        out = {
             "waiting": len(self.waiting),
             "prefilling": len(self.prefilling),
             "running": len(self.running),
@@ -525,7 +586,38 @@ class LLMEngine:
             "prefix_tokens_saved": bm.prefix_tokens_saved,
             "prefill_tokens_computed": self.prefill_tokens_computed,
             "queued_prefill_tokens": backlog,
+            "weights_version": self.weights_version,
         }
+        if self.host_prefix_tier is not None:
+            t = self.host_prefix_tier.stats()
+            out.update({
+                "host_prefix_entries": t["entries"],
+                "host_prefix_bytes": t["bytes"],
+                "host_prefix_spills": t["spills"],
+                "host_prefix_demotions": t["demotions"],
+                "host_prefix_hits": self.host_prefix_hits,
+                "host_prefix_tokens_saved": self.host_prefix_tokens_saved,
+            })
+        if self.cluster_store is not None:
+            c = self.cluster_store.stats()
+            out.update({
+                "cluster_prefix_published": c["published"],
+                "cluster_prefix_adopted_blocks": c["adopted_blocks"],
+                "cluster_prefix_stale_rejected": c["stale_rejected"],
+                "cluster_prefix_hits": self.cluster_prefix_hits,
+                "cluster_prefix_tokens_saved":
+                    self.cluster_prefix_tokens_saved,
+            })
+        lm = self.runner.lora
+        if lm is not None:
+            out.update({
+                "lora_slots": lm.n_slots - 1,
+                "lora_loaded": len(getattr(lm, "_slots", {})),
+                "lora_pinned": len(getattr(lm, "_pins", {})),
+                "lora_loads": getattr(lm, "loads", 0),
+                "lora_evictions": getattr(lm, "evictions", 0),
+            })
+        return out
 
     # ---- disaggregated prefill/decode handoff (llm/disagg.py) ------------
 
@@ -660,6 +752,219 @@ class LLMEngine:
         self.running.append(req)
         return True
 
+    # ---- tiered prefix store (llm/prefix_store.py) -------------------------
+
+    def attach_prefix_store(self, host_tier=None, cluster_store=None):
+        """Wire the tiered prefix store in: BlockManager evictions spill
+        through `host_tier`, host-tier watermark victims demote into
+        `cluster_store`, and _admit promotes from both. Either tier may be
+        None (host-only works standalone; cluster-only skips host RAM)."""
+        self.host_prefix_tier = host_tier
+        self.cluster_store = cluster_store
+        self.block_manager.lora_name_fn = self._lora_name
+        if host_tier is not None:
+            self.block_manager.spill_fn = self._spill_block
+            if cluster_store is not None and host_tier.on_demote is None:
+                host_tier.on_demote = self._demote_entry
+
+    def _lora_name(self, lora_slot: int) -> Optional[str]:
+        """Adapter name for a pinned slot: "" = base model, None = cannot
+        resolve (no manager / unknown slot — such KV is unaddressable)."""
+        if lora_slot == 0:
+            return ""
+        lm = self.runner.lora
+        name_of = getattr(lm, "name_of", None) if lm is not None else None
+        return name_of(lora_slot) if name_of is not None else None
+
+    def _spill_block(self, bid: int, h: bytes) -> None:
+        """BlockManager eviction hook: copy the victim block's pages to the
+        host tier before the device page is recycled. Best-effort — a
+        failed spill is a future cache miss, never an engine error."""
+        tier = self.host_prefix_tier
+        if tier is None:
+            return
+        meta = self.block_manager.digest_meta.get(h)
+        if meta is None:
+            return
+        slot, lora_name, tokens = meta
+        if lora_name is None:
+            return
+        try:
+            k, v = self.runner.gather_pages([bid])
+            k = np.asarray(k)
+            v = np.asarray(v)
+        except Exception:
+            return
+        tier.put(h, {"tokens": tokens, "k": k, "v": v, "lora_slot": slot,
+                     "lora_name": lora_name,
+                     "weights_version": self.weights_version,
+                     "nbytes": int(k.nbytes + v.nbytes)})
+
+    def _demote_entry(self, entry: dict) -> None:
+        """Host-tier watermark victim -> cluster store (tier 2)."""
+        if self.cluster_store is None:
+            return
+        self.cluster_store.publish(entry)
+
+    def _promote_prefix(self, req: _Request) -> int:
+        """Extend req's cached-chain attachment past the device tier: host
+        RAM block by block, then ONE cluster-table fetch for the rest of
+        the chain. Promoted blocks are scattered into fresh device pages
+        and re-registered under the local digest chain, so the next prompt
+        sharing them hits the device tier directly. Returns tokens saved."""
+        bm = self.block_manager
+        bs = self.block_size
+        limit = min(len(req.prefix_hashes), (len(req.prompt) - 1) // bs)
+        promoted = 0
+        tier = self.host_prefix_tier
+        while tier is not None and len(req.blocks) < limit:
+            j = len(req.blocks)
+            e = tier.get(req.prefix_hashes[j])
+            if (e is None
+                    or e.get("weights_version") != self.weights_version
+                    or e.get("lora_name") != self._lora_name(req.lora_slot)
+                    or tuple(e["tokens"])
+                    != tuple(req.prompt[:(j + 1) * bs])):
+                break
+            ids = bm.adopt_blocks(1)
+            if ids is None:
+                break
+            self.runner.scatter_pages(ids, e["k"], e["v"])
+            req.blocks.extend(ids)
+            bm.register_adopted_block(ids[0], req.prefix_hashes[j],
+                                      req.lora_slot, e["tokens"])
+            promoted += bs
+            self.host_prefix_hits += 1
+            self.host_prefix_tokens_saved += bs
+        if self.cluster_store is not None and len(req.blocks) < limit:
+            lora_name = self._lora_name(req.lora_slot)
+            if lora_name is not None:
+                from ray_tpu.llm.prefix_store import cluster_chain
+
+                j0 = len(req.blocks)
+                chain = cluster_chain(req.prompt[:limit * bs], bs, lora_name)
+                verified = []
+                for e in self.cluster_store.lookup_pages(
+                        chain[j0:limit], lora_id=lora_name,
+                        weights_version=self.weights_version):
+                    j = j0 + len(verified)
+                    want = [int(t) for t in req.prompt[:(j + 1) * bs]]
+                    if [int(t) for t in e["tokens"]] != want:
+                        break  # token verification IS the forgery guard
+                    verified.append((e, want))
+                while verified:  # pool pressure: adopt a shorter prefix
+                    ids = bm.adopt_blocks(len(verified))
+                    if ids is not None:
+                        break
+                    verified.pop()
+                if verified:
+                    # One batched scatter: a per-block device write costs
+                    # ~1-2 ms of dispatch each, which is most of the
+                    # adopt-vs-reprefill budget for long contexts.
+                    self.runner.scatter_pages(
+                        ids,
+                        np.concatenate([e["k"] for e, _ in verified],
+                                       axis=2),
+                        np.concatenate([e["v"] for e, _ in verified],
+                                       axis=2))
+                    for bid, (e, want) in zip(ids, verified):
+                        bm.register_adopted_block(
+                            bid, req.prefix_hashes[len(req.blocks)],
+                            req.lora_slot, want)
+                        req.blocks.append(bid)
+                        promoted += bs
+                        self.cluster_prefix_hits += 1
+                        self.cluster_prefix_tokens_saved += bs
+        return promoted
+
+    def adopt_prefix(self, state: dict, k_pages, v_pages) -> int:
+        """Adopt prefix blocks pushed by a draining peer (llm/disagg.py
+        wire, meta["prefix"]=True): scatter each block into a fresh page,
+        register it under THIS engine's digest chain, and park it in the
+        reusable pool — exactly as if a local request had prefilled and
+        released it. Skips (never errors on) blocks it cannot place:
+        stale weights, unknown adapters, token/shape mismatches, or pool
+        pressure. Returns blocks adopted."""
+        if int(state.get("weights_version", 0)) != self.weights_version:
+            return 0
+        entries = state.get("entries") or []
+        k_pages = np.asarray(k_pages)
+        v_pages = np.asarray(v_pages)
+        if k_pages.ndim != 5 or int(k_pages.shape[2]) != len(entries):
+            return 0
+        bm = self.block_manager
+        bs = self.block_size
+        adopted = 0
+        for i, ent in enumerate(entries):
+            tokens = [int(t) for t in (ent.get("tokens") or [])]
+            if not tokens or len(tokens) % bs:
+                continue
+            lora = ent.get("lora") or ""
+            slot = 0
+            if lora:
+                lm = self.runner.lora
+                try:
+                    slot = lm.slot_of(lora) if lm is not None else None
+                except KeyError:
+                    slot = None
+                if slot is None or self._lora_name(slot) != lora:
+                    continue  # adapter not resident here: unaddressable
+            seed = int(slot).to_bytes(8, "little", signed=True)
+            h = prefix_digest_chain(tokens, bs, seed=seed)[-1]
+            if h in bm.cached:
+                continue
+            ids = bm.adopt_blocks(1)
+            if ids is None:
+                break
+            self.runner.scatter_pages(ids, k_pages[:, :, i:i + 1],
+                                      v_pages[:, :, i:i + 1])
+            if bm.register_adopted_block(ids[0], h, slot, tokens):
+                adopted += 1
+            # Parks in `reusable` (hashed, refcount hits 0) — or returns
+            # straight to `free` if registration lost the race.
+            bm.release_blocks(ids)
+        return adopted
+
+    def export_prefixes(self, limit: int = 16):
+        """Snapshot the hottest idle prefix blocks for a drain-time push
+        (serving.LLMServer.push_prefixes): parked device blocks first
+        (hottest), then host-tier entries. Returns (state, k, v) shaped
+        for llm/disagg.py send_handoff, or None when there is nothing
+        worth pushing."""
+        bm = self.block_manager
+        picked = []
+        for bid in reversed(bm.reusable):
+            h = bm.block_hash.get(bid)
+            meta = bm.digest_meta.get(h) if h is not None else None
+            if meta is None:
+                continue
+            slot, lora_name, tokens = meta
+            if lora_name is None:
+                continue
+            picked.append((bid, lora_name, tokens))
+            if len(picked) >= limit:
+                break
+        entries, ks, vs = [], [], []
+        if picked:
+            k, v = self.runner.gather_pages([b for b, _, _ in picked])
+            ks.append(np.asarray(k))
+            vs.append(np.asarray(v))
+            entries.extend({"tokens": list(t), "lora": name}
+                           for _, name, t in picked)
+        if self.host_prefix_tier is not None and len(entries) < limit:
+            for e in self.host_prefix_tier.hottest(limit - len(entries)):
+                entries.append({"tokens": list(e["tokens"]),
+                                "lora": e["lora_name"]})
+                ks.append(np.asarray(e["k"]))
+                vs.append(np.asarray(e["v"]))
+        if not entries:
+            return None
+        k = np.concatenate(ks, axis=2) if len(ks) > 1 else ks[0]
+        v = np.concatenate(vs, axis=2) if len(vs) > 1 else vs[0]
+        state = {"prefix": True, "entries": entries,
+                 "weights_version": self.weights_version}
+        return state, k, v
+
     # ---- internals -------------------------------------------------------
 
     def _admit(self):
@@ -696,6 +1001,12 @@ class LLMEngine:
                         req.prompt, req.lora_slot)
                 cached_tokens = self.block_manager.match_prefix(
                     req, req.prefix_hashes)
+                # Device tier exhausted: promote from host RAM, then the
+                # cluster store (llm/prefix_store.py) — spilled blocks
+                # re-enter fresh device pages instead of re-prefilling.
+                if (self.host_prefix_tier is not None
+                        or self.cluster_store is not None):
+                    cached_tokens += self._promote_prefix(req)
                 req.registered_blocks = len(req.blocks)
             assert self.block_manager.allocate(req, len(req.context) + 1)
             req.prefilled = cached_tokens

@@ -107,6 +107,12 @@ class LoRAManager:
         # slot must never be evicted (an LRU reuse would silently switch
         # a running sequence's adapter mid-generation).
         self._pins: Dict[int, int] = {}
+        # Pool-scaling telemetry (engine stats() -> LoRAPoolPolicy): loads
+        # count every load_adapter install; evictions count only the
+        # LRU-eviction subset — a persistently nonzero eviction rate is
+        # the "pool too small" signal.
+        self.loads = 0
+        self.evictions = 0
 
     def lora_pytree(self) -> Dict:
         """The stacks, passed into the jitted step (a dict pytree whose
@@ -141,6 +147,18 @@ class LoRAManager:
     def loaded(self) -> List[str]:
         return sorted(self._slots)
 
+    def name_of(self, slot: int) -> Optional[str]:
+        """Inverse of slot_of: the adapter currently holding `slot`
+        ("" for the base slot, None for an empty/unknown slot). The prefix
+        store keys spilled KV by adapter NAME, not slot — slots are
+        recycled across loads, names are identity."""
+        if slot == 0:
+            return ""
+        for name, s in self._slots.items():
+            if s == slot:
+                return name
+        return None
+
     def load_adapter(self, adapter: LoRAAdapter) -> int:
         """Install (or refresh) an adapter; returns its slot. Evicts the
         LRU adapter when all user slots are taken."""
@@ -169,6 +187,8 @@ class LoRAManager:
             slot = min(evictable, key=lambda s: self._last_used.get(s, 0))
             evicted = next(n for n, s in self._slots.items() if s == slot)
             del self._slots[evicted]
+            self.evictions += 1
+        self.loads += 1
         self._slots[adapter.name] = slot
         self._tick += 1
         self._last_used[slot] = self._tick
@@ -196,6 +216,95 @@ class LoRAManager:
                 a_stack.at[:, slot].set(jnp.asarray(a_pad, dtype=self.dtype)),
                 b_stack.at[:, slot].set(jnp.asarray(b_pad, dtype=self.dtype)))
         return slot
+
+    def resize(self, n_slots: int) -> int:
+        """Grow/shrink the user-slot pool in place (LoRAPoolPolicy's
+        actuator), rebuilding the stacked tensors and preserving every
+        occupied slot column. Shrinks clamp to the highest loaded or
+        pinned slot index — a resize must never orphan a resident adapter
+        or yank one out from under an in-flight request. Returns the new
+        user-slot count. (The stacks change shape, so the next step pays
+        one recompile — the policy's cooldown keeps that rare.)"""
+        floor = max([0] + list(self._slots.values())
+                    + [s for s, n in self._pins.items() if n])
+        new = max(int(n_slots), floor, 1)
+        total = new + 1
+        if total == self.n_slots:
+            return new
+        keep = min(self.n_slots, total)
+        for t in self.targets:
+            a_stack, b_stack = self.stacks[t]
+            a_new = jnp.zeros(
+                (a_stack.shape[0], total) + a_stack.shape[2:],
+                dtype=self.dtype)
+            b_new = jnp.zeros(
+                (b_stack.shape[0], total) + b_stack.shape[2:],
+                dtype=self.dtype)
+            self.stacks[t] = (a_new.at[:, :keep].set(a_stack[:, :keep]),
+                              b_new.at[:, :keep].set(b_stack[:, :keep]))
+        self.n_slots = total
+        return new
+
+
+@dataclasses.dataclass
+class LoRAPoolPolicyConfig:
+    """Watermarks for adapter-pool scaling (ReplicaPolicyConfig analog)."""
+
+    min_slots: int = 1
+    max_slots: int = 32
+    high_occupancy: float = 0.9   # loaded/slots at or above -> grow
+    low_occupancy: float = 0.5    # at or below (sustained) -> shrink
+    grow_factor: float = 1.5
+    cooldown_s: float = 10.0      # min seconds between resizes
+    quiet_s: float = 30.0         # sustained low occupancy before a shrink
+
+
+class LoRAPoolPolicy:
+    """Adapter-pool scaling off the same engine_stats() telemetry that
+    drives ReplicaPolicy (llm/replica_policy.py), one level down: instead
+    of replicas, the actuator is LoRAManager.resize. Pure and clock-driven
+    — feed it stats dicts + `now`, it answers a desired slot count or None
+    (serving.LLMServer ticks it from the engine loop).
+
+    Grow on pressure: occupancy at the high watermark, or any LRU eviction
+    since the last tick (an eviction means a wanted adapter was pushed out
+    — occupancy alone can't see that once the pool pins full). Shrink only
+    after a sustained quiet window, never below what is loaded/pinned."""
+
+    def __init__(self, config: Optional[LoRAPoolPolicyConfig] = None):
+        self.config = config or LoRAPoolPolicyConfig()
+        self._last_resize = 0.0
+        self._quiet_since: Optional[float] = None
+        self._last_evictions = 0
+
+    def desired(self, stats: dict, now: float) -> Optional[int]:
+        cfg = self.config
+        slots = int(stats.get("lora_slots", 0))
+        if slots <= 0:
+            return None
+        loaded = int(stats.get("lora_loaded", 0))
+        evictions = int(stats.get("lora_evictions", 0))
+        evicting = evictions > self._last_evictions
+        self._last_evictions = evictions
+        occupancy = loaded / slots
+        if now - self._last_resize < cfg.cooldown_s:
+            return None
+        if ((evicting or occupancy >= cfg.high_occupancy)
+                and slots < cfg.max_slots):
+            self._quiet_since = None
+            self._last_resize = now
+            return min(cfg.max_slots,
+                       max(slots + 1, int(slots * cfg.grow_factor)))
+        if occupancy <= cfg.low_occupancy and slots > cfg.min_slots:
+            if self._quiet_since is None:
+                self._quiet_since = now
+            elif now - self._quiet_since >= cfg.quiet_s:
+                self._quiet_since = None
+                self._last_resize = now
+                return max(cfg.min_slots, loaded, slots // 2, 1)
+        else:
+            self._quiet_since = None
+        return None
 
 
 def apply_lora(x: jax.Array, lA: jax.Array, lB: jax.Array,

@@ -502,12 +502,17 @@ class FleetSupervisor:
                  prefill_replicas: Sequence[Any] = (),
                  policy: Any = None,
                  scale_up_fn: Optional[Callable[[int], Any]] = None,
-                 retire_fn: Optional[Callable[[int], Any]] = None):
+                 retire_fn: Optional[Callable[[int], Any]] = None,
+                 prefix_store: Any = None):
         self.core = core
         self.replicas: List[Any] = list(replicas)
         self.prefill_replicas: List[Any] = list(prefill_replicas)
         self.deployment = deployment
         self.policy = policy
+        # Cluster prefix table client (llm/prefix_store.py), optional: the
+        # owner-LRU's fallback on owner ejection/restart, and the same-tick
+        # hygiene hook when a replica is ejected.
+        self.prefix_store = prefix_store
         self._scale_up_fn = scale_up_fn
         self._retire_fn = retire_fn
         self._lock = threading.Lock()        # replica list + drain state
@@ -595,6 +600,21 @@ class FleetSupervisor:
             return None
         self._handoff_addrs.pop(idx, None)
         self._m_healthy.set(self.core.healthy_count())
+        # Same-tick cluster-table hygiene, mirroring the owner-LRU prune
+        # above: blank the dead replica's live-owner hints in the GCS
+        # prefix table so no lookup routes a request at the corpse. The
+        # rows themselves stay — the pages are GCS-homed and adoptable by
+        # any survivor (that is the point of the store).
+        if self.prefix_store is not None:
+            with self._stats_lock:
+                s = self._stats[idx] if idx < len(self._stats) else None
+            tag = (s or {}).get("replica") or ""
+            if tag:
+                try:
+                    self.prefix_store.purge(owner_replica=tag,
+                                            clear_owner_only=True)
+                except Exception:
+                    pass
         from ray_tpu.runtime import events
 
         events.emit(
@@ -637,6 +657,16 @@ class FleetSupervisor:
             # as failures and the requests replay when capacity returns.
             return {"migrated": [], "replayed": [], "target": None}
         self._drain_target[idx] = target
+        # Working-set handoff first: stream the victim's hottest reusable
+        # prefix pages to the target before the live sessions move, so the
+        # fleet's shared prompts stay warm across the drain (the successor
+        # serves them with zero re-prefill). Best-effort — these pages were
+        # already spill candidates, a failed push costs nothing.
+        try:
+            self.replicas[idx].call("push_prefixes",
+                                    self._handoff_addr(target))
+        except Exception:
+            pass
         try:
             addr = self._handoff_addr(target)
             summary = self.replicas[idx].call("migrate_sessions", addr)
@@ -821,6 +851,16 @@ class FleetSupervisor:
             except NoHealthyReplicasError as e:
                 return {"error": {"code": 503, "type": "no_healthy_replicas",
                                   "message": str(e)}}
+            if decision["reason"] == "pow2" and self.prefix_store is not None:
+                # Local owner-LRU miss: the cluster prefix table remembers
+                # owners across router restarts and owner ejection. A live
+                # owner hint re-establishes local affinity; no hint is fine
+                # — the pages are GCS-homed, so whoever we picked adopts
+                # them from the store instead of re-prefilling.
+                better = self._cluster_affinity(token_prompt, request,
+                                                tried)
+                if better is not None:
+                    idx = better
             if first_attempt:
                 # Admission gates the FIRST attempt only: a failover replay
                 # has already consumed prefill work somewhere — shedding it
@@ -865,6 +905,36 @@ class FleetSupervisor:
                 tried.add(idx)
             finally:
                 self.core.finish(idx)
+
+    def _cluster_affinity(self, token_prompt: List[int], request: Dict,
+                          tried: Set[int]) -> Optional[int]:
+        """Map a cluster-table owner hint for this prompt's prefix back to
+        a routable replica index (replica tags come from engine_stats).
+        Returns None on miss, dead hint, or any store error — the pow2
+        pick stands."""
+        from ray_tpu.llm.prefix_store import cluster_chain
+
+        try:
+            chain = cluster_chain(token_prompt, self.core.block_size,
+                                  request.get("lora_name") or "")
+            if not chain:
+                return None
+            hit = self.prefix_store.lookup_owner(
+                chain, lora_id=request.get("lora_name") or "")
+        except Exception:
+            return None
+        if not hit or not hit.get("owner_replica"):
+            return None
+        with self._stats_lock:
+            stats_now = list(self._stats)
+        for i, s in enumerate(stats_now):
+            if ((s or {}).get("replica") == hit["owner_replica"]
+                    and i not in tried and self.core.is_routable(i)):
+                # Re-seed the local owner-LRU so follow-ups skip the probe.
+                self.core._remember(self.core.digest_chain(
+                    token_prompt, request.get("lora_name")), i)
+                return i
+        return None
 
     def _disagg_completions(self, request: Dict, decode_idx: int,
                             token_prompt: List[int]) -> Dict:
@@ -1028,12 +1098,24 @@ class LLMRouter:
                 if not isinstance(pol_cfg, ReplicaPolicy):
                     pol_cfg = ReplicaPolicy(pol_cfg)
                 policy = pol_cfg
+            prefix_store = None
+            if getattr(self.config, "cluster_prefix_store", False):
+                try:
+                    from ray_tpu.llm.prefix_store import ClusterPrefixStore
+
+                    store = ClusterPrefixStore(self.config.block_size,
+                                               deployment=self.deployment)
+                    if store.available():
+                        prefix_store = store
+                except Exception:
+                    prefix_store = None
             # supervisor is the publication barrier: assigned LAST, so a
             # racing reader that sees it non-None sees resolved state too.
             sup = FleetSupervisor(
                 self.core, self.replicas, deployment=self.deployment,
                 prefill_replicas=self.prefill_replicas, policy=policy,
-                scale_up_fn=self._scale_up, retire_fn=self._retire)
+                scale_up_fn=self._scale_up, retire_fn=self._retire,
+                prefix_store=prefix_store)
             self.supervisor = sup
             self._start_control_loop()
 

@@ -107,6 +107,23 @@ class LLMConfig:
     # How long completions/streams wait for the next engine output before
     # aborting the request (the abandoned-request guard).
     stream_timeout_s: float = 300.0
+    # Tiered KV prefix store (llm/prefix_store.py): cold-but-reusable
+    # prefix pages spill to host RAM before dropping (tier 1), and host-
+    # tier victims publish into the GCS cluster prefix table (tier 2) so
+    # any replica can adopt the shared working set after the owner dies,
+    # drains, or the deployment restarts.
+    host_prefix_mb: float = 32.0        # 0 disables the host tier
+    host_prefix_low_watermark: float = 0.8
+    cluster_prefix_store: bool = True   # publish/adopt via the GCS table
+    # LoRA pool autoscaling (llm/lora.py LoRAPoolPolicy): grow/shrink the
+    # adapter slot table off the same engine_stats() telemetry that drives
+    # ReplicaPolicy.
+    lora_autoscale: bool = False
+    lora_min_slots: int = 1
+    lora_max_slots: int = 32
+    # Deployment name, stamped by build_llm_deployment — keys this fleet's
+    # rows in the cluster prefix table so delete_deployment can purge them.
+    deployment_name: str = ""
 
 
 def _node_hex() -> Optional[str]:
@@ -197,6 +214,36 @@ class LLMServer:
         self._tok_count = 0
         self._tok_t0 = time.monotonic()
         self._gauges = self._bind_gauges()
+        # Tiered prefix store (llm/prefix_store.py): host spill tier +
+        # cluster publish/adopt, each optional per config. The cluster tier
+        # degrades to None outside a cluster (in-process tests, bench).
+        host_tier = cluster_store = None
+        if llm_config.host_prefix_mb > 0:
+            from ray_tpu.llm.prefix_store import HostPrefixTier
+
+            host_tier = HostPrefixTier(
+                int(llm_config.host_prefix_mb * (1 << 20)),
+                low_watermark=llm_config.host_prefix_low_watermark)
+        if llm_config.cluster_prefix_store:
+            from ray_tpu.llm.prefix_store import ClusterPrefixStore
+
+            store = ClusterPrefixStore(
+                llm_config.block_size, replica=self._replica_tag,
+                deployment=llm_config.deployment_name)
+            if store.available():
+                cluster_store = store
+        if host_tier is not None or cluster_store is not None:
+            self.engine.attach_prefix_store(host_tier=host_tier,
+                                            cluster_store=cluster_store)
+        # LoRA pool autoscaling: ticked at 1 Hz from the engine loop.
+        self._lora_policy = None
+        if llm_config.lora_autoscale and self.engine.runner.lora is not None:
+            from ray_tpu.llm.lora import (LoRAPoolPolicy,
+                                          LoRAPoolPolicyConfig)
+
+            self._lora_policy = LoRAPoolPolicy(LoRAPoolPolicyConfig(
+                min_slots=llm_config.lora_min_slots,
+                max_slots=llm_config.lora_max_slots))
         # KV stream listener — always on: prefill replicas stream populated
         # pages here in disaggregated mode, and draining peers migrate live
         # sessions here in every mode (llm/disagg.py wire).
@@ -287,6 +334,11 @@ class LLMServer:
                     self._publish_gauges()
                 except Exception:
                     pass
+                if self._lora_policy is not None:
+                    try:
+                        self._lora_pool_tick(now)
+                    except Exception:
+                        pass
             if not busy:
                 time.sleep(0.005)
 
@@ -467,7 +519,50 @@ class LLMServer:
                 "send_failed": send_failed, "finished": finished,
                 "replica": self._replica_tag}
 
+    def push_prefixes(self, target_address, *, limit: int = 16,
+                      timeout: float = 60.0) -> Dict:
+        """Drain-plane working-set handoff: stream the hottest reusable
+        prefix pages (device `reusable` pool first, then the host tier) to
+        `target_address` — another replica's KV stream listener — so a
+        drain's successor starts warm instead of re-prefilling the shared
+        prompts. Same whole-or-nothing raw-frame wire as
+        migrate_sessions; a failed send costs nothing (the pages were
+        already spill candidates)."""
+        from ray_tpu.llm.disagg import send_handoff
+
+        with self._lock:
+            export = self.engine.export_prefixes(limit=limit)
+        if export is None:
+            return {"pushed": 0, "replica": self._replica_tag}
+        state, k, v = export
+        try:
+            send_handoff(target_address, state, k, v, timeout=timeout)
+        except Exception:
+            return {"pushed": 0, "replica": self._replica_tag,
+                    "error": "send_failed"}
+        return {"pushed": len(state["entries"]),
+                "replica": self._replica_tag}
+
+    def _lora_pool_tick(self, now: float) -> None:
+        """1 Hz LoRA pool autoscale: LoRAPoolPolicy reads the engine stats
+        and, when the watermarks say so, resizes the adapter slot table
+        under the engine lock (the resize rebuilds the stacked tensors, so
+        it must not race a step)."""
+        mgr = self.engine.runner.lora
+        with self._lock:
+            target = self._lora_policy.desired(self.engine.stats(),
+                                               now)
+            if target is not None and target != mgr.n_slots - 1:
+                mgr.resize(target)
+
     def _adopt_handoff(self, state: Dict, k_pages, v_pages) -> bool:
+        # Drain-plane prefix push (push_prefixes): cached pages, not a
+        # live session — adopt straight into the prefix cache; no stream
+        # queue, no consumer.
+        if state.get("prefix"):
+            with self._lock:
+                return self.engine.adopt_prefix(state, k_pages,
+                                                v_pages) > 0
         # The stream queue must exist BEFORE the request can start decoding
         # (the engine loop drops outputs with no queue), and the ack goes
         # back only after adopt_request returns — so by the time the router
@@ -579,6 +674,8 @@ class LLMServer:
 
 
 def build_llm_deployment(llm_config: LLMConfig, name: str = "llm") -> Any:
+    if llm_config.deployment_name != name:
+        llm_config = dataclasses.replace(llm_config, deployment_name=name)
     dep = serve.deployment(LLMServer).options(
         name=name, num_replicas=llm_config.num_replicas,
         num_tpus=llm_config.num_tpus_per_replica,

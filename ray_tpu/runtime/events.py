@@ -38,7 +38,13 @@ Emitters in-tree:
                  LLM_REPLICA_EJECTED (health tracking declared a replica
                  dead: affinity state pruned, no more picks land on it),
                  LLM_REPLICAS_SCALED (the serve-side replica policy
-                 changed the LLM fleet size; scale-down drains first)
+                 changed the LLM fleet size; scale-down drains first),
+                 LLM_PREFIX_SPILLED (a replica published a cold prefix's
+                 KV pages into the GCS cluster prefix store — the shared
+                 working set now survives that replica's death),
+                 LLM_PREFIX_ADOPTED (a replica adopted spilled prefix
+                 pages from the cluster store instead of re-prefilling;
+                 labels carry block counts)
   * rlhf       — RLHF_PLACEMENT_SWITCH (the adaptive placement policy
                  moved generator/learner between colocated and
                  disaggregated; labels carry from/to mode, the switch
@@ -82,6 +88,8 @@ LLM_REQUEST_FAILOVER = "LLM_REQUEST_FAILOVER"
 LLM_SESSION_MIGRATED = "LLM_SESSION_MIGRATED"
 LLM_REPLICA_EJECTED = "LLM_REPLICA_EJECTED"
 LLM_REPLICAS_SCALED = "LLM_REPLICAS_SCALED"
+LLM_PREFIX_SPILLED = "LLM_PREFIX_SPILLED"
+LLM_PREFIX_ADOPTED = "LLM_PREFIX_ADOPTED"
 RLHF_PLACEMENT_SWITCH = "RLHF_PLACEMENT_SWITCH"
 CHECKPOINT_SAVED = "CHECKPOINT_SAVED"
 EVENT_TYPES = (NODE_DEAD, NODE_DRAINING, NODE_PREEMPTED, SLICE_LOST,
@@ -89,8 +97,8 @@ EVENT_TYPES = (NODE_DEAD, NODE_DRAINING, NODE_PREEMPTED, SLICE_LOST,
                AUTOSCALER_SCALE, TRAIN_GANG_RESTART, TASK_STALLED,
                DEADLOCK_DETECTED, LLM_REQUEST_SHED, LLM_REQUEST_FAILOVER,
                LLM_SESSION_MIGRATED, LLM_REPLICA_EJECTED,
-               LLM_REPLICAS_SCALED, RLHF_PLACEMENT_SWITCH,
-               CHECKPOINT_SAVED)
+               LLM_REPLICAS_SCALED, LLM_PREFIX_SPILLED, LLM_PREFIX_ADOPTED,
+               RLHF_PLACEMENT_SWITCH, CHECKPOINT_SAVED)
 
 
 def make_event(event_type: str, message: str, *,

@@ -601,6 +601,157 @@ class GcsServer:
         rows.sort(key=lambda r: (r["path"], r["name"], r["shard"]))
         return rows
 
+    # ---- cluster prefix store (llm/prefix_store.py) -----------------------
+    #
+    # digest -> spilled KV prefix pages + adoption metadata, modeled on the
+    # checkpoint shard registry above, with one deliberate difference: the
+    # page bytes are homed HERE (the GCS byte plane), not in a worker's
+    # object store — worker-owned objects ride the owner-addressed
+    # ownership protocol and are reaped when their owner dies, which is
+    # the exact event a spilled prefix must survive. Traffic is raw-frame
+    # RPC both directions (rpc.py call_raw): the handlers below never
+    # pickle a page byte. Byte-capacity LRU so replicas can't flood the
+    # head node's RAM.
+
+    PREFIX_STORE_CAPACITY = 256 << 20
+
+    def _prefix_table(self):
+        tbl = getattr(self, "_prefix_entries", None)
+        if tbl is None:
+            from collections import OrderedDict
+
+            tbl = self._prefix_entries = OrderedDict()
+            self._prefix_bytes = 0
+        return tbl
+
+    @staticmethod
+    def _prefix_row_msg(key: bytes, row: dict):
+        from ray_tpu.runtime import wire
+
+        return wire.PrefixEntryMsg(
+            digest=key, lora_id=row["lora_id"],
+            weights_version=row["weights_version"],
+            block_size=row["block_size"], n_tokens=row["n_tokens"],
+            token_ids=row["token_ids"], nbytes=len(row["payload"]),
+            owner_replica=row["owner_replica"], node_id=row["node_id"],
+            deployment=row["deployment"])
+
+    async def handle_prefix_upsert(self, conn, m, payload):
+        from ray_tpu.runtime.rpc import RawReply
+        from ray_tpu.runtime import wire
+
+        ent = wire.PrefixEntryMsg.decode(bytes(m))
+        buf = bytes(payload)
+        if not ent.digest or not buf or not ent.token_ids:
+            return RawReply(wire.AckMsg(
+                ok=False, error="empty prefix upsert").encode())
+        tbl = self._prefix_table()
+        key = bytes(ent.digest)
+        old = tbl.pop(key, None)
+        if old is not None:
+            self._prefix_bytes -= len(old["payload"])
+        tbl[key] = {
+            "lora_id": ent.lora_id, "weights_version": ent.weights_version,
+            "block_size": ent.block_size, "n_tokens": ent.n_tokens,
+            "token_ids": list(ent.token_ids),
+            "owner_replica": ent.owner_replica,
+            "node_id": bytes(ent.node_id), "deployment": ent.deployment,
+            "payload": buf, "time": time.time()}
+        self._prefix_bytes += len(buf)
+        while tbl and self._prefix_bytes > self.PREFIX_STORE_CAPACITY:
+            _, victim = tbl.popitem(last=False)
+            self._prefix_bytes -= len(victim["payload"])
+        return RawReply(wire.AckMsg(ok=True,
+                                    existed=old is not None).encode())
+
+    async def handle_prefix_lookup(self, conn, m, payload):
+        """Answer with the CONTIGUOUS run of entries held from digests[0]
+        upward (the caller lists its missing chain longest-last); the
+        reply payload is the matching spill buffers concatenated — frames
+        are self-delimiting, so the adopter decodes them back apart."""
+        from ray_tpu.runtime.rpc import RawReply
+        from ray_tpu.runtime import wire
+
+        q = wire.PrefixLookupMsg.decode(bytes(m))
+        tbl = self._prefix_table()
+        entries, bufs = [], []
+        for d in (q.digests or ()):
+            key = bytes(d)
+            row = tbl.get(key)
+            # weights_version <= 0 in the query means "any": the router's
+            # metadata-only owner probe doesn't know the fleet's weights
+            # version. Adopters always pass their exact version AND
+            # re-verify it per entry client-side, so a relaxed probe can
+            # never smuggle stale KV into an engine.
+            if (row is None or row["lora_id"] != q.lora_id
+                    or (q.weights_version > 0
+                        and row["weights_version"] != q.weights_version)
+                    or row["block_size"] != q.block_size):
+                break
+            tbl.move_to_end(key)
+            if q.replica:
+                # The adopter is about to hold these pages hot: it becomes
+                # the live-owner hint the router's fallback routes to.
+                row["owner_replica"] = q.replica
+            entries.append(self._prefix_row_msg(key, row))
+            if q.want_payload:
+                bufs.append(row["payload"])
+        reply = wire.PrefixLookupReplyMsg(found=bool(entries),
+                                          entries=entries)
+        return RawReply(reply.encode(), payload=b"".join(bufs))
+
+    async def handle_prefix_purge(self, conn, m, payload):
+        from ray_tpu.runtime.rpc import RawReply
+        from ray_tpu.runtime import wire
+
+        q = wire.PrefixPurgeMsg.decode(bytes(m))
+        purged, cleared = self._purge_prefix_entries(
+            owner_replica=q.owner_replica, node_id=bytes(q.node_id),
+            deployment=q.deployment,
+            digests=[bytes(d) for d in (q.digests or ())],
+            below_weights_version=q.below_weights_version,
+            clear_owner_only=q.clear_owner_only)
+        return RawReply(wire.PrefixPurgeReplyMsg(
+            ok=True, purged=purged, owners_cleared=cleared).encode())
+
+    def _purge_prefix_entries(self, *, owner_replica: str = "",
+                              node_id: bytes = b"", deployment: str = "",
+                              digests=(), below_weights_version: int = 0,
+                              clear_owner_only: bool = False):
+        """Prune the prefix table (OR across the given selectors; no
+        selector matches nothing). clear_owner_only blanks the live-owner
+        hint but keeps the row adoptable — the replica-death path, where
+        the pages (GCS-homed) are still valid but a routing hint naming a
+        dead or re-registered replica would serve a stale owner hit."""
+        tbl = getattr(self, "_prefix_entries", None)
+        if not tbl:
+            return 0, 0
+        digest_set = set(digests)
+
+        def match(key, row):
+            if key in digest_set:
+                return True
+            if owner_replica and row["owner_replica"] == owner_replica:
+                return True
+            if node_id and row["node_id"] == node_id:
+                return True
+            if deployment and row["deployment"] == deployment:
+                return True
+            return bool(below_weights_version
+                        and row["weights_version"] < below_weights_version)
+
+        purged = cleared = 0
+        for key in [k for k, r in tbl.items() if match(k, r)]:
+            if clear_owner_only:
+                tbl[key]["owner_replica"] = ""
+                tbl[key]["node_id"] = b""
+                cleared += 1
+            else:
+                row = tbl.pop(key)
+                self._prefix_bytes -= len(row["payload"])
+                purged += 1
+        return purged, cleared
+
     async def _on_disconnect(self, conn: ServerConnection):
         for subs in self._subscribers.values():
             subs.discard(conn)
@@ -714,6 +865,13 @@ class GcsServer:
                 self._store.delete("kv", key)
             except Exception:
                 pass
+        # Same hygiene for the cluster prefix table, in the SAME tick: a
+        # dead node's replicas never touch their spilled prefixes again,
+        # so their live-owner hints must not survive to misroute a router
+        # fallback (a later re-registered node could otherwise serve a
+        # stale owner hit). The pages themselves are GCS-homed and stay
+        # adoptable by any survivor — that is the point of the store.
+        self._purge_prefix_entries(node_id=node_id, clear_owner_only=True)
         await self.publish("node", {"event": "removed", "node": rec.view(), "reason": reason})
         # Slice fate-sharing: a multi-host ICI slice is ONE failure domain.
         # Losing any host breaks the slice's collectives, so every sibling

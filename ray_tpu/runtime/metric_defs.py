@@ -176,6 +176,24 @@ LLM_REPLICAS_HEALTHY = Gauge(
     "replicas the router currently considers live and routable",
     tag_keys=("deployment",))
 
+# Tiered KV prefix store (llm/prefix_store.py): tier="host" is the
+# replica-local pinned-RAM spill pool, tier="store" the GCS-homed cluster
+# table that survives replica death and restarts.
+LLM_PREFIX_SPILLS = Counter(
+    "ray_tpu_llm_prefix_spills_total",
+    "prefix KV pages demoted into a store tier instead of being dropped",
+    tag_keys=("tier",))                          # host | store
+LLM_PREFIX_ADOPTIONS = Counter(
+    "ray_tpu_llm_prefix_adoptions_total",
+    "spilled prefix blocks re-adopted into an engine (re-prefill avoided)",
+    tag_keys=("tier",))                          # host | store
+LLM_PREFIX_STORE_BYTES = Gauge(
+    "ray_tpu_llm_prefix_store_bytes",
+    "bytes currently held in this replica's host prefix tier")
+LLM_PREFIX_STALE_REJECTED = Counter(
+    "ray_tpu_llm_prefix_stale_rejected_total",
+    "spilled prefix entries refused at adoption (weights version mismatch)")
+
 # Checkpoint plane (checkpoint/plane.py): the snapshot histogram is the
 # train-step stall, the persist histogram is the background cost — the
 # 5x-plus gap between them is the async plane's whole point.

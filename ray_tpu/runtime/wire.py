@@ -725,3 +725,60 @@ class AckMsg(Message):
     ok = Field(1, BOOL)
     error = Field(2, STR)
     existed = Field(3, BOOL)
+
+
+# ------------------------------------------------ cluster prefix store
+#
+# GCS prefix-table RPCs (llm/prefix_store.py <-> gcs/server.py). Headers
+# only — the spilled KV pages ride OUT-OF-BAND as the raw-frame payload,
+# exactly like the object pull/push path above. `token_ids` is the full
+# root-anchored token prefix the entry covers: adopters verify it
+# byte-for-byte against their own prompt before scattering pages (the
+# cluster chain uses a FIXED salt so digests compare across processes;
+# token verification is what makes a forged digest useless).
+
+class PrefixEntryMsg(Message):
+    digest = Field(1, BYTES)           # cluster_chain(token_ids)[-1]
+    lora_id = Field(2, STR)            # "" = base model
+    weights_version = Field(3, INT)    # adopt only on exact match
+    block_size = Field(4, INT)
+    n_tokens = Field(5, INT)
+    token_ids = Field(6, LIST(INT))
+    nbytes = Field(7, INT)             # encoded payload size
+    owner_replica = Field(8, STR)      # live-holder hint (router fallback)
+    node_id = Field(9, BYTES)          # publisher's node (death pruning)
+    deployment = Field(10, STR)
+
+
+class PrefixLookupMsg(Message):
+    # Digest chain from the first block the caller is missing, upward:
+    # the GCS answers with the contiguous run it holds from digests[0].
+    digests = Field(1, LIST(BYTES))
+    lora_id = Field(2, STR)
+    weights_version = Field(3, INT)
+    block_size = Field(4, INT)
+    want_payload = Field(5, BOOL)      # False = owner-hint probe only
+    replica = Field(6, STR)            # adopter tag -> new live-owner hint
+
+
+class PrefixLookupReplyMsg(Message):
+    found = Field(1, BOOL)
+    entries = Field(2, LIST(MSG(PrefixEntryMsg)))
+    error = Field(3, STR)
+
+
+class PrefixPurgeMsg(Message):
+    owner_replica = Field(1, STR)
+    node_id = Field(2, BYTES)
+    deployment = Field(3, STR)
+    digests = Field(4, LIST(BYTES))
+    below_weights_version = Field(5, INT)
+    # True: blank live-owner hints only (replica eject/death — the pages,
+    # homed in the GCS byte plane, stay adoptable). False: drop rows.
+    clear_owner_only = Field(6, BOOL)
+
+
+class PrefixPurgeReplyMsg(Message):
+    ok = Field(1, BOOL)
+    purged = Field(2, INT)
+    owners_cleared = Field(3, INT)

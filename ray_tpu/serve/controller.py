@@ -304,7 +304,25 @@ class ServeController:
         self.version += 1
         self._publish(name, self.version, "deleted")
         self._snapshot_to_kv()
+        self._purge_prefix_entries(name)
         return True
+
+    @staticmethod
+    def _purge_prefix_entries(name: str):
+        """Drop the deployment's rows from the GCS cluster prefix table
+        (llm/prefix_store.py): unlike replica death — which only blanks the
+        live-owner hint so survivors can still adopt the pages — deleting
+        the deployment retires the whole fleet, so its spilled KV is freed
+        outright."""
+        try:
+            from ray_tpu.core.worker import global_worker
+            from ray_tpu.runtime import wire
+
+            m = wire.PrefixPurgeMsg(deployment=name).encode()
+            core = global_worker()
+            core.io.spawn(core.gcs.call_raw("prefix_purge", m=m))
+        except Exception:
+            pass
 
     def global_version(self) -> int:
         return self.version

@@ -157,6 +157,12 @@ def run(scale: float = 1.0, num_cpus: int = 4) -> List[Dict]:
         # -- LLM fleet resilience: failover replay + live migration ------
         results.extend(_bench_serve_resilience(scale))
 
+        # -- tiered prefix store: cluster-table adopt vs re-prefill ------
+        results.extend(_bench_serve_prefix_store(scale))
+
+        # -- closed-loop load sweep: 1->N replicas, drain churn mid-run --
+        results.extend(_bench_serve_load_sweep(scale))
+
         # -- RLHF pipeline: colocated vs disaggregated placement ---------
         results.extend(_bench_rlhf(scale))
 
@@ -768,6 +774,187 @@ def _swallow(fn, *args):
         fn(*args)
     except Exception:
         pass
+
+
+def _bench_serve_prefix_store(scale: float) -> List[Dict]:
+    """Tiered prefix store (llm/prefix_store.py): what adopting a spilled
+    prefix from the GCS cluster table costs vs re-prefilling it.
+
+      * serve_prefix_adopt_ms — first token for the SAME d256x4 /
+        129-token contexts as serve_reprefill_baseline_ms, but the
+        context's 16 KV blocks were published into the cluster prefix
+        table by a (since churned-out) owner engine, so the adopter pays
+        a table lookup + page scatter + a 1-block tail prefill instead of
+        re-running the model over the full context. The table transport
+        is the GCS handler invoked in-process, so the leg prices the
+        store machinery (codec, verification, scatter), not RPC.
+    """
+    import asyncio
+
+    import jax.numpy as jnp
+
+    from ray_tpu.llm.prefix_store import ClusterPrefixStore, HostPrefixTier
+    from ray_tpu.llm.sampling import SamplingParams
+    from ray_tpu.llm.serving import LLMConfig, build_engine
+    from ray_tpu.models import llama
+    from ray_tpu.runtime.gcs.server import GcsServer
+
+    mid = llama.LlamaConfig(vocab_size=128, d_model=256, n_layers=4,
+                            n_heads=8, n_kv_heads=4, d_ff=1024,
+                            max_seq=256, dtype=jnp.float32)
+    cfg = LLMConfig(model_config=mid, num_kv_blocks=48, block_size=8,
+                    max_batch_size=4, prefill_chunk=8, warmup_buckets="off")
+
+    def prompt(seed, n=65):
+        # Same generator as _bench_serve_resilience: seeds trial+7 give
+        # bit-identical contexts to the re-prefill baseline's.
+        return [(seed * 11 + 5 * i + 2) % 128 for i in range(n)]
+
+    srv = GcsServer()
+
+    def transport(method, m, payload=b""):
+        r = asyncio.run(getattr(srv, f"handle_{method}")(None, m, payload))
+        return r.m, r.payload
+
+    trials = max(3, int(4 * scale))
+    ctx_tokens = 129
+
+    # The owner: a tiny host tier whose watermark demotes straight into
+    # the cluster table. Serving each context then churning the pool
+    # publishes the context's blocks — the owner then "dies" (is dropped).
+    src = build_engine(cfg)
+    src.attach_prefix_store(
+        host_tier=HostPrefixTier(96 << 10, low_watermark=0.05),
+        cluster_store=ClusterPrefixStore(8, replica="bench-owner",
+                                         transport=transport))
+
+    def first_token(eng, toks):
+        rid = eng.add_request(toks, SamplingParams(max_tokens=1))
+        while not any(o.request_id == rid and o.new_token_ids
+                      for o in eng.step()):
+            pass
+
+    for trial in range(-1, trials):          # -1 = warmup context
+        first_token(src, prompt(trial + 7, ctx_tokens))
+        for f in range(6):                   # churn: evict -> spill -> demote
+            first_token(src, prompt(1000 + trial * 10 + f, 41))
+    published = src.cluster_store.published
+    del src
+
+    adopter = build_engine(cfg)
+    adopter.attach_prefix_store(
+        cluster_store=ClusterPrefixStore(8, replica="bench-adopter",
+                                         transport=transport))
+    first_token(adopter, prompt(6, ctx_tokens))  # warm compile, via adopt
+    adopt_ms: List[float] = []
+    for trial in range(trials):
+        hits0 = adopter.cluster_prefix_hits
+        t0 = time.perf_counter()
+        first_token(adopter, prompt(trial + 7, ctx_tokens))
+        dt = (time.perf_counter() - t0) * 1e3
+        if adopter.cluster_prefix_hits - hits0 >= ctx_tokens // 8 - 1:
+            adopt_ms.append(dt)              # only count real adoptions
+    return [{"benchmark": "serve_prefix_adopt_ms",
+             "value": round(min(adopt_ms), 2) if adopt_ms else -1.0,
+             "unit": "ms", "n": 1, "trials": trials,
+             "published_blocks": published}]
+
+
+def _bench_serve_load_sweep(scale: float) -> List[Dict]:
+    """Closed-loop load sweep over fleet sizes (ROADMAP 2b): N client
+    threads each keep exactly one request in flight against a
+    FleetSupervisor fronting 1 then 2 in-process replicas, reporting
+    decode throughput and p99 TTFT per (replicas, clients) point. Every
+    third request asks for max_tokens=1, so its wall latency IS the
+    time-to-first-token under the surrounding load — no streaming hooks
+    needed. The last point repeats (2 replicas, 4 clients) with a
+    drain-based scale-down fired mid-window: the sweep's churn leg, where
+    every request must still complete (drain migrates, it never kills).
+    """
+    import threading
+
+    import jax.numpy as jnp
+
+    from ray_tpu.llm.router import FleetSupervisor, LocalReplica, RouterCore
+    from ray_tpu.llm.serving import LLMConfig, LLMServer
+    from ray_tpu.models import llama
+
+    config = llama.LlamaConfig.tiny(vocab_size=128, max_seq=256,
+                                    dtype=jnp.float32)
+    cfg = LLMConfig(model_config=config, num_kv_blocks=128, block_size=8,
+                    max_batch_size=4, prefill_chunk=8, warmup_buckets="off")
+
+    def prompt(seed, n=33):
+        return [(seed * 11 + 5 * i + 2) % 128 for i in range(n)]
+
+    servers = [LLMServer(cfg), LLMServer(cfg)]
+    for s in servers:
+        s.completions({"prompt": prompt(0), "max_tokens": 4})  # compiles
+
+    def run_point(n_replicas, clients, n_reqs, churn=False):
+        sup = FleetSupervisor(
+            RouterCore(n_replicas, block_size=8),
+            [LocalReplica(servers[i], f"sweep-{i}")
+             for i in range(n_replicas)])
+        lock = threading.Lock()
+        state = {"next": 0, "tokens": 0, "ttft": [], "errors": 0}
+
+        def client():
+            while True:
+                with lock:
+                    i = state["next"]
+                    state["next"] += 1
+                if i >= n_reqs:
+                    return
+                probe = i % 3 == 0
+                t0 = time.perf_counter()
+                try:
+                    resp = sup.completions(
+                        {"prompt": prompt(100 + i),
+                         "max_tokens": 1 if probe else 16})
+                except Exception:
+                    with lock:
+                        state["errors"] += 1
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    state["tokens"] += len(
+                        resp["choices"][0]["token_ids"])
+                    if probe:
+                        state["ttft"].append(dt)
+
+        threads = [threading.Thread(target=client, daemon=True,
+                                    name=f"sweep-client-{c}")
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        if churn:
+            while state["next"] < n_reqs // 3:
+                time.sleep(0.002)
+            _swallow(sup.drain_replica, 1, 0)  # scale-down under load
+        for th in threads:
+            th.join(300)
+        wall = time.perf_counter() - t0
+        ttft = sorted(state["ttft"])
+        p99 = ttft[min(len(ttft) - 1, int(0.99 * len(ttft)))] if ttft \
+            else -1.0
+        return (state["tokens"] / wall, p99 * 1e3, state["errors"])
+
+    out: List[Dict] = []
+    n_reqs = max(9, int(18 * scale))
+    for n_replicas, clients, churn in ((1, 1, False), (1, 4, False),
+                                       (2, 4, False), (2, 4, True)):
+        tps, p99_ms, errors = run_point(n_replicas, clients, n_reqs,
+                                        churn=churn)
+        tag = f"r{n_replicas}_c{clients}" + ("_churn" if churn else "")
+        out.append({"benchmark": f"serve_sweep_tokens_per_s_{tag}",
+                    "value": round(tps, 1), "unit": "tokens/s",
+                    "n": n_reqs, "errors": errors})
+        out.append({"benchmark": f"serve_sweep_ttft_p99_ms_{tag}",
+                    "value": round(p99_ms, 2), "unit": "ms",
+                    "n": n_reqs, "errors": errors})
+    return out
 
 
 def _bench_rlhf(scale: float) -> List[Dict]:

@@ -78,6 +78,25 @@ WIRE_ROUNDTRIP_REGISTRY = {
         oid=b"o" * 20, offset=8, total=128, metadata=b"m", seal=True),
     "AckMsg": lambda: wire.AckMsg(ok=True, error="store full",
                                   existed=True),
+    "PrefixEntryMsg": lambda: wire.PrefixEntryMsg(
+        digest=b"d" * 16, lora_id="summarizer", weights_version=3,
+        block_size=8, n_tokens=16, token_ids=[5, 7, 11, 13],
+        nbytes=1 << 20, owner_replica="1234-abcdef", node_id=b"n" * 14,
+        deployment="llm"),
+    "PrefixLookupMsg": lambda: wire.PrefixLookupMsg(
+        digests=[b"a" * 16, b"b" * 16], lora_id="summarizer",
+        weights_version=2, block_size=8, want_payload=True,
+        replica="5678-fedcba"),
+    "PrefixLookupReplyMsg": lambda: wire.PrefixLookupReplyMsg(
+        found=True, entries=[wire.PrefixEntryMsg(digest=b"a" * 16,
+                                                 n_tokens=8)],
+        error="partial"),
+    "PrefixPurgeMsg": lambda: wire.PrefixPurgeMsg(
+        owner_replica="1234-abcdef", node_id=b"n" * 14, deployment="llm",
+        digests=[b"a" * 16], below_weights_version=4,
+        clear_owner_only=True),
+    "PrefixPurgeReplyMsg": lambda: wire.PrefixPurgeReplyMsg(
+        ok=True, purged=3, owners_cleared=2),
 }
 
 
