@@ -319,7 +319,9 @@ class LLMEngine:
                  enable_prefix_caching: bool = True,
                  speculative_ngram: int = 0,
                  decode_multi_step: int = 1,
-                 prefill_only: bool = False):
+                 prefill_only: bool = False,
+                 unified_ticks: bool = True,
+                 token_budget: Optional[int] = None):
         self.runner = model_runner
         self.block_size = model_runner.block_size
         self.block_manager = BlockManager(
@@ -362,6 +364,7 @@ class LLMEngine:
         # batches (exact acceptance needs argmax determinism).
         self.spec_ngram = int(speculative_ngram)
         self.spec_tokens_accepted = 0
+        self.spec_tokens_proposed = 0
         # Multi-step decode: one dispatch scans k tokens on device (the
         # vLLM multi-step-scheduling analog, done as a lax.scan). The big
         # lever when per-execute dispatch latency (remote TPU relays)
@@ -392,6 +395,25 @@ class LLMEngine:
         self.host_prefix_tokens_saved = 0
         self.cluster_prefix_hits = 0
         self.cluster_prefix_tokens_saved = 0
+        # Unified ragged ticks: ONE mixed kernel launch per iteration —
+        # decode rows (1 token), spec-verify rows (k+1 tokens), and prefill
+        # chunk slices share a token-major batch bucketed on TOTAL token
+        # count, so a long prompt's chunk no longer stalls every running
+        # decode behind a separate rectangular launch. Engages when
+        # decode_multi_step == 1 (the on-device k-token scan is its own
+        # optimized program) and the engine decodes (prefill-only tiers
+        # keep the split path for the disagg handoff discipline).
+        self.unified_ticks = bool(unified_ticks)
+        self._spec_width = 1 + self.spec_ngram
+        # Token budget per unified tick: decode/verify rows are admitted
+        # first, the remainder fills from the prefill backlog. Must cover
+        # every running row's verify width, and stays a multiple of 8 (the
+        # ragged kernel's q_block — token buckets inherit it).
+        budget = (int(token_budget) if token_budget else
+                  self.prefill_chunk + self.max_batch * self._spec_width)
+        budget = max(budget, self.max_batch * self._spec_width, 8)
+        self.token_budget = -(-budget // 8) * 8
+        self._warm_mixed: set = set()   # token buckets already precompiled
 
     # ---- API -------------------------------------------------------------
 
@@ -439,11 +461,29 @@ class LLMEngine:
         if self._rejected:
             outputs.extend(self._rejected)
             self._rejected.clear()
+        if self._use_unified():
+            outputs.extend(self._mixed_tick())
+            return outputs
         if self.prefilling:
             outputs.extend(self._prefill_step())
         if not self.prefill_only and (self.running or self._flights):
             outputs.extend(self._decode_tick())
         return outputs
+
+    def _use_unified(self) -> bool:
+        """Route this iteration through the unified mixed launch. Falls back
+        to the split phases when a feature needs them: the multi-step
+        on-device scan, prefill-only (disagg) engines, requests needing
+        host logits (repetition penalty), or async flights still draining
+        from a pre-unified tick."""
+        if not (self.unified_ticks and self.multi_step == 1
+                and not self.prefill_only):
+            return False
+        if self._flights:
+            return False
+        if not (self.prefilling or self.running):
+            return False
+        return not self._needs_logits(list(self.prefilling) + self.running)
 
     def generate(self, prompts: List[Sequence[int]],
                  params: Optional[SamplingParams] = None,
@@ -587,6 +627,14 @@ class LLMEngine:
             "prefill_tokens_computed": self.prefill_tokens_computed,
             "queued_prefill_tokens": backlog,
             "weights_version": self.weights_version,
+            # Speculation effectiveness (accepted/proposed is the win
+            # ratio) + the runner's compile count: steady-state growth of
+            # step_compiles flags a silent hot-loop recompile.
+            "spec_tokens_proposed": self.spec_tokens_proposed,
+            "spec_tokens_accepted": self.spec_tokens_accepted,
+            "step_compiles": getattr(self.runner, "step_compiles", 0),
+            "unified_ticks": self.unified_ticks,
+            "token_budget": self.token_budget,
         }
         if self.host_prefix_tier is not None:
             t = self.host_prefix_tier.stats()
@@ -1079,7 +1127,22 @@ class LLMEngine:
                 # uses repetition_penalty — warm it too so the "no compile
                 # mid-stream" guarantee covers every sampling feature.
                 r.step(*args)
-        return len(combos)
+        compiled = len(combos)
+        if self.unified_ticks and self.multi_step == 1 \
+                and not self.prefill_only:
+            # The unified tick's whole bucket grid is the TOKEN ladder at
+            # one pinned batch bucket — precompile it so the serving hot
+            # loop runs steady-state with zero compiles.
+            from ray_tpu.llm.model_runner import token_buckets
+
+            S = r.batch_bucket(self.max_batch)
+            for Tb in token_buckets(self.token_budget):
+                if Tb in self._warm_mixed:
+                    continue
+                r.warm_mixed(Tb, S, self._spec_width)
+                self._warm_mixed.add(Tb)
+                compiled += 1
+        return compiled
 
     def _needs_logits(self, reqs) -> bool:
         """Host sampling (full logits fetch) is only needed for features the
@@ -1361,13 +1424,17 @@ class LLMEngine:
     def _ngram_propose(context: List[int], k: int, n: int = 3) -> List[int]:
         """Prompt-lookup proposal (vLLM's ngram speculative method): find
         the most recent earlier occurrence of the trailing (n-1)-gram and
-        propose the k tokens that followed it."""
-        if len(context) < n:
-            return []
-        key = tuple(context[-(n - 1):])
-        for i in range(len(context) - n, -1, -1):
-            if tuple(context[i:i + n - 1]) == key:
-                return list(context[i + n - 1:i + n - 1 + k])
+        propose the k tokens that followed it. Falls back to shorter grams
+        (down to matching just the last token) when the longer key has no
+        earlier occurrence — the lookup-max/min ladder; a weak proposal
+        costs only a wasted verify row, never a wrong token."""
+        for nn in range(min(n, len(context)), 1, -1):
+            key = tuple(context[-(nn - 1):])
+            for i in range(len(context) - nn, -1, -1):
+                if tuple(context[i:i + nn - 1]) == key:
+                    prop = list(context[i + nn - 1:i + nn - 1 + k])
+                    if prop:
+                        return prop
         return []
 
     def _decode_spec(self) -> List[RequestOutput]:
@@ -1453,12 +1520,212 @@ class LLMEngine:
                     break
             req.output.extend(accepted)
             self.spec_tokens_accepted += len(accepted) - 1
+            if prop:
+                from ray_tpu.runtime import metric_defs
+
+                self.spec_tokens_proposed += len(prop)
+                metric_defs.LLM_SPEC_PROPOSED.inc(len(prop))
+                if len(accepted) > 1:
+                    metric_defs.LLM_SPEC_ACCEPTED.inc(len(accepted) - 1)
             outputs.append(self._emit(req, accepted))
             if req.finished_reason:
                 finished.append(req)
         for req in finished:
             self.running.remove(req)
             self.block_manager.release(req)
+        return outputs
+
+    # ---- unified ragged tick --------------------------------------------
+
+    def _mixed_tick(self) -> List[RequestOutput]:
+        """ONE mixed kernel launch per engine iteration (ISSUE 17 tentpole,
+        the Ragged Paged Attention layout): a token-budget batch composer
+        admits decode and spec-verify rows FIRST — running sequences never
+        stall behind a long prompt — then fills the remaining budget from
+        the prefill backlog, and dispatches the whole composition through
+        ModelRunner.step_mixed, bucketed on total token count.
+
+        Speculation runs at ANY temperature here: greedy rows accept by
+        argmax agreement (exactly the split _decode_spec rule) and
+        temperature>0 rows by seeded acceptance (rejection) sampling —
+        keys derive from crc32(request_id) and the token's absolute index,
+        so a failover replay or migrated session re-derives the identical
+        accept/reject trajectory. The tick is synchronous (dispatched
+        stays 0 for every request), which keeps the PR 12 export/migration
+        preconditions trivially true mid-stream."""
+        from ray_tpu.llm.model_runner import _bucket, token_buckets
+        from ray_tpu.runtime import metric_defs
+
+        outputs: List[RequestOutput] = []
+        self._drain_release()
+        W = self._spec_width
+        budget = self.token_budget
+        # The batch dimension is pinned to one bucket (compiles scale with
+        # the token ladder alone) — the composer must respect it as a ROW
+        # cap too, or a backlog of near-finished prefills (many requests,
+        # tiny remaining chunks) overflows cu/out_rows.
+        S = self.runner.batch_bucket(self.max_batch)
+        # -- decode / spec-verify rows first --------------------------------
+        batch = self.running[:self.max_batch]
+        proposals: List[List[int]] = []
+        if batch:
+            spec_left = budget - len(batch)   # 1 token/row is reserved
+            k = self.spec_ngram
+            for r in batch:
+                room = self._cap_tokens - (r.num_tokens + 1)
+                pb = min(k, max(0, room),
+                         r.params.max_tokens - len(r.output) - 1, spec_left)
+                prop = (self._ngram_propose(r.context, pb) if pb > 0 else [])
+                spec_left -= len(prop)
+                proposals.append(prop)
+            for req, prop in zip(list(batch), list(proposals)):
+                if not self.block_manager.allocate(
+                        req, min(req.num_tokens + len(prop) + 1,
+                                 self._cap_tokens)):
+                    # Page pressure: degrade to plain 1-token rows, then
+                    # preempt-newest until the plain tick fits (the same
+                    # fallback ladder as _decode_spec).
+                    self._ensure_pages()
+                    batch = [r for r in batch if r in self.running]
+                    proposals = [[] for _ in batch]
+                    break
+        entries: List[dict] = []
+        used = 0
+        for req, prop in zip(batch, proposals):
+            row = [req.output[-1] if req.output else req.prompt[-1]] + prop
+            entries.append({"req": req, "tokens": row, "prop": prop,
+                            "kind": "decode",
+                            "q_pos": req.num_tokens - 1,
+                            "kv_len": req.num_tokens + len(prop),
+                            "counter": req.num_tokens})
+            used += len(row)
+        # -- remaining budget fills from the prefill backlog ----------------
+        for req in list(self.prefilling):
+            if len(entries) >= S:
+                break
+            c = min(len(req.context) - req.prefilled, self.prefill_chunk,
+                    budget - used)
+            if c <= 0:
+                break
+            entries.append({"req": req,
+                            "tokens": req.context[req.prefilled:
+                                                  req.prefilled + c],
+                            "prop": [], "kind": "prefill", "chunk": c,
+                            "q_pos": req.prefilled,
+                            "kv_len": req.prefilled + c,
+                            "counter": req.prefilled + c})
+            used += c
+            self.prefill_tokens_computed += c
+        if not entries:
+            return outputs
+        # -- assemble the token-major batch ---------------------------------
+        Tb = _bucket(used, token_buckets(budget))
+        if Tb not in self._warm_mixed:
+            # A bucket outside the warmed ladder (or a pre-warmup call):
+            # compile it on a dummy BEFORE the real tokens ride it, so the
+            # steady-state loop never absorbs the stall unannounced.
+            self.runner.warm_mixed(Tb, S, W)
+            self._warm_mixed.add(Tb)
+        flat = np.zeros(Tb, dtype=np.int32)
+        cu = np.zeros(S + 1, dtype=np.int32)
+        q_positions = np.zeros(S, dtype=np.int32)
+        kv_lens = np.zeros(S, dtype=np.int32)
+        tables = np.zeros((S, self.max_blocks_per_seq), dtype=np.int32)
+        out_rows = np.zeros((S, W), dtype=np.int32)
+        props = np.zeros((S, W), dtype=np.int32)
+        prop_lens = np.zeros(S, dtype=np.int32)
+        counters = np.zeros(S, dtype=np.int32)
+        pos = 0
+        for i, e in enumerate(entries):
+            n = len(e["tokens"])
+            flat[pos:pos + n] = e["tokens"]
+            cu[i] = pos
+            cu[i + 1] = pos + n
+            q_positions[i] = e["q_pos"]
+            kv_lens[i] = e["kv_len"]
+            req = e["req"]
+            tables[i, :len(req.blocks)] = req.blocks
+            if e["kind"] == "prefill":
+                # The chunk's LAST row carries the next-token logits.
+                out_rows[i] = pos + n - 1
+            else:
+                # Row j of a decode/verify span: logits after consuming
+                # proposal tokens 0..j-1 (clamped for the padding columns).
+                out_rows[i] = [pos + min(j, n - 1) for j in range(W)]
+            pl = len(e["prop"])
+            if pl:
+                props[i, :pl] = e["prop"]
+            prop_lens[i] = pl
+            counters[i] = e["counter"]
+            pos += n
+        cu[len(entries) + 1:] = pos
+        reqs = [e["req"] for e in entries]
+        temps, top_ks, top_ps, seeds, counters = self._sampling_arrays(
+            reqs, S, counters)
+        accept, samples = self.runner.step_mixed(
+            flat, q_positions, kv_lens, cu, tables, out_rows, props,
+            prop_lens, temps, top_ks, top_ps, seeds, counters,
+            lora_idx=self._lora_idx(reqs, S))
+        acc = np.asarray(accept)
+        smp = np.asarray(samples)
+        # -- commit ---------------------------------------------------------
+        for i, e in enumerate(entries):
+            req = e["req"]
+            if e["kind"] == "prefill":
+                req.prefilled += e["chunk"]
+                if self.block_manager.caching:
+                    full = (min(req.prefilled, len(req.prompt))
+                            // self.block_size)
+                    while req.registered_blocks < full:
+                        j = req.registered_blocks
+                        self.block_manager.register_block(
+                            req, j, req.prefix_hashes[j])
+                        req.registered_blocks += 1
+                if req.prefilled < len(req.context):
+                    continue   # mid-prompt: this chunk's sample is unused
+                self.prefilling.remove(req)
+                if req.output:
+                    # Recomputed after preemption: resume decoding without
+                    # re-sampling already-emitted tokens.
+                    self.running.append(req)
+                    continue
+                token = int(smp[i, 0])
+                req.output.append(token)
+                outputs.append(self._emit(req, [token]))
+                if req.finished_reason:
+                    self.block_manager.release(req)
+                else:
+                    self.running.append(req)
+                continue
+            if req not in self.running:
+                continue   # preempted inside this tick: recompute path
+            prop = e["prop"]
+            accepted: List[int] = []
+            for j, t in enumerate(prop):
+                if not bool(acc[i, j]):
+                    break
+                accepted.append(int(t))
+            # The model's own token after the agreed prefix (greedy rows)
+            # or the residual/bonus sample (temperature rows).
+            accepted.append(int(smp[i, len(accepted)]))
+            room = req.params.max_tokens - len(req.output)
+            accepted = accepted[:max(1, room)]
+            stops = req.params.stop_token_ids or ()
+            for j, t in enumerate(accepted):
+                if t in stops:
+                    accepted = accepted[:j + 1]
+                    break
+            req.output.extend(accepted)
+            if prop:
+                self.spec_tokens_proposed += len(prop)
+                self.spec_tokens_accepted += len(accepted) - 1
+                metric_defs.LLM_SPEC_PROPOSED.inc(len(prop))
+                if len(accepted) > 1:
+                    metric_defs.LLM_SPEC_ACCEPTED.inc(len(accepted) - 1)
+            outputs.append(self._emit(req, accepted))
+            if req.finished_reason:
+                self.running.remove(req)
+                self.block_manager.release(req)
         return outputs
 
     def _decode_sync(self) -> List[RequestOutput]:

@@ -20,12 +20,15 @@ Reference analog: the vLLM engine internals the reference only *places*
 
 from __future__ import annotations
 
+import logging
 import math
 from functools import partial
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
 
 from ray_tpu.models import llama as llama_mod
 from ray_tpu.ops import paged_attention as pa
@@ -46,7 +49,22 @@ def _bucket(n: int, buckets: Sequence[int]) -> int:
             return b
     # Beyond the precomputed set: next power of two (a new compile, never a
     # silent cap — capping would overflow the engine's padded arrays).
+    # ModelRunner._note_shapes makes that compile visible (metric + log)
+    # instead of a silent multi-second hot-loop stall.
     return 1 << (n - 1).bit_length()
+
+
+def token_buckets(budget: int) -> list:
+    """Static token-budget ladder for the unified mixed step: powers of two
+    from 8 up to (and always including) `budget`. Single source of truth for
+    runtime bucketing AND warmup precompilation, mirroring chunk_buckets().
+    Every bucket is a multiple of 8 — the Pallas unified kernel's q_block."""
+    buckets, b = [], 8
+    while b < budget:
+        buckets.append(b)
+        b *= 2
+    buckets.append(budget)
+    return buckets
 
 
 class ModelRunner:
@@ -87,7 +105,28 @@ class ModelRunner:
         self._step_jit = jax.jit(self._step, donate_argnums=(1,))
         self._step_sample_jit = jax.jit(self._step_sample, donate_argnums=(1,))
         self._step_verify_jit = jax.jit(self._step_verify, donate_argnums=(1,))
+        self._step_mixed_jit = jax.jit(self._step_mixed, donate_argnums=(1,))
         self._multi_jits: Dict[int, object] = {}  # n_steps -> jitted scan
+        # Shape signatures already dispatched: a new one means XLA compiles
+        # a fresh program on this call (satellite of ISSUE 17 — silent
+        # hot-loop recompiles become a counted, logged event).
+        self._seen_shapes: set = set()
+        self.step_compiles = 0
+
+    def _note_shapes(self, kind: str, *arrs) -> bool:
+        """Record the padded shape signature entering a jitted entry point.
+        Returns True (bumping ray_tpu_llm_step_compiles_total and logging
+        once) when the signature is new — i.e. this dispatch pays a compile."""
+        key = (kind,) + tuple(tuple(getattr(a, "shape", ())) for a in arrs)
+        if key in self._seen_shapes:
+            return False
+        self._seen_shapes.add(key)
+        self.step_compiles += 1
+        from ray_tpu.runtime import metric_defs
+
+        metric_defs.LLM_STEP_COMPILES.inc()
+        logger.info("llm step compile #%d: %s", self.step_compiles, key)
+        return True
 
     # ---- placement (TP over the mesh, SERVE_RULES) -----------------------
 
@@ -125,6 +164,24 @@ class ModelRunner:
                 out_specs=P(None, None, "tp", None))
         return fn(q, k_pages, v_pages, block_tables, kv_lens, q_positions)
 
+    def _attend_mixed(self, q, k_pages, v_pages, block_tables, kv_lens,
+                      q_positions, cu_q_lens, scale):
+        impl = (pa.ragged_paged_attention_unified
+                if self.attention_impl == "pallas"
+                else pa.ragged_paged_attention_unified_reference)
+        fn = partial(impl, scale=scale)
+        if self.tp > 1:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            fn = shard_map(
+                fn, mesh=self.mesh,
+                in_specs=(P(None, "tp", None), P("tp"), P("tp"),
+                          P(), P(), P(), P()),
+                out_specs=P(None, "tp", None))
+        return fn(q, k_pages, v_pages, block_tables, kv_lens, q_positions,
+                  cu_q_lens)
+
     # ---- the unified step ------------------------------------------------
 
     def _backbone(self, params, cache, tokens, q_positions, kv_lens, q_lens,
@@ -147,8 +204,11 @@ class ModelRunner:
         block_ids = jnp.take_along_axis(
             block_tables, jnp.clip(logical_block, 0,
                                    block_tables.shape[1] - 1), axis=1)
-        # Out-of-range ids drop padded rows' writes (scatter mode="drop").
-        block_ids = jnp.where(valid, block_ids, -1)
+        # Padding rows get id == num_blocks: out of bounds HIGH, which
+        # mode="drop" discards. (-1 would NOT be dropped — JAX wraps
+        # negative indices before the bounds check, so padded rows would
+        # silently corrupt the pool's last page.)
+        block_ids = jnp.where(valid, block_ids, self.num_blocks)
         offsets = positions % self.block_size
         rope_pos = jnp.clip(positions, 0, config.max_seq - 1)
         use_lora = bool(lora)   # static: {}/None compiles the base program
@@ -224,6 +284,178 @@ class ModelRunner:
                             preferred_element_type=jnp.float32)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
+    # ---- the unified RAGGED step (one launch per engine tick) ------------
+
+    def _backbone_mixed(self, params, cache, tokens, q_positions, kv_lens,
+                        cu_q_lens, block_tables, lora=None, lora_idx=None):
+        """Token-major unified backbone: `tokens` is flat (T,) — sequence s
+        owns rows [cu_q_lens[s], cu_q_lens[s+1]) and rows past cu_q_lens[S]
+        are padding. q_positions[s] is the absolute position of s's FIRST
+        query token; kv_lens[s] the context length AFTER this step's
+        tokens. Embed / RoPE / KV-scatter run per token on (T, ...) shapes;
+        attention is the ragged unified kernel — decode rows, spec-verify
+        rows, and prefill chunk slices share ONE launch instead of one
+        rectangular (S, Bq) launch per phase. Returns (hidden (T, d),
+        cache)."""
+        config = self.config
+        T = tokens.shape[0]
+        S = kv_lens.shape[0]
+        H, K, hd = config.n_heads, config.n_kv_heads, config.head_dim
+        scale = 1.0 / math.sqrt(hd)
+        seq = pa.token_seq_ids(cu_q_lens, T, S)              # (T,)
+        local = jnp.arange(T) - cu_q_lens[seq]
+        valid = jnp.arange(T) < cu_q_lens[S]
+        positions = q_positions[seq] + local                 # (T,)
+        x = params["embed"][tokens].astype(config.dtype)     # (T, d)
+        logical_block = positions // self.block_size
+        block_ids = block_tables[seq, jnp.clip(
+            logical_block, 0, block_tables.shape[1] - 1)]
+        # Padding rows get id == num_blocks (out of bounds HIGH, dropped);
+        # -1 would wrap to the pool's last page and corrupt it.
+        block_ids = jnp.where(valid, block_ids, self.num_blocks)
+        offsets = positions % self.block_size
+        rope_pos = jnp.clip(positions, 0, config.max_seq - 1)
+        use_lora = bool(lora)
+        tok_lora = (lora_idx[seq] if use_lora and lora_idx is not None
+                    else None)
+
+        def proj(h, lp, ll, name):
+            out = h @ lp[name]
+            if use_lora and name in ll:
+                from ray_tpu.llm.lora import apply_lora
+
+                # apply_lora is (S, Bq, d)-shaped; flat rows ride as Bq=1
+                # with a per-TOKEN slot index (sequences may differ).
+                out = out + apply_lora(
+                    h[:, None], ll[name]["a"], ll[name]["b"],
+                    tok_lora)[:, 0].astype(out.dtype)
+            return out
+
+        def layer_step(carry, scanned):
+            x, ck, cv = carry
+            lp, li, ll = scanned
+            h = rms_norm(x, lp["attn_norm"], config.norm_eps)
+            q = proj(h, lp, ll, "wq").reshape(T, H, hd)
+            k = proj(h, lp, ll, "wk").reshape(T, K, hd)
+            v = proj(h, lp, ll, "wv").reshape(T, K, hd)
+            q = apply_rope(q, self.cos, self.sin, rope_pos)
+            k = apply_rope(k, self.cos, self.sin, rope_pos)
+            ck = ck.at[li, :, block_ids, offsets].set(k, mode="drop")
+            cv = cv.at[li, :, block_ids, offsets].set(v, mode="drop")
+            attn = self._attend_mixed(q, ck[li], cv[li], block_tables,
+                                      kv_lens, q_positions, cu_q_lens,
+                                      scale)
+            x = x + proj(attn.reshape(T, H * hd), lp, ll, "wo")
+            h = rms_norm(x, lp["mlp_norm"], config.norm_eps)
+            x = x + proj(swiglu(proj(h, lp, ll, "w_gate"),
+                                proj(h, lp, ll, "w_up")), lp, ll, "w_down")
+            return (x, ck, cv), None
+
+        layer_indices = jnp.arange(config.n_layers)
+        (x, ck, cv), _ = jax.lax.scan(
+            layer_step, (x, cache["k"], cache["v"]),
+            (params["layers"], layer_indices, lora if use_lora else {}))
+        x = rms_norm(x, params["final_norm"], config.norm_eps)
+        return x, {"k": ck, "v": cv}
+
+    def _step_mixed(self, params, cache, tokens, q_positions, kv_lens,
+                    cu_q_lens, block_tables, out_rows, proposals, prop_lens,
+                    temps, top_ks, top_ps, seeds, counters, lora=None,
+                    lora_idx=None):
+        """Unified mixed step + on-device seeded acceptance sampling.
+
+        out_rows (S, W): flat hidden-state rows whose logits sequence s
+        reads (decode: its single row, W times; spec verify: the rows
+        after proposal positions 0..k; prefill finals: the chunk's last
+        row). proposals (S, W) / prop_lens (S,): the deterministic draft
+        under test (length 0 for plain rows). Row (s, j) carries generation
+        counter counters[s] + j — the SAME absolute-index keying as the
+        plain sampler, so a row with no proposal degenerates bit-identically
+        to _step_sample.
+
+        Returns (accept (S, W) bool, samples (S, W) int32, cache):
+          accept[s, j]  — proposal j passes (greedy rows: argmax matches;
+                          temp>0 rows: u < p(proposal), the rejection test
+                          against the FILTERED target distribution — the
+                          draft is a point mass, so q(proposal) = 1)
+          samples[s, j] — the token to commit when j is the first rejected
+                          slot (temp>0: a residual sample with the proposal
+                          masked out) or the bonus slot (the full filtered
+                          distribution under the plain sampler's key).
+        The host commits proposals[s, :n_acc] + [samples[s, n_acc]]."""
+        x, cache = self._backbone_mixed(params, cache, tokens, q_positions,
+                                        kv_lens, cu_q_lens, block_tables,
+                                        lora, lora_idx)
+        S, W = out_rows.shape
+        rows = x[out_rows.reshape(-1)]                       # (S*W, d)
+        # Same head expression as _step/_step_verify: fp32 accumulation via
+        # preferred_element_type so unified and split ticks round alike.
+        logits = jnp.matmul(rows,
+                            params["lm_head"].astype(self.config.dtype),
+                            preferred_element_type=jnp.float32)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def rep(a):
+            return jnp.repeat(a, W)
+
+        scaled = self._filter_logits(logits, rep(temps), rep(top_ks),
+                                     rep(top_ps))
+        j_idx = jnp.tile(jnp.arange(W), S)
+        n = rep(counters) + j_idx                            # (S*W,)
+        is_bonus = j_idx >= rep(prop_lens)
+        prop_flat = proposals.reshape(-1)
+
+        def one_row(seed, counter, lg, prop):
+            base = jax.random.fold_in(jax.random.key(seed), counter)
+            # `full` uses EXACTLY the plain sampler's key (_device_sample's
+            # `one`): bonus slots and spec-off rows reproduce the
+            # non-speculative stream bit for bit. u / resid fold in fixed
+            # subkeys so a replayed request re-derives the identical
+            # accept/reject trajectory (failover + migration determinism).
+            full = jax.random.categorical(base, lg)
+            u = jax.random.uniform(jax.random.fold_in(base, 101))
+            resid = jax.random.categorical(
+                jax.random.fold_in(base, 102),
+                lg.at[prop].set(self.NEG_INF))
+            return full, u, resid, jax.nn.softmax(lg)[prop]
+
+        full, u, resid, p_prop = jax.vmap(one_row)(
+            rep(seeds), n, scaled, prop_flat)
+        grow = rep(temps) <= 0.0
+        accept = jnp.where(grow, greedy == prop_flat, u < p_prop)
+        samples = jnp.where(
+            grow, greedy,
+            jnp.where(is_bonus, full.astype(jnp.int32),
+                      resid.astype(jnp.int32)))
+        return accept.reshape(S, W), samples.reshape(S, W), cache
+
+    def step_mixed(self, tokens, q_positions, kv_lens, cu_q_lens,
+                   block_tables, out_rows, proposals, prop_lens, temps,
+                   top_ks, top_ps, seeds, counters, lora_idx=None):
+        """One unified ragged launch for a mixed decode / spec-verify /
+        prefill batch, bucketed on total token count T rather than the
+        (batch, Bq) product. Returns (accept (S, W) bool, samples (S, W)
+        int32) as host numpy-convertible arrays."""
+        self._note_shapes("mixed", tokens, out_rows, block_tables)
+        lora, idx = self._lora_args(lora_idx, len(kv_lens))
+        accept, samples, self.cache = self._step_mixed_jit(
+            self.params, self.cache, tokens, q_positions, kv_lens,
+            cu_q_lens, block_tables, out_rows, proposals, prop_lens, temps,
+            top_ks, top_ps, seeds, counters, lora, idx)
+        return accept, samples
+
+    def warm_mixed(self, T: int, S: int, W: int):
+        """Precompile the mixed-step program for token bucket T without
+        touching cache state: cu_q_lens all zero makes every row padding,
+        so every KV write drops and the outputs are ignored."""
+        import numpy as np
+
+        z = lambda *s: np.zeros(s, np.int32)
+        self.step_mixed(
+            z(T), z(S), z(S), z(S + 1), z(S, self.max_blocks_per_seq),
+            z(S, W), z(S, W), z(S), np.zeros(S, np.float32), z(S),
+            np.ones(S, np.float32), z(S), z(S))
+
     def _lora_args(self, lora_idx, batch: int):
         if self.lora is None:
             return {}, None
@@ -235,6 +467,7 @@ class ModelRunner:
              lora_idx=None):
         """Run one bucketed step; inputs are host arrays already padded to a
         (batch, Bq) bucket by the engine. Returns logits (S, vocab)."""
+        self._note_shapes("step", tokens, block_tables)
         lora, idx = self._lora_args(lora_idx, len(tokens))
         logits, self.cache = self._step_jit(
             self.params, self.cache, tokens, q_positions, kv_lens, q_lens,
@@ -246,6 +479,7 @@ class ModelRunner:
         """One bucketed verify step: returns greedy token ids (S, Bq) —
         position j's id is the model's next token after consuming
         tokens[:, :j+1] (the speculative-decoding acceptance input)."""
+        self._note_shapes("verify", tokens, block_tables)
         lora, idx = self._lora_args(lora_idx, len(tokens))
         toks, self.cache = self._step_verify_jit(
             self.params, self.cache, tokens, q_positions, kv_lens, q_lens,
@@ -256,15 +490,14 @@ class ModelRunner:
 
     NEG_INF = -1e30
 
-    def _device_sample(self, logits, temps, top_ks, top_ps, seeds, counters):
-        """Vectorized per-sequence sampling on device: greedy (temp 0),
-        temperature, top-k, top-p, seeded. Keeps the decode loop free of
-        (S, vocab) device->host logit transfers — only sampled token ids
-        cross the wire (the latency win that makes async decode possible).
-        top-p keeps the smallest prefix with mass >= p (crossing token
-        included, vLLM semantics)."""
+    def _filter_logits(self, logits, temps, top_ks, top_ps):
+        """Temperature / top-k / top-p filtering shared by the plain sampler
+        and the mixed-step acceptance sampler — ONE implementation, so the
+        unified and split tick paths round identically (their bit-identity
+        rides on it). top-p keeps the smallest prefix with mass >= p
+        (crossing token included, vLLM semantics). Returns filtered scaled
+        logits; sampling from softmax of them is the target distribution."""
         S, V = logits.shape
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         scaled = logits / jnp.maximum(temps[:, None], 1e-6)
         sorted_desc = -jnp.sort(-scaled, axis=-1)
         k_eff = jnp.where(top_ks > 0, top_ks, V)
@@ -280,7 +513,15 @@ class ModelRunner:
         keep_sorted = (csum - sp) < top_ps[:, None]
         cutoff = jnp.min(jnp.where(keep_sorted, sp, jnp.inf), axis=-1,
                          keepdims=True)
-        scaled = jnp.where(probs >= cutoff, scaled, self.NEG_INF)
+        return jnp.where(probs >= cutoff, scaled, self.NEG_INF)
+
+    def _device_sample(self, logits, temps, top_ks, top_ps, seeds, counters):
+        """Vectorized per-sequence sampling on device: greedy (temp 0),
+        temperature, top-k, top-p, seeded. Keeps the decode loop free of
+        (S, vocab) device->host logit transfers — only sampled token ids
+        cross the wire (the latency win that makes async decode possible)."""
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = self._filter_logits(logits, temps, top_ks, top_ps)
 
         def one(seed, counter, lg):
             key = jax.random.fold_in(jax.random.key(seed), counter)
@@ -305,6 +546,7 @@ class ModelRunner:
         (the previous step's output — async chaining without host sync).
         Returns the sampled token ids as a device array; the caller decides
         when to fetch (overlap the transfer with the next dispatch)."""
+        self._note_shapes("sample", tokens, block_tables)
         lora, idx = self._lora_args(lora_idx, len(tokens))
         toks, self.cache = self._step_sample_jit(
             self.params, self.cache, tokens, q_positions, kv_lens, q_lens,
@@ -344,6 +586,7 @@ class ModelRunner:
         """n_steps decode tokens per sequence in one dispatch. kv_lens /
         counters are the FIRST step's values (advance on device). Returns
         device int32 (S, n_steps)."""
+        self._note_shapes(f"multi{n_steps}", tokens, block_tables)
         fn = self._multi_jits.get(n_steps)
         if fn is None:
             fn = jax.jit(partial(self._step_sample_multi, n_steps),

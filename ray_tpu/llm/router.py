@@ -657,16 +657,13 @@ class FleetSupervisor:
             # as failures and the requests replay when capacity returns.
             return {"migrated": [], "replayed": [], "target": None}
         self._drain_target[idx] = target
-        # Working-set handoff first: stream the victim's hottest reusable
-        # prefix pages to the target before the live sessions move, so the
-        # fleet's shared prompts stay warm across the drain (the successor
-        # serves them with zero re-prefill). Best-effort — these pages were
-        # already spill candidates, a failed push costs nothing.
-        try:
-            self.replicas[idx].call("push_prefixes",
-                                    self._handoff_addr(target))
-        except Exception:
-            pass
+        # Live sessions move FIRST: migrate_sessions quiesces admission and
+        # snapshots the in-flight set, so the sessions decoding at the
+        # moment the drain lands are the ones that travel with their KV.
+        # Streaming the warm working set before this opened a window of
+        # hundreds of ms in which fast-cycling sessions finished and their
+        # affinity-pinned successors were admitted mid-prefill — the live
+        # capture then found nothing to migrate and replayed everything.
         try:
             addr = self._handoff_addr(target)
             summary = self.replicas[idx].call("migrate_sessions", addr)
@@ -677,6 +674,16 @@ class FleetSupervisor:
             self.eject_replica(idx, reason=f"died during drain: {e!r:.60}")
             return {"migrated": [], "replayed": [], "target": target,
                     "error": repr(e)}
+        # Working-set handoff second: stream the victim's hottest reusable
+        # prefix pages to the target so the fleet's shared prompts stay
+        # warm across the drain (the successor serves them with zero
+        # re-prefill). Best-effort — these pages were already spill
+        # candidates, a failed push costs nothing.
+        try:
+            self.replicas[idx].call("push_prefixes",
+                                    self._handoff_addr(target))
+        except Exception:
+            pass
         for rid in summary.get("send_failed", ()):
             # A migration send that failed with a lost ack may have left
             # the session adopted on the target — decoding with no

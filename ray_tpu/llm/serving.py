@@ -85,6 +85,14 @@ class LLMConfig:
     # scan (engine.py) — the decode-throughput lever when dispatch latency
     # rivals per-token compute (remote-attached TPUs).
     decode_multi_step: int = 1
+    # Unified ragged ticks (engine.py _mixed_tick): decode rows, spec-verify
+    # rows, and prefill chunk slices share ONE kernel launch per step,
+    # bucketed on total token budget. token_budget=None sizes the flat-token
+    # ceiling as prefill_chunk + max_batch * (1 + speculative_ngram). The
+    # split per-phase path remains for decode_multi_step > 1, prefill-only
+    # replicas, and logit-feedback sampling (repetition penalty).
+    unified_ticks: bool = True
+    token_budget: Optional[int] = None
     # Precompile step buckets at replica start so user requests don't pay
     # XLA compiles mid-stream (vLLM-TPU startup precompile; a cold bucket
     # costs seconds of TTFT on multi-B-param models). "full" = whole
@@ -186,6 +194,8 @@ def build_engine(llm_config: LLMConfig, prefill_only: bool = False):
         enable_prefix_caching=llm_config.enable_prefix_caching,
         speculative_ngram=llm_config.speculative_ngram,
         decode_multi_step=llm_config.decode_multi_step,
+        unified_ticks=llm_config.unified_ticks,
+        token_budget=llm_config.token_budget,
         prefill_only=prefill_only)
     wm = llm_config.warmup_buckets
     wm = {True: "full", False: "off"}.get(wm, wm)

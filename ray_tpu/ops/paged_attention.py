@@ -137,6 +137,210 @@ def _rpa_kernel(block_tables_ref, kv_lens_ref, q_pos_ref,   # scalar prefetch
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
+def token_seq_ids(cu_q_lens, T: int, S: int):
+    """Sequence id per flat token (count of cu boundaries at or below it),
+    clamped into [0, S-1] so padding tokens index real scalar rows; the
+    caller masks them out separately (tok >= cu_q_lens[S])."""
+    tok = jnp.arange(T)
+    seq = jnp.sum(tok[:, None] >= cu_q_lens[None, 1:], axis=1).astype(
+        jnp.int32)
+    return jnp.minimum(seq, S - 1)
+
+
+def ragged_paged_attention_unified_reference(
+        q, k_pages, v_pages, block_tables, kv_lens, q_positions, cu_q_lens,
+        *, scale: Optional[float] = None):
+    """Token-major unified reference: q is flat (T, H, hd), sequences own
+    contiguous row spans delimited by cu_q_lens (S+1 cumulative starts).
+
+    Implemented by scattering the flat rows back into the rectangular
+    (S, T, H, hd) layout and calling ragged_paged_attention_reference —
+    per-row math is THE SAME FUNCTION, so a unified mixed launch is
+    bit-identical to the split rectangular launches it replaces (the CPU-CI
+    anchor for the engine's unified-vs-split-tick identity tests)."""
+    T, H, hd = q.shape
+    S = kv_lens.shape[0]
+    seq = token_seq_ids(cu_q_lens, T, S)
+    local = jnp.arange(T) - cu_q_lens[seq]
+    valid = jnp.arange(T) < cu_q_lens[S]
+    # Padding tokens scatter to column T (out of bounds -> dropped): never
+    # a wrapped negative index, which would silently overwrite real rows.
+    qr = jnp.zeros((S, T, H, hd), q.dtype).at[
+        seq, jnp.where(valid, local, T)].set(q, mode="drop")
+    out_r = ragged_paged_attention_reference(
+        qr, k_pages, v_pages, block_tables, kv_lens, q_positions,
+        scale=scale)
+    out = out_r[seq, jnp.minimum(local, T - 1)]
+    return jnp.where(valid[:, None, None], out, jnp.zeros_like(out))
+
+
+def _rua_kernel(block_tables_ref, kv_lens_ref, q_pos_ref, cu_ref,  # prefetch
+                q_ref, kpages_hbm, vpages_hbm,                     # tensors
+                o_ref,                                             # output
+                k_scr, v_scr, sems,                                # scratch
+                *, ps: int, scale: float, TB: int, G: int, hd: int, S: int):
+    """Grid: (T // TB, K). Block q_ref/o_ref: (1, TB, G, hd) — TB flat
+    query tokens for kv head `kh`; a block may span several sequences, so
+    rows carry their own sequence id (derived from the prefetched
+    cu_q_lens) and every page contribution is masked per row. KV pages
+    stay in HBM; each sequence in the block walks only its own
+    ceil(kv_len/ps) pages, double-buffer DMA'd into VMEM and folded into
+    an online softmax."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    blk = pl.program_id(0)
+    kh = pl.program_id(1)
+    rows = TB * G
+    q = q_ref[0].astype(jnp.float32).reshape(rows, hd) * scale
+
+    # Global token index per row (row r belongs to token r // G).
+    tok = blk * TB + jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // G
+    n_real = cu_ref[S]
+    row_valid = tok < n_real
+
+    def count_seq(s, acc):
+        return acc + (tok >= cu_ref[s]).astype(jnp.int32)
+
+    seq = jax.lax.fori_loop(
+        1, S + 1, count_seq, jnp.zeros((rows, 1), jnp.int32))
+    seq = jnp.minimum(seq, S - 1)
+
+    def seq_of(t):
+        def cnt(s, acc):
+            return acc + jnp.where(t >= cu_ref[s], 1, 0)
+
+        return jnp.minimum(jax.lax.fori_loop(1, S + 1, cnt, 0), S - 1)
+
+    s_lo = seq_of(blk * TB)
+    s_hi = seq_of(jnp.minimum(blk * TB + TB - 1, jnp.maximum(n_real - 1, 0)))
+
+    def seq_body(s, carry):
+        m, l, acc = carry
+        kv_len = kv_lens_ref[s]
+        n_pages = pl.cdiv(kv_len, ps)
+        mine = (seq == s) & row_valid                       # (rows, 1)
+        q_abs = q_pos_ref[s] + (tok - cu_ref[s])            # (rows, 1)
+
+        def page_dma(slot, i):
+            page = block_tables_ref[s, i]
+            return (pltpu.make_async_copy(kpages_hbm.at[kh, page],
+                                          k_scr.at[slot], sems.at[slot, 0]),
+                    pltpu.make_async_copy(vpages_hbm.at[kh, page],
+                                          v_scr.at[slot], sems.at[slot, 1]))
+
+        @pl.when(n_pages > 0)
+        def _():
+            kd, vd = page_dma(0, 0)
+            kd.start()
+            vd.start()
+
+        def body(i, carry):
+            m, l, acc = carry
+            slot = jax.lax.rem(i, 2)
+
+            @pl.when(i + 1 < n_pages)
+            def _():
+                nk, nv = page_dma(1 - slot, i + 1)
+                nk.start()
+                nv.start()
+
+            kw, vw = page_dma(slot, i)
+            kw.wait()
+            vw.wait()
+            k_page = k_scr[slot].astype(jnp.float32)        # (ps, hd)
+            v_page = v_scr[slot].astype(jnp.float32)
+            sc = q @ k_page.T                               # (rows, ps)
+            k_pos = i * ps + jax.lax.broadcasted_iota(
+                jnp.int32, (rows, ps), 1)
+            ok = mine & (k_pos < kv_len) & (q_abs >= k_pos)
+            sc = jnp.where(ok, sc, NEG_INF)
+            m_new = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
+            # Explicit zero where masked: rows of OTHER sequences see an
+            # all-NEG_INF page, and exp(NEG_INF - NEG_INF) == 1 would leak
+            # phantom mass into their (still-empty) softmax state.
+            p = jnp.where(ok, jnp.exp(sc - m_new), 0.0)
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+            acc_new = alpha * acc + p @ v_page
+            return m_new, l_new, acc_new
+
+        return jax.lax.fori_loop(0, n_pages, body, (m, l, acc))
+
+    m0 = jnp.full((rows, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((rows, 1), dtype=jnp.float32)
+    a0 = jnp.zeros((rows, hd), dtype=jnp.float32)
+    m, l, acc = jax.lax.fori_loop(s_lo, s_hi + 1, seq_body, (m0, l0, a0))
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.reshape(TB, G, hd).astype(o_ref.dtype)
+
+
+def ragged_paged_attention_unified(q, k_pages, v_pages, block_tables,
+                                   kv_lens, q_positions, cu_q_lens, *,
+                                   scale: Optional[float] = None,
+                                   q_block: int = 8,
+                                   interpret: Optional[bool] = None):
+    """Pallas unified ragged paged attention: ONE launch for a mixed batch
+    where each sequence contributes its own query-token count (decode = 1,
+    spec verify = k+1, prefill chunk = up to chunk tokens).
+
+    Layouts (vs the rectangular entry above):
+      q:         (T, H, hd) flat token-major; sequence s owns rows
+                 [cu_q_lens[s], cu_q_lens[s+1]); rows past cu_q_lens[S]
+                 are padding
+      cu_q_lens: (S+1,) int32 cumulative query starts
+      block_tables/kv_lens/q_positions: per-sequence, as the rectangular
+                 entry (q_positions[s] = absolute position of the FIRST
+                 query token of s)
+
+    T must be a multiple of q_block (the engine pads to token-budget
+    buckets, all multiples of 8)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, H, hd = q.shape
+    K, P, ps, _ = k_pages.shape
+    S = kv_lens.shape[0]
+    G = H // K
+    TB = q_block
+    if T % TB:
+        raise ValueError(f"T={T} not a multiple of q_block={TB}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+    if interpret is None:
+        from ray_tpu.ops import is_tpu_backend
+
+        interpret = not is_tpu_backend()
+
+    # (T, H, hd) -> (K, T, G, hd): one kv head's query rows contiguous.
+    qt = q.reshape(T, K, G, hd).transpose(1, 0, 2, 3)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(T // TB, K),
+        in_specs=[
+            pl.BlockSpec((1, TB, G, hd), lambda blk, kh, *_: (kh, blk, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # k pages stay in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # v pages stay in HBM
+        ],
+        out_specs=pl.BlockSpec((1, TB, G, hd),
+                               lambda blk, kh, *_: (kh, blk, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, hd), k_pages.dtype),
+            pltpu.VMEM((2, ps, hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    kernel = functools.partial(
+        _rua_kernel, ps=ps, scale=scale, TB=TB, G=G, hd=hd, S=S)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((K, T, G, hd), q.dtype),
+        interpret=interpret,
+    )(block_tables, kv_lens, q_positions, cu_q_lens, qt, k_pages, v_pages)
+    return out.transpose(1, 0, 2, 3).reshape(T, H, hd)
+
+
 def ragged_paged_attention(q, k_pages, v_pages, block_tables, kv_lens,
                            q_positions, *, scale: Optional[float] = None,
                            interpret: Optional[bool] = None):

@@ -116,6 +116,22 @@ SERVE_REQUESTS = Counter(
     tag_keys=("deployment",))
 LLM_TOKENS_GENERATED = Counter(
     "ray_tpu_llm_tokens_generated_total", "tokens sampled by LLM engines")
+LLM_STEP_COMPILES = Counter(
+    "ray_tpu_llm_step_compiles_total",
+    "XLA compiles triggered by new step-shape signatures (warmup pays "
+    "these; any growth in the steady-state loop is a silent-recompile "
+    "stall worth chasing)")
+
+# Speculative decoding (engine n-gram drafts + unified-tick acceptance
+# sampling): the accepted/proposed ratio is the speculation win per
+# deployment — near 1.0 means the draft source predicts the model well,
+# near 0 means verify launches are wasted work.
+LLM_SPEC_PROPOSED = Counter(
+    "ray_tpu_llm_spec_proposed_total",
+    "draft tokens submitted to speculative verification")
+LLM_SPEC_ACCEPTED = Counter(
+    "ray_tpu_llm_spec_accepted_total",
+    "draft tokens accepted by speculative verification")
 
 # Per-replica engine depth + KV occupancy: the same numbers
 # LLMServer.engine_stats() feeds the router's pow2/admission logic, pushed

@@ -543,6 +543,7 @@ def _bench_serve_mixed(scale: float) -> List[Dict]:
 
     def chatty_gaps(server, submit_long):
         stop = threading.Event()
+        done = [0]                 # pressure completions (2 tokens each)
 
         def pressure():
             while not stop.is_set():
@@ -550,6 +551,7 @@ def _bench_serve_mixed(scale: float) -> List[Dict]:
                     submit_long()
                 except Exception:
                     return
+                done[0] += 1
 
         # Two pressure threads keep a long prefill in flight continuously —
         # a lone thread leaves idle windows between requests that let the
@@ -562,22 +564,33 @@ def _bench_serve_mixed(scale: float) -> List[Dict]:
         next(gen)                  # chatty decoding before pressure starts
         for t in ts:
             t.start()
-        gaps, last = [], time.perf_counter()
+        gaps, t0 = [], time.perf_counter()
+        last = t0
         for chunk in gen:
             now = time.perf_counter()
             if chunk.get("token") is not None:
                 gaps.append(now - last)
                 last = now
+        elapsed = last - t0
         stop.set()
         for t in ts:
             t.join(60)
-        return gaps
+        return gaps, elapsed, done[0]
 
     # One-shot 225-token prefill chunks: the regime disaggregation targets
     # is an expensive chunk stalling the decode batch (big models / long
     # prompts); chunk=8 on the tiny model makes a chunk as cheap as a
     # decode step and measures nothing.
-    colo = LLMServer(cfg(prefill_chunk=256))
+    # colocated pins unified_ticks=False: it IS the split-phase baseline the
+    # unified leg is measured against. The unified leg runs the same server
+    # config with unified ragged ticks (the default) and a 64-token budget:
+    # the composer slices the 225-token prefills across ticks with the
+    # chatty decode row riding EVERY launch, so the inter-token gap is one
+    # small mixed launch instead of a whole 256-token chunk dispatch plus a
+    # decode tick. (The split path can't do this: its scheduling quantum IS
+    # the prefill chunk, and decode waits out each chunk.)
+    colo = LLMServer(cfg(prefill_chunk=256, unified_ticks=False))
+    unified = LLMServer(cfg(prefill_chunk=256, token_budget=64))
     decode = LLMServer(cfg(prefill_chunk=256, disaggregate=1))
     addr = decode.handoff_address()
 
@@ -614,20 +627,35 @@ def _bench_serve_mixed(scale: float) -> List[Dict]:
              lambda _pre: colo.completions(
                  {"prompt": next_long(), "max_tokens": 2}),
              lambda: None),
+            ("unified", unified,
+             lambda _pre: unified.completions(
+                 {"prompt": next_long(), "max_tokens": 2}),
+             lambda: None),
             ("disagg", decode, replay_handoff,
              lambda: capture_handoffs(80)))
     # Best of 2 trials per leg: a descheduling blip in the pressure thread
     # on a small box corrupts the tail the leg exists to compare.
     for name, server, submit_long, setup in legs:
-        best, n = float("inf"), 0
+        best, best_tps, n = float("inf"), 0.0, 0
         for _ in range(2):
             pre = setup()
-            gaps = chatty_gaps(server, lambda: submit_long(pre))
+            gaps, elapsed, done = chatty_gaps(server,
+                                              lambda: submit_long(pre))
             n = len(gaps)
             best = min(best, float(np.percentile(gaps, 99)))
+            if elapsed > 0:
+                best_tps = max(best_tps, (len(gaps) + 2 * done) / elapsed)
         out.append({"benchmark": f"serve_{name}_itl_p99_ms",
                     "value": round(best * 1e3, 2),
                     "unit": "ms", "n": n, "trials": 2})
+        # tokens/s under the same pressure (chatty + pressure completions):
+        # the guard that a better tail wasn't bought by starving throughput.
+        # The disagg leg's pressure tokens ride pre-captured handoffs, not
+        # comparable work — only the apples-to-apples legs report it.
+        if name in ("colocated", "unified"):
+            out.append({"benchmark": f"serve_{name}_tokens_per_s",
+                        "value": round(best_tps, 1),
+                        "unit": "tokens/s", "n": n, "trials": 2})
     return out
 
 

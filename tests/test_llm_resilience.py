@@ -206,6 +206,67 @@ def test_failover_replay_is_token_identical(setup, captured_events,
     assert events.LLM_REPLICA_EJECTED in types
 
 
+def test_spec_acceptance_failover_replay_token_identical(setup):
+    """Speculative decoding at temperature>0 (unified-tick seeded acceptance
+    sampling): accept/reject draws key on (crc32(request_id), absolute token
+    index) and the n-gram drafts are pure functions of sequence history, so
+    the survivor's replay reproduces the victim's trajectory bit-exactly."""
+    from ray_tpu.llm.router import FleetSupervisor, LocalReplica, RouterCore
+    from ray_tpu.llm.serving import LLMServer
+
+    spec = dict(speculative_ngram=3)
+    # A cyclic prompt keeps the n-gram proposer firing, so the replayed
+    # trajectory exercises real accept/reject draws, not just the spec-off
+    # sampler.
+    req = {"prompt": [5, 9, 13, 5, 9, 13, 5, 9, 13, 5, 9],
+           "max_tokens": 14, "request_id": "spec-replay",
+           "session_id": "sr", "temperature": 0.8, "top_k": 20}
+
+    ref_server = LLMServer(_cfg(setup, **spec))
+    ref = ref_server.completions(dict(req))
+    assert ref_server.engine.spec_tokens_proposed > 0  # drafts actually ran
+
+    victim = _FlakyReplica(LLMServer(_cfg(setup, **spec)))
+    survivor = LLMServer(_cfg(setup, **spec))
+    core = RouterCore(2, fail_threshold=1)
+    sup = FleetSupervisor(core, [LocalReplica(victim, "victim"),
+                                 LocalReplica(survivor, "survivor")])
+    core._session_owner["sr"] = 0
+
+    resp = sup.completions(dict(req))
+    assert "error" not in resp, resp
+    assert resp["choices"][0]["token_ids"] == ref["choices"][0]["token_ids"]
+    assert survivor.engine.spec_tokens_proposed > 0
+
+
+def test_spec_acceptance_migration_token_identical(setup):
+    """A speculating temperature>0 session live-migrated mid-decode resumes
+    on the target with its (seed, absolute-counter) sampling state carried
+    in the portable state, so the collected output still equals the
+    uninterrupted reference."""
+    from ray_tpu.llm.serving import LLMServer
+
+    spec = dict(speculative_ngram=3)
+    req = {"prompt": [5, 9, 13, 5, 9, 13, 5, 9, 13, 5, 9],
+           "max_tokens": 24, "request_id": "spec-mig",
+           "temperature": 0.8, "top_k": 20}
+    ref = LLMServer(_cfg(setup, **spec)).completions(dict(req))
+
+    src, dst = LLMServer(_cfg(setup, **spec)), LLMServer(_cfg(setup, **spec))
+    box = _bg_collect(src, req)
+    assert _wait_running(src)
+    summary = src.migrate_sessions(dst.handoff_address())
+    box["thread"].join(15)
+    if summary["migrated"] == ["spec-mig"]:
+        resp = dst.completions_collect("spec-mig")
+    else:
+        # Raced to completion before the drain plane took it — the src
+        # result must then already be the full (identical) stream.
+        assert "resp" in box, box
+        resp = box["resp"]
+    assert resp["choices"][0]["token_ids"] == ref["choices"][0]["token_ids"]
+
+
 def test_decode_failover_aborts_orphan_no_kv_leak(setup):
     """Decode replica 'dies' AFTER admitting the request: the failover path
     must abort the orphan server-side so it stops holding KV pages, and

@@ -1,0 +1,281 @@
+"""Unified ragged ticks: one mixed prefill+decode launch per engine step.
+
+Anchors the tentpole's correctness contract at three layers:
+
+  * kernel — the token-major unified reference is BIT-identical per row to
+    the rectangular per-sequence reference (same math, different layout),
+    and the Pallas unified kernel (interpret mode on CPU) matches it
+    numerically;
+  * engine — a unified mixed tick produces bit-identical output to the
+    split prefill-then-decode path for the same admitted schedule, greedy
+    AND seeded temperature sampling, with zero pickling on the hot loop;
+  * speculation — n-gram drafts verified by seeded acceptance sampling
+    replay deterministically (same request id -> same tokens), and the
+    warmed T-bucket ladder holds steady state at zero recompiles.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu  # noqa: F401
+
+
+def _tiny(vocab=128, max_seq=64):
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    # fp32: greedy argmax must be noise-free for exact unified-vs-split.
+    return llama.LlamaConfig.tiny(vocab_size=vocab, max_seq=max_seq,
+                                  dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def setup(cpu_jax):
+    import jax
+
+    from ray_tpu.models import llama
+
+    config = _tiny()
+    params = llama.init_params(config, jax.random.key(0))
+    return config, params
+
+
+def _engine(config, params, *, unified, spec=0, **kw):
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.model_runner import ModelRunner
+
+    runner = ModelRunner(config, params, num_blocks=64, block_size=8,
+                         chunk_size=8)
+    return LLMEngine(runner, max_batch_size=4, prefill_chunk=8,
+                     unified_ticks=unified, speculative_ngram=spec, **kw)
+
+
+def naive_greedy(params, config, prompt, n_steps):
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    tokens = list(prompt)
+    for _ in range(n_steps):
+        logits = llama.forward(params, jnp.asarray([tokens], dtype=jnp.int32),
+                               config)
+        tokens.append(int(np.argmax(np.asarray(logits[0, -1]))))
+    return tokens[len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# Kernel layer: token-major ragged layout vs rectangular per-sequence.
+# ---------------------------------------------------------------------------
+
+
+def _ragged_case(seed=0, S=3, K=2, H=4, hd=8, ps=4, max_pages=6):
+    """A mixed batch: one decode row (1 token), one spec-verify-sized chunk
+    (3 rows), one prefill slice (8 rows) — plus flat-tail padding."""
+    rng = np.random.default_rng(seed)
+    q_lens = [1, 3, 8]
+    T = 16                                   # multiple of q_block=8, > sum
+    cu = np.zeros(S + 1, np.int32)
+    cu[1:] = np.cumsum(q_lens)
+    kv_lens = np.asarray([9, 11, 8], np.int32)   # context incl. new tokens
+    q_positions = kv_lens - np.asarray(q_lens, np.int32)
+    P = 1 + S * max_pages
+    k_pages = rng.standard_normal((K, P, ps, hd), dtype=np.float32)
+    v_pages = rng.standard_normal((K, P, ps, hd), dtype=np.float32)
+    block_tables = np.arange(S * max_pages, dtype=np.int32).reshape(
+        S, max_pages) + 1
+    q = rng.standard_normal((T, H, hd), dtype=np.float32)
+    return q, k_pages, v_pages, block_tables, kv_lens, q_positions, cu
+
+
+def test_unified_reference_matches_rectangular_per_sequence(cpu_jax):
+    """Each sequence's rows through the token-major layout equal the same
+    rows pushed through the rectangular per-sequence reference. Tolerance
+    is last-ulp only: XLA reduction order differs between batch shapes,
+    the math does not. (The token-level bit-identity contract is enforced
+    at the engine layer below, where both paths sample identical ids.)"""
+    import jax.numpy as jnp
+
+    from ray_tpu.ops import paged_attention as pa
+
+    q, kp, vp, bt, kv_lens, q_pos, cu = _ragged_case()
+    out = pa.ragged_paged_attention_unified_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(bt),
+        jnp.asarray(kv_lens), jnp.asarray(q_pos), jnp.asarray(cu))
+    out = np.asarray(out)
+    S = len(kv_lens)
+    for s in range(S):
+        rect = pa.ragged_paged_attention_reference(
+            jnp.asarray(q[cu[s]:cu[s + 1]][None]), jnp.asarray(kp),
+            jnp.asarray(vp), jnp.asarray(bt[s:s + 1]),
+            jnp.asarray(kv_lens[s:s + 1]), jnp.asarray(q_pos[s:s + 1]))
+        np.testing.assert_allclose(out[cu[s]:cu[s + 1]], np.asarray(rect[0]),
+                                   rtol=2e-6, atol=2e-7,
+                                   err_msg=f"row block {s} diverged")
+    # Padding rows (beyond cu[-1]) are exact zeros, not garbage.
+    assert np.array_equal(out[cu[S]:], np.zeros_like(out[cu[S]:]))
+
+
+def test_unified_pallas_matches_reference(cpu_jax):
+    """The Pallas kernel (interpret mode on CPU) computes the same online
+    softmax as the reference within fp32 accumulation noise."""
+    import jax.numpy as jnp
+
+    from ray_tpu.ops import paged_attention as pa
+
+    q, kp, vp, bt, kv_lens, q_pos, cu = _ragged_case(seed=7)
+    args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(kv_lens), jnp.asarray(q_pos),
+            jnp.asarray(cu))
+    ref = np.asarray(pa.ragged_paged_attention_unified_reference(*args))
+    out = np.asarray(pa.ragged_paged_attention_unified(*args))
+    np.testing.assert_allclose(out[:cu[-1]], ref[:cu[-1]],
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Engine layer: unified mixed tick vs split prefill-then-decode.
+# ---------------------------------------------------------------------------
+
+
+def test_unified_matches_split_greedy(setup):
+    """Mixed batches (several prompts of different lengths, decode rows and
+    prefill slices sharing launches) greedy-decode bit-identically to the
+    split path AND to the naive full-forward reference."""
+    from ray_tpu.llm.sampling import SamplingParams
+
+    config, params = setup
+    prompts = [[(7 * i + 3) % 128 for i in range(21)],      # 3 chunks
+               [1, 5, 9, 2, 11, 3, 8],                      # 1 chunk
+               [(3 * i + 2) % 128 for i in range(13)]]      # 2 chunks
+    params_s = SamplingParams(max_tokens=6)
+    uni = _engine(config, params, unified=True)
+    outs_u = uni.generate(prompts, params_s)
+    assert any(sig[0] == "mixed" for sig in uni.runner._seen_shapes), \
+        "unified mixed step never dispatched"
+    split = _engine(config, params, unified=False)
+    outs_s = split.generate(prompts, params_s)
+    for p, ou, os_ in zip(prompts, outs_u, outs_s):
+        assert ou.output_token_ids == os_.output_token_ids
+        assert ou.output_token_ids == naive_greedy(params, config, p, 6)
+
+
+def test_unified_matches_split_seeded_sampling(setup, pickle_sanitizer):
+    """temperature>0 with a fixed seed: the unified tick keys each token's
+    draw on (seed, absolute position) exactly like the split sampler, so
+    outputs are bit-identical — and the steady-state loop never pickles."""
+    from ray_tpu.llm.sampling import SamplingParams
+
+    config, params = setup
+    prompts = [[(5 * i + 1) % 128 for i in range(11)],
+               [2, 7, 1, 12, 9, 5, 3, 13]]
+    sp = SamplingParams(max_tokens=8, temperature=0.8, top_k=20, seed=1234)
+    uni = _engine(config, params, unified=True)
+    split = _engine(config, params, unified=False)
+    with pickle_sanitizer.window() as w:
+        outs_u = uni.generate(prompts, sp)
+    outs_s = split.generate(prompts, sp)
+    for ou, os_ in zip(outs_u, outs_s):
+        assert ou.output_token_ids == os_.output_token_ids
+        assert len(ou.output_token_ids) == 8
+    w.assert_zero_pickle()
+
+
+def test_unified_falls_back_for_logit_feedback(setup):
+    """Repetition penalty needs host logits — the engine must route those
+    requests down the split path and still match it exactly."""
+    from ray_tpu.llm.sampling import SamplingParams
+
+    config, params = setup
+    prompt = [3, 14, 15, 9, 2, 6, 5]
+    sp = SamplingParams(max_tokens=6, repetition_penalty=1.3)
+    out_u = _engine(config, params, unified=True).generate([prompt], sp)[0]
+    out_s = _engine(config, params, unified=False).generate([prompt], sp)[0]
+    assert out_u.output_token_ids == out_s.output_token_ids
+
+
+# ---------------------------------------------------------------------------
+# Speculation: seeded acceptance sampling replays deterministically.
+# ---------------------------------------------------------------------------
+
+
+def test_spec_acceptance_sampling_replays_identically(setup):
+    """n-gram drafts + temperature>0 acceptance sampling: accept/reject
+    draws key on (crc32-derived seed, absolute token index) and drafts are
+    a pure function of sequence history, so a fresh engine replaying the
+    same request reproduces the trajectory token for token."""
+    from ray_tpu.llm.sampling import SamplingParams
+
+    config, params = setup
+    prompt = [5, 9, 13, 5, 9, 13, 5, 9, 13, 5, 9]
+    sp = SamplingParams(max_tokens=12, temperature=0.7, seed=42)
+    runs = []
+    for _ in range(2):
+        eng = _engine(config, params, unified=True, spec=3)
+        out = eng.generate([prompt], sp)[0]
+        runs.append((out.output_token_ids, eng.stats()))
+    assert runs[0][0] == runs[1][0]
+    s = runs[0][1]
+    assert s["spec_tokens_proposed"] > 0, s    # drafts actually launched
+    assert s["spec_tokens_proposed"] == runs[1][1]["spec_tokens_proposed"]
+    assert s["spec_tokens_accepted"] == runs[1][1]["spec_tokens_accepted"]
+
+
+def test_spec_greedy_accepts_model_continuation(setup):
+    """Force-feed the verifier the model's own greedy continuation as the
+    draft: every draft token must be accepted (greedy accept rule is
+    proposal == argmax), proving the accept branch end to end."""
+    import zlib
+
+    from ray_tpu.llm.sampling import SamplingParams
+
+    config, params = setup
+    prompt = [1, 5, 9, 2, 11, 3, 8]
+    cont = naive_greedy(params, config, prompt, 4)
+    eng = _engine(config, params, unified=True, spec=3)
+    eng._ngram_propose = lambda context, k, n=3: list(
+        cont[len(context) - len(prompt):len(context) - len(prompt) + k])
+    out = eng.generate([prompt], SamplingParams(max_tokens=4))[0]
+    assert out.output_token_ids == cont
+    s = eng.stats()
+    assert s["spec_tokens_accepted"] > 0, s
+    assert s["spec_tokens_accepted"] <= s["spec_tokens_proposed"]
+    # Seed bookkeeping: derived from crc32(request_id) when not supplied.
+    rid = out.request_id
+    assert isinstance(zlib.crc32(rid.encode()) & 0x7FFFFFFF, int)
+
+
+# ---------------------------------------------------------------------------
+# Compile discipline: warmed T-ladder, zero steady-state recompiles.
+# ---------------------------------------------------------------------------
+
+
+def test_steady_state_zero_recompiles_after_warmup(setup):
+    """warmup() precompiles the token-bucket ladder; serving traffic that
+    stays inside warmed buckets must never trigger another compile (the
+    silent-recompile stall the step_compiles counter exists to catch)."""
+    from ray_tpu.llm.sampling import SamplingParams
+
+    config, params = setup
+    eng = _engine(config, params, unified=True)
+    eng.warmup(full=True)
+    warm = eng.stats()["step_compiles"]
+    assert warm > 0
+    eng.generate([[(7 * i + 3) % 128 for i in range(21)],
+                  [1, 5, 9, 2], [2, 7, 1, 12, 9]],
+                 SamplingParams(max_tokens=6))
+    eng.generate([[4, 4, 8], [9, 1, 1, 2, 3, 5, 8, 13]],
+                 SamplingParams(max_tokens=4, temperature=0.9, seed=7))
+    assert eng.stats()["step_compiles"] == warm, \
+        "steady-state traffic recompiled after warmup"
+
+
+def test_spec_counters_roll_into_summary(setup):
+    """ray_tpu_llm_spec_* counters ride the standard metric defs, so the
+    cluster summary's llm_serving rollup picks them up without plumbing."""
+    from ray_tpu.runtime import metric_defs as md
+
+    names = {m._name for m in md.ALL_METRICS}
+    assert "ray_tpu_llm_spec_proposed_total" in names
+    assert "ray_tpu_llm_spec_accepted_total" in names
+    assert "ray_tpu_llm_step_compiles_total" in names

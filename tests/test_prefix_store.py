@@ -545,9 +545,13 @@ def test_router_cluster_fallback_restores_affinity():
     assert sup._cluster_affinity(prompt, {}, set()) is None
 
 
-def test_drain_pushes_prefixes_before_sessions():
-    """drain_replica streams the victim's working set to the target
-    before migrate_sessions moves the live streams."""
+def test_drain_migrates_sessions_before_prefix_push():
+    """drain_replica captures live sessions FIRST, then streams the
+    victim's working set: migrate_sessions quiesces admission, so it must
+    run the instant the drain lands — pushing prefixes first opened a
+    window (hundreds of ms under load) in which fast-cycling sessions
+    finished and their affinity-pinned successors were admitted
+    mid-prefill, leaving nothing to migrate with KV."""
     from ray_tpu.llm.router import FleetSupervisor, RouterCore
 
     class _DrainReplica(_FakeReplica):
@@ -570,8 +574,8 @@ def test_drain_pushes_prefixes_before_sessions():
     summary = sup.drain_replica(0, target=1)
     assert summary["target"] == 1
     methods = [m for m, _ in replicas[0].calls]
-    assert methods.index("push_prefixes") < methods.index(
-        "migrate_sessions")
+    assert methods.index("migrate_sessions") < methods.index(
+        "push_prefixes")
 
 
 # --------------------------------------------------- LoRA pool scaling
