@@ -110,6 +110,7 @@ HOT_PATHS: Tuple[str, ...] = (
     "ray_tpu/llm/disagg.py",
     "ray_tpu/llm/prefix_store.py",
     "ray_tpu/checkpoint/manifest.py",
+    "ray_tpu/data/streaming.py",
 )
 
 

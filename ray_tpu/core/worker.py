@@ -304,8 +304,19 @@ class CoreWorker:
                         return self._raise_if_error(self.memory_store[oid])
                 # fell through: result is in plasma
             start = time.monotonic()
+            # A BORROWED ref (we never submitted the producing task and
+            # another process owns it) may resolve ONLY at its owner: an
+            # inline result never lands in plasma, even on this node. Probe
+            # plasma briefly, then spend the budget on the owner fetch —
+            # otherwise a same-node borrow waits the full timeout for a
+            # local appearance that can never happen.
+            borrowed = (fut is None and ref.owner_addr is not None
+                        and tuple(ref.owner_addr) != tuple(self.owner_addr))
+            plasma_timeout = timeout
+            if borrowed:
+                plasma_timeout = 0.05 if timeout is None else min(timeout, 0.05)
             try:
-                value = self._get_plasma_value(oid, ref.owner, timeout)
+                value = self._get_plasma_value(oid, ref.owner, plasma_timeout)
             except ObjectNotFoundError:
                 # The plasma wait may have consumed the whole budget: the
                 # owner fallback only gets what remains (never doubles the
@@ -1642,13 +1653,15 @@ class CoreWorker:
             msg = wire.LeaseRequestMsg(
                 resources=resources, for_actor=False,
                 placement_group_id=pg_id or b"", bundle_index=bundle_index,
-                env_key=env_key or "", req_id=req_id or os.urandom(8))
+                env_key=env_key or "", req_id=req_id or os.urandom(8),
+                holder=self.worker_ident)
             return await self._lease_call_batched(target, msg)
         if "lease_worker" in self._typed_methods:
             msg = wire.LeaseRequestMsg(
                 resources=resources, for_actor=False,
                 placement_group_id=pg_id or b"", bundle_index=bundle_index,
-                env_key=env_key or "", req_id=req_id or b"")
+                env_key=env_key or "", req_id=req_id or b"",
+                holder=self.worker_ident)
             try:
                 encoded = await target.call("lease_worker2", m=msg.encode())
                 return wire.LeaseReplyMsg.decode(encoded).to_reply()

@@ -83,8 +83,31 @@ class Dataset:
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
-                     drop_last: bool = False) -> Iterator[Any]:
-        """Streams batches, re-chunking across block boundaries."""
+                     drop_last: bool = False,
+                     prefetch_batches: Optional[int] = None,
+                     device_index: Optional[int] = None,
+                     cursor=None) -> Iterator[Any]:
+        """Streams batches. With `prefetch_batches=N` this returns a
+        `StreamingIterator` (data/streaming.py): a producer thread overlaps
+        read/transform/transfer with the consumer, up to N batches stay
+        prefetched through a device ring, and the iterator carries a
+        resumable cursor. Streaming batches never straddle block
+        boundaries (exact cursors); the default sync path re-chunks
+        across them."""
+        if prefetch_batches is not None:
+            from ray_tpu.data.streaming import make_local_iterator
+
+            return make_local_iterator(
+                self, batch_size=batch_size, batch_format=batch_format,
+                drop_last=drop_last, prefetch_batches=prefetch_batches,
+                device_index=device_index, cursor=cursor)
+        return self._iter_batches_sync(batch_size=batch_size,
+                                       batch_format=batch_format,
+                                       drop_last=drop_last)
+
+    def _iter_batches_sync(self, *, batch_size: Optional[int] = 256,
+                           batch_format: str = "numpy",
+                           drop_last: bool = False) -> Iterator[Any]:
         leftover: Optional[Block] = None
         for block in self.iter_blocks():
             if leftover is not None and leftover.num_rows:
@@ -375,17 +398,28 @@ class Dataset:
 
     # ---- train ingestion -------------------------------------------------
 
-    def streaming_split(self, n: int) -> List["DataIterator"]:
-        """N disjoint iterators (one per train worker), round-robin blocks.
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        seed: Optional[int] = None,
+                        batch_size: Optional[int] = 256,
+                        batch_format: str = "numpy",
+                        drop_last: bool = False,
+                        prefetch_batches: int = 2,
+                        device_index: Optional[int] = None):
+        """N disjoint `StreamShard`s over ONE shared pipelined execution
+        (data/streaming.py): a coordinator actor streams block refs with
+        bounded in-flight, shard r takes seeded-permuted positions
+        r, r+n, ... — no driver materialization. Same seed + world gives
+        a bit-identical global visit order; `equal=True` trims the tail
+        remainder so every shard sees the same block count.
 
-        Reference analog: Dataset.streaming_split used by Train's DataConfig.
-        """
-        blocks = list(self.iter_blocks())  # materialized split (round 1)
-        shards: List[List[Block]] = [[] for _ in _range(n)]
-        for i, b in enumerate(blocks):
-            shards[i % n].append(b)
-        return [DataIterator(MaterializedDataset(s, self._parallelism))
-                for s in shards]
+        Reference analog: Dataset.streaming_split used by Train's
+        DataConfig."""
+        from ray_tpu.data.streaming import make_stream_shards
+
+        return make_stream_shards(
+            self, n, equal=equal, seed=seed, batch_size=batch_size,
+            batch_format=batch_format, drop_last=drop_last,
+            prefetch_batches=prefetch_batches, device_index=device_index)
 
     def split(self, n: int) -> List["MaterializedDataset"]:
         blocks = list(self.iter_blocks())

@@ -53,12 +53,17 @@ class DatasetStats:
         return entry
 
     def finalize(self):
+        """Fold any meta refs not yet harvested incrementally. Stages fed
+        by `_note_meta` (every streaming stage) have an empty `_meta_refs`
+        list by the time the stream ends, so this adds NO tail stall —
+        the old implementation blocked the consumer on a bulk
+        `ray_tpu.get` of every per-block meta at stream end."""
         for s in self.stages:
             refs = s.pop("_meta_refs", [])
             if refs:
                 metas = ray_tpu.get(refs, timeout=600)
-                s["rows"] = sum(m["rows"] for m in metas)
-                s["bytes"] = sum(m["bytes"] for m in metas)
+                s["rows"] = (s["rows"] or 0) + sum(m["rows"] for m in metas)
+                s["bytes"] = (s["bytes"] or 0) + sum(m["bytes"] for m in metas)
         return self
 
     def summary(self) -> str:
@@ -70,6 +75,21 @@ class DatasetStats:
             lines.append(f"  {s['name']}: {s['wall_s'] * 1000:.0f}ms wall, "
                          f"{s['blocks']} blocks{extra}")
         return "\n".join(lines)
+
+
+def _note_meta(stage_entry: Optional[dict], meta_ref) -> None:
+    """Harvest one block's meta at block-completion time. The meta ref is
+    sealed by the same task (num_returns=2) that sealed the block ref, so
+    this get returns immediately — stats accumulate as the stream flows
+    instead of stalling the consumer at stream end."""
+    if stage_entry is None:
+        return
+    try:
+        meta = ray_tpu.get(meta_ref, timeout=cfg().data_task_timeout_s)
+    except Exception:
+        return
+    stage_entry["rows"] = (stage_entry["rows"] or 0) + meta["rows"]
+    stage_entry["bytes"] = (stage_entry["bytes"] or 0) + meta["bytes"]
 
 
 def _timed(stage_entry: Optional[dict], stream):
@@ -165,15 +185,17 @@ def _task_stage(upstream, payload: bytes, max_in_flight: int,
     pending = {}
     for idx, ref in upstream:
         block_ref, meta_ref = apply.remote(ref)
-        pending[block_ref] = idx
-        if stage_entry is not None:
-            stage_entry["_meta_refs"].append(meta_ref)
+        pending[block_ref] = (idx, meta_ref)
         while len(pending) >= max_in_flight:
             for r in _wait_one(pending):
-                yield pending.pop(r), r
+                out_idx, m = pending.pop(r)
+                _note_meta(stage_entry, m)
+                yield out_idx, r
     while pending:
         for r in _wait_one(pending):
-            yield pending.pop(r), r
+            out_idx, m = pending.pop(r)
+            _note_meta(stage_entry, m)
+            yield out_idx, r
 
 
 def _actor_stage(upstream, op: plan_mod.MapBatches,
@@ -192,15 +214,17 @@ def _actor_stage(upstream, op: plan_mod.MapBatches,
             i += 1
             block_ref, meta_ref = actor.transform.options(
                 num_returns=2).remote(ref)
-            pending[block_ref] = idx
-            if stage_entry is not None:
-                stage_entry["_meta_refs"].append(meta_ref)
+            pending[block_ref] = (idx, meta_ref)
             while len(pending) >= 2 * len(pool):
                 for r in _wait_one(pending):
-                    yield pending.pop(r), r
+                    out_idx, m = pending.pop(r)
+                    _note_meta(stage_entry, m)
+                    yield out_idx, r
         while pending:
             for r in _wait_one(pending):
-                yield pending.pop(r), r
+                out_idx, m = pending.pop(r)
+                _note_meta(stage_entry, m)
+                yield out_idx, r
     finally:
         # Runs on normal completion AND when the consumer stops early
         # (GeneratorExit) — pool actors must never outlive the stage.
@@ -389,11 +413,45 @@ def _effective_inflight(max_in_flight: int) -> int:
     return max(1, max_in_flight // 4) if throttle else max_in_flight
 
 
+def _streamable_tail(ops: List[plan_mod.LogicalOp]) -> bool:
+    """True when every op after Read streams 1:1 over blocks (no barrier)."""
+    for op in ops[1:]:
+        if not (isinstance(op, plan_mod.FusedMap) or
+                (isinstance(op, plan_mod.MapBatches)
+                 and op.compute == "actors")):
+            return False
+    return True
+
+
+def plan_block_count(ops: List[plan_mod.LogicalOp],
+                     parallelism: int) -> Optional[int]:
+    """Output block count of a barrier-free plan, known WITHOUT executing
+    it (read tasks map 1:1 onto output blocks through fused/actor map
+    stages). None for barrier plans (shuffle/sort/repartition/limit change
+    the block count) — the streaming layer then has to materialize refs
+    to learn the epoch size."""
+    ops = plan_mod.optimize(ops)
+    if not ops or not isinstance(ops[0], plan_mod.Read):
+        return None
+    if not _streamable_tail(ops):
+        return None
+    read: plan_mod.Read = ops[0]
+    return len(read.datasource.read_tasks(parallelism, read.limit))
+
+
 def execute_refs(ops: List[plan_mod.LogicalOp], parallelism: int,
                  max_in_flight: Optional[int] = None,
-                 stats: Optional[DatasetStats] = None) -> Iterator:
+                 stats: Optional[DatasetStats] = None,
+                 task_order: Optional[List[int]] = None) -> Iterator:
     """Run the optimized plan; yields BLOCK REFS in order as they complete
-    (streaming until the first barrier op, task waves after)."""
+    (streaming until the first barrier op, task waves after).
+
+    `task_order` permutes READ-TASK submission order: output index i is
+    read task task_order[i], so for barrier-free plans the yielded block
+    order IS the permutation — the seeded per-epoch shuffle of the
+    streaming data plane, decided before any task runs (no extra pass
+    over the data). Ignored for barrier plans (the barrier re-keys block
+    order; callers permute the materialized ref list instead)."""
     import cloudpickle as cp
 
     if max_in_flight is None:
@@ -437,22 +495,29 @@ def execute_refs(ops: List[plan_mod.LogicalOp], parallelism: int,
             name += f"+{len(lead_payloads)} fused map(s)"
         read_entry = stats.stage(f"Read[{name}]")
 
+    order = list(range(len(tasks)))
+    if task_order is not None and not barrier_ops:
+        if sorted(task_order) != order:
+            raise ValueError("task_order must be a permutation of "
+                             f"range({len(tasks)})")
+        order = list(task_order)
+
     def source():
         pending = {}
-        queue = [(i, cp.dumps(t)) for i, t in enumerate(tasks)]
+        queue = [(i, cp.dumps(tasks[t])) for i, t in enumerate(order)]
         while queue or pending:
             while queue and len(pending) < _effective_inflight(max_in_flight):
                 idx, payload = queue.pop(0)
                 block_ref, meta_ref = run_block.remote(payload, lead_payloads)
-                pending[block_ref] = idx
-                if read_entry is not None:
-                    read_entry["_meta_refs"].append(meta_ref)
+                pending[block_ref] = (idx, meta_ref)
             ready, _ = ray_tpu.wait(list(pending), num_returns=1,
                                     timeout=cfg().data_task_timeout_s)
             if not ready:
                 raise TimeoutError("dataset task timed out")
             for ref in ready:
-                yield pending.pop(ref), ref
+                idx, meta_ref = pending.pop(ref)
+                _note_meta(read_entry, meta_ref)
+                yield idx, ref
 
     stream = _timed(read_entry, source())
     for op in stream_stages:

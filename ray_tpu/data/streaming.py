@@ -1,0 +1,678 @@
+"""Streaming data plane: pipelined, backpressured train ingestion.
+
+Reference analogs: tf.data's `prefetch()` overlap and Ray Data's streaming
+executor + `Dataset.streaming_split` (python/ray/data/iterator.py,
+_internal/execution/streaming_executor.py). The batch-shaped path drives the
+plan synchronously from the consumer, so every train step pays
+read + transform + host->device transfer on the critical path. This module
+turns it into a push-based pipeline:
+
+  * `StreamingIterator` — a producer THREAD drives the plan's bounded
+    in-flight ref stream (execution.py) and pushes ready batches through a
+    `DeviceChannel` ring; `next(it)` is a ring pop when the pipeline keeps
+    up. A semaphore caps produced-but-unconsumed batches at
+    `prefetch_batches`, so a slow consumer backpressures the whole pipeline
+    (the stage-level in-flight caps bound the rest).
+  * Zero-pickle last hop — steady-state batches ride the ring as one
+    `_FAST_DEVICE` frame PER COLUMN (jax arrays move as raw dlpack bytes,
+    serialization.py), landing on the consumer's device via the channel's
+    `device_index`. Schema frames (pickled name lists) flow only when the
+    column set changes — once per stream in practice.
+  * `StreamShard` / `Dataset.streaming_split(n)` — one `_StreamCoordinator`
+    actor runs the plan ONCE per epoch as a shared, seeded, pipelined ref
+    stream; shard r consumes permuted positions r, r+n, r+2n, ... The
+    permutation depends only on (seed, epoch), so same seed + world gives a
+    bit-identical global visit order, and the coordinator holds REFS only —
+    no driver materialization of data.
+  * `StreamCursor` — (epoch, per-shard block offset, batch-in-block offset,
+    seed), advanced at every pop. Batches never straddle block boundaries
+    in streaming mode, so a checkpointed cursor resumes mid-epoch with the
+    bit-identical remaining visit order. Train's `report(state=...)` saves
+    cursors through the async checkpoint plane under the separate
+    "datastream" manifest (train/session.py).
+
+See docs/data_streaming.md for knobs, numbers, and cursor semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.config import cfg
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.execution import (DatasetStats, execute_refs,
+                                    plan_block_count)
+
+__all__ = ["StreamCursor", "StreamingIterator", "StreamShard",
+           "make_stream_shards", "shutdown_shards"]
+
+_CURSOR_MANIFEST = "datastream"  # checkpoint-plane manifest name for cursors
+
+
+# ----------------------------------------------------------------- cursor
+
+@dataclasses.dataclass
+class StreamCursor:
+    """Resumable position of one consumer's stream. `block_offset` counts
+    PER-SHARD blocks fully consumed this epoch; `batch_offset` counts
+    batches already popped from the block at `block_offset`. Both advance
+    consumer-side at pop time, so a cursor captured between two `next()`
+    calls replays nothing and skips nothing."""
+
+    epoch: int = 0
+    block_offset: int = 0
+    batch_offset: int = 0
+    seed: int = 0
+
+    def as_row(self) -> np.ndarray:
+        return np.array([self.epoch, self.block_offset, self.batch_offset,
+                         self.seed], dtype=np.int64)
+
+    @classmethod
+    def from_row(cls, row) -> "StreamCursor":
+        row = np.asarray(row).reshape(-1)
+        return cls(epoch=int(row[0]), block_offset=int(row[1]),
+                   batch_offset=int(row[2]), seed=int(row[3]))
+
+
+def _epoch_permutation(seed: int, epoch: int, n: int) -> List[int]:
+    """The epoch's seeded visit order over n blocks. Depends only on
+    (seed, epoch) — every shard of every attempt derives the same order."""
+    rng = np.random.default_rng([int(seed) & 0xFFFFFFFF, int(epoch)])
+    return [int(i) for i in rng.permutation(n)]
+
+
+# ------------------------------------------------------------- transports
+#
+# The ring carries BATCHES between the producer thread and the consumer.
+# Frame protocol over the DeviceChannel (deterministic framing — the reader
+# always knows what the next frame is, no type sniffing in steady state):
+#
+#   [schema list]  only when the column set changed (pickled; rare)
+#   header         int64 jax array [shard_block_idx, batch_idx, last, ncols]
+#   column x ncols one _FAST_DEVICE frame per column (zero-pickle)
+#
+# Non-numeric batches (object/string columns) fall back to one
+# (header, dict) tuple frame — a documented slow path.
+
+def _as_device_array(v):
+    """Numeric column -> jax array for the zero-pickle frame; None when
+    the column can't move as raw bytes (object/string dtypes)."""
+    try:
+        a = np.asarray(v)
+        if a.dtype.kind in "OUSV":
+            return None
+        import jax.numpy as jnp
+
+        return jnp.asarray(a)
+    except Exception:
+        return None
+
+
+class _ChannelRing:
+    """SPSC batch transport over a DeviceChannel. The writer (producer
+    thread) and reader (consumer) share this object in-process; writer
+    state (`_schema`, `_wv`) and reader state (`_rschema`, `_rv`) are
+    disjoint, so no lock is needed beyond the channel's own protocol."""
+
+    def __init__(self, capacity_frames: int, device_index: Optional[int]):
+        from ray_tpu.dag.device_channel import DeviceChannel
+
+        self._ch = DeviceChannel(capacity=capacity_frames,
+                                 device_index=device_index)
+        self._schema: Optional[Tuple[str, ...]] = None   # writer side
+        self._rschema: Tuple[str, ...] = ()              # reader side
+
+    # -- writer (producer thread) ------------------------------------------
+    def put(self, header: Tuple[int, int, int], batch: Dict[str, Any]) -> bool:
+        """Push one batch; True when it rode the zero-pickle column path."""
+        import jax.numpy as jnp
+
+        cols: Optional[Dict[str, Any]] = {}
+        for k, v in batch.items():
+            arr = _as_device_array(v)
+            if arr is None:
+                cols = None
+                break
+            cols[k] = arr
+        if cols is None:
+            # Non-numeric batch: one pickled frame (documented slow path).
+            self._ch.write((tuple(header), batch))
+            return False
+        names = tuple(cols)
+        if names != self._schema:
+            self._schema = names
+            self._ch.write(list(names))
+        self._ch.write(jnp.asarray([header[0], header[1], header[2],
+                                    len(names)], dtype=jnp.int32))
+        for k in names:
+            self._ch.write(cols[k])
+        return True
+
+    def close_write(self) -> None:
+        self._ch.close_write()
+
+    # -- reader (consumer) -------------------------------------------------
+    def get(self, timeout: Optional[float] = None
+            ) -> Tuple[Tuple[int, int, int], Dict[str, Any]]:
+        frame = self._ch.read(timeout=timeout)   # ChannelClosed at stream end
+        if isinstance(frame, list):
+            self._rschema = tuple(frame)
+            frame = self._ch.read(timeout=timeout)
+        if isinstance(frame, tuple):
+            header, batch = frame
+            return (int(header[0]), int(header[1]), int(header[2])), batch
+        h = np.asarray(frame)
+        ncols = int(h[3])
+        cols = [self._ch.read(timeout=timeout) for _ in range(ncols)]
+        return ((int(h[0]), int(h[1]), int(h[2])),
+                dict(zip(self._rschema, cols)))
+
+    def close_read(self) -> None:
+        try:
+            self._ch.close_read()
+        except Exception:
+            pass
+
+    def drain(self) -> None:
+        try:
+            self._ch.drain()
+        except Exception:
+            pass
+
+
+class _QueueRing:
+    """In-process fallback when there is no object store or no jax (plain
+    library use outside a cluster). Hands batch dicts across the thread
+    boundary directly — nothing serializes at all."""
+
+    class Closed(Exception):
+        pass
+
+    _END = object()
+
+    def __init__(self):
+        import queue
+
+        self._q: "queue.Queue" = queue.Queue()
+
+    def put(self, header, batch) -> bool:
+        self._q.put((tuple(header), batch))
+        return True
+
+    def close_write(self) -> None:
+        self._q.put(self._END)
+
+    def get(self, timeout: Optional[float] = None):
+        item = self._q.get(timeout=timeout)
+        if item is self._END:
+            from ray_tpu.dag.channel import ChannelClosed
+
+            raise ChannelClosed()
+        return item
+
+    def close_read(self) -> None:
+        pass
+
+    def drain(self) -> None:
+        pass
+
+
+def _make_ring(capacity_frames: int, device_index: Optional[int]):
+    try:
+        from ray_tpu.core import worker as worker_mod
+
+        worker_mod.global_worker()._require_store()
+        import jax  # noqa: F401
+
+        return _ChannelRing(capacity_frames, device_index)
+    except Exception:
+        return _QueueRing()
+
+
+# -------------------------------------------------------------- iterator
+
+def _block_batches(block: Block, batch_size: Optional[int],
+                   drop_last: bool) -> List[Dict[str, np.ndarray]]:
+    """Split one block into host batches. Streaming batches never straddle
+    block boundaries (unlike the batch-shaped `iter_batches` re-chunker):
+    that makes (block_offset, batch_offset) cursors exact, at the cost of
+    a short tail batch per block (dropped under drop_last)."""
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if n == 0:
+        return []
+    if batch_size is None:
+        return [acc.to_batch()]
+    out = []
+    for lo in range(0, n, batch_size):
+        hi = min(lo + batch_size, n)
+        if drop_last and hi - lo < batch_size:
+            break
+        out.append(BlockAccessor(acc.slice(lo, hi)).to_batch())
+    return out
+
+
+class StreamingIterator:
+    """Pipelined batch iterator: a daemon producer thread pulls blocks from
+    `source(cursor)` (an iterator of (shard_block_index, Block) starting at
+    the cursor), slices them into batches, and pushes them through the
+    device ring; `__next__` pops. Blocking time in `__next__` is the true
+    input-wait — it books the `input_wait` train-telemetry phase and the
+    `ray_tpu_data_input_wait_ms` histogram.
+
+    Backpressure: at most `prefetch_batches` produced-but-unconsumed
+    batches exist at any moment (semaphore acquired before each push,
+    released at each pop); upstream, the executor's bounded in-flight caps
+    hold. `max_backlog` records the high-water mark as the proof probe."""
+
+    def __init__(self, source: Callable[[StreamCursor], Iterator[
+                     Tuple[int, Block]]], *,
+                 batch_size: Optional[int] = 256,
+                 batch_format: str = "numpy",
+                 drop_last: bool = False,
+                 prefetch_batches: int = 2,
+                 device_index: Optional[int] = None,
+                 cursor: Optional[StreamCursor] = None,
+                 on_exhausted: Optional[Callable[[], None]] = None):
+        self._source = source
+        self._batch_size = batch_size
+        self._batch_format = batch_format
+        self._drop_last = drop_last
+        self._prefetch = max(1, int(prefetch_batches))
+        self._on_exhausted = on_exhausted
+        self.cursor = cursor if cursor is not None else StreamCursor()
+        self._start = dataclasses.replace(self.cursor)
+        # Frame capacity: a batch is 1 header + ncols frames. 8 columns per
+        # batch fully buffered is generous; wider batches just make the
+        # writer block mid-batch while the reader drains (no deadlock: the
+        # reader never waits on anything but the channel).
+        self._ring = _make_ring((self._prefetch + 2) * 8, device_index)
+        self._sem = threading.Semaphore(self._prefetch)
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._finished = False
+        self._produced = 0
+        self._consumed = 0
+        # Probes: backpressure proof + prefetch effectiveness.
+        self.max_backlog = 0
+        self.pops = 0
+        self.hits = 0          # pops that returned without blocking
+        self.wait_s = 0.0      # total blocking input-wait
+        self.zero_pickle_batches = 0
+        self.fallback_batches = 0
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name="data-stream-producer")
+        self._thread.start()
+
+    # -- producer thread ---------------------------------------------------
+    def _produce(self) -> None:
+        from ray_tpu.dag.channel import ChannelClosed
+        from ray_tpu.runtime import metric_defs
+
+        try:
+            for s_idx, block in self._source(self._start):
+                metric_defs.DATA_BLOCKS_PRODUCED.inc()
+                batches = _block_batches(block, self._batch_size,
+                                         self._drop_last)
+                skip = (self._start.batch_offset
+                        if s_idx == self._start.block_offset else 0)
+                for j in range(skip, len(batches)):
+                    while not self._sem.acquire(timeout=0.1):
+                        if self._stop.is_set():
+                            return
+                    if self._stop.is_set():
+                        return
+                    header = (s_idx, j, 1 if j == len(batches) - 1 else 0)
+                    if self._ring.put(header, batches[j]):
+                        self.zero_pickle_batches += 1
+                    else:
+                        self.fallback_batches += 1
+                    self._produced += 1
+                    backlog = self._produced - self._consumed
+                    if backlog > self.max_backlog:
+                        self.max_backlog = backlog
+                    metric_defs.DATA_BACKLOG_DEPTH.set(backlog)
+            self._ring.close_write()
+        except ChannelClosed:
+            pass   # consumer abandoned the stream; nothing to flush
+        except BaseException as e:  # noqa: BLE001 - re-raised at the consumer
+            self._error = e
+            try:
+                self._ring.close_write()
+            except Exception:
+                pass
+
+    # -- consumer ----------------------------------------------------------
+    def __iter__(self) -> "StreamingIterator":
+        return self
+
+    def __next__(self):
+        from ray_tpu.dag.channel import ChannelClosed
+        from ray_tpu.runtime import metric_defs
+        from ray_tpu.train.session import step_phase
+
+        t0 = time.perf_counter()
+        try:
+            with step_phase("input_wait"):
+                header, batch = self._ring.get(
+                    timeout=cfg().data_task_timeout_s)
+        except ChannelClosed:
+            self._finish()
+            raise StopIteration
+        dt = time.perf_counter() - t0
+        self.pops += 1
+        self.wait_s += dt
+        if dt < 1e-3:
+            self.hits += 1
+        metric_defs.DATA_INPUT_WAIT_MS.observe(dt * 1e3)
+        self._consumed += 1
+        metric_defs.DATA_BACKLOG_DEPTH.set(self._produced - self._consumed)
+        self._sem.release()
+        s_idx, j, last = header
+        if last:
+            self.cursor.block_offset = s_idx + 1
+            self.cursor.batch_offset = 0
+        else:
+            self.cursor.block_offset = s_idx
+            self.cursor.batch_offset = j + 1
+        return self._format(batch)
+
+    def _format(self, batch: Dict[str, Any]):
+        if self._batch_format in ("jax", "device"):
+            return batch
+        if self._batch_format in ("numpy", "default"):
+            return {k: np.asarray(v) for k, v in batch.items()}
+        if self._batch_format == "pandas":
+            import pandas as pd
+
+            return pd.DataFrame({k: np.asarray(v) for k, v in batch.items()})
+        raise ValueError(
+            f"unknown streaming batch_format {self._batch_format!r} "
+            "(numpy | jax | pandas)")
+
+    def _finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        self._thread.join(timeout=60)
+        self._ring.drain()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        if self._on_exhausted is not None:
+            self._on_exhausted()
+
+    def stop(self) -> None:
+        """Abandon the stream early: unwedge and join the producer."""
+        self._stop.set()
+        self._ring.close_read()
+        self._thread.join(timeout=10)
+        self._ring.drain()
+
+    def __del__(self):
+        try:
+            if not self._finished and self._thread.is_alive():
+                self.stop()
+        except Exception:
+            pass
+
+    # -- probes ------------------------------------------------------------
+    @property
+    def prefetch_hit_rate(self) -> float:
+        """Fraction of pops served without blocking — 1.0 means the
+        pipeline fully hid ingestion behind the consumer's compute."""
+        return self.hits / self.pops if self.pops else 0.0
+
+    def state_dict(self) -> Dict[str, int]:
+        return dataclasses.asdict(self.cursor)
+
+
+# ------------------------------------------------------- shared execution
+
+class _StreamCoordinator:
+    """Driver-side actor producing ONE shared, seeded, pipelined block-ref
+    stream per epoch; shards pull disjoint permuted positions on demand.
+    Holds refs only (the object store holds the blocks), so a lagging rank
+    costs ref-list memory, never driver data. Epochs older than the newest
+    two are dropped, bounding that list across long runs."""
+
+    def __init__(self, ops_payload: bytes, parallelism: int,
+                 seed: Optional[int], world: int, equal: bool,
+                 max_in_flight: Optional[int]):
+        import cloudpickle
+
+        # graftlint: allow[hot-pickle] plan arrives once at stream setup, never per block
+        self._ops = cloudpickle.loads(ops_payload)
+        self._parallelism = parallelism
+        self._seed = seed
+        self._world = max(1, int(world))
+        self._equal = bool(equal)
+        self._max_in_flight = max_in_flight
+        self._epochs: Dict[int, dict] = {}
+        self._total_hint = plan_block_count(self._ops, parallelism)
+
+    def _epoch(self, epoch: int) -> dict:
+        st = self._epochs.get(epoch)
+        if st is not None:
+            return st
+        stats = DatasetStats()
+        order = None
+        if self._total_hint is not None and self._seed is not None:
+            order = _epoch_permutation(self._seed, epoch, self._total_hint)
+        gen = execute_refs(self._ops, self._parallelism,
+                           max_in_flight=self._max_in_flight,
+                           stats=stats, task_order=order)
+        st = {"gen": gen, "refs": [], "done": False, "stats": stats}
+        if self._total_hint is None:
+            # Barrier plan: ref production is a task wave, not a stream —
+            # drain it (refs only), then permute the materialized list so
+            # the seeded epoch order still holds.
+            refs = list(gen)
+            if self._seed is not None:
+                perm = _epoch_permutation(self._seed, epoch, len(refs))
+                refs = [refs[i] for i in perm]
+            st["refs"] = refs
+            st["done"] = True
+        self._epochs[epoch] = st
+        for old in [e for e in self._epochs if e < epoch - 1]:
+            del self._epochs[old]
+        return st
+
+    def next_block(self, epoch: int, pos: int):
+        """The block ref at global permuted position `pos` of `epoch`, or
+        None past the epoch's end. Under equal=True the tail remainder
+        (total % world) is dropped so every shard sees the same block
+        count; a position is only served once enough downstream blocks
+        exist to prove it survives the truncation."""
+        st = self._epoch(epoch)
+        guard = self._world if (self._equal and self._world > 1) else 1
+        while not st["done"] and len(st["refs"]) < pos + guard:
+            try:
+                st["refs"].append(next(st["gen"]))
+            except StopIteration:
+                st["done"] = True
+        if len(st["refs"]) <= pos:
+            return None
+        if st["done"] and self._equal and self._world > 1:
+            usable = len(st["refs"]) - len(st["refs"]) % self._world
+            if pos >= usable:
+                return None
+        return st["refs"][pos]
+
+    def epoch_stats(self, epoch: int) -> Optional[str]:
+        st = self._epochs.get(epoch)
+        return None if st is None else st["stats"].finalize().summary()
+
+
+class StreamShard:
+    """One consumer's handle onto a shared streaming execution. Picklable —
+    it ships (coordinator handle, rank/world/seed, batch defaults) to a
+    train worker; the iterator, its ring, and its producer thread are all
+    created consumer-side at `iter_batches()` time.
+
+    Epochs: each `iter_batches()` call streams ONE epoch (the shard's
+    current one) and advances the cursor to the next epoch on exhaustion.
+    `load_cursor()` / a restored checkpoint seeks mid-epoch; the epoch's
+    pipeline replays up to the cursor without re-yielding consumed data,
+    so the remaining visit order is bit-identical to the uninterrupted
+    run."""
+
+    def __init__(self, coordinator, rank: int, world: int,
+                 seed: Optional[int], *, batch_size: Optional[int] = 256,
+                 batch_format: str = "numpy", drop_last: bool = False,
+                 prefetch_batches: int = 2,
+                 device_index: Optional[int] = None):
+        self._coord = coordinator
+        self.rank = int(rank)
+        self.world = max(1, int(world))
+        self.seed = seed
+        self._defaults = dict(batch_size=batch_size,
+                              batch_format=batch_format,
+                              drop_last=drop_last,
+                              prefetch_batches=prefetch_batches,
+                              device_index=device_index)
+        self._cursor = StreamCursor(seed=int(seed or 0))
+        self._it: Optional[StreamingIterator] = None
+
+    def __reduce__(self):
+        return (_rebuild_shard, (self._coord, self.rank, self.world,
+                                 self.seed, self._defaults,
+                                 dataclasses.asdict(self._cursor)))
+
+    # -- cursor ------------------------------------------------------------
+    @property
+    def cursor(self) -> StreamCursor:
+        if self._it is not None and not self._it._finished:
+            return self._it.cursor
+        return self._cursor
+
+    def state_dict(self) -> Dict[str, int]:
+        return dataclasses.asdict(self.cursor)
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        self._cursor = StreamCursor(**{k: int(v) for k, v in state.items()})
+        self._it = None
+
+    def cursor_row(self) -> np.ndarray:
+        return self.cursor.as_row()
+
+    def load_cursor(self, row) -> None:
+        self._cursor = StreamCursor.from_row(row)
+        self._it = None
+
+    # -- consumption -------------------------------------------------------
+    def _source(self, cursor: StreamCursor) -> Iterator[Tuple[int, Block]]:
+        timeout = cfg().data_task_timeout_s
+        pos = cursor.block_offset
+        while True:
+            ref = ray_tpu.get(
+                self._coord.next_block.remote(
+                    cursor.epoch, self.rank + pos * self.world),
+                timeout=timeout)
+            if ref is None:
+                return
+            yield pos, ray_tpu.get(ref, timeout=timeout)
+            pos += 1
+
+    def iter_batches(self, **overrides) -> StreamingIterator:
+        kw = {**self._defaults, **overrides}
+        start = dataclasses.replace(self._cursor)
+
+        def on_exhausted():
+            self._cursor = StreamCursor(epoch=start.epoch + 1,
+                                        seed=int(self.seed or 0))
+
+        it = StreamingIterator(self._source, cursor=start,
+                               on_exhausted=on_exhausted, **kw)
+        self._it = it
+        return it
+
+    def stats(self, epoch: Optional[int] = None) -> Optional[str]:
+        """Per-epoch execution stats from the shared coordinator."""
+        e = self.cursor.epoch if epoch is None else epoch
+        return ray_tpu.get(self._coord.epoch_stats.remote(e), timeout=60)
+
+
+def _rebuild_shard(coord, rank, world, seed, defaults, cursor_state):
+    shard = StreamShard(coord, rank, world, seed, **defaults)
+    shard._cursor = StreamCursor(**{k: int(v)
+                                    for k, v in cursor_state.items()})
+    return shard
+
+
+def make_stream_shards(ds, n: int, *, equal: bool = False,
+                       seed: Optional[int] = None,
+                       batch_size: Optional[int] = 256,
+                       batch_format: str = "numpy",
+                       drop_last: bool = False,
+                       prefetch_batches: int = 2,
+                       device_index: Optional[int] = None,
+                       max_in_flight: Optional[int] = None
+                       ) -> List[StreamShard]:
+    """N disjoint streaming shards over one shared plan execution (the
+    `Dataset.streaming_split` implementation)."""
+    import cloudpickle
+
+    ops = list(getattr(ds, "_ops", None) or [])
+    if not ops:
+        # Materialized dataset: re-enter the lazy path so the coordinator
+        # has a plan to execute (blocks ride the read-task closures).
+        from ray_tpu.data.dataset import from_blocks
+
+        ds = from_blocks(list(ds.iter_blocks()), ds._parallelism)
+        ops = ds._ops
+    Coordinator = ray_tpu.remote(_StreamCoordinator)
+    # graftlint: allow[hot-pickle] plan ships once at stream setup, never per block
+    payload = cloudpickle.dumps(ops)
+    coord = Coordinator.options(num_cpus=0).remote(
+        payload, ds._parallelism, seed, n, equal, max_in_flight)
+    return [StreamShard(coord, r, n, seed, batch_size=batch_size,
+                        batch_format=batch_format, drop_last=drop_last,
+                        prefetch_batches=prefetch_batches,
+                        device_index=device_index)
+            for r in range(n)]
+
+
+def shutdown_shards(shards: List[StreamShard]) -> None:
+    """Kill the coordinator(s) behind a set of shards (stream teardown)."""
+    seen = set()
+    for s in shards:
+        coord = getattr(s, "_coord", None)
+        if coord is None or id(coord) in seen:
+            continue
+        seen.add(id(coord))
+        try:
+            ray_tpu.kill(coord)
+        except Exception:
+            pass
+
+
+# ----------------------------------------------------- local (single-rank)
+
+def make_local_iterator(ds, *, batch_size: Optional[int] = 256,
+                        batch_format: str = "numpy", drop_last: bool = False,
+                        prefetch_batches: int = 2,
+                        device_index: Optional[int] = None,
+                        cursor: Optional[StreamCursor] = None
+                        ) -> StreamingIterator:
+    """The `Dataset.iter_batches(prefetch_batches=N)` implementation: the
+    producer thread drives `ds.iter_blocks()` (bounded in-flight execution
+    + incremental stats) and the consumer pops prefetched batches."""
+
+    def source(cur: StreamCursor) -> Iterator[Tuple[int, Block]]:
+        for i, block in enumerate(ds.iter_blocks()):
+            if i < cur.block_offset:
+                continue
+            yield i, block
+
+    return StreamingIterator(source, batch_size=batch_size,
+                             batch_format=batch_format, drop_last=drop_last,
+                             prefetch_batches=prefetch_batches,
+                             device_index=device_index, cursor=cursor)

@@ -105,6 +105,13 @@ class RLHFConfig:
     # plumbing
     reward_fn: Optional[Callable] = None
     prompt_fn: Optional[Callable[[int], List[int]]] = None
+    # Streaming prompt source: a ray_tpu.data Dataset whose rows carry
+    # token lists in `prompt_column`. Pulled through the pipelined data
+    # plane (iter_batches(prefetch_batches=...)) and cycled at epoch end,
+    # so prompt transform/read cost overlaps rollouts instead of stalling
+    # each iteration. Falls back to prompt_fn when unset.
+    prompt_dataset: Optional[Any] = None
+    prompt_column: str = "tokens"
     run_name: str = "rlhf"
     rollout_get_timeout: float = 120.0
     update_wait_timeout: float = 300.0
@@ -443,6 +450,9 @@ class RLHFTrainer:
         self._prompt_fn = (config.prompt_fn or
                            (lambda i: default_prompt_fn(
                                i, config.prompt_len, vocab)))
+        self._prompt_index = 0
+        self._prompt_stream = None   # lazy StreamingIterator (prompt_dataset)
+        self._prompt_buf: List[List[int]] = []
         self._hyper = {
             "lr": config.lr, "clip_eps": config.clip_eps,
             "kl_coef": config.kl_coef, "gamma": config.gamma,
@@ -781,6 +791,47 @@ class RLHFTrainer:
         if decision.switch:
             self._switch(decision.mode, decision.reason, iteration)
 
+    def _next_prompts(self, count: int) -> List[List[int]]:
+        """The next `count` prompts. With a prompt_dataset, rows stream
+        through the pipelined data plane — prefetch keeps the next batch
+        materializing while rollouts run — and the set cycles at epoch
+        end. Without one, the synthetic prompt_fn stream."""
+        cfg = self.config
+        if cfg.prompt_dataset is None:
+            base = self._prompt_index
+            self._prompt_index += count
+            return [self._prompt_fn(base + i) for i in range(count)]
+        out: List[List[int]] = []
+        while len(out) < count:
+            if self._prompt_buf:
+                out.append(self._prompt_buf.pop(0))
+                continue
+            if self._prompt_stream is None:
+                self._prompt_stream = cfg.prompt_dataset.iter_batches(
+                    batch_size=max(count, 1), prefetch_batches=2)
+            try:
+                batch = next(self._prompt_stream)
+            except StopIteration:
+                self._prompt_stream = None  # epoch exhausted: cycle
+                continue
+            col = (batch[cfg.prompt_column]
+                   if cfg.prompt_column in batch
+                   else next(iter(batch.values())))
+            for row in col:
+                toks = row.tolist() if hasattr(row, "tolist") else row
+                if not isinstance(toks, list):
+                    toks = [toks]
+                self._prompt_buf.append([int(t) for t in toks])
+        return out
+
+    def _close_prompt_stream(self) -> None:
+        if self._prompt_stream is not None:
+            try:
+                self._prompt_stream.stop()
+            except Exception:
+                pass
+            self._prompt_stream = None
+
     # -- main loop ----------------------------------------------------------
     def run(self) -> dict:
         cfg = self.config
@@ -790,13 +841,10 @@ class RLHFTrainer:
         self._start_loop()
         modes: List[str] = []
         rollout_tokens: Dict[int, Dict[int, List[int]]] = {}
-        prompt_index = 0
         try:
             for it in range(cfg.iterations):
                 t_iter = time.perf_counter()
-                prompts = [self._prompt_fn(prompt_index + i)
-                           for i in range(cfg.prompts_per_iter)]
-                prompt_index += cfg.prompts_per_iter
+                prompts = self._next_prompts(cfg.prompts_per_iter)
                 self.coordinator.add_prompts(prompts)
 
                 t0 = time.perf_counter()
@@ -827,6 +875,8 @@ class RLHFTrainer:
         except Exception:
             self.shutdown()
             raise
+        finally:
+            self._close_prompt_stream()
         # Wall time spans gang formation, switches, and rebuilds, so
         # placement churn dilutes goodput exactly like Train restarts do.
         self.telemetry.wall_time_s = time.perf_counter() - t_run
@@ -872,6 +922,7 @@ class RLHFTrainer:
             prompt, max_new_tokens))
 
     def shutdown(self) -> None:
+        self._close_prompt_stream()
         if self.loop is not None:
             try:
                 self.loop.stop(drain=False)

@@ -84,6 +84,19 @@ DATA_BACKPRESSURE = Counter(
     "ray_tpu_data_backpressure_total",
     "dataset producer throttle ENGAGEMENTS (idle->throttled transitions) "
     "under object-store pressure")
+DATA_BLOCKS_PRODUCED = Counter(
+    "ray_tpu_data_blocks_produced_total",
+    "blocks pulled through streaming data-plane producers (all consumers "
+    "on this process)")
+DATA_INPUT_WAIT_MS = Histogram(
+    "ray_tpu_data_input_wait_ms",
+    "time a streaming consumer blocked in next(batch) — near-zero means "
+    "the pipeline fully hid ingestion behind compute",
+    boundaries=[0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000])
+DATA_BACKLOG_DEPTH = Gauge(
+    "ray_tpu_data_backlog_depth",
+    "produced-but-unconsumed batches in this process's streaming rings "
+    "(bounded by prefetch_batches — the backpressure proof)")
 
 # -- collectives -----------------------------------------------------------
 # Per-(op, algo) traffic and latency of the out-of-graph collective plane.

@@ -64,6 +64,9 @@ class WorkerHandle:
         self.lease_resources: Dict[str, float] = {}
         self.pg_key: Optional[Tuple[bytes, int]] = None
         self.req_id: Optional[bytes] = None
+        # Worker ident (hex) of the lease HOLDER (the submitter caching this
+        # lease), so its death can reclaim the lease (_reclaim_holder_leases).
+        self.leased_to: str = ""
         # runtime_env fingerprint of work this process has executed: a
         # worker contaminated by env A's py_modules/working_dir is never
         # reused for env B (worker_pool.h runtime-env-keyed PopWorker).
@@ -72,13 +75,14 @@ class WorkerHandle:
 
 class PendingLease:
     def __init__(self, resources, for_actor, pg_key, fut, req_id=None,
-                 env_key=None):
+                 env_key=None, holder=""):
         self.resources = resources
         self.for_actor = for_actor
         self.pg_key = pg_key
         self.fut = fut
         self.req_id = req_id
         self.env_key = env_key
+        self.holder = holder
         self.enqueued = time.monotonic()
 
 
@@ -752,7 +756,43 @@ class Raylet:
                                             reason=reason)
                     except Exception:
                         pass
+                    await self._reclaim_holder_leases(w.worker_id.hex())
                     await self._dispatch_pending()
+
+    async def _reclaim_holder_leases(self, holder: str):
+        """Reclaim every lease whose HOLDER just died.
+
+        return_worker only ever arrives from the lease holder (submitters
+        cache idle leases for lease_idle_timeout_s before returning them),
+        so a client killed while holding cached leases — e.g. an actor
+        running a task-submitting loop — would otherwise leak its granted
+        resources forever: available CPUs pin at 0, every later lease
+        request starves, and the still-alive leased workers idle unleasable.
+        The leased worker itself keeps running; it just goes back in the
+        idle pool."""
+        if not holder:
+            return
+        freed = False
+        for w in list(self._workers.values()):
+            if w.lease_id is not None and w.leased_to == holder:
+                logger.info("reclaiming lease %s (holder %s died)",
+                            w.lease_id.hex()[:8], holder[:12])
+                try:
+                    scheduling.add(self._lease_pool(w.pg_key),
+                                   w.lease_resources)
+                except Exception:
+                    pass  # bundle already released with its PG
+                w.lease_id = None
+                w.lease_resources = {}
+                w.pg_key = None
+                w.req_id = None
+                w.busy_since = None
+                w.leased_to = ""
+                freed = True
+                if not w.is_actor:
+                    self._park_idle(w)
+        if freed:
+            await self._dispatch_pending()
 
     # ---- resource accounting ---------------------------------------------
 
@@ -780,7 +820,8 @@ class Raylet:
             conn, dict(req.resources), for_actor=req.for_actor,
             placement_group_id=req.placement_group_id or None,
             bundle_index=req.bundle_index,
-            req_id=req.req_id or None, env_key=req.env_key or None)
+            req_id=req.req_id or None, env_key=req.env_key or None,
+            holder=req.holder or "")
         return wire.LeaseReplyMsg.from_reply(reply).encode()
 
     async def handle_lease_batch2(self, conn, m: bytes):
@@ -814,7 +855,8 @@ class Raylet:
                 pg_key = (req.placement_group_id, idx)
             fut = asyncio.get_event_loop().create_future()
             pend = PendingLease(dict(req.resources), req.for_actor, pg_key,
-                                fut, req_id, env_key=req.env_key or None)
+                                fut, req_id, env_key=req.env_key or None,
+                                holder=req.holder or "")
             key = self._sched_class(pend.resources, pg_key, pend.env_key)
             self._queues.setdefault(key, collections.deque()).append(pend)
             waiting.append((req_id, fut))
@@ -862,7 +904,8 @@ class Raylet:
                                   placement_group_id: Optional[bytes] = None,
                                   bundle_index: int = -1,
                                   req_id: Optional[bytes] = None,
-                                  env_key: Optional[str] = None):
+                                  env_key: Optional[str] = None,
+                                  holder: str = ""):
         pg_key = None
         if placement_group_id is not None:
             idx = bundle_index if bundle_index >= 0 else self._any_bundle_index(placement_group_id)
@@ -873,7 +916,7 @@ class Raylet:
                      self.available, self._pending_count())
         fut = asyncio.get_event_loop().create_future()
         req = PendingLease(resources, for_actor, pg_key, fut, req_id,
-                           env_key=env_key)
+                           env_key=env_key, holder=holder)
         key = self._sched_class(resources, pg_key, env_key)
         self._queues.setdefault(key, collections.deque()).append(req)
         await self._dispatch_pending()
@@ -927,6 +970,7 @@ class Raylet:
                 w.pg_key = None
                 w.req_id = None
                 w.busy_since = None
+                w.leased_to = ""
                 if not w.is_actor:
                     self._park_idle(w)
                 await self._dispatch_pending()
@@ -1109,6 +1153,7 @@ class Raylet:
             w.pg_key = req.pg_key
             w.is_actor = req.for_actor
             w.req_id = req.req_id
+            w.leased_to = req.holder or ""
             w.busy_since = time.monotonic()
             if not req.fut.done():
                 logger.debug("grant_lease: worker=%s addr=%s", w.worker_id.hex()[:8], w.address)
@@ -1130,6 +1175,7 @@ class Raylet:
                 w.lease_resources = {}
                 w.pg_key = None
                 w.busy_since = None
+                w.leased_to = ""
                 if worker_dead:
                     try:
                         w.proc.terminate()

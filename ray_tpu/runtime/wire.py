@@ -458,6 +458,9 @@ class LeaseRequestMsg(Message):
     runtime_env_hash = Field(5, BYTES)
     env_key = Field(6, STR)
     req_id = Field(7, BYTES)
+    # Requesting worker's ident (hex): lets the raylet reclaim leases whose
+    # holder died while caching them idle (see raylet._reclaim_holder_leases).
+    holder = Field(8, STR)
 
 
 class LeaseReplyMsg(Message):

@@ -302,6 +302,12 @@ def summary() -> Dict:
             out["llm_serving"] = llm
     except Exception:
         pass  # no metrics plane / no LLM replicas: leave the key out
+    try:
+        ingest = data_ingest_summary()
+        if ingest:
+            out["data_ingest"] = ingest
+    except Exception:
+        pass  # no metrics plane / nothing streamed: leave the key out
     return out
 
 
@@ -316,6 +322,44 @@ def llm_serving_summary() -> Dict:
         if reply.get("value"):
             snapshots.append(json.loads(reply["value"]))
     return _aggregate_llm_metrics(snapshots)
+
+
+def data_ingest_summary() -> Dict:
+    """Fleet-wide streaming data-plane rollup from pushed metric
+    snapshots (data/streaming.py producers on every process): blocks
+    pulled, backpressure engagements, live ring backlog, and total/mean
+    consumer input-wait — the number that says whether ingestion hid
+    behind compute. Empty dict when nothing has streamed yet."""
+    import json
+
+    blocks = backpressure = backlog = wait_sum = 0.0
+    wait_count = 0
+    for key in _gcs_call("kv_keys", prefix=b"metrics:")["keys"]:
+        reply = _gcs_call("kv_get", key=key)
+        if not reply.get("value"):
+            continue
+        for metric in json.loads(reply["value"]):
+            name = metric.get("name", "")
+            if name == "ray_tpu_data_blocks_produced_total":
+                blocks += sum(metric.get("values", {}).values())
+            elif name == "ray_tpu_data_backpressure_total":
+                backpressure += sum(metric.get("values", {}).values())
+            elif name == "ray_tpu_data_backlog_depth":
+                backlog += sum(metric.get("values", {}).values())
+            elif name == "ray_tpu_data_input_wait_ms":
+                for h in metric.get("histograms", {}).values():
+                    wait_sum += h.get("sum", 0.0)
+                    wait_count += int(h.get("count", 0))
+    if not blocks and not wait_count:
+        return {}
+    out = {"blocks_produced": int(blocks),
+           "backpressure_engagements": int(backpressure),
+           "backlog_depth": int(backlog),
+           "batches_consumed": wait_count,
+           "input_wait_ms_total": round(wait_sum, 1)}
+    if wait_count:
+        out["input_wait_ms_mean"] = round(wait_sum / wait_count, 3)
+    return out
 
 
 def _aggregate_llm_metrics(snapshots: List[List[dict]]) -> Dict:

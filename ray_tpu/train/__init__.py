@@ -19,6 +19,7 @@ from ray_tpu.train.result import Result  # noqa: F401
 from ray_tpu.train.session import (  # noqa: F401
     get_checkpoint,
     get_context,
+    get_dataset_shard,
     load_state,
     report,
     step_phase,

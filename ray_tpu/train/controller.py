@@ -28,11 +28,17 @@ class TrainController:
     def __init__(self, train_fn: Callable, *, train_loop_config: Optional[Dict],
                  scaling_config: ScalingConfig, run_config: RunConfig,
                  backend: Any = "none", scaling_policy=None,
-                 failure_policy=None):
+                 failure_policy=None, datasets: Optional[Dict[str, Any]] = None,
+                 dataset_config: Optional[Dict[str, Any]] = None):
         from ray_tpu.train.elastic import FailurePolicy, FixedScalingPolicy
 
         self.train_fn = train_fn
         self.train_loop_config = train_loop_config or {}
+        # name -> Dataset, streamed to workers as per-rank StreamShards
+        # (session.get_dataset_shard). dataset_config holds iter_batches
+        # defaults (batch_size, prefetch_batches, ...).
+        self.datasets = datasets or {}
+        self.dataset_config = dataset_config or {}
         self.scaling = scaling_config
         self.run_config = run_config
         self.backend = backend
@@ -106,12 +112,14 @@ class TrainController:
             group = WorkerGroup(scaling, f"{self.run_name}-a{attempt}",
                                 self.storage_path)
             error = None
+            shards = None
             try:
                 group.start(self.backend, group_name=f"{self.run_name}-a{attempt}")
                 latest = self.ckpt_manager.latest_checkpoint
+                shards = self._make_dataset_shards(world)
                 group.start_training(
                     self.train_fn, self.train_loop_config,
-                    latest.path if latest else None)
+                    latest.path if latest else None, shards)
                 error = self._poll_until_done(group, poll_interval, world)
             except RayTpuError as e:
                 error = repr(e)
@@ -141,6 +149,7 @@ class TrainController:
                     except Exception:
                         pass
                 group.shutdown()
+                self._shutdown_dataset_shards(shards)
             if error is None:
                 self._final_result = Result(
                     metrics=self.latest_metrics,
@@ -200,6 +209,36 @@ class TrainController:
                 metrics_dataframe=self.metrics_history, error=error,
                 telemetry=self._finalize_telemetry(attempt))
             return self._final_result
+
+    def _make_dataset_shards(self, world: int) -> Optional[Dict[str, List]]:
+        """Per-attempt streaming shards: name -> list of per-rank
+        StreamShards over a fresh coordinator actor. equal=True so DDP
+        ranks see identical batch counts (no collective divergence); the
+        shuffle seed derives from the run name, so every attempt of a run
+        — including gang restarts — replays the same global visit order
+        and a restored cursor lands on the same blocks."""
+        if not self.datasets:
+            return None
+        import zlib
+
+        from ray_tpu.data.streaming import make_stream_shards
+
+        seed = zlib.crc32(self.run_name.encode())
+        return {name: make_stream_shards(ds, world, equal=True, seed=seed,
+                                         **self.dataset_config)
+                for name, ds in self.datasets.items()}
+
+    @staticmethod
+    def _shutdown_dataset_shards(shards: Optional[Dict[str, List]]) -> None:
+        if not shards:
+            return
+        from ray_tpu.data.streaming import shutdown_shards
+
+        for per_rank in shards.values():
+            try:
+                shutdown_shards(per_rank)
+            except Exception:
+                pass
 
     def _finalize_telemetry(self, attempts: int):
         self.telemetry.attempts = attempts

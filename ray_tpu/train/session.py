@@ -49,7 +49,8 @@ class TrainContext:
 class TrainSession:
     def __init__(self, world_rank: int, world_size: int, local_rank: int,
                  node_rank: int, run_name: str, storage_path: str,
-                 latest_checkpoint: Optional[Checkpoint] = None):
+                 latest_checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -57,6 +58,9 @@ class TrainSession:
         self.run_name = run_name
         self.storage_path = storage_path
         self.latest_checkpoint = latest_checkpoint
+        # name -> StreamShard (data/streaming.py) for THIS rank, installed
+        # by the worker group when the trainer was given `datasets=`.
+        self.dataset_shards: Dict[str, Any] = dataset_shards or {}
         self.results: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
@@ -89,6 +93,7 @@ class TrainSession:
         rec = {"step": self.step_index, "rank": self.world_rank,
                "total_s": total,
                "data_s": phases.pop("data", 0.0),
+               "input_wait_s": phases.pop("input_wait", 0.0),
                "collective_s": phases.pop("collective", 0.0),
                "checkpoint_s": phases.pop("checkpoint", 0.0),
                "checkpoint_persist_s": bg.get("checkpoint_persist", 0.0),
@@ -184,6 +189,7 @@ def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None,
         s.latest_checkpoint = checkpoint
     if state is not None:
         _save_state_async(s, state, dict(metrics), state_name)
+        _save_stream_cursors(s)
     telemetry = s._close_step()
     s.results.put({"metrics": dict(metrics), "checkpoint_path": ckpt_path,
                    "rank": s.world_rank, "telemetry": telemetry})
@@ -211,6 +217,60 @@ def _save_state_async(s: TrainSession, state: Any, metrics: Dict[str, Any],
         s.ensure_plane().save_async(
             state, directory, name=name, rank=s.world_rank,
             world=s.world_size, step=s.step_index, on_done=on_done)
+
+
+def get_dataset_shard(name: str = "train"):
+    """This rank's StreamShard for a dataset the trainer was given via
+    `datasets={name: ds}` — a pipelined, backpressured, cursor-resumable
+    iterator source (data/streaming.py). Returns None when the run has no
+    such dataset, so train fns can fall back to synthetic input."""
+    return get_session().dataset_shards.get(name)
+
+
+def _save_stream_cursors(s: TrainSession) -> None:
+    """Ride the async checkpoint plane with each shard's stream cursor so
+    a restore resumes ingestion mid-epoch, bit-identically. One (world, 4)
+    int64 leaf per dataset: the plane's axis-0 sharding persists exactly
+    this rank's row, and reassembly on restore yields every rank's cursor
+    regardless of which rank reads it back."""
+    if not s.dataset_shards:
+        return
+    import numpy as np
+
+    directory = os.path.join(s.storage_path, f"{s.run_name}-ckpt",
+                             f"step_{s.step_index:08d}")
+    cursors = {}
+    for name, shard in s.dataset_shards.items():
+        arr = np.zeros((s.world_size, 4), dtype=np.int64)
+        arr[s.world_rank] = shard.cursor_row()
+        cursors[name] = arr
+    tree = {"world": np.asarray(s.world_size, dtype=np.int64),
+            "cursors": cursors}
+    with step_phase("checkpoint"):
+        s.ensure_plane().save_async(
+            tree, directory, name="datastream", rank=s.world_rank,
+            world=s.world_size, step=s.step_index)
+
+
+def restore_stream_cursors(s: TrainSession, directory: str) -> None:
+    """Load saved stream cursors from a checkpoint directory into this
+    session's shards (worker startup, after a failure or resize restart).
+    Skipped wholesale when the saving world size differs from the current
+    one — a resumed cursor indexes a per-rank shard sequence that only
+    exists at the original world size."""
+    if not s.dataset_shards:
+        return
+    from ray_tpu.checkpoint import has_manifest, restore_tree
+
+    if not has_manifest(directory, "datastream"):
+        return
+    tree = restore_tree(directory, name="datastream")
+    if int(tree.get("world", -1)) != s.world_size:
+        return
+    for name, shard in s.dataset_shards.items():
+        arr = tree.get("cursors", {}).get(name)
+        if arr is not None:
+            shard.load_cursor(arr[s.world_rank])
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

@@ -33,8 +33,11 @@ from typing import Dict, List
 # step paid; `checkpoint_persist_s` is background persist time that
 # overlapped compute (booked separately so it never distorts the residual) —
 # their ratio is the async checkpoint plane's win, per step.
-PHASE_KEYS = ("total_s", "data_s", "collective_s", "checkpoint_s",
-              "checkpoint_persist_s", "compute_s", "other_s")
+# `input_wait_s` is time the step spent BLOCKED in next(batch) on a
+# streaming dataset shard (data/streaming.py books it automatically) —
+# near-zero means the pipelined data plane fully hid ingestion.
+PHASE_KEYS = ("total_s", "data_s", "input_wait_s", "collective_s",
+              "checkpoint_s", "checkpoint_persist_s", "compute_s", "other_s")
 
 
 @dataclasses.dataclass
@@ -84,6 +87,7 @@ class TrainTelemetry:
                         "compute_s": acc["compute_s"],
                         "collective_s": acc["collective_s"],
                         "data_s": acc["data_s"],
+                        "input_wait_s": acc["input_wait_s"],
                         "checkpoint_s": acc["checkpoint_s"],
                         "checkpoint_persist_s": acc["checkpoint_persist_s"]})
         if out:

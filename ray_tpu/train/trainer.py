@@ -28,11 +28,19 @@ class DataParallelTrainer:
                  *, train_loop_config: Optional[Dict] = None,
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
-                 backend: Optional[Any] = None):
+                 backend: Optional[Any] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 dataset_config: Optional[Dict[str, Any]] = None):
         self.train_loop_per_worker = train_loop_per_worker
         self.train_loop_config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        # Streaming input pipeline: each dataset becomes per-rank
+        # StreamShards the train fn pulls via
+        # `train.get_dataset_shard(name).iter_batches()`; dataset_config
+        # carries iter_batches defaults (batch_size, prefetch_batches...).
+        self.datasets = datasets
+        self.dataset_config = dataset_config
         if backend is not None:
             self.backend = backend
 
@@ -42,7 +50,9 @@ class DataParallelTrainer:
             train_loop_config=self.train_loop_config,
             scaling_config=self.scaling_config,
             run_config=self.run_config,
-            backend=self.backend)
+            backend=self.backend,
+            datasets=self.datasets,
+            dataset_config=self.dataset_config)
         return controller.run()
 
 

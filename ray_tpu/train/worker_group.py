@@ -41,7 +41,8 @@ class TrainWorker:
         return True
 
     def start_training(self, train_fn_payload: bytes, config: Dict,
-                       latest_checkpoint_path: Optional[str]) -> bool:
+                       latest_checkpoint_path: Optional[str],
+                       dataset_shards: Optional[Dict[str, Any]] = None) -> bool:
         import cloudpickle
 
         train_fn = cloudpickle.loads(train_fn_payload)
@@ -49,7 +50,17 @@ class TrainWorker:
         self.session = session_mod.init_session(
             world_rank=self.rank, world_size=self.world_size,
             local_rank=self.rank, node_rank=0, run_name=self.run_name,
-            storage_path=self.storage_path, latest_checkpoint=ckpt)
+            storage_path=self.storage_path, latest_checkpoint=ckpt,
+            dataset_shards=dataset_shards)
+        if dataset_shards and latest_checkpoint_path:
+            # Resume ingestion where the checkpoint left it (mid-epoch,
+            # bit-identical visit order). Best-effort: a checkpoint from
+            # before the run had streaming datasets simply has no cursors.
+            try:
+                session_mod.restore_stream_cursors(
+                    self.session, latest_checkpoint_path)
+            except Exception:
+                pass
 
         def run():
             try:
@@ -144,12 +155,21 @@ class WorkerGroup:
         ray_tpu.get([w.setup_backend.remote(backend_name, group_name)
                      for w in self.workers], timeout=300)
 
-    def start_training(self, train_fn, config, latest_checkpoint_path):
+    def start_training(self, train_fn, config, latest_checkpoint_path,
+                       dataset_shards: Optional[Dict[str, List]] = None):
+        """`dataset_shards`: name -> per-rank StreamShard list (length
+        num_workers), built by the controller via make_stream_shards."""
         import cloudpickle
 
         payload = cloudpickle.dumps(train_fn)
-        ray_tpu.get([w.start_training.remote(payload, config, latest_checkpoint_path)
-                     for w in self.workers], timeout=300)
+        refs = []
+        for i, w in enumerate(self.workers):
+            per_rank = ({name: shards[i]
+                         for name, shards in dataset_shards.items()}
+                        if dataset_shards else None)
+            refs.append(w.start_training.remote(
+                payload, config, latest_checkpoint_path, per_rank))
+        ray_tpu.get(refs, timeout=300)
 
     def poll(self) -> List[Dict]:
         return ray_tpu.get([w.poll.remote() for w in self.workers], timeout=120)
