@@ -36,6 +36,9 @@ event-undeclared      emit()/make_event() called with a string literal
                       that is not a registered event type.
 metric-def            metric_defs.py hygiene: ray_tpu_-prefixed name,
                       non-empty description, literal tag_keys tuple.
+metric-docs           every metric declared in runtime/metric_defs.py must
+                      have a backticked row in docs/observability.md (the
+                      event-docs discipline, applied to metrics).
 metric-central        Counter/Gauge/Histogram constructed outside
                       runtime/metric_defs.py (runtime metrics are defined
                       once, in the central table).
@@ -80,6 +83,7 @@ RULES: Dict[str, str] = {
     "event-docs": "event type has no docs/observability.md row",
     "event-undeclared": "emit() with an unregistered event-type literal",
     "metric-def": "metric definition hygiene (name/description/tag_keys)",
+    "metric-docs": "metric has no docs/observability.md row",
     "metric-central": "metric constructed outside runtime/metric_defs.py",
     "metric-tags": "metric observed with undeclared tag keys",
     "thread-attrs": "threading.Thread without daemon=True and name=",
@@ -567,6 +571,38 @@ def _metric_registry(cfg: LintConfig, mods: Dict[str, _Module]
     return registry, violations
 
 
+def _pass_metric_docs(cfg: LintConfig, mods: Dict[str, _Module],
+                      notes: List[str]) -> Iterator[Violation]:
+    """Every metric declared in metric_defs.py needs a backticked row in
+    docs/observability.md — the event-docs discipline applied to metrics:
+    the docs table is the contract for what operators can alert on."""
+    mi = mods.get(cfg.metric_defs_module)
+    if mi is None:
+        return
+    docs = _read_text(cfg, cfg.docs_observability)
+    if docs is None:
+        notes.append(f"metric-docs skipped: {cfg.docs_observability} "
+                     f"not found")
+        return
+    for node in mi.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id in _METRIC_CLASSES):
+            continue
+        name_arg = node.value.args[0] if node.value.args else None
+        if not (isinstance(name_arg, ast.Constant)
+                and isinstance(name_arg.value, str)):
+            continue  # metric-def already flags non-literal names
+        if f"`{name_arg.value}`" not in docs:
+            yield Violation(
+                "metric-docs", cfg.metric_defs_module, node.lineno,
+                f"metric {name_arg.value} has no row in "
+                f"{cfg.docs_observability} — document what it measures "
+                f"and when it moves before shipping it")
+
+
 def _pass_metrics(cfg: LintConfig,
                   mods: Dict[str, _Module]) -> Iterator[Violation]:
     registry, def_violations = _metric_registry(cfg, mods)
@@ -710,6 +746,7 @@ def run(root: Optional[str] = None,
     raw.extend(_pass_actor_init(cfg, mods))
     raw.extend(_pass_wire(cfg, mods, result.notes))
     raw.extend(_pass_events(cfg, mods, result.notes))
+    raw.extend(_pass_metric_docs(cfg, mods, result.notes))
     raw.extend(_pass_metrics(cfg, mods))
     raw.extend(_pass_threads(cfg, mods))
     baseline = _load_baseline(cfg, baseline_path)

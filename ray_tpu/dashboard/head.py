@@ -223,6 +223,7 @@ class DashboardHead:
             web.get("/api/actors", self.actors),
             web.get("/api/actors/{actor_id}", self.actor_detail),
             web.get("/api/timeline", self.timeline),
+            web.get("/api/requests", self.requests_view),
             web.get("/api/placement_groups", self.placement_groups),
             web.get("/api/cluster_resources", self.cluster_resources),
             web.get("/api/serve", self.serve_deployments),
@@ -383,6 +384,49 @@ class DashboardHead:
             } for b in bars]
             return _json({"traceEvents": trace})
         return _json(bars)
+
+    async def requests_view(self, request):
+        """Stitched per-request serving trace (`scripts request` analog):
+        /api/requests?id=<request_id> pulls every process's span ring —
+        the raylets fan `dump_spans` out to their workers — and returns
+        the spans whose trace id derives from that request id, sorted by
+        start time. The trace id is a pure function of the request id, so
+        no propagation state is needed here."""
+        from ray_tpu.runtime.rpc import RpcClient
+        from ray_tpu.util import tracing
+
+        rid = request.query.get("id")
+        if not rid:
+            return _json({"error": "missing ?id=<request_id>"}, status=400)
+        want = tracing.request_trace_id(rid).hex()
+        groups = [("dashboard", tracing.get_spans())]
+        for n in await self.gcs.call("get_nodes"):
+            try:
+                client = RpcClient(*tuple(n["address"]))
+                await client.connect(timeout=5)
+                try:
+                    reply = await client.call("dump_spans", timeout=15)
+                finally:
+                    await client.close()
+            except Exception:
+                continue
+            for proc in reply.get("processes", ()):
+                groups.append((proc["label"], proc["spans"]))
+        spans, seen = [], set()
+        for label, group in groups:
+            for s in group:
+                a = s.get("args") or {}
+                if a.get("trace_id") != want:
+                    continue
+                sid = a.get("span_id")
+                if sid and sid in seen:
+                    continue  # same ring reachable via two fan-out paths
+                seen.add(sid)
+                ev = dict(s)
+                ev["process"] = label
+                spans.append(ev)
+        spans.sort(key=lambda s: s.get("ts", 0.0))
+        return _json({"request_id": rid, "trace_id": want, "spans": spans})
 
     async def placement_groups(self, request):
         return _json(await self.gcs.call("list_placement_groups"))

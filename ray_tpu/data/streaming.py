@@ -37,6 +37,7 @@ See docs/data_streaming.md for knobs, numbers, and cursor semantics.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
@@ -270,14 +271,23 @@ class StreamingIterator:
     Backpressure: at most `prefetch_batches` produced-but-unconsumed
     batches exist at any moment (semaphore acquired before each push,
     released at each pop); upstream, the executor's bounded in-flight caps
-    hold. `max_backlog` records the high-water mark as the proof probe."""
+    hold. `max_backlog` records the high-water mark as the proof probe.
+
+    Adaptive depth: pass ``prefetch_batches="adaptive"`` and the window
+    sizes itself from the same signal `ray_tpu_data_input_wait_ms`
+    observes — a blocking pop grows the depth by one (an extra semaphore
+    permit), a sustained quiet run shrinks it by withholding one release.
+    Clamps: [1, RAY_TPU_DATA_PREFETCH_MAX] (default 16); the quiet window
+    is RAY_TPU_DATA_PREFETCH_QUIET pops (default 32). The current depth is
+    the `prefetch_depth` probe; `depth_grows`/`depth_shrinks` count the
+    controller's moves."""
 
     def __init__(self, source: Callable[[StreamCursor], Iterator[
                      Tuple[int, Block]]], *,
                  batch_size: Optional[int] = 256,
                  batch_format: str = "numpy",
                  drop_last: bool = False,
-                 prefetch_batches: int = 2,
+                 prefetch_batches=2,
                  device_index: Optional[int] = None,
                  cursor: Optional[StreamCursor] = None,
                  on_exhausted: Optional[Callable[[], None]] = None):
@@ -285,15 +295,30 @@ class StreamingIterator:
         self._batch_size = batch_size
         self._batch_format = batch_format
         self._drop_last = drop_last
-        self._prefetch = max(1, int(prefetch_batches))
+        if prefetch_batches == "adaptive":
+            self._adaptive = True
+            self._min_prefetch = 1
+            self._max_prefetch = max(2, int(os.environ.get(
+                "RAY_TPU_DATA_PREFETCH_MAX", "16")))
+            self._prefetch = min(2, self._max_prefetch)
+        else:
+            self._adaptive = False
+            self._prefetch = max(1, int(prefetch_batches))
+            self._min_prefetch = self._max_prefetch = self._prefetch
+        self._quiet_window = max(1, int(os.environ.get(
+            "RAY_TPU_DATA_PREFETCH_QUIET", "32")))
+        self._quiet_run = 0
+        self.depth_grows = 0
+        self.depth_shrinks = 0
         self._on_exhausted = on_exhausted
         self.cursor = cursor if cursor is not None else StreamCursor()
         self._start = dataclasses.replace(self.cursor)
         # Frame capacity: a batch is 1 header + ncols frames. 8 columns per
         # batch fully buffered is generous; wider batches just make the
         # writer block mid-batch while the reader drains (no deadlock: the
-        # reader never waits on anything but the channel).
-        self._ring = _make_ring((self._prefetch + 2) * 8, device_index)
+        # reader never waits on anything but the channel). Sized for the
+        # MAX depth so adaptive growth never outruns the ring.
+        self._ring = _make_ring((self._max_prefetch + 2) * 8, device_index)
         self._sem = threading.Semaphore(self._prefetch)
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
@@ -374,7 +399,8 @@ class StreamingIterator:
         metric_defs.DATA_INPUT_WAIT_MS.observe(dt * 1e3)
         self._consumed += 1
         metric_defs.DATA_BACKLOG_DEPTH.set(self._produced - self._consumed)
-        self._sem.release()
+        for _ in range(self._adapt(dt) if self._adaptive else 1):
+            self._sem.release()
         s_idx, j, last = header
         if last:
             self.cursor.block_offset = s_idx + 1
@@ -423,7 +449,36 @@ class StreamingIterator:
         except Exception:
             pass
 
+    def _adapt(self, dt: float) -> int:
+        """Adaptive-depth controller, run at every pop. Returns how many
+        semaphore permits to release: 2 grows the window (the producer may
+        now keep one more batch in flight), 1 holds it, 0 shrinks it by
+        one. A blocking pop is direct evidence the producer fell behind;
+        only a sustained run of non-blocking pops is evidence the window
+        is oversized (a single fast pop proves nothing — the producer may
+        just have gotten lucky)."""
+        if dt >= 1e-3:
+            self._quiet_run = 0
+            if self._prefetch < self._max_prefetch:
+                self._prefetch += 1
+                self.depth_grows += 1
+                return 2
+            return 1
+        self._quiet_run += 1
+        if (self._quiet_run >= self._quiet_window
+                and self._prefetch > self._min_prefetch):
+            self._quiet_run = 0
+            self._prefetch -= 1
+            self.depth_shrinks += 1
+            return 0
+        return 1
+
     # -- probes ------------------------------------------------------------
+    @property
+    def prefetch_depth(self) -> int:
+        """Current prefetch window (fixed unless "adaptive")."""
+        return self._prefetch
+
     @property
     def prefetch_hit_rate(self) -> float:
         """Fraction of pops served without blocking — 1.0 means the
@@ -527,7 +582,7 @@ class StreamShard:
     def __init__(self, coordinator, rank: int, world: int,
                  seed: Optional[int], *, batch_size: Optional[int] = 256,
                  batch_format: str = "numpy", drop_last: bool = False,
-                 prefetch_batches: int = 2,
+                 prefetch_batches=2,
                  device_index: Optional[int] = None):
         self._coord = coordinator
         self.rank = int(rank)
@@ -612,7 +667,7 @@ def make_stream_shards(ds, n: int, *, equal: bool = False,
                        batch_size: Optional[int] = 256,
                        batch_format: str = "numpy",
                        drop_last: bool = False,
-                       prefetch_batches: int = 2,
+                       prefetch_batches=2,
                        device_index: Optional[int] = None,
                        max_in_flight: Optional[int] = None
                        ) -> List[StreamShard]:
@@ -658,7 +713,7 @@ def shutdown_shards(shards: List[StreamShard]) -> None:
 
 def make_local_iterator(ds, *, batch_size: Optional[int] = 256,
                         batch_format: str = "numpy", drop_last: bool = False,
-                        prefetch_batches: int = 2,
+                        prefetch_batches=2,
                         device_index: Optional[int] = None,
                         cursor: Optional[StreamCursor] = None
                         ) -> StreamingIterator:

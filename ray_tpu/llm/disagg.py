@@ -13,12 +13,18 @@ enters decode directly.
 Wire format (reuses the chunked raw-frame machinery the ring collectives
 run on, collective/cpu_group.py):
 
-    [u64 body len][u8 kind=2][JSON request state + kv dtype/shape]
+    [u64 body len][u8 kind=3][KVHandoffMsg: state JSON + kv meta + trace ctx]
     [u64][u8 kind=1][97B _AMETA][raw k-page bytes]   x ceil(bytes/1MiB)
     [u64][u8 kind=1][97B _AMETA][raw v-page bytes]   x ceil(bytes/1MiB)
     <- [u64][u8 kind=2][JSON ack]
 
-Control frames are JSON (kind 2), NOT pickle: the handoff hot path moves
+The head frame is the typed wire.KVHandoffMsg (kind 3): the portable
+request state as JSON bytes plus the request's trace context, so the decode
+replica's adopt span parent-links to the sender's handoff span and one
+stitched trace covers PrefillServer -> decode replica -> migration target.
+Receivers also still accept a bare JSON head frame (kind 2, the pre-trace
+wire) — unknown-field/missing-field semantics match the TaskSpec wire.
+Control frames are never pickle: the handoff hot path moves
 zero pickled bytes end to end (counter-tested like the ring collectives),
 and a decode replica never evals attacker-shaped pickles off a socket. Page
 payloads ride kind-1 array frames straight out of / into the page buffers
@@ -46,9 +52,14 @@ from ray_tpu.collective.cpu_group import (
     _AMETA, _HDR, _K_ARRAY, _chunks, _frame_views, _read_ameta, _read_hdr,
     _sock_recv_into, _sock_send)
 from ray_tpu.core import serialization as _ser
+from ray_tpu.runtime import wire
+from ray_tpu.util import tracing
 
 # Handoff control frame: JSON body (kinds 0/1 belong to cpu_group's wire).
 _K_JSON = 2
+# Typed control frame: wire.KVHandoffMsg body — the JSON request state plus
+# the trace context that stitches the request's spans across the handoff.
+_K_MSG = 3
 _CHUNK_BYTES = 1 << 20
 
 
@@ -63,10 +74,30 @@ def _send_json(sock: socket.socket, obj: dict,
                None, deadline)
 
 
+def _send_msg(sock: socket.socket, msg,
+              deadline: Optional[float] = None) -> None:
+    body = msg.encode()
+    _sock_send(sock, memoryview(_HDR.pack(len(body), _K_MSG) + body),
+               None, deadline)
+
+
 def _recv_frame(sock: socket.socket, deadline: Optional[float] = None):
     """Receive one logical handoff message: ("json", dict) or a whole raw
     array reassembled across its chunk frames ("array", flat uint8)."""
     length, kind = _read_hdr(sock, None, deadline)
+    if kind == _K_MSG:
+        # Typed head frame: request state + trace context (KVHandoffMsg).
+        # Decoded into the same meta dict shape the JSON frame carries so
+        # everything downstream is agnostic to which head frame arrived.
+        body = bytearray(length)
+        _sock_recv_into(sock, memoryview(body), None, deadline)
+        msg = wire.KVHandoffMsg.decode(bytes(body))
+        meta = json.loads(msg.state_json.decode())
+        meta["kv_dtype"] = msg.kv_dtype
+        meta["kv_shape"] = list(msg.kv_shape)
+        if msg.trace_id:
+            meta["_trace"] = (msg.trace_id, msg.parent_span_id or None)
+        return "json", meta
     if kind == _K_JSON:
         body = bytearray(length)
         _sock_recv_into(sock, memoryview(body), None, deadline)
@@ -110,16 +141,25 @@ def send_handoff(address, state: dict, k_pages, v_pages, *,
     is treated as never having happened; the router re-runs prefill)."""
     k = np.ascontiguousarray(k_pages)
     v = np.ascontiguousarray(v_pages)
-    meta = dict(state)
-    meta["kv_dtype"] = str(k.dtype)
-    meta["kv_shape"] = list(k.shape)
-    deadline = time.monotonic() + timeout
-    with socket.create_connection(tuple(address), timeout=timeout) as sock:
-        sock.settimeout(timeout)
-        _send_json(sock, meta, deadline)
-        _send_array(sock, k, deadline)
-        _send_array(sock, v, deadline)
-        kind, ack = _recv_frame(sock, deadline)
+    migrated = bool(state.get("migrated"))
+    with tracing.span("llm:kv_handoff", "llm",
+                      request_id=str(state.get("id", "")),
+                      migrated=migrated, bytes=int(k.nbytes + v.nbytes)):
+        # The trace ids captured INSIDE the span: the receiver's adopt span
+        # parent-links to this handoff span, not to the caller's.
+        msg = wire.KVHandoffMsg(
+            state_json=json.dumps(state).encode(),
+            kv_dtype=str(k.dtype), kv_shape=list(k.shape),
+            migrated=migrated,
+            trace_id=tracing.current_trace_id() or b"",
+            parent_span_id=tracing.current_span_id() or b"")
+        deadline = time.monotonic() + timeout
+        with socket.create_connection(tuple(address), timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            _send_msg(sock, msg, deadline)
+            _send_array(sock, k, deadline)
+            _send_array(sock, v, deadline)
+            kind, ack = _recv_frame(sock, deadline)
     if kind != "json" or not ack.get("ok"):
         raise HandoffError(f"decode replica rejected handoff: {ack}")
     return ack
@@ -187,12 +227,20 @@ class KVStreamServer:
                 # Partial stream (sender died / malformed): adopt NOTHING.
                 self.handoffs_rejected += 1
                 return
+            trace_id, parent = meta.pop("_trace", (None, None))
             try:
                 dtype = np.dtype(meta.pop("kv_dtype"))
                 shape = tuple(meta.pop("kv_shape"))
                 k = kflat.view(dtype).reshape(shape)
                 v = vflat.view(dtype).reshape(shape)
-                ok = bool(self._adopt(meta, k, v))
+                # Adopt under the sender's trace context so this side of the
+                # handoff — and any spans the adopt path opens — stitches
+                # into the request's trace across the process boundary.
+                with tracing.trace_context(trace_id, parent):
+                    with tracing.span("llm:kv_adopt", "llm",
+                                      request_id=str(meta.get("id", "")),
+                                      migrated=bool(meta.get("migrated"))):
+                        ok = bool(self._adopt(meta, k, v))
             except Exception as e:
                 self.handoffs_rejected += 1
                 try:
@@ -251,6 +299,7 @@ class PrefillServer:
         finished during prefill."""
         prompt, params, lora_name, rid = self._parse(request)
         t0 = time.monotonic()
+        t0_wall = time.time()
         with self._lock:
             # A router-assigned request_id rides through so the decode-side
             # stream keeps the router's name for the request (failover
@@ -269,6 +318,8 @@ class PrefillServer:
                 if not self.engine.has_unfinished():
                     raise RuntimeError(f"request {rid} vanished mid-prefill")
             if final is not None:
+                # Finished AT prefill: the engine recorded the lifecycle
+                # spans when the request finished; nothing to hand off.
                 return {"handoff": False, "rid": rid,
                         "response": _completion_response(final)}
             state = self.engine.export_request(rid)
@@ -279,9 +330,17 @@ class PrefillServer:
             tps = len(prompt) / elapsed
             self._prefill_tps = (tps if self._prefill_tps == 0.0
                                  else 0.8 * self._prefill_tps + 0.2 * tps)
+            t1_wall = time.time()
         # Stream outside the lock: the socket write must not serialize the
-        # next request's prefill compute behind network time.
-        ack = send_handoff(decode_address, state, k, v)
+        # next request's prefill compute behind network time. The handoff
+        # span (opened inside send_handoff) joins the request's trace — the
+        # trace id is derived from the rid, so this stitches to the router's
+        # root span without any context having crossed the RPC.
+        with tracing.trace_context(tracing.request_trace_id(rid), None):
+            tracing.record_span("llm:prefill", "llm", t0_wall, t1_wall,
+                                request_id=rid, tokens=len(prompt),
+                                tier="prefill")
+            ack = send_handoff(decode_address, state, k, v)
         return {"handoff": True, "rid": rid, "ack": ack,
                 "prefill_tokens_per_s": round(self._prefill_tps, 1)}
 

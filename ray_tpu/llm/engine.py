@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import os
+import time
 import uuid
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -104,6 +105,16 @@ class _Request:
         self.lora_pinned = lora_slot != 0   # released once on finish
         self.prefix_hashes: Optional[List[bytes]] = None  # lazy, per prompt
         self.registered_blocks = 0  # prompt blocks made cache-addressable
+        # Lifecycle timestamps (wall clock, so they compare across replicas)
+        # for the TTFT/ITL decomposition. The dict travels INSIDE the
+        # export_request/export_session state, so queue/prefill time spent
+        # on a prefill replica stays attributed after a disagg handoff or a
+        # live migration; handoff_s/pause_s accumulate the off-engine gaps.
+        self.timing: Dict[str, Optional[float]] = {
+            "t_submit": time.time(), "t_admit": None,
+            "t_first_token": None, "t_last_token": None,
+            "handoff_s": 0.0, "pause_s": 0.0}
+        self.adopted = False   # arrived via KV handoff (prefill elsewhere)
 
     @property
     def num_tokens(self) -> int:
@@ -414,6 +425,15 @@ class LLMEngine:
         budget = max(budget, self.max_batch * self._spec_width, 8)
         self.token_budget = -(-budget // 8) * 8
         self._warm_mixed: set = set()   # token buckets already precompiled
+        # Tick flight recorder: bounded ring of per-tick records (batch
+        # composition, token budget used, T-bucket, recompile flag, tokens
+        # emitted per request) so a slow token is attributable to a CAUSE —
+        # budget exhaustion behind a long prefill, a silent recompile, a
+        # migration pause — not just visible as a gap. Dict-append per tick,
+        # no device sync: cheap enough to stay always-on.
+        self.flight_records: deque = deque(
+            maxlen=int(os.environ.get("RAY_TPU_LLM_FLIGHT_RECORDS", "256")))
+        self._tick_note: Dict = {}
 
     # ---- API -------------------------------------------------------------
 
@@ -461,14 +481,35 @@ class LLMEngine:
         if self._rejected:
             outputs.extend(self._rejected)
             self._rejected.clear()
+        t0 = time.time()
+        self._tick_note = {}
         if self._use_unified():
             outputs.extend(self._mixed_tick())
-            return outputs
-        if self.prefilling:
-            outputs.extend(self._prefill_step())
-        if not self.prefill_only and (self.running or self._flights):
-            outputs.extend(self._decode_tick())
+        else:
+            if self.prefilling:
+                outputs.extend(self._prefill_step())
+            if not self.prefill_only and (self.running or self._flights):
+                outputs.extend(self._decode_tick())
+        note = self._tick_note
+        if note:
+            note["t"] = t0
+            note["dur_ms"] = round((time.time() - t0) * 1e3, 3)
+            note["waiting"] = len(self.waiting)
+            # Per-request token positions emitted this tick: rid -> absolute
+            # output position after the tick (gap attribution joins a slow
+            # token's position to the tick that produced it).
+            note["emitted"] = {o.request_id: len(o.output_token_ids)
+                               for o in outputs if o.new_token_ids}
+            self.flight_records.append(note)
         return outputs
+
+    def _note(self, **fields):
+        """Merge one phase's facts into the current tick record (the split
+        path may run prefill AND decode inside one step)."""
+        n = self._tick_note
+        if "kind" in n and "kind" in fields:
+            fields["kind"] = f"{n['kind']}+{fields['kind']}"
+        n.update(fields)
 
     def _use_unified(self) -> bool:
         """Route this iteration through the unified mixed launch. Falls back
@@ -635,6 +676,7 @@ class LLMEngine:
             "step_compiles": getattr(self.runner, "step_compiles", 0),
             "unified_ticks": self.unified_ticks,
             "token_budget": self.token_budget,
+            "tick_records": len(self.flight_records),
         }
         if self.host_prefix_tier is not None:
             t = self.host_prefix_tier.stats()
@@ -666,6 +708,19 @@ class LLMEngine:
                 "lora_evictions": getattr(lm, "evictions", 0),
             })
         return out
+
+    def tick_records(self, limit: Optional[int] = None,
+                     request_id: Optional[str] = None) -> List[Dict]:
+        """Flight-recorder snapshot, newest last. `request_id` filters to
+        ticks that emitted tokens for that request (gap attribution for one
+        stream); `limit` keeps the newest N after filtering."""
+        records = list(self.flight_records)
+        if request_id is not None:
+            records = [r for r in records
+                       if request_id in (r.get("emitted") or {})]
+        if limit is not None:
+            records = records[-int(limit):]
+        return records
 
     # ---- disaggregated prefill/decode handoff (llm/disagg.py) ------------
 
@@ -743,6 +798,10 @@ class LLMEngine:
             "lora_slot": req.lora_slot,
             "params": dataclasses.asdict(req.params),
             "blocks": blocks,
+            # t_handoff marks when the request left this engine; the adopter
+            # books (adopt time - t_handoff) as handoff_s (or pause_s for a
+            # migration), so the off-engine gap stays attributed.
+            "timing": dict(req.timing, t_handoff=time.time()),
         }
 
     def adopt_request(self, state: dict, k_pages, v_pages) -> bool:
@@ -759,6 +818,18 @@ class LLMEngine:
                        int(state.get("lora_slot", 0)))
         req.output = [int(t) for t in state["output"]]
         req.seed_val = int(state["seed"])
+        req.adopted = True
+        timing = state.get("timing")
+        if timing:
+            for key in ("t_submit", "t_admit", "t_first_token",
+                        "t_last_token", "handoff_s", "pause_s"):
+                if timing.get(key) is not None:
+                    req.timing[key] = timing[key]
+            t_handoff = timing.get("t_handoff")
+            if t_handoff is not None:
+                gap = max(0.0, time.time() - float(t_handoff))
+                key = "pause_s" if state.get("migrated") else "handoff_s"
+                req.timing[key] = float(req.timing.get(key) or 0.0) + gap
         n_pages = int(np.shape(k_pages)[2])
         if self.block_manager.blocks_needed(len(req.context)) > n_pages:
             # The stream must cover every context token's KV; anything less
@@ -860,10 +931,13 @@ class LLMEngine:
         the chain. Promoted blocks are scattered into fresh device pages
         and re-registered under the local digest chain, so the next prompt
         sharing them hits the device tier directly. Returns tokens saved."""
+        from ray_tpu.util import tracing
+
         bm = self.block_manager
         bs = self.block_size
         limit = min(len(req.prefix_hashes), (len(req.prompt) - 1) // bs)
         promoted = 0
+        t_adopt0 = time.time()
         tier = self.host_prefix_tier
         while tier is not None and len(req.blocks) < limit:
             j = len(req.blocks)
@@ -923,6 +997,15 @@ class LLMEngine:
                         promoted += bs
                         self.cluster_prefix_hits += 1
                         self.cluster_prefix_tokens_saved += bs
+        if promoted and tracing.enabled():
+            # Stitch adoption into the request's trace: tokens the prefill
+            # did NOT have to recompute show up as a named span instead of
+            # unexplained TTFT variance.
+            with tracing.trace_context(tracing.request_trace_id(req.id),
+                                       None):
+                tracing.record_span(
+                    "llm:prefix_adopt", "llm", t_adopt0, time.time(),
+                    request_id=req.id, tokens_saved=promoted)
         return promoted
 
     def adopt_prefix(self, state: dict, k_pages, v_pages) -> int:
@@ -1058,6 +1141,8 @@ class LLMEngine:
                 req.registered_blocks = len(req.blocks)
             assert self.block_manager.allocate(req, len(req.context) + 1)
             req.prefilled = cached_tokens
+            if req.timing["t_admit"] is None:
+                req.timing["t_admit"] = time.time()
             self.prefilling.append(req)
 
     def warmup(self, *, full: bool = False) -> int:
@@ -1170,6 +1255,8 @@ class LLMEngine:
         Bq = self.runner.chunk_bucket(max(chunks))
         chunks = [min(c, Bq) for c in chunks]
         self.prefill_tokens_computed += sum(chunks)
+        self._note(kind="prefill", prefill_rows=len(batch),
+                   chunk_bucket=Bq, prefill_tokens=sum(chunks))
         S = self.runner.batch_bucket(len(batch))
         tokens = np.zeros((S, Bq), dtype=np.int32)
         q_positions = np.zeros(S, dtype=np.int32)
@@ -1374,6 +1461,8 @@ class LLMEngine:
             pass
         for req in batch:
             req.dispatched += k
+        self._note(kind="decode", decode_rows=len(batch), multi_step=k,
+                   inflight=len(self._flights) + 1)
         return {"batch": batch, "tokens": dev_tokens, "last": last, "k": k}
 
     def _process_inflight(self, flight: Optional[dict]) -> List[RequestOutput]:
@@ -1497,6 +1586,9 @@ class LLMEngine:
             kv_lens[i] = req.num_tokens + len(prop)
             q_lens[i] = len(row)
             tables[i, :len(req.blocks)] = req.blocks
+        self._note(kind="spec_verify", decode_rows=len(batch),
+                   spec_tokens=sum(len(p) for p in proposals),
+                   chunk_bucket=Bq)
         got = np.asarray(self.runner.step_verify(
             tokens, q_positions, kv_lens, q_lens, tables,
             lora_idx=self._lora_idx(batch, S)))
@@ -1620,7 +1712,15 @@ class LLMEngine:
             return outputs
         # -- assemble the token-major batch ---------------------------------
         Tb = _bucket(used, token_buckets(budget))
-        if Tb not in self._warm_mixed:
+        recompile = Tb not in self._warm_mixed
+        self._note(
+            kind="mixed", budget=budget, used=used, bucket=Tb,
+            recompile=recompile,
+            decode_rows=sum(1 for e in entries if e["kind"] == "decode"),
+            prefill_rows=sum(1 for e in entries if e["kind"] == "prefill"),
+            spec_tokens=sum(len(e["prop"]) for e in entries),
+            budget_exhausted=used >= budget)
+        if recompile:
             # A bucket outside the warmed ladder (or a pre-warmup call):
             # compile it on a dummy BEFORE the real tokens ride it, so the
             # steady-state loop never absorbs the stall unannounced.
@@ -1751,6 +1851,7 @@ class LLMEngine:
             kv_lens[i] = req.num_tokens
             q_lens[i] = 1
             tables[i, :len(req.blocks)] = req.blocks
+        self._note(kind="decode_host", decode_rows=len(batch))
         logits = np.asarray(self.runner.step(
             tokens, q_positions, kv_lens, q_lens, tables,
             lora_idx=self._lora_idx(batch, S)))
@@ -1770,13 +1871,89 @@ class LLMEngine:
         from ray_tpu.runtime import metric_defs
 
         metric_defs.LLM_TOKENS_GENERATED.inc(len(new_tokens))
+        now = time.time()
+        if req.timing["t_first_token"] is None:
+            req.timing["t_first_token"] = now
+        req.timing["t_last_token"] = now
         self._check_finished(req)
         done = req.finished_reason is not None
         if done:
             self._unpin_lora(req)
+            self._finish_trace(req)
         return RequestOutput(
             req.id, req.prompt, list(req.output), done, req.finished_reason,
             self._detok(req.output) if done else None, new_tokens)
+
+    def request_breakdown(self, req: _Request) -> Optional[Dict[str, float]]:
+        """TTFT/ITL decomposition for one request from its lifecycle
+        timestamps: queue_s (submit->admit), prefill_s (admit->first token,
+        minus handoff time), handoff_s (disagg KV streams), decode_s
+        (first->last token, minus stalls), stall_s (migration pauses)."""
+        t = req.timing
+        if t["t_first_token"] is None:
+            return None
+        t_submit = t["t_submit"]
+        t_admit = t["t_admit"] if t["t_admit"] is not None else t_submit
+        t_first = t["t_first_token"]
+        t_last = (t["t_last_token"] if t["t_last_token"] is not None
+                  else t_first)
+        handoff_s = float(t.get("handoff_s") or 0.0)
+        stall_s = float(t.get("pause_s") or 0.0)
+        return {
+            "queue_s": max(0.0, t_admit - t_submit),
+            "prefill_s": max(0.0, t_first - t_admit),
+            "handoff_s": handoff_s,
+            "decode_s": max(0.0, t_last - t_first - handoff_s - stall_s),
+            "stall_s": stall_s,
+        }
+
+    def _finish_trace(self, req: _Request):
+        """Close out a finished request's latency attribution: observe the
+        ray_tpu_llm_{ttft,itl}_breakdown_ms histograms and record the
+        queue/prefill/decode lifecycle spans under the request's trace (the
+        trace id derives from the rid, so these stitch with the router's
+        root span and the disagg handoff spans without any context having
+        crossed a process boundary)."""
+        from ray_tpu.runtime import metric_defs
+        from ray_tpu.util import tracing
+
+        bd = self.request_breakdown(req)
+        if bd is None:
+            return
+        metric_defs.LLM_TTFT_BREAKDOWN_MS.observe(
+            bd["queue_s"] * 1e3, tags={"phase": "queue"})
+        metric_defs.LLM_TTFT_BREAKDOWN_MS.observe(
+            bd["prefill_s"] * 1e3, tags={"phase": "prefill"})
+        if bd["handoff_s"]:
+            metric_defs.LLM_TTFT_BREAKDOWN_MS.observe(
+                bd["handoff_s"] * 1e3, tags={"phase": "handoff"})
+        # ITL phases are per inter-token gap: the mean decode gap, and the
+        # stall share (migration pauses) amortized over the same gaps.
+        gaps = max(1, len(req.output) - 1)
+        metric_defs.LLM_ITL_BREAKDOWN_MS.observe(
+            bd["decode_s"] * 1e3 / gaps, tags={"phase": "decode"})
+        if bd["stall_s"]:
+            metric_defs.LLM_ITL_BREAKDOWN_MS.observe(
+                bd["stall_s"] * 1e3 / gaps, tags={"phase": "stall"})
+        if not tracing.enabled():
+            return
+        t = req.timing
+        t_admit = t["t_admit"] if t["t_admit"] is not None else t["t_submit"]
+        with tracing.trace_context(tracing.request_trace_id(req.id), None):
+            if t_admit > t["t_submit"]:
+                tracing.record_span("llm:queue", "llm", t["t_submit"],
+                                    t_admit, request_id=req.id)
+            if not req.adopted:
+                # Adopted requests prefilled elsewhere — that replica
+                # already recorded the llm:prefill span.
+                tracing.record_span(
+                    "llm:prefill", "llm", t_admit, t["t_first_token"],
+                    request_id=req.id, tokens=len(req.prompt))
+            tracing.record_span(
+                "llm:decode", "llm", t["t_first_token"], t["t_last_token"],
+                request_id=req.id, tokens=len(req.output),
+                finish_reason=req.finished_reason or "",
+                **{k: round(v, 6) for k, v in bd.items()})
 
     def _check_finished(self, req: _Request):
         p = req.params

@@ -123,9 +123,18 @@ class ModelRunner:
         self._seen_shapes.add(key)
         self.step_compiles += 1
         from ray_tpu.runtime import metric_defs
+        from ray_tpu.util import tracing
 
         metric_defs.LLM_STEP_COMPILES.inc()
         logger.info("llm step compile #%d: %s", self.step_compiles, key)
+        # Instant span: the compile itself happens inside the dispatch that
+        # follows, but a marker in the request timeline is what attributes
+        # the one slow inter-token gap to XLA rather than to scheduling.
+        import time as time_mod
+        t = time_mod.time()
+        tracing.record_span("llm:step_compile", "llm", t, t,
+                            entry_point=kind,
+                            compile_index=self.step_compiles)
         return True
 
     # ---- placement (TP over the mesh, SERVE_RULES) -----------------------

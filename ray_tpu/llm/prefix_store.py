@@ -392,7 +392,15 @@ class ClusterPrefixStore:
             token_ids=[int(t) for t in tokens], nbytes=len(payload),
             owner_replica=self.replica, node_id=self._node_id(),
             deployment=self.deployment).encode()
+        import time
+
+        from ray_tpu.util import tracing
+
+        t0 = time.time()
         out = self._call("prefix_upsert", m, payload, wait=wait)
+        tracing.record_span("llm:prefix_spill", "llm", t0, time.time(),
+                            tokens=len(tokens), bytes=len(payload),
+                            replica=self.replica)
         self.published += 1
         try:
             from ray_tpu.runtime import events, metric_defs
@@ -424,12 +432,20 @@ class ClusterPrefixStore:
 
         if not digests:
             return []
+        import time
+
+        from ray_tpu.util import tracing
+
         m = wire.PrefixLookupMsg(
             digests=[bytes(d) for d in digests], lora_id=lora_id or "",
             weights_version=int(weights_version),
             block_size=self.block_size, want_payload=True,
             replica=self.replica).encode()
+        t0 = time.time()
         out = self._call("prefix_lookup", m)
+        tracing.record_span("llm:prefix_fetch", "llm", t0, time.time(),
+                            digests=len(digests), hit=out is not None,
+                            replica=self.replica)
         if out is None:
             return []
         m_reply, payload = out

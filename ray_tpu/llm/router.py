@@ -837,12 +837,27 @@ class FleetSupervisor:
         """Route one completion with failover: the request gets a stable
         router-assigned request_id, so any replay — replica death, drain
         fallback — reproduces the identical token stream (the engine seeds
-        sampling from crc32(request_id) when no explicit seed is given)."""
-        from ray_tpu.runtime import events, metric_defs
+        sampling from crc32(request_id) when no explicit seed is given).
+
+        The router owns the request's ROOT trace span: the trace id derives
+        from the rid (util/tracing.request_trace_id), so every span any
+        replica records for this request — engine lifecycle, disagg
+        handoff, migration pause — stitches under one trace with no context
+        riding the RPCs themselves."""
+        from ray_tpu.util import tracing
 
         request = dict(request)
         rid = request.get("request_id") or uuid.uuid4().hex[:12]
         request["request_id"] = rid
+        with tracing.trace_context(tracing.request_trace_id(rid), None):
+            with tracing.span("llm:request", "llm", request_id=rid,
+                              deployment=self.deployment):
+                return self._route_completions(request, rid)
+
+    def _route_completions(self, request: Dict, rid: str) -> Dict:
+        from ray_tpu.runtime import events, metric_defs
+        from ray_tpu.util import tracing
+
         prompt = request.get("prompt", [])
         token_prompt = (list(prompt.encode()) if isinstance(prompt, str)
                         else list(prompt))
@@ -875,8 +890,13 @@ class FleetSupervisor:
                 metric_defs.LLM_ROUTER_AFFINITY.inc(tags={
                     "outcome": "hit" if decision["reason"] != "pow2"
                     else "miss"})
+                t_adm = time.time()
                 ok, projected = self.core.admit(idx, len(token_prompt),
                                                 stats)
+                tracing.record_span(
+                    "llm:admit", "llm", t_adm, time.time(),
+                    request_id=rid, replica=str(idx), admitted=ok,
+                    projected_ttft_s=round(projected, 4))
                 if not ok:
                     metric_defs.LLM_ROUTER_SHED.inc(
                         tags={"deployment": self.deployment})
@@ -906,9 +926,14 @@ class FleetSupervisor:
                     len(token_prompt), max(time.monotonic() - t0, 1e-6))
                 return resp
             except Exception as exc:
+                t_fail = time.time()
                 outcome = self._handle_request_failure(idx, rid, exc)
                 if outcome is not None:
                     return outcome          # re-collected at drain target
+                tracing.record_span(
+                    "llm:failover_replay", "llm", t_fail, time.time(),
+                    request_id=rid, replica=str(idx),
+                    error=type(exc).__name__)
                 tried.add(idx)
             finally:
                 self.core.finish(idx)
@@ -947,8 +972,11 @@ class FleetSupervisor:
                             token_prompt: List[int]) -> Dict:
         # Resolved BEFORE the prefill try: a dead decode replica fails
         # here and correctly rides the eject-and-replay path.
+        from ray_tpu.util import tracing
+
         decode_addr = self._handoff_addr(decode_idx)
         t0 = time.monotonic()
+        t0_wall = time.time()
         try:
             result = prefill_with_retry(self.prefill_replicas, request,
                                         decode_addr)
@@ -965,6 +993,10 @@ class FleetSupervisor:
                 "message": f"prefill tier unavailable: {e}"}}
         if not result.get("handoff"):
             return result["response"]  # finished at prefill
+        tracing.record_span(
+            "llm:prefill_rpc", "llm", t0_wall, time.time(),
+            request_id=request["request_id"], tokens=len(token_prompt),
+            replica=str(decode_idx))
         self.core.observe_prefill(
             len(token_prompt), max(time.monotonic() - t0, 1e-6))
         return self.replicas[decode_idx].call(

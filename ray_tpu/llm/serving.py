@@ -425,6 +425,15 @@ class LLMServer:
             pass
         return s
 
+    def flight_records(self, limit: Optional[int] = None,
+                       request_id: Optional[str] = None) -> List[Dict]:
+        """Engine tick flight recorder (llm/engine.py): the per-tick batch
+        composition / budget / recompile ring, for attributing a slow token
+        to its cause. `request_id` filters to ticks that emitted for it."""
+        with self._lock:
+            return self.engine.tick_records(limit=limit,
+                                            request_id=request_id)
+
     def _publish_gauges(self, s: Optional[Dict] = None):
         if s is None:
             with self._lock:
@@ -504,10 +513,32 @@ class LLMServer:
         # must not hold the engine hostage either).
         send_failed: List[str] = []
         for rid, state, k, v in exports:
+            # The pause is a first-class trace span, not a silent gap: it
+            # starts at export (the engine stamped t_handoff then — decode
+            # stopped for this request the moment it left the scheduler)
+            # and ends when the target acked adoption. The adopter books
+            # the same interval into the request's stall_s via t_handoff.
+            t_pause0 = (state.get("timing") or {}).get("t_handoff",
+                                                       time.time())
+            from ray_tpu.util import tracing
+
             try:
-                migrate_session(target_address, state, k, v,
-                                timeout=timeout)
-                migrated.append(rid)
+                # The stream rides under the request's trace context so the
+                # kv_handoff span (opened inside send_handoff) — and the
+                # adopter's kv_adopt span parent-linked to it over the wire
+                # — stitch into this request's trace, not a fresh one.
+                with tracing.trace_context(tracing.request_trace_id(rid),
+                                           None):
+                    migrate_session(target_address, state, k, v,
+                                    timeout=timeout)
+                    migrated.append(rid)
+                    tracing.record_span(
+                        "llm:migration_pause", "llm", t_pause0, time.time(),
+                        request_id=rid, source=self._replica_tag, mode="kv")
+                self.engine.flight_records.append({
+                    "t": t_pause0, "kind": "migration_pause",
+                    "dur_ms": round((time.time() - t_pause0) * 1e3, 3),
+                    "emitted": {}, "request_id": rid})
             except Exception:
                 # Atomic wire: nothing half-adopted — but a timeout with a
                 # LOST ACK can leave the session fully adopted (decoding

@@ -188,6 +188,24 @@ LLM_KV_HANDOFFS = Counter(
     "ray_tpu_llm_kv_handoffs_total",
     "prefill->decode KV page handoffs adopted")
 
+# Per-request latency attribution (llm/engine.py _finish_trace): each
+# finished request decomposes its TTFT into queue/prefill/handoff time and
+# its mean inter-token gap into decode/stall time — the histogram twins of
+# the per-request trace spans, so fleet-wide tail regressions name a phase
+# before anyone pulls a single trace.
+LLM_TTFT_BREAKDOWN_MS = Histogram(
+    "ray_tpu_llm_ttft_breakdown_ms",
+    "per-request time-to-first-token by phase: queue (submit->admit), "
+    "prefill (admit->first token), handoff (disagg KV stream gaps)",
+    boundaries=[0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000, 5000],
+    tag_keys=("phase",))                         # queue | prefill | handoff
+LLM_ITL_BREAKDOWN_MS = Histogram(
+    "ray_tpu_llm_itl_breakdown_ms",
+    "per-request MEAN inter-token gap by phase: decode (engine ticks) and "
+    "stall (migration pauses amortized over the request's gaps)",
+    boundaries=[0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000],
+    tag_keys=("phase",))                         # decode | stall
+
 # Fleet resilience (llm/router.py FleetSupervisor): failover replays,
 # drain-plane session migrations, and the live-replica count the router's
 # health tracker believes in. All roll up into

@@ -785,3 +785,21 @@ class PrefixPurgeReplyMsg(Message):
     ok = Field(1, BOOL)
     purged = Field(2, INT)
     owners_cleared = Field(3, INT)
+
+
+# ------------------------------------------------ LLM KV handoff header
+#
+# Typed head frame of the disaggregated prefill->decode / live-migration
+# KV stream (llm/disagg.py). The portable request state stays JSON bytes
+# (it is heterogeneous, small, and already pickle-free); the trace fields
+# carry the per-request trace context across the handoff so the decode
+# replica's adopt span parent-links to the sender's handoff span — the
+# serving-plane analog of TaskSpecMsg fields 17/18.
+
+class KVHandoffMsg(Message):
+    state_json = Field(1, BYTES)     # json.dumps(portable request state)
+    kv_dtype = Field(2, STR)
+    kv_shape = Field(3, LIST(INT))
+    migrated = Field(4, BOOL)        # live session migration vs prefill handoff
+    trace_id = Field(5, BYTES)       # 16-byte stitched-request trace id
+    parent_span_id = Field(6, BYTES)  # sender's handoff span (8 bytes)

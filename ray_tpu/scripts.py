@@ -227,6 +227,50 @@ def cmd_timeline(args):
           "(open in chrome://tracing)")
 
 
+def cmd_request(args):
+    """Stitched per-request serving trace: every span any process recorded
+    for one request id — router admission, queueing, prefill, disagg KV
+    handoff, decode, failover replay, migration pause — ordered by start
+    time. The trace id derives from the request id alone, so this works
+    after the fact with nothing but the rid."""
+    from ray_tpu.state import api
+    from ray_tpu.util import tracing
+
+    if args.cluster:
+        if not args.address:
+            sys.exit("--cluster requires --address")
+        _connect(args.address)
+    trace = api.request_trace(args.request_id, cluster=args.cluster)
+    spans = trace["spans"]
+    if not spans:
+        print(f"no spans recorded for request {args.request_id} "
+              f"(trace id {trace['trace_id']})")
+        return
+    if args.chrome:
+        groups = {}
+        for s in spans:
+            groups.setdefault(s.get("process", "?"), []).append(s)
+        events = tracing.merge_spans(sorted(groups.items()))
+        with open(args.chrome, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        print(f"wrote {len(spans)} spans to {args.chrome} "
+              "(open in chrome://tracing)")
+    t0 = min(s["ts"] for s in spans)
+    print(f"request {args.request_id}  trace {trace['trace_id']}  "
+          f"{len(spans)} span(s)")
+    print(f"  {'offset':>12}  {'duration':>12}  span")
+    for s in spans:
+        off_ms = (s["ts"] - t0) / 1e3
+        dur_ms = s.get("dur", 0.0) / 1e3
+        extra = {k: v for k, v in (s.get("args") or {}).items()
+                 if k not in ("trace_id", "span_id", "parent_span_id",
+                              "request_id")}
+        attrs = " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+        print(f"  {off_ms:>10.3f}ms  {dur_ms:>10.3f}ms  "
+              f"{s['name']:<20} [{s.get('process', '?')}]"
+              + (f"  {attrs}" if attrs else ""))
+
+
 def cmd_events(args):
     """Typed cluster events, newest first (`ray list cluster-events`
     analog; see ray_tpu/runtime/events.py for the record shape)."""
@@ -297,6 +341,19 @@ def main(argv=None):
                         "(requires --address)")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("request",
+                       help="stitched per-request serving trace: every span "
+                            "recorded for one request id across router, "
+                            "prefill, decode, and migration target")
+    p.add_argument("request_id")
+    p.add_argument("--address", default=None)
+    p.add_argument("--cluster", action="store_true",
+                   help="pull span rings from every process in the cluster "
+                        "(requires --address)")
+    p.add_argument("--chrome", default=None, metavar="OUTPUT",
+                   help="also write the trace as chrome://tracing JSON")
+    p.set_defaults(fn=cmd_request)
 
     p = sub.add_parser("events",
                        help="typed cluster events (node death, slice loss, "

@@ -215,6 +215,47 @@ def dump_cluster_spans() -> List[tuple]:
     return groups
 
 
+def request_trace(request_id: str, cluster: bool = False) -> Dict:
+    """Stitched end-to-end trace for one LLM serving request.
+
+    The trace id derives deterministically from the request id
+    (`tracing.request_trace_id`), so spans recorded by ANY process that
+    touched the request — router, prefill replica, decode replica,
+    migration target — are matched by id alone with no context
+    propagation. With ``cluster=True`` every per-process span ring in the
+    cluster is pulled (`dump_cluster_spans`); otherwise only this
+    process's ring is searched (the in-process serving path records
+    everything locally). Spans come back sorted by start time, each
+    annotated with the recording process label."""
+    import os
+
+    from ray_tpu.util import tracing
+
+    want = tracing.request_trace_id(request_id).hex()
+    if cluster:
+        try:
+            groups = dump_cluster_spans()
+        except Exception:
+            groups = [(f"driver:{os.getpid()}", tracing.get_spans())]
+    else:
+        groups = [(f"driver:{os.getpid()}", tracing.get_spans())]
+    spans, seen = [], set()
+    for label, group in groups:
+        for s in group:
+            args = s.get("args") or {}
+            if args.get("trace_id") != want:
+                continue
+            sid = args.get("span_id")
+            if sid and sid in seen:
+                continue  # same ring reachable via two fan-out paths
+            seen.add(sid)
+            ev = dict(s)
+            ev["process"] = label
+            spans.append(ev)
+    spans.sort(key=lambda s: s.get("ts", 0.0))
+    return {"request_id": request_id, "trace_id": want, "spans": spans}
+
+
 def wait_graph() -> Dict:
     """The GCS-assembled cluster wait-graph: who is blocked on what
     (`edges`), active deadlock cycles (`cycles`), and the detector's
@@ -362,25 +403,54 @@ def data_ingest_summary() -> Dict:
     return out
 
 
+_BREAKDOWN_METRICS = {
+    "ray_tpu_llm_ttft_breakdown_ms": "ttft_breakdown_ms",
+    "ray_tpu_llm_itl_breakdown_ms": "itl_breakdown_ms",
+}
+
+
 def _aggregate_llm_metrics(snapshots: List[List[dict]]) -> Dict:
     """Pure rollup over per-process metric snapshots (util/metrics.py
     snapshot_all() lists): sums every ray_tpu_llm_* gauge series across
-    replicas and counts the distinct replica tags seen."""
+    replicas and counts the distinct replica tags seen. The per-request
+    latency-breakdown histograms get a phase-aware rollup instead — their
+    `values` entries are per-phase running means, and summing means
+    across phases/replicas would be meaningless — so they surface as
+    {phase: mean_ms} maps weighted by observation count."""
+    import json
+
     sums: Dict[str, float] = {}
+    breakdown: Dict[str, Dict[str, List[float]]] = {}
     replicas = set()
     for snap in snapshots:
         for metric in snap:
             name = metric.get("name", "")
             if not name.startswith("ray_tpu_llm_"):
                 continue
+            if name in _BREAKDOWN_METRICS:
+                dest = breakdown.setdefault(_BREAKDOWN_METRICS[name], {})
+                for tag_key, h in metric.get("histograms", {}).items():
+                    phase = "?"
+                    try:
+                        phase = dict(json.loads(tag_key)).get("phase", "?")
+                    except Exception:
+                        pass
+                    acc = dest.setdefault(phase, [0.0, 0])
+                    acc[0] += h.get("sum", 0.0)
+                    acc[1] += int(h.get("count", 0))
+                continue
             short = name[len("ray_tpu_llm_"):]
             for tag_key, value in metric.get("values", {}).items():
                 if "replica" in tag_key:
                     replicas.add(tag_key)
                 sums[short] = sums.get(short, 0.0) + value
-    if not sums:
+    if not sums and not breakdown:
         return {}
     out = {k: round(v, 1) for k, v in sums.items()}
+    for key, phases in breakdown.items():
+        rolled = {p: round(s / c, 3) for p, (s, c) in phases.items() if c}
+        if rolled:
+            out[key] = rolled
     out["replicas_reporting"] = len(replicas)
     return out
 

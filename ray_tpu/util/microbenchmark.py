@@ -626,28 +626,48 @@ def _bench_serve_mixed(scale: float) -> List[Dict]:
         send_handoff(addr, state, k, v)
         decode.completions_collect(state["id"])
 
+    # The unified leg runs with tracing OFF and the traced leg — the SAME
+    # server, same workload, already warm — with tracing ON: their tokens/s
+    # ratio is the per-request tracing overhead, budgeted at <=2% (the
+    # spans are ring appends and a handful of time.time() calls; anything
+    # bigger means a span landed on the per-token hot path). Sharing the
+    # engine keeps compile/warmup state identical across the pair.
+    from ray_tpu.util import tracing as _tracing
+
     legs = (("colocated", colo,
              lambda _pre: colo.completions(
                  {"prompt": next_long(), "max_tokens": 2}),
-             lambda: None),
+             lambda: None, None),
             ("unified", unified,
              lambda _pre: unified.completions(
                  {"prompt": next_long(), "max_tokens": 2}),
-             lambda: None),
+             lambda: None, False),
+            ("traced", unified,
+             lambda _pre: unified.completions(
+                 {"prompt": next_long(), "max_tokens": 2}),
+             lambda: None, True),
             ("disagg", decode, replay_handoff,
-             lambda: capture_handoffs(80)))
+             lambda: capture_handoffs(80), None))
+    tps_by_leg: Dict[str, float] = {}
     # Best of 2 trials per leg: a descheduling blip in the pressure thread
     # on a small box corrupts the tail the leg exists to compare.
-    for name, server, submit_long, setup in legs:
-        best, best_tps, n = float("inf"), 0.0, 0
-        for _ in range(2):
-            pre = setup()
-            gaps, elapsed, done = chatty_gaps(server,
-                                              lambda: submit_long(pre))
-            n = len(gaps)
-            best = min(best, float(np.percentile(gaps, 99)))
-            if elapsed > 0:
-                best_tps = max(best_tps, (len(gaps) + 2 * done) / elapsed)
+    for name, server, submit_long, setup, trace_on in legs:
+        was_enabled = _tracing.enabled()
+        if trace_on is not None:
+            _tracing.set_enabled(trace_on)
+        try:
+            best, best_tps, n = float("inf"), 0.0, 0
+            for _ in range(2):
+                pre = setup()
+                gaps, elapsed, done = chatty_gaps(server,
+                                                  lambda: submit_long(pre))
+                n = len(gaps)
+                best = min(best, float(np.percentile(gaps, 99)))
+                if elapsed > 0:
+                    best_tps = max(best_tps,
+                                   (len(gaps) + 2 * done) / elapsed)
+        finally:
+            _tracing.set_enabled(was_enabled)
         out.append({"benchmark": f"serve_{name}_itl_p99_ms",
                     "value": round(best * 1e3, 2),
                     "unit": "ms", "n": n, "trials": 2})
@@ -655,10 +675,17 @@ def _bench_serve_mixed(scale: float) -> List[Dict]:
         # the guard that a better tail wasn't bought by starving throughput.
         # The disagg leg's pressure tokens ride pre-captured handoffs, not
         # comparable work — only the apples-to-apples legs report it.
-        if name in ("colocated", "unified"):
+        if name in ("colocated", "unified", "traced"):
+            tps_by_leg[name] = best_tps
             out.append({"benchmark": f"serve_{name}_tokens_per_s",
                         "value": round(best_tps, 1),
                         "unit": "tokens/s", "n": n, "trials": 2})
+    if tps_by_leg.get("unified") and tps_by_leg.get("traced"):
+        overhead = 100.0 * (1.0 - tps_by_leg["traced"]
+                            / tps_by_leg["unified"])
+        out.append({"benchmark": "serve_tracing_overhead_pct",
+                    "value": round(overhead, 2), "unit": "%",
+                    "n": 1, "trials": 2})
     return out
 
 

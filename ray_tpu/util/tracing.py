@@ -38,6 +38,23 @@ _otel_tracer = None
 _ctx = threading.local()
 
 
+def request_trace_id(request_id: str) -> bytes:
+    """Deterministic 16-byte trace id for one LLM serving request.
+
+    Derived from crc32(request_id) — the same function the engine seeds
+    sampling from — so EVERY process that handles the request (router,
+    prefill replica, decode replica, migration target, the CLI after the
+    fact) computes the identical trace id from the rid alone. Stitching a
+    request's spans across failover replays and live migration therefore
+    needs no side channel: the rid is the trace identity; the disagg wire
+    only carries parent-span linkage."""
+    import zlib
+
+    rid = request_id.encode()
+    return b"".join(
+        zlib.crc32(rid + bytes([i])).to_bytes(4, "big") for i in range(4))
+
+
 def current_trace_id() -> Optional[bytes]:
     return getattr(_ctx, "trace_id", None)
 

@@ -271,3 +271,87 @@ def test_chaos_mid_epoch_resume_bit_identical(cluster, tmp_path):
 
     assert len(consumed) == len(reference) == 16
     assert consumed == reference
+
+
+# --------------------------------------------------- adaptive prefetch depth
+
+def _blocks(n_blocks, rows=4, delay_s=0.0):
+    """Synthetic source: (index, pyarrow Block) pairs, optionally slow."""
+    import pyarrow as pa
+
+    def source(cursor):
+        for i in range(n_blocks):
+            if delay_s:
+                time.sleep(delay_s)
+            lo = i * rows
+            yield i, pa.table({"id": np.arange(lo, lo + rows)})
+    return source
+
+
+def test_adaptive_prefetch_grows_under_input_wait(monkeypatch):
+    """prefetch_batches="adaptive": every blocking pop is direct evidence
+    the producer fell behind, so the window widens — up to the clamp —
+    without anyone hand-tuning a depth per workload."""
+    from ray_tpu.data.streaming import StreamingIterator
+
+    monkeypatch.setenv("RAY_TPU_DATA_PREFETCH_MAX", "4")
+    it = StreamingIterator(_blocks(30, delay_s=0.005), batch_size=4,
+                           prefetch_batches="adaptive")
+    assert it.prefetch_depth == 2  # starts conservative
+    ids = _consume_ids(it)
+    assert ids == list(range(120))  # adaptation never reorders or drops
+    assert it.depth_grows >= 2 and it.prefetch_depth == 4
+    assert it.prefetch_depth <= 4  # clamped at RAY_TPU_DATA_PREFETCH_MAX
+
+
+def test_adaptive_prefetch_shrinks_after_quiet_run(monkeypatch):
+    """A sustained run of non-blocking pops (the consumer is the slow
+    side) is the only evidence the window is oversized: the controller
+    then withholds one permit, shrinking toward the floor of 1. The
+    controller is driven directly — a pop's measured latency on a
+    shared box is too noisy to promise four consecutive <1ms pops, and
+    one noisy pop per quiet-window legitimately resets the run."""
+    from ray_tpu.data.streaming import StreamingIterator
+
+    monkeypatch.setenv("RAY_TPU_DATA_PREFETCH_QUIET", "4")
+    monkeypatch.setenv("RAY_TPU_DATA_PREFETCH_MAX", "4")
+    it = StreamingIterator(_blocks(10, rows=8), batch_size=4,
+                           prefetch_batches="adaptive")
+    assert it.prefetch_depth == 2
+    # End-to-end: adaptation never reorders or drops batches, and the
+    # backpressure contract holds at every depth the window visited.
+    ids = _consume_ids(it)
+    assert ids == list(range(80))
+    assert it.max_backlog <= 4
+    # Controller semantics, driven directly from wherever the live run
+    # left the window. A blocking pop resets the quiet run and (off the
+    # floor already, or by growing) guarantees headroom to shrink from.
+    it._adapt(0.01)
+    d0, s0 = it.prefetch_depth, it.depth_shrinks
+    assert d0 >= 2
+    # Three quiet pops build a run but don't shrink yet...
+    assert [it._adapt(0.0) for _ in range(3)] == [1, 1, 1]
+    # ...and a blocking pop resets it, so three more still hold...
+    it._adapt(0.01)
+    d1, s1 = it.prefetch_depth, it.depth_shrinks
+    assert s1 == s0
+    assert [it._adapt(0.0) for _ in range(3)] == [1, 1, 1]
+    # ...and only the fourth withholds a permit.
+    assert it._adapt(0.0) == 0
+    assert it.depth_shrinks == s1 + 1 and it.prefetch_depth == d1 - 1
+    # Sustained quiet shrinks to the floor of 1, where it stays.
+    for _ in range(5 * 4):
+        it._adapt(0.0)
+    assert it.prefetch_depth == 1
+    assert all(it._adapt(0.0) == 1 for _ in range(8))
+    assert it.prefetch_depth == 1
+
+
+def test_fixed_prefetch_depth_never_adapts():
+    from ray_tpu.data.streaming import StreamingIterator
+
+    it = StreamingIterator(_blocks(6, delay_s=0.005), batch_size=4,
+                           prefetch_batches=3)
+    _consume_ids(it)
+    assert it.prefetch_depth == 3
+    assert it.depth_grows == 0 and it.depth_shrinks == 0

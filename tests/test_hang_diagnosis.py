@@ -292,9 +292,9 @@ def test_summarize_objects_and_memory_cli(cluster, capsys):
     del held
 
 
-_CLI_SUBCOMMANDS = ("start", "job", "timeline", "events", "status", "list",
-                    "memory", "stack", "drain", "stop", "microbenchmark",
-                    "lint")
+_CLI_SUBCOMMANDS = ("start", "job", "timeline", "request", "events",
+                    "status", "list", "memory", "stack", "drain", "stop",
+                    "microbenchmark", "lint")
 
 
 @pytest.mark.parametrize("cmd", ("",) + _CLI_SUBCOMMANDS)

@@ -97,6 +97,10 @@ WIRE_ROUNDTRIP_REGISTRY = {
         clear_owner_only=True),
     "PrefixPurgeReplyMsg": lambda: wire.PrefixPurgeReplyMsg(
         ok=True, purged=3, owners_cleared=2),
+    "KVHandoffMsg": lambda: wire.KVHandoffMsg(
+        state_json=b'{"id": "req-1"}', kv_dtype="bfloat16",
+        kv_shape=[2, 4, 8, 16], migrated=True, trace_id=b"t" * 16,
+        parent_span_id=b"s" * 8),
 }
 
 
