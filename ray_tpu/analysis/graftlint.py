@@ -44,6 +44,12 @@ metric-central        Counter/Gauge/Histogram constructed outside
                       once, in the central table).
 metric-tags           a metric observation (.inc/.set/.observe/.bind)
                       passing literal tag keys not declared by the metric.
+alert-def             runtime/alert_defs.py hygiene: every rule in
+                      ALERT_RULES must be a literal dict whose series is
+                      a metric declared in runtime/metric_defs.py, and
+                      whose name has a backticked row in
+                      docs/observability.md (the metric-docs discipline,
+                      applied to alert rules).
 thread-attrs          threading.Thread(...) without daemon=True and
                       name=...: an unnamed or non-daemon background
                       thread is undiagnosable in stack dumps and can wedge
@@ -86,6 +92,7 @@ RULES: Dict[str, str] = {
     "metric-docs": "metric has no docs/observability.md row",
     "metric-central": "metric constructed outside runtime/metric_defs.py",
     "metric-tags": "metric observed with undeclared tag keys",
+    "alert-def": "alert rule on an undeclared series or without a docs row",
     "thread-attrs": "threading.Thread without daemon=True and name=",
     "parse-error": "file failed to parse",
 }
@@ -129,6 +136,7 @@ class LintConfig:
     wire_module: str = "ray_tpu/runtime/wire.py"
     events_module: str = "ray_tpu/runtime/events.py"
     metric_defs_module: str = "ray_tpu/runtime/metric_defs.py"
+    alert_defs_module: str = "ray_tpu/runtime/alert_defs.py"
     metrics_module: str = "ray_tpu/util/metrics.py"
     roundtrip_registry: str = "tests/test_wire_schema.py"
     registry_name: str = "WIRE_ROUNDTRIP_REGISTRY"
@@ -603,6 +611,82 @@ def _pass_metric_docs(cfg: LintConfig, mods: Dict[str, _Module],
                 f"and when it moves before shipping it")
 
 
+def _declared_metric_names(cfg: LintConfig,
+                           mods: Dict[str, _Module]) -> Set[str]:
+    """Metric NAME strings (not var names) declared in metric_defs.py."""
+    names: Set[str] = set()
+    mi = mods.get(cfg.metric_defs_module)
+    if mi is None:
+        return names
+    for node in mi.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id in _METRIC_CLASSES
+                and node.value.args
+                and isinstance(node.value.args[0], ast.Constant)
+                and isinstance(node.value.args[0].value, str)):
+            names.add(node.value.args[0].value)
+    return names
+
+
+def _pass_alert_defs(cfg: LintConfig, mods: Dict[str, _Module],
+                     notes: List[str]) -> Iterator[Violation]:
+    """ALERT_RULES hygiene: literal rules only, each referencing a series
+    declared in metric_defs.py, each with a backticked docs row — an alert
+    over a series nobody emits would be dead weight that never fires, and
+    an undocumented rule is one an operator cannot interpret at 3am."""
+    mi = mods.get(cfg.alert_defs_module)
+    if mi is None:
+        return
+    declared = _declared_metric_names(cfg, mods)
+    docs = _read_text(cfg, cfg.docs_observability)
+    if docs is None:
+        notes.append(f"alert-def docs check skipped: "
+                     f"{cfg.docs_observability} not found")
+    rules_node = next(
+        (node.value for node in mi.tree.body
+         if isinstance(node, ast.Assign) and len(node.targets) == 1
+         and isinstance(node.targets[0], ast.Name)
+         and node.targets[0].id == "ALERT_RULES"), None)
+    if not isinstance(rules_node, (ast.List, ast.Tuple)):
+        yield Violation(
+            "alert-def", cfg.alert_defs_module, 1,
+            "ALERT_RULES must be a literal list of dicts (the lint and "
+            "the GCS evaluator both read it as data)")
+        return
+    for elt in rules_node.elts:
+        if not isinstance(elt, ast.Dict):
+            yield Violation(
+                "alert-def", cfg.alert_defs_module, elt.lineno,
+                "alert rule must be a literal dict — no computed rules")
+            continue
+        fields: Dict[str, object] = {}
+        for k, v in zip(elt.keys, elt.values):
+            if isinstance(k, ast.Constant) and isinstance(v, ast.Constant):
+                fields[k.value] = v.value
+        name = fields.get("name")
+        series = fields.get("series")
+        if not isinstance(name, str) or not name:
+            yield Violation(
+                "alert-def", cfg.alert_defs_module, elt.lineno,
+                "alert rule needs a literal string `name` (the event "
+                "signature and docs-row key)")
+            continue
+        if not isinstance(series, str) or series not in declared:
+            yield Violation(
+                "alert-def", cfg.alert_defs_module, elt.lineno,
+                f"alert rule {name}: series {series!r} is not declared "
+                f"in {cfg.metric_defs_module} — alerts may only watch "
+                f"registered metrics")
+        if docs is not None and f"`{name}`" not in docs:
+            yield Violation(
+                "alert-def", cfg.alert_defs_module, elt.lineno,
+                f"alert rule {name} has no row in "
+                f"{cfg.docs_observability} — document what it watches "
+                f"and what an operator should do before shipping it")
+
+
 def _pass_metrics(cfg: LintConfig,
                   mods: Dict[str, _Module]) -> Iterator[Violation]:
     registry, def_violations = _metric_registry(cfg, mods)
@@ -747,6 +831,7 @@ def run(root: Optional[str] = None,
     raw.extend(_pass_wire(cfg, mods, result.notes))
     raw.extend(_pass_events(cfg, mods, result.notes))
     raw.extend(_pass_metric_docs(cfg, mods, result.notes))
+    raw.extend(_pass_alert_defs(cfg, mods, result.notes))
     raw.extend(_pass_metrics(cfg, mods))
     raw.extend(_pass_threads(cfg, mods))
     baseline = _load_baseline(cfg, baseline_path)

@@ -124,6 +124,20 @@ _DEFS: Dict[str, Tuple[type, Any, str]] = {
                             "GCS drops a reporter's wait edges not "
                             "refreshed within this window (crashed or "
                             "unblocked worker)"),
+    "metrics_history_enabled": (bool, True,
+                                "GCS folds every metrics flush into sharded "
+                                "time-series rings (windowed queries, "
+                                "link utilization, alerting); off = "
+                                "latest-snapshot-only, the pre-history "
+                                "behavior"),
+    "metrics_history_max_bytes": (int, 8 << 20,
+                                  "byte budget for the GCS metric-history "
+                                  "rings; oldest points are evicted first "
+                                  "once the estimate crosses it"),
+    "alert_eval_interval_s": (float, 2.0,
+                              "GCS alert-table evaluation tick period "
+                              "(rules in runtime/alert_defs.py -> "
+                              "ALERT_FIRING / ALERT_RESOLVED events)"),
     # -- collectives -------------------------------------------------------
     "collective_watchdog_interval_s": (float, 1.0,
                                        "peer-liveness/abort poll period of "

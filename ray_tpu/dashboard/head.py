@@ -230,6 +230,9 @@ class DashboardHead:
             web.get("/api/tasks", self.tasks),
             web.get("/api/tasks/{task_id}", self.task_detail),
             web.get("/api/events", self.events),
+            web.get("/api/metrics/history", self.metrics_history_view),
+            web.get("/api/alerts", self.alerts_view),
+            web.get("/api/link_utilization", self.link_utilization_view),
             web.get("/api/stacks", self.stacks),
             web.get("/api/wait_graph", self.wait_graph_view),
             web.get("/metrics", self.metrics),
@@ -464,6 +467,53 @@ class DashboardHead:
             severity=request.query.get("severity"),
             source=request.query.get("source"), limit=limit)
         return _json({"events": events})
+
+    async def metrics_history_view(self, request):
+        """Windowed queries over the GCS metric-history rings (`state.
+        metrics_history` twin): ?name=<series> [&window=N] [&agg=rate|
+        delta|mean|last|p99...] [&tags=k:v,k:v] — returns the aggregate
+        value, the per-node split, and per-reporter point tails the
+        Metrics view renders as sparklines."""
+        name = request.query.get("name")
+        if not name:
+            return _json({"error": "name query param required"}, status=400)
+        try:
+            window_s = float(request.query.get("window", "60"))
+            points_limit = int(request.query.get("points", "240"))
+        except ValueError:
+            return _json({"error": "window/points must be numeric"},
+                         status=400)
+        tags = None
+        raw = request.query.get("tags")
+        if raw:
+            try:
+                tags = dict(kv.split(":", 1) for kv in raw.split(","))
+            except ValueError:
+                return _json({"error": "tags must be k:v[,k:v...]"},
+                             status=400)
+        try:
+            reply = await self.gcs.call(
+                "metrics_history", name=name, tags=tags, window_s=window_s,
+                agg=request.query.get("agg"), points_limit=points_limit)
+        except Exception as e:
+            return _json({"error": str(e)}, status=400)
+        return _json(reply)
+
+    async def alerts_view(self, request):
+        """Alert-rule states from the GCS alert evaluator (runtime/
+        alert_defs.py): every rule with state ok/firing, last value, and
+        since — the header badge + alerts strip data source."""
+        return _json(await self.gcs.call("list_alerts"))
+
+    async def link_utilization_view(self, request):
+        """Observed per-link bandwidth matrix from the tagged collective
+        byte counters in the history rings (?window=N, default 30s)."""
+        try:
+            window_s = float(request.query.get("window", "30"))
+        except ValueError:
+            return _json({"error": "window must be numeric"}, status=400)
+        return _json(await self.gcs.call("link_utilization",
+                                         window_s=window_s))
 
     async def stacks(self, request):
         """Cluster-wide annotated stack dumps (`scripts stack --cluster`

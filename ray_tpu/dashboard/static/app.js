@@ -185,6 +185,16 @@ const VIEWS = {
   metrics: {title: "Metrics", render: renderMetrics},
 };
 let logsIndex = {nodes: {}};  // /api/logs: node -> [{file, lines}]
+let alertsState = null;       // /api/alerts payload (metrics view)
+let serverHist = [];          // /api/metrics/history sparkline payloads
+// GCS-ring-backed sparklines shown on the Metrics view: unlike the
+// client-side ring (history.metrics, lost on reload), these survive
+// page loads and window the server's own time series.
+const SERVER_SERIES = [
+  {name: "ray_tpu_tasks_finished_total", agg: "rate", unit: "ops/s"},
+  {name: "ray_tpu_llm_ttft_breakdown_ms", agg: "p99", unit: "ms"},
+  {name: "ray_tpu_collective_bytes_sent_total", agg: "rate", unit: "B/s"},
+];
 let logSel = null;            // {node, file} picked in the Logs view
 let logTail = null;           // /api/logs/<node>/<file> payload
 let detail = null;   // {title, body} pinned under the active view
@@ -371,12 +381,51 @@ function renderLogs() {
   <section class="wide">${tail}</section>`;
 }
 
+function renderAlerts() {
+  if (!alertsState || !(alertsState.rules || []).length) return "";
+  const firing = (alertsState.firing || []).length;
+  return `<section class="wide"><h2>Alerts
+      <span class="right ${firing ? "bad" : "muted"}">${firing} firing</span>
+    </h2>${rows(["rule", "state", "value", "threshold", "summary"],
+    alertsState.rules, (r) => [
+      esc(r.name), state(r.state === "firing" ? "FIRING" : "ok"),
+      r.value == null ? "—" : +(+r.value).toPrecision(4),
+      r.threshold ?? "", `<span class="muted">${esc(r.summary || "")}</span>`,
+    ])}</section>`;
+}
+
+function renderServerHistory() {
+  if (!serverHist.length) return "";
+  // Each payload carries per-reporter point tails from the GCS rings;
+  // draw one sparkline per reporter series, value label = the windowed
+  // aggregate the server computed (rate / p99 / ...).
+  const charts = serverHist.map((s, i) => {
+    const lines = s.hist.series.slice(0, 4).map((ser, k) => ({
+      name: `${ser.reporter.slice(0, 12)} ${Object.entries(ser.tags || {})
+        .map(([a, b]) => `${a}=${b}`).join(",")}`,
+      color: SERIES[(i + k) % SERIES.length],
+      points: ser.points.map(([t, v]) => ({t: t * 1000, v})),
+    }));
+    const val = s.hist.value == null ? "no samples"
+      : `${s.agg} ${+(+s.hist.value).toPrecision(4)} ${s.unit}`;
+    return `<section><h2>${esc(s.name)}
+        <span class="right muted">${esc(val)} · 5 min window</span></h2>
+      ${lineChart(`h:${s.name}`, lines, {h: 90,
+                                         fmt: (v) => +v.toPrecision(3)})}
+    </section>`;
+  });
+  return `<section class="wide"><h2>Cluster history
+      <span class="right muted">GCS time-series rings ·
+        /api/metrics/history</span></h2></section>` + charts.join("");
+}
+
 function renderMetrics() {
+  const head = renderAlerts() + renderServerHistory();
   const fams = [...history.metrics.entries()]
     .filter(([, b]) => b.points.length > 1)
     .sort(([a], [b]) => a.localeCompare(b));
   if (!fams.length)
-    return `<section class="wide"><h2>Metrics</h2>
+    return head + `<section class="wide"><h2>Metrics</h2>
       <span class="muted">no prometheus families scraped yet</span></section>`;
   const charts = fams.slice(0, 24).map(([name, buf], i) => `
     <section><h2>${esc(name)}</h2>
@@ -385,7 +434,7 @@ function renderMetrics() {
                   [{name, color: SERIES[i % SERIES.length],
                     points: buf.points}],
                   {h: 110, fmt: (v) => +v.toPrecision(3)})}</section>`);
-  return charts.join("") +
+  return head + charts.join("") +
     (fams.length > 24 ? `<section class="wide"><span class="muted">` +
       `${fams.length - 24} more families not shown</span></section>` : "");
 }
@@ -416,6 +465,16 @@ async function render() {
   if (currentView() === "tasks") {
     try { timelineBars = await j("/api/timeline?limit=2000"); }
     catch { timelineBars = []; }
+  }
+  if (currentView() === "metrics") {
+    try { alertsState = await j("/api/alerts"); } catch { alertsState = null; }
+    serverHist = (await Promise.all(SERVER_SERIES.map(async (s) => {
+      try {
+        const hist = await j(`/api/metrics/history?name=${s.name}` +
+                             `&agg=${s.agg}&window=300`);
+        return {...s, hist};
+      } catch { return null; }
+    }))).filter((s) => s && s.hist && (s.hist.series || []).length);
   }
   if (currentView() === "logs") {
     try { logsIndex = await j("/api/logs"); } catch { logsIndex = {nodes: {}}; }

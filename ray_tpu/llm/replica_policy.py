@@ -61,12 +61,20 @@ class ReplicaPolicyConfig:
     # At most one step per direction per this interval (lets a freshly
     # added replica absorb load before the policy reads the fleet again).
     cooldown_s: float = 10.0
+    # Windowed-input mode: > 0 means watermark tests run against the mean
+    # of the signals over this many trailing seconds instead of the
+    # instantaneous tick, so a single-tick spike (one burst of queued
+    # prefill tokens, one transient KV high-water) cannot trigger an
+    # upscale by itself. 0 keeps the original instantaneous behaviour.
+    signal_window_s: float = 0.0
 
     def __post_init__(self):
         if self.min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
         if self.max_replicas < self.min_replicas:
             raise ValueError("max_replicas must be >= min_replicas")
+        if self.signal_window_s < 0:
+            raise ValueError("signal_window_s must be >= 0")
 
 
 class ReplicaPolicy:
@@ -76,6 +84,8 @@ class ReplicaPolicy:
         self.config = config or ReplicaPolicyConfig()
         self._quiet_since: Optional[float] = None
         self._last_action_t: Optional[float] = None
+        # (t, signals) samples for windowed-input mode; trimmed each tick.
+        self._samples: List = []
 
     # ---- signal extraction ----------------------------------------------
 
@@ -108,6 +118,25 @@ class ReplicaPolicy:
             "live": len(live),
         }
 
+    def _windowed(self, sig: Dict[str, float], now: float) -> Dict[str, float]:
+        """Fold this tick's signals into the sample window and return the
+        window means. Unknown queue delays (-1) are excluded from the delay
+        mean; the result is -1 only when NO sample in the window knew it."""
+        w = self.config.signal_window_s
+        self._samples.append((now, sig))
+        self._samples = [(t, s) for t, s in self._samples if now - t <= w]
+        samples = [s for _, s in self._samples]
+        delays = [s["queue_delay_s"] for s in samples
+                  if s["queue_delay_s"] >= 0]
+        return {
+            "queue_delay_s": (sum(delays) / len(delays)) if delays else -1.0,
+            "queue_depth": sum(s["queue_depth"] for s in samples)
+            / len(samples),
+            "kv_pressure": sum(s["kv_pressure"] for s in samples)
+            / len(samples),
+            "live": sig["live"],
+        }
+
     # ---- the decision ----------------------------------------------------
 
     def desired(self, stats: Sequence[Optional[Dict]], current: int,
@@ -121,6 +150,8 @@ class ReplicaPolicy:
         sig = self.signals(stats)
         if sig["live"] == 0:
             return current  # blind tick: never act on no data
+        if cfg.signal_window_s > 0:
+            sig = self._windowed(sig, now)
         delay = sig["queue_delay_s"]
         hot = (sig["kv_pressure"] > cfg.kv_pressure_high
                or (delay >= 0 and delay > cfg.queue_delay_high_s)

@@ -1,0 +1,89 @@
+"""Declarative alert table evaluated by the GCS alert tick.
+
+Each rule is a plain dict literal (graftlint's ``alert-def`` pass parses
+this file statically — keep rules literal, no computed fields):
+
+* ``name`` — stable rule id; the firing/resolved event signature and the
+  backticked row key in the docs/observability.md alert table.
+* ``series`` — a metric name declared in ``runtime/metric_defs.py`` (the
+  lint pass rejects rules referencing undeclared series).
+* ``kind`` — ``"threshold"``: one windowed aggregate compared against a
+  bound; ``"burn_rate"``: a multi-window SLO burn-rate rule over a
+  latency histogram (short AND long window must both burn faster than
+  ``threshold`` x the error budget — the classic two-window guard
+  against both slow burns and single-tick blips).
+* ``tags`` — optional subset filter on the series' tag sets.
+* ``severity`` — one of the cluster-event severities.
+
+Threshold rules add ``agg`` (``rate``/``delta``/``mean``/``pNN``),
+``window_s``, ``op`` (``>``/``>=``/``<``/``<=``) and ``threshold``.
+Burn-rate rules add ``slo_ms`` (an observation above this breaches the
+SLO), ``objective`` (e.g. 0.99 -> 1% error budget), ``short_window_s``,
+``long_window_s`` and ``threshold`` (the burn-rate multiple).
+
+Evaluated every ``alert_eval_interval_s`` on the GCS health loop against
+the metrics-history rings; state transitions emit signature-deduped
+``ALERT_FIRING`` / ``ALERT_RESOLVED`` cluster events and surface in
+``state.summary()["alerts"]``. See "Metric history, link utilization &
+alerts" in docs/observability.md.
+"""
+
+ALERT_RULES = [
+    {
+        "name": "slo_burn_ttft",
+        "series": "ray_tpu_llm_ttft_breakdown_ms",
+        "kind": "burn_rate",
+        "slo_ms": 1000.0,
+        "objective": 0.99,
+        "short_window_s": 30.0,
+        "long_window_s": 300.0,
+        "threshold": 10.0,
+        "severity": "ERROR",
+        "summary": "TTFT SLO error budget burning >=10x too fast",
+    },
+    {
+        "name": "slo_burn_itl",
+        "series": "ray_tpu_llm_itl_breakdown_ms",
+        "kind": "burn_rate",
+        "slo_ms": 200.0,
+        "objective": 0.99,
+        "short_window_s": 30.0,
+        "long_window_s": 300.0,
+        "threshold": 10.0,
+        "severity": "WARNING",
+        "summary": "inter-token latency SLO budget burning >=10x too fast",
+    },
+    {
+        "name": "oom_kill_burst",
+        "series": "ray_tpu_oom_kills_total",
+        "kind": "threshold",
+        "agg": "rate",
+        "window_s": 120.0,
+        "op": ">",
+        "threshold": 0.0,
+        "severity": "WARNING",
+        "summary": "memory monitor is killing workers",
+    },
+    {
+        "name": "llm_requests_shed",
+        "series": "ray_tpu_llm_router_shed_total",
+        "kind": "threshold",
+        "agg": "rate",
+        "window_s": 60.0,
+        "op": ">",
+        "threshold": 0.0,
+        "severity": "WARNING",
+        "summary": "SLO admission is rejecting requests (fleet saturated)",
+    },
+    {
+        "name": "task_events_dropped",
+        "series": "ray_tpu_task_events_dropped_total",
+        "kind": "threshold",
+        "agg": "rate",
+        "window_s": 120.0,
+        "op": ">",
+        "threshold": 0.0,
+        "severity": "WARNING",
+        "summary": "task-event buffers overflowing before flush",
+    },
+]

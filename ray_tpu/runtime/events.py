@@ -54,6 +54,13 @@ Emitters in-tree:
                  committer — with step, world, bytes, snapshot_ms and
                  persist_ms labels so dashboards attribute train-step
                  stall vs background persist cost)
+  * GCS        — ALERT_FIRING / ALERT_RESOLVED (the alert evaluator
+                 tick found a rule from runtime/alert_defs.py crossing /
+                 leaving its windowed predicate over the metrics-history
+                 rings; labels carry the rule, series, observed value,
+                 threshold and the top contributing node — signature-
+                 deduped, so an ongoing condition emits once and a
+                 recovered one emits exactly one RESOLVED)
 
 Read back via `state.list_cluster_events()`, the dashboard
 `/api/events` route, or `python -m ray_tpu.scripts events`.
@@ -92,13 +99,16 @@ LLM_PREFIX_SPILLED = "LLM_PREFIX_SPILLED"
 LLM_PREFIX_ADOPTED = "LLM_PREFIX_ADOPTED"
 RLHF_PLACEMENT_SWITCH = "RLHF_PLACEMENT_SWITCH"
 CHECKPOINT_SAVED = "CHECKPOINT_SAVED"
+ALERT_FIRING = "ALERT_FIRING"
+ALERT_RESOLVED = "ALERT_RESOLVED"
 EVENT_TYPES = (NODE_DEAD, NODE_DRAINING, NODE_PREEMPTED, SLICE_LOST,
                OOM_KILL, COLLECTIVE_ABORT,
                AUTOSCALER_SCALE, TRAIN_GANG_RESTART, TASK_STALLED,
                DEADLOCK_DETECTED, LLM_REQUEST_SHED, LLM_REQUEST_FAILOVER,
                LLM_SESSION_MIGRATED, LLM_REPLICA_EJECTED,
                LLM_REPLICAS_SCALED, LLM_PREFIX_SPILLED, LLM_PREFIX_ADOPTED,
-               RLHF_PLACEMENT_SWITCH, CHECKPOINT_SAVED)
+               RLHF_PLACEMENT_SWITCH, CHECKPOINT_SAVED,
+               ALERT_FIRING, ALERT_RESOLVED)
 
 
 def make_event(event_type: str, message: str, *,

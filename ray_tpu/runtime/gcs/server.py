@@ -13,6 +13,7 @@ is `self._kv` + the table dicts.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import time
 import zlib
@@ -865,6 +866,9 @@ class GcsServer:
                 self._store.delete("kv", key)
             except Exception:
                 pass
+        # ... and its time-series rings: a dead node's history would only
+        # pin ring budget that live reporters need.
+        self._mh_purge_reporter(f"{node_id.hex()}:")
         # Same hygiene for the cluster prefix table, in the SAME tick: a
         # dead node's replicas never touch their spilled prefixes again,
         # so their live-owner hints must not survive to misroute a router
@@ -972,6 +976,14 @@ class GcsServer:
                     self._stall_detector_tick()
                 except Exception:
                     logger.exception("stall detector tick failed")
+            # So does the alert evaluator (rules over the history rings).
+            last = getattr(self, "_last_alert_tick", 0.0)
+            if now - last >= cfg().alert_eval_interval_s:
+                self._last_alert_tick = now
+                try:
+                    self._alert_eval_tick()
+                except Exception:
+                    logger.exception("alert evaluator tick failed")
 
     # ---- KV (function/class table, runtime metadata) ---------------------
 
@@ -995,6 +1007,12 @@ class GcsServer:
 
         msg = wire.MetricsReportMsg.decode(m)
         self._kv[f"metrics:{msg.node}:{msg.pid}".encode()] = msg.payload
+        try:
+            self._ingest_metrics_history(msg.node, msg.pid, msg.payload)
+        except Exception:
+            # History is an overlay on the snapshot plane; a malformed
+            # payload must not fail the flush the snapshot path accepted.
+            logger.exception("metrics history ingest failed")
         return {"ok": True}
 
     async def handle_kv_get(self, conn, key: bytes):
@@ -1006,6 +1024,527 @@ class GcsServer:
 
     async def handle_kv_keys(self, conn, prefix: bytes = b""):
         return {"keys": [k for k in self._kv if k.startswith(prefix)]}
+
+    # ---- metrics history plane -------------------------------------------
+    #
+    # Every MetricsReportMsg flush is additionally folded into crc32-sharded
+    # fixed-budget time-series rings (the task-event `gcs_ring_shards`
+    # pattern): counters/gauges store (ts, cumulative value) points per
+    # (series, tag set, reporter), histograms store per-flush bucket DELTAS
+    # so any window's distribution — and therefore any quantile — can be
+    # reconstructed by summing deltas. The whole structure is byte-capped
+    # (`metrics_history_max_bytes`), evicting oldest points first. Zero new
+    # wire frames: the payload is the same JSON the snapshot plane already
+    # ships; history only changes what the GCS *keeps*.
+
+    _MH_POINT_COST = 32          # rough bytes per scalar (ts, value) point
+
+    def _metrics_history_shards(self) -> list:
+        shards = getattr(self, "_mh_shards", None)
+        if shards is None:
+            from ray_tpu.config import cfg
+
+            n = max(1, cfg().gcs_ring_shards)
+            per = max(4096, cfg().metrics_history_max_bytes // n)
+            shards = self._mh_shards = [
+                {"series": {}, "bytes": 0, "budget": per} for _ in range(n)]
+            self._mh_prev_hist = {}   # reporter -> {series key: cumulative}
+            self._mh_flushes = 0
+            self._mh_evicted_points = 0
+        return shards
+
+    def _mh_shard_for(self, skey: str) -> dict:
+        shards = self._metrics_history_shards()
+        return shards[zlib.crc32(skey.encode()) % len(shards)]
+
+    def _ingest_metrics_history(self, node: str, pid: int, payload: bytes,
+                                now: float = None):
+        from ray_tpu.config import cfg
+
+        if not cfg().metrics_history_enabled:
+            return
+        snaps = json.loads(payload)
+        if now is None:
+            now = time.time()
+        reporter = f"{node}:{pid}"
+        self._metrics_history_shards()
+        self._mh_flushes += 1
+        prev_hist = self._mh_prev_hist.setdefault(reporter, {})
+        touched = set()
+        for snap in snaps:
+            name, typ = snap.get("name"), snap.get("type")
+            if not name:
+                continue
+            if typ == "histogram":
+                boundaries = snap.get("boundaries") or []
+                for tkey, h in (snap.get("histograms") or {}).items():
+                    skey = f"{name}|{tkey}|{reporter}"
+                    cur = (list(h.get("buckets") or []),
+                           float(h.get("sum", 0.0)), int(h.get("count", 0)))
+                    last = prev_hist.get(skey)
+                    prev_hist[skey] = cur
+                    if last is not None and cur[2] >= last[2] \
+                            and len(cur[0]) == len(last[0]):
+                        dcount = cur[2] - last[2]
+                        if dcount == 0:
+                            continue      # idle flush: store nothing
+                        delta = ([max(0, c - p)
+                                  for c, p in zip(cur[0], last[0])],
+                                 max(0.0, cur[1] - last[1]), dcount)
+                    else:
+                        # First sight, or the reporter restarted (pid
+                        # reuse): the whole cumulative state is the delta.
+                        delta = cur
+                        if delta[2] == 0:
+                            continue
+                    rec = self._mh_series(skey, name, tkey, reporter,
+                                          "histogram", boundaries)
+                    rec["points"].append(
+                        (now, tuple(delta[0]), delta[1], delta[2]))
+                    shard = self._mh_shard_for(skey)
+                    shard["bytes"] += rec["psize"]
+                    touched.add(id(shard))
+            elif typ in ("counter", "gauge"):
+                for tkey, v in (snap.get("values") or {}).items():
+                    skey = f"{name}|{tkey}|{reporter}"
+                    rec = self._mh_series(skey, name, tkey, reporter, typ)
+                    pts = rec["points"]
+                    # An idle counter repeats its cumulative value every
+                    # flush; storing the repeats buys nothing (rate/delta
+                    # fold consecutive differences). Gauges keep every
+                    # sample — a flat gauge is data, "no samples" is not.
+                    if typ == "counter" and pts and pts[-1][1] == v:
+                        continue
+                    pts.append((now, float(v)))
+                    shard = self._mh_shard_for(skey)
+                    shard["bytes"] += rec["psize"]
+                    touched.add(id(shard))
+        for shard in self._mh_shards:
+            if id(shard) in touched and shard["bytes"] > shard["budget"]:
+                self._mh_evict(shard)
+
+    def _mh_series(self, skey: str, name: str, tkey: str, reporter: str,
+                   kind: str, boundaries=None) -> dict:
+        from collections import deque
+
+        shard = self._mh_shard_for(skey)
+        rec = shard["series"].get(skey)
+        if rec is None:
+            psize = (self._MH_POINT_COST if boundaries is None
+                     else 48 + 8 * (len(boundaries) + 1))
+            try:
+                tagmap = dict(json.loads(tkey))
+            except Exception:
+                tagmap = {}
+            rec = shard["series"][skey] = {
+                "name": name, "tags": tagmap, "reporter": reporter,
+                "kind": kind, "boundaries": list(boundaries or ()),
+                "points": deque(), "psize": psize}
+        return rec
+
+    def _mh_evict(self, shard: dict):
+        """Oldest-window eviction: while the shard is over budget, drop
+        points from the head of whichever series currently holds the
+        oldest one (batched so a large overshoot is not O(n) min-scans)."""
+        series = shard["series"]
+        while shard["bytes"] > shard["budget"] and series:
+            rec = min(series.values(), key=lambda r: r["points"][0][0])
+            pts = rec["points"]
+            drop = max(8, len(pts) // 16)
+            while drop and pts and shard["bytes"] > shard["budget"]:
+                pts.popleft()
+                shard["bytes"] -= rec["psize"]
+                self._mh_evicted_points += 1
+                drop -= 1
+            if not pts:
+                for k, r in list(series.items()):
+                    if r is rec:
+                        del series[k]
+                        break
+
+    def _mh_purge_reporter(self, who: str):
+        """Drop every history series for one reporter — an exact
+        `node:pid` (worker death) or a `node:` prefix (node death; the
+        trailing colon keeps pid 123 from shadowing pid 1234)."""
+        def match(reporter: str) -> bool:
+            return (reporter == who
+                    or (who.endswith(":") and reporter.startswith(who)))
+
+        for shard in getattr(self, "_mh_shards", None) or ():
+            stale = [k for k, r in shard["series"].items()
+                     if match(r["reporter"])]
+            for k in stale:
+                rec = shard["series"].pop(k)
+                shard["bytes"] -= rec["psize"] * len(rec["points"])
+        prev = getattr(self, "_mh_prev_hist", None) or {}
+        for reporter in [r for r in prev if match(r)]:
+            del prev[reporter]
+
+    def _mh_match(self, name: str, tags=None) -> list:
+        """Every series record for `name` whose tag set contains `tags`."""
+        out = []
+        for shard in self._metrics_history_shards():
+            for rec in shard["series"].values():
+                if rec["name"] != name:
+                    continue
+                if tags and any(rec["tags"].get(k) != v
+                                for k, v in tags.items()):
+                    continue
+                out.append(rec)
+        return out
+
+    @staticmethod
+    def _mh_counter_delta(points, cutoff: float) -> float:
+        """Sum of positive increments landing inside the window. The last
+        pre-window point is the baseline, so an increment that *crossed*
+        the window edge counts; resets (process restart) clamp to 0
+        instead of going negative."""
+        total, prev = 0.0, None
+        for ts, v in points:
+            if prev is not None and ts >= cutoff:
+                total += max(0.0, v - prev)
+            prev = v
+        return total
+
+    def _mh_window(self, name: str, tags=None, window_s: float = 60.0,
+                   agg: str = None, now: float = None):
+        """One windowed aggregate over every matching series, plus the
+        per-node contribution split (alert attribution, link matrix).
+
+        agg: counters `rate` (default) / `delta`; gauges `mean` (default)
+        / `last`; histograms `pNN` (p99 default) / `mean` / `rate`
+        (observations per second). Returns (value_or_None, by_node dict,
+        extras dict)."""
+        if now is None:
+            now = time.time()
+        cutoff = now - max(window_s, 1e-9)
+        recs = self._mh_match(name, tags)
+        if not recs:
+            return None, {}, {"series": 0}
+        kind = recs[0]["kind"]
+        by_node: Dict[str, float] = {}
+
+        def book(rec, amount):
+            node = rec["reporter"].split(":", 1)[0]
+            by_node[node] = by_node.get(node, 0.0) + amount
+
+        if kind == "histogram":
+            boundaries, buckets = [], []
+            total_sum = total_count = 0.0
+            for rec in recs:
+                if not boundaries and rec["boundaries"]:
+                    boundaries = rec["boundaries"]
+                    buckets = [0.0] * (len(boundaries) + 1)
+                contrib = 0.0
+                for ts, db, dsum, dcount in rec["points"]:
+                    if ts < cutoff:
+                        continue
+                    if len(db) == len(buckets):
+                        for i, c in enumerate(db):
+                            buckets[i] += c
+                    total_sum += dsum
+                    total_count += dcount
+                    contrib += dcount
+                book(rec, contrib)
+            extras = {"series": len(recs), "count": total_count,
+                      "sum": total_sum, "boundaries": boundaries,
+                      "buckets": buckets}
+            if total_count <= 0:
+                return None, by_node, extras
+            agg = agg or "p99"
+            if agg == "mean":
+                return total_sum / total_count, by_node, extras
+            if agg in ("rate", "delta"):
+                val = (total_count if agg == "delta"
+                       else total_count / window_s)
+                return val, by_node, extras
+            if agg.startswith("p"):
+                from ray_tpu.util.metrics import histogram_quantile
+
+                q = float(agg[1:]) / 100.0
+                return (histogram_quantile(boundaries, buckets, q),
+                        by_node, extras)
+            raise ValueError(f"unknown histogram agg {agg!r}")
+        if kind == "counter":
+            agg = agg or "rate"
+            if agg not in ("rate", "delta"):
+                raise ValueError(f"unknown counter agg {agg!r}")
+            total = 0.0
+            for rec in recs:
+                d = self._mh_counter_delta(rec["points"], cutoff)
+                book(rec, d)
+                total += d
+            value = total if agg == "delta" else total / window_s
+            return value, by_node, {"series": len(recs)}
+        # gauge
+        agg = agg or "mean"
+        if agg not in ("mean", "last"):
+            raise ValueError(f"unknown gauge agg {agg!r}")
+        vals = []
+        for rec in recs:
+            pts = [v for ts, v in rec["points"] if ts >= cutoff]
+            if not pts and rec["points"]:
+                # A quiet gauge still has a current value: fall back to
+                # its most recent sample so `mean` reflects level, not
+                # flush cadence.
+                pts = [rec["points"][-1][1]]
+            if pts:
+                per = pts[-1] if agg == "last" else sum(pts) / len(pts)
+                vals.append(per)
+                book(rec, per)
+        if not vals:
+            return None, by_node, {"series": len(recs)}
+        return sum(vals) / len(vals), by_node, {"series": len(recs)}
+
+    async def handle_metrics_history(self, conn, name: str, tags=None,
+                                     window_s: float = 60.0, agg=None,
+                                     points_limit: int = 240):
+        """Windowed query over the history rings (`state.metrics_history`
+        / `scripts metrics` / dashboard sparklines). Returns the aggregate
+        plus the raw per-series point tails for plotting."""
+        value, by_node, extras = self._mh_window(
+            name, tags=tags, window_s=window_s, agg=agg)
+        series = []
+        for rec in self._mh_match(name, tags):
+            pts = list(rec["points"])[-max(1, points_limit):]
+            if rec["kind"] == "histogram":
+                # Per-flush mean: the plottable scalar a bucket-delta
+                # point reduces to.
+                plotted = [[ts, (dsum / dcount) if dcount else 0.0]
+                           for ts, _db, dsum, dcount in pts]
+            else:
+                plotted = [[ts, v] for ts, v in pts]
+            series.append({"name": rec["name"], "tags": rec["tags"],
+                           "reporter": rec["reporter"], "kind": rec["kind"],
+                           "points": plotted})
+        return {"name": name, "window_s": window_s, "agg": agg,
+                "value": value, "by_node": by_node, "series": series,
+                **{k: v for k, v in extras.items()
+                   if k in ("count", "sum")}}
+
+    async def handle_metrics_history_stats(self, conn):
+        """Ingest-side health of the history plane (budget pressure,
+        eviction churn) — `handle_task_event_stats` symmetry."""
+        shards = getattr(self, "_mh_shards", None) or []
+        return {
+            "shards": len(shards),
+            "series": sum(len(s["series"]) for s in shards),
+            "points": sum(len(r["points"]) for s in shards
+                          for r in s["series"].values()),
+            "bytes": sum(s["bytes"] for s in shards),
+            "budget_bytes": sum(s["budget"] for s in shards),
+            "evicted_points": getattr(self, "_mh_evicted_points", 0),
+            "flushes_ingested": getattr(self, "_mh_flushes", 0),
+        }
+
+    async def handle_link_utilization(self, conn, window_s: float = 30.0):
+        """Observed per-link bandwidth matrix, derived from the (op, algo)-
+        tagged collective byte counters in the history rings and attributed
+        to topology links: a slice-labeled node's traffic rides the ICI
+        ring link toward its worker-id successor (rx from its predecessor),
+        an unlabeled node's traffic is host/DCN egress. This is the feed
+        for the ROADMAP-3 contention model — schedulers act on measured
+        goodput per link, not instantaneous readings."""
+        now = time.time()
+        cutoff = now - max(window_s, 1e-9)
+        # node hex -> (slice, worker index) from the live node table.
+        slices: Dict[str, list] = {}
+        place: Dict[str, tuple] = {}
+        for nid, rec in self._nodes.items():
+            if not rec.alive:
+                continue
+            sl = rec.labels.get("tpu-slice-name")
+            if sl is None:
+                continue
+            try:
+                w = int(rec.labels.get("tpu-worker-id", -1))
+            except (TypeError, ValueError):
+                w = -1
+            if w >= 0:
+                place[nid.hex()] = (sl, w)
+                slices.setdefault(sl, []).append(w)
+        for sl in slices:
+            slices[sl] = sorted(set(slices[sl]))
+        links: Dict[str, dict] = {}
+        nodes: Dict[str, dict] = {}
+
+        def link_rec(key, kind, slice_name=None):
+            return links.setdefault(key, {
+                "link": key, "kind": kind, "slice": slice_name,
+                "tx_bytes_per_s": 0.0, "rx_bytes_per_s": 0.0, "by_op": {}})
+
+        for direction, metric in (
+                ("tx", "ray_tpu_collective_bytes_sent_total"),
+                ("rx", "ray_tpu_collective_bytes_recv_total")):
+            for rec in self._mh_match(metric):
+                rate = self._mh_counter_delta(
+                    rec["points"], cutoff) / window_s
+                if rate <= 0:
+                    continue
+                node = rec["reporter"].split(":", 1)[0]
+                nrec = nodes.setdefault(node, {"tx_bytes_per_s": 0.0,
+                                               "rx_bytes_per_s": 0.0})
+                nrec[f"{direction}_bytes_per_s"] += rate
+                sl_w = place.get(node)
+                if sl_w and len(slices.get(sl_w[0], ())) > 1:
+                    sl, w = sl_w
+                    ring = slices[sl]
+                    pos = ring.index(w)
+                    peer = (ring[(pos + 1) % len(ring)] if direction == "tx"
+                            else ring[(pos - 1) % len(ring)])
+                    lo, hi = (w, peer) if direction == "tx" else (peer, w)
+                    key = f"ici:{sl}:{lo}->{hi}"
+                    lrec = link_rec(key, "ici", sl)
+                else:
+                    key = f"host:{node[:12]}"
+                    lrec = link_rec(key, "host")
+                lrec[f"{direction}_bytes_per_s"] += rate
+                op = "/".join(str(rec["tags"].get(k, "?"))
+                              for k in ("op", "algo"))
+                lrec["by_op"][op] = lrec["by_op"].get(op, 0.0) + rate
+        return {"window_s": window_s,
+                "links": sorted(links.values(), key=lambda l: l["link"]),
+                "nodes": nodes}
+
+    # ---- alert evaluator (runtime/alert_defs.py) -------------------------
+
+    def _alert_eval_tick(self, now: float = None):
+        """Walk the declarative alert table against the history rings.
+        Signature-dedup mirrors the stall detector — an ongoing condition
+        emits ALERT_FIRING once — but a signature LEAVING the active set
+        additionally emits ALERT_RESOLVED (the stall detector retires
+        silently; an alert's all-clear is itself a signal)."""
+        from ray_tpu.runtime import alert_defs
+        from ray_tpu.runtime import events as events_mod
+
+        if now is None:
+            now = time.time()
+        sigs = getattr(self, "_alert_sigs", None)
+        if sigs is None:
+            sigs = self._alert_sigs = set()
+        state = getattr(self, "_alert_state", None)
+        if state is None:
+            state = self._alert_state = {}
+        active = set()
+        for rule in alert_defs.ALERT_RULES:
+            name = rule["name"]
+            try:
+                firing, value, by_node = self._alert_eval_rule(rule, now)
+            except Exception:
+                logger.exception("alert rule %s evaluation failed", name)
+                continue
+            st = state.setdefault(name, {"state": "ok", "since": None})
+            st.update({"value": value, "severity": rule["severity"],
+                       "series": rule["series"], "summary":
+                       rule.get("summary", ""), "checked": now})
+            if not firing:
+                st["state"], st["since"] = "ok", None
+                continue
+            active.add(name)
+            if st["state"] != "firing":
+                st["since"] = now
+            st["state"] = "firing"
+            if name in sigs:
+                continue
+            sigs.add(name)
+            top_node = max(by_node, key=by_node.get) if by_node else None
+            labels = {"rule": name, "series": rule["series"],
+                      "value": f"{value:.6g}" if value is not None else "",
+                      "threshold": str(rule.get("threshold", "")),
+                      "kind": rule.get("kind", "threshold")}
+            if rule.get("tags"):
+                labels.update({f"tag_{k}": str(v)
+                               for k, v in rule["tags"].items()})
+            self._record_event(events_mod.make_event(
+                events_mod.ALERT_FIRING,
+                f"alert {name}: {rule.get('summary', rule['series'])} "
+                f"(value {value:.6g} vs threshold "
+                f"{rule.get('threshold')})" if value is not None else
+                f"alert {name}: {rule.get('summary', rule['series'])}",
+                severity=rule["severity"], source="gcs",
+                node_id=top_node, labels=labels))
+            logger.warning("ALERT_FIRING %s value=%s", name, value)
+        for name in sorted(sigs - active):
+            st = state.get(name, {})
+            self._record_event(events_mod.make_event(
+                events_mod.ALERT_RESOLVED,
+                f"alert {name} resolved",
+                severity=events_mod.INFO, source="gcs",
+                labels={"rule": name, "series": st.get("series", "")}))
+            logger.info("ALERT_RESOLVED %s", name)
+        sigs.intersection_update(active)
+
+    def _alert_eval_rule(self, rule: dict, now: float):
+        """Evaluate one rule. Returns (firing, observed value, by_node)."""
+        tags = rule.get("tags")
+        if rule.get("kind") == "burn_rate":
+            short, s_node = self._mh_burn_rate(
+                rule["series"], tags, rule["slo_ms"], rule["objective"],
+                rule["short_window_s"], now)
+            long, _ = self._mh_burn_rate(
+                rule["series"], tags, rule["slo_ms"], rule["objective"],
+                rule["long_window_s"], now)
+            # Both windows must burn: the long window filters single-tick
+            # blips, the short one makes recovery resolve promptly.
+            if short is None or long is None:
+                return False, short, s_node
+            thr = rule["threshold"]
+            return (short >= thr and long >= thr), short, s_node
+        value, by_node, _ = self._mh_window(
+            rule["series"], tags=tags, window_s=rule["window_s"],
+            agg=rule.get("agg"), now=now)
+        if value is None:
+            return False, None, by_node
+        op = rule.get("op", ">")
+        thr = rule["threshold"]
+        firing = {"<": value < thr, "<=": value <= thr,
+                  ">": value > thr, ">=": value >= thr}[op]
+        return firing, value, by_node
+
+    def _mh_burn_rate(self, series: str, tags, slo_ms: float,
+                      objective: float, window_s: float, now: float):
+        """SLO burn rate over one window: the fraction of observations
+        breaching the SLO, divided by the error budget (1 - objective).
+        1.0 = burning exactly at budget; 10x = the window's traffic would
+        exhaust a month's budget in ~3 days. None = no traffic (a silent
+        service is not burning)."""
+        _, by_node, extras = self._mh_window(
+            series, tags=tags, window_s=window_s, agg="mean", now=now)
+        total = extras.get("count") or 0.0
+        if total <= 0:
+            return None, by_node
+        boundaries = extras.get("boundaries") or []
+        buckets = extras.get("buckets") or []
+        breaches = 0.0
+        for i, c in enumerate(buckets):
+            lower = boundaries[i - 1] if i > 0 else 0.0
+            if i >= len(boundaries):
+                lower = boundaries[-1] if boundaries else 0.0
+            if lower >= slo_ms:
+                breaches += c
+        frac = breaches / total
+        return frac / max(1e-9, 1.0 - objective), by_node
+
+    async def handle_list_alerts(self, conn):
+        """Current rule states (`state.summary()["alerts"]` data source).
+        Rules never evaluated yet report state "ok" with no value."""
+        from ray_tpu.runtime import alert_defs
+
+        state = getattr(self, "_alert_state", None) or {}
+        rules = []
+        for rule in alert_defs.ALERT_RULES:
+            st = state.get(rule["name"], {})
+            rules.append({
+                "name": rule["name"], "series": rule["series"],
+                "kind": rule.get("kind", "threshold"),
+                "severity": rule["severity"],
+                "summary": rule.get("summary", ""),
+                "state": st.get("state", "ok"),
+                "since": st.get("since"), "value": st.get("value"),
+                "threshold": rule.get("threshold"),
+            })
+        return {"rules": rules,
+                "firing": sorted(getattr(self, "_alert_sigs", ()) or ())}
 
     # ---- pubsub ----------------------------------------------------------
 
@@ -1602,12 +2141,28 @@ class GcsServer:
         return {"ok": True}
 
     async def handle_report_worker_death(self, conn, node_id, worker_id, actor_id=None,
-                                         reason=""):
+                                         reason="", pid=None):
         """Raylet tells us a worker process exited (node_manager death path).
         Republished on the 'worker_death' channel so object owners can prune
-        dead borrowers (reference_count.h borrower-failure handling)."""
+        dead borrowers (reference_count.h borrower-failure handling).
+
+        When the raylet names the dead worker's os pid, the reporter's
+        `metrics:<node>:<pid>` snapshot and its history rings are purged
+        here — the per-worker flavor of the dead-node metrics purge (a pid
+        that exited while its node stayed alive would otherwise count
+        toward /metrics aggregation forever)."""
         if actor_id is not None:
             await self._handle_actor_failure(actor_id, reason or "worker died")
+        if pid is not None:
+            node_hex = (node_id.hex() if isinstance(node_id, bytes)
+                        else str(node_id))
+            key = f"metrics:{node_hex}:{pid}".encode()
+            self._kv.pop(key, None)
+            try:
+                self._store.delete("kv", key)
+            except Exception:
+                pass
+            self._mh_purge_reporter(f"{node_hex}:{pid}")
         await self.publish("worker_death", {
             "worker_id": worker_id.hex() if isinstance(worker_id, bytes)
             else worker_id, "reason": reason})

@@ -751,9 +751,11 @@ class Raylet:
                     if not w.ready.is_set():
                         w.ready.set()  # unblock lease waiters; address stays None
                     try:
+                        # pid lets the GCS purge the dead reporter's
+                        # metrics:<node>:<pid> snapshot + history rings.
                         await self.gcs.call("report_worker_death", node_id=self.node_id,
                                             worker_id=w.worker_id, actor_id=w.actor_id,
-                                            reason=reason)
+                                            reason=reason, pid=w.proc.pid)
                     except Exception:
                         pass
                     await self._reclaim_holder_leases(w.worker_id.hex())

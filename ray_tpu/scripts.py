@@ -283,6 +283,48 @@ def cmd_events(args):
     print(json.dumps(events, indent=2, default=str))
 
 
+def cmd_metrics(args):
+    """Windowed queries over the GCS metric-history rings: the aggregate
+    value (rate / delta / mean / quantile-over-window), the per-node
+    split, and a text sparkline per reporter series. The same data backs
+    `state.metrics_history()` and the dashboard's `/api/metrics/history`."""
+    from ray_tpu.state import api
+
+    _connect(args.address)
+    tags = dict(kv.split("=", 1) for kv in args.tag or ())
+    agg = "rate" if args.rate else args.agg
+    out = api.metrics_history(args.series, tags=tags or None,
+                              window_s=args.window, agg=agg)
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+        return
+    value = out.get("value")
+    shown = out.get("agg") or "auto"
+    print(f"{args.series}  window={out['window_s']:g}s  agg={shown}")
+    print(f"  value: {value:.6g}" if value is not None
+          else "  value: (no samples in window)")
+    for node, v in sorted(out.get("by_node", {}).items()):
+        print(f"    node {node[:12]}: {v:.6g}")
+    for s in out.get("series", []):
+        pts = [p[1] for p in s.get("points", ())]
+        tag_txt = ",".join(f"{k}={v}" for k, v in sorted(s["tags"].items()))
+        print(f"  [{s['reporter']}] {tag_txt or '(untagged)'} "
+              f"{_spark(pts)}  n={len(pts)}")
+
+
+def _spark(values, width: int = 40) -> str:
+    """Render a value tail as a unicode sparkline (block elements)."""
+    if not values:
+        return ""
+    tail = values[-width:]
+    lo, hi = min(tail), max(tail)
+    bars = "▁▂▃▄▅▆▇█"
+    if hi - lo < 1e-12:
+        return bars[0] * len(tail)
+    return "".join(bars[int((v - lo) / (hi - lo) * (len(bars) - 1))]
+                   for v in tail)
+
+
 def cmd_microbenchmark(args):
     from ray_tpu.util import microbenchmark
 
@@ -417,6 +459,29 @@ def main(argv=None):
     p = sub.add_parser("stop")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("metrics",
+                       help="windowed metric-history queries: counter "
+                            "rates, gauge means, histogram quantiles "
+                            "reconstructed over a trailing window from "
+                            "the GCS time-series rings")
+    p.add_argument("series",
+                   help="metric name (see runtime/metric_defs.py, e.g. "
+                        "ray_tpu_tasks_finished_total)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--window", type=float, default=60.0,
+                   help="trailing window in seconds (default 60)")
+    p.add_argument("--agg", default=None,
+                   help="aggregate: rate/delta (counters), mean/last "
+                        "(gauges), p50..p99/mean/rate (histograms); "
+                        "default picks by metric kind")
+    p.add_argument("--rate", action="store_true",
+                   help="shorthand for --agg rate")
+    p.add_argument("--tag", action="append", default=None, metavar="K=V",
+                   help="tag subset filter (repeatable)")
+    p.add_argument("--json", action="store_true",
+                   help="full structured reply incl. per-series points")
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("microbenchmark",
                        help="core runtime ops/s (ray_perf.py analog)")

@@ -4,8 +4,10 @@ Reference analog: python/ray/util/state/__init__.py re-exporting the list_*
 API surface."""
 
 from ray_tpu.state.api import (  # noqa: F401
+    cluster_alerts,
     dump_cluster_spans,
     dump_cluster_stacks,
+    link_utilization,
     list_actors,
     list_cluster_events,
     list_cluster_objects,
@@ -14,6 +16,7 @@ from ray_tpu.state.api import (  # noqa: F401
     list_objects,
     list_placement_groups,
     list_tasks,
+    metrics_history,
     node_stats,
     summarize_objects,
     summary,

@@ -263,6 +263,42 @@ def wait_graph() -> Dict:
     return _gcs_call("wait_graph")
 
 
+def metrics_history(name: str, tags: Optional[Dict[str, str]] = None,
+                    window_s: float = 60.0, agg: Optional[str] = None,
+                    points_limit: int = 240) -> Dict:
+    """Windowed query over the GCS metric-history rings.
+
+    `name` is a series from runtime/metric_defs.py; `tags` is a subset
+    filter on its tag sets. `agg` picks the windowed aggregate —
+    counters: `rate` (default, per second) / `delta`; gauges: `mean`
+    (default) / `last`; histograms: `p50`/`p90`/`p99`... (p99 default) /
+    `mean` / `rate` — quantiles are reconstructed from the per-flush
+    bucket deltas recorded in the window, not from lifetime cumulative
+    state. Returns the aggregate `value`, the per-node contribution
+    split (`by_node`), and per-reporter point tails (`series`) for
+    plotting. CLI twin: `scripts metrics <series> [--window N]`."""
+    return _gcs_call("metrics_history", name=name, tags=tags,
+                     window_s=window_s, agg=agg, points_limit=points_limit)
+
+
+def link_utilization(window_s: float = 30.0) -> Dict:
+    """Observed per-link bandwidth matrix over the trailing window,
+    derived from the (op, algo)-tagged collective byte counters in the
+    history rings and attributed per ICI ring link (slice-labeled nodes,
+    via their `tpu-worker-id` ring order) or host/DCN egress (unlabeled
+    nodes). The measured-goodput feed for contention-aware placement
+    (ROADMAP item 3)."""
+    return _gcs_call("link_utilization", window_s=window_s)
+
+
+def cluster_alerts() -> Dict:
+    """Current alert-rule states (runtime/alert_defs.py evaluated on the
+    GCS alert tick): every rule with its state (`ok`/`firing`), last
+    observed value, and `since` timestamp, plus the `firing` name list.
+    Transitions land in the event ring as ALERT_FIRING/ALERT_RESOLVED."""
+    return _gcs_call("list_alerts")
+
+
 def dump_cluster_stacks() -> List[dict]:
     """Annotated stack dumps from every process in the cluster.
 
@@ -349,6 +385,15 @@ def summary() -> Dict:
             out["data_ingest"] = ingest
     except Exception:
         pass  # no metrics plane / nothing streamed: leave the key out
+    try:
+        alerts = cluster_alerts()
+        out["alerts"] = {
+            "firing": alerts.get("firing", []),
+            "rules": len(alerts.get("rules", [])),
+        }
+    except Exception:
+        # Older GCS without the alert evaluator: leave the key out.
+        pass
     return out
 
 
@@ -416,11 +461,15 @@ def _aggregate_llm_metrics(snapshots: List[List[dict]]) -> Dict:
     latency-breakdown histograms get a phase-aware rollup instead — their
     `values` entries are per-phase running means, and summing means
     across phases/replicas would be meaningless — so they surface as
-    {phase: mean_ms} maps weighted by observation count."""
+    {phase: mean_ms} maps weighted by observation count, with a p99
+    sibling map reconstructed from the merged bucket counts (the shared
+    `util.metrics.histogram_quantile` helper)."""
     import json
 
+    from ray_tpu.util.metrics import histogram_quantile
+
     sums: Dict[str, float] = {}
-    breakdown: Dict[str, Dict[str, List[float]]] = {}
+    breakdown: Dict[str, Dict[str, list]] = {}
     replicas = set()
     for snap in snapshots:
         for metric in snap:
@@ -429,15 +478,21 @@ def _aggregate_llm_metrics(snapshots: List[List[dict]]) -> Dict:
                 continue
             if name in _BREAKDOWN_METRICS:
                 dest = breakdown.setdefault(_BREAKDOWN_METRICS[name], {})
+                boundaries = metric.get("boundaries") or []
                 for tag_key, h in metric.get("histograms", {}).items():
                     phase = "?"
                     try:
                         phase = dict(json.loads(tag_key)).get("phase", "?")
                     except Exception:
                         pass
-                    acc = dest.setdefault(phase, [0.0, 0])
+                    acc = dest.setdefault(phase, [0.0, 0, [], boundaries])
                     acc[0] += h.get("sum", 0.0)
                     acc[1] += int(h.get("count", 0))
+                    buckets = h.get("buckets") or []
+                    if not acc[2]:
+                        acc[2] = [0] * len(buckets)
+                    if len(buckets) == len(acc[2]):
+                        acc[2] = [a + b for a, b in zip(acc[2], buckets)]
                 continue
             short = name[len("ray_tpu_llm_"):]
             for tag_key, value in metric.get("values", {}).items():
@@ -448,9 +503,19 @@ def _aggregate_llm_metrics(snapshots: List[List[dict]]) -> Dict:
         return {}
     out = {k: round(v, 1) for k, v in sums.items()}
     for key, phases in breakdown.items():
-        rolled = {p: round(s / c, 3) for p, (s, c) in phases.items() if c}
+        rolled = {p: round(s / c, 3)
+                  for p, (s, c, _b, _bd) in phases.items() if c}
         if rolled:
             out[key] = rolled
+        p99 = {}
+        for p, (_s, c, buckets, boundaries) in phases.items():
+            if not c:
+                continue
+            q = histogram_quantile(boundaries, buckets, 0.99)
+            if q is not None:
+                p99[p] = round(q, 3)
+        if p99:
+            out[key.replace("_ms", "_p99_ms")] = p99
     out["replicas_reporting"] = len(replicas)
     return out
 

@@ -197,6 +197,39 @@ class BoundHistogram:
         self._metric._observe_key(self._key, value)
 
 
+def histogram_quantile(boundaries: List[float], buckets: List[float],
+                       q: float) -> Optional[float]:
+    """Estimate the q-quantile (0..1) from histogram bucket counts.
+
+    ``buckets`` has ``len(boundaries) + 1`` entries — one count per
+    boundary plus the +Inf overflow bucket — exactly the shape
+    ``Histogram.snapshot()`` stores and the GCS history rings replay.
+    Linear interpolation inside the target bucket (the PromQL
+    ``histogram_quantile`` convention); observations in the overflow
+    bucket clamp to the highest finite boundary, and the first bucket
+    interpolates from 0. Returns None on empty input so callers can
+    leave the key out instead of reporting a fake 0.
+    """
+    total = sum(buckets)
+    if total <= 0 or not boundaries:
+        return None
+    q = min(max(q, 0.0), 1.0)
+    rank = q * total
+    cumulative = 0.0
+    for i, count in enumerate(buckets):
+        if count <= 0:
+            continue
+        if cumulative + count >= rank:
+            if i >= len(boundaries):     # +Inf bucket: no upper edge
+                return float(boundaries[-1])
+            lo = boundaries[i - 1] if i > 0 else 0.0
+            hi = boundaries[i]
+            frac = (rank - cumulative) / count
+            return float(lo + (hi - lo) * frac)
+        cumulative += count
+    return float(boundaries[-1])
+
+
 def snapshot_all() -> List[Dict]:
     with _REGISTRY_LOCK:
         metrics = list(_REGISTRY.values())

@@ -294,7 +294,7 @@ def test_summarize_objects_and_memory_cli(cluster, capsys):
 
 _CLI_SUBCOMMANDS = ("start", "job", "timeline", "request", "events",
                     "status", "list", "memory", "stack", "drain", "stop",
-                    "microbenchmark", "lint")
+                    "metrics", "microbenchmark", "lint")
 
 
 @pytest.mark.parametrize("cmd", ("",) + _CLI_SUBCOMMANDS)
